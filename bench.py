@@ -1,0 +1,117 @@
+"""Benchmark entry — prints ONE JSON line the driver records.
+
+Runs a BERT/ERNIE-base-style pretraining step (the north-star workload,
+BASELINE.md: ERNIE-base pretrain tokens/sec/chip) built with the paddle_tpu
+static-graph API and executed as one jitted XLA computation on the available
+device (real TPU chip under axon; CPU otherwise).
+
+MFU accounting: 6 * params * tokens/sec vs chip peak (v5e bf16 ~197 TFLOPs,
+fallback to measured-only on CPU).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
+                    batch=8):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers, nets
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, seq], dtype="int64")
+        pos = layers.data("pos", [-1, seq], dtype="int64")
+        labels = layers.data("labels", [-1, seq, 1], dtype="int64")
+        emb = layers.embedding(ids, size=[vocab, hidden])
+        pemb = layers.embedding(pos, size=[seq, hidden])
+        h = layers.elementwise_add(emb, pemb)
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        for _ in range(layers_n):
+            # self-attention
+            q = layers.fc(h, hidden, num_flatten_dims=2)
+            k = layers.fc(h, hidden, num_flatten_dims=2)
+            v = layers.fc(h, hidden, num_flatten_dims=2)
+            ctx = nets.scaled_dot_product_attention(q, k, v, num_heads=heads)
+            attn_out = layers.fc(ctx, hidden, num_flatten_dims=2)
+            h = layers.layer_norm(layers.elementwise_add(h, attn_out),
+                                  begin_norm_axis=2)
+            # ffn
+            ffn = layers.fc(h, hidden * 4, num_flatten_dims=2, act="gelu")
+            ffn = layers.fc(ffn, hidden, num_flatten_dims=2)
+            h = layers.layer_norm(layers.elementwise_add(h, ffn),
+                                  begin_norm_axis=2)
+        logits = layers.fc(h, vocab, num_flatten_dims=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, labels))
+        static.Adam(learning_rate=1e-4).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    # allow CPU fallback benchmarking when no TPU is reachable
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import paddle_tpu.static as static
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    seq, batch = (512, 8) if on_tpu else (128, 2)
+    layers_n = 12 if on_tpu else 2
+    hidden = 768 if on_tpu else 256
+    heads = 12 if on_tpu else 4
+    vocab = 30522 if on_tpu else 1024
+
+    main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
+                                              heads, batch)
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+
+    def batch_feed():
+        return {
+            "ids": rng.randint(0, vocab, (batch, seq)).astype(np.int64),
+            "pos": np.tile(np.arange(seq), (batch, 1)).astype(np.int64),
+            "labels": rng.randint(0, vocab,
+                                  (batch, seq, 1)).astype(np.int64),
+        }
+
+    with static.scope_guard(scope):
+        exe.run(startup_p)
+        feed = batch_feed()
+        # warmup/compile
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        n_steps = 10 if on_tpu else 3
+        t0 = time.time()
+        for _ in range(n_steps):
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+        np.asarray(out[0])
+        dt = time.time() - t0
+
+    tokens_per_sec = n_steps * batch * seq / dt
+
+    # param count for MFU
+    n_params = sum(
+        int(np.prod(v.shape)) for v in main_p.all_parameters()
+        if v.shape is not None)
+    flops_per_token = 6 * n_params
+    achieved = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 0  # v5e bf16 peak
+    mfu = achieved / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
+                  if on_tpu else "bert_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

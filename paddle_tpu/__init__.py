@@ -1,0 +1,53 @@
+"""paddle_tpu: a TPU-native deep-learning framework with Paddle-Fluid-era
+capabilities, built on JAX/XLA/pjit/Pallas.
+
+The public API mirrors paddle 2.0 (`paddle.*`) plus the fluid static-graph
+API (`paddle_tpu.static`, analog of `paddle.fluid`).  See SURVEY.md for the
+capability inventory this package implements.
+"""
+from .core.dtype import DataType as dtype  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, XLAPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    device_count,
+)
+from .core.program import (  # noqa: F401
+    Program, program_guard, default_main_program, default_startup_program,
+    name_scope,
+)
+from .core.generator import seed  # noqa: F401
+
+# kernel library registers all ops on import
+from .ops import kernels as _kernels  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def _setup_api():
+    """Populate the 2.0-style public namespace lazily as subpackages land."""
+    import importlib
+    for mod in ("dygraph", "tensor", "nn", "optimizer", "static",
+                "distributed", "amp", "metric", "io", "vision", "text",
+                "hapi", "jit", "incubate", "profiler", "utils"):
+        try:
+            importlib.import_module(f".{mod}", __name__)
+        except ImportError:
+            continue
+
+
+_setup_api()
+
+# promote common symbols when available
+try:
+    from .dygraph.base import (  # noqa: F401
+        enable_static, disable_static, in_dynamic_mode, no_grad, grad,
+        to_tensor, Tensor,
+    )
+    from .tensor import *  # noqa: F401,F403
+except ImportError:
+    pass
+try:
+    from .hapi.model import Model  # noqa: F401
+    from .framework_io import save, load  # noqa: F401
+except ImportError:
+    pass

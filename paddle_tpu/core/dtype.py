@@ -1,0 +1,92 @@
+"""Dtype system.
+
+TPU-native analog of the reference dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:104 VarType.Type and
+ /root/reference/paddle/fluid/framework/data_type.h): a small closed set of
+dtypes mapped directly onto JAX/numpy dtypes.  bfloat16 is first-class (it is
+the TPU MXU-native compute type); float16 is kept for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DataType", "convert_dtype", "np_dtype", "jnp_dtype", "is_floating",
+    "is_integer", "core_dtypes",
+]
+
+
+class DataType:
+    """String-keyed dtype registry (matches VarType.Type capability)."""
+    BOOL = "bool"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FP16 = "float16"
+    BF16 = "bfloat16"
+    FP32 = "float32"
+    FP64 = "float64"
+    COMPLEX64 = "complex64"
+    COMPLEX128 = "complex128"
+
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bfloat16": "bfloat16",
+}
+
+_CORE = [
+    "bool", "int8", "uint8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+]
+
+
+def core_dtypes():
+    return list(_CORE)
+
+
+def convert_dtype(dtype) -> str:
+    """Normalise any dtype spec (str, np.dtype, jnp dtype, python type) to the
+    canonical string name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+    elif dtype in (float,):
+        name = "float32"
+    elif dtype in (int,):
+        name = "int64"
+    elif dtype in (bool,):
+        name = "bool"
+    else:
+        name = jnp.dtype(dtype).name
+    if name not in _CORE:
+        raise TypeError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def np_dtype(dtype) -> np.dtype:
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def jnp_dtype(dtype):
+    return jnp.dtype(np_dtype(dtype))
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

@@ -1,0 +1,58 @@
+"""Global RNG seeding (reference: /root/reference/paddle/fluid/framework/
+generator.h:39 per-device Generator; python fluid/generator.py).
+
+TPU-native: a single global seed feeding JAX threefry keys.  Static programs
+derive per-op keys as fold_in(PRNGKey(seed + step), op_uid); dygraph draws
+sequentially from a counter."""
+from __future__ import annotations
+
+import threading
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "seed"):
+        _state.seed = 0
+        _state.counter = 0
+    return _state
+
+
+def seed(s: int):
+    """paddle.seed analog: seed every generator."""
+    st = _get()
+    st.seed = int(s)
+    st.counter = 0
+    from .program import default_main_program, default_startup_program
+    default_main_program().random_seed = int(s)
+    default_startup_program().random_seed = int(s)
+    return st.seed
+
+
+def global_seed() -> int:
+    return _get().seed
+
+
+def next_eager_uid() -> int:
+    """Monotone uid for dygraph op calls (each eager random op gets a fresh
+    key from fold_in(key(seed), uid))."""
+    st = _get()
+    st.counter += 1
+    return st.counter
+
+
+class Generator:
+    """Per-device generator API shim."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def manual_seed(self, s):
+        return seed(s)
+
+    def seed(self):
+        return global_seed()
+
+
+def default_generator():
+    return Generator()

@@ -1,0 +1,76 @@
+"""Build-time shape/dtype inference.
+
+Analog of the reference's per-op InferShape functions
+(/root/reference/paddle/fluid/framework/shape_inference.h), but implemented
+ONCE for all ops: since every kernel is a traceable JAX function, we abstractly
+evaluate it with `jax.eval_shape` on ShapeDtypeStructs built from the input
+VarDescs.  Dynamic dims (-1, the batch dim) are temporarily bound to a
+sentinel size and mapped back to -1 in the outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import get_op_info, OpContext
+from .dtype import np_dtype
+
+# prime, unlikely to appear as a derived static dim
+_SENTINEL = 191
+
+
+def _struct_for(var):
+    if var.shape is None:
+        raise NotImplementedError(f"var {var.name} has no shape")
+    shape = tuple(_SENTINEL if s == -1 else s for s in var.shape)
+    return jax.ShapeDtypeStruct(shape, np_dtype(var.dtype))
+
+
+def infer_shape_for_op(block, op) -> None:
+    """Fill in shape/dtype of op outputs from inputs by abstract evaluation.
+    Called from Block.append_op; silently skips if inputs are incomplete."""
+    info = get_op_info(op.type)
+    if info is None:
+        raise NotImplementedError(op.type)
+
+    ins = {}
+    for slot in info.inputs:
+        names = op.inputs.get(slot.name, [])
+        if not names:
+            if not slot.optional:
+                return  # incomplete op; executor will error later if run
+            ins[slot.name] = [] if slot.duplicable else None
+            continue
+        try:
+            vars_ = [block.var(n) for n in names]
+            structs = [_struct_for(v) for v in vars_]
+        except (KeyError, NotImplementedError):
+            return
+        ins[slot.name] = structs if slot.duplicable else structs[0]
+
+    if info.infer_shape is not None:
+        outs = info.infer_shape(ins, op.attrs)
+    else:
+        ctx = OpContext(seed=0)
+        try:
+            outs = jax.eval_shape(lambda i: info.kernel(i, op.attrs, ctx), ins)
+        except Exception:
+            return
+
+    for slot in info.outputs:
+        names = op.outputs.get(slot.name, [])
+        if not names:
+            continue
+        res = outs.get(slot.name) if isinstance(outs, dict) else None
+        if res is None:
+            continue
+        res_list = res if isinstance(res, (list, tuple)) else [res]
+        for name, st in zip(names, res_list):
+            if st is None:
+                continue
+            try:
+                v = block.var(name)
+            except KeyError:
+                v = block.create_var(name=name)
+            v.shape = tuple(-1 if s == _SENTINEL else s for s in st.shape)
+            v.dtype = jnp.dtype(st.dtype).name

@@ -1,0 +1,517 @@
+"""Graph IR: Program / Block / OpDesc / VarDesc.
+
+TPU-native analog of the reference ProgramDesc IR
+(/root/reference/paddle/fluid/framework/framework.proto:42-217 — OpDesc,
+ VarDesc, BlockDesc, ProgramDesc) and its Python wrappers
+(/root/reference/python/paddle/fluid/framework.py:903 Variable, :1895 Operator,
+ :2486 Block, :3948 Program).
+
+Design differences from the reference (deliberate, TPU-first):
+  * The IR is plain Python dataclass-style objects, serialised to/from a
+    protobuf-compatible dict/JSON form (see serialize/deserialize below).
+    There is no C++ desc mirror: the executor consumes this IR directly by
+    tracing every op's JAX kernel into one XLA computation, so the IR never
+    sits on a hot path.
+  * Shapes are static except dim -1 (batch); XLA requires static shapes, and
+    -1 dims are bound at first `Executor.run` from the feed.
+  * LoD (ragged) metadata is represented host-side only; on-device everything
+    is dense/padded (SURVEY.md §5.7 bucketing/padding strategy).
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+from .dtype import convert_dtype
+
+__all__ = [
+    "VarDesc", "OpDesc", "Block", "Program", "default_main_program",
+    "default_startup_program", "program_guard", "unique_name",
+    "switch_main_program", "switch_startup_program", "name_scope", "OpRole",
+]
+
+
+class OpRole:
+    """Mirrors the reference's op_role attribute used by pipeline/dist passes
+    (/root/reference/python/paddle/fluid/framework.py op_role)."""
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 3
+    Dist = 4
+    LRSched = 16
+    Loss = 256
+
+    KEY = "op_role"
+    VAR_KEY = "op_role_var"
+
+
+class VarDesc:
+    """A named tensor slot in a Block.
+
+    Analog of framework.proto:165 VarDesc + framework.py:903 Variable (merged:
+    the build-time API object and the desc are the same thing here).
+    """
+
+    __slots__ = ("name", "shape", "dtype", "persistable", "stop_gradient",
+                 "is_parameter", "initializer", "trainable", "lod_level",
+                 "is_data", "attrs", "block")
+
+    def __init__(self, name, shape=None, dtype="float32", persistable=False,
+                 stop_gradient=False, is_parameter=False, initializer=None,
+                 trainable=True, lod_level=0, is_data=False, block=None):
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype) if dtype is not None else None
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_parameter = is_parameter
+        # initializer: (op_type, attrs) recorded for the startup program path
+        self.initializer = initializer
+        self.trainable = trainable
+        self.lod_level = lod_level
+        self.is_data = is_data
+        self.attrs = {}
+        self.block = block
+
+    # ---- build-time tensor-like sugar (framework.py math_op_patch parity) ----
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from ..static import layers
+        return layers.cast(self, dtype)
+
+    def _binary(self, op, other, reverse=False):
+        from ..static import layers
+        return layers._binary_op(op, self, other, reverse)
+
+    def __add__(self, o):
+        return self._binary("elementwise_add", o)
+
+    def __radd__(self, o):
+        return self._binary("elementwise_add", o, True)
+
+    def __sub__(self, o):
+        return self._binary("elementwise_sub", o)
+
+    def __rsub__(self, o):
+        return self._binary("elementwise_sub", o, True)
+
+    def __mul__(self, o):
+        return self._binary("elementwise_mul", o)
+
+    def __rmul__(self, o):
+        return self._binary("elementwise_mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binary("elementwise_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("elementwise_div", o, True)
+
+    def __pow__(self, o):
+        return self._binary("elementwise_pow", o)
+
+    def __neg__(self):
+        from ..static import layers
+        return layers.scale(self, scale=-1.0)
+
+    def __matmul__(self, o):
+        from ..static import layers
+        return layers.matmul(self, o)
+
+    def __lt__(self, o):
+        return self._binary("less_than", o)
+
+    def __le__(self, o):
+        return self._binary("less_equal", o)
+
+    def __gt__(self, o):
+        return self._binary("greater_than", o)
+
+    def __ge__(self, o):
+        return self._binary("greater_equal", o)
+
+    def __repr__(self):
+        kind = "param" if self.is_parameter else ("data" if self.is_data else "var")
+        return f"{kind}[{self.name}: {self.dtype}{list(self.shape) if self.shape else '?'}]"
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_parameter": self.is_parameter,
+            "initializer": self.initializer,
+            "trainable": self.trainable,
+            "lod_level": self.lod_level,
+            "is_data": self.is_data,
+        }
+
+    @staticmethod
+    def from_dict(d, block=None):
+        v = VarDesc(d["name"], d["shape"], d["dtype"], d["persistable"],
+                    d["stop_gradient"], d["is_parameter"], d.get("initializer"),
+                    d.get("trainable", True), d.get("lod_level", 0),
+                    d.get("is_data", False), block)
+        return v
+
+
+# Parameter is a VarDesc with is_parameter=True (framework.py:5067 Parameter).
+def Parameter(name, shape, dtype="float32", initializer=None, trainable=True,
+              block=None):
+    return VarDesc(name, shape, dtype, persistable=True, is_parameter=True,
+                   initializer=initializer, trainable=trainable, block=block)
+
+
+class OpDesc:
+    """One operator instance: type + named input/output slots + attrs.
+
+    Analog of framework.proto:42 OpDesc.  Slots map slot-name -> list of var
+    names (duplicable slots hold >1).
+    """
+
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, type: str, inputs: Dict[str, List[str]] = None,
+                 outputs: Dict[str, List[str]] = None, attrs: Dict[str, Any] = None):
+        self.type = type
+        self.inputs = {k: list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input_names(self) -> List[str]:
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self) -> List[str]:
+        return [n for vs in self.outputs.values() for n in vs]
+
+    @property
+    def op_role(self):
+        return self.attrs.get(OpRole.KEY, OpRole.Forward)
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"Op({self.type}: {ins} -> {outs})"
+
+    def to_dict(self):
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": _json_safe_attrs(self.attrs)}
+
+    @staticmethod
+    def from_dict(d):
+        return OpDesc(d["type"], d["inputs"], d["outputs"], d["attrs"])
+
+
+def _json_safe_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (list, tuple)):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+class Block:
+    """Ordered op list + var table (framework.proto:174 BlockDesc)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, VarDesc] = {}
+        self.ops: List[OpDesc] = []
+
+    # -- var management -----------------------------------------------------
+    def create_var(self, name=None, shape=None, dtype="float32", **kw) -> VarDesc:
+        if name is None:
+            name = unique_name("tmp")
+        v = VarDesc(name, shape, dtype, block=self, **kw)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype="float32", initializer=None,
+                         trainable=True) -> VarDesc:
+        p = Parameter(name, shape, dtype, initializer, trainable, block=self)
+        self.vars[name] = p
+        # parameters live in block 0 (global block), like the reference
+        if self.idx != 0:
+            self.program.global_block().vars[name] = p
+        return p
+
+    def var(self, name: str) -> VarDesc:
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = (self.program.blocks[b.parent_idx]
+                 if b.parent_idx >= 0 else None)
+        raise KeyError(f"var {name!r} not found in block {self.idx}")
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.var(name)
+            return True
+        except KeyError:
+            return False
+
+    # -- op management ------------------------------------------------------
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> OpDesc:
+        op = OpDesc(type,
+                    {k: _as_name_list(v) for k, v in (inputs or {}).items()},
+                    {k: _as_name_list(v) for k, v in (outputs or {}).items()},
+                    attrs)
+        op.attrs.setdefault("op_uid", self.program._next_uid())
+        op.attrs.setdefault(OpRole.KEY, self.program._current_op_role)
+        self.ops.append(op)
+        # infer shapes/dtypes of outputs that don't have them yet
+        from .infer_shape import infer_shape_for_op
+        try:
+            infer_shape_for_op(self, op)
+        except NotImplementedError:
+            pass
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> OpDesc:
+        op = self.append_op(type, inputs, outputs, attrs)
+        self.ops.insert(0, self.ops.pop())
+        return op
+
+    def to_dict(self):
+        return {"idx": self.idx, "parent_idx": self.parent_idx,
+                "vars": [v.to_dict() for v in self.vars.values()],
+                "ops": [o.to_dict() for o in self.ops]}
+
+
+def _as_name_list(v):
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [x.name if isinstance(x, VarDesc) else str(x) for x in v]
+    return [v.name if isinstance(v, VarDesc) else str(v)]
+
+
+class Program:
+    """A multi-block op graph (framework.proto:212 ProgramDesc +
+    framework.py:3948 Program)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.random_seed = 0
+        self._uid = 0
+        self._current_block_idx = 0
+        self._current_op_role = OpRole.Forward
+        self._version = 1
+        # populated by append_backward: maps var -> grad var name
+        self._grad_map: Dict[str, str] = {}
+        self._fingerprint_cache = None
+
+    def _next_uid(self) -> int:
+        self._uid += 1
+        self._fingerprint_cache = None
+        return self._uid
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self._current_block_idx]
+
+    def create_block(self, parent_idx=None) -> Block:
+        parent = self._current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self._current_block_idx = b.idx
+        return b
+
+    def rollback(self):
+        self._current_block_idx = self.blocks[self._current_block_idx].parent_idx
+
+    @contextlib.contextmanager
+    def _op_role_guard(self, role):
+        prev = self._current_op_role
+        self._current_op_role = role
+        try:
+            yield
+        finally:
+            self._current_op_role = prev
+
+    def all_parameters(self) -> List[VarDesc]:
+        return [v for b in self.blocks for v in b.vars.values()
+                if v.is_parameter]
+
+    def list_vars(self):
+        return [v for b in self.blocks for v in b.vars.values()]
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = copy.deepcopy(self)
+        p._fingerprint_cache = None
+        if for_test:
+            p._set_test_mode()
+        return p
+
+    def _set_test_mode(self):
+        for b in self.blocks:
+            for op in b.ops:
+                if "is_test" in _TEST_MODE_OPS.get(op.type, ()):
+                    op.attrs["is_test"] = True
+        self._fingerprint_cache = None
+        return self
+
+    def _prune(self, fetch_names: List[str]) -> "Program":
+        """Feed/fetch pruning (analog of framework/prune.cc): keep only ops
+        needed (transitively) to produce `fetch_names` plus all side-effecting
+        ops (optimizer writes to persistables, collectives)."""
+        from ..ops.registry import get_op_info
+        block = self.global_block()
+        needed = set(fetch_names)
+        keep = [False] * len(block.ops)
+        for i in range(len(block.ops) - 1, -1, -1):
+            op = block.ops[i]
+            info = get_op_info(op.type)
+            side_effect = info is not None and info.side_effect
+            writes_persistable = any(
+                block.has_var(n) and block.var(n).persistable
+                for n in op.output_names())
+            if side_effect or writes_persistable or \
+                    any(n in needed for n in op.output_names()):
+                keep[i] = True
+                needed.update(op.input_names())
+        p = copy.deepcopy(self)
+        p._fingerprint_cache = None
+        b0 = p.global_block()
+        b0.ops = [op for i, op in enumerate(b0.ops) if keep[i]]
+        return p
+
+    def fingerprint(self) -> str:
+        if self._fingerprint_cache is None:
+            payload = json.dumps(self.to_dict(), sort_keys=True, default=str)
+            import hashlib
+            self._fingerprint_cache = hashlib.sha1(payload.encode()).hexdigest()
+        return self._fingerprint_cache
+
+    # -- serialization (P19/C22 parity) -------------------------------------
+    def to_dict(self):
+        return {"version": self._version, "random_seed": self.random_seed,
+                "blocks": [b.to_dict() for b in self.blocks]}
+
+    def serialize_to_string(self) -> bytes:
+        return json.dumps(self.to_dict(), sort_keys=True).encode("utf-8")
+
+    @staticmethod
+    def parse_from_string(data: bytes) -> "Program":
+        d = json.loads(data.decode("utf-8"))
+        p = Program()
+        p.random_seed = d.get("random_seed", 0)
+        p._version = d.get("version", 1)
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            for vd in bd["vars"]:
+                b.vars[vd["name"]] = VarDesc.from_dict(vd, b)
+            b.ops = [OpDesc.from_dict(od) for od in bd["ops"]]
+            p.blocks.append(b)
+        p._uid = max((op.attrs.get("op_uid", 0)
+                      for b in p.blocks for op in b.ops), default=0)
+        return p
+
+    def __repr__(self):
+        lines = [f"Program(blocks={len(self.blocks)})"]
+        for b in self.blocks:
+            lines.append(f"  block {b.idx} (parent {b.parent_idx}):")
+            for v in b.vars.values():
+                lines.append(f"    {v!r}")
+            for op in b.ops:
+                lines.append(f"    {op!r}")
+        return "\n".join(lines)
+
+
+# ops whose behaviour flips in test mode (clone(for_test=True))
+_TEST_MODE_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+    "sync_batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# default program registry & guards (framework.py:5311 default_main_program)
+# ---------------------------------------------------------------------------
+class _ProgramState(threading.local):
+    def __init__(self):
+        self.main = Program()
+        self.startup = Program()
+
+
+_state = _ProgramState()
+
+
+def default_main_program() -> Program:
+    return _state.main
+
+
+def default_startup_program() -> Program:
+    return _state.startup
+
+
+def switch_main_program(p: Program) -> Program:
+    prev, _state.main = _state.main, p
+    return prev
+
+
+def switch_startup_program(p: Program) -> Program:
+    prev, _state.startup = _state.startup, p
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    prev_main = switch_main_program(main_program)
+    prev_start = (switch_startup_program(startup_program)
+                  if startup_program is not None else None)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_start is not None:
+            switch_startup_program(prev_start)
+
+
+# ---------------------------------------------------------------------------
+# unique_name (python/paddle/fluid/unique_name.py parity)
+# ---------------------------------------------------------------------------
+class _NameGenerator(threading.local):
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.prefix: List[str] = []
+
+
+_names = _NameGenerator()
+
+
+def unique_name(key: str = "tmp") -> str:
+    full = "/".join(_names.prefix + [key]) if _names.prefix else key
+    n = _names.counters.get(full, 0)
+    _names.counters[full] = n + 1
+    return f"{full}_{n}"
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    _names.prefix.append(prefix)
+    try:
+        yield
+    finally:
+        _names.prefix.pop()
+
+
+def _reset_unique_names():
+    _names.counters.clear()

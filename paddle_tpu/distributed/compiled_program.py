@@ -1,0 +1,271 @@
+"""CompiledProgram.with_data_parallel — the ParallelExecutor analog.
+
+Reference: /root/reference/python/paddle/fluid/compiler.py:87 CompiledProgram
+→ framework/parallel_executor.cc:461 (per-device scopes, NCCL comms, SSA
+graph with AllReduceOpHandle per gradient,
+ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:464 CreateAllReduceOp).
+
+TPU-native redesign: no SSA graph, no per-op scheduler threads.  The program
+is rewritten once — a `c_allreduce_sum` + 1/N scale is inserted on every
+parameter gradient feeding an optimizer op (same insertion point as
+multi_devices_graph_pass.cc:632) — then the WHOLE block is traced under
+`shard_map` over a jax.sharding.Mesh with a "dp" axis: parameters replicated,
+feed batch-sharded, gradients allreduced over ICI by XLA collectives.  The
+scheduler the reference needed (fast_threaded_ssa_graph_executor.cc:59) is
+XLA's problem now; grad bucketing/fusion (fuse_all_reduce_op_pass) is done by
+XLA's collective combiner.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.program import Program, OpRole, unique_name
+from ..ops.registry import get_op_info, OpContext
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "insert_grad_allreduce"]
+
+
+class ReduceStrategy:
+    AllReduce = 0
+    Reduce = 1
+
+
+class GradientScaleStrategy:
+    CoeffNumDevice = 0
+    One = 1
+    Customized = 2
+
+
+class BuildStrategy:
+    """Knob parity with details/build_strategy.h; most toggles are subsumed
+    by XLA (fusion, memory optimization) and kept as accepted no-ops."""
+    ReduceStrategy = ReduceStrategy
+    GradientScaleStrategy = GradientScaleStrategy
+
+    def __init__(self):
+        self.reduce_strategy = ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = GradientScaleStrategy.CoeffNumDevice
+        self.fuse_all_reduce_ops = True      # XLA collective combiner
+        self.fuse_all_optimizer_ops = True   # whole-graph jit subsumes
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.enable_inplace = True           # buffer donation
+        self.memory_optimize = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.cache_runtime_context = True
+        self.trainers_endpoints = []
+        self.debug_graphviz_path = ""
+
+
+class ExecutionStrategy:
+    """details/execution_strategy.h:22 — thread counts are meaningless under
+    XLA; kept for API parity."""
+
+    class ExecutorType:
+        Default = 0
+        Experimental = 1
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 100
+        self.num_iteration_per_run = 1
+        self.allow_op_delay = False
+        self.use_thread_barrier = True
+
+
+def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
+                          scale=True) -> Program:
+    """Insert c_allreduce_sum (+ 1/N scale) on every Grad input of optimizer
+    ops.  Mirrors CreateAllReduceOp insertion
+    (multi_devices_graph_pass.cc:464,:632); returns a rewritten clone."""
+    p = copy.deepcopy(program)
+    block = p.global_block()
+    new_ops = []
+    done: Dict[str, str] = {}
+    for op in block.ops:
+        if op.attrs.get(OpRole.KEY) == OpRole.Optimize and "Grad" in op.inputs:
+            gnames = op.inputs["Grad"]
+            new_gnames = []
+            for g in gnames:
+                if g in done:
+                    new_gnames.append(done[g])
+                    continue
+                red = unique_name(g + "@ALLREDUCE")
+                block.create_var(name=red, stop_gradient=True)
+                from ..core.program import OpDesc
+                ar = OpDesc("c_allreduce_sum", {"X": [g]}, {"Out": [red]},
+                            {"ring_id": 0, OpRole.KEY: OpRole.Dist,
+                             "op_uid": p._next_uid()})
+                new_ops.append(ar)
+                if scale:
+                    scaled = unique_name(g + "@SCALED")
+                    block.create_var(name=scaled, stop_gradient=True)
+                    sc = OpDesc("scale_by_world_size", {"X": [red]},
+                                {"Out": [scaled]},
+                                {"ring_id": 0, OpRole.KEY: OpRole.Dist,
+                                 "op_uid": p._next_uid()})
+                    new_ops.append(sc)
+                    red = scaled
+                done[g] = red
+                new_gnames.append(red)
+            op.inputs["Grad"] = new_gnames
+        new_ops.append(op)
+    block.ops = new_ops
+    return p
+
+
+class CompiledProgram:
+    """compiler.py:87 parity.  `places` defaults to all local devices."""
+
+    def __init__(self, program_or_graph, build_strategy: BuildStrategy = None):
+        self._program: Program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+        self._loss_name = None
+        self._places = None
+        self._share_vars_from = None
+        self._compiled = None  # (key -> jitted)
+        self._cache: Dict[Any, Any] = {}
+        self._mesh: Optional[Mesh] = None
+        self._rewritten: Optional[Program] = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    # -- execution (called from Executor.run) -------------------------------
+    def _devices(self):
+        if self._places is not None:
+            devs = []
+            for pl in self._places:
+                if hasattr(pl, "jax_device"):
+                    devs.append(pl.jax_device())
+                else:
+                    devs.append(pl)
+            return devs
+        return list(jax.devices())
+
+    def _get_mesh(self) -> Mesh:
+        if self._mesh is None:
+            devs = np.array(self._devices())
+            self._mesh = Mesh(devs, ("dp",))
+        return self._mesh
+
+    def _get_program(self) -> Program:
+        if not self._is_data_parallel:
+            return self._program
+        if self._rewritten is None:
+            n = len(self._devices())
+            scale = (self._build_strategy.gradient_scale_strategy ==
+                     GradientScaleStrategy.CoeffNumDevice and n > 1)
+            self._rewritten = insert_grad_allreduce(self._program,
+                                                    scale=scale)
+        return self._rewritten
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        from ..static.executor import (global_scope, BlockTracer,
+                                       _persistable_names)
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in (fetch_list or [])]
+        program = self._get_program()
+        mesh = self._get_mesh()
+        n_dev = len(mesh.devices.flat)
+        block = program.global_block()
+
+        feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
+        state_names = [n for n in _persistable_names(program)
+                       if scope.get(n) is not None]
+        feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                                for n, v in feed_vals.items()))
+        key = (program.fingerprint(), feed_sig, tuple(fetch_names),
+               tuple(state_names), n_dev)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile(program, state_names, sorted(feed_vals),
+                               fetch_names, mesh)
+            self._cache[key] = fn
+
+        state = {n: scope.get(n) for n in state_names}
+        seed = executor._seed_for_step(program)
+        fetches, new_state = fn(state, feed_vals, jnp.uint32(seed))
+        executor._step += 1
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _compile(self, program, state_names, feed_names, fetch_names, mesh):
+        from ..static.executor import BlockTracer
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+        block = program.global_block()
+        tracer = BlockTracer(block)
+        axes = ("dp",)
+
+        def step(state, feed, seed):
+            # decorrelate RNG across replicas (the reference gives each
+            # device worker a distinct seed)
+            local_seed = seed + jnp.uint32(jax.lax.axis_index("dp"))
+            ctx = OpContext(seed=local_seed, mesh_axes=axes,
+                            dist_info={0: "dp"})
+            env = dict(state)
+            env.update(feed)
+            tracer.run(env, ctx)
+            new_state = {n: env[n] for n in state_names}
+            fetches = []
+            for n in fetch_names:
+                v = env[n]
+                # fetch semantics: average across replicas for floats (the
+                # reference concatenates per-device fetches then users mean
+                # them; mean is what every training loop does with loss)
+                if jnp.issubdtype(v.dtype, jnp.inexact):
+                    v = jax.lax.pmean(v, "dp")
+                else:
+                    v = jax.lax.pmax(v, "dp")
+                fetches.append(v)
+            return tuple(fetches), new_state
+
+        state_specs = {n: P() for n in state_names}
+        feed_specs = {n: P("dp") for n in feed_names}
+        fetch_specs = tuple(P() for _ in fetch_names)
+
+        try:
+            sharded = shard_map(
+                step, mesh=mesh,
+                in_specs=(state_specs, feed_specs, P()),
+                out_specs=(fetch_specs, state_specs),
+                check_vma=False)
+        except TypeError:  # older jax spells it check_rep
+            sharded = shard_map(
+                step, mesh=mesh,
+                in_specs=(state_specs, feed_specs, P()),
+                out_specs=(fetch_specs, state_specs),
+                check_rep=False)
+        return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,21 @@
+"""Kernel library: every module registers ops into the global registry on
+import (analog of /root/reference/paddle/fluid/operators/ — but each kernel is
+one traceable JAX function instead of per-device C++/CUDA code)."""
+from . import (  # noqa: F401
+    math,
+    elementwise,
+    activation,
+    reduce,
+    manip,
+    nn,
+    loss,
+    random,
+    optimizers,
+    control,
+    metrics,
+    collective,
+    sequence,
+    amp,
+    rnn,
+    vision,
+)

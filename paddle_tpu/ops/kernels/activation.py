@@ -1,0 +1,108 @@
+"""Activation family (reference macro FOR_EACH_ACTIVATION_OP,
+/root/reference/paddle/fluid/operators/activation_op.cc).  Pure VPU ops —
+XLA fuses them into producers; gradients come from the registry's auto-vjp."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _act(name, fn, grad="auto"):
+    @register_op(name, inputs=["X"], outputs=["Out"], grad=grad)
+    def kernel(ins, attrs, ctx, _fn=fn):
+        return {"Out": _fn(ins["X"], attrs)}
+    return kernel
+
+
+_act("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_act("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_act("relu", lambda x, a: jax.nn.relu(x))
+_act("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_act("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_act("soft_relu", lambda x, a: jnp.log1p(jnp.exp(jnp.clip(
+    x, -a.get("threshold", 40.0), a.get("threshold", 40.0)))))
+_act("tanh", lambda x, a: jnp.tanh(x))
+_act("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_act("stanh", lambda x, a: a.get("scale_b", 1.7159) *
+     jnp.tanh(a.get("scale_a", 0.67) * x))
+_act("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_act("softshrink", lambda x, a: jnp.where(
+    x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+    jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)))
+_act("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_act("hard_swish", lambda x, a: x * jnp.clip(
+    x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)) /
+    a.get("scale", 6.0))
+_act("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_act("softplus", lambda x, a: jax.nn.softplus(x))
+_act("softsign", lambda x, a: x / (1 + jnp.abs(x)))
+_act("sqrt", lambda x, a: jnp.sqrt(x))
+_act("rsqrt", lambda x, a: jax.lax.rsqrt(x))
+_act("abs", lambda x, a: jnp.abs(x))
+_act("ceil", lambda x, a: jnp.ceil(x), grad=None)
+_act("floor", lambda x, a: jnp.floor(x), grad=None)
+_act("round", lambda x, a: jnp.round(x), grad=None)
+_act("reciprocal", lambda x, a: 1.0 / x)
+_act("log", lambda x, a: jnp.log(x))
+_act("log1p", lambda x, a: jnp.log1p(x))
+_act("log2", lambda x, a: jnp.log2(x))
+_act("log10", lambda x, a: jnp.log10(x))
+_act("square", lambda x, a: jnp.square(x))
+_act("exp", lambda x, a: jnp.exp(x))
+_act("sin", lambda x, a: jnp.sin(x))
+_act("cos", lambda x, a: jnp.cos(x))
+_act("sinh", lambda x, a: jnp.sinh(x))
+_act("cosh", lambda x, a: jnp.cosh(x))
+_act("tan", lambda x, a: jnp.tan(x))
+_act("asin", lambda x, a: jnp.arcsin(x))
+_act("acos", lambda x, a: jnp.arccos(x))
+_act("atan", lambda x, a: jnp.arctan(x))
+_act("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, 0.0))
+_act("pow", lambda x, a: jnp.power(x, a.get("factor", 1.0)))
+_act("erf", lambda x, a: jax.scipy.special.erf(x))
+_act("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate",
+                                                           False)))
+_act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_act("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+    x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)))
+_act("silu", lambda x, a: jax.nn.silu(x))
+_act("log_softmax", lambda x, a: jax.nn.log_softmax(x, axis=a.get("axis", -1)))
+
+
+@register_op("prelu", inputs=["X", "Alpha"], outputs=["Out"])
+def prelu(ins, attrs, ctx):
+    x, alpha = ins["X"], ins["Alpha"]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    return {"Out": jnp.where(x > 0, x, alpha * x)}
+
+
+@register_op("leaky_relu", inputs=["X"], outputs=["Out"])
+def leaky_relu(ins, attrs, ctx):
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": jax.nn.leaky_relu(ins["X"], alpha)}
+
+
+@register_op("elu", inputs=["X"], outputs=["Out"])
+def elu(ins, attrs, ctx):
+    return {"Out": jax.nn.elu(ins["X"], attrs.get("alpha", 1.0))}
+
+
+@register_op("maxout", inputs=["X"], outputs=["Out"])
+def maxout(ins, attrs, ctx):
+    x = ins["X"]
+    groups = attrs["groups"]
+    axis = attrs.get("axis", 1)
+    if axis < 0:
+        axis += x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return {"Out": jnp.max(x.reshape(new_shape), axis=axis + 1)}

@@ -1,0 +1,66 @@
+"""AMP support ops (reference: /root/reference/paddle/fluid/operators/amp/
+check_finite_and_unscale_op.cc, update_loss_scaling_op.cc).  On TPU the AMP
+dtype is bfloat16 (wide exponent — loss scaling rarely strictly needed), but
+the full fp16-style dynamic loss-scaling machinery is kept for parity and for
+float16 use."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+@register_op("check_finite_and_unscale", inputs=["X*", "Scale!"],
+             outputs=["Out*", "FoundInfinite"], grad=None, side_effect=True)
+def check_finite_and_unscale(ins, attrs, ctx):
+    xs = ins["X"]
+    scale = ins["Scale"].reshape(()).astype(jnp.float32)
+    inv = 1.0 / scale
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for x in xs:
+        finite = jnp.all(jnp.isfinite(x.astype(jnp.float32)))
+        found = found | ~finite
+        outs.append((x.astype(jnp.float32) * inv).astype(x.dtype))
+    return {"Out": outs, "FoundInfinite": found.reshape(1)}
+
+
+@register_op("update_loss_scaling",
+             inputs=["X*", "FoundInfinite!", "PrevLossScaling!",
+                     "InGoodSteps!", "InBadSteps!"],
+             outputs=["Out*", "LossScaling", "OutGoodSteps", "OutBadSteps"],
+             grad=None, side_effect=True)
+def update_loss_scaling(ins, attrs, ctx):
+    found = ins["FoundInfinite"].reshape(())
+    scale = ins["PrevLossScaling"].reshape(()).astype(jnp.float32)
+    good = ins["InGoodSteps"].reshape(()).astype(jnp.int32)
+    bad = ins["InBadSteps"].reshape(()).astype(jnp.int32)
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    new_good = jnp.where(found, 0, good + 1)
+    new_bad = jnp.where(found, bad + 1, 0)
+    grow = new_good >= incr_every
+    shrink = new_bad >= decr_every
+    new_scale = jnp.where(grow, scale * incr_ratio,
+                          jnp.where(shrink, jnp.maximum(scale * decr_ratio,
+                                                        1.0), scale))
+    new_good = jnp.where(grow | shrink, 0, new_good)
+    new_bad = jnp.where(grow | shrink, 0, new_bad)
+
+    outs = []
+    for x in ins["X"]:
+        # zero out grads when non-finite so the optimizer step is a no-op
+        outs.append(jnp.where(found, jnp.zeros_like(x), x))
+    return {"Out": outs,
+            "LossScaling": new_scale.reshape(ins["PrevLossScaling"].shape),
+            "OutGoodSteps": new_good.reshape(ins["InGoodSteps"].shape),
+            "OutBadSteps": new_bad.reshape(ins["InBadSteps"].shape)}
+
+
+@register_op("cast_with_ptr", inputs=["X"], outputs=["Out"])
+def cast_with_ptr(ins, attrs, ctx):
+    from ...core.dtype import np_dtype
+    return {"Out": ins["X"].astype(np_dtype(attrs["out_dtype"]))}

@@ -1,0 +1,246 @@
+"""Collective ops (reference: /root/reference/paddle/fluid/operators/collective/
+c_allreduce_op.h:124 ncclAllReduce dispatch, c_broadcast_op, c_allgather_op,
+c_reducescatter_op, barrier_op; ring ids from
+platform/collective_helper.h:62 NCCLCommContext).
+
+TPU-native lowering: when the executor traces the program under shard_map over
+a jax.sharding.Mesh, ctx.collective_axes(ring_id) names the mesh axes and the
+ops become XLA collectives over ICI (psum/all_gather/psum_scatter/ppermute).
+Outside any mesh (single-chip), world size is 1 and they are identities —
+the same degenerate behaviour the reference has with one trainer.
+
+The c_sync_*_stream ops are no-ops: XLA owns scheduling, there are no user
+streams to sync (reference needed them because NCCL ran on separate CUDA
+streams).  c_comm_init/c_gen_nccl_id have no TPU equivalent: mesh formation is
+jax.distributed initialization; they are registered as no-ops for program
+compatibility."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _axes(ctx, attrs):
+    return ctx.collective_axes(attrs.get("ring_id", 0))
+
+
+def _c_allreduce(name, op):
+    @register_op(name, inputs=["X"], outputs=["Out"], grad="auto",
+                 side_effect=True)
+    def kernel(ins, attrs, ctx, _op=op):
+        x = ins["X"]
+        axes = _axes(ctx, attrs)
+        if not axes:
+            return {"Out": x}
+        if _op == "sum":
+            return {"Out": jax.lax.psum(x, axes)}
+        if _op == "max":
+            return {"Out": jax.lax.pmax(x, axes)}
+        if _op == "min":
+            return {"Out": jax.lax.pmin(x, axes)}
+        if _op == "prod":
+            return {"Out": jnp.exp(jax.lax.psum(jnp.log(x), axes))}
+        raise ValueError(_op)
+    return kernel
+
+
+_c_allreduce("c_allreduce_sum", "sum")
+_c_allreduce("c_allreduce_max", "max")
+_c_allreduce("c_allreduce_min", "min")
+_c_allreduce("c_allreduce_prod", "prod")
+_c_allreduce("allreduce", "sum")  # legacy distributed_ops/allreduce_op
+_c_allreduce("c_reduce_sum", "sum")   # reduce-to-root approximated as
+_c_allreduce("c_reduce_max", "max")   # allreduce (root semantics preserved
+_c_allreduce("c_reduce_min", "min")   # for the root rank's value)
+_c_allreduce("c_reduce_prod", "prod")
+
+
+@register_op("c_broadcast", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_broadcast(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    # broadcast root's value: select root's shard and psum the rest to it
+    idx = jax.lax.axis_index(axes if isinstance(axes, str) else axes[0])
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": jax.lax.psum(masked, axes)}
+
+
+@register_op("broadcast", inputs=["X"], outputs=["Out"], side_effect=True)
+def broadcast_legacy(ins, attrs, ctx):
+    return c_broadcast(ins, attrs, ctx)
+
+
+@register_op("c_allgather", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_allgather(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    out = jax.lax.all_gather(x, ax, axis=0, tiled=True)
+    return {"Out": out}
+
+
+@register_op("c_reducescatter", inputs=["X"], outputs=["Out"],
+             side_effect=True)
+def c_reducescatter(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    return {"Out": jax.lax.psum_scatter(x, ax, scatter_dimension=0,
+                                        tiled=True)}
+
+
+@register_op("c_scatter", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_scatter(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    n = jax.lax.axis_size(ax)
+    idx = jax.lax.axis_index(ax)
+    shard = x.shape[0] // n
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, idx * shard, shard, 0)}
+
+
+@register_op("barrier", inputs=["X?"], outputs=["Out?"], grad=None,
+             side_effect=True)
+def barrier(ins, attrs, ctx):
+    # XLA collectives synchronise implicitly; a psum of a scalar is a true
+    # cross-replica barrier when one is explicitly requested
+    axes = _axes(ctx, attrs)
+    x = ins.get("X")
+    if x is None:
+        x = jnp.zeros((1,), jnp.float32)
+    if axes:
+        x = x + 0 * jax.lax.psum(jnp.ones((), x.dtype), axes)
+    return {"Out": x}
+
+
+@register_op("c_embedding", inputs=["W", "Ids!"], outputs=["Out"],
+             side_effect=True)
+def c_embedding(ins, attrs, ctx):
+    # model-parallel embedding shard: rows [start, start+n) live here
+    w, ids = ins["W"], ins["Ids"].astype(jnp.int32)
+    start = attrs.get("start_index", 0)
+    local = ids - start
+    valid = (local >= 0) & (local < w.shape[0])
+    out = jnp.take(w, jnp.clip(local, 0, w.shape[0] - 1), axis=0)
+    out = jnp.where(valid[..., None], out, jnp.zeros_like(out))
+    axes = _axes(ctx, attrs)
+    if axes:
+        out = jax.lax.psum(out, axes)
+    return {"Out": out}
+
+
+@register_op("c_concat", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_concat(ins, attrs, ctx):
+    # tensor-parallel allgather along last dim
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    return {"Out": jax.lax.all_gather(x, ax, axis=x.ndim - 1, tiled=True)}
+
+
+@register_op("c_split", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_split(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    n = jax.lax.axis_size(ax)
+    idx = jax.lax.axis_index(ax)
+    shard = x.shape[-1] // n
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, idx * shard, shard,
+                                                x.ndim - 1)}
+
+
+@register_op("c_identity", inputs=["X"], outputs=["Out"], side_effect=True)
+def c_identity(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_sync_calc_stream", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def c_sync_calc_stream(ins, attrs, ctx):
+    return {"Out": ins["X"]}  # no user streams under XLA
+
+
+@register_op("c_sync_comm_stream", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def c_sync_comm_stream(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_comm_init", inputs=["X?"], outputs=[], grad=None,
+             side_effect=True)
+def c_comm_init(ins, attrs, ctx):
+    return {}  # mesh formation happens in jax.distributed / Mesh creation
+
+
+@register_op("c_comm_init_all", inputs=[], outputs=[], grad=None,
+             side_effect=True)
+def c_comm_init_all(ins, attrs, ctx):
+    return {}
+
+
+@register_op("c_gen_nccl_id", inputs=[], outputs=["Out?"], grad=None,
+             side_effect=True)
+def c_gen_nccl_id(ins, attrs, ctx):
+    return {}  # no NCCL id on TPU; kept for program compatibility
+
+
+@register_op("c_wait_comm", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def c_wait_comm(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("c_wait_compute", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def c_wait_compute(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("partial_allgather", inputs=["X"], outputs=["Out"],
+             side_effect=True)
+def partial_allgather(ins, attrs, ctx):
+    return c_allgather(ins, attrs, ctx)
+
+
+@register_op("alltoall", inputs=["X"], outputs=["Out"], side_effect=True)
+def alltoall(ins, attrs, ctx):
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    n = jax.lax.axis_size(ax)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = jax.lax.all_to_all(xs, ax, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": out.reshape(x.shape)}
+
+
+@register_op("scale_by_world_size", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def scale_by_world_size(ins, attrs, ctx):
+    """Divide by the collective world size (used after c_allreduce_sum for
+    gradient averaging — the reference's ScaleLossGradOpHandle /
+    GradientScaleStrategy.CoeffNumDevice, details/scale_loss_grad_op_handle)."""
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": ins["X"]}
+    n = jax.lax.psum(1, axes)
+    x = ins["X"]
+    return {"Out": (x / jnp.asarray(n, x.dtype))}

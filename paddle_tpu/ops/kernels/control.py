@@ -1,0 +1,100 @@
+"""Control/graph-plumbing ops: feed/fetch, compare, logical, select.
+(reference: /root/reference/paddle/fluid/operators/controlflow/ — feed_op.cc,
+fetch_op.cc, compare_op.cc, logical_op.cc; while/conditional_block are
+handled natively by the executor via lax.while_loop/cond, see
+core/executor.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+@register_op("feed", inputs=[], outputs=["Out"], grad=None, side_effect=True)
+def feed(ins, attrs, ctx):
+    raise RuntimeError("feed op is handled by the executor")
+
+
+@register_op("fetch", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def fetch(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+def _cmp(name, fn):
+    @register_op(name, inputs=["X!", "Y!"], outputs=["Out"], grad=None)
+    def kernel(ins, attrs, ctx, _fn=fn):
+        return {"Out": _fn(ins["X"], ins["Y"])}
+    return kernel
+
+
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+
+
+@register_op("equal_all", inputs=["X!", "Y!"], outputs=["Out"], grad=None)
+def equal_all(ins, attrs, ctx):
+    return {"Out": jnp.array_equal(ins["X"], ins["Y"])}
+
+
+def _logical(name, fn, binary=True):
+    ins_spec = ["X!", "Y!"] if binary else ["X!"]
+
+    @register_op(name, inputs=ins_spec, outputs=["Out"], grad=None)
+    def kernel(ins, attrs, ctx, _fn=fn, _binary=binary):
+        if _binary:
+            return {"Out": _fn(ins["X"], ins["Y"])}
+        return {"Out": _fn(ins["X"])}
+    return kernel
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, binary=False)
+
+
+@register_op("select_input", inputs=["X*", "Mask!"], outputs=["Out"])
+def select_input(ins, attrs, ctx):
+    idx = ins["Mask"].reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    out = xs[0]
+    for i in range(1, len(xs)):
+        out = jnp.where(idx == i, xs[i], out)
+    return {"Out": out}
+
+
+@register_op("print", inputs=["In"], outputs=["Out"], grad=None,
+             side_effect=True)
+def print_op(ins, attrs, ctx):
+    # debug print survives jit via jax.debug
+    import jax
+    jax.debug.print(attrs.get("message", "") + " {}", ins["In"])
+    return {"Out": ins["In"]}
+
+
+@register_op("assert", inputs=["Cond!", "Data*?"], outputs=[], grad=None,
+             side_effect=True)
+def assert_op(ins, attrs, ctx):
+    return {}
+
+
+@register_op("optimization_barrier", inputs=["X*"], outputs=["Out*"],
+             grad=None, side_effect=True)
+def optimization_barrier(ins, attrs, ctx):
+    """Identity that XLA cannot CSE/reorder through (jax.lax
+    .optimization_barrier).  Used by the recompute rewrite to keep replayed
+    forward segments distinct from the original forward pass, which is what
+    turns graph-level replay into real rematerialization (reference
+    backward.py:689 replays ops; on TPU the barrier is what makes XLA
+    actually recompute instead of reusing the live value)."""
+    import jax
+    xs = ins["X"]
+    if not xs:
+        return {"Out": []}
+    outs = jax.lax.optimization_barrier(tuple(xs))
+    return {"Out": list(outs)}

@@ -1,0 +1,47 @@
+"""Elementwise binary ops with the reference's `axis` broadcast semantics
+(/root/reference/paddle/fluid/operators/elementwise/elementwise_op_function.h):
+Y's shape is aligned to X starting at `axis` (axis=-1 means numpy-style
+trailing alignment).  XLA fuses these into neighbouring matmuls, so no Pallas
+needed here."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _broadcast_y(x, y, axis):
+    if axis == -1 or axis is None or x.shape == y.shape:
+        return y
+    # pad y's shape with trailing 1s so y.dims align to x.dims at `axis`
+    pad = x.ndim - axis - y.ndim
+    if pad > 0:
+        y = y.reshape(y.shape + (1,) * pad)
+    return y
+
+
+def _ew(name, fn, grad="auto"):
+    @register_op(name, inputs=["X", "Y"], outputs=["Out"], grad=grad)
+    def kernel(ins, attrs, ctx, _fn=fn):
+        x, y = ins["X"], ins["Y"]
+        y = _broadcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": _fn(x, y)}
+    return kernel
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod, grad=None)
+_ew("elementwise_floordiv", jnp.floor_divide, grad=None)
+
+
+# grad_add: used by append_backward for gradient accumulation (reference uses
+# sum op / grad_add)
+@register_op("grad_add", inputs=["X", "Y"], outputs=["Out"])
+def grad_add(ins, attrs, ctx):
+    return {"Out": ins["X"] + ins["Y"]}

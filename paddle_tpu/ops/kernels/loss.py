@@ -1,0 +1,246 @@
+"""Loss ops (reference: softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+bce_loss_op.cc, nll_loss_op.cc, huber_loss, smooth_l1_loss, log_loss,
+kldiv_loss, sigmoid_cross_entropy_with_logits, mse ...)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _compute_dtype(x):
+    """f32 accumulation for half types; preserve f32/f64."""
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+
+
+@register_op("softmax_with_cross_entropy", inputs=["Logits", "Label!"],
+             outputs=["Softmax", "Loss"])
+def softmax_with_cross_entropy(ins, attrs, ctx):
+    logits, label = ins["Logits"], ins["Label"]
+    axis = attrs.get("axis", -1) % logits.ndim
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    cdt = _compute_dtype(logits)
+    lf = logits.astype(cdt)
+    logp = jax.nn.log_softmax(lf, axis=axis)
+    sm = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label.astype(cdt) * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis)
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lbl, 0, logits.shape[axis] - 1),
+                                  axis), axis=axis)
+        loss = -picked
+        if ignore_index >= 0:
+            loss = jnp.where(jnp.expand_dims(lbl, axis) == ignore_index,
+                             jnp.zeros_like(loss), loss)
+    return {"Softmax": sm.astype(logits.dtype), "Loss": loss}
+
+
+@register_op("cross_entropy", inputs=["X", "Label!"], outputs=["Y"])
+def cross_entropy(ins, attrs, ctx):
+    x, label = ins["X"], ins["Label"]
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    eps = 1e-12
+    if soft_label:
+        y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, -1)
+        lbl = lbl.astype(jnp.int32)
+        p = jnp.take_along_axis(x, jnp.expand_dims(
+            jnp.clip(lbl, 0, x.shape[-1] - 1), -1), axis=-1)
+        y = -jnp.log(p + eps)
+        if ignore_index >= 0:
+            y = jnp.where(jnp.expand_dims(lbl, -1) == ignore_index,
+                          jnp.zeros_like(y), y)
+    return {"Y": y}
+
+
+@register_op("cross_entropy2", inputs=["X", "Label!"],
+             outputs=["Y", "XShape", "MatchX"])
+def cross_entropy2(ins, attrs, ctx):
+    out = cross_entropy(ins, attrs, ctx)
+    x = ins["X"]
+    lbl = ins["Label"]
+    if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    matchx = jnp.take_along_axis(x, jnp.expand_dims(
+        jnp.clip(lbl.astype(jnp.int32), 0, x.shape[-1] - 1), -1), axis=-1)
+    return {"Y": out["Y"], "XShape": jnp.zeros((0,) + x.shape, x.dtype),
+            "MatchX": matchx}
+
+
+@register_op("bce_loss", inputs=["X", "Label"], outputs=["Out"])
+def bce_loss(ins, attrs, ctx):
+    x, label = ins["X"], ins["Label"]
+    eps = 1e-12
+    out = -(label * jnp.log(x + eps) + (1 - label) * jnp.log(1 - x + eps))
+    return {"Out": out}
+
+
+@register_op("nll_loss", inputs=["X", "Label!", "Weight?"],
+             outputs=["Out", "Total_weight"])
+def nll_loss(ins, attrs, ctx):
+    x, label = ins["X"], ins["Label"].astype(jnp.int32)
+    weight = ins.get("Weight")
+    reduction = attrs.get("reduction", "mean")
+    ignore_index = attrs.get("ignore_index", -100)
+    n, c = x.shape[0], x.shape[1]
+    picked = -jnp.take_along_axis(
+        x, jnp.expand_dims(jnp.clip(label, 0, c - 1), 1), axis=1).squeeze(1)
+    w = jnp.ones_like(picked) if weight is None \
+        else jnp.take(weight, jnp.clip(label, 0, c - 1))
+    valid = label != ignore_index
+    picked = jnp.where(valid, picked * w, 0.0)
+    w = jnp.where(valid, w, 0.0)
+    tw = jnp.sum(w)
+    if reduction == "mean":
+        return {"Out": jnp.sum(picked) / jnp.maximum(tw, 1e-12),
+                "Total_weight": tw}
+    if reduction == "sum":
+        return {"Out": jnp.sum(picked), "Total_weight": tw}
+    return {"Out": picked, "Total_weight": tw}
+
+
+@register_op("hinge_loss", inputs=["Logits", "Labels!"], outputs=["Loss"])
+def hinge_loss(ins, attrs, ctx):
+    logits, labels = ins["Logits"], ins["Labels"]
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2 * labels - 1) * logits)}
+
+
+@register_op("huber_loss", inputs=["X", "Y"], outputs=["Residual", "Out"])
+def huber_loss(ins, attrs, ctx):
+    delta = attrs.get("delta", 1.0)
+    r = ins["Y"] - ins["X"]
+    ab = jnp.abs(r)
+    out = jnp.where(ab <= delta, 0.5 * r * r, delta * (ab - 0.5 * delta))
+    return {"Residual": r, "Out": out}
+
+
+@register_op("smooth_l1_loss", inputs=["X", "Y", "InsideWeight?",
+                                       "OutsideWeight?"],
+             outputs=["Diff", "Out"])
+def smooth_l1_loss(ins, attrs, ctx):
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = ins["X"] - ins["Y"]
+    if ins.get("InsideWeight") is not None:
+        d = d * ins["InsideWeight"]
+    ab = jnp.abs(d)
+    loss = jnp.where(ab < 1.0 / s2, 0.5 * d * d * s2, ab - 0.5 / s2)
+    if ins.get("OutsideWeight") is not None:
+        loss = loss * ins["OutsideWeight"]
+    out = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Diff": d, "Out": out}
+
+
+@register_op("log_loss", inputs=["Predicted", "Labels"], outputs=["Loss"])
+def log_loss(ins, attrs, ctx):
+    eps = attrs.get("epsilon", 1e-4)
+    p, l = ins["Predicted"], ins["Labels"]
+    return {"Loss": -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps)}
+
+
+@register_op("kldiv_loss", inputs=["X", "Target"], outputs=["Loss"])
+def kldiv_loss(ins, attrs, ctx):
+    x, t = ins["X"], ins["Target"]
+    reduction = attrs.get("reduction", "mean")
+    loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-12)) - x), 0.0)
+    if reduction == "mean":
+        return {"Loss": jnp.mean(loss)}
+    if reduction == "sum":
+        return {"Loss": jnp.sum(loss)}
+    if reduction == "batchmean":
+        return {"Loss": jnp.sum(loss) / x.shape[0]}
+    return {"Loss": loss}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", inputs=["X", "Label"],
+             outputs=["Out"])
+def sigmoid_cross_entropy_with_logits(ins, attrs, ctx):
+    x, label = ins["X"], ins["Label"]
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label != ignore_index
+    loss = jnp.where(mask, loss, 0.0)
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+    return {"Out": loss}
+
+
+@register_op("sigmoid_focal_loss", inputs=["X", "Label!", "FgNum!"],
+             outputs=["Out"])
+def sigmoid_focal_loss(ins, attrs, ctx):
+    x, label, fg = ins["X"], ins["Label"].astype(jnp.int32), ins["FgNum"]
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    n, c = x.shape
+    # per-class binary target: label in [0, C]; 0 = background
+    tgt = jax.nn.one_hot(label.ravel() - 1, c, dtype=x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0.0) - x * tgt + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * tgt + (1 - p) * (1 - tgt)
+    a_t = alpha * tgt + (1 - alpha) * (1 - tgt)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce / jnp.maximum(
+        fg.astype(x.dtype), 1.0)
+    return {"Out": loss}
+
+
+@register_op("mse_loss", inputs=["X", "Y"], outputs=["Out"])
+def mse_loss(ins, attrs, ctx):
+    return {"Out": jnp.square(ins["X"] - ins["Y"])}
+
+
+@register_op("rank_loss", inputs=["Label!", "Left", "Right"], outputs=["Out"])
+def rank_loss(ins, attrs, ctx):
+    label, left, right = ins["Label"], ins["Left"], ins["Right"]
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+@register_op("margin_rank_loss", inputs=["Label!", "X1", "X2"],
+             outputs=["Out", "Activated"])
+def margin_rank_loss(ins, attrs, ctx):
+    margin = attrs.get("margin", 0.0)
+    label, x1, x2 = ins["Label"], ins["X1"], ins["X2"]
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("bpr_loss", inputs=["X", "Label!"], outputs=["Y"])
+def bpr_loss(ins, attrs, ctx):
+    x, label = ins["X"], ins["Label"].astype(jnp.int32)
+    n, c = x.shape
+    if label.ndim == 2:
+        label = label.squeeze(-1)
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    diff = x - pos
+    # exclude the positive column itself
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    loss = -jnp.sum(jnp.log(jax.nn.sigmoid(-diff) + 1e-12) * (1 - mask),
+                    axis=1, keepdims=True) / (c - 1)
+    return {"Y": loss}
+
+
+@register_op("center_loss", inputs=["X", "Label!", "Centers", "CenterUpdateRate!"],
+             outputs=["CentersOut", "SampleCenterDiff", "Loss"])
+def center_loss(ins, attrs, ctx):
+    x, label, centers = ins["X"], ins["Label"].astype(jnp.int32).ravel(), \
+        ins["Centers"]
+    alpha = ins["CenterUpdateRate"].reshape(())
+    picked = jnp.take(centers, label, axis=0)
+    diff = x - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        centers = centers + alpha * upd / (counts[:, None] + 1.0)
+    return {"CentersOut": centers, "SampleCenterDiff": diff, "Loss": loss}

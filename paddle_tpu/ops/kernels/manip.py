@@ -1,0 +1,606 @@
+"""Tensor manipulation ops (reference: reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, gather_op.cc, slice_op.cc, cast_op.cc,
+fill_constant_op.cc, one_hot_op.cc, top_k_op.cc, arg_min_max_op_base.h, ...).
+
+Note on dynamic-shape ops: `masked_select`, `where_index`, `unique` have
+data-dependent output shapes, which XLA cannot compile into a static program.
+They work in eager/dygraph mode; inside a jitted static program they must be
+used as fetch boundaries (the reference had the same split between device ops
+and host-side logic for these)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op
+from ...core.dtype import np_dtype
+
+
+def _resolve_shape(shape, x):
+    """reshape semantics: 0 -> copy input dim, -1 -> infer."""
+    shape = list(shape)
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return tuple(shape)
+
+
+@register_op("reshape2", inputs=["X", "Shape?!", "ShapeTensor*?!"],
+             outputs=["Out", "XShape"])
+def reshape2(ins, attrs, ctx):
+    x = ins["X"]
+    shape = _resolve_shape(attrs.get("shape", []), x)
+    return {"Out": x.reshape(shape),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("reshape", inputs=["X", "Shape?!"], outputs=["Out"])
+def reshape(ins, attrs, ctx):
+    x = ins["X"]
+    return {"Out": x.reshape(_resolve_shape(attrs.get("shape", []), x))}
+
+
+@register_op("squeeze2", inputs=["X"], outputs=["Out", "XShape"])
+def squeeze2(ins, attrs, ctx):
+    x = ins["X"]
+    axes = attrs.get("axes", [])
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return {"Out": jnp.squeeze(x, axis=axes),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("squeeze", inputs=["X"], outputs=["Out"])
+def squeeze(ins, attrs, ctx):
+    return {"Out": squeeze2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("unsqueeze2", inputs=["X"], outputs=["Out", "XShape"])
+def unsqueeze2(ins, attrs, ctx):
+    x = ins["X"]
+    out = x
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("unsqueeze", inputs=["X"], outputs=["Out"])
+def unsqueeze(ins, attrs, ctx):
+    return {"Out": unsqueeze2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("flatten2", inputs=["X"], outputs=["Out", "XShape"])
+def flatten2(ins, attrs, ctx):
+    x = ins["X"]
+    axis = attrs.get("axis", 1)
+    out = x.reshape((int(np.prod(x.shape[:axis])), -1))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("flatten", inputs=["X"], outputs=["Out"])
+def flatten(ins, attrs, ctx):
+    return {"Out": flatten2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("flatten_contiguous_range", inputs=["X"], outputs=["Out", "XShape"])
+def flatten_contiguous_range(ins, attrs, ctx):
+    x = ins["X"]
+    start = attrs.get("start_axis", 1) % max(x.ndim, 1)
+    stop = attrs.get("stop_axis", -1) % max(x.ndim, 1)
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": x.reshape(shape),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("transpose2", inputs=["X"], outputs=["Out", "XShape"])
+def transpose2(ins, attrs, ctx):
+    x = ins["X"]
+    return {"Out": jnp.transpose(x, attrs["axis"]),
+            "XShape": jnp.zeros((0,) + x.shape, x.dtype)}
+
+
+@register_op("transpose", inputs=["X"], outputs=["Out"])
+def transpose(ins, attrs, ctx):
+    return {"Out": jnp.transpose(ins["X"], attrs["axis"])}
+
+
+@register_op("concat", inputs=["X*", "AxisTensor?!"], outputs=["Out"])
+def concat(ins, attrs, ctx):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split", inputs=["X"], outputs=["Out*"])
+def split(ins, attrs, ctx):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        total = x.shape[axis]
+        sections = list(sections)
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = total - known
+        idx = np.cumsum(sections[:-1])
+        return {"Out": jnp.split(x, idx, axis=axis)}
+    return {"Out": jnp.split(x, num, axis=axis)}
+
+
+@register_op("stack", inputs=["X*"], outputs=["Y"])
+def stack(ins, attrs, ctx):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack", inputs=["X"], outputs=["Y*"])
+def unstack(ins, attrs, ctx):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    n = attrs.get("num", x.shape[axis])
+    return {"Y": [jnp.squeeze(s, axis)
+                  for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("unbind", inputs=["X"], outputs=["Out*"])
+def unbind(ins, attrs, ctx):
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.squeeze(s, axis)
+                    for s in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("gather", inputs=["X", "Index!", "Axis?!"], outputs=["Out"])
+def gather(ins, attrs, ctx):
+    axis = attrs.get("axis", 0)
+    if ins.get("Axis") is not None:
+        axis = int(ins["Axis"])
+    return {"Out": jnp.take(ins["X"], ins["Index"].astype(jnp.int32),
+                            axis=axis)}
+
+
+@register_op("gather_nd", inputs=["X", "Index!"], outputs=["Out"])
+def gather_nd(ins, attrs, ctx):
+    x, idx = ins["X"], ins["Index"].astype(jnp.int32)
+    k = idx.shape[-1]
+    return {"Out": x[tuple(jnp.moveaxis(idx, -1, 0))] if k == x.ndim
+            else x[tuple(jnp.moveaxis(idx, -1, 0))]}
+
+
+@register_op("scatter", inputs=["X", "Ids!", "Updates"], outputs=["Out"])
+def scatter(ins, attrs, ctx):
+    x, ids, upd = ins["X"], ins["Ids"].astype(jnp.int32).ravel(), ins["Updates"]
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("scatter_nd_add", inputs=["X", "Index!", "Updates"],
+             outputs=["Out"])
+def scatter_nd_add(ins, attrs, ctx):
+    x, idx, upd = ins["X"], ins["Index"].astype(jnp.int32), ins["Updates"]
+    return {"Out": x.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)}
+
+
+@register_op("slice", inputs=["Input", "StartsTensor?!", "EndsTensor?!"],
+             outputs=["Out"])
+def slice_op(ins, attrs, ctx):
+    x = ins["Input"]
+    axes = attrs["axes"]
+    starts = list(attrs.get("starts", []))
+    ends = list(attrs.get("ends", []))
+    sl = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        sl[a] = slice(s, e)
+    out = x[tuple(sl)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": out}
+
+
+@register_op("strided_slice", inputs=["Input"], outputs=["Out"])
+def strided_slice(ins, attrs, ctx):
+    x = ins["Input"]
+    sl = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        sl[a] = slice(s, e, st)
+    out = x[tuple(sl)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": out}
+
+
+@register_op("index_select", inputs=["X", "Index!"], outputs=["Out"])
+def index_select(ins, attrs, ctx):
+    return {"Out": jnp.take(ins["X"], ins["Index"].astype(jnp.int32),
+                            axis=attrs.get("dim", 0))}
+
+
+@register_op("index_sample", inputs=["X", "Index!"], outputs=["Out"])
+def index_sample(ins, attrs, ctx):
+    x, idx = ins["X"], ins["Index"].astype(jnp.int32)
+    return {"Out": jnp.take_along_axis(x, idx, axis=1)}
+
+
+@register_op("masked_select", inputs=["X", "Mask!"], outputs=["Y"])
+def masked_select(ins, attrs, ctx):
+    # data-dependent shape: eager-mode only
+    return {"Y": ins["X"][ins["Mask"]]}
+
+
+@register_op("where", inputs=["Condition!", "X", "Y"], outputs=["Out"])
+def where(ins, attrs, ctx):
+    return {"Out": jnp.where(ins["Condition"], ins["X"], ins["Y"])}
+
+
+@register_op("where_index", inputs=["Condition!"], outputs=["Out"], grad=None)
+def where_index(ins, attrs, ctx):
+    # data-dependent shape: eager-mode only
+    return {"Out": jnp.stack(jnp.nonzero(ins["Condition"]), axis=1)
+            .astype(jnp.int64)}
+
+
+def _expand(x, times):
+    return jnp.tile(x, tuple(times))
+
+
+@register_op("expand", inputs=["X"], outputs=["Out"])
+def expand(ins, attrs, ctx):
+    return {"Out": _expand(ins["X"], attrs["expand_times"])}
+
+
+@register_op("expand_v2", inputs=["X"], outputs=["Out"])
+def expand_v2(ins, attrs, ctx):
+    x = ins["X"]
+    shape = list(attrs["shape"])
+    if len(shape) > x.ndim:
+        x = x.reshape((1,) * (len(shape) - x.ndim) + x.shape)
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    return {"Out": jnp.broadcast_to(x, tuple(shape))}
+
+
+@register_op("expand_as", inputs=["X", "target_tensor!"], outputs=["Out"])
+def expand_as(ins, attrs, ctx):
+    return {"Out": jnp.broadcast_to(ins["X"], ins["target_tensor"].shape)}
+
+
+@register_op("expand_as_v2", inputs=["X", "Y?!"], outputs=["Out"])
+def expand_as_v2(ins, attrs, ctx):
+    shape = attrs.get("target_shape")
+    if shape is None:
+        shape = ins["Y"].shape
+    return {"Out": jnp.broadcast_to(ins["X"], tuple(shape))}
+
+
+@register_op("tile", inputs=["X"], outputs=["Out"])
+def tile(ins, attrs, ctx):
+    return {"Out": jnp.tile(ins["X"], tuple(attrs["repeat_times"]))}
+
+
+@register_op("flip", inputs=["X"], outputs=["Out"])
+def flip(ins, attrs, ctx):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}
+
+
+@register_op("roll", inputs=["X"], outputs=["Out"])
+def roll(ins, attrs, ctx):
+    shifts = attrs["shifts"]
+    axis = attrs.get("axis", attrs.get("dims", None))
+    if axis is None or (isinstance(axis, (list, tuple)) and not axis):
+        return {"Out": jnp.roll(ins["X"].ravel(), shifts[0] if
+                                isinstance(shifts, (list, tuple)) else shifts)
+                .reshape(ins["X"].shape)}
+    return {"Out": jnp.roll(ins["X"], tuple(shifts), tuple(axis))}
+
+
+@register_op("reverse", inputs=["X"], outputs=["Out"])
+def reverse(ins, attrs, ctx):
+    return {"Out": jnp.flip(ins["X"], axis=tuple(attrs["axis"]))}
+
+
+@register_op("pad", inputs=["X"], outputs=["Out"])
+def pad(ins, attrs, ctx):
+    x = ins["X"]
+    p = attrs["paddings"]
+    widths = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, widths, constant_values=attrs.get("pad_value",
+                                                                0.0))}
+
+
+@register_op("pad2d", inputs=["X"], outputs=["Out"])
+def pad2d(ins, attrs, ctx):
+    x = ins["X"]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NCHW":
+        widths = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        widths = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    mode_map = {"constant": "constant", "reflect": "reflect", "edge": "edge"}
+    kw = {"constant_values": attrs.get("pad_value", 0.0)} \
+        if mode == "constant" else {}
+    return {"Out": jnp.pad(x, widths, mode=mode_map[mode], **kw)}
+
+
+@register_op("pad3d", inputs=["X"], outputs=["Out"])
+def pad3d(ins, attrs, ctx):
+    x = ins["X"]
+    p = attrs["paddings"]  # [front,back,top,bottom,left,right] NCDHW
+    mode = attrs.get("mode", "constant")
+    widths = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    kw = {"constant_values": attrs.get("value", 0.0)} \
+        if mode == "constant" else {}
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    return {"Out": jnp.pad(x, widths, mode=mode_map[mode], **kw)}
+
+
+@register_op("pad_constant_like", inputs=["X!", "Y"], outputs=["Out"])
+def pad_constant_like(ins, attrs, ctx):
+    x, y = ins["X"], ins["Y"]
+    widths = [(0, xi - yi) for xi, yi in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, widths,
+                           constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("cast", inputs=["X"], outputs=["Out"])
+def cast(ins, attrs, ctx):
+    return {"Out": ins["X"].astype(np_dtype(attrs["out_dtype"]))}
+
+
+@register_op("assign", inputs=["X"], outputs=["Out"])
+def assign(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("share_data", inputs=["X"], outputs=["Out"])
+def share_data(ins, attrs, ctx):
+    return {"Out": ins["X"]}
+
+
+@register_op("assign_value", inputs=[], outputs=["Out"], grad=None)
+def assign_value(ins, attrs, ctx):
+    values = attrs.get("fp32_values") or attrs.get("int32_values") \
+        or attrs.get("int64_values") or attrs.get("values")
+    return {"Out": jnp.asarray(values, np_dtype(attrs.get("dtype", "float32")))
+            .reshape(tuple(attrs["shape"]))}
+
+
+@register_op("fill_constant", inputs=["ShapeTensor?!", "ValueTensor?!"],
+             outputs=["Out"], grad=None)
+def fill_constant(ins, attrs, ctx):
+    shape = tuple(attrs.get("shape", []))
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    if isinstance(value, str):
+        value = float(value)
+    if ins.get("ValueTensor") is not None:
+        value = ins["ValueTensor"].reshape(())
+    return {"Out": jnp.full(shape, value, dt)}
+
+
+@register_op("fill_constant_batch_size_like", inputs=["Input!"],
+             outputs=["Out"], grad=None)
+def fill_constant_batch_size_like(ins, attrs, ctx):
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ins["Input"].shape[in_idx]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("fill_any_like", inputs=["X!"], outputs=["Out"], grad=None)
+def fill_any_like(ins, attrs, ctx):
+    x = ins["X"]
+    dt = attrs.get("dtype", None)
+    dt = x.dtype if dt in (None, -1) else np_dtype(dt)
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dt)}
+
+
+@register_op("fill_zeros_like", inputs=["X!"], outputs=["Out"], grad=None)
+def fill_zeros_like(ins, attrs, ctx):
+    return {"Out": jnp.zeros_like(ins["X"])}
+
+
+@register_op("eye", inputs=[], outputs=["Out"], grad=None)
+def eye(ins, attrs, ctx):
+    rows = attrs["num_rows"]
+    cols = attrs.get("num_columns", -1)
+    cols = rows if cols in (None, -1) else cols
+    return {"Out": jnp.eye(rows, cols,
+                           dtype=np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("linspace", inputs=["Start!", "Stop!", "Num!"], outputs=["Out"],
+             grad=None)
+def linspace(ins, attrs, ctx):
+    n = int(ins["Num"])
+    return {"Out": jnp.linspace(ins["Start"].reshape(()),
+                                ins["Stop"].reshape(()), n)}
+
+
+@register_op("range", inputs=["Start!", "End!", "Step!"], outputs=["Out"],
+             grad=None)
+def range_op(ins, attrs, ctx):
+    # static variant: values must be host constants (bound at build time)
+    s, e, st = (np.asarray(ins["Start"]).item(), np.asarray(ins["End"]).item(),
+                np.asarray(ins["Step"]).item())
+    return {"Out": jnp.arange(s, e, st, dtype=ins["Start"].dtype)}
+
+
+@register_op("one_hot", inputs=["X!"], outputs=["Out"], grad=None)
+def one_hot(ins, attrs, ctx):
+    x = ins["X"]
+    depth = attrs["depth"]
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return {"Out": jax.nn.one_hot(x.astype(jnp.int32), depth,
+                                  dtype=jnp.float32)}
+
+
+@register_op("one_hot_v2", inputs=["X!"], outputs=["Out"], grad=None)
+def one_hot_v2(ins, attrs, ctx):
+    return {"Out": jax.nn.one_hot(ins["X"].astype(jnp.int32), attrs["depth"],
+                                  dtype=jnp.float32)}
+
+
+@register_op("arg_max", inputs=["X!"], outputs=["Out"], grad=None)
+def arg_max(ins, attrs, ctx):
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(ins["X"], axis=axis, keepdims=attrs.get("keepdims",
+                                                             False))
+    return {"Out": out.astype(np_dtype(attrs.get("dtype", "int64")))}
+
+
+@register_op("arg_min", inputs=["X!"], outputs=["Out"], grad=None)
+def arg_min(ins, attrs, ctx):
+    axis = attrs.get("axis", -1)
+    out = jnp.argmin(ins["X"], axis=axis, keepdims=attrs.get("keepdims",
+                                                             False))
+    return {"Out": out.astype(np_dtype(attrs.get("dtype", "int64")))}
+
+
+@register_op("argsort", inputs=["X"], outputs=["Out", "Indices"])
+def argsort(ins, attrs, ctx):
+    x = ins["X"]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k", inputs=["X", "K?!"], outputs=["Out", "Indices"])
+def top_k(ins, attrs, ctx):
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    if ins.get("K") is not None:
+        k = int(np.asarray(ins["K"]).item())
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2", inputs=["X", "K?!"], outputs=["Out", "Indices"])
+def top_k_v2(ins, attrs, ctx):
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", True)
+    x_ = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x_ if largest else -x_, k)
+    if not largest:
+        vals = -vals
+    return {"Out": jnp.moveaxis(vals, -1, axis),
+            "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64)}
+
+
+@register_op("unique", inputs=["X!"], outputs=["Out", "Index"], grad=None)
+def unique(ins, attrs, ctx):
+    # data-dependent shape: eager-mode only
+    out, inv = jnp.unique(ins["X"], return_inverse=True)
+    return {"Out": out, "Index": inv.astype(np_dtype(attrs.get("dtype",
+                                                               "int64")))}
+
+
+@register_op("unique_with_counts", inputs=["X!"],
+             outputs=["Out", "Index", "Count"], grad=None)
+def unique_with_counts(ins, attrs, ctx):
+    out, inv, cnt = jnp.unique(ins["X"], return_inverse=True,
+                               return_counts=True)
+    dt = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": out, "Index": inv.astype(dt), "Count": cnt.astype(dt)}
+
+
+@register_op("shape", inputs=["Input!"], outputs=["Out"], grad=None)
+def shape(ins, attrs, ctx):
+    return {"Out": jnp.asarray(ins["Input"].shape, jnp.int32)}
+
+
+@register_op("size", inputs=["Input!"], outputs=["Out"], grad=None)
+def size(ins, attrs, ctx):
+    return {"Out": jnp.asarray(ins["Input"].size, jnp.int64)}
+
+
+@register_op("is_empty", inputs=["X!"], outputs=["Out"], grad=None)
+def is_empty(ins, attrs, ctx):
+    return {"Out": jnp.asarray(ins["X"].size == 0)}
+
+
+@register_op("diag", inputs=["Diagonal"], outputs=["Out"])
+def diag(ins, attrs, ctx):
+    return {"Out": jnp.diag(ins["Diagonal"])}
+
+
+@register_op("diag_v2", inputs=["X"], outputs=["Out"])
+def diag_v2(ins, attrs, ctx):
+    x = ins["X"]
+    offset = attrs.get("offset", 0)
+    out = jnp.diag(x, offset)
+    pv = attrs.get("padding_value", 0.0)
+    if x.ndim == 1 and pv != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), pv, x.dtype)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, jnp.diag(x, offset), base)
+    return {"Out": out}
+
+
+@register_op("diag_embed", inputs=["Input"], outputs=["Out"])
+def diag_embed(ins, attrs, ctx):
+    x = ins["Input"]
+    offset = attrs.get("offset", 0)
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    return {"Out": out}
+
+
+@register_op("meshgrid", inputs=["X*"], outputs=["Out*"])
+def meshgrid(ins, attrs, ctx):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register_op("multiplex", inputs=["Ids!", "X*"], outputs=["Out"])
+def multiplex(ins, attrs, ctx):
+    ids = ins["Ids"].astype(jnp.int32).ravel()
+    stacked = jnp.stack(ins["X"], axis=0)  # [n, batch, ...]
+    rows = jnp.arange(stacked.shape[1])
+    return {"Out": stacked[ids, rows]}
+
+
+@register_op("empty", inputs=[], outputs=["Out"], grad=None)
+def empty(ins, attrs, ctx):
+    return {"Out": jnp.zeros(tuple(attrs["shape"]),
+                             np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("shard_index", inputs=["X!"], outputs=["Out"], grad=None)
+def shard_index(ins, attrs, ctx):
+    x = ins["X"]
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore_value = attrs.get("ignore_value", -1)
+    size = (index_num + nshards - 1) // nshards
+    in_shard = (x // size) == shard_id
+    return {"Out": jnp.where(in_shard, x % size, ignore_value)}
+
+
+@register_op("coalesce_tensor", inputs=["Input*"],
+             outputs=["Output*", "FusedOutput"], grad=None)
+def coalesce_tensor(ins, attrs, ctx):
+    # grad-fusion buffer op; XLA already fuses collectives, so this is
+    # semantically a flatten+concat view (details/coalesce_grad_tensor_pass)
+    xs = ins["Input"]
+    flat = jnp.concatenate([x.ravel() for x in xs])
+    return {"Output": list(xs), "FusedOutput": flat}

@@ -1,0 +1,111 @@
+"""Metric ops (reference: /root/reference/paddle/fluid/operators/metrics/
+accuracy_op.cc, auc_op.cc, precision_recall_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+@register_op("accuracy", inputs=["Out", "Indices!", "Label!"],
+             outputs=["Accuracy", "Correct", "Total"], grad=None)
+def accuracy(ins, attrs, ctx):
+    idx, label = ins["Indices"], ins["Label"]
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label
+    else:
+        label = label.reshape(-1, 1)
+    correct = jnp.any(idx == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(idx.shape[0], jnp.int32)
+    return {"Accuracy": (num_correct / total).astype(jnp.float32).reshape(1),
+            "Correct": num_correct.reshape(1), "Total": total.reshape(1)}
+
+
+@register_op("auc",
+             inputs=["Predict!", "Label!", "StatPos!", "StatNeg!"],
+             outputs=["AUC", "StatPosOut", "StatNegOut"], grad=None,
+             side_effect=True)
+def auc(ins, attrs, ctx):
+    pred, label = ins["Predict"], ins["Label"].ravel()
+    stat_pos, stat_neg = ins["StatPos"], ins["StatNeg"]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    pos_prob = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+        else pred.ravel()
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label > 0)
+    stat_pos = stat_pos.at[bucket].add(is_pos.astype(stat_pos.dtype))
+    stat_neg = stat_neg.at[bucket].add((~is_pos).astype(stat_neg.dtype))
+    # AUC via trapezoid over cumulative TP/FP (descending threshold)
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc_val = jnp.where(tot_pos * tot_neg > 0,
+                        area / (tot_pos * tot_neg), 0.0)
+    return {"AUC": auc_val.astype(jnp.float64), "StatPosOut": stat_pos,
+            "StatNegOut": stat_neg}
+
+
+@register_op("precision_recall",
+             inputs=["MaxProbs!", "Indices!", "Labels!", "Weights?",
+                     "StatesInfo?"],
+             outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+             grad=None)
+def precision_recall(ins, attrs, ctx):
+    cls_num = attrs["class_number"]
+    idx = ins["Indices"].ravel().astype(jnp.int32)
+    labels = ins["Labels"].ravel().astype(jnp.int32)
+    states = ins.get("StatesInfo")
+    if states is None:
+        states = jnp.zeros((cls_num, 4), jnp.float32)
+    correct = idx == labels
+    tp = jnp.zeros(cls_num).at[labels].add(correct.astype(jnp.float32))
+    fp = jnp.zeros(cls_num).at[idx].add((~correct).astype(jnp.float32))
+    fn = jnp.zeros(cls_num).at[labels].add((~correct).astype(jnp.float32))
+    tn = jnp.zeros(cls_num)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)
+    acc_states = states + batch_states
+
+    def metrics(s):
+        tp_, fp_, tn_, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec + 1e-12),
+                       0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        w = tp_ + fn_
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        micro = jnp.stack([jnp.sum(prec * w) / wsum, jnp.sum(rec * w) / wsum,
+                           jnp.sum(f1 * w) / wsum])
+        return jnp.concatenate([macro, micro])
+
+    return {"BatchMetrics": metrics(batch_states),
+            "AccumMetrics": metrics(acc_states),
+            "AccumStatesInfo": acc_states}
+
+
+@register_op("mean_iou", inputs=["Predictions!", "Labels!"],
+             outputs=["OutMeanIou", "OutWrong", "OutCorrect"], grad=None)
+def mean_iou(ins, attrs, ctx):
+    num_classes = attrs["num_classes"]
+    pred = ins["Predictions"].ravel().astype(jnp.int32)
+    label = ins["Labels"].ravel().astype(jnp.int32)
+    correct = jnp.zeros(num_classes, jnp.int32).at[
+        jnp.where(pred == label, pred, num_classes - 1)].add(
+        (pred == label).astype(jnp.int32))
+    wrong_pred = jnp.zeros(num_classes, jnp.int32).at[pred].add(
+        (pred != label).astype(jnp.int32))
+    wrong_label = jnp.zeros(num_classes, jnp.int32).at[label].add(
+        (pred != label).astype(jnp.int32))
+    union = correct + wrong_pred + wrong_label
+    iou = jnp.where(union > 0, correct / jnp.maximum(union, 1), 0.0)
+    valid = jnp.sum((union > 0).astype(jnp.float32))
+    mean_iou_val = jnp.sum(iou) / jnp.maximum(valid, 1.0)
+    return {"OutMeanIou": mean_iou_val.astype(jnp.float32),
+            "OutWrong": wrong_pred + wrong_label, "OutCorrect": correct}

@@ -1,0 +1,522 @@
+"""NN ops: conv / pool / norm / softmax / dropout / embedding.
+
+Reference kernels: /root/reference/paddle/fluid/operators/conv_op.cc (cuDNN),
+pool_op.cc, batch_norm_op.cu, layer_norm_op.cu, softmax_op.cc, dropout_op.cu,
+lookup_table_op.cu.  Here convs/matmuls lower to lax.conv_general_dilated /
+MXU; norms are jnp compositions XLA fuses into single kernels; dropout uses
+the counter-based PRNG from OpContext (mask recomputed in backward, never
+stored — saves HBM versus the reference's cached-mask design)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op
+from ...core.dtype import np_dtype
+
+
+
+def _cdt(x):
+    """f32 accumulation for half types; preserve f32/f64."""
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+def _conv_padding(paddings, algo, ndims):
+    if algo == "SAME":
+        return "SAME"
+    if algo == "VALID":
+        return [(0, 0)] * ndims
+    p = list(paddings)
+    if len(p) == ndims:
+        return [(pi, pi) for pi in p]
+    if len(p) == 2 * ndims:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(ndims)]
+    raise ValueError(f"bad paddings {paddings}")
+
+
+def _conv(x, w, attrs, ndims, feature_group_count=None, transpose=False):
+    strides = tuple(attrs.get("strides", [1] * ndims))
+    dilations = tuple(attrs.get("dilations", [1] * ndims))
+    padding = _conv_padding(attrs.get("paddings", [0] * ndims),
+                            attrs.get("padding_algorithm", "EXPLICIT"), ndims)
+    groups = attrs.get("groups", 1) if feature_group_count is None \
+        else feature_group_count
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt in ("NHWC", "NDHWC"):
+        x = jnp.moveaxis(x, -1, 1)
+    spatial = "DHW"[3 - ndims:]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    if transpose:
+        out = jax.lax.conv_transpose(
+            x, jnp.swapaxes(w, 0, 1), strides, padding,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            transpose_kernel=True,
+            preferred_element_type=acc)
+    else:
+        out = jax.lax.conv_general_dilated(
+            x, w, strides, padding, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=acc)
+    out = out.astype(x.dtype)
+    if fmt in ("NHWC", "NDHWC"):
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_op("conv2d", inputs=["Input", "Filter", "Bias?"], outputs=["Output"])
+def conv2d(ins, attrs, ctx):
+    out = _conv(ins["Input"], ins["Filter"], attrs, 2)
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].reshape(1, -1, 1, 1)
+    return {"Output": out}
+
+
+@register_op("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
+def conv3d(ins, attrs, ctx):
+    return {"Output": _conv(ins["Input"], ins["Filter"], attrs, 3)}
+
+
+@register_op("depthwise_conv2d", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def depthwise_conv2d(ins, attrs, ctx):
+    x, w = ins["Input"], ins["Filter"]
+    # paddle filter: [C*mult, 1, kh, kw]; lax wants [C*mult, 1, kh, kw] with
+    # feature_group_count = C
+    c_in = x.shape[1] if attrs.get("data_format", "NCHW") == "NCHW" \
+        else x.shape[-1]
+    return {"Output": _conv(x, w, attrs, 2, feature_group_count=c_in)}
+
+
+@register_op("conv2d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def conv2d_transpose(ins, attrs, ctx):
+    x, w = ins["Input"], ins["Filter"]
+    ndims = 2
+    strides = tuple(attrs.get("strides", [1] * ndims))
+    dilations = tuple(attrs.get("dilations", [1] * ndims))
+    pads = _conv_padding(attrs.get("paddings", [0] * ndims),
+                         attrs.get("padding_algorithm", "EXPLICIT"), ndims)
+    # conv_transpose as gradient-of-conv: lhs dilation
+    dn = jax.lax.conv_dimension_numbers(x.shape,
+                                        jnp.swapaxes(w, 0, 1).shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    if isinstance(pads, str):
+        padding = pads
+    else:
+        padding = []
+        for i, (lo, hi) in enumerate(pads):
+            k = (w.shape[2 + i] - 1) * dilations[i] + 1
+            padding.append((k - 1 - lo, k - 1 - hi))
+    w_flip = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(-1, -2))
+    out = jax.lax.conv_general_dilated(
+        x, w_flip, (1, 1), padding, lhs_dilation=strides,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=attrs.get("groups", 1))
+    return {"Output": out.astype(x.dtype)}
+
+
+@register_op("conv3d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def conv3d_transpose(ins, attrs, ctx):
+    return {"Output": _conv(ins["Input"], ins["Filter"], attrs, 3,
+                            transpose=True)}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(x, attrs, ndims):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = list(attrs.get("ksize", [2] * ndims))
+    strides = list(attrs.get("strides", ksize))
+    pads = attrs.get("paddings", [0] * ndims)
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) \
+            and all(k == 1 for k in ksize):
+        axes = tuple(range(2, 2 + ndims))
+        red = jnp.max if ptype == "max" else jnp.mean
+        return red(x, axis=axes, keepdims=True)
+    if attrs.get("adaptive", False):
+        # adaptive pooling: output spatial = ksize
+        out_hw = ksize
+        slices = []
+        for d, o in enumerate(out_hw):
+            in_sz = x.shape[2 + d]
+            ksize[d] = in_sz // o
+            strides[d] = in_sz // o
+        pads = [0] * ndims
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    p = _conv_padding(pads, attrs.get("padding_algorithm", "EXPLICIT"), ndims)
+    if isinstance(p, str):
+        padding = p
+    else:
+        padding = [(0, 0), (0, 0)] + list(p)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                     padding)
+    ones = jnp.ones_like(x)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, padding)
+    if attrs.get("exclusive", True):
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride,
+                                    padding)
+    else:
+        cnt = float(np.prod(ksize))
+    return s / cnt
+
+
+@register_op("pool2d", inputs=["X"], outputs=["Out"])
+def pool2d(ins, attrs, ctx):
+    return {"Out": _pool(ins["X"], attrs, 2)}
+
+
+@register_op("pool3d", inputs=["X"], outputs=["Out"])
+def pool3d(ins, attrs, ctx):
+    return {"Out": _pool(ins["X"], attrs, 3)}
+
+
+@register_op("max_pool2d_with_index", inputs=["X"], outputs=["Out", "Mask"])
+def max_pool2d_with_index(ins, attrs, ctx):
+    x = ins["X"]
+    attrs2 = dict(attrs)
+    attrs2["pooling_type"] = "max"
+    out = _pool(x, attrs2, 2)
+    # argmax indices via a paired (value, -index) reduce_window: the variadic
+    # reduce computes max on value and, on ties, the smallest flat index —
+    # exact for arbitrary float values (a single packed-float trick is not)
+    n, c, h, w = x.shape
+    ksize = list(attrs.get("ksize", [2, 2]))
+    strides = list(attrs.get("strides", ksize))
+    idx_map = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
+    idx_map = jnp.broadcast_to(idx_map, x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        pick_b = (bv > av) | ((bv == av) & (bi < ai))
+        return (jnp.where(pick_b, bv, av), jnp.where(pick_b, bi, ai))
+
+    init_v = jnp.array(-jnp.inf, x.dtype) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+    _, mask = jax.lax.reduce_window(
+        (x, idx_map), (init_v, jnp.array(h * w, jnp.int32)), reducer,
+        (1, 1) + tuple(ksize), (1, 1) + tuple(strides), [(0, 0)] * 4)
+    return {"Out": out, "Mask": mask.astype(jnp.int64)}
+
+
+@register_op("spp", inputs=["X"], outputs=["Out"])
+def spp(ins, attrs, ctx):
+    # spatial pyramid pooling
+    x = ins["X"]
+    levels = attrs.get("pyramid_height", 2)
+    ptype = attrs.get("pooling_type", "max")
+    outs = []
+    n, c = x.shape[:2]
+    for l in range(levels):
+        bins = 2 ** l
+        a = {"pooling_type": ptype, "ksize": [bins, bins], "adaptive": True}
+        outs.append(_pool(x, a, 2).reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+@register_op("unpool", inputs=["X", "Indices!"], outputs=["Out"])
+def unpool(ins, attrs, ctx):
+    x, idx = ins["X"], ins["Indices"].astype(jnp.int32)
+    n, c, h, w = x.shape
+    out_h, out_w = attrs.get("output_size", [h * 2, w * 2])[-2:]
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    flat_idx = idx.reshape(n, c, -1)
+    out = out.at[jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+                 flat_idx].set(x.reshape(n, c, -1))
+    return {"Out": out.reshape(n, c, out_h, out_w)}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+@register_op("batch_norm",
+             inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+             outputs=["Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance", "ReserveSpace?"])
+def batch_norm(ins, attrs, ctx):
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean, var = ins["Mean"], ins["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    fmt = attrs.get("data_format", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    caxis = 1 if fmt == "NCHW" and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = tuple(x.shape[caxis] if i == caxis else 1 for i in range(x.ndim))
+
+    xf = x.astype(_cdt(x))
+    if is_test or attrs.get("use_global_stats", False):
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.var(xf, axis=axes)
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+    inv = jax.lax.rsqrt(v + eps)
+    y = (xf - m.reshape(bshape)) * inv.reshape(bshape) \
+        * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": m, "SavedVariance": inv}
+
+
+@register_op("layer_norm", inputs=["X", "Scale?", "Bias?"],
+             outputs=["Y", "Mean", "Variance"])
+def layer_norm(ins, attrs, ctx):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(_cdt(x))
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    if ins.get("Scale") is not None:
+        y = y * ins["Scale"].astype(_cdt(x)).reshape(
+            (1,) * bna + x.shape[bna:])
+    if ins.get("Bias") is not None:
+        y = y + ins["Bias"].astype(_cdt(x)).reshape(
+            (1,) * bna + x.shape[bna:])
+    flat = int(np.prod(x.shape[:bna]))
+    return {"Y": y.astype(x.dtype), "Mean": m.reshape(flat),
+            "Variance": v.reshape(flat)}
+
+
+@register_op("instance_norm", inputs=["X", "Scale?", "Bias?"],
+             outputs=["Y", "SavedMean", "SavedVariance"])
+def instance_norm(ins, attrs, ctx):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(_cdt(x))
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    c = x.shape[1]
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale") is not None:
+        y = y * ins["Scale"].reshape(bshape)
+    if ins.get("Bias") is not None:
+        y = y + ins["Bias"].reshape(bshape)
+    n = x.shape[0]
+    return {"Y": y.astype(x.dtype), "SavedMean": m.reshape(n * c),
+            "SavedVariance": jax.lax.rsqrt(v + eps).reshape(n * c)}
+
+
+@register_op("group_norm", inputs=["X", "Scale?", "Bias?"],
+             outputs=["Y", "Mean", "Variance"])
+def group_norm(ins, attrs, ctx):
+    x = ins["X"]
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xf = x.astype(_cdt(x)).reshape((n, g, c // g) + spatial)
+    axes = tuple(range(2, xf.ndim))
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale") is not None:
+        y = y * ins["Scale"].reshape(bshape)
+    if ins.get("Bias") is not None:
+        y = y + ins["Bias"].reshape(bshape)
+    return {"Y": y.astype(x.dtype), "Mean": m.reshape(n, g),
+            "Variance": v.reshape(n, g)}
+
+
+@register_op("lrn", inputs=["X"], outputs=["Out", "MidOut"])
+def lrn(ins, attrs, ctx):
+    x = ins["X"]
+    n_size = attrs.get("n", 5)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    k = attrs.get("k", 1.0)
+    sq = jnp.square(x)
+    half = n_size // 2
+    pads = [(0, 0), (half, half), (0, 0), (0, 0)]
+    sq_pad = jnp.pad(sq, pads)
+    acc = sum(sq_pad[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("data_norm", inputs=["X", "BatchSize", "BatchSum",
+                                  "BatchSquareSum"],
+             outputs=["Y", "Means", "Scales"])
+def data_norm(ins, attrs, ctx):
+    x = ins["X"]
+    bsize, bsum, bsq = ins["BatchSize"], ins["BatchSum"], ins["BatchSquareSum"]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    return {"Y": (x - means) * scales, "Means": means, "Scales": scales}
+
+
+@register_op("spectral_norm", inputs=["Weight", "U", "V"], outputs=["Out"])
+def spectral_norm(ins, attrs, ctx):
+    w, u, v = ins["Weight"], ins["U"], ins["V"]
+    dim = attrs.get("dim", 0)
+    power_iters = attrs.get("power_iters", 1)
+    eps = attrs.get("eps", 1e-12)
+    w_mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(power_iters):
+        v = w_mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w_mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w_mat @ v
+    return {"Out": w / sigma}
+
+
+# ---------------------------------------------------------------------------
+# softmax / dropout / embedding
+# ---------------------------------------------------------------------------
+@register_op("softmax", inputs=["X"], outputs=["Out"])
+def softmax(ins, attrs, ctx):
+    return {"Out": jax.nn.softmax(ins["X"], axis=attrs.get("axis", -1))}
+
+
+@register_op("dropout", inputs=["X", "Seed?!"], outputs=["Out", "Mask"])
+def dropout(ins, attrs, ctx):
+    x = ins["X"]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False) or ctx.is_test:
+        out = x if impl == "upscale_in_train" \
+            else x * jnp.asarray(1.0 - p, x.dtype)
+        return {"Out": out, "Mask": jnp.ones_like(x, dtype=jnp.uint8)}
+    key = ctx.key(attrs)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / jnp.asarray(max(1.0 - p, 1e-8), x.dtype),
+                        jnp.zeros_like(x))
+    else:
+        out = jnp.where(keep, x, jnp.zeros_like(x))
+    return {"Out": out, "Mask": keep.astype(jnp.uint8)}
+
+
+@register_op("lookup_table", inputs=["W", "Ids!"], outputs=["Out"])
+def lookup_table(ins, attrs, ctx):
+    w, ids = ins["W"], ins["Ids"]
+    ids = jnp.squeeze(ids, -1) if ids.shape[-1] == 1 else ids
+    out = _embedding(w, ids, attrs)
+    return {"Out": out}
+
+
+@register_op("lookup_table_v2", inputs=["W", "Ids!"], outputs=["Out"])
+def lookup_table_v2(ins, attrs, ctx):
+    return {"Out": _embedding(ins["W"], ins["Ids"], attrs)}
+
+
+def _embedding(w, ids, attrs):
+    padding_idx = attrs.get("padding_idx", -1)
+    ids = ids.astype(jnp.int32)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx != -1:
+        pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        mask = (ids != pid)[..., None]
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return out
+
+
+@register_op("embedding", inputs=["W", "Ids!"], outputs=["Out"])
+def embedding(ins, attrs, ctx):
+    return {"Out": _embedding(ins["W"], ins["Ids"], attrs)}
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+@register_op("fc", inputs=["Input", "W", "Bias?"], outputs=["Out"])
+def fc(ins, attrs, ctx):
+    x, w = ins["Input"], ins["W"]
+    in_num_col_dims = attrs.get("in_num_col_dims", 1)
+    x2 = x.reshape((int(np.prod(x.shape[:in_num_col_dims])), -1))
+    out = jnp.matmul(x2, w, preferred_element_type=jnp.float32
+                     if x.dtype in (jnp.bfloat16, jnp.float16) else None)
+    out = out.astype(x.dtype)
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"]
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return {"Out": out.reshape(x.shape[:in_num_col_dims] + (w.shape[1],))}
+
+
+@register_op("add_position_encoding", inputs=["X"], outputs=["Out"])
+def add_position_encoding(ins, attrs, ctx):
+    x = ins["X"]
+    alpha = attrs.get("alpha", 1.0)
+    beta = attrs.get("beta", 1.0)
+    b, seq, d = x.shape
+    pos = jnp.arange(seq)[:, None].astype(jnp.float32)
+    i = jnp.arange((d + 1) // 2)[None, :].astype(jnp.float32)
+    freq = jnp.power(10000.0, -2.0 * i / d)
+    # interleaved layout: enc[:, 2i] = sin, enc[:, 2i+1] = cos (reference
+    # add_position_encoding_op.h); handles odd d by truncation
+    sin = jnp.sin(pos * freq)
+    cos = jnp.cos(pos * freq)
+    enc = jnp.stack([sin, cos], axis=-1).reshape(seq, -1)[:, :d]
+    return {"Out": alpha * x + beta * enc[None].astype(x.dtype)}
+
+
+@register_op("pixel_shuffle", inputs=["X"], outputs=["Out"])
+def pixel_shuffle(ins, attrs, ctx):
+    x = ins["X"]
+    r = attrs.get("upscale_factor", 1)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return {"Out": x.reshape(n, c // (r * r), h * r, w * r)}
+
+
+@register_op("space_to_depth", inputs=["X"], outputs=["Out"])
+def space_to_depth(ins, attrs, ctx):
+    x = ins["X"]
+    bs = attrs["blocksize"]
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return {"Out": x.reshape(n, c * bs * bs, h // bs, w // bs)}
+
+
+@register_op("temporal_shift", inputs=["X"], outputs=["Out"])
+def temporal_shift(ins, attrs, ctx):
+    x = ins["X"]
+    seg = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    x = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    pad = jnp.pad(x, [(0, 0), (1, 1), (0, 0), (0, 0), (0, 0)])
+    out = jnp.concatenate([pad[:, :-2, :c1],          # shift left
+                           pad[:, 2:, c1:c2],         # shift right
+                           x[:, :, c2:]], axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("shuffle_channel", inputs=["X"], outputs=["Out"])
+def shuffle_channel(ins, attrs, ctx):
+    x = ins["X"]
+    g = attrs.get("group", 1)
+    n, c, h, w = x.shape
+    return {"Out": jnp.transpose(x.reshape(n, g, c // g, h, w),
+                                 (0, 2, 1, 3, 4)).reshape(x.shape)}

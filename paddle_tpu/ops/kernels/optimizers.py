@@ -1,0 +1,326 @@
+"""Optimizer ops (reference: /root/reference/paddle/fluid/operators/optimizers/
+sgd_op.cc, momentum_op.cc, adam_op.cc, adamax, adagrad, adadelta, rmsprop,
+lamb_op.cc, lars_momentum_op.cc, ftrl_op.cc).
+
+These are in-place updates in the reference; here the "Out" slots are new
+functional values — the executor rebinds the persistable var names, and XLA's
+buffer donation makes the update in-place on device.  All moments accumulate
+in the parameter's own dtype unless a master-weight input is given (AMP)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"].reshape(()).astype(jnp.float32)
+
+
+@register_op("sgd", inputs=["Param", "LearningRate!", "Grad"],
+             outputs=["ParamOut"], grad=None, side_effect=True)
+def sgd(ins, attrs, ctx):
+    p, g = ins["Param"], ins["Grad"]
+    return {"ParamOut": (p.astype(jnp.float32) -
+                         _lr(ins) * g.astype(jnp.float32)).astype(p.dtype)}
+
+
+@register_op("momentum",
+             inputs=["Param", "Grad", "Velocity", "LearningRate!"],
+             outputs=["ParamOut", "VelocityOut"], grad=None, side_effect=True)
+def momentum(ins, attrs, ctx):
+    p, g, v = ins["Param"], ins["Grad"], ins["Velocity"]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    use_nesterov = attrs.get("use_nesterov", False)
+    pf, gf, vf = (x.astype(jnp.float32) for x in (p, g, v))
+    v_out = mu * vf + gf
+    if use_nesterov:
+        p_out = pf - (gf + mu * v_out) * lr
+    else:
+        p_out = pf - lr * v_out
+    return {"ParamOut": p_out.astype(p.dtype),
+            "VelocityOut": v_out.astype(v.dtype)}
+
+
+@register_op("lars_momentum",
+             inputs=["Param", "Grad", "Velocity", "LearningRate!"],
+             outputs=["ParamOut", "VelocityOut"], grad=None, side_effect=True)
+def lars_momentum(ins, attrs, ctx):
+    p, g, v = (ins[k].astype(jnp.float32) for k in ("Param", "Grad",
+                                                    "Velocity"))
+    mu = attrs.get("mu", 0.9)
+    lars_coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + wd * p_norm + eps), lr)
+    v_out = mu * v + local_lr * (g + wd * p)
+    p_out = p - v_out
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "VelocityOut": v_out.astype(ins["Velocity"].dtype)}
+
+
+@register_op("adam",
+             inputs=["Param", "Grad", "LearningRate!", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow", "MasterParam?"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut", "MasterParamOut?"],
+             grad=None, side_effect=True)
+def adam(ins, attrs, ctx):
+    p, g = ins["Param"], ins["Grad"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1p, b2p = ins["Beta1Pow"].astype(jnp.float32), \
+        ins["Beta2Pow"].astype(jnp.float32)
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    master = ins.get("MasterParam")
+    pf = (master if master is not None else p).astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    m1f, m2f = m1.astype(jnp.float32), m2.astype(jnp.float32)
+    m1_out = beta1 * m1f + (1 - beta1) * gf
+    m2_out = beta2 * m2f + (1 - beta2) * jnp.square(gf)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_out = pf - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    outs = {"ParamOut": p_out.astype(p.dtype),
+            "Moment1Out": m1_out.astype(m1.dtype),
+            "Moment2Out": m2_out.astype(m2.dtype),
+            "Beta1PowOut": (b1p * beta1).astype(ins["Beta1Pow"].dtype),
+            "Beta2PowOut": (b2p * beta2).astype(ins["Beta2Pow"].dtype)}
+    if master is not None:
+        outs["MasterParamOut"] = p_out
+    return outs
+
+
+@register_op("adamw",
+             inputs=["Param", "Grad", "LearningRate!", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow", "MasterParam?"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut", "MasterParamOut?"],
+             grad=None, side_effect=True)
+def adamw(ins, attrs, ctx):
+    coeff = attrs.get("coeff", 0.01)
+    lr = _lr(ins)
+    p = ins["Param"]
+    master = ins.get("MasterParam")
+    pf = (master if master is not None else p).astype(jnp.float32)
+    decayed = pf * (1.0 - lr * coeff)
+    ins2 = dict(ins)
+    if master is not None:
+        ins2["MasterParam"] = decayed
+    else:
+        ins2["Param"] = decayed.astype(p.dtype)
+    return adam(ins2, attrs, ctx)
+
+
+@register_op("adamax",
+             inputs=["Param", "Grad", "LearningRate!", "Moment", "InfNorm",
+                     "Beta1Pow"],
+             outputs=["ParamOut", "MomentOut", "InfNormOut"],
+             grad=None, side_effect=True)
+def adamax(ins, attrs, ctx):
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    m, u = ins["Moment"].astype(jnp.float32), ins["InfNorm"].astype(jnp.float32)
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins)
+    b1p = ins["Beta1Pow"].reshape(()).astype(jnp.float32)
+    m_out = beta1 * m + (1 - beta1) * g
+    u_out = jnp.maximum(beta2 * u, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (u_out + eps)
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "MomentOut": m_out.astype(ins["Moment"].dtype),
+            "InfNormOut": u_out.astype(ins["InfNorm"].dtype)}
+
+
+@register_op("adagrad",
+             inputs=["Param", "Grad", "Moment", "LearningRate!"],
+             outputs=["ParamOut", "MomentOut"], grad=None, side_effect=True)
+def adagrad(ins, attrs, ctx):
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    m = ins["Moment"].astype(jnp.float32)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "MomentOut": m_out.astype(ins["Moment"].dtype)}
+
+
+@register_op("decayed_adagrad",
+             inputs=["Param", "Grad", "Moment", "LearningRate!"],
+             outputs=["ParamOut", "MomentOut"], grad=None, side_effect=True)
+def decayed_adagrad(ins, attrs, ctx):
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    m = ins["Moment"].astype(jnp.float32)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - _lr(ins) * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "MomentOut": m_out.astype(ins["Moment"].dtype)}
+
+
+@register_op("adadelta",
+             inputs=["Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"],
+             outputs=["ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"],
+             grad=None, side_effect=True)
+def adadelta(ins, attrs, ctx):
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    sg = ins["AvgSquaredGrad"].astype(jnp.float32)
+    su = ins["AvgSquaredUpdate"].astype(jnp.float32)
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    sg_out = rho * sg + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((su + eps) / (sg_out + eps)) * g
+    su_out = rho * su + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": (p + upd).astype(ins["Param"].dtype),
+            "AvgSquaredGradOut": sg_out.astype(jnp.float32),
+            "AvgSquaredUpdateOut": su_out.astype(jnp.float32)}
+
+
+@register_op("rmsprop",
+             inputs=["Param", "Grad", "MeanSquare", "MeanGrad", "Moment",
+                     "LearningRate!"],
+             outputs=["ParamOut", "MomentOut", "MeanSquareOut", "MeanGradOut"],
+             grad=None, side_effect=True)
+def rmsprop(ins, attrs, ctx):
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    ms = ins["MeanSquare"].astype(jnp.float32)
+    mg = ins["MeanGrad"].astype(jnp.float32)
+    mom = ins["Moment"].astype(jnp.float32)
+    rho = attrs.get("decay", 0.9)
+    eps = attrs.get("epsilon", 1e-10)
+    momentum_ = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    lr = _lr(ins)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - jnp.square(mg_out) + eps
+    else:
+        mg_out = mg
+        denom = ms_out + eps
+    mom_out = momentum_ * mom + lr * g / jnp.sqrt(denom)
+    return {"ParamOut": (p - mom_out).astype(ins["Param"].dtype),
+            "MomentOut": mom_out, "MeanSquareOut": ms_out,
+            "MeanGradOut": mg_out}
+
+
+@register_op("ftrl",
+             inputs=["Param", "SquaredAccumulator", "LinearAccumulator",
+                     "Grad", "LearningRate!"],
+             outputs=["ParamOut", "SquaredAccumOut", "LinearAccumOut"],
+             grad=None, side_effect=True)
+def ftrl(ins, attrs, ctx):
+    p = ins["Param"].astype(jnp.float32)
+    sq = ins["SquaredAccumulator"].astype(jnp.float32)
+    lin = ins["LinearAccumulator"].astype(jnp.float32)
+    g = ins["Grad"].astype(jnp.float32)
+    l1 = attrs.get("l1", 0.0) + 1e-10
+    l2 = attrs.get("l2", 0.0) + 1e-10
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_out = pre / denom
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
+
+
+@register_op("lamb",
+             inputs=["Param", "Grad", "LearningRate!", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                      "Beta2PowOut"],
+             grad=None, side_effect=True)
+def lamb(ins, attrs, ctx):
+    p = ins["Param"].astype(jnp.float32)
+    g = ins["Grad"].astype(jnp.float32)
+    m1 = ins["Moment1"].astype(jnp.float32)
+    m2 = ins["Moment2"].astype(jnp.float32)
+    beta1 = attrs.get("beta1", 0.9)
+    beta2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(ins)
+    b1p = ins["Beta1Pow"].reshape(()).astype(jnp.float32)
+    b2p = ins["Beta2Pow"].reshape(()).astype(jnp.float32)
+    m1_out = beta1 * m1 + (1 - beta1) * g
+    m2_out = beta2 * m2 + (1 - beta2) * jnp.square(g)
+    m1_hat = m1_out / (1 - b1p)
+    m2_hat = m2_out / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = p - lr * trust * r
+    return {"ParamOut": p_out.astype(ins["Param"].dtype),
+            "Moment1Out": m1_out, "Moment2Out": m2_out,
+            "Beta1PowOut": (b1p * beta1).astype(ins["Beta1Pow"].dtype),
+            "Beta2PowOut": (b2p * beta2).astype(ins["Beta2Pow"].dtype)}
+
+
+@register_op("dpsgd", inputs=["Param", "Grad", "LearningRate!"],
+             outputs=["ParamOut"], grad=None, side_effect=True)
+def dpsgd(ins, attrs, ctx):
+    import jax
+    p, g = ins["Param"].astype(jnp.float32), ins["Grad"].astype(jnp.float32)
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.key(attrs), g.shape)
+    p_out = p - _lr(ins) * (g + noise / batch_size)
+    return {"ParamOut": p_out.astype(ins["Param"].dtype)}
+
+
+@register_op("average_accumulates",
+             inputs=["param", "in_sum_1", "in_sum_2", "in_sum_3",
+                     "in_num_accumulates!", "in_old_num_accumulates!",
+                     "in_num_updates!"],
+             outputs=["out_sum_1", "out_sum_2", "out_sum_3",
+                      "out_num_accumulates", "out_old_num_accumulates",
+                      "out_num_updates"],
+             grad=None, side_effect=True)
+def average_accumulates(ins, attrs, ctx):
+    # ModelAverage support op (reference optimizers/average_accumulates_op)
+    p = ins["param"]
+    s1, s2, s3 = ins["in_sum_1"], ins["in_sum_2"], ins["in_sum_3"]
+    na = ins["in_num_accumulates"].reshape(())
+    ona = ins["in_old_num_accumulates"].reshape(())
+    nu = ins["in_num_updates"].reshape(())
+    avg_window = attrs.get("average_window", 10.0)
+    max_avg = attrs.get("max_average_window", 10000)
+    min_avg = attrs.get("min_average_window", 10000)
+    na = na + 1
+    nu = nu + 1
+    s1 = s1 + p
+    window_full = (na >= min_avg) & (na >= jnp.minimum(
+        max_avg, nu * avg_window))
+    s2_new = jnp.where(window_full, s2 + s1, s2)
+    s1_new = jnp.where(window_full, jnp.zeros_like(s1), s1)
+    ona_new = jnp.where(window_full, na, ona)
+    na_new = jnp.where(window_full, jnp.zeros_like(na), na)
+    # roll s2->s3 when it grows too old
+    return {"out_sum_1": s1_new, "out_sum_2": s2_new, "out_sum_3": s3,
+            "out_num_accumulates": na_new.reshape(ins["in_num_accumulates"].shape),
+            "out_old_num_accumulates": ona_new.reshape(
+                ins["in_old_num_accumulates"].shape),
+            "out_num_updates": nu.reshape(ins["in_num_updates"].shape)}

@@ -1,0 +1,117 @@
+"""Random ops (reference: uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, bernoulli_op, randint_op, randperm_op).
+All keys come from the counter-based OpContext PRNG (Philox under JAX) so
+static-graph replays are reproducible per (seed, op_uid)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from ...core.dtype import np_dtype
+
+
+def _shape(ins, attrs):
+    return tuple(attrs.get("shape", []))
+
+
+@register_op("uniform_random", inputs=["ShapeTensor?!"], outputs=["Out"],
+             grad=None)
+def uniform_random(ins, attrs, ctx):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(ctx.key(attrs), _shape(ins, attrs),
+                             jnp.float32,
+                             attrs.get("min", -1.0), attrs.get("max", 1.0))
+    return {"Out": out.astype(dt)}
+
+
+@register_op("uniform_random_batch_size_like", inputs=["Input!"],
+             outputs=["Out"], grad=None)
+def uniform_random_batch_size_like(ins, attrs, ctx):
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ins["Input"].shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.uniform(ctx.key(attrs), tuple(shape), jnp.float32,
+                             attrs.get("min", -1.0), attrs.get("max", 1.0))
+    return {"Out": out.astype(np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("gaussian_random", inputs=["ShapeTensor?!"], outputs=["Out"],
+             grad=None)
+def gaussian_random(ins, attrs, ctx):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(ctx.key(attrs), _shape(ins, attrs), jnp.float32)
+    return {"Out": out.astype(dt)}
+
+
+@register_op("truncated_gaussian_random", inputs=[], outputs=["Out"],
+             grad=None)
+def truncated_gaussian_random(ins, attrs, ctx):
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.truncated_normal(ctx.key(attrs), -2.0, 2.0,
+                                    _shape(ins, attrs), jnp.float32)
+    return {"Out": out.astype(dt)}
+
+
+@register_op("bernoulli", inputs=["X"], outputs=["Out"], grad=None)
+def bernoulli(ins, attrs, ctx):
+    x = ins["X"]
+    return {"Out": jax.random.bernoulli(ctx.key(attrs), x).astype(x.dtype)}
+
+
+@register_op("randint", inputs=[], outputs=["Out"], grad=None)
+def randint(ins, attrs, ctx):
+    dt = np_dtype(attrs.get("dtype", "int64"))
+    out = jax.random.randint(ctx.key(attrs), tuple(attrs["shape"]),
+                             attrs.get("low", 0), attrs.get("high", 100))
+    return {"Out": out.astype(dt)}
+
+
+@register_op("randperm", inputs=[], outputs=["Out"], grad=None)
+def randperm(ins, attrs, ctx):
+    n = attrs["n"]
+    dt = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(ctx.key(attrs), n).astype(dt)}
+
+
+@register_op("sampling_id", inputs=["X!"], outputs=["Out"], grad=None)
+def sampling_id(ins, attrs, ctx):
+    x = ins["X"]
+    ids = jax.random.categorical(ctx.key(attrs), jnp.log(x + 1e-12), axis=-1)
+    return {"Out": ids.astype(jnp.int64)}
+
+
+@register_op("multinomial", inputs=["X!"], outputs=["Out"], grad=None)
+def multinomial(ins, attrs, ctx):
+    x = ins["X"]
+    num = attrs.get("num_samples", 1)
+    logits = jnp.log(x + 1e-12)
+    keys = jax.random.split(ctx.key(attrs), num)
+    samples = jnp.stack([jax.random.categorical(k, logits, axis=-1)
+                         for k in keys], axis=-1)
+    return {"Out": samples.astype(jnp.int64)}
+
+
+@register_op("seed", inputs=[], outputs=["Out"], grad=None, side_effect=True)
+def seed_op(ins, attrs, ctx):
+    return {"Out": jnp.asarray([attrs.get("seed", 0)], jnp.int32)}
+
+
+@register_op("random_crop", inputs=["X", "Seed!"], outputs=["Out", "SeedOut"],
+             grad=None)
+def random_crop(ins, attrs, ctx):
+    x = ins["X"]
+    shape = attrs["shape"]  # crop shape for trailing dims
+    key = ctx.key(attrs)
+    starts = []
+    for i, s in enumerate(shape):
+        dim = x.shape[x.ndim - len(shape) + i]
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, dim - s + 1))
+    sl = [slice(None)] * (x.ndim - len(shape))
+    out = jax.lax.dynamic_slice(
+        x, [0] * (x.ndim - len(shape)) + starts,
+        list(x.shape[:x.ndim - len(shape)]) + list(shape))
+    return {"Out": out, "SeedOut": ins["Seed"]}

@@ -1,0 +1,38 @@
+"""Reductions (reference: /root/reference/paddle/fluid/operators/reduce_ops/).
+Attrs follow the reference: `dim` (list), `keep_dim`, `reduce_all`."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _axes(x, attrs):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % x.ndim for d in dim)
+
+
+def _reduce(name, fn, grad="auto"):
+    @register_op(name, inputs=["X"], outputs=["Out"], grad=grad)
+    def kernel(ins, attrs, ctx, _fn=fn):
+        x = ins["X"]
+        out = _fn(x, axis=_axes(x, attrs), keepdims=attrs.get("keep_dim", False))
+        return {"Out": out}
+    return kernel
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", lambda x, axis, keepdims: jnp.all(x, axis=axis,
+                                                        keepdims=keepdims),
+        grad=None)
+_reduce("reduce_any", lambda x, axis, keepdims: jnp.any(x, axis=axis,
+                                                        keepdims=keepdims),
+        grad=None)

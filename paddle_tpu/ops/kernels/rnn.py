@@ -1,0 +1,221 @@
+"""RNN ops (reference: gru_op.cc, lstm_op.cc, gru_unit_op.cc, lstm_unit_op.cc,
+warpctc, beam search).  Time loops use lax.scan — compiler-friendly, static
+shapes, no per-step Python dispatch (the reference runs one C++ kernel per
+step inside a while op)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+@register_op("lstm_unit", inputs=["X", "C_prev"], outputs=["C", "H"])
+def lstm_unit(ins, attrs, ctx):
+    x, c_prev = ins["X"], ins["C_prev"]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, j, f, o = jnp.split(x, 4, axis=1)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit", inputs=["Input", "HiddenPrev", "Weight", "Bias?"],
+             outputs=["Gate", "ResetHiddenPrev", "Hidden"])
+def gru_unit(ins, attrs, ctx):
+    x, h_prev, w = ins["Input"], ins["HiddenPrev"], ins["Weight"]
+    d = h_prev.shape[1]
+    if ins.get("Bias") is not None:
+        x = x + ins["Bias"].reshape(1, -1)
+    # w: [d, 3d] -> gates [d, 2d], candidate [d, d]
+    w_gates, w_cand = w[:, :2 * d], w[:, 2 * d:]
+    xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+    gates = jnp.concatenate([xu, xr], 1) + h_prev @ w_gates
+    u = jax.nn.sigmoid(gates[:, :d])
+    r = jax.nn.sigmoid(gates[:, d:])
+    rh = r * h_prev
+    c = jnp.tanh(xc + rh @ w_cand)
+    h = u * h_prev + (1.0 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=1)
+    return {"Gate": gate, "ResetHiddenPrev": rh, "Hidden": h}
+
+
+def _lstm_scan(x, h0, c0, w, b, reverse=False):
+    """x: [b, t, 4d] pre-projected gates input; w: [d, 4d] recurrent weight."""
+    d = h0.shape[-1]
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ w + (b if b is not None else 0.0)
+        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    xs = jnp.swapaxes(x, 0, 1)  # [t, b, 4d]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+@register_op("lstm", inputs=["Input", "H0?", "C0?", "Weight", "Bias?"],
+             outputs=["Hidden", "Cell", "BatchGate", "BatchCellPreAct"])
+def lstm(ins, attrs, ctx):
+    x = ins["Input"]  # [b, t, 4d] (dense path)
+    d = ins["Weight"].shape[0]
+    b_sz = x.shape[0]
+    h0 = ins.get("H0")
+    c0 = ins.get("C0")
+    h0 = jnp.zeros((b_sz, d), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((b_sz, d), x.dtype) if c0 is None else c0
+    bias = ins.get("Bias")
+    hs, cs = _lstm_scan(x, h0, c0, ins["Weight"],
+                        bias[:, :4 * d] if bias is not None else None,
+                        reverse=attrs.get("is_reverse", False))
+    return {"Hidden": hs, "Cell": cs, "BatchGate": x,
+            "BatchCellPreAct": cs}
+
+
+@register_op("gru", inputs=["Input", "H0?", "Weight", "Bias?"],
+             outputs=["Hidden", "BatchGate", "BatchResetHiddenPrev",
+                      "BatchHidden"])
+def gru(ins, attrs, ctx):
+    x, w = ins["Input"], ins["Weight"]  # x: [b, t, 3d]
+    d = w.shape[0]
+    b_sz = x.shape[0]
+    h0 = ins.get("H0")
+    h0 = jnp.zeros((b_sz, d), x.dtype) if h0 is None else h0
+    bias = ins.get("Bias")
+
+    def step(h, xt):
+        sub = {"Input": xt, "HiddenPrev": h, "Weight": w}
+        if bias is not None:
+            sub["Bias"] = bias
+        out = gru_unit(sub, attrs, ctx)
+        return out["Hidden"], out["Hidden"]
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, 0)
+    _, hs = jax.lax.scan(step, h0, xs)
+    if attrs.get("is_reverse", False):
+        hs = jnp.flip(hs, 0)
+    hs = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": hs, "BatchGate": x, "BatchResetHiddenPrev": hs,
+            "BatchHidden": hs}
+
+
+@register_op("rnn",
+             inputs=["Input", "PreState*", "WeightList*", "SequenceLength?!"],
+             outputs=["Out", "State*", "Reserve", "DropoutState"])
+def rnn(ins, attrs, ctx):
+    """2.0 cudnn-style multi-layer RNN (LSTM/GRU/RNN) over [t, b, d] input."""
+    x = ins["Input"]
+    mode = attrs.get("mode", "LSTM")
+    hidden = attrs.get("hidden_size")
+    layers = attrs.get("num_layers", 1)
+    bidi = attrs.get("is_bidirec", False)
+    ndir = 2 if bidi else 1
+    ws = ins["WeightList"]
+    pre = ins["PreState"]
+    h0_all = pre[0]  # [layers*ndir, b, h]
+    c0_all = pre[1] if mode == "LSTM" else None
+    t, b, _ = x.shape
+    out = x
+    h_last, c_last = [], []
+    wi = 0
+    for layer in range(layers):
+        dir_outs = []
+        for d_ in range(ndir):
+            w_ih, w_hh = ws[wi], ws[wi + 1]
+            b_ih = ws[2 * layers * ndir + wi] \
+                if len(ws) > 2 * layers * ndir else None
+            b_hh = ws[2 * layers * ndir + wi + 1] \
+                if len(ws) > 2 * layers * ndir else None
+            wi += 2
+            idx = layer * ndir + d_
+            h0 = h0_all[idx]
+            xs = out if d_ == 0 else out
+            gates_in = jnp.einsum("tbd,gd->tbg", xs, w_ih)
+            if b_ih is not None:
+                gates_in = gates_in + b_ih + (b_hh if b_hh is not None else 0)
+            if mode == "LSTM":
+                c0 = c0_all[idx]
+
+                def step(carry, g):
+                    h, c = carry
+                    gates = g + h @ w_hh.T
+                    i, f, cand, o = jnp.split(gates, 4, axis=-1)
+                    c_new = jax.nn.sigmoid(f) * c + \
+                        jax.nn.sigmoid(i) * jnp.tanh(cand)
+                    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                    return (h_new, c_new), h_new
+
+                seq = gates_in if d_ == 0 else jnp.flip(gates_in, 0)
+                (hT, cT), hs = jax.lax.scan(step, (h0, c0), seq)
+                if d_ == 1:
+                    hs = jnp.flip(hs, 0)
+                h_last.append(hT)
+                c_last.append(cT)
+            else:  # GRU / simple RNN
+                def step_g(h, g):
+                    zr = g[..., :2 * hidden] + (h @ w_hh.T)[..., :2 * hidden]
+                    z = jax.nn.sigmoid(zr[..., :hidden])
+                    r = jax.nn.sigmoid(zr[..., hidden:])
+                    cand = jnp.tanh(g[..., 2 * hidden:] +
+                                    (r * h) @ w_hh[2 * hidden:].T)
+                    h_new = z * h + (1 - z) * cand
+                    return h_new, h_new
+
+                seq = gates_in if d_ == 0 else jnp.flip(gates_in, 0)
+                hT, hs = jax.lax.scan(step_g, h0, seq)
+                if d_ == 1:
+                    hs = jnp.flip(hs, 0)
+                h_last.append(hT)
+            dir_outs.append(hs)
+        out = jnp.concatenate(dir_outs, axis=-1) if bidi else dir_outs[0]
+    states = [jnp.stack(h_last)]
+    if mode == "LSTM":
+        states.append(jnp.stack(c_last))
+    return {"Out": out, "State": states,
+            "Reserve": jnp.zeros((1,), x.dtype),
+            "DropoutState": jnp.zeros((1,), jnp.uint8)}
+
+
+@register_op("edit_distance", inputs=["Hyps!", "Refs!"],
+             outputs=["Out", "SequenceNum"], grad=None)
+def edit_distance(ins, attrs, ctx):
+    hyp, ref = ins["Hyps"], ins["Refs"]
+    # dense [b, t] int tokens, -1 padding
+    def dist_one(h, r):
+        hl = jnp.sum(h >= 0)
+        rl = jnp.sum(r >= 0)
+        maxh, maxr = h.shape[0], r.shape[0]
+        row = jnp.arange(maxr + 1).astype(jnp.float32)
+
+        def outer(i, row):
+            def inner(j, acc):
+                prev_row, cur = acc
+                cost = jnp.where(h[i - 1] == r[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(cur[j - 1] + 1,
+                                              prev_row[j] + 1),
+                                  prev_row[j - 1] + cost)
+                return prev_row, cur.at[j].set(val)
+
+            new = jnp.zeros_like(row).at[0].set(i * 1.0)
+            _, new = jax.lax.fori_loop(1, maxr + 1, inner, (row, new))
+            return new
+
+        final = jax.lax.fori_loop(1, maxh + 1, outer, row)
+        d = final[rl]
+        if attrs.get("normalized", True):
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    out = jax.vmap(dist_one)(hyp, ref)
+    return {"Out": out.reshape(-1, 1),
+            "SequenceNum": jnp.asarray([hyp.shape[0]], jnp.int64)}

@@ -1,0 +1,219 @@
+"""Sequence ops.
+
+The reference's sequence family operates on LoD (ragged) tensors
+(/root/reference/paddle/fluid/operators/sequence_ops/,
+ framework/lod_tensor.h:62).  XLA requires static shapes, so the TPU-native
+representation is dense padded batches + explicit length tensors (SURVEY.md
+§5.7): `sequence_mask` produces masks from lengths, `sequence_pad/unpad`
+convert between ragged-host and padded-device forms, and reductions take the
+mask into account.  Ops whose reference semantics are inherently ragged-rank
+(lod_reset etc.) live on the host side in io/lod.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+@register_op("sequence_mask", inputs=["X!", "MaxLenTensor?!"], outputs=["Y"],
+             grad=None)
+def sequence_mask(ins, attrs, ctx):
+    x = ins["X"]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        maxlen = int(x.max()) if not isinstance(x, jax.core.Tracer) else None
+        if maxlen is None:
+            raise ValueError("sequence_mask inside jit needs static maxlen")
+    from ...core.dtype import np_dtype
+    rng = jnp.arange(maxlen)
+    mask = rng[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(x.shape + (maxlen,))
+    return {"Y": mask.astype(np_dtype(attrs.get("out_dtype", "int64")))}
+
+
+@register_op("sequence_pad", inputs=["X", "PadValue", "Length?!"],
+             outputs=["Out", "Length"])
+def sequence_pad(ins, attrs, ctx):
+    # dense path: X already [batch, maxlen, ...]; passthrough with lengths
+    x = ins["X"]
+    length = ins.get("Length")
+    if length is None:
+        length = jnp.full((x.shape[0],), x.shape[1], jnp.int64)
+    return {"Out": x, "Length": length}
+
+
+@register_op("sequence_unpad", inputs=["X", "Length!"], outputs=["Out"])
+def sequence_unpad(ins, attrs, ctx):
+    # on-device we keep padded; masking happens in consumers
+    return {"Out": ins["X"]}
+
+
+@register_op("sequence_pool", inputs=["X", "Length?!"],
+             outputs=["Out", "MaxIndex?"])
+def sequence_pool(ins, attrs, ctx):
+    """Padded-batch pooling: X [batch, maxlen, d], optional Length [batch]."""
+    x = ins["X"]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    length = ins.get("Length")
+    if length is not None:
+        mask = (jnp.arange(x.shape[1])[None, :] <
+                length.reshape(-1, 1)).astype(x.dtype)
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    else:
+        mask = jnp.ones(x.shape[:2] + (1,) * (x.ndim - 2), x.dtype)
+    cnt = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    if ptype == "SUM":
+        out = jnp.sum(x * mask, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * mask, axis=1) / cnt
+    elif ptype == "SQRT":
+        out = jnp.sum(x * mask, axis=1) / jnp.sqrt(cnt)
+    elif ptype == "MAX":
+        neg = jnp.asarray(-1e38, x.dtype)
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = (jnp.sum(mask, axis=1) - 1).astype(jnp.int32)
+        out = jnp.take_along_axis(x, idx[:, None].reshape(
+            (-1, 1) + (1,) * (x.ndim - 2)), axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(ptype)
+    return {"Out": out}
+
+
+@register_op("sequence_softmax", inputs=["X", "Length?!"], outputs=["Out"])
+def sequence_softmax(ins, attrs, ctx):
+    x = ins["X"]
+    length = ins.get("Length")
+    if length is None:
+        return {"Out": jax.nn.softmax(x, axis=-1)}
+    mask = jnp.arange(x.shape[1])[None, :] < length.reshape(-1, 1)
+    neg = jnp.asarray(-1e38, x.dtype)
+    return {"Out": jax.nn.softmax(jnp.where(mask, x, neg), axis=1) *
+            mask.astype(x.dtype)}
+
+
+@register_op("sequence_expand", inputs=["X", "Y!"], outputs=["Out"])
+def sequence_expand(ins, attrs, ctx):
+    # dense analog: broadcast X rows to Y's time dim
+    x, y = ins["X"], ins["Y"]
+    if x.ndim < y.ndim:
+        x = jnp.expand_dims(x, 1)
+    return {"Out": jnp.broadcast_to(x, y.shape[:2] + x.shape[2:])}
+
+
+@register_op("sequence_expand_as", inputs=["X", "Y!"], outputs=["Out"])
+def sequence_expand_as(ins, attrs, ctx):
+    return sequence_expand(ins, attrs, ctx)
+
+
+@register_op("sequence_reverse", inputs=["X", "Length?!"], outputs=["Y"])
+def sequence_reverse(ins, attrs, ctx):
+    x = ins["X"]
+    length = ins.get("Length")
+    if length is None:
+        return {"Y": jnp.flip(x, axis=1)}
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    L = length.reshape(-1, 1)
+    rev_idx = jnp.where(idx < L, L - 1 - idx, idx)
+    return {"Y": jnp.take_along_axis(
+        x, rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2))
+        .astype(jnp.int32), axis=1)}
+
+
+@register_op("sequence_concat", inputs=["X*"], outputs=["Out"])
+def sequence_concat(ins, attrs, ctx):
+    return {"Out": jnp.concatenate(ins["X"], axis=1)}
+
+
+@register_op("sequence_conv", inputs=["X", "Filter", "PaddingData?"],
+             outputs=["Out"])
+def sequence_conv(ins, attrs, ctx):
+    # context window conv over time: X [b, t, d], Filter [ctx*d, m]
+    x, w = ins["X"], ins["Filter"]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        if off < 0:
+            m = jnp.arange(t)[None, :, None] >= -off
+        else:
+            m = jnp.arange(t)[None, :, None] < t - off
+        cols.append(jnp.where(m, shifted, 0.0))
+    col = jnp.concatenate(cols, axis=-1)  # [b, t, ctx*d]
+    return {"Out": jnp.einsum("btc,cm->btm", col, w)}
+
+
+@register_op("sequence_enumerate", inputs=["X!"], outputs=["Out"], grad=None)
+def sequence_enumerate(ins, attrs, ctx):
+    x = ins["X"]
+    win = attrs["win_size"]
+    pad = attrs.get("pad_value", 0)
+    t = x.shape[-1] if x.ndim > 1 else x.shape[0]
+    flat = x.reshape(-1, t)
+    outs = []
+    for i in range(win):
+        shifted = jnp.concatenate(
+            [flat[:, i:], jnp.full((flat.shape[0], i), pad, x.dtype)], axis=1)
+        outs.append(shifted)
+    return {"Out": jnp.stack(outs, axis=-1).reshape(x.shape + (win,))}
+
+
+@register_op("sequence_erase", inputs=["X!"], outputs=["Out"], grad=None)
+def sequence_erase(ins, attrs, ctx):
+    raise NotImplementedError(
+        "sequence_erase has data-dependent output shape; use host-side "
+        "io.lod.sequence_erase")
+
+
+@register_op("sequence_slice", inputs=["X", "Offset!", "Length!"],
+             outputs=["Out"])
+def sequence_slice(ins, attrs, ctx):
+    x = ins["X"]
+    off = jnp.asarray(ins["Offset"]).reshape(-1)[0]
+    ln = int(jnp.asarray(ins["Length"]).reshape(-1)[0])
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, off, ln, axis=1)}
+
+
+@register_op("sequence_reshape", inputs=["X"], outputs=["Out"])
+def sequence_reshape(ins, attrs, ctx):
+    x = ins["X"]
+    new_dim = attrs["new_dim"]
+    return {"Out": x.reshape(x.shape[0], -1, new_dim)}
+
+
+@register_op("im2sequence", inputs=["X", "Y?!"], outputs=["Out"])
+def im2sequence(ins, attrs, ctx):
+    x = ins["X"]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])])
+    oh = (x.shape[2] - kh) // sh + 1
+    ow = (x.shape[3] - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(0, 0), (0, 0)])
+    # patches: [n, c*kh*kw, oh, ow] -> [n*oh*ow, c*kh*kw]
+    out = jnp.moveaxis(patches, 1, -1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": out}
+
+
+@register_op("row_conv", inputs=["X", "Filter"], outputs=["Out"])
+def row_conv(ins, attrs, ctx):
+    # lookahead conv: X [b, t, d], Filter [future_ctx, d]
+    x, w = ins["X"], ins["Filter"]
+    fut = w.shape[0]
+    t = x.shape[1]
+    out = jnp.zeros_like(x)
+    for i in range(fut):
+        shifted = jnp.roll(x, -i, axis=1)
+        mask = (jnp.arange(t) < t - i)[None, :, None]
+        out = out + jnp.where(mask, shifted, 0.0) * w[i][None, None, :]
+    return {"Out": out}

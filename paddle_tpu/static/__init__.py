@@ -1,0 +1,32 @@
+"""paddle_tpu.static — the static-graph (fluid) API surface.
+
+Analog of `paddle.fluid` / `paddle.static`: Program construction, layers,
+Executor, backward, optimizers, initializers (SURVEY.md §2.2 P1-P6).
+"""
+from ..core.program import (  # noqa: F401
+    Program, Block, OpDesc, VarDesc, OpRole, default_main_program,
+    default_startup_program, program_guard, name_scope, unique_name,
+)
+from ..core.place import (  # noqa: F401
+    CPUPlace, XLAPlace, TPUPlace, CUDAPlace,
+)
+from .executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard, BlockTracer,
+)
+from .backward import append_backward, gradients  # noqa: F401
+from .initializer import (  # noqa: F401
+    Constant, Uniform, Normal, TruncatedNormal, Xavier, MSRA,
+    NumpyArrayInitializer, set_global_initializer,
+)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import nets  # noqa: F401
+from .layers import data  # noqa: F401
+
+from .optimizer import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Ftrl,
+    Lamb, ExponentialMovingAverage, L1Decay, L2Decay, GradientClipByValue,
+    GradientClipByNorm, GradientClipByGlobalNorm,
+)

@@ -1,0 +1,225 @@
+"""append_backward: graph-level reverse-mode autodiff by op rewriting.
+
+Analog of /root/reference/python/paddle/fluid/backward.py:1275 append_backward
+(and _append_backward_ops_ :922, _append_backward_vars_ :1103).  Walks the
+block's ops in reverse, appending each op's grad op (slot convention from
+paddle_tpu.ops.registry._register_grad), accumulating duplicate gradients with
+sum ops (the reference's @RENAME@ mechanism).
+
+Kept as a *program rewrite* rather than jax.grad so that AMP / recompute /
+gradient-merge / pipeline meta-optimizers can rewrite the backward graph the
+same way the reference does (SURVEY.md §7 stage 5).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core.program import Program, Block, OpDesc, VarDesc, OpRole, unique_name
+from ..ops.registry import get_op_info
+
+__all__ = ["append_backward", "grad_var_name", "gradients",
+           "_find_loss_op_idx"]
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def _requires_grad_vars(block: Block, ops: List[OpDesc]) -> Set[str]:
+    """Forward sweep: vars that (transitively) depend on a trainable param or
+    a non-stop-gradient var."""
+    req: Set[str] = set()
+    for v in block.program.global_block().vars.values():
+        if v.is_parameter and v.trainable:
+            req.add(v.name)
+        elif v.is_data and not v.stop_gradient:
+            # data vars default stop_gradient=True (fluid semantics); an
+            # explicitly unfrozen input is a grad leaf (fluid.gradients)
+            req.add(v.name)
+    for v in block.vars.values():
+        if v.is_data and not v.stop_gradient:
+            req.add(v.name)
+    for op in ops:
+        info = get_op_info(op.type)
+        if info is None or not info.has_grad:
+            continue
+        needs = False
+        for slot in info.inputs:
+            if slot.no_grad:
+                continue
+            for n in op.inputs.get(slot.name, []):
+                if n in req:
+                    needs = True
+        if needs:
+            for n in op.output_names():
+                try:
+                    if not block.var(n).stop_gradient:
+                        req.add(n)
+                except KeyError:
+                    req.add(n)
+    return req
+
+
+def _find_loss_op_idx(block: Block, loss_name: str) -> int:
+    for i in range(len(block.ops) - 1, -1, -1):
+        if loss_name in block.ops[i].output_names():
+            return i
+    raise ValueError(f"loss var {loss_name!r} is not produced in this block")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops for `loss` to its program; returns
+    [(param VarDesc, grad VarDesc)] like the reference (backward.py:1275).
+
+    checkpoints: list of var (names) to use for recompute segmentation
+    (reference backward.py:689) — handled by the recompute rewrite in
+    paddle_tpu.distributed.meta_optimizers; accepted here for API parity.
+    """
+    block = loss.block if loss.block is not None else None
+    if block is None:
+        from ..core.program import default_main_program
+        block = default_main_program().global_block()
+    program: Program = block.program
+    loss_name = loss.name
+    no_grad = set(no_grad_set or ())
+
+    if checkpoints:
+        from .recompute_rewrite import append_backward_with_checkpoints
+        return append_backward_with_checkpoints(
+            block, loss, parameter_list, no_grad, checkpoints)
+
+    loss_idx = _find_loss_op_idx(block, loss_name)
+    fwd_ops = block.ops[: loss_idx + 1]
+    req = _requires_grad_vars(block, fwd_ops)
+    req -= no_grad
+
+    # mark the loss op for pipeline/AMP passes (reference uses op_role Loss)
+    block.ops[loss_idx].attrs[OpRole.KEY] = int(OpRole.Forward | OpRole.Loss)
+
+    with program._op_role_guard(OpRole.Backward):
+        # seed: d loss / d loss = 1
+        g_loss = block.create_var(
+            name=grad_var_name(loss_name), shape=loss.shape,
+            dtype=loss.dtype, stop_gradient=True)
+        block.append_op(
+            "fill_constant", outputs={"Out": g_loss},
+            attrs={"shape": (list(loss.shape) if loss.shape is not None
+                             else [1]), "dtype": loss.dtype,
+                   "value": 1.0, OpRole.KEY: OpRole.Backward})
+
+        # pending grad pieces per var: var -> [grad piece names]
+        pending: Dict[str, List[str]] = {loss_name: [g_loss.name]}
+        grad_map: Dict[str, str] = {}
+
+        def _settle(name: str) -> Optional[str]:
+            """Collapse accumulated grad pieces of `name` into one var."""
+            pieces = pending.get(name)
+            if not pieces:
+                return None
+            if len(pieces) == 1:
+                grad_map[name] = pieces[0]
+                return pieces[0]
+            out = grad_var_name(name)
+            if out in (p for p in pieces):
+                out = unique_name(grad_var_name(name) + "@SUM")
+            v = block.create_var(name=out, stop_gradient=True)
+            block.append_op("sum", inputs={"X": list(pieces)},
+                            outputs={"Out": out})
+            pending[name] = [out]
+            grad_map[name] = out
+            return out
+
+        for op in reversed(fwd_ops):
+            info = get_op_info(op.type)
+            if info is None or not info.has_grad:
+                continue
+            out_has_grad = any(n in pending for n in op.output_names())
+            in_requires = any(
+                n in req
+                for slot in info.inputs if not slot.no_grad
+                for n in op.inputs.get(slot.name, []))
+            if not (out_has_grad and in_requires):
+                continue
+
+            g_inputs: Dict[str, List[str]] = {}
+            for slot in info.inputs:
+                names = op.inputs.get(slot.name, [])
+                if names:
+                    g_inputs[slot.name] = list(names)
+            for slot in info.outputs:
+                names = op.outputs.get(slot.name, [])
+                if names:
+                    g_inputs[slot.name] = list(names)
+                    gnames = []
+                    for n in names:
+                        g = _settle(n)
+                        gnames.append(g if g is not None else "")
+                    if any(gnames):
+                        g_inputs[slot.name + GRAD_SUFFIX] = gnames
+
+            g_outputs: Dict[str, List[str]] = {}
+            for slot in info.inputs:
+                if slot.no_grad:
+                    continue
+                names = op.inputs.get(slot.name, [])
+                outs = []
+                for n in names:
+                    if n not in req or n in no_grad:
+                        outs.append("")
+                        continue
+                    piece = unique_name(grad_var_name(n))
+                    block.create_var(name=piece, stop_gradient=True)
+                    pending.setdefault(n, []).append(piece)
+                    outs.append(piece)
+                if any(outs):
+                    g_outputs[slot.name + GRAD_SUFFIX] = outs
+
+            if not g_outputs:
+                continue
+            gop = block.append_op(info.grad_op_type(), g_inputs, g_outputs,
+                                  attrs=dict(op.attrs))
+            gop.attrs[OpRole.KEY] = OpRole.Backward
+            gop.attrs["fwd_uid"] = op.attrs.get("op_uid", 0)
+
+        # settle every remaining pending var (params & inputs)
+        for name in list(pending):
+            _settle(name)
+
+    program._grad_map.update(grad_map)
+
+    if parameter_list is not None:
+        params = [p if isinstance(p, VarDesc) else
+                  program.global_block().var(p) for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    result = []
+    for p in params:
+        g = grad_map.get(p.name)
+        if g is None:
+            continue
+        gv = block.var(g)
+        gv.shape = p.shape
+        gv.dtype = gv.dtype or p.dtype
+        result.append((p, gv))
+        # record for op_role_var (used by DGC/AMP passes in the reference)
+    return result
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients — grads of targets w.r.t. arbitrary inputs
+    (reference backward.py:1823)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(targets) == 1, "gradients(): single target supported"
+    pairs = append_backward(targets[0], parameter_list=None,
+                            no_grad_set=no_grad_set)
+    block = targets[0].block
+    program = block.program
+    outs = []
+    for x in inputs:
+        g = program._grad_map.get(x.name)
+        outs.append(block.var(g) if g else None)
+    return outs

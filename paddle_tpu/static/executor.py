@@ -1,0 +1,285 @@
+"""Executor: runs a Program by tracing its whole block into ONE jitted XLA
+computation.
+
+Analog of the reference executor stack
+(/root/reference/python/paddle/fluid/executor.py:474 Executor,
+ /root/reference/paddle/fluid/framework/executor.cc:474-480 per-op hot loop) —
+but where the reference interprets op-by-op with per-kernel launches, here the
+op list is composed into a single function (state, feed, seed) ->
+(fetches, state') and `jax.jit`-ed with state buffers donated, so XLA fuses
+the entire step (SURVEY.md §3.1 "the whole :474-480 loop becomes ONE traced
+XLA computation").  Garbage collection (executor.cc:445-472 GC selection)
+disappears: XLA buffer liveness subsumes it.
+
+Startup programs are interpreted eagerly op-by-op — they run once, tracing
+would only add compile latency.  Set FLAGS_eager_run=1 to interpret main
+programs too (debug path, analog of the reference's sequential executor).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.program import Program, Block, default_main_program, OpRole
+from ..core.place import CPUPlace, XLAPlace, Place, _current_expected_place
+from ..core.dtype import np_dtype
+from ..ops.registry import get_op_info, OpContext
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard",
+           "as_numpy", "BlockTracer"]
+
+
+class Scope:
+    """name -> device array store (analog of framework/scope.h:52, flattened:
+    no parent chain — programs here use unique names)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def find_var(self, name: str):
+        return _VarView(self, name) if name in self.vars else None
+
+    def var(self, name: str):
+        self.vars.setdefault(name, None)
+        return _VarView(self, name)
+
+    def set(self, name: str, value):
+        self.vars[name] = value
+
+    def get(self, name: str):
+        return self.vars.get(name)
+
+    def drop_kids(self):
+        pass
+
+    def keys(self):
+        return self.vars.keys()
+
+
+class _VarView:
+    def __init__(self, scope, name):
+        self._scope, self._name = scope, name
+
+    def get_tensor(self):
+        return self._scope.vars[self._name]
+
+    def set(self, value, place=None):
+        self._scope.vars[self._name] = jnp.asarray(value)
+
+
+_global_scope = Scope()
+_scope_stack = threading.local()
+
+
+def global_scope() -> Scope:
+    stack = getattr(_scope_stack, "stack", None)
+    return stack[-1] if stack else _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        if not hasattr(_scope_stack, "stack"):
+            _scope_stack.stack = []
+        _scope_stack.stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *a):
+        _scope_stack.stack.pop()
+
+
+def as_numpy(x):
+    if isinstance(x, (list, tuple)):
+        return [as_numpy(i) for i in x]
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# block tracing
+# ---------------------------------------------------------------------------
+class BlockTracer:
+    """Composes a block's ops into one pure function over an environment of
+    jax values.  Shared by Executor (jit path), the startup interpreter, and
+    the distributed CompiledProgram (which traces under shard_map)."""
+
+    def __init__(self, block: Block, skip_types=("feed", "fetch")):
+        self.block = block
+        self.skip_types = set(skip_types)
+
+    def run(self, env: Dict[str, Any], ctx: OpContext,
+            ops=None) -> Dict[str, Any]:
+        for op in (ops if ops is not None else self.block.ops):
+            if op.type in self.skip_types:
+                continue
+            self.run_op(op, env, ctx)
+        return env
+
+    def run_op(self, op, env: Dict[str, Any], ctx: OpContext):
+        info = get_op_info(op.type)
+        if info is None:
+            raise NotImplementedError(
+                f"op {op.type!r} has no registered kernel")
+        ins: Dict[str, Any] = {}
+        for slot in info.inputs:
+            names = op.inputs.get(slot.name, [])
+            if slot.duplicable:
+                ins[slot.name] = [env[n] for n in names if n and n in env]
+            else:
+                n = names[0] if names else None
+                ins[slot.name] = env.get(n) if n else None
+        attrs = dict(op.attrs)
+        outs = info.kernel(ins, attrs, ctx)
+        for slot in info.outputs:
+            names = op.outputs.get(slot.name, [])
+            if not names:
+                continue
+            val = outs.get(slot.name) if outs else None
+            if val is None:
+                continue
+            if slot.duplicable:
+                for n, v in zip(names, val):
+                    if n and v is not None:
+                        env[n] = v
+            else:
+                if names[0]:
+                    env[names[0]] = val
+        return env
+
+
+def _persistable_names(program: Program) -> List[str]:
+    return sorted(v.name for b in program.blocks for v in b.vars.values()
+                  if v.persistable)
+
+
+class Executor:
+    """exe = Executor(XLAPlace(0)); exe.run(startup); exe.run(main, feed,
+    fetch_list) — the reference's two-program contract (executor.py:474)."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or _current_expected_place()
+        # compiled step cache: key -> (jitted fn, state names)
+        self._cache: Dict[Tuple, Any] = {}
+        self._step = 0
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True, fetch_var_name="fetch",
+            feed_var_name="feed", use_prune=False):
+        from ..distributed.compiled_program import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        program = program if program is not None else default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in (fetch_list or [])]
+
+        if self._is_startup_like(program):
+            self._run_eager(program, scope, feed, fetch_names)
+            return [] if not fetch_names else [
+                as_numpy(scope.get(n)) if return_numpy else scope.get(n)
+                for n in fetch_names]
+
+        import os
+        if os.environ.get("FLAGS_eager_run"):
+            self._run_eager(program, scope, feed, fetch_names)
+            fetched = [scope.get(n) for n in fetch_names]
+            return [as_numpy(f) for f in fetched] if return_numpy else fetched
+
+        return self._run_compiled(program, scope, feed, fetch_names,
+                                  return_numpy)
+
+    def close(self):
+        self._cache.clear()
+
+    # -- eager interpreter (startup / debug) --------------------------------
+    def _is_startup_like(self, program: Program) -> bool:
+        """Heuristic: programs containing only init ops (no feed/data deps)
+        run eagerly once — matches the reference running startup through the
+        plain executor."""
+        b = program.global_block()
+        init_types = {"fill_constant", "uniform_random", "gaussian_random",
+                      "truncated_gaussian_random", "assign_value", "eye",
+                      "c_broadcast", "broadcast", "seed", "range", "linspace"}
+        return len(b.ops) > 0 and all(op.type in init_types for op in b.ops)
+
+    def _run_eager(self, program: Program, scope: Scope, feed, fetch_names):
+        block = program.global_block()
+        env = {k: v for k, v in scope.vars.items() if v is not None}
+        for name, val in feed.items():
+            env[name] = self._coerce_feed(block, name, val)
+        ctx = OpContext(seed=self._seed_for_step(program))
+        BlockTracer(block).run(env, ctx)
+        self._step += 1
+        # write back persistables + fetches
+        for n in _persistable_names(program):
+            if n in env:
+                scope.set(n, env[n])
+        for n in fetch_names:
+            if n in env:
+                scope.set(n, env[n])
+
+    # -- compiled whole-block path ------------------------------------------
+    def _run_compiled(self, program: Program, scope: Scope, feed,
+                      fetch_names, return_numpy):
+        block = program.global_block()
+        feed_vals = {n: self._coerce_feed(block, n, v)
+                     for n, v in feed.items()}
+        state_names = [n for n in _persistable_names(program)
+                       if scope.get(n) is not None]
+        feed_sig = tuple(sorted(
+            (n, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for n, v in feed_vals.items()))
+        key = (program.fingerprint(), feed_sig, tuple(fetch_names),
+               tuple(state_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile(program, state_names, sorted(feed_vals),
+                               fetch_names)
+            self._cache[key] = fn
+
+        state = {n: scope.get(n) for n in state_names}
+        seed = self._seed_for_step(program)
+        fetches, new_state = fn(state, feed_vals, jnp.uint32(seed))
+        self._step += 1
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _compile(self, program: Program, state_names, feed_names,
+                 fetch_names):
+        block = program.global_block()
+        tracer = BlockTracer(block)
+
+        def step(state, feed, seed):
+            env = dict(state)
+            env.update(feed)
+            ctx = OpContext(seed=seed)
+            tracer.run(env, ctx)
+            new_state = {n: env[n] for n in state_names}
+            fetches = tuple(env[n] for n in fetch_names)
+            return fetches, new_state
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # -- helpers ------------------------------------------------------------
+    def _coerce_feed(self, block, name, val):
+        arr = jnp.asarray(val)
+        try:
+            var = block.var(name)
+        except KeyError:
+            return arr
+        if var.dtype is not None and str(arr.dtype) != var.dtype:
+            arr = arr.astype(np_dtype(var.dtype))
+        return arr
+
+    def _seed_for_step(self, program: Program) -> int:
+        return (int(program.random_seed) * 1000003 + self._step) % (2 ** 31)

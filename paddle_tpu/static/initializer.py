@@ -1,0 +1,203 @@
+"""Parameter initializers — append init ops to the startup program.
+
+Analog of /root/reference/python/paddle/fluid/initializer.py (Constant :118,
+Uniform :214, Normal :308, Xavier :438, MSRA :557, Bilinear, NumpyArrayInit).
+Each initializer appends one op (fill_constant / uniform_random /
+gaussian_random / assign_value) to the *startup* program's global block; the
+Executor runs the startup program once to materialise parameters, exactly like
+the reference's two-program contract.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.program import default_startup_program, VarDesc
+
+__all__ = [
+    "Initializer", "Constant", "ConstantInitializer", "Uniform",
+    "UniformInitializer", "Normal", "NormalInitializer", "TruncatedNormal",
+    "TruncatedNormalInitializer", "Xavier", "XavierInitializer", "MSRA",
+    "MSRAInitializer", "NumpyArrayInitializer", "Assign",
+    "_global_weight_initializer", "_global_bias_initializer",
+    "set_global_initializer",
+]
+
+
+class Initializer:
+    """Base: __call__(var, block) appends the init op into `block` (normally
+    the startup program's global block)."""
+
+    def __call__(self, var: VarDesc, block=None):
+        raise NotImplementedError
+
+    def _startup_block(self, block):
+        if block is not None:
+            return block
+        return default_startup_program().global_block()
+
+    def _declare(self, block, var):
+        # the startup program needs its own VarDesc for the parameter
+        if var.name not in block.vars:
+            block.vars[var.name] = VarDesc(
+                var.name, var.shape, var.dtype, persistable=True,
+                is_parameter=var.is_parameter, block=block)
+
+
+def _fan_in_out(var):
+    shape = var.shape
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+    # fluid convention: fan_in from dim0 for fc ([in, out]), conv is
+    # [out_c, in_c, k, k]
+    fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self.value = float(value)
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        block.append_op(
+            "fill_constant", outputs={"Out": var.name},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "value": self.value})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = float(low), float(high), seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        block.append_op(
+            "uniform_random", outputs={"Out": var.name},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "min": self.low, "max": self.high, "seed": self.seed})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = float(loc), float(scale), seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        block.append_op(
+            "gaussian_random", outputs={"Out": var.name},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = float(loc), float(scale), seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        block.append_op(
+            "truncated_gaussian_random", outputs={"Out": var.name},
+            attrs={"shape": list(var.shape), "dtype": var.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed})
+
+
+class XavierInitializer(Initializer):
+    """Glorot (fluid/initializer.py:438): uniform or normal scaled by
+    fan_in+fan_out."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform = uniform
+        self.fan_in, self.fan_out, self.seed = fan_in, fan_out, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        fi, fo = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            block.append_op(
+                "uniform_random", outputs={"Out": var.name},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "min": -limit, "max": limit, "seed": self.seed})
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            block.append_op(
+                "gaussian_random", outputs={"Out": var.name},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "mean": 0.0, "std": std, "seed": self.seed})
+
+
+class MSRAInitializer(Initializer):
+    """Kaiming/He init (fluid/initializer.py:557)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0,
+                 negative_slope=0.0, nonlinearity="relu"):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        fi, _ = _fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            block.append_op(
+                "uniform_random", outputs={"Out": var.name},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "min": -limit, "max": limit, "seed": self.seed})
+        else:
+            std = math.sqrt(2.0 / fi)
+            block.append_op(
+                "gaussian_random", outputs={"Out": var.name},
+                attrs={"shape": list(var.shape), "dtype": var.dtype,
+                       "mean": 0.0, "std": std, "seed": self.seed})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        block.append_op(
+            "assign_value", outputs={"Out": var.name},
+            attrs={"shape": list(self.value.shape), "dtype": var.dtype,
+                   "values": self.value.ravel().tolist()})
+
+
+# fluid-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Assign = NumpyArrayInitializer
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_weight_initializer():
+    return _global_weight_init
+
+
+def _global_bias_initializer():
+    return _global_bias_init

@@ -1,0 +1,88 @@
+"""LayerHelper: the bridge between layer functions and the Program IR.
+
+Analog of /root/reference/python/paddle/fluid/layer_helper.py — every layer
+function makes one of these to create parameters (registering their
+initializer ops in the startup program), temp output vars, and append ops to
+the current main program block.
+"""
+from __future__ import annotations
+
+from ..core.program import (default_main_program, default_startup_program,
+                            unique_name, VarDesc)
+from .initializer import Xavier, Constant, Initializer
+from .param_attr import ParamAttr
+
+__all__ = ["LayerHelper"]
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        self.name = name if name else unique_name(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    @property
+    def block(self):
+        return self.main_program.current_block()
+
+    # -- parameters ---------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if default_initializer is None:
+            default_initializer = Constant(0.0) if is_bias else Xavier()
+        init = attr.initializer or default_initializer
+        name = attr.name or unique_name(f"{self.name}.w" if not is_bias
+                                        else f"{self.name}.b")
+        # parameter lives in the main program's global block
+        p = self.main_program.global_block().create_parameter(
+            name, shape, dtype, initializer=None, trainable=attr.trainable)
+        p.attrs["learning_rate"] = attr.learning_rate
+        p.attrs["regularizer"] = attr.regularizer
+        p.attrs["need_clip"] = attr.need_clip
+        # init op goes to the startup program
+        init(p, self.startup_program.global_block())
+        return p
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False):
+        return self.block.create_var(
+            name=unique_name(f"{self.name}.tmp"), dtype=dtype,
+            stop_gradient=stop_gradient)
+
+    def create_global_variable(self, shape, dtype="float32", persistable=False,
+                               name=None, initializer=None):
+        name = name or unique_name(f"{self.name}.global")
+        v = self.main_program.global_block().create_var(
+            name=name, shape=shape, dtype=dtype, persistable=persistable)
+        if initializer is not None:
+            initializer(v, self.startup_program.global_block())
+        return v
+
+    # -- ops ----------------------------------------------------------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        return self.block.append_op(type, inputs, outputs, attrs)
+
+    def append_activation(self, out_var, act):
+        if act is None:
+            return out_var
+        tmp = self.create_variable_for_type_inference(out_var.dtype)
+        self.append_op(act, inputs={"X": out_var}, outputs={"Out": tmp})
+        return tmp
+
+    def input(self, name):
+        v = self.kwargs.get(name)
+        if isinstance(v, str):
+            return self.block.var(v)
+        return v

@@ -1,0 +1,1275 @@
+"""Static-graph layer functions: the fluid.layers API surface.
+
+Analog of /root/reference/python/paddle/fluid/layers/nn.py (fc :168,
+conv2d :1405, pool2d, batch_norm :2320, dropout, embedding :393, concat...),
+layers/tensor.py (fill_constant, cast, assign...), layers/loss.py
+(cross_entropy, softmax_with_cross_entropy :1253), layers/control_flow.py.
+
+Each function creates parameters via LayerHelper (init ops into the startup
+program) and appends one or more ops to the current main program block; the
+TPU executor later traces the whole block into a single XLA computation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.program import (VarDesc, default_main_program, unique_name,
+                            OpRole)
+from .layer_helper import LayerHelper
+from .initializer import Constant, Xavier, Normal, NumpyArrayInitializer
+from .param_attr import ParamAttr
+
+__all__ = [
+    "data", "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+    "pool2d", "pool3d", "adaptive_pool2d", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "dropout", "softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "mean", "mul", "matmul",
+    "concat", "split", "stack", "reshape", "squeeze", "unsqueeze", "flatten",
+    "transpose", "cast", "scale", "sums", "sum", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div", "elementwise_max",
+    "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "reduce_prod", "reduce_all", "reduce_any", "fill_constant",
+    "fill_constant_batch_size_like", "assign", "zeros", "ones", "zeros_like",
+    "ones_like", "uniform_random", "gaussian_random", "one_hot", "accuracy",
+    "auc", "relu", "sigmoid", "tanh", "gelu", "sqrt", "square", "exp", "log",
+    "abs", "pow", "clip", "clip_by_norm", "topk", "argmax", "argmin",
+    "argsort", "gather", "gather_nd", "scatter", "slice", "expand", "tile",
+    "lookup_table", "cos", "sin", "hard_swish", "relu6", "leaky_relu", "prelu",
+    "swish", "softplus", "softsign", "log_softmax", "sigmoid_cross_entropy_with_logits",
+    "smooth_l1", "huber_loss", "kldiv_loss", "mse_loss", "l2_normalize",
+    "label_smooth", "pad", "pad2d", "shape", "increment", "equal", "not_equal",
+    "less_than", "less_equal", "greater_than", "greater_equal", "logical_and",
+    "logical_or", "logical_not", "where", "arange", "linspace", "create_tensor",
+    "create_global_var", "unstack", "_binary_op", "sequence_mask", "cumsum",
+    "maxout", "lrn", "resize_bilinear", "resize_nearest", "roi_align", "nce",
+    "row_conv", "beam_search", "batch_norm_stats",
+]
+
+
+def _current_block():
+    return default_main_program().current_block()
+
+
+def _to_var(x, block=None, dtype=None):
+    """Coerce python scalars / numpy arrays to vars via fill_constant /
+    assign_value."""
+    if isinstance(x, VarDesc):
+        return x
+    block = block or _current_block()
+    if np.isscalar(x):
+        dtype = dtype or ("int64" if isinstance(x, (int, np.integer))
+                          else "float32")
+        return fill_constant([1], dtype, float(x))
+    arr = np.asarray(x)
+    out = block.create_var(shape=arr.shape, dtype=str(arr.dtype))
+    block.append_op("assign_value", outputs={"Out": out},
+                    attrs={"shape": list(arr.shape), "dtype": str(arr.dtype),
+                           "values": arr.ravel().tolist()})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# data / feed
+# ---------------------------------------------------------------------------
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=False):
+    """Declare an input var (fluid.data / fluid.layers.data). Dim -1 = batch,
+    bound at first Executor.run."""
+    block = default_main_program().global_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    v = block.create_var(name=name, shape=shape, dtype=dtype,
+                         is_data=True, stop_gradient=True)
+    v.lod_level = lod_level
+    return v
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / pool / norm
+# ---------------------------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid.layers.fc (layers/nn.py:168): flatten trailing dims, x @ W + b."""
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    outs = []
+    for x in inputs:
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        w = helper.create_parameter(param_attr, [in_features, size], x.dtype)
+        tmp = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("mul", inputs={"X": x, "Y": w},
+                         outputs={"Out": tmp},
+                         attrs={"x_num_col_dims": num_flatten_dims,
+                                "y_num_col_dims": 1})
+        outs.append(tmp)
+    if len(outs) > 1:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": outs}, outputs={"Out": pre_bias})
+    else:
+        pre_bias = outs[0]
+    b = helper.create_parameter(bias_attr, [size], inputs[0].dtype,
+                                is_bias=True)
+    if b is not None:
+        pre_act = helper.create_variable_for_type_inference(pre_bias.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": b},
+                         outputs={"Out": pre_act},
+                         attrs={"axis": len(pre_bias.shape) - 1})
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    """fluid.layers.embedding / fluid.embedding (nn.py:393). is_sparse is
+    accepted for API parity; on TPU gradients are dense segment-sums (XLA
+    scatter-add), SURVEY.md §7 'SelectedRows fallback'."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(param_attr, list(size), dtype,
+                                default_initializer=Xavier())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table_v2", inputs={"W": w, "Ids": input},
+        outputs={"Out": out},
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+    return out
+
+
+lookup_table = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    """fluid.layers.conv2d (nn.py:1405); lowers to
+    lax.conv_general_dilated (MXU)."""
+    helper = LayerHelper("conv2d", name=name)
+    c_in = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    ks = _pair(filter_size)
+    w_shape = [num_filters, c_in // groups] + ks
+    fan_in = (c_in // groups) * ks[0] * ks[1]
+    w = helper.create_parameter(
+        param_attr, w_shape, input.dtype,
+        default_initializer=Normal(0.0, float(np.sqrt(2.0 / fan_in))))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups,
+               "data_format": data_format})
+    b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
+                         outputs={"Out": pre_act},
+                         attrs={"axis": 1 if data_format == "NCHW" else 3})
+    else:
+        pre_act = out
+    return helper.append_activation(pre_act, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", name=name)
+    c_in = input.shape[1]
+    ks = _triple(filter_size)
+    w = helper.create_parameter(param_attr,
+                                [num_filters, c_in // groups] + ks,
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d", inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation), "groups": groups})
+    b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        pre = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
+                         outputs={"Out": pre}, attrs={"axis": 1})
+        out = pre
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    c_in = input.shape[1]
+    if filter_size is None:
+        # derive kernel size from the requested output size, as the
+        # reference does (layers/nn.py conv2d_transpose)
+        if output_size is None:
+            raise ValueError(
+                "conv2d_transpose: one of output_size / filter_size required")
+        osize = _pair(output_size)
+        strides_, pads_, dils_ = _pair(stride), _pair(padding), _pair(dilation)
+        ks = []
+        for i in range(2):
+            in_i = input.shape[2 + i]
+            k = ((osize[i] - (in_i - 1) * strides_[i] + 2 * pads_[i] - 1)
+                 // dils_[i] + 1)
+            ks.append(int(k))
+        filter_size = ks
+    ks = _pair(filter_size)
+    w = helper.create_parameter(param_attr, [c_in, num_filters // groups] + ks,
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": _pair(stride), "paddings": _pair(padding),
+                            "dilations": _pair(dilation), "groups": groups})
+    b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        pre = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
+                         outputs={"Out": pre}, attrs={"axis": 1})
+        out = pre
+    return helper.append_activation(out, act)
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v)] * 2
+
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [int(v)] * 3
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, name=None, data_format="NCHW"):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
+               "strides": _pair(pool_stride), "paddings": _pair(pool_padding),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive, "data_format": data_format})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": _triple(pool_size),
+               "strides": _triple(pool_stride),
+               "paddings": _triple(pool_padding),
+               "global_pooling": global_pooling})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool2d", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": _pair(pool_size), "adaptive": True})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               moving_mean_name=None, moving_variance_name=None,
+               use_global_stats=False, name=None):
+    """fluid.layers.batch_norm (nn.py:2320). Running stats are persistable
+    non-trainable params updated in-graph (MeanOut/VarianceOut rebind)."""
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [c], "float32",
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], "float32", is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False), [c], "float32",
+        default_initializer=Constant(0.0))
+    var = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False), [c], "float32",
+        default_initializer=Constant(1.0))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    y = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference("float32", True)
+    saved_var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+                "Variance": var},
+        outputs={"Y": y, "MeanOut": mean, "VarianceOut": var,
+                 "SavedMean": saved_mean, "SavedVariance": saved_var},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "data_layout": data_layout, "is_test": is_test,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(y, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, "float32",
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, "float32",
+                                    is_bias=True)
+        if b is not None:
+            inputs["Bias"] = b
+    y = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference("float32", True)
+    v = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": y, "Mean": m, "Variance": v},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(y, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    inputs = {"X": input}
+    s = helper.create_parameter(param_attr, [c], "float32",
+                                default_initializer=Constant(1.0))
+    inputs["Scale"] = s
+    b = helper.create_parameter(bias_attr, [c], "float32", is_bias=True)
+    if b is not None:
+        inputs["Bias"] = b
+    y = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference("float32", True)
+    v = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": y, "Mean": m, "Variance": v},
+                     attrs={"groups": groups, "epsilon": epsilon,
+                            "data_layout": data_layout})
+    return helper.append_activation(y, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    inputs = {"X": input}
+    s = helper.create_parameter(param_attr, [c], "float32",
+                                default_initializer=Constant(1.0))
+    inputs["Scale"] = s
+    b = helper.create_parameter(bias_attr, [c], "float32", is_bias=True)
+    if b is not None:
+        inputs["Bias"] = b
+    y = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference("float32", True)
+    sv = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("instance_norm", inputs=inputs,
+                     outputs={"Y": y, "SavedMean": sm, "SavedVariance": sv},
+                     attrs={"epsilon": epsilon})
+    return y
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference("uint8", True)
+    helper.append_op(
+        "dropout", inputs={"X": x}, outputs={"Out": out, "Mask": mask},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "seed": seed or 0, "fix_seed": seed is not None,
+               "dropout_implementation": dropout_implementation})
+    return out
+
+
+def softmax(input, axis=-1, name=None):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("softmax", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_softmax", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    helper = LayerHelper("cross_entropy", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cross_entropy",
+                     inputs={"X": input, "Label": label},
+                     outputs={"Y": out},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False,
+                               axis=-1, name=None):
+    helper = LayerHelper("softmax_with_cross_entropy", name=name)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     inputs={"Logits": logits, "Label": label},
+                     outputs={"Softmax": softmax_out, "Loss": loss},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index, "axis": axis})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     inputs={"X": x, "Label": label}, outputs={"Out": out},
+                     attrs={"ignore_index": ignore_index,
+                            "normalize": normalize})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0,
+              name=None):
+    helper = LayerHelper("smooth_l1_loss", name=name)
+    diff = helper.create_variable_for_type_inference(x.dtype, True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x, "Y": y}
+    if inside_weight is not None:
+        ins["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        ins["OutsideWeight"] = outside_weight
+    helper.append_op("smooth_l1_loss", inputs=ins,
+                     outputs={"Diff": diff, "Out": out},
+                     attrs={"sigma": sigma})
+    return out
+
+
+def huber_loss(input, label, delta, name=None):
+    helper = LayerHelper("huber_loss", name=name)
+    residual = helper.create_variable_for_type_inference(input.dtype, True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("huber_loss", inputs={"X": input, "Y": label},
+                     outputs={"Residual": residual, "Out": out},
+                     attrs={"delta": delta})
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kldiv_loss", inputs={"X": x, "Target": target},
+                     outputs={"Loss": out}, attrs={"reduction": reduction})
+    return out
+
+
+def mse_loss(input, label, name=None):
+    sq = square(elementwise_sub(input, label))
+    return reduce_mean(sq)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(label.dtype)
+    ins = {"X": label}
+    if prior_dist is not None:
+        ins["PriorDist"] = prior_dist
+    helper.append_op("label_smooth", inputs=ins, outputs={"Out": out},
+                     attrs={"epsilon": epsilon})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# math / elementwise / reduce
+# ---------------------------------------------------------------------------
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mul", inputs={"X": x, "Y": y}, outputs={"Out": out},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("matmul", inputs={"X": x, "Y": y}, outputs={"Out": out},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def _binary_op(op_type, x, y, reverse=False, axis=-1):
+    """Shared builder for VarDesc operator overloads and the elementwise_*
+    functions."""
+    block = _current_block()
+    if not isinstance(x, VarDesc):
+        x = _to_var(x, block, dtype=getattr(y, "dtype", None))
+    if not isinstance(y, VarDesc):
+        y = _to_var(y, block, dtype=x.dtype)
+    if reverse:
+        x, y = y, x
+    helper = LayerHelper(op_type)
+    cmp_ops = {"less_than", "less_equal", "greater_than", "greater_equal",
+               "equal", "not_equal"}
+    dtype = "bool" if op_type in cmp_ops else x.dtype
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def _make_elementwise(op_type):
+    def f(x, y, axis=-1, act=None, name=None):
+        out = _binary_op(op_type, x, y, axis=axis)
+        if act:
+            helper = LayerHelper(op_type)
+            out = helper.append_activation(out, act)
+        return out
+    f.__name__ = op_type
+    return f
+
+
+elementwise_add = _make_elementwise("elementwise_add")
+elementwise_sub = _make_elementwise("elementwise_sub")
+elementwise_mul = _make_elementwise("elementwise_mul")
+elementwise_div = _make_elementwise("elementwise_div")
+elementwise_max = _make_elementwise("elementwise_max")
+elementwise_min = _make_elementwise("elementwise_min")
+elementwise_pow = _make_elementwise("elementwise_pow")
+elementwise_mod = _make_elementwise("elementwise_mod")
+elementwise_floordiv = _make_elementwise("elementwise_floordiv")
+
+equal = _make_elementwise("equal")
+not_equal = _make_elementwise("not_equal")
+less_than = _make_elementwise("less_than")
+less_equal = _make_elementwise("less_equal")
+greater_than = _make_elementwise("greater_than")
+greater_equal = _make_elementwise("greater_equal")
+logical_and = _make_elementwise("logical_and")
+logical_or = _make_elementwise("logical_or")
+
+
+def logical_not(x, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("logical_not", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def _make_reduce(op_type):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        if dim is None:
+            attrs = {"reduce_all": True, "dim": [0], "keep_dim": keep_dim}
+        else:
+            d = dim if isinstance(dim, (list, tuple)) else [dim]
+            attrs = {"reduce_all": False, "dim": list(d), "keep_dim": keep_dim}
+        helper.append_op(op_type, inputs={"X": input}, outputs={"Out": out},
+                         attrs=attrs)
+        return out
+    f.__name__ = op_type
+    return f
+
+
+reduce_sum = _make_reduce("reduce_sum")
+reduce_mean = _make_reduce("reduce_mean")
+reduce_max = _make_reduce("reduce_max")
+reduce_min = _make_reduce("reduce_min")
+reduce_prod = _make_reduce("reduce_prod")
+reduce_all = _make_reduce("reduce_all")
+reduce_any = _make_reduce("reduce_any")
+
+
+def _make_unary(op_type, out_dtype=None):
+    def f(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(out_dtype or x.dtype)
+        helper.append_op(op_type, inputs={"X": x}, outputs={"Out": out})
+        return out
+    f.__name__ = op_type
+    return f
+
+
+relu = _make_unary("relu")
+sigmoid = _make_unary("sigmoid")
+tanh = _make_unary("tanh")
+sqrt = _make_unary("sqrt")
+square = _make_unary("square")
+exp = _make_unary("exp")
+log = _make_unary("log")
+abs = _make_unary("abs")
+cos = _make_unary("cos")
+sin = _make_unary("sin")
+relu6 = _make_unary("relu6")
+softplus = _make_unary("softplus")
+softsign = _make_unary("softsign")
+swish = _make_unary("swish")
+hard_swish = _make_unary("hard_swish")
+
+
+def gelu(x, approximate=False, name=None):
+    helper = LayerHelper("gelu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("gelu", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"approximate": approximate})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("leaky_relu", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1]
+    else:
+        alpha_shape = [1] + list(x.shape[1:])
+    alpha = helper.create_parameter(param_attr, alpha_shape, x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": x, "Alpha": alpha},
+                     outputs={"Out": out}, attrs={"mode": mode})
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"factor": factor})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"scale": scale, "bias": bias,
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out, act)
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"max_norm": max_norm})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("norm", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("norm", inputs={"X": x},
+                     outputs={"Out": out, "Norm": norm},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def cumsum(x, axis=None, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cumsum", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": -1 if axis is None else axis,
+                            "flatten": axis is None})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": input}, outputs={"Out": out})
+    return out
+
+
+def sum(x, dim=None, keep_dim=False, name=None):
+    if isinstance(x, (list, tuple)):
+        return sums(x)
+    return reduce_sum(x, dim, keep_dim, name)
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", inputs={"X": list(input)},
+                     outputs={"Out": out}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        sections = []
+    else:
+        n = len(num_or_sections)
+        sections = list(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", inputs={"X": input}, outputs={"Out": outs},
+                     attrs={"num": 0 if sections else n,
+                            "sections": sections, "axis": dim})
+    return outs
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack", name=name)
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": list(x)}, outputs={"Y": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack", name=name)
+    if num is None:
+        num = x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op("unstack", inputs={"X": x}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": num})
+    return outs
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("reshape2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"shape": list(shape)})
+    return helper.append_activation(out, act)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("squeeze2", inputs={"X": input},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("unsqueeze2", inputs={"X": input},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("flatten2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axis": axis})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("transpose2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    helper = LayerHelper("cast")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"in_dtype": x.dtype, "out_dtype": dtype})
+    return out
+
+
+def gather(input, index, axis=None, name=None):
+    helper = LayerHelper("gather", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", inputs={"X": input, "Index": index},
+                     outputs={"Out": out},
+                     attrs={"axis": 0 if axis is None else axis})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", inputs={"X": input, "Index": index},
+                     outputs={"Out": out})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     inputs={"X": input, "Ids": index, "Updates": updates},
+                     outputs={"Out": out}, attrs={"overwrite": overwrite})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def tile(x, repeat_times, name=None):
+    helper = LayerHelper("tile", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tile", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"repeat_times": list(repeat_times)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": pad_value})
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad2d", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": pad_value,
+                            "data_format": data_format})
+    return out
+
+
+def where(condition, x=None, y=None, name=None):
+    helper = LayerHelper("where", name=name)
+    if x is None and y is None:
+        out = helper.create_variable_for_type_inference("int64", True)
+        helper.append_op("where_index", inputs={"Condition": condition},
+                         outputs={"Out": out})
+        return out
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where",
+                     inputs={"Condition": condition, "X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def one_hot(input, depth, allow_out_of_range=False, name=None):
+    helper = LayerHelper("one_hot_v2", name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot_v2", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"depth": depth,
+                            "allow_out_of_range": allow_out_of_range})
+    return out
+
+
+def shape(input, name=None):
+    helper = LayerHelper("shape", name=name)
+    out = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("shape", inputs={"Input": input}, outputs={"Out": out})
+    return out
+
+
+def increment(x, value=1.0, in_place=True, name=None):
+    helper = LayerHelper("increment", name=name)
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"step": value})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# creation ops
+# ---------------------------------------------------------------------------
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    helper = LayerHelper("fill_constant", name=name)
+    from ..core.dtype import convert_dtype
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("fill_constant", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    helper = LayerHelper("fill_constant_batch_size_like", name=name)
+    from ..core.dtype import convert_dtype
+    out = helper.create_variable_for_type_inference(convert_dtype(dtype), True)
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": list(shape),
+                            "dtype": convert_dtype(dtype),
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, VarDesc):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("assign", inputs={"X": input},
+                         outputs={"Out": output})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(str(arr.dtype))
+        helper.append_op("assign_value", outputs={"Out": output},
+                         attrs={"shape": list(arr.shape),
+                                "dtype": str(arr.dtype),
+                                "values": arr.ravel().tolist()})
+    return output
+
+
+def zeros(shape, dtype="float32", force_cpu=False, name=None):
+    return fill_constant(shape, dtype, 0.0, name=name)
+
+
+def ones(shape, dtype="float32", force_cpu=False, name=None):
+    return fill_constant(shape, dtype, 1.0, name=name)
+
+
+def zeros_like(x, out=None, name=None):
+    helper = LayerHelper("fill_zeros_like", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def ones_like(x, out=None, name=None):
+    helper = LayerHelper("fill_any_like", name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_any_like", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"value": 1.0, "dtype": x.dtype})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    helper = LayerHelper("uniform_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("uniform_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "min": min, "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    helper = LayerHelper("gaussian_random", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("gaussian_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype,
+                            "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def arange(start, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    helper = LayerHelper("range", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("range", outputs={"Out": out},
+                     attrs={"start": start, "end": end, "step": step,
+                            "dtype": dtype})
+    return out
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    helper = LayerHelper("linspace", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("linspace", outputs={"Out": out},
+                     attrs={"start": start, "stop": stop, "num": num,
+                            "dtype": dtype})
+    return out
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    block = _current_block()
+    return block.create_var(name=name or unique_name("create_tensor"),
+                            dtype=dtype, persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    v = helper.create_global_variable(shape, dtype, persistable=persistable,
+                                      name=name)
+    from .initializer import Constant as _C
+    _C(value)(v, helper.startup_program.global_block())
+    return v
+
+
+# ---------------------------------------------------------------------------
+# search / sort / metrics
+# ---------------------------------------------------------------------------
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("top_k", inputs={"X": input},
+                     outputs={"Out": values, "Indices": indices},
+                     attrs={"k": k})
+    return values, indices
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max", name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("arg_max", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min", name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("arg_min", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("argsort", inputs={"X": input},
+                     outputs={"Out": out, "Indices": ids},
+                     attrs={"axis": axis, "descending": descending})
+    return out, ids
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """layers/metric_op.py accuracy: top-k accuracy of predictions."""
+    helper = LayerHelper("accuracy")
+    topk_out, topk_ids = topk(input, k)
+    acc = helper.create_variable_for_type_inference("float32", True)
+    correct = correct or helper.create_variable_for_type_inference(
+        "int32", True)
+    total = total or helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("accuracy",
+                     inputs={"Out": topk_out, "Indices": topk_ids,
+                             "Label": label},
+                     outputs={"Accuracy": acc, "Correct": correct,
+                              "Total": total})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    helper = LayerHelper("auc")
+    auc_out = helper.create_variable_for_type_inference("float64", True)
+    stat_pos = helper.create_global_variable(
+        [1, num_thresholds + 1], "int64", persistable=True)
+    stat_neg = helper.create_global_variable(
+        [1, num_thresholds + 1], "int64", persistable=True)
+    from .initializer import Constant as _C
+    _C(0.0)(stat_pos, helper.startup_program.global_block())
+    _C(0.0)(stat_neg, helper.startup_program.global_block())
+    pos_out = helper.create_variable_for_type_inference("int64", True)
+    neg_out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("auc",
+                     inputs={"Predict": input, "Label": label,
+                             "StatPos": stat_pos, "StatNeg": stat_neg},
+                     outputs={"AUC": auc_out, "StatPosOut": stat_pos,
+                              "StatNegOut": stat_neg},
+                     attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg], [pos_out, neg_out]
+
+
+# ---------------------------------------------------------------------------
+# misc layers used by models
+# ---------------------------------------------------------------------------
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", inputs={"X": x}, outputs={"Y": out},
+                     attrs={"maxlen": -1 if maxlen is None else maxlen,
+                            "out_dtype": dtype})
+    return out
+
+
+def maxout(x, groups, name=None, axis=1):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("maxout", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"groups": groups, "axis": axis})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("lrn", inputs={"X": input},
+                     outputs={"Out": out, "MidOut": mid},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, align_corners=True,
+                    align_mode=1, data_format="NCHW", name=None):
+    helper = LayerHelper("bilinear_interp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"interp_method": "bilinear", "align_corners": align_corners,
+             "align_mode": align_mode, "data_layout": data_format}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("bilinear_interp", inputs={"X": input},
+                     outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, align_corners=True,
+                   data_format="NCHW", name=None):
+    helper = LayerHelper("nearest_interp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    attrs = {"interp_method": "nearest", "align_corners": align_corners,
+             "data_layout": data_format}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("nearest_interp", inputs={"X": input},
+                     outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("roi_align", inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale,
+                            "sampling_ratio": sampling_ratio})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv")
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out})
+    return helper.append_activation(out, act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, **kw):
+    # negative sampling loss reduces to sampled softmax on TPU; provide the
+    # API, implement via sampled dense matmul
+    raise NotImplementedError("nce: use sampled_softmax on TPU")
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    helper = LayerHelper("beam_search", name=name)
+    selected_ids = helper.create_variable_for_type_inference("int64", True)
+    selected_scores = helper.create_variable_for_type_inference(
+        scores.dtype, True)
+    parent_idx = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        "beam_search",
+        inputs={"pre_ids": pre_ids, "pre_scores": pre_scores,
+                "ids": ids, "scores": scores},
+        outputs={"selected_ids": selected_ids,
+                 "selected_scores": selected_scores,
+                 "parent_idx": parent_idx},
+        attrs={"beam_size": beam_size, "end_id": end_id, "level": level,
+               "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def batch_norm_stats(*a, **kw):
+    raise NotImplementedError

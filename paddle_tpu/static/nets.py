@@ -1,0 +1,109 @@
+"""Composite nets (analog of /root/reference/python/paddle/fluid/nets.py:
+simple_img_conv_pool :28, img_conv_group, sequence_conv_pool, glu,
+scaled_dot_product_attention)."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    conv_out = layers.conv2d(input, num_filters, filter_size,
+                             stride=conv_stride, padding=conv_padding,
+                             dilation=conv_dilation, groups=conv_groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return layers.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    tmp = input
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+    n = len(conv_num_filter)
+
+    def _expand(v):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    padding = _expand(conv_padding)
+    fsize = _expand(conv_filter_size)
+    with_bn = _expand(conv_with_batchnorm)
+    drop = _expand(conv_batchnorm_drop_rate)
+    pattr = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * n
+    for i in range(n):
+        local_act = conv_act if not with_bn[i] else None
+        tmp = layers.conv2d(tmp, conv_num_filter[i], fsize[i],
+                            padding=padding[i], param_attr=pattr[i],
+                            act=local_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(tmp, act=conv_act)
+            if drop[i] > 0:
+                tmp = layers.dropout(tmp, dropout_prob=drop[i])
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    from .layer_helper import LayerHelper
+    helper = LayerHelper("sequence_conv_pool")
+    w = helper.create_parameter(
+        param_attr, [filter_size * input.shape[-1], num_filters],
+        input.dtype)
+    conv_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_conv",
+                     inputs={"X": input, "Filter": w},
+                     outputs={"Out": conv_out},
+                     attrs={"contextLength": filter_size, "contextStart":
+                            -(filter_size // 2), "contextStride": 1})
+    conv_out = helper.append_activation(conv_out, act)
+    pool_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_pool", inputs={"X": conv_out},
+                     outputs={"Out": pool_out},
+                     attrs={"pooltype": pool_type.upper()})
+    return pool_out
+
+
+def glu(input, dim=-1):
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head attention built from primitive ops (nets.py:503).  The
+    flash/ring Pallas kernel lives in paddle_tpu.ops.pallas; this is the
+    graph-API form."""
+    d_key = queries.shape[-1] // num_heads
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        t, c = x.shape[1], x.shape[2]
+        x = layers.reshape(x, [-1, t, num_heads, c // num_heads])
+        return layers.transpose(x, [0, 2, 1, 3])  # [b, h, t, d]
+
+    q, k, v = _split_heads(queries), _split_heads(keys), _split_heads(values)
+    scaled = layers.scale(q, scale=d_key ** -0.5)
+    logits = layers.matmul(scaled, k, transpose_y=True)
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    if num_heads == 1:
+        return ctx
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])  # [b, t, h, d]
+    t, h, d = ctx.shape[1], ctx.shape[2], ctx.shape[3]
+    return layers.reshape(ctx, [-1, t, h * d])

@@ -1,0 +1,43 @@
+"""ParamAttr — per-parameter configuration (name/initializer/lr/regularizer/
+trainable), analog of /root/reference/python/paddle/fluid/param_attr.py."""
+from __future__ import annotations
+
+from .initializer import Initializer, Xavier, Constant
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        """Accept None / str (name) / Initializer / ParamAttr / False
+        (fluid param_attr.py:196 _to_attr semantics; False means no param,
+        used for bias_attr=False)."""
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if arg is False:
+            return False
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if isinstance(arg, Initializer):
+            return ParamAttr(initializer=arg)
+        raise TypeError(f"cannot convert {arg!r} to ParamAttr")
+
+
+class WeightNormParamAttr(ParamAttr):
+    def __init__(self, dim=None, **kw):
+        super().__init__(**kw)
+        self.dim = dim

@@ -1,0 +1,223 @@
+"""Recompute (activation checkpointing) as a backward-pass graph rewrite.
+
+Analog of /root/reference/python/paddle/fluid/backward.py:689
+`_append_backward_ops_with_checkpoints_`: forward ops are divided into
+segments at user-chosen checkpoint vars; during backward, each segment's
+forward ops are REPLAYED from the stored checkpoint before its grad ops run,
+so only checkpoints (not every activation) stay live through the backward
+sweep.
+
+TPU-specific twist: under whole-block XLA compilation a naive replay would be
+CSE'd with the original forward (XLA sees two identical pure subgraphs and
+reuses the first's results — keeping the activations alive and defeating the
+memory saving).  Segment inputs are therefore routed through an
+`optimization_barrier` op, which XLA cannot look through; the replayed
+segment is then genuinely rematerialized, matching jax.checkpoint semantics
+but driven from the program IR so AMP / pipeline / fleet rewrites compose
+with it the way they do in the reference.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..core.program import Block, OpDesc, OpRole, unique_name
+from ..ops.registry import get_op_info
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def _segment_ids(fwd_ops: List[OpDesc], checkpoints: Set[str]):
+    """Assign each forward op a segment id; segment boundary AFTER an op that
+    produces a checkpoint var.  Ops after the last checkpoint form the final
+    'fresh' segment which is never replayed (its activations are still hot
+    when backward starts)."""
+    seg = []
+    cur = 0
+    for op in fwd_ops:
+        seg.append(cur)
+        if any(n in checkpoints for n in op.output_names()):
+            cur += 1
+    return seg, cur  # cur == id of the fresh (non-replayed) segment
+
+
+def append_backward_with_checkpoints(block: Block, loss, parameter_list,
+                                     no_grad: Set[str], checkpoints):
+    from .backward import _find_loss_op_idx, _requires_grad_vars, \
+        grad_var_name
+    program = block.program
+    ckpt_names = {c.name if hasattr(c, "name") else str(c)
+                  for c in checkpoints}
+    loss_idx = _find_loss_op_idx(block, loss.name)
+    fwd_ops = block.ops[: loss_idx + 1]
+    seg_of, fresh_seg = _segment_ids(fwd_ops, ckpt_names)
+    req = _requires_grad_vars(block, fwd_ops) - set(no_grad)
+
+    # names safe to read without replay: checkpoints, persistables (params),
+    # data inputs — everything else produced inside a replayed segment gets
+    # a per-segment @RC alias
+    def _stored(name: str) -> bool:
+        if name in ckpt_names:
+            return True
+        try:
+            v = block.var(name)
+        except KeyError:
+            return False
+        return v.persistable or v.is_data
+
+    with program._op_role_guard(OpRole.Backward):
+        g_loss = block.create_var(
+            name=grad_var_name(loss.name), shape=loss.shape,
+            dtype=loss.dtype, stop_gradient=True)
+        block.append_op(
+            "fill_constant", outputs={"Out": g_loss},
+            attrs={"shape": (list(loss.shape) if loss.shape is not None
+                             else [1]),
+                   "dtype": loss.dtype, "value": 1.0})
+
+        pending: Dict[str, List[str]] = {loss.name: [g_loss.name]}
+        grad_map: Dict[str, str] = {}
+
+        def _settle(name):
+            pieces = pending.get(name)
+            if not pieces:
+                return None
+            if len(pieces) == 1:
+                grad_map[name] = pieces[0]
+                return pieces[0]
+            out = unique_name(grad_var_name(name) + "@SUM")
+            block.create_var(name=out, stop_gradient=True)
+            block.append_op("sum", inputs={"X": list(pieces)},
+                            outputs={"Out": out})
+            pending[name] = [out]
+            grad_map[name] = out
+            return out
+
+        # replay maps: segment id -> {orig name -> replayed name}
+        replay_maps: Dict[int, Dict[str, str]] = {}
+
+        def _emit_replay(seg_id: int):
+            """Re-emit segment seg_id's forward ops with @RC-renamed outputs,
+            inputs routed through an optimization_barrier."""
+            if seg_id in replay_maps or seg_id == fresh_seg:
+                return
+            ops_in_seg = [op for op, s in zip(fwd_ops, seg_of) if s == seg_id]
+            produced = {n for op in ops_in_seg for n in op.output_names()}
+            ext_inputs = sorted({
+                n for op in ops_in_seg for n in op.input_names()
+                if n not in produced})
+            rmap: Dict[str, str] = {}
+            barrier_ins = [n for n in ext_inputs if not _is_barrier_free(n)]
+            if barrier_ins:
+                bar_outs = []
+                for n in barrier_ins:
+                    alias = unique_name(n + "@RCB")
+                    block.create_var(name=alias, stop_gradient=True)
+                    rmap[n] = alias
+                    bar_outs.append(alias)
+                block.append_op("optimization_barrier",
+                                inputs={"X": barrier_ins},
+                                outputs={"Out": bar_outs})
+            for op in ops_in_seg:
+                new_ins = {k: [rmap.get(n, n) for n in v]
+                           for k, v in op.inputs.items()}
+                new_outs = {}
+                for k, v in op.outputs.items():
+                    outs = []
+                    for n in v:
+                        alias = unique_name(n + "@RC")
+                        block.create_var(name=alias, stop_gradient=True)
+                        rmap[n] = alias
+                        outs.append(alias)
+                    new_outs[k] = outs
+                rop = block.append_op(op.type, new_ins, new_outs,
+                                      attrs=dict(op.attrs))
+                rop.attrs["op_uid"] = op.attrs.get("op_uid", 0)  # same RNG
+            replay_maps[seg_id] = rmap
+
+        def _is_barrier_free(name: str) -> bool:
+            # params/data feed both passes identically; barrier only needed
+            # on vars whose live range we want to cut (checkpoints and any
+            # stored intermediate)
+            try:
+                v = block.var(name)
+            except KeyError:
+                return False
+            return v.persistable or v.is_data
+
+        for i in range(len(fwd_ops) - 1, -1, -1):
+            op = fwd_ops[i]
+            info = get_op_info(op.type)
+            if info is None or not info.has_grad:
+                continue
+            out_has_grad = any(n in pending for n in op.output_names())
+            in_requires = any(
+                n in req
+                for slot in info.inputs if not slot.no_grad
+                for n in op.inputs.get(slot.name, []))
+            if not (out_has_grad and in_requires):
+                continue
+
+            seg_id = seg_of[i]
+            _emit_replay(seg_id)
+            rmap = replay_maps.get(seg_id, {})
+
+            g_inputs: Dict[str, List[str]] = {}
+            for slot in info.inputs:
+                names = op.inputs.get(slot.name, [])
+                if names:
+                    g_inputs[slot.name] = [rmap.get(n, n) for n in names]
+            for slot in info.outputs:
+                names = op.outputs.get(slot.name, [])
+                if names:
+                    g_inputs[slot.name] = [rmap.get(n, n) for n in names]
+                    gnames = []
+                    for n in names:
+                        g = _settle(n)
+                        gnames.append(g if g is not None else "")
+                    if any(gnames):
+                        g_inputs[slot.name + GRAD_SUFFIX] = gnames
+
+            g_outputs: Dict[str, List[str]] = {}
+            for slot in info.inputs:
+                if slot.no_grad:
+                    continue
+                names = op.inputs.get(slot.name, [])
+                outs = []
+                for n in names:
+                    if n not in req or n in no_grad:
+                        outs.append("")
+                        continue
+                    piece = unique_name(grad_var_name(n))
+                    block.create_var(name=piece, stop_gradient=True)
+                    pending.setdefault(n, []).append(piece)
+                    outs.append(piece)
+                if any(outs):
+                    g_outputs[slot.name + GRAD_SUFFIX] = outs
+            if not g_outputs:
+                continue
+            gop = block.append_op(info.grad_op_type(), g_inputs, g_outputs,
+                                  attrs=dict(op.attrs))
+            gop.attrs[OpRole.KEY] = OpRole.Backward
+            gop.attrs["fwd_uid"] = op.attrs.get("op_uid", 0)
+
+        for name in list(pending):
+            _settle(name)
+
+    program._grad_map.update(grad_map)
+
+    from ..core.program import VarDesc
+    if parameter_list is not None:
+        params = [p if isinstance(p, VarDesc) else
+                  program.global_block().var(p) for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    result = []
+    for p in params:
+        g = grad_map.get(p.name)
+        if g is None:
+            continue
+        gv = block.var(g)
+        gv.shape = p.shape
+        gv.dtype = gv.dtype or p.dtype
+        result.append((p, gv))
+    return result

@@ -1,0 +1,23 @@
+"""Test config: run everything on a virtual 8-device CPU mesh so multi-chip
+sharding paths compile and execute without TPU hardware (the driver separately
+dry-runs the multichip path; bench.py uses the real chip).
+
+NOTE: the container's sitecustomize registers the `axon` TPU-tunnel PJRT
+plugin and imports jax at interpreter startup with JAX_PLATFORMS=axon, so env
+vars are too late here — use jax.config.update, which takes effect because
+backend *initialization* is still lazy at conftest time.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

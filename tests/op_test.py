@@ -1,0 +1,163 @@
+"""OpTest harness — analog of the reference's workhorse single-op test base
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:183):
+a test declares op_type/inputs/attrs (+ optionally expected outputs); the
+harness runs the registered kernel and checks outputs against the declared
+numpy reference, and checks the registered grad op against float64 central
+finite differences."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import get_op_info, run_kernel, OpContext
+
+
+class OpTest:
+    op_type: str = None
+    atol = 1e-5
+    rtol = 1e-5
+
+    def setup(self):
+        self.inputs = {}
+        self.outputs = {}
+        self.attrs = {}
+
+    # -- helpers ------------------------------------------------------------
+    def _ctx(self):
+        return OpContext(seed=2024)
+
+    def _to_device(self, v):
+        if isinstance(v, (list, tuple)):
+            return [jnp.asarray(x) for x in v]
+        return jnp.asarray(v)
+
+    def _run_forward(self, inputs=None, attrs=None):
+        inputs = self.inputs if inputs is None else inputs
+        attrs = self.attrs if attrs is None else attrs
+        dev_ins = {k: self._to_device(v) for k, v in inputs.items()}
+        return run_kernel(self.op_type, dev_ins, dict(attrs), self._ctx())
+
+    # -- checks -------------------------------------------------------------
+    def check_output(self, atol=None, rtol=None, no_check_set=()):
+        atol = self.atol if atol is None else atol
+        rtol = self.rtol if rtol is None else rtol
+        outs = self._run_forward()
+        for name, expected in self.outputs.items():
+            if name in no_check_set or expected is None:
+                continue
+            got = outs[name]
+            if isinstance(expected, (list, tuple)):
+                for e, g in zip(expected, got):
+                    np.testing.assert_allclose(
+                        np.asarray(g, np.float64) if np.asarray(g).dtype.kind
+                        == "f" else np.asarray(g),
+                        np.asarray(e, np.float64) if np.asarray(e).dtype.kind
+                        == "f" else np.asarray(e),
+                        atol=atol, rtol=rtol, err_msg=f"output {name}")
+            else:
+                g = np.asarray(got)
+                e = np.asarray(expected)
+                if g.dtype.kind == "f":
+                    g = g.astype(np.float64)
+                    e = e.astype(np.float64)
+                np.testing.assert_allclose(g, e, atol=atol, rtol=rtol,
+                                           err_msg=f"output {name}")
+        return outs
+
+    def check_grad(self, inputs_to_check, output_names, delta=1e-3,
+                   max_relative_error=5e-3, user_defined_grads=None):
+        """Compare the registered grad kernel against float64 central
+        differences (the reference enforces fp64 grad checks too,
+        op_test.py:232-248)."""
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        info = get_op_info(self.op_type)
+        assert info is not None and info.has_grad, \
+            f"{self.op_type} has no grad op"
+        f64_ins = {}
+        for k, v in self.inputs.items():
+            if isinstance(v, (list, tuple)):
+                f64_ins[k] = [np.asarray(x).astype(np.float64)
+                              if np.asarray(x).dtype.kind == "f"
+                              else np.asarray(x) for x in v]
+            else:
+                a = np.asarray(v)
+                f64_ins[k] = a.astype(np.float64) if a.dtype.kind == "f" else a
+        ctx = self._ctx()
+
+        def run_fwd(ins_np):
+            dev = {k: ([jnp.asarray(x) for x in v]
+                       if isinstance(v, list) else jnp.asarray(v))
+                   for k, v in ins_np.items()}
+            outs = run_kernel(self.op_type, dev, dict(self.attrs), ctx)
+            return outs
+
+        # scalar objective: sum of requested outputs (cotangent of ones),
+        # jitted once so the finite-difference loop is cheap
+        @jax.jit
+        def _objective_dev(dev_ins):
+            outs = run_kernel(self.op_type, dev_ins, dict(self.attrs), ctx)
+            total = jnp.zeros((), jnp.float64)
+            for name in output_names:
+                o = outs[name]
+                os_ = o if isinstance(o, list) else [o]
+                for x in os_:
+                    total = total + jnp.sum(x.astype(jnp.float64))
+            return total
+
+        def objective(ins_np):
+            dev = {k: ([jnp.asarray(x) for x in v]
+                       if isinstance(v, list) else jnp.asarray(v))
+                   for k, v in ins_np.items()}
+            return float(_objective_dev(dev))
+
+        # analytic grads from the registered grad kernel
+        fwd_outs = run_fwd(f64_ins)
+        grad_ins = {k: ([jnp.asarray(x) for x in v] if isinstance(v, list)
+                        else jnp.asarray(v)) for k, v in f64_ins.items()}
+        for slot in info.outputs:
+            if slot.name in fwd_outs:
+                o = fwd_outs[slot.name]
+                grad_ins[slot.name] = o
+                if slot.name in output_names:
+                    grad_ins[slot.name + "@GRAD"] = (
+                        [jnp.ones_like(x) for x in o]
+                        if isinstance(o, list) else jnp.ones_like(o))
+                else:
+                    grad_ins[slot.name + "@GRAD"] = (
+                        [jnp.zeros_like(x) for x in o]
+                        if isinstance(o, list) else jnp.zeros_like(o))
+        analytic = run_kernel(info.grad_op_type(), grad_ins,
+                              dict(self.attrs), ctx)
+
+        for i, name in enumerate(inputs_to_check):
+            a_grad = analytic.get(name + "@GRAD")
+            assert a_grad is not None, f"no grad produced for {name}"
+            a_grad = np.asarray(a_grad, np.float64)
+            if user_defined_grads is not None:
+                n_grad = np.asarray(user_defined_grads[i], np.float64)
+            else:
+                base = np.asarray(f64_ins[name], np.float64)
+                n_grad = np.zeros_like(base).ravel()
+                flat = base.ravel()
+                for j in range(flat.size):
+                    orig = flat[j]
+                    flat[j] = orig + delta
+                    ins_p = dict(f64_ins)
+                    ins_p[name] = flat.reshape(base.shape).copy()
+                    up = objective(ins_p)
+                    flat[j] = orig - delta
+                    ins_m = dict(f64_ins)
+                    ins_m[name] = flat.reshape(base.shape).copy()
+                    down = objective(ins_m)
+                    flat[j] = orig
+                    n_grad[j] = (up - down) / (2 * delta)
+                n_grad = n_grad.reshape(base.shape)
+            denom = np.maximum(np.maximum(np.abs(a_grad), np.abs(n_grad)),
+                               1e-3)
+            rel = np.max(np.abs(a_grad - n_grad) / denom)
+            assert rel <= max_relative_error, (
+                f"grad check failed for {self.op_type}.{name}: "
+                f"max rel err {rel:.2e} > {max_relative_error:.2e}\n"
+                f"analytic={a_grad.ravel()[:8]}\nnumeric={n_grad.ravel()[:8]}")

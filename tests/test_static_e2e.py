@@ -1,0 +1,280 @@
+"""End-to-end static-graph tests — analog of the reference's book tests
+(/root/reference/python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_recognize_digits.py): build, train a few iters, assert loss decreases;
+plus executor-equivalence between single-device and data-parallel runs
+(parallel_executor_test_base.py pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _fresh_programs():
+    main, startup = static.Program(), static.Program()
+    return main, startup
+
+
+def test_fit_a_line():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 13])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.01).minimize(loss)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        w_true = rng.rand(13, 1).astype(np.float32)
+        losses = []
+        for i in range(30):
+            xb = rng.rand(16, 13).astype(np.float32)
+            yb = xb @ w_true + 0.1
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_recognize_digits_mlp():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        img = layers.data("img", [-1, 784])
+        label = layers.data("label", [-1, 1], dtype="int64")
+        h = layers.fc(img, size=64, act="relu")
+        logits = layers.fc(h, size=10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        static.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(1)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(25):
+            xb = rng.rand(32, 784).astype(np.float32) * 0.1
+            yb = rng.randint(0, 10, (32, 1)).astype(np.int64)
+            # make labels learnable: class = argmax of first 10 pixels
+            yb = np.argmax(xb[:, :10], axis=1).astype(np.int64)[:, None]
+            lv, av = exe.run(main, feed={"img": xb, "label": yb},
+                             fetch_list=[loss, acc])
+            losses.append(float(lv))
+    assert losses[-1] < losses[0], losses
+
+
+def test_lenet_conv():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        img = layers.data("img", [-1, 1, 28, 28])
+        label = layers.data("label", [-1, 1], dtype="int64")
+        import paddle_tpu.static.nets as nets
+        c1 = nets.simple_img_conv_pool(img, num_filters=6, filter_size=5,
+                                       pool_size=2, pool_stride=2,
+                                       act="relu")
+        c2 = nets.simple_img_conv_pool(c1, num_filters=16, filter_size=5,
+                                       pool_size=2, pool_stride=2,
+                                       act="relu")
+        logits = layers.fc(c2, size=10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        static.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(2)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for i in range(8):
+            xb = rng.rand(8, 1, 28, 28).astype(np.float32)
+            yb = (xb[:, 0, 0, :10].argmax(1).astype(np.int64))[:, None]
+            (lv,) = exe.run(main, feed={"img": xb, "label": yb},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_batch_norm_dropout_train_eval():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8, 4, 4])
+        h = layers.batch_norm(x)
+        h = layers.dropout(h, dropout_prob=0.5)
+        out = layers.reduce_mean(h)
+    test_prog = main.clone(for_test=True)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        xb = np.random.RandomState(3).rand(4, 8, 4, 4).astype(np.float32)
+        (train_out,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        (eval1,) = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+        (eval2,) = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+        # eval is deterministic (no dropout sampling)
+        np.testing.assert_allclose(eval1, eval2, rtol=1e-6)
+
+
+def test_gradients_api():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [4, 4])
+        x.stop_gradient = False
+        y = layers.reduce_sum(layers.square(x))
+        (gx,) = static.gradients([y], [x])
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        xb = np.arange(16, dtype=np.float32).reshape(4, 4)
+        (g,) = exe.run(main, feed={"x": xb}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xb, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        opt = static.SGD(learning_rate=0.1,
+                         grad_clip=static.GradientClipByGlobalNorm(0.1))
+        opt.minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            xb = rng.rand(8, 4).astype(np.float32) * 100
+            yb = rng.rand(8, 1).astype(np.float32) * 100
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            assert np.isfinite(lv)
+
+
+def test_data_parallel_equivalence():
+    """Single-device vs 8-way data-parallel must match (the reference's
+    ParallelExecutor-vs-Executor equivalence test,
+    parallel_executor_test_base.py)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (virtual CPU mesh)")
+
+    def build():
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 8])
+            y = layers.data("y", [-1, 1])
+            pred = layers.fc(x, size=1,
+                             param_attr=static.ParamAttr(
+                                 initializer=static.Constant(0.5)),
+                             bias_attr=static.ParamAttr(
+                                 initializer=static.Constant(0.0)))
+            loss = layers.mean(
+                layers.square(layers.elementwise_sub(pred, y)))
+            static.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(7)
+    batches = [(rng.rand(16, 8).astype(np.float32),
+                rng.rand(16, 1).astype(np.float32)) for _ in range(5)]
+
+    # single-device run
+    main, startup, loss = build()
+    exe = static.Executor()
+    s1 = static.Scope()
+    with static.scope_guard(s1):
+        exe.run(startup)
+        single = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0])
+                  for xb, yb in batches]
+
+    # data-parallel run
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    main2, startup2, loss2 = build()
+    exe2 = static.Executor()
+    s2 = static.Scope()
+    with static.scope_guard(s2):
+        exe2.run(startup2)
+        cp = CompiledProgram(main2).with_data_parallel(loss_name=loss2.name)
+        par = [float(exe2.run(cp, feed={"x": xb, "y": yb},
+                              fetch_list=[loss2])[0])
+               for xb, yb in batches]
+
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_checkpoints():
+    """Recompute backward (graph replay + optimization barriers) must give
+    the same gradients/training trajectory as plain backward (reference
+    backward.py:689 semantics)."""
+    def build(use_recompute):
+        main, startup = _fresh_programs()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 16])
+            y = layers.data("y", [-1, 1])
+            h1 = layers.fc(x, 32, act="relu",
+                           param_attr=static.ParamAttr(
+                               initializer=static.Constant(0.1)))
+            h2 = layers.fc(h1, 32, act="relu",
+                           param_attr=static.ParamAttr(
+                               initializer=static.Constant(0.1)))
+            pred = layers.fc(h2, 1,
+                             param_attr=static.ParamAttr(
+                                 initializer=static.Constant(0.1)))
+            loss = layers.mean(layers.square(pred - y))
+            inner = static.SGD(0.1)
+            if use_recompute:
+                from paddle_tpu.static.optimizer import RecomputeOptimizer
+                opt = RecomputeOptimizer(inner)
+                opt._set_checkpoints([h1, h2])
+                opt.minimize(loss)
+            else:
+                inner.minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(11)
+    batches = [(rng.rand(8, 16).astype(np.float32),
+                rng.rand(8, 1).astype(np.float32)) for _ in range(4)]
+    results = []
+    for flag in (False, True):
+        main, startup, loss = build(flag)
+        exe = static.Executor()
+        with static.scope_guard(static.Scope()):
+            exe.run(startup)
+            results.append([
+                float(exe.run(main, feed={"x": xb, "y": yb},
+                              fetch_list=[loss])[0]) for xb, yb in batches])
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5)
+
+
+def test_optimizer_outside_program_guard():
+    """minimize() called after the program guard exits must still append
+    optimizer ops to the loss's program (review finding)."""
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        loss = layers.mean(layers.square(layers.fc(x, 1) - y))
+    # outside the guard now
+    static.SGD(0.1).minimize(loss)
+    assert any(op.type == "sgd" for op in main.global_block().ops)
+
+
+def test_clone_for_test_distinct_fingerprint():
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        h = layers.dropout(x, dropout_prob=0.5)
+        _ = layers.reduce_mean(h)
+    fp_train = main.fingerprint()
+    test_prog = main.clone(for_test=True)
+    assert test_prog.fingerprint() != fp_train
