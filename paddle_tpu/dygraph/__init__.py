@@ -1,1 +1,17 @@
+"""paddle_tpu.dygraph — imperative (eager) mode.
 
+Analog of /root/reference/paddle/fluid/imperative/ (C20) +
+python/paddle/fluid/dygraph/: eager Tensor over the shared kernel registry,
+tape autograd engine, Layer module system.
+"""
+from .base import (  # noqa: F401
+    enabled, guard, no_grad, enable_grad, in_dygraph_mode, in_dynamic_mode,
+    enable_dygraph, disable_dygraph, enable_static, disable_static,
+    is_grad_enabled, set_grad_enabled,
+)
+from .tensor import Tensor, to_tensor, to_variable  # noqa: F401
+from .tracer import trace_op, trace_jax  # noqa: F401
+from .engine import grad  # noqa: F401
+from .layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList, ParamBase,
+)

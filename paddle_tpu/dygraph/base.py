@@ -1,0 +1,133 @@
+"""Dygraph mode switching & autograd guards.
+
+Analog of the reference's dygraph mode machinery
+(/root/reference/python/paddle/fluid/framework.py:181 in_dygraph_mode,
+ fluid/dygraph/base.py guard/enabled/no_grad, imperative/tracer.cc:50).
+
+paddle 2.0 semantics: dynamic mode is ON by default; `enable_static()`
+switches to graph building.  Static-API calls (`paddle_tpu.static.*`) always
+build programs regardless of this flag — the flag only steers the dual-mode
+`paddle_tpu.tensor` / `paddle_tpu.nn.functional` surface.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+
+__all__ = [
+    "enabled", "in_dygraph_mode", "in_dynamic_mode", "enable_dygraph",
+    "disable_dygraph", "enable_static", "disable_static", "guard",
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "grad_scope",
+]
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.dygraph = True       # paddle 2.0 default: imperative
+        self.grad_enabled = True
+
+
+_mode = _Mode()
+
+
+def in_dygraph_mode() -> bool:
+    return _mode.dygraph
+
+
+in_dynamic_mode = in_dygraph_mode
+enabled = in_dygraph_mode
+
+
+def enable_dygraph(place=None):
+    _mode.dygraph = True
+    if place is not None:
+        from ..core.place import set_device
+        set_device(place)
+
+
+def disable_dygraph():
+    _mode.dygraph = False
+
+
+def enable_static():
+    _mode.dygraph = False
+
+
+def disable_static(place=None):
+    enable_dygraph(place)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard — run a `with` body in dygraph mode."""
+    prev = _mode.dygraph
+    _mode.dygraph = True
+    try:
+        yield
+    finally:
+        _mode.dygraph = prev
+
+
+# ---------------------------------------------------------------------------
+# grad guards (imperative has_grad / paddle.no_grad)
+# ---------------------------------------------------------------------------
+def is_grad_enabled() -> bool:
+    return _mode.grad_enabled
+
+
+def set_grad_enabled(flag: bool):
+    class _Guard:
+        def __init__(self):
+            self.prev = _mode.grad_enabled
+            _mode.grad_enabled = bool(flag)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            _mode.grad_enabled = self.prev
+
+    return _Guard()
+
+
+class no_grad:
+    """Context manager AND decorator disabling tape recording
+    (fluid/dygraph/base.py no_grad)."""
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    def __enter__(self):
+        self._prev = _mode.grad_enabled
+        _mode.grad_enabled = False
+        return self
+
+    def __exit__(self, *a):
+        _mode.grad_enabled = self._prev
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _mode.grad_enabled
+        _mode.grad_enabled = True
+        return self
+
+    def __exit__(self, *a):
+        _mode.grad_enabled = self._prev
+
+
+@contextlib.contextmanager
+def grad_scope(flag: bool):
+    prev = _mode.grad_enabled
+    _mode.grad_enabled = flag
+    try:
+        yield
+    finally:
+        _mode.grad_enabled = prev

@@ -1,0 +1,221 @@
+"""Dygraph autograd engine.
+
+Analog of /root/reference/paddle/fluid/imperative/basic_engine.cc:161
+BasicEngine::Execute (reverse traversal with dep counts :124-155) and
+partial_grad_engine.cc (`paddle.grad`).
+
+TPU-native: traversal is a host-side reverse-topological walk; each grad op
+dispatches the registered `<op>_grad` kernel eagerly (the same kernels the
+static whole-block path traces).  Gradient accumulation is plain addition —
+the reference's sorted-sum mode (FLAGS_sort_sum_gradient) is irrelevant
+because jnp addition is deterministic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..ops.registry import get_op_info, OpContext
+from .tensor import Tensor
+from .tracer import GradNode
+
+__all__ = ["run_backward", "grad", "register_tensor_hook"]
+
+
+def register_tensor_hook(tensor: Tensor, hook):
+    if tensor._hooks is None:
+        tensor._hooks = []
+    tensor._hooks.append(hook)
+
+    class _Handle:
+        def remove(self, t=tensor, h=hook):
+            t._hooks.remove(h)
+
+    return _Handle()
+
+
+def _topo_order(root_node: GradNode) -> List[GradNode]:
+    """Reverse-postorder DFS over the consumer->producer graph = an order
+    where every node appears before the producers of its inputs."""
+    order, seen = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.input_tensors():
+            if t._grad_node is not None and id(t._grad_node) not in seen:
+                stack.append((t._grad_node, False))
+    order.reverse()  # reverse postorder: consumers before producers
+    return order
+
+
+def _apply_hooks(t: Tensor, g):
+    if t._hooks:
+        for h in t._hooks:
+            out = h(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+    return g
+
+
+class _GradMap:
+    """id(tensor) -> accumulated raw grad, with tensor keepalive."""
+
+    def __init__(self):
+        self.vals: Dict[int, object] = {}
+        self.keep: Dict[int, Tensor] = {}
+
+    def add(self, t: Tensor, g):
+        if g is None:
+            return
+        k = id(t)
+        self.keep[k] = t
+        cur = self.vals.get(k)
+        self.vals[k] = g if cur is None else cur + g
+
+    def get(self, t: Tensor):
+        return self.vals.get(id(t))
+
+
+def _node_grad_ins(node: GradNode, gmap: _GradMap):
+    """Assemble the grad kernel's input dict per the registry convention:
+    forward ins, forward outs, and <out>@GRAD cotangents."""
+    info = get_op_info(node.op_type)
+    ins = {}
+    for slot in info.inputs:
+        v = node.ins.get(slot.name)
+        if slot.duplicable:
+            ins[slot.name] = [t._value if isinstance(t, Tensor) else t
+                              for t in (v or [])]
+        else:
+            ins[slot.name] = v._value if isinstance(v, Tensor) else v
+    for slot in info.outputs:
+        ins[slot.name] = node.outs_raw.get(slot.name)
+        ts = node.out_tensors.get(slot.name, [])
+        if slot.duplicable:
+            gs = [_final_grad(t, gmap) for t in ts]
+            ins[slot.name + "@GRAD"] = gs
+        else:
+            ins[slot.name + "@GRAD"] = (_final_grad(ts[0], gmap)
+                                        if ts else None)
+    return ins, info
+
+
+def _final_grad(t: Tensor, gmap: _GradMap):
+    g = gmap.get(t)
+    if g is not None:
+        g = _apply_hooks(t, g)
+    return g
+
+
+def _run_node(node: GradNode, gmap: _GradMap):
+    if node.vjp_fn is not None:  # trace_jax node
+        ts = node.out_tensors["Out"]
+        g = _final_grad(ts[0], gmap)
+        if g is None:
+            return
+        dins = node.vjp_fn(g)
+        for t, d in zip(node.ins["X"], dins):
+            if isinstance(t, Tensor) and not t.stop_gradient:
+                gmap.add(t, d)
+        return
+
+    gtype = node.op_type + "_grad"
+    ginfo = get_op_info(gtype)
+    if ginfo is None:
+        raise RuntimeError(f"no grad kernel for op {node.op_type!r}")
+    ins, finfo = _node_grad_ins(node, gmap)
+    ctx = OpContext(seed=node.seed)
+    gouts = ginfo.kernel(ins, node.attrs, ctx)
+    if not gouts:
+        return
+    for slot in finfo.inputs:
+        if slot.no_grad:
+            continue
+        g = gouts.get(slot.name + "@GRAD")
+        if g is None:
+            continue
+        v = node.ins.get(slot.name)
+        if slot.duplicable:
+            for t, gi in zip(v or [], g):
+                if isinstance(t, Tensor) and not t.stop_gradient:
+                    gmap.add(t, gi)
+        elif isinstance(v, Tensor) and not v.stop_gradient:
+            gmap.add(v, g)
+
+
+def _seed_grad(root: Tensor, grad_tensor):
+    if grad_tensor is None:
+        return jnp.ones_like(root._value)
+    return (grad_tensor._value if isinstance(grad_tensor, Tensor)
+            else jnp.asarray(grad_tensor))
+
+
+def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
+    """tensor.backward(): accumulate grads into every reachable LEAF tensor
+    with stop_gradient=False (paddle semantics: non-leaf grads are not
+    retained)."""
+    if root.stop_gradient:
+        raise RuntimeError(
+            "backward() on a tensor with stop_gradient=True")
+    gmap = _GradMap()
+    gmap.add(root, _seed_grad(root, grad_tensor))
+    if root._grad_node is not None:
+        for node in _topo_order(root._grad_node):
+            _run_node(node, gmap)
+    # write back leaf grads (accumulating across backward calls)
+    for k, t in gmap.keep.items():
+        if t.stop_gradient or t._grad_node is not None:
+            continue
+        g = _apply_hooks(t, gmap.vals[k])
+        if t.grad_ is None:
+            t.grad_ = Tensor(g, stop_gradient=True, name=t.name + "@GRAD")
+        else:
+            t.grad_ = Tensor(t.grad_._value + g, stop_gradient=True,
+                             name=t.name + "@GRAD")
+    if not retain_graph:
+        root._grad_node = None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — PartialGradEngine analog: return grads of `outputs`
+    w.r.t. `inputs` without touching .grad."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    gmap = _GradMap()
+    for out, go in zip(outputs, grad_outputs):
+        gmap.add(out, _seed_grad(out, go))
+    # a virtual root over all outputs gives one globally-valid topo order
+    # even when the roots' graphs share interior nodes
+    virtual = GradNode("__root__", {"X": list(outputs)}, {}, {}, {}, 0)
+    for node in _topo_order(virtual):
+        if node is virtual:
+            continue
+        _run_node(node, gmap)
+
+    results = []
+    for t in inputs:
+        g = gmap.get(t)
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {t.name} is unreachable from outputs "
+                    "(set allow_unused=True to get None)")
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=not create_graph))
+    return results

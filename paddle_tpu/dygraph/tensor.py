@@ -1,0 +1,360 @@
+"""Eager Tensor (VarBase analog) for dygraph mode.
+
+Reference: /root/reference/paddle/fluid/imperative/layer.h:65 VarBase wrapping
+VariableWrapper; python-side method patches in
+/root/reference/python/paddle/fluid/dygraph/varbase_patch_methods.py and
+math_op_patch.py.
+
+TPU-native: the payload is a jax.Array living on the current expected place's
+device; every op call runs the same traceable kernels as the static executor,
+dispatched eagerly (JAX op-by-op dispatch is the eager runtime — there is no
+separate kernel table, cf. prepared_operator.cc:69 kernel lookup in the
+reference).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, np_dtype
+from ..core.program import unique_name
+
+__all__ = ["Tensor", "to_tensor", "to_variable"]
+
+
+class Tensor:
+    """Eager tensor with tape-based autograd."""
+
+    __slots__ = ("_value", "stop_gradient", "persistable", "name", "grad_",
+                 "_grad_node", "trainable", "_hooks", "__weakref__")
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True,
+                 name=None, persistable=False, trainable=True):
+        if isinstance(value, Tensor):
+            value = value._value
+        arr = jnp.asarray(value)
+        if dtype is not None:
+            want = np_dtype(convert_dtype(dtype))
+            if arr.dtype != want:
+                arr = arr.astype(want)
+        if place is not None:
+            dev = place.jax_device() if hasattr(place, "jax_device") else place
+            arr = jax.device_put(arr, dev)
+        self._value = arr
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self.name = name or unique_name("eager_tmp")
+        self.grad_: Optional["Tensor"] = None
+        self._grad_node = None  # GradNode that produced this tensor
+        self._hooks = None      # list of grad hooks (register_hook)
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return convert_dtype(str(self._value.dtype))
+
+    @property
+    def place(self):
+        from ..core.place import _current_expected_place
+        return _current_expected_place()
+
+    @property
+    def grad(self):
+        return self.grad_
+
+    @grad.setter
+    def grad(self, g):
+        self.grad_ = g
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value.item())
+
+    def __int__(self):
+        return int(self._value.item())
+
+    def __bool__(self):
+        return bool(self._value.item())
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .engine import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def gradient(self):
+        return None if self.grad_ is None else self.grad_.numpy()
+
+    def clear_gradient(self):
+        self.grad_ = None
+
+    def clear_grad(self):
+        self.grad_ = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True,
+                   name=self.name + ".detach")
+        return t
+
+    def clone(self) -> "Tensor":
+        from .tracer import trace_op
+        return trace_op("assign", {"X": self}, {}, ["Out"])
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def register_hook(self, hook):
+        from .engine import register_tensor_hook
+        return register_tensor_hook(self, hook)
+
+    # -- mutation (optimizers write in place) -------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        arr = jnp.asarray(value)
+        if arr.dtype != self._value.dtype:
+            arr = arr.astype(self._value.dtype)
+        self._value = arr
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def scale_(self, s):
+        self._value = self._value * s
+        return self
+
+    # -- dtype / device sugar ----------------------------------------------
+    def astype(self, dtype):
+        from .tracer import trace_op
+        return trace_op("cast", {"X": self},
+                        {"out_dtype": convert_dtype(dtype)}, ["Out"])
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, device_id=0):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- op sugar (math_op_patch parity) ------------------------------------
+    def _op(self, type_, other=None, reverse=False, **attrs):
+        from .tracer import trace_op
+        ins = {"X": self}
+        if other is not None:
+            if not isinstance(other, Tensor):
+                other = Tensor(np.asarray(other, dtype=self.numpy().dtype),
+                               stop_gradient=True)
+            ins = ({"X": other, "Y": self} if reverse
+                   else {"X": self, "Y": other})
+        return trace_op(type_, ins, attrs, ["Out"])
+
+    def __add__(self, o):
+        return self._op("elementwise_add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._op("elementwise_sub", o)
+
+    def __rsub__(self, o):
+        return self._op("elementwise_sub", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._op("elementwise_mul", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._op("elementwise_div", o)
+
+    def __rtruediv__(self, o):
+        return self._op("elementwise_div", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._op("elementwise_pow", o)
+
+    def __mod__(self, o):
+        return self._op("elementwise_mod", o)
+
+    def __floordiv__(self, o):
+        return self._op("elementwise_floordiv", o)
+
+    def __matmul__(self, o):
+        from .tracer import trace_op
+        return trace_op("matmul", {"X": self, "Y": o}, {}, ["Out"])
+
+    def __neg__(self):
+        return self._op("scale", scale=-1.0, bias=0.0)
+
+    def __abs__(self):
+        return self._op("abs")
+
+    def _cmp(self, type_, o):
+        from .tracer import trace_op
+        if not isinstance(o, Tensor):
+            o = Tensor(np.asarray(o, dtype=self.numpy().dtype))
+        return trace_op(type_, {"X": self, "Y": o}, {}, ["Out"])
+
+    def __lt__(self, o):
+        return self._cmp("less_than", o)
+
+    def __le__(self, o):
+        return self._cmp("less_equal", o)
+
+    def __gt__(self, o):
+        return self._cmp("greater_than", o)
+
+    def __ge__(self, o):
+        return self._cmp("greater_equal", o)
+
+    def __eq__(self, o):
+        if isinstance(o, (Tensor, int, float, np.ndarray)):
+            return self._cmp("equal", o)
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Tensor, int, float, np.ndarray)):
+            return self._cmp("not_equal", o)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        # slicing detaches nothing: route through jnp directly, recording a
+        # generic slice via tracked op when grad is needed
+        from .tracer import trace_jax
+        return trace_jax(lambda v: v[idx], [self], f"getitem")
+
+    def __setitem__(self, idx, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = self._value.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- reductions/method sugar (subset; full set patched by tensor module)--
+    def _reduce(self, type_, axis, keepdim):
+        from .tracer import trace_op
+        attrs = {"keep_dim": keepdim}
+        if axis is None:
+            attrs["reduce_all"] = True
+            attrs["dim"] = [0]
+        else:
+            attrs["dim"] = [axis] if np.isscalar(axis) else list(axis)
+        return trace_op(type_, {"X": self}, attrs, ["Out"])
+
+    def sum(self, axis=None, keepdim=False):
+        return self._reduce("reduce_sum", axis, keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        return self._reduce("reduce_mean", axis, keepdim)
+
+    def max(self, axis=None, keepdim=False):
+        return self._reduce("reduce_max", axis, keepdim)
+
+    def min(self, axis=None, keepdim=False):
+        return self._reduce("reduce_min", axis, keepdim)
+
+    def prod(self, axis=None, keepdim=False):
+        return self._reduce("reduce_prod", axis, keepdim)
+
+    def reshape(self, shape):
+        from .tracer import trace_op
+        return trace_op("reshape2", {"X": self}, {"shape": list(shape)},
+                        ["Out"])
+
+    def transpose(self, perm):
+        from .tracer import trace_op
+        return trace_op("transpose2", {"X": self}, {"axis": list(perm)},
+                        ["Out"])
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        shape = self.shape
+        n = len(shape)
+        stop = stop_axis % n
+        start = start_axis % n
+        new = shape[:start] + [-1] + shape[stop + 1:]
+        return self.reshape(new)
+
+    def squeeze(self, axis=None):
+        from .tracer import trace_op
+        axes = [] if axis is None else ([axis] if np.isscalar(axis) else list(axis))
+        return trace_op("squeeze2", {"X": self}, {"axes": axes}, ["Out"])
+
+    def unsqueeze(self, axis):
+        from .tracer import trace_op
+        axes = [axis] if np.isscalar(axis) else list(axis)
+        return trace_op("unsqueeze2", {"X": self}, {"axes": axes}, ["Out"])
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def __repr__(self):
+        grad_note = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}"
+                f"{grad_note},\n       {np.asarray(self._value)!r})")
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None) -> Tensor:
+    """fluid.dygraph.to_variable (legacy alias)."""
+    return Tensor(value, dtype=dtype, name=name)

@@ -1,0 +1,149 @@
+"""Dygraph tracer: eager op dispatch + autograd graph capture.
+
+Analog of /root/reference/paddle/fluid/imperative/tracer.cc:50 TraceOp —
+run the kernel eagerly, then CreateGradOpNode (tracer.cc:104) records a node
+into the reverse graph.  Kernel dispatch reuses the SAME registry as the
+static executor (ops/registry.py), so eager and traced execution can never
+diverge numerically (the reference guarantees this by sharing OpKernelType
+dispatch, prepared_operator.cc:69).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.generator import global_seed, next_eager_uid
+from ..ops.registry import get_op_info, OpContext
+from .base import is_grad_enabled
+from .tensor import Tensor
+
+__all__ = ["trace_op", "trace_jax", "GradNode"]
+
+
+class GradNode:
+    """One recorded op in the reverse graph (OpBase/GradOpNode analog,
+    imperative/layer.h)."""
+
+    __slots__ = ("op_type", "ins", "attrs", "outs_raw", "out_tensors",
+                 "seed", "vjp_fn", "n_vjp_inputs", "in_tensors_flat")
+
+    def __init__(self, op_type, ins, attrs, outs_raw, out_tensors, seed):
+        self.op_type = op_type
+        self.ins = ins                # slot -> Tensor | [Tensor] | None
+        self.attrs = attrs
+        self.outs_raw = outs_raw      # slot -> raw value(s) (for grad kernels)
+        self.out_tensors = out_tensors  # slot -> [Tensor] (strong refs)
+        self.seed = seed
+        self.vjp_fn = None            # set for trace_jax nodes
+        self.n_vjp_inputs = 0
+        self.in_tensors_flat: List[Tensor] = []
+
+    def input_tensors(self) -> List[Tensor]:
+        if self.in_tensors_flat:
+            return self.in_tensors_flat
+        out = []
+        for v in self.ins.values():
+            if isinstance(v, Tensor):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                out.extend(t for t in v if isinstance(t, Tensor))
+        self.in_tensors_flat = out
+        return out
+
+
+def _raw(v):
+    if isinstance(v, Tensor):
+        return v._value
+    if isinstance(v, (list, tuple)):
+        return [_raw(x) for x in v]
+    return v
+
+
+def _requires_grad(ins) -> bool:
+    for v in ins.values():
+        if isinstance(v, Tensor) and not v.stop_gradient:
+            return True
+        if isinstance(v, (list, tuple)):
+            if any(isinstance(t, Tensor) and not t.stop_gradient for t in v):
+                return True
+    return False
+
+
+def trace_op(op_type: str, ins: Dict[str, Any], attrs: Dict[str, Any],
+             out_slots: Sequence[str], n_outs: Optional[Dict[str, int]] = None):
+    """Run one op eagerly; record a GradNode when grad is required.
+
+    Returns a single Tensor if `out_slots` has one entry, else a tuple in
+    slot order.  Duplicable output slots return lists.
+    """
+    info = get_op_info(op_type)
+    if info is None:
+        raise NotImplementedError(f"op {op_type!r} has no registered kernel")
+
+    attrs = dict(attrs or {})
+    attrs.setdefault("op_uid", next_eager_uid())
+    seed = global_seed()
+    ctx = OpContext(seed=seed)
+
+    raw_ins = {}
+    for slot in info.inputs:
+        v = ins.get(slot.name)
+        if slot.duplicable:
+            raw_ins[slot.name] = [_raw(t) for t in (v or [])]
+        else:
+            raw_ins[slot.name] = _raw(v) if v is not None else None
+
+    outs = info.kernel(raw_ins, attrs, ctx)
+
+    needs_grad = (is_grad_enabled() and info.has_grad and _requires_grad(ins))
+
+    node = None
+    out_tensors: Dict[str, List[Tensor]] = {}
+    if needs_grad:
+        node = GradNode(op_type, dict(ins), attrs, outs, out_tensors, seed)
+
+    results = []
+    for slot_name in out_slots:
+        slot = next((s for s in info.outputs if s.name == slot_name), None)
+        val = outs.get(slot_name) if outs else None
+        if slot is not None and slot.duplicable:
+            ts = []
+            for v in (val or []):
+                t = Tensor(v, stop_gradient=not needs_grad)
+                t._grad_node = node
+                ts.append(t)
+            out_tensors[slot_name] = ts
+            results.append(ts)
+        else:
+            if val is None:
+                results.append(None)
+                continue
+            sg = not needs_grad or not jnp.issubdtype(
+                jnp.asarray(val).dtype, jnp.inexact)
+            t = Tensor(val, stop_gradient=sg)
+            if not sg:
+                t._grad_node = node
+            out_tensors[slot_name] = [t]
+            results.append(t)
+
+    return results[0] if len(out_slots) == 1 else tuple(results)
+
+
+def trace_jax(fn, in_tensors: List[Tensor], label: str = "jax_fn"):
+    """Trace an arbitrary jax function of the given tensors (used for
+    indexing and other sugar that has no named op)."""
+    raws = [t._value for t in in_tensors]
+    needs_grad = is_grad_enabled() and any(
+        not t.stop_gradient for t in in_tensors)
+    if not needs_grad:
+        return Tensor(fn(*raws))
+    out_raw, vjp_fn = jax.vjp(fn, *raws)
+    t = Tensor(out_raw, stop_gradient=False)
+    node = GradNode("__vjp__:" + label, {"X": list(in_tensors)}, {},
+                    {"Out": out_raw}, {"Out": [t]}, global_seed())
+    node.vjp_fn = vjp_fn
+    node.n_vjp_inputs = len(in_tensors)
+    t._grad_node = node
+    return t
