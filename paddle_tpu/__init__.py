@@ -37,17 +37,24 @@ def _setup_api():
 
 _setup_api()
 
-# promote common symbols when available
+# promote common symbols
+from .dygraph.base import (  # noqa: F401
+    enable_static, disable_static, in_dynamic_mode, in_dygraph_mode, no_grad,
+    set_grad_enabled, is_grad_enabled,
+)
+from .dygraph.tensor import Tensor, to_tensor  # noqa: F401
+from .dygraph.engine import grad  # noqa: F401
+from .dygraph.layers import ParamBase  # noqa: F401
+
 try:
-    from .dygraph.base import (  # noqa: F401
-        enable_static, disable_static, in_dynamic_mode, no_grad, grad,
-        to_tensor, Tensor,
-    )
     from .tensor import *  # noqa: F401,F403
 except ImportError:
     pass
 try:
     from .hapi.model import Model  # noqa: F401
-    from .framework_io import save, load  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .io.framework_io import save, load  # noqa: F401
 except ImportError:
     pass

@@ -50,8 +50,9 @@ def _topo_order(root_node: GradNode) -> List[GradNode]:
         seen.add(id(node))
         stack.append((node, True))
         for t in node.input_tensors():
-            if t._grad_node is not None and id(t._grad_node) not in seen:
-                stack.append((t._grad_node, False))
+            prod = t._grad_node
+            if isinstance(prod, GradNode) and id(prod) not in seen:
+                stack.append((prod, False))
     order.reverse()  # reverse postorder: consumers before producers
     return order
 
@@ -71,9 +72,10 @@ class _GradMap:
     def __init__(self):
         self.vals: Dict[int, object] = {}
         self.keep: Dict[int, Tensor] = {}
+        self.blocked: set = set()  # no_grad_vars: ids that absorb no grad
 
     def add(self, t: Tensor, g):
-        if g is None:
+        if g is None or id(t) in self.blocked:
             return
         k = id(t)
         self.keep[k] = t
@@ -158,6 +160,13 @@ def _seed_grad(root: Tensor, grad_tensor):
             else jnp.asarray(grad_tensor))
 
 
+class _FreedGraph:
+    """Sentinel replacing a root's GradNode after a non-retained backward."""
+
+
+_FREED = _FreedGraph()
+
+
 def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
     """tensor.backward(): accumulate grads into every reachable LEAF tensor
     with stop_gradient=False (paddle semantics: non-leaf grads are not
@@ -165,6 +174,10 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
     if root.stop_gradient:
         raise RuntimeError(
             "backward() on a tensor with stop_gradient=True")
+    if root._grad_node is _FREED:
+        raise RuntimeError(
+            "backward() called twice on the same graph; pass "
+            "retain_graph=True to the first call to allow this")
     gmap = _GradMap()
     gmap.add(root, _seed_grad(root, grad_tensor))
     if root._grad_node is not None:
@@ -180,8 +193,8 @@ def run_backward(root: Tensor, grad_tensor=None, retain_graph=False):
         else:
             t.grad_ = Tensor(t.grad_._value + g, stop_gradient=True,
                              name=t.name + "@GRAD")
-    if not retain_graph:
-        root._grad_node = None
+    if not retain_graph and root._grad_node is not None:
+        root._grad_node = _FREED
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -189,6 +202,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """paddle.grad — PartialGradEngine analog: return grads of `outputs`
     w.r.t. `inputs` without touching .grad."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double backward) is not supported by the "
+            "tape engine yet; use jax.grad composition via the static path")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -197,6 +214,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [grad_outputs]
 
     gmap = _GradMap()
+    if no_grad_vars:
+        gmap.blocked = {id(t) for t in no_grad_vars}
     for out, go in zip(outputs, grad_outputs):
         gmap.add(out, _seed_grad(out, go))
     # a virtual root over all outputs gives one globally-valid topo order
@@ -217,5 +236,5 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "(set allow_unused=True to get None)")
             results.append(None)
         else:
-            results.append(Tensor(g, stop_gradient=not create_graph))
+            results.append(Tensor(g, stop_gradient=True))
     return results

@@ -149,9 +149,12 @@ class Layer:
             self._sub_layers[name] = value
             object.__setattr__(self, name, value)
         else:
-            params = getattr(self, "_parameters", None)
-            if params is not None and name in params and value is None:
-                del params[name]
+            # reassigning a former parameter/sublayer slot to None or a
+            # plain value must drop the stale registry entry too
+            for store in ("_parameters", "_sub_layers", "_buffers"):
+                d = self.__dict__.get(store)
+                if d is not None and name in d:
+                    del d[name]
             object.__setattr__(self, name, value)
 
     def __getattr__(self, name):
@@ -213,13 +216,17 @@ class Layer:
         return [b for _, b in self.named_buffers(
             include_sublayers=include_sublayers)]
 
-    def named_buffers(self, prefix="", include_sublayers=True):
+    def named_buffers(self, prefix="", include_sublayers=True,
+                      persistable_only=False):
         for name, lay in self.named_sublayers(prefix=prefix,
                                               include_self=True):
             if not include_sublayers and lay is not self:
                 continue
             for bname, b in lay._buffers.items():
                 if b is None:
+                    continue
+                if persistable_only and \
+                        bname in lay._non_persistable_buffer_names:
                     continue
                 yield (name + "." + bname if name else bname), b
 
@@ -279,10 +286,8 @@ class Layer:
             dest[name] = p
         for name, b in self.named_buffers(
                 prefix=structured_name_prefix.rstrip("."),
-                include_sublayers=include_sublayers):
-            short = name.rsplit(".", 1)[-1]
-            if short in self._non_persistable_buffer_names:
-                continue
+                include_sublayers=include_sublayers,
+                persistable_only=True):
             dest[name] = b
         return dest
 
@@ -374,7 +379,11 @@ class LayerList(Layer):
     def __getitem__(self, idx):
         if isinstance(idx, slice):
             return list(self._sub_layers.values())[idx]
-        return self._sub_layers[str(idx % max(len(self), 1))]
+        n = len(self._sub_layers)
+        if not -n <= idx < n:
+            raise IndexError(
+                f"index {idx} out of range for LayerList of length {n}")
+        return self._sub_layers[str(idx % n)]
 
     def __setitem__(self, idx, layer):
         self._sub_layers[str(idx)] = layer

@@ -190,7 +190,9 @@ class Tensor:
         ins = {"X": self}
         if other is not None:
             if not isinstance(other, Tensor):
-                other = Tensor(np.asarray(other, dtype=self.numpy().dtype),
+                # use the device array's dtype directly — .numpy() would be a
+                # full D2H transfer just to learn the dtype
+                other = Tensor(jnp.asarray(other, dtype=self._value.dtype),
                                stop_gradient=True)
             ins = ({"X": other, "Y": self} if reverse
                    else {"X": self, "Y": other})
@@ -240,7 +242,7 @@ class Tensor:
     def _cmp(self, type_, o):
         from .tracer import trace_op
         if not isinstance(o, Tensor):
-            o = Tensor(np.asarray(o, dtype=self.numpy().dtype))
+            o = Tensor(jnp.asarray(o, dtype=self._value.dtype))
         return trace_op(type_, {"X": self, "Y": o}, {}, ["Out"])
 
     def __lt__(self, o):

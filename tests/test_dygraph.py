@@ -173,3 +173,70 @@ def test_shared_parameter_dedup():
     m = Sequential(_MLP())
     m2 = Sequential(m[0])  # same underlying layer
     assert len(m2.parameters()) == 2
+
+
+def test_backward_twice_raises():
+    x = to_tensor(np.ones(2, dtype=np.float32), stop_gradient=False)
+    y = (x * 2.0).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+    # retain_graph allows it
+    z = (x * 2.0).sum()
+    z.backward(retain_graph=True)
+    z.backward()
+    np.testing.assert_allclose(x.gradient(), [6.0, 6.0], rtol=1e-6)
+
+
+def test_no_grad_vars_blocks():
+    x = to_tensor(np.ones(2, dtype=np.float32), stop_gradient=False)
+    w = to_tensor(np.full(2, 3.0, dtype=np.float32), stop_gradient=False)
+    y = x * w
+    (gx,) = grad(y.sum(), x, no_grad_vars=[w])
+    np.testing.assert_allclose(gx.numpy(), [3.0, 3.0], rtol=1e-6)
+
+
+def test_sublayer_nonpersistable_buffer_excluded():
+    class Sub(Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("tmp", np.zeros(2, np.float32),
+                                 persistable=False)
+            self.register_buffer("mu", np.ones(2, np.float32))
+
+        def forward(self, x):
+            return x
+
+    class Top(Layer):
+        def __init__(self):
+            super().__init__()
+            self.s = Sub()
+
+        def forward(self, x):
+            return x
+
+    sd = Top().state_dict()
+    assert "s.mu" in sd and "s.tmp" not in sd
+
+
+def test_setattr_none_unregisters_sublayer():
+    class M(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = _MLP()
+
+        def forward(self, x):
+            return x
+
+    m = M()
+    assert len(m.parameters()) == 2
+    m.fc = None
+    assert len(m.parameters()) == 0
+    assert m.fc is None
+
+
+def test_top_level_api_promoted():
+    assert paddle.to_tensor is not None
+    assert paddle.Tensor is Tensor
+    t = paddle.to_tensor([1.0, 2.0])
+    assert isinstance(t, Tensor)
