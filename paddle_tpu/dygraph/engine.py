@@ -213,6 +213,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
 
+    for out in outputs:
+        if out._grad_node is _FREED:
+            raise RuntimeError(
+                "grad(): the graph reaching this output was freed by a "
+                "previous backward(); pass retain_graph=True to backward()")
     gmap = _GradMap()
     if no_grad_vars:
         gmap.blocked = {id(t) for t in no_grad_vars}

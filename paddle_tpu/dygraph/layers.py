@@ -139,22 +139,37 @@ class Layer:
         return sublayer
 
     # -- attribute magic ----------------------------------------------------
+    def _drop_from_stores(self, name, keep=None):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            if store == keep:
+                continue
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+
     def __setattr__(self, name, value):
         if isinstance(value, ParamBase):
             if not hasattr(self, "_parameters"):
                 raise RuntimeError("call Layer.__init__ first")
+            self._drop_from_stores(name, keep="_parameters")
             self._parameters[name] = value
             object.__setattr__(self, name, value)
         elif isinstance(value, Layer):
+            self._drop_from_stores(name, keep="_sub_layers")
             self._sub_layers[name] = value
             object.__setattr__(self, name, value)
         else:
-            # reassigning a former parameter/sublayer slot to None or a
-            # plain value must drop the stale registry entry too
-            for store in ("_parameters", "_sub_layers", "_buffers"):
-                d = self.__dict__.get(store)
-                if d is not None and name in d:
-                    del d[name]
+            buffers = self.__dict__.get("_buffers")
+            if buffers is not None and name in buffers and \
+                    isinstance(value, Tensor):
+                # `self.x = self.register_buffer("x", t)` (and later
+                # re-assignments of a registered buffer) update the buffer
+                # store rather than unregistering it
+                buffers[name] = value
+            else:
+                # reassigning a former parameter/sublayer/buffer slot to
+                # None or a plain value drops the stale registry entry
+                self._drop_from_stores(name)
             object.__setattr__(self, name, value)
 
     def __getattr__(self, name):

@@ -1,1 +1,16 @@
+"""paddle_tpu.nn — the paddle-2.0 neural-net API.
 
+Analog of /root/reference/python/paddle/nn/ (P7 in SURVEY.md §2.2): Layer
+classes over the dygraph module system + functional forms; all compute goes
+through the shared kernel registry.
+"""
+from ..dygraph.layers import (  # noqa: F401
+    Layer, Sequential, LayerList, ParameterList,
+)
+from ..dygraph.base import no_grad  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import (  # noqa: F401
+    common, conv, pooling, norm, activation, loss, rnn, transformer,
+)

@@ -1,0 +1,587 @@
+"""paddle.nn.functional (dual-mode).
+
+Analog of /root/reference/python/paddle/nn/functional/ — stateless forms of
+the nn layers, dispatching through the shared kernel registry in both eager
+and static mode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..tensor._dispatch import dispatch
+
+__all__ = []
+
+
+def _export(fn, name=None):
+    globals()[name or fn.__name__] = fn
+    __all__.append(name or fn.__name__)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+_ACTS = ["relu", "relu6", "sigmoid", "tanh", "softplus", "softsign",
+         "tanh_shrink", "silu", "mish", "selu", "swish", "hard_sigmoid",
+         "hard_swish", "logsigmoid"]
+
+
+def _make_act(op):
+    def fn(x, name=None):
+        return dispatch(op, {"X": x}, name=name)
+
+    fn.__name__ = op
+    return fn
+
+
+for _a in _ACTS:
+    _export(_make_act(_a))
+
+_export(_make_act("logsigmoid"), "log_sigmoid")
+_export(_make_act("hard_swish"), "hardswish")
+_export(_make_act("hard_sigmoid"), "hardsigmoid")
+_export(_make_act("tanh_shrink"), "tanhshrink")
+
+
+@_export
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", {"X": x}, {"approximate": bool(approximate)},
+                    name=name)
+
+
+@_export
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch("leaky_relu", {"X": x}, {"alpha": float(negative_slope)},
+                    name=name)
+
+
+@_export
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", {"X": x}, {"alpha": float(alpha)}, name=name)
+
+
+@_export
+def prelu(x, weight, name=None):
+    mode = "all" if int(np.prod(weight.shape)) == 1 else "channel"
+    return dispatch("prelu", {"X": x, "Alpha": weight}, {"mode": mode},
+                    name=name)
+
+
+@_export
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch("hard_shrink", {"X": x}, {"threshold": float(threshold)},
+                    name=name)
+
+
+@_export
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch("softshrink", {"X": x}, {"lambda": float(threshold)},
+                    name=name)
+
+
+@_export
+def thresholded_relu(x, threshold=1.0, name=None):
+    return dispatch("thresholded_relu", {"X": x},
+                    {"threshold": float(threshold)}, name=name)
+
+
+@_export
+def maxout(x, groups, axis=1, name=None):
+    return dispatch("maxout", {"X": x}, {"groups": groups, "axis": axis},
+                    name=name)
+
+
+@_export
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = dispatch("softmax", {"X": x}, {"axis": int(axis)}, name=name)
+    return out
+
+
+@_export
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return dispatch("log_softmax", {"X": x}, {"axis": int(axis)}, name=name)
+
+
+@_export
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..tensor import random as R
+    from ..tensor import math as M
+    u = R.uniform(x.shape, "float32", 1e-20, 1.0 - 1e-7)
+    g = M.scale(M.log(M.scale(M.log(u), scale=-1.0)), scale=-1.0)
+    y = softmax(M.scale(M.add(x, g), scale=1.0 / temperature), axis=axis)
+    if hard:
+        from ..tensor import search as S
+        from ..tensor.manipulation import transpose
+        nd = len(y.shape)
+        ax = axis % nd
+        idx = S.argmax(y, axis=ax, keepdim=True)
+        hard_y = dispatch("one_hot_v2", {"X": idx.squeeze(ax)},
+                          {"depth": y.shape[ax]})
+        if ax != nd - 1:
+            # one_hot appends depth last; move it back to `axis`
+            perm = list(range(nd - 1))
+            perm.insert(ax, nd - 1)
+            hard_y = transpose(hard_y, perm)
+        # straight-through estimator
+        y = M.add(M.subtract(hard_y, y.detach()
+                             if hasattr(y, "detach") else y), y)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# linear / conv / pool
+# ---------------------------------------------------------------------------
+@_export
+def linear(x, weight, bias=None, name=None):
+    out = dispatch("matmul_v2", {"X": x, "Y": weight},
+                   {"trans_x": False, "trans_y": False}, name=name)
+    if bias is not None:
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": -1})
+    return out
+
+
+def _pair(v, n=2):
+    return [v] * n if np.isscalar(v) else list(v)
+
+
+@_export
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups,
+             "data_format": data_format}
+    if isinstance(padding, str):
+        attrs["paddings"] = [0, 0]
+        attrs["padding_algorithm"] = padding.upper()
+    out = dispatch("conv2d", {"Input": x, "Filter": weight}, attrs,
+                   ["Output"], name=name)
+    if bias is not None:
+        caxis = 1 if data_format.startswith("NC") else -1
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": caxis})
+    return out
+
+
+@_export
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+             "dilations": _pair(dilation), "groups": groups,
+             "data_format": data_format}
+    out = dispatch("conv2d_transpose", {"Input": x, "Filter": weight},
+                   attrs, ["Output"], name=name)
+    if bias is not None:
+        caxis = 1 if data_format.startswith("NC") else -1
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": caxis})
+    return out
+
+
+@_export
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    attrs = {"strides": _pair(stride, 3), "paddings": _pair(padding, 3),
+             "dilations": _pair(dilation, 3), "groups": groups,
+             "data_format": data_format}
+    out = dispatch("conv3d", {"Input": x, "Filter": weight}, attrs,
+                   ["Output"], name=name)
+    if bias is not None:
+        caxis = 1 if data_format.startswith("NC") else -1
+        out = dispatch("elementwise_add", {"X": out, "Y": bias},
+                       {"axis": caxis})
+    return out
+
+
+@_export
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    attrs = {"pooling_type": "max", "ksize": ks,
+             "strides": _pair(stride) if stride is not None else ks,
+             "paddings": _pair(padding), "ceil_mode": ceil_mode,
+             "global_pooling": False, "data_format": data_format,
+             "exclusive": True}
+    if return_mask:
+        return dispatch("max_pool2d_with_index", {"X": x}, attrs,
+                        ["Out", "Mask"], name=name)
+    return dispatch("pool2d", {"X": x}, attrs, name=name)
+
+
+@_export
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    attrs = {"pooling_type": "avg", "ksize": ks,
+             "strides": _pair(stride) if stride is not None else ks,
+             "paddings": _pair(padding), "ceil_mode": ceil_mode,
+             "global_pooling": False, "data_format": data_format,
+             "exclusive": exclusive}
+    return dispatch("pool2d", {"X": x}, attrs, name=name)
+
+
+@_export
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    attrs = {"pooling_type": "avg", "ksize": _pair(output_size),
+             "adaptive": True, "strides": [1, 1], "paddings": [0, 0],
+             "global_pooling": False, "data_format": data_format,
+             "exclusive": True}
+    return dispatch("pool2d", {"X": x}, attrs, name=name)
+
+
+@_export
+def adaptive_max_pool2d(x, output_size, data_format="NCHW", name=None):
+    attrs = {"pooling_type": "max", "ksize": _pair(output_size),
+             "adaptive": True, "strides": [1, 1], "paddings": [0, 0],
+             "global_pooling": False, "data_format": data_format,
+             "exclusive": True}
+    return dispatch("pool2d", {"X": x}, attrs, name=name)
+
+
+# ---------------------------------------------------------------------------
+# norm / dropout / embedding
+# ---------------------------------------------------------------------------
+@_export
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    n_norm = 1 if np.isscalar(normalized_shape) else len(normalized_shape)
+    bna = len(x.shape) - n_norm
+    out, _, _ = dispatch("layer_norm",
+                         {"X": x, "Scale": weight, "Bias": bias},
+                         {"epsilon": epsilon, "begin_norm_axis": bna},
+                         ["Y", "Mean", "Variance"], name=name)
+    return out
+
+
+@_export
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    attrs = {"momentum": momentum, "epsilon": epsilon,
+             "data_format": data_format, "is_test": not training,
+             "use_global_stats": bool(use_global_stats)
+             if use_global_stats is not None else False}
+    y, mean_out, var_out, _, _, _ = dispatch(
+        "batch_norm",
+        {"X": x, "Scale": weight, "Bias": bias, "Mean": running_mean,
+         "Variance": running_var}, attrs,
+        ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance",
+         "ReserveSpace"], name=name)
+    # eager: write back running stats in place (the static path wires
+    # MeanOut/VarianceOut to the same persistable vars)
+    if training and hasattr(running_mean, "set_value"):
+        running_mean.set_value(mean_out)
+        running_var.set_value(var_out)
+    return y
+
+
+@_export
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, eps=1e-5, momentum=0.9, name=None):
+    out, _, _ = dispatch("instance_norm",
+                         {"X": x, "Scale": weight, "Bias": bias},
+                         {"epsilon": eps},
+                         ["Y", "SavedMean", "SavedVariance"], name=name)
+    return out
+
+
+@_export
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    out, _, _ = dispatch("group_norm",
+                         {"X": x, "Scale": weight, "Bias": bias},
+                         {"epsilon": epsilon, "groups": num_groups,
+                          "data_format": data_format},
+                         ["Y", "Mean", "Variance"], name=name)
+    return out
+
+
+@_export
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    from ..tensor import math as M
+    if p == 2:
+        attrs = {"axis": int(axis), "epsilon": float(epsilon),
+                 "is_test": True}
+        out, _ = dispatch("norm", {"X": x}, attrs, ["Out", "Norm"],
+                          name=name)
+        return out
+    pn = dispatch("p_norm", {"X": x},
+                  {"porder": float(p), "axis": int(axis), "keepdim": True,
+                   "epsilon": float(epsilon)})
+    return M.divide(x, pn)
+
+
+@_export
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    impl = mode if mode in ("upscale_in_train",
+                            "downgrade_in_infer") else "upscale_in_train"
+    out, _ = dispatch("dropout", {"X": x},
+                      {"dropout_prob": float(p), "is_test": not training,
+                       "dropout_implementation": impl},
+                      ["Out", "Mask"], name=name)
+    return out
+
+
+@_export
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, training=training, name=name)
+
+
+@_export
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return dispatch("lookup_table_v2", {"W": weight, "Ids": x},
+                    {"padding_idx": -1 if padding_idx is None
+                     else padding_idx}, name=name)
+
+
+@_export
+def one_hot(x, num_classes, name=None):
+    return dispatch("one_hot_v2", {"X": x}, {"depth": int(num_classes)},
+                    name=name)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    from ..tensor import math as M
+    if reduction == "mean":
+        return M.mean(loss)
+    if reduction == "sum":
+        return M.sum(loss)
+    return loss
+
+
+@_export
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    loss, _ = dispatch(
+        "softmax_with_cross_entropy",
+        {"Logits": input, "Label": label},
+        {"soft_label": bool(soft_label), "ignore_index": ignore_index,
+         "axis": int(axis), "use_softmax": use_softmax},
+        ["Loss", "Softmax"], name=name)
+    if weight is not None and not soft_label:
+        from ..tensor import math as M
+        from ..tensor.manipulation import gather, squeeze, unsqueeze
+        lbl = label if len(label.shape) == len(loss.shape) else label
+        w = gather(weight, squeeze(lbl, -1)
+                   if lbl.shape[-1] == 1 else lbl)
+        w = unsqueeze(w, -1) if len(w.shape) < len(loss.shape) else w
+        loss = M.multiply(loss, w)
+        if reduction == "mean":
+            from ..tensor import math as M2
+            return M2.divide(M2.sum(loss), M2.sum(w))
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100,
+                               return_softmax=False, name=None):
+    loss, sm = dispatch(
+        "softmax_with_cross_entropy", {"Logits": logits, "Label": label},
+        {"soft_label": bool(soft_label), "ignore_index": ignore_index,
+         "axis": int(axis)}, ["Loss", "Softmax"], name=name)
+    return (loss, sm) if return_softmax else loss
+
+
+@_export
+def mse_loss(input, label, reduction="mean", name=None):
+    loss = dispatch("mse_loss", {"X": input, "Y": label}, name=name)
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def l1_loss(input, label, reduction="mean", name=None):
+    from ..tensor import math as M
+    loss = M.abs(M.subtract(input, label))
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    loss, _ = dispatch("nll_loss", {"X": input, "Label": label,
+                                    "Weight": weight},
+                       {"ignore_index": ignore_index,
+                        "reduction": reduction}, ["Out", "Total_weight"],
+                       name=name)
+    return loss
+
+
+@_export
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    loss = dispatch("bce_loss", {"X": input, "Label": label}, name=name)
+    if weight is not None:
+        from ..tensor import math as M
+        loss = M.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    loss = dispatch("sigmoid_cross_entropy_with_logits",
+                    {"X": logit, "Label": label},
+                    {"ignore_index": -100, "normalize": False}, name=name)
+    if weight is not None:
+        from ..tensor import math as M
+        loss = M.multiply(loss, weight)
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def kl_div(input, label, reduction="mean", name=None):
+    loss = dispatch("kldiv_loss", {"X": input, "Target": label},
+                    {"reduction": "none"}, name=name)
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    loss = dispatch("huber_loss", {"X": input, "Y": label},
+                    {"delta": float(delta)}, name=name)
+    return _reduce_loss(loss, reduction)
+
+
+@_export
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    from ..tensor import math as M
+    out = M.multiply(M.subtract(other, input), label)
+    out = M.clip(M.add(out, M.scale(out, 0.0) + margin), min=0.0)
+    return _reduce_loss(out, reduction)
+
+
+@_export
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    from ..tensor import math as M
+    p = sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = M.add(M.multiply(p, label),
+                M.multiply(M.scale(p, -1.0, 1.0),
+                           M.scale(label, -1.0, 1.0)))
+    mod = M.pow(M.scale(p_t, -1.0, 1.0), gamma)
+    a_t = M.add(M.scale(label, alpha),
+                M.scale(M.scale(label, -1.0, 1.0), 1 - alpha))
+    loss = M.multiply(M.multiply(a_t, mod), ce)
+    if normalizer is not None:
+        loss = M.divide(loss, normalizer)
+    return _reduce_loss(loss, reduction)
+
+
+# ---------------------------------------------------------------------------
+# shape/pad/vision ops
+# ---------------------------------------------------------------------------
+@_export
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    nd = len(x.shape)
+    if len(pad) == nd * 2:
+        paddings = list(pad)
+    else:
+        # paddle/torch semantics: the pad list applies LAST dim first —
+        # [left, right, top, bottom] pads W by (l, r) then H by (t, b)
+        paddings = [0] * (nd * 2)
+        pairs = list(zip(pad[0::2], pad[1::2]))
+        for j, (lo, hi) in enumerate(pairs):
+            dim = nd - 1 - j
+            paddings[2 * dim] = int(lo)
+            paddings[2 * dim + 1] = int(hi)
+    return dispatch("pad", {"X": x},
+                    {"paddings": paddings, "pad_value": float(value),
+                     "mode": mode}, name=name)
+
+
+@_export
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    op = {"nearest": "nearest_interp_v2", "bilinear": "bilinear_interp_v2",
+          "bicubic": "bicubic_interp_v2", "trilinear": "trilinear_interp",
+          "linear": "linear_interp"}[mode]
+    attrs = {"align_corners": align_corners, "align_mode": align_mode,
+             "data_layout": data_format}
+    if size is not None:
+        size = _pair(size)
+        attrs["out_h"], attrs["out_w"] = int(size[0]), int(size[1])
+    if scale_factor is not None:
+        attrs["scale"] = (float(scale_factor)
+                          if np.isscalar(scale_factor)
+                          else list(scale_factor))
+    return dispatch(op, {"X": x}, attrs, name=name)
+
+
+upsample = interpolate
+_export(interpolate, "upsample")
+
+
+@_export
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return dispatch("pixel_shuffle", {"X": x},
+                    {"upscale_factor": upscale_factor,
+                     "data_format": data_format}, name=name)
+
+
+@_export
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return dispatch("im2sequence", {"X": x},
+                    {"kernels": _pair(kernel_sizes),
+                     "strides": _pair(strides),
+                     "paddings": _pair(paddings, 4)}, name=name)
+
+
+@_export
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return dispatch("grid_sampler", {"X": x, "Grid": grid},
+                    {"mode": mode, "padding_mode": padding_mode,
+                     "align_corners": align_corners}, ["Output"], name=name)
+
+
+@_export
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return dispatch("affine_grid", {"Theta": theta},
+                    {"output_shape": list(out_shape),
+                     "align_corners": align_corners}, ["Output"], name=name)
+
+
+@_export
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    from ..tensor import math as M
+    x1n = normalize(x1, axis=axis, epsilon=eps)
+    x2n = normalize(x2, axis=axis, epsilon=eps)
+    return M.sum(M.multiply(x1n, x2n), axis=axis)
+
+
+@_export
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    from ..tensor import math as M
+    n = label.shape[-1]
+    smoothed = M.scale(label, 1.0 - epsilon)
+    if prior_dist is not None:
+        return M.add(smoothed, M.scale(prior_dist, epsilon))
+    return M.add(smoothed, M.scale(M.scale(label, 0.0), 0.0) +
+                 (epsilon / n))
+
+
+@_export
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    return dispatch("sequence_mask", {"X": x},
+                    {"maxlen": -1 if maxlen is None else int(maxlen),
+                     "out_dtype": convert_dtype(dtype)}, ["Y"], name=name)
+
+
+@_export
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return dispatch("temporal_shift", {"X": x},
+                    {"seg_num": seg_num, "shift_ratio": shift_ratio},
+                    name=name)

@@ -1,0 +1,24 @@
+"""paddle.nn.initializer — 2.0 names over the shared initializer classes
+(analog of python/paddle/nn/initializer/)."""
+from ..static.initializer import (  # noqa: F401
+    Constant, Uniform, Normal, TruncatedNormal, Xavier,
+    XavierInitializer, MSRA, MSRAInitializer, NumpyArrayInitializer,
+    Assign, set_global_initializer,
+)
+
+XavierNormal = XavierInitializer
+
+
+class XavierUniform(XavierInitializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in, fan_out=fan_out)
+
+
+class KaimingNormal(MSRAInitializer):
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=False, fan_in=fan_in)
+
+
+class KaimingUniform(MSRAInitializer):
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in)

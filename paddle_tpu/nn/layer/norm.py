@@ -1,0 +1,231 @@
+"""paddle.nn norm layers (analog of python/paddle/nn/layer/norm.py).
+
+BatchNorm running stats are buffers updated in place by F.batch_norm in
+eager mode; SyncBatchNorm adds a cross-replica mean/var allreduce over the
+data-parallel mesh axis (reference: operators/sync_batch_norm_op.cu via
+ir/sync_batch_norm_pass.cc — here it's one psum inside the kernel's mesh
+context).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...dygraph.layers import Layer
+from ...static.initializer import Constant
+from .. import functional as F
+
+__all__ = ["BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm",
+           "SpectralNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = "NCHW" if data_format in (
+            "NC", "NCL", "NCHW", "NCDHW") else "NHWC"
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        self._mean = self.register_buffer(
+            "_mean", np.zeros([num_features], np.float32))
+        self._variance = self.register_buffer(
+            "_variance", np.ones([num_features], np.float32))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}"
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm(num_channels) (dygraph/nn.py BatchNorm)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False, use_global_stats=False,
+                 **kw):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            from ...tensor._dispatch import dispatch
+            out = dispatch(self._act, {"X": out})
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-device BN: batch statistics are allreduced over the "dp" mesh
+    axis when run under a mesh context; identical to BatchNorm on 1 device."""
+
+    def forward(self, x):
+        from ...tensor._dispatch import dispatch, is_eager
+        attrs = {"momentum": self._momentum, "epsilon": self._epsilon,
+                 "data_format": self._data_format,
+                 "is_test": not self.training}
+        y, mean_out, var_out, _, _ = dispatch(
+            "sync_batch_norm",
+            {"X": x, "Scale": self.weight, "Bias": self.bias,
+             "Mean": self._mean, "Variance": self._variance}, attrs,
+            ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"])
+        if self.training and hasattr(self._mean, "set_value"):
+            self._mean.set_value(mean_out)
+            self._variance.set_value(var_out)
+        return y
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively convert BatchNorm* sublayers to SyncBatchNorm."""
+        out = layer
+        if isinstance(layer, _BatchNormBase) and \
+                not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._buffers = layer._buffers
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if np.isscalar(normalized_shape):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(normalized_shape))
+        self.weight = (self.create_parameter(
+            [n], attr=weight_attr, default_initializer=Constant(1.0))
+            if weight_attr is not False else None)
+        self.bias = (self.create_parameter([n], attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=Constant(1.0))
+            if weight_attr is not False else None)
+        self.bias = (self.create_parameter([num_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.scale = self.create_parameter(
+                [num_features], attr=weight_attr,
+                default_initializer=Constant(1.0))
+            self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.scale = self.bias = None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        from ...tensor._dispatch import dispatch
+        out, _ = dispatch("lrn", {"X": x},
+                          {"n": self.size, "alpha": self.alpha,
+                           "beta": self.beta, "k": self.k},
+                          ["Out", "MidOut"])
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self._dim, self._power_iters, self._eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=None)
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=None)
+
+    def forward(self, weight):
+        from ...tensor._dispatch import dispatch
+        return dispatch("spectral_norm",
+                        {"Weight": weight, "U": self.weight_u,
+                         "V": self.weight_v},
+                        {"dim": self._dim, "power_iters": self._power_iters,
+                         "eps": self._eps})

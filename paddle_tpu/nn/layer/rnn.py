@@ -1,0 +1,303 @@
+"""paddle.nn RNN layers (analog of python/paddle/nn/layer/rnn.py).
+
+The multi-layer LSTM/GRU/SimpleRNN forward runs the single `rnn` kernel
+(ops/kernels/rnn.py), which lowers the whole time loop to one lax.scan —
+XLA-friendly where the reference dispatched a C++ kernel per step.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+
+from ...dygraph.layers import Layer
+from ...static.initializer import Uniform
+from ...tensor._dispatch import dispatch
+from .. import functional as F
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        from ...tensor.creation import full
+        b = batch_ref.shape[0]
+        return full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / _math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        from ...tensor import math as M
+        from ...tensor.linalg import matmul
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        i2h = M.add(matmul(inputs, self.weight_ih, transpose_y=True),
+                    self.bias_ih)
+        h2h = M.add(matmul(pre_h, self.weight_hh, transpose_y=True),
+                    self.bias_hh)
+        h = dispatch(self.activation, {"X": M.add(i2h, h2h)})
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / _math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        from ...tensor import math as M
+        from ...tensor.manipulation import split
+        from ...tensor.linalg import matmul
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        gates = M.add(
+            M.add(matmul(inputs, self.weight_ih, transpose_y=True),
+                  self.bias_ih),
+            M.add(matmul(h, self.weight_hh, transpose_y=True), self.bias_hh))
+        i, f, g, o = split(gates, 4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c_new = M.add(M.multiply(f, c), M.multiply(i, g))
+        h_new = M.multiply(o, F.tanh(c_new))
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / _math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        from ...tensor import math as M
+        from ...tensor.manipulation import split
+        from ...tensor.linalg import matmul
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        x_g = M.add(matmul(inputs, self.weight_ih, transpose_y=True),
+                    self.bias_ih)
+        h_g = M.add(matmul(h, self.weight_hh, transpose_y=True),
+                    self.bias_hh)
+        xz, xr, xc = split(x_g, 3, axis=-1)
+        hz, hr, hc = split(h_g, 3, axis=-1)
+        z = F.sigmoid(M.add(xz, hz))
+        r = F.sigmoid(M.add(xr, hr))
+        c = F.tanh(M.add(xc, M.multiply(r, hc)))
+        h_new = M.add(M.multiply(z, h),
+                      M.multiply(M.scale(z, -1.0, 1.0), c))
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Run a cell over the time axis (python loop in eager; unrolls under
+    trace — use the fused LSTM/GRU classes for long sequences)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import stack, unstack
+        axis = 0 if self.time_major else 1
+        xs = unstack(inputs, axis=axis)
+        if self.is_reverse:
+            xs = xs[::-1]
+        states = initial_states
+        outs = []
+        for x in xs:
+            out, states = self.cell(x, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer fused RNN over the `rnn` kernel (one lax.scan)."""
+
+    _mode: str = None
+    _gate_mult: int = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self._ndir = ndir
+        g = self._gate_mult * hidden_size
+        std = 1.0 / _math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._weights, self._biases = [], []
+        wi = 0
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                w_ih = self.create_parameter([g, in_sz], weight_ih_attr,
+                                             default_initializer=init)
+                w_hh = self.create_parameter([g, hidden_size],
+                                             weight_hh_attr,
+                                             default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{'_r' if d else ''}",
+                                   w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{'_r' if d else ''}",
+                                   w_hh)
+                self._weights.extend([w_ih, w_hh])
+        for layer in range(num_layers):
+            for d in range(ndir):
+                b_ih = self.create_parameter([g], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+                b_hh = self.create_parameter([g], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+                self.add_parameter(f"bias_ih_l{layer}{'_r' if d else ''}",
+                                   b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{'_r' if d else ''}",
+                                   b_hh)
+                self._biases.extend([b_ih, b_hh])
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import transpose
+        from ...tensor.creation import zeros
+        x = inputs if self.time_major else transpose(inputs, [1, 0, 2])
+        t, b = x.shape[0], x.shape[1]
+        n = self.num_layers * self._ndir
+        if initial_states is None:
+            h0 = zeros([n, b, self.hidden_size])
+            states = [h0]
+            if self._mode == "LSTM":
+                states.append(zeros([n, b, self.hidden_size]))
+        else:
+            states = (list(initial_states)
+                      if isinstance(initial_states, (list, tuple))
+                      else [initial_states])
+        outs = dispatch(
+            "rnn",
+            {"Input": x, "PreState": states,
+             "WeightList": self._weights + self._biases},
+            {"mode": self._mode, "hidden_size": self.hidden_size,
+             "num_layers": self.num_layers, "is_bidirec": self.bidirect,
+             "dropout_prob": self.dropout},
+            ["Out", "State", "Reserve", "DropoutState"])
+        out, state = outs[0], outs[1]
+        if not self.time_major:
+            out = transpose(out, [1, 0, 2])
+        if self._mode == "LSTM":
+            return out, (state[0], state[1])
+        return out, state[0]
+
+
+class SimpleRNN(_RNNBase):
+    _gate_mult = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        # instance-level mode so activation="relu" selects RNN_RELU
+        self._mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    _mode = "LSTM"
+    _gate_mult = 4
+
+
+class GRU(_RNNBase):
+    _mode = "GRU"
+    _gate_mult = 3

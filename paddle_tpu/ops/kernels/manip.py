@@ -417,21 +417,32 @@ def eye(ins, attrs, ctx):
                            dtype=np_dtype(attrs.get("dtype", "float32")))}
 
 
-@register_op("linspace", inputs=["Start!", "Stop!", "Num!"], outputs=["Out"],
-             grad=None)
+@register_op("linspace", inputs=["Start?!", "Stop?!", "Num?!"],
+             outputs=["Out"], grad=None)
 def linspace(ins, attrs, ctx):
-    n = int(ins["Num"])
-    return {"Out": jnp.linspace(ins["Start"].reshape(()),
-                                ins["Stop"].reshape(()), n)}
+    if ins.get("Num") is not None:
+        n = int(np.asarray(ins["Num"]).item())
+        s, e = ins["Start"].reshape(()), ins["Stop"].reshape(())
+        return {"Out": jnp.linspace(s, e, n)}
+    dt = np_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.linspace(attrs["start"], attrs["stop"],
+                                int(attrs["num"]), dtype=dt)}
 
 
-@register_op("range", inputs=["Start!", "End!", "Step!"], outputs=["Out"],
+@register_op("range", inputs=["Start?!", "End?!", "Step?!"], outputs=["Out"],
              grad=None)
 def range_op(ins, attrs, ctx):
-    # static variant: values must be host constants (bound at build time)
-    s, e, st = (np.asarray(ins["Start"]).item(), np.asarray(ins["End"]).item(),
-                np.asarray(ins["Step"]).item())
-    return {"Out": jnp.arange(s, e, st, dtype=ins["Start"].dtype)}
+    # bounds come as input tensors (fluid style) or attrs (2.0 arange);
+    # either way they must be host constants (static shapes on TPU)
+    if ins.get("Start") is not None:
+        s = np.asarray(ins["Start"]).item()
+        e = np.asarray(ins["End"]).item()
+        st = np.asarray(ins["Step"]).item()
+        dt = ins["Start"].dtype
+    else:
+        s, e, st = attrs["start"], attrs["end"], attrs["step"]
+        dt = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jnp.arange(s, e, st, dtype=dt)}
 
 
 @register_op("one_hot", inputs=["X!"], outputs=["Out"], grad=None)
@@ -452,17 +463,17 @@ def one_hot_v2(ins, attrs, ctx):
 
 @register_op("arg_max", inputs=["X!"], outputs=["Out"], grad=None)
 def arg_max(ins, attrs, ctx):
-    axis = attrs.get("axis", -1)
-    out = jnp.argmax(ins["X"], axis=axis, keepdims=attrs.get("keepdims",
-                                                             False))
+    x = ins["X"].reshape(-1) if attrs.get("flatten") else ins["X"]
+    axis = attrs.get("axis", -1) if not attrs.get("flatten") else 0
+    out = jnp.argmax(x, axis=axis, keepdims=attrs.get("keepdims", False))
     return {"Out": out.astype(np_dtype(attrs.get("dtype", "int64")))}
 
 
 @register_op("arg_min", inputs=["X!"], outputs=["Out"], grad=None)
 def arg_min(ins, attrs, ctx):
-    axis = attrs.get("axis", -1)
-    out = jnp.argmin(ins["X"], axis=axis, keepdims=attrs.get("keepdims",
-                                                             False))
+    x = ins["X"].reshape(-1) if attrs.get("flatten") else ins["X"]
+    axis = attrs.get("axis", -1) if not attrs.get("flatten") else 0
+    out = jnp.argmin(x, axis=axis, keepdims=attrs.get("keepdims", False))
     return {"Out": out.astype(np_dtype(attrs.get("dtype", "int64")))}
 
 
@@ -500,12 +511,16 @@ def top_k_v2(ins, attrs, ctx):
             "Indices": jnp.moveaxis(idx, -1, axis).astype(jnp.int64)}
 
 
-@register_op("unique", inputs=["X!"], outputs=["Out", "Index"], grad=None)
+@register_op("unique", inputs=["X!"],
+             outputs=["Out", "Indices", "Index", "Counts"], grad=None)
 def unique(ins, attrs, ctx):
-    # data-dependent shape: eager-mode only
-    out, inv = jnp.unique(ins["X"], return_inverse=True)
-    return {"Out": out, "Index": inv.astype(np_dtype(attrs.get("dtype",
-                                                               "int64")))}
+    # data-dependent shape: eager-mode only.  v2 slots: Indices = first
+    # occurrence positions, Index = inverse map, Counts = multiplicities.
+    out, first, inv, cnt = jnp.unique(
+        ins["X"], return_index=True, return_inverse=True, return_counts=True)
+    dt = np_dtype(attrs.get("dtype", "int64"))
+    return {"Out": out, "Indices": first.astype(dt),
+            "Index": inv.astype(dt), "Counts": cnt.astype(dt)}
 
 
 @register_op("unique_with_counts", inputs=["X!"],

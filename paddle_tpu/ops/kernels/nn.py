@@ -296,6 +296,50 @@ def layer_norm(ins, attrs, ctx):
             "Variance": v.reshape(flat)}
 
 
+@register_op("sync_batch_norm",
+             inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+             outputs=["Y", "MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance", "ReserveSpace?"])
+def sync_batch_norm(ins, attrs, ctx):
+    """Cross-replica batch norm (reference:
+    /root/reference/paddle/fluid/operators/sync_batch_norm_op.cu — NCCL
+    allreduce of partial sums).  TPU-native: when traced under a mesh the
+    per-device sums are combined with one psum over the data-parallel axes;
+    degenerates to plain batch_norm on a single device."""
+    x = ins["X"]
+    scale, bias = ins["Scale"], ins["Bias"]
+    mean, var = ins["Mean"], ins["Variance"]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    fmt = attrs.get("data_format", "NCHW")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    caxis = 1 if fmt == "NCHW" and x.ndim > 1 else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    bshape = tuple(x.shape[caxis] if i == caxis else 1 for i in range(x.ndim))
+    xf = x.astype(_cdt(x))
+    if is_test:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        s1 = jnp.sum(xf, axis=axes)
+        s2 = jnp.sum(xf * xf, axis=axes)
+        cnt = float(np.prod([x.shape[i] for i in axes]))
+        mesh_axes = ctx.collective_axes(attrs.get("ring_id", 0))
+        if mesh_axes:
+            s1 = jax.lax.psum(s1, mesh_axes)
+            s2 = jax.lax.psum(s2, mesh_axes)
+            cnt = cnt * jax.lax.psum(1, mesh_axes)
+        m = s1 / cnt
+        v = s2 / cnt - m * m
+        mean_out = mean * momentum + m * (1 - momentum)
+        var_out = var * momentum + v * (1 - momentum)
+    inv = jax.lax.rsqrt(v + eps)
+    y = (xf - m.reshape(bshape)) * inv.reshape(bshape) \
+        * scale.reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": m, "SavedVariance": inv}
+
+
 @register_op("instance_norm", inputs=["X", "Scale?", "Bias?"],
              outputs=["Y", "SavedMean", "SavedVariance"])
 def instance_norm(ins, attrs, ctx):

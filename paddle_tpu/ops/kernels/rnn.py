@@ -161,7 +161,19 @@ def rnn(ins, attrs, ctx):
                     hs = jnp.flip(hs, 0)
                 h_last.append(hT)
                 c_last.append(cT)
-            else:  # GRU / simple RNN
+            elif mode.startswith("RNN"):  # RNN_TANH / RNN_RELU
+                act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+                def step_s(h, g):
+                    h_new = act(g + h @ w_hh.T)
+                    return h_new, h_new
+
+                seq = gates_in if d_ == 0 else jnp.flip(gates_in, 0)
+                hT, hs = jax.lax.scan(step_s, h0, seq)
+                if d_ == 1:
+                    hs = jnp.flip(hs, 0)
+                h_last.append(hT)
+            else:  # GRU
                 def step_g(h, g):
                     zr = g[..., :2 * hidden] + (h @ w_hh.T)[..., :2 * hidden]
                     z = jax.nn.sigmoid(zr[..., :hidden])

@@ -1,0 +1,96 @@
+"""Dual-mode op dispatch for the paddle-2.0 functional surface.
+
+The reference generates one fast C++ entry per op for dygraph
+(/root/reference/paddle/fluid/pybind/op_function_generator.cc) and a Python
+layer function appending OpDescs for static mode
+(/root/reference/python/paddle/fluid/layers/layer_function_generator.py).
+Here ONE helper serves both: eager inputs -> trace_op through the shared
+kernel registry; graph VarDescs -> append an op to the current Program (shape
+/dtype inference is generic via jax.eval_shape, core/infer_shape.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..core.program import VarDesc, default_main_program, unique_name
+from ..dygraph.base import in_dygraph_mode
+from ..dygraph.tensor import Tensor
+
+__all__ = ["dispatch", "is_eager", "wrap_data", "OUT"]
+
+OUT = ("Out",)
+
+
+def _contains(ins, klass) -> bool:
+    for v in ins.values():
+        if isinstance(v, klass):
+            return True
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(t, klass) for t in v):
+            return True
+    return False
+
+
+def is_eager(ins: Dict[str, Any]) -> bool:
+    """Mode resolution: explicit tensor types win over the global flag, so
+    static Programs can be built from inside dygraph code and vice versa."""
+    if _contains(ins, Tensor):
+        return True
+    if _contains(ins, VarDesc):
+        return False
+    return in_dygraph_mode()
+
+
+def wrap_data(x, like=None, dtype=None):
+    """Coerce a python scalar / ndarray operand to the mode-matching type,
+    matching `like`'s dtype so scalar operands don't upcast int/bf16
+    tensors through numpy's float64/int64 defaults."""
+    if isinstance(x, (Tensor, VarDesc)) or x is None:
+        return x
+    if like is not None and isinstance(like, VarDesc):
+        from ..static import layers
+        arr = np.asarray(x, dtype=dtype or (like.dtype if like else None))
+        return layers.assign(arr)
+    if dtype is None and isinstance(like, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(x, dtype=like._value.dtype))
+    return Tensor(np.asarray(x, dtype=dtype))
+
+
+def dispatch(op_type: str, ins: Dict[str, Any],
+             attrs: Optional[Dict[str, Any]] = None,
+             outs: Sequence[str] = OUT, name: Optional[str] = None,
+             out_counts: Optional[Dict[str, int]] = None):
+    """out_counts: for duplicable output slots in STATIC mode, how many vars
+    to create per slot (eager mode learns the count from the kernel)."""
+    attrs = attrs or {}
+    if is_eager(ins):
+        from ..dygraph.tracer import trace_op
+        return trace_op(op_type, ins, attrs, list(outs))
+    # ---- static graph path ----
+    from ..ops.registry import get_op_info
+    info = get_op_info(op_type)
+    block = default_main_program().current_block()
+    out_vars = {}
+    results = []
+    for slot in outs:
+        slot_decl = None if info is None else next(
+            (s for s in info.outputs if s.name == slot), None)
+        if slot_decl is not None and slot_decl.duplicable:
+            n = (out_counts or {}).get(slot, 1)
+            vs = [block.create_var(
+                name=unique_name(name or f"{op_type}.{slot.lower()}"))
+                for _ in range(n)]
+            out_vars[slot] = vs
+            results.append(vs)
+        else:
+            v = block.create_var(
+                name=unique_name(name or f"{op_type}.{slot.lower()}"))
+            out_vars[slot] = v
+            results.append(v)
+    block.append_op(op_type,
+                    inputs={k: v for k, v in ins.items() if v is not None},
+                    outputs=out_vars, attrs=attrs)
+    return results[0] if len(outs) == 1 else tuple(results)

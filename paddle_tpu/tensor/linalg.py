@@ -1,0 +1,83 @@
+"""paddle.tensor linalg ops (dual-mode).
+
+Analog of /root/reference/python/paddle/tensor/linalg.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "norm", "dist", "cholesky",
+    "inverse", "cross", "histogram", "t", "transpose",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return dispatch("matmul_v2", {"X": x, "Y": y},
+                    {"trans_x": bool(transpose_x),
+                     "trans_y": bool(transpose_y)}, name=name)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2, name=name)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", {"X": x, "Y": y}, name=name)
+
+
+def dot(x, y, name=None):
+    return dispatch("dot", {"X": x, "Y": y}, name=name)
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", {"X": x, "Vec": vec}, name=name)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" and axis is None:
+        return dispatch("frobenius_norm", {"X": x},
+                        {"dim": [0], "keep_dim": keepdim, "reduce_all": True},
+                        name=name)
+    if p == "fro":
+        dims = [axis] if np.isscalar(axis) else list(axis)
+        return dispatch("frobenius_norm", {"X": x},
+                        {"dim": dims, "keep_dim": keepdim,
+                         "reduce_all": False}, name=name)
+    porder = float(p) if not isinstance(p, str) else float(p)
+    attrs = {"porder": porder, "keepdim": keepdim, "epsilon": 1e-12}
+    if axis is None:
+        attrs["asvector"] = True
+        attrs["axis"] = 0
+    else:
+        attrs["asvector"] = False
+        attrs["axis"] = int(axis) if np.isscalar(axis) else int(axis[0])
+    return dispatch("p_norm", {"X": x}, attrs, name=name)
+
+
+def dist(x, y, p=2, name=None):
+    return dispatch("dist", {"X": x, "Y": y}, {"p": float(p)}, name=name)
+
+
+def cholesky(x, upper=False, name=None):
+    return dispatch("cholesky", {"X": x}, {"upper": bool(upper)}, name=name)
+
+
+def inverse(x, name=None):
+    return dispatch("inverse", {"Input": x}, {}, ["Output"], name=name)
+
+
+def cross(x, y, axis=None, name=None):
+    return dispatch("cross", {"X": x, "Y": y},
+                    {"dim": -1 if axis is None else int(axis)}, name=name)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return dispatch("histogram", {"X": input},
+                    {"bins": bins, "min": min, "max": max}, name=name)
+
+
+# aliases shared with manipulation
+from .manipulation import t, transpose  # noqa: E402,F401

@@ -1,0 +1,260 @@
+"""paddle.tensor math ops (dual-mode).
+
+Analog of /root/reference/python/paddle/tensor/math.py — same public names,
+dispatching through the shared kernel registry in both eager and static mode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch, wrap_data
+
+__all__ = []  # populated below
+
+
+def _export(fn, name=None):
+    name = name or fn.__name__
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+_UNARY = [
+    "exp", "sqrt", "rsqrt", "abs", "ceil", "floor", "round", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "reciprocal",
+    "square", "sign", "erf", "log", "log2", "log10", "log1p", "sigmoid",
+]
+
+
+def _make_unary(op_type):
+    def fn(x, name=None):
+        return dispatch(op_type, {"X": x}, name=name)
+
+    fn.__name__ = op_type
+    fn.__doc__ = f"Elementwise {op_type} (kernel: ops/kernels)."
+    return fn
+
+
+for _op in _UNARY:
+    _export(_make_unary(_op))
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise (broadcasting)
+# ---------------------------------------------------------------------------
+def _binary(op_type, x, y, name=None):
+    y = wrap_data(y, like=x)
+    x = wrap_data(x, like=y)
+    return dispatch(op_type, {"X": x, "Y": y}, {"axis": -1}, name=name)
+
+
+@_export
+def add(x, y, name=None):
+    return _binary("elementwise_add", x, y, name)
+
+
+@_export
+def subtract(x, y, name=None):
+    return _binary("elementwise_sub", x, y, name)
+
+
+@_export
+def multiply(x, y, name=None):
+    return _binary("elementwise_mul", x, y, name)
+
+
+@_export
+def divide(x, y, name=None):
+    return _binary("elementwise_div", x, y, name)
+
+
+@_export
+def floor_divide(x, y, name=None):
+    return _binary("elementwise_floordiv", x, y, name)
+
+
+@_export
+def remainder(x, y, name=None):
+    return _binary("elementwise_mod", x, y, name)
+
+
+mod = remainder
+_export(remainder, "mod")
+floor_mod = remainder
+_export(remainder, "floor_mod")
+
+
+@_export
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return dispatch("pow", {"X": x}, {"factor": float(y)}, name=name)
+    return _binary("elementwise_pow", x, y, name)
+
+
+@_export
+def maximum(x, y, name=None):
+    return _binary("elementwise_max", x, y, name)
+
+
+@_export
+def minimum(x, y, name=None):
+    return _binary("elementwise_min", x, y, name)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _reduce(op_type, x, axis, keepdim, name=None):
+    attrs = {"keep_dim": bool(keepdim)}
+    if axis is None:
+        attrs["reduce_all"] = True
+        attrs["dim"] = [0]
+    else:
+        attrs["dim"] = [axis] if np.isscalar(axis) else list(axis)
+    return dispatch(op_type, {"X": x}, attrs, name=name)
+
+
+@_export
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = _reduce("reduce_sum", x, axis, keepdim, name)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+@_export
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_mean", x, axis, keepdim, name)
+
+
+@_export
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_max", x, axis, keepdim, name)
+
+
+@_export
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_min", x, axis, keepdim, name)
+
+
+@_export
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = _reduce("reduce_prod", x, axis, keepdim, name)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+@_export
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_all", x, axis, keepdim, name)
+
+
+@_export
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_any", x, axis, keepdim, name)
+
+
+@_export
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    attrs = {"keepdim": bool(keepdim)}
+    if axis is None:
+        attrs["reduce_all"] = True
+        attrs["axis"] = [0]
+    else:
+        attrs["axis"] = [axis] if np.isscalar(axis) else list(axis)
+    return dispatch("logsumexp", {"X": x}, attrs, name=name)
+
+
+# ---------------------------------------------------------------------------
+# other math
+# ---------------------------------------------------------------------------
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = dispatch("scale", {"X": x},
+                   {"scale": float(scale), "bias": float(bias),
+                    "bias_after_scale": bool(bias_after_scale)}, name=name)
+    if act:
+        out = dispatch(act, {"X": out})
+    return out
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    lo = -3.4e38 if min is None else float(min)
+    hi = 3.4e38 if max is None else float(max)
+    return dispatch("clip", {"X": x}, {"min": lo, "max": hi}, name=name)
+
+
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    attrs = {"flatten": axis is None, "axis": int(axis or 0)}
+    out = dispatch("cumsum", {"X": x}, attrs, name=name)
+    if dtype is not None:
+        from .manipulation import cast
+        out = cast(out, dtype)
+    return out
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    return dispatch("increment", {"X": x}, {"step": float(value)}, name=name)
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    return dispatch("multiplex", {"X": list(inputs), "Ids": index},
+                    name=name)
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch("stanh", {"X": x},
+                    {"scale_a": scale_a, "scale_b": scale_b}, name=name)
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch("addmm", {"Input": input, "X": x, "Y": y},
+                    {"Beta": float(beta), "Alpha": float(alpha)}, name=name)
+
+
+@_export
+def kron(x, y, name=None):
+    return dispatch("kron", {"X": x, "Y": y}, name=name)
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch("trace", {"Input": x},
+                    {"offset": offset, "axis1": axis1, "axis2": axis2},
+                    name=name)
+
+
+@_export
+def isfinite(x, name=None):
+    return dispatch("isfinite_v2", {"X": x}, name=name)
+
+
+@_export
+def isinf(x, name=None):
+    return dispatch("isinf_v2", {"X": x}, name=name)
+
+
+@_export
+def isnan(x, name=None):
+    return dispatch("isnan_v2", {"X": x}, name=name)
+
+
+@_export
+def tanh_(x, name=None):
+    out = dispatch("tanh", {"X": x}, name=name)
+    if hasattr(x, "set_value"):
+        x.set_value(out)
+        return x
+    return out

@@ -1,0 +1,49 @@
+"""paddle.tensor stat ops (dual-mode).
+
+Analog of /root/reference/python/paddle/tensor/stat.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._dispatch import dispatch
+from .math import mean, sum as _sum  # noqa: F401 (mean re-exported)
+
+__all__ = ["mean", "std", "var", "numel", "median"]
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    from . import math as m
+    mu = m.mean(x, axis=axis, keepdim=True)
+    sq = m.multiply(m.subtract(x, mu), m.subtract(x, mu))
+    out = m.mean(sq, axis=axis, keepdim=keepdim)
+    if unbiased:
+        if axis is None:
+            n = int(np.prod(x.shape))
+        else:
+            axes = [axis] if np.isscalar(axis) else list(axis)
+            n = int(np.prod([x.shape[a] for a in axes]))
+        if n > 1:
+            out = m.scale(out, scale=n / (n - 1.0))
+    return out
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    from . import math as m
+    return m.sqrt(var(x, axis, unbiased, keepdim))
+
+
+def numel(x, name=None):
+    return dispatch("size", {"Input": x}, name=name)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    # via sort: median = middle element (average of two middles for even n)
+    from ..dygraph.tensor import Tensor
+    import jax.numpy as jnp
+    if isinstance(x, Tensor):
+        from ..dygraph.tracer import trace_jax
+        ax = axis
+        return trace_jax(
+            lambda v: jnp.median(v, axis=ax, keepdims=keepdim), [x], "median")
+    raise NotImplementedError("median is eager-only for now")

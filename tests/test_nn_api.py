@@ -1,0 +1,298 @@
+"""paddle.nn / paddle.tensor 2.0 API tests (dygraph-first).
+
+Mirrors the reference's test_layers.py / imperative layer tests; numerics
+checked against numpy/jax.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.dygraph import to_tensor
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_linear_layer():
+    lin = nn.Linear(8, 4)
+    x = to_tensor(_rand(2, 8))
+    out = lin(x)
+    assert out.shape == [2, 4]
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv_bn_pool_stack():
+    m = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1),
+        nn.BatchNorm2D(8),
+        nn.ReLU(),
+        nn.MaxPool2D(2),
+    )
+    x = to_tensor(_rand(2, 3, 8, 8))
+    out = m(x)
+    assert out.shape == [2, 8, 4, 4]
+    # running stats updated in train mode
+    bn = m[1]
+    assert abs(float(bn._mean.numpy().sum())) > 0
+
+
+def test_batchnorm_train_eval_modes():
+    bn = nn.BatchNorm1D(4)
+    x = to_tensor(_rand(16, 4, seed=3) * 5 + 2)
+    y_train = bn(x)
+    np.testing.assert_allclose(y_train.numpy().mean(axis=0), 0.0, atol=1e-4)
+    bn.eval()
+    y_eval = bn(x)
+    # eval uses running stats, not batch stats
+    assert abs(y_eval.numpy().mean()) > 1e-3
+
+
+def test_layernorm_vs_numpy():
+    ln = nn.LayerNorm(6)
+    x = to_tensor(_rand(3, 6, seed=1))
+    out = ln(x).numpy()
+    xn = x.numpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / np.sqrt(
+        xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = to_tensor(np.array([[1, 2, 0]], dtype=np.int64))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(4), atol=1e-7)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = to_tensor(np.ones((100,), np.float32))
+    y = d(x)
+    assert (y.numpy() == 0).sum() > 10
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_cross_entropy_loss():
+    logits = to_tensor(_rand(4, 5))
+    labels = to_tensor(np.array([[1], [2], [3], [0]], dtype=np.int64))
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    # numpy reference
+    z = logits.numpy()
+    z = z - z.max(-1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    ref = -logp[np.arange(4), labels.numpy().ravel()].mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_mse_and_l1():
+    a, b = to_tensor(_rand(3, 3)), to_tensor(_rand(3, 3, seed=5))
+    np.testing.assert_allclose(
+        float(nn.MSELoss()(a, b)),
+        ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(nn.L1Loss()(a, b)),
+        np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-5)
+
+
+def test_activations_numerics():
+    x = to_tensor(_rand(10))
+    np.testing.assert_allclose(F.relu(x).numpy(),
+                               np.maximum(x.numpy(), 0), rtol=1e-6)
+    np.testing.assert_allclose(F.sigmoid(x).numpy(),
+                               1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    import math
+    np.testing.assert_allclose(F.gelu(x).numpy(),
+                               0.5 * x.numpy() * (1 + np.vectorize(
+                                   lambda v: math.erf(v / math.sqrt(2)))(
+                                   x.numpy())), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layer_shapes():
+    lstm = nn.LSTM(input_size=6, hidden_size=8, num_layers=2)
+    x = to_tensor(_rand(2, 5, 6))  # [batch, time, feat]
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]  # [num_layers*ndir, batch, hidden]
+    assert c.shape == [2, 2, 8]
+
+
+def test_gru_and_simple_rnn():
+    gru = nn.GRU(4, 6)
+    out, h = gru(to_tensor(_rand(3, 7, 4)))
+    assert out.shape == [3, 7, 6]
+    rnn = nn.SimpleRNN(4, 6)
+    out, h = rnn(to_tensor(_rand(3, 7, 4)))
+    assert out.shape == [3, 7, 6]
+
+
+def test_lstm_cell_matches_fused_single_step():
+    cell = nn.LSTMCell(4, 4)
+    x = to_tensor(_rand(2, 4))
+    out, (h, c) = cell(x)
+    assert out.shape == [2, 4]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = to_tensor(_rand(2, 5, 16))
+    out = mha(q, q, q)
+    assert out.shape == [2, 5, 16]
+
+
+def test_transformer_encoder_backward():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = to_tensor(_rand(2, 6, 16))
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    out.mean().backward()
+    grads = [p.gradient() for p in enc.parameters()]
+    assert sum(g is not None for g in grads) == len(grads)
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = to_tensor(_rand(2, 4, 16))
+    tgt = to_tensor(_rand(2, 3, 16, seed=2))
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_sync_batch_norm_single_device():
+    sbn = nn.SyncBatchNorm(4)
+    x = to_tensor(_rand(8, 4, 2, 2))
+    y = sbn(x)
+    np.testing.assert_allclose(
+        y.numpy().mean(axis=(0, 2, 3)), 0.0, atol=1e-4)
+
+
+def test_convert_sync_batchnorm():
+    m = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+    m2 = nn.SyncBatchNorm.convert_sync_batchnorm(m)
+    assert isinstance(m2[1], nn.SyncBatchNorm)
+
+
+def test_conv_transpose():
+    m = nn.Conv2DTranspose(4, 3, 2, stride=2)
+    x = to_tensor(_rand(1, 4, 5, 5))
+    assert m(x).shape == [1, 3, 10, 10]
+
+
+def test_interpolate_and_pixel_shuffle():
+    x = to_tensor(_rand(1, 4, 4, 4))
+    assert F.interpolate(x, size=[8, 8], mode="nearest").shape == \
+        [1, 4, 8, 8]
+    assert F.pixel_shuffle(x, 2).shape == [1, 1, 8, 8]
+
+
+def test_functional_losses():
+    logit = to_tensor(_rand(4))
+    label = to_tensor((np.random.RandomState(1).rand(4) > 0.5)
+                      .astype(np.float32))
+    l1 = F.binary_cross_entropy_with_logits(logit, label)
+    p = 1 / (1 + np.exp(-logit.numpy()))
+    ref = -(label.numpy() * np.log(p) +
+            (1 - label.numpy()) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(float(l1), ref, rtol=1e-4)
+
+
+def test_nn_training_convergence():
+    paddle.seed(42)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    x = to_tensor(_rand(32, 4, seed=7))
+    y = to_tensor((_rand(32, 4, seed=7)[:, :1] * 2 + 1))
+    losses = []
+    for _ in range(80):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        with paddle.no_grad():
+            for p in net.parameters():
+                p.set_value(p._value - 0.05 * p.grad_._value)
+        net.clear_gradients()
+        losses.append(float(loss))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_bn_buffers_in_state_dict():
+    bn = nn.BatchNorm2D(4)
+    sd = bn.state_dict()
+    assert "_mean" in sd and "_variance" in sd
+    assert len(bn.buffers()) == 2
+
+
+def test_unstack_default_and_generic_rnn():
+    x = to_tensor(_rand(2, 3, 4))
+    parts = paddle.unstack(x, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 4]
+    cell = nn.GRUCell(4, 5)
+    rnn = nn.RNN(cell)
+    out, h = rnn(to_tensor(_rand(2, 3, 4)))
+    assert out.shape == [2, 3, 5]
+
+
+def test_pad_last_dim_first():
+    x = to_tensor(_rand(1, 1, 2, 3))
+    out = F.pad(x, [1, 1, 0, 0])  # pads W only
+    assert out.shape == [1, 1, 2, 5]
+    out2 = F.pad(x, [0, 0, 2, 1])  # pads H only
+    assert out2.shape == [1, 1, 5, 3]
+
+
+def test_conv_bias_nhwc():
+    x = to_tensor(_rand(1, 4, 4, 3))
+    w = to_tensor(_rand(8, 3, 3, 3, seed=2))
+    b = to_tensor(_rand(8, seed=3))
+    out = F.conv2d(x, w, b, data_format="NHWC")
+    assert out.shape[-1] == 8
+
+
+def test_simple_rnn_relu_mode():
+    rnn = nn.SimpleRNN(3, 4, activation="relu")
+    assert rnn._mode == "RNN_RELU"
+    out, _ = rnn(to_tensor(_rand(2, 5, 3)))
+    assert (out.numpy() >= 0).all()
+
+
+def test_gumbel_softmax_hard_axis():
+    x = to_tensor(_rand(2, 3, 4))
+    y = F.gumbel_softmax(x, hard=True, axis=1)
+    assert y.shape == [2, 3, 4]
+    s = y.numpy().sum(axis=1)
+    np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
+
+
+def test_grad_after_freed_graph_raises():
+    x = to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = (x * 2.0).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        paddle.grad(y, x)
+
+
+def test_cross_entropy_with_weight():
+    logits = to_tensor(_rand(4, 3))
+    labels = to_tensor(np.array([[0], [1], [2], [1]], dtype=np.int64))
+    w = to_tensor(np.array([1.0, 2.0, 0.5], np.float32))
+    loss = F.cross_entropy(logits, labels, weight=w)
+    z = logits.numpy()
+    z = z - z.max(-1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(-1, keepdims=True))
+    li = -logp[np.arange(4), labels.numpy().ravel()]
+    wi = w.numpy()[labels.numpy().ravel()]
+    np.testing.assert_allclose(float(loss), (li * wi).sum() / wi.sum(),
+                               rtol=1e-5)
+
+
+def test_scalar_operand_keeps_dtype():
+    xi = to_tensor(np.array([1, 2], dtype=np.int32))
+    assert paddle.add(xi, 1).dtype == "int32"
