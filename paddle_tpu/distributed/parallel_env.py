@@ -1,0 +1,59 @@
+"""ParallelEnv — the PADDLE_* launcher env contract.
+
+Reference: /root/reference/python/paddle/fluid/dygraph/parallel.py ParallelEnv
+(rank/world/endpoints from PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS — the contract set by
+distributed/fleet/launch.py:198 launch_collective).
+
+TPU mapping: one launched process per host of a TPU slice; within a process
+all local chips are driven by a single jax client, so `device_id` is kept for
+API parity but local parallelism comes from the mesh, not from one process
+per chip.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ParallelEnv"]
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.environ.get("FLAGS_selected_xlas",
+                              os.environ.get("FLAGS_selected_gpus", "0"))
+                              .split(",")[0])
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = [e for e in eps.split(",") if e]
+        self._nrings = int(os.environ.get("FLAGS_nccl_nrings", "1"))
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def nrings(self):
+        return self._nrings
+
+    # legacy aliases (parallel.py exposes local_rank/nranks)
+    local_rank = rank
+    nranks = world_size
+    dev_id = device_id

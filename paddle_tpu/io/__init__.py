@@ -1,1 +1,17 @@
-
+"""paddle.io — datasets, samplers, DataLoader, and checkpoint IO."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .generator_loader import GeneratorLoader  # noqa: F401
+from .framework_io import (  # noqa: F401
+    save, load, save_vars, save_params, save_persistables, load_vars,
+    load_params, load_persistables, save_inference_model,
+    load_inference_model, save_dygraph, load_dygraph, is_persistable,
+    static_save, static_load, set_program_state,
+)

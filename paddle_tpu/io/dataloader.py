@@ -1,0 +1,248 @@
+"""DataLoader — host-side input pipeline.
+
+Reference: /root/reference/python/paddle/fluid/reader.py:147 DataLoader and
+/root/reference/python/paddle/fluid/dataloader/dataloader_iter.py (worker
+processes + blocking queue + ParentWatchDog).
+
+TPU-native design notes:
+  * The device feed is one host→device transfer of an already-collated,
+    statically-shaped numpy batch per step — there is no per-op feed path to
+    overlap with, so the pipeline's job is only to keep batches ready on the
+    host.  A multiprocessing pool (fork) prepares batches ahead of time and a
+    prefetch thread keeps a bounded queue full (the reference's
+    _reader_process_loop + LoDTensorBlockingQueue collapse into this).
+  * Batches are numpy; in dygraph mode they are wrapped as eager Tensors.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into a batch (field-wise for tuple samples)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        return [default_collate_fn(list(fields))
+                for fields in zip(*batch)]
+    # paddle/jax tensors and anything array-like
+    try:
+        return np.stack([np.asarray(s) for s in batch], axis=0)
+    except Exception:
+        return batch
+
+
+def _fetch_batch(args):
+    # module-level so it pickles for the worker pool
+    dataset, indices, collate = args
+    return collate([dataset[i] for i in indices])
+
+
+class _PrefetchIterator:
+    """Wraps an iterator with a bounded background-thread prefetch queue.
+
+    close() (also called on abandonment via __del__ and on exhaustion)
+    unblocks and stops the filler thread and closes the underlying
+    generator, so early `break` from an epoch doesn't leak threads or
+    worker pools."""
+
+    _DONE = object()
+
+    def __init__(self, it, depth=2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._err = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._fill, args=(it,),
+                                        daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self, it):
+        try:
+            for item in it:
+                if not self._put(item):
+                    break
+        except BaseException as e:  # propagate to consumer
+            self._err = e
+        finally:
+            if hasattr(it, "close"):  # run abandoned generators' finally
+                try:
+                    it.close()
+                except Exception:
+                    pass
+            self._put(self._DONE)
+
+    def close(self):
+        self._stop.set()
+        try:  # drain so a blocked filler can observe the stop flag
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            self.close()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 use_shared_memory: bool = True, timeout: int = 0,
+                 worker_init_fn: Optional[Callable] = None):
+        self.dataset = dataset
+        self.feed_list = feed_list
+        self.places = places
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            if batch_sampler is not None:
+                raise ValueError("batch_sampler not supported for "
+                                 "IterableDataset")
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+            self.drop_last = batch_sampler.drop_last
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size required without batch_sampler")
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    # -- iteration ----------------------------------------------------------
+    def _wrap(self, batch):
+        from ..dygraph.base import in_dygraph_mode
+        if in_dygraph_mode() and self.return_list:
+            from ..dygraph.tensor import Tensor
+
+            def to_t(x):
+                if isinstance(x, np.ndarray):
+                    return Tensor(x)
+                if isinstance(x, dict):
+                    return {k: to_t(v) for k, v in x.items()}
+                if isinstance(x, list):
+                    return [to_t(v) for v in x]
+                return x
+
+            return to_t(batch)
+        return batch
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            samples = list(itertools.islice(it, self.batch_size))
+            if not samples:
+                return
+            if len(samples) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(samples)
+
+    def _iter_map_sync(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_map_workers(self):
+        # Thread pool, not fork: the jax runtime is multithreaded and fork
+        # deadlocks; numpy/IO release the GIL so host-side batch prep still
+        # overlaps.  (The reference forks worker *processes* because its
+        # transforms are GIL-bound Python — dataloader_iter.py.)
+        from multiprocessing.dummy import Pool
+        pool = Pool(self.num_workers, initializer=self.worker_init_fn)
+        try:
+            args = ((self.dataset, indices, self.collate_fn)
+                    for indices in self.batch_sampler)
+            for batch in pool.imap(_fetch_batch, args):
+                yield batch
+        finally:
+            pool.terminate()
+            pool.join()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            it = self._iter_iterable()
+        elif self.num_workers > 0:
+            it = self._iter_map_workers()
+        else:
+            it = self._iter_map_sync()
+        if not self.use_buffer_reader:
+            yield from (self._wrap(b) for b in it)
+            return
+        pf = _PrefetchIterator(it, depth=2 + self.num_workers)
+        try:
+            for batch in pf:
+                yield self._wrap(batch)
+        finally:  # consumer broke out early: stop filler, close workers
+            pf.close()
+
+    # -- legacy fluid constructors (reader.py:434/:685) ---------------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        from .generator_loader import GeneratorLoader
+        return GeneratorLoader(feed_list=feed_list, capacity=capacity,
+                               iterable=iterable, return_list=return_list,
+                               drop_last=drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        raise NotImplementedError(
+            "from_dataset targets the C++ Dataset path; use "
+            "paddle_tpu.distributed.InMemoryDataset")

@@ -1,0 +1,288 @@
+"""Checkpoint & model serialization (P19 parity).
+
+Reference:
+  /root/reference/python/paddle/fluid/io.py:224-598 save_vars/save_params/
+  save_persistables, :1164 save_inference_model, :1374 load_inference_model,
+  :1669/:1730 2.0 save/load (.pdmodel/.pdparams/.pdopt);
+  /root/reference/python/paddle/fluid/dygraph/checkpoint.py save_dygraph;
+  /root/reference/paddle/fluid/framework/save_load_util.cc (tensor format).
+
+Formats (TPU build):
+  * per-var file      : raw np.save (.npy payload under the var's name)
+  * combined file     : np.savez archive keyed by var name
+  * program file      : Program.serialize_to_string (JSON, versioned)
+  * 2.0 prefix        : <prefix>.pdmodel / .pdparams / .pdopt where the
+                        param/opt files are pickled {name: ndarray} dicts.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["save", "load", "save_vars", "save_params", "save_persistables",
+           "load_vars", "load_params", "load_persistables",
+           "save_inference_model", "load_inference_model",
+           "save_dygraph", "load_dygraph", "is_persistable",
+           "static_save", "static_load", "set_program_state"]
+
+_OPT_SUFFIXES = ("_moment1", "_moment2", "_beta1_pow", "_beta2_pow",
+                 "_velocity", "_mean_square", "_mean_grad", "_accum",
+                 "@master")
+
+
+def _to_numpy(x):
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def _tree_to_numpy(obj):
+    if isinstance(obj, dict):
+        return type(obj)((k, _tree_to_numpy(v)) for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_numpy(v) for v in obj)
+    if hasattr(obj, "numpy") or isinstance(obj, np.ndarray):
+        return _to_numpy(obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save — pickle an object tree with tensors lowered to numpy."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_tree_to_numpy(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=True):
+    """paddle.load — inverse of save; arrays come back as numpy (feed them
+    to set_state_dict, which wraps as needed)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+# ---------------------------------------------------------------------------
+# fluid-style static save/load over a Scope
+# ---------------------------------------------------------------------------
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def _is_parameter(var) -> bool:
+    return is_persistable(var) and bool(
+        getattr(var, "is_parameter", False) or
+        getattr(var, "trainable", False))
+
+
+def _resolve(executor, main_program, predicate, vars):
+    from ..core.program import default_main_program
+    prog = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in prog.list_vars() if predicate(v)]
+    return prog, vars
+
+
+def _scope_of(executor):
+    from ..static.executor import global_scope
+    return global_scope()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    prog, vars = _resolve(executor, main_program,
+                          predicate or is_persistable, vars)
+    scope = _scope_of(executor)
+    os.makedirs(dirname, exist_ok=True)
+    values = OrderedDict()
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            raise RuntimeError(f"variable {v.name!r} has no value in scope "
+                               "(run the startup program first)")
+        values[v.name] = _to_numpy(val)
+    if filename is None:
+        for name, val in values.items():
+            np.save(os.path.join(dirname, name + ".npy"), val)
+    else:
+        # write through a file object so np.savez can't append '.npz' and
+        # break the save→load filename round-trip
+        with open(os.path.join(dirname, filename), "wb") as f:
+            np.savez(f, **values)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    prog, vars = _resolve(executor, main_program,
+                          predicate or is_persistable, vars)
+    scope = _scope_of(executor)
+    if filename is not None:
+        archive = np.load(os.path.join(dirname, filename))
+        src = {k: archive[k] for k in archive.files}
+    else:
+        src = None
+    for v in vars:
+        if src is not None:
+            if v.name not in src:
+                raise KeyError(f"{v.name!r} missing from {filename}")
+            val = src[v.name]
+        else:
+            p = os.path.join(dirname, v.name + ".npy")
+            if not os.path.exists(p):
+                raise FileNotFoundError(p)
+            val = np.load(p)
+        scope.set(v.name, jnp.asarray(val))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=is_persistable, filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# inference model (io.py:1164/:1374)
+# ---------------------------------------------------------------------------
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    import copy
+    from ..core.program import default_main_program, OpRole
+    prog = main_program or default_main_program()
+    fetch_names = [t.name if hasattr(t, "name") else str(t)
+                   for t in target_vars]
+    # strip training-only ops (backward/optimize/lr-sched) before pruning —
+    # _prune alone would keep optimizer ops because they write persistables
+    # (reference: clone(for_test) + prune_backward, io.py:1164)
+    fwd = copy.deepcopy(prog)
+    blk = fwd.global_block()
+    train_roles = (OpRole.Backward, OpRole.Optimize, OpRole.LRSched,
+                   OpRole.Optimize | OpRole.LRSched)
+    blk.ops = [op for op in blk.ops
+               if op.attrs.get(OpRole.KEY, OpRole.Forward) not in train_roles]
+    pruned = fwd._prune(fetch_names)
+    inference = pruned.clone(for_test=True)
+    inference._feed_names = list(feeded_var_names)
+    inference._fetch_names = fetch_names
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    payload = {"program": inference.to_dict(),
+               "feed_names": list(feeded_var_names),
+               "fetch_names": fetch_names}
+    import json
+    with open(model_path, "w") as f:
+        json.dump(payload, f, sort_keys=True)
+    if not program_only:
+        save_persistables(executor, dirname, inference,
+                          filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import json
+    from ..core.program import Program
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        payload = json.load(f)
+    prog = Program.parse_from_string(
+        json.dumps(payload["program"]).encode())
+    feed_names = payload["feed_names"]
+    fetch_names = payload["fetch_names"]
+    load_persistables(executor, dirname, prog, filename=params_filename)
+    block = prog.global_block()
+    fetch_targets = [block.var(n) for n in fetch_names]
+    return prog, feed_names, fetch_targets
+
+
+# ---------------------------------------------------------------------------
+# 2.0 static save/load (.pdmodel/.pdparams/.pdopt — io.py:1669/:1730)
+# ---------------------------------------------------------------------------
+def _split_param_opt(program, scope):
+    params, opts = OrderedDict(), OrderedDict()
+    param_names = {v.name for v in program.all_parameters()}
+    for v in program.list_vars():
+        if not is_persistable(v):
+            continue
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        (params if v.name in param_names else opts)[v.name] = _to_numpy(val)
+    return params, opts
+
+
+def static_save(program, path_prefix, executor=None):
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    from ..static.executor import global_scope
+    scope = global_scope()
+    params, opts = _split_param_opt(program, scope)
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=4)
+    with open(path_prefix + ".pdopt", "wb") as f:
+        pickle.dump(opts, f, protocol=4)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def set_program_state(program, state):
+    """Write a {name: ndarray} dict into the global scope for `program`."""
+    import jax.numpy as jnp
+    from ..static.executor import global_scope
+    scope = global_scope()
+    names = {v.name for v in program.list_vars() if is_persistable(v)}
+    for name, val in state.items():
+        if name in names:
+            scope.set(name, jnp.asarray(val))
+
+
+def static_load(program, path_prefix, executor=None):
+    for suffix in (".pdparams", ".pdopt"):
+        p = path_prefix + suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                set_program_state(program, pickle.load(f))
+
+
+# ---------------------------------------------------------------------------
+# dygraph checkpoint (fluid/dygraph/checkpoint.py)
+# ---------------------------------------------------------------------------
+def save_dygraph(state_dict, model_path):
+    suffix = ".pdparams"
+    if any(k.endswith(s) for s in _OPT_SUFFIXES
+           for k in state_dict) or "LR_Scheduler" in state_dict:
+        suffix = ".pdopt"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    params = opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    if params is None and opt is None and os.path.exists(model_path):
+        params = load(model_path)
+    return params, opt

@@ -1,0 +1,118 @@
+"""GeneratorLoader — the fluid py_reader/from_generator path.
+
+Reference: /root/reference/python/paddle/fluid/reader.py:997 GeneratorLoader
+(feeds a LoDTensorBlockingQueue consumed by read ops).  TPU design: there is
+no in-graph reader op — the loader simply produces feed dicts keyed by the
+feed_list var names; the executor's whole-block jit consumes one feed per
+step.  A bounded prefetch thread stands in for the blocking queue.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .dataloader import _PrefetchIterator, default_collate_fn
+
+__all__ = ["GeneratorLoader"]
+
+
+class GeneratorLoader:
+    def __init__(self, feed_list=None, capacity=16, iterable=True,
+                 return_list=False, drop_last=True):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self.iterable = iterable
+        self.return_list = return_list
+        self.drop_last = drop_last
+        self._gen: Optional[Callable] = None
+        self._batched = False
+        self._places = None
+        self._batch_size = None
+
+    def _names(self) -> List[str]:
+        return [v.name if hasattr(v, "name") else str(v)
+                for v in self.feed_list]
+
+    # -- reference API: three generator granularities -----------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        """reader yields one flat sample tuple per call."""
+        self._batch_size = batch_size
+        self.drop_last = drop_last
+        self._places = places
+
+        def batched():
+            batch = []
+            for sample in reader():
+                batch.append(sample)
+                if len(batch) == batch_size:
+                    yield default_collate_fn(batch)
+                    batch = []
+            if batch and not drop_last:
+                yield default_collate_fn(batch)
+
+        self._gen = batched
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        """reader yields a list of sample tuples (one batch) per call."""
+        self._places = places
+
+        def batched():
+            for samples in reader():
+                yield default_collate_fn(list(samples))
+
+        self._gen = batched
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        """reader yields already-batched field arrays per call."""
+        self._places = places
+
+        def batched():
+            for fields in reader():
+                if isinstance(fields, dict):
+                    yield fields
+                else:
+                    yield [np.asarray(f) for f in fields]
+
+        self._gen = batched
+        return self
+
+    # -- consumption --------------------------------------------------------
+    def _feed_iter(self):
+        if self._gen is None:
+            raise RuntimeError("no generator set; call set_*_generator first")
+        names = self._names()
+        for fields in self._gen():
+            if isinstance(fields, dict):
+                yield fields
+            else:
+                if len(names) != len(fields):
+                    raise ValueError(
+                        f"feed_list has {len(names)} vars but generator "
+                        f"produced {len(fields)} fields")
+                yield dict(zip(names, fields))
+
+    def __iter__(self):
+        if not self.iterable:
+            raise RuntimeError("loader built with iterable=False; use "
+                               "start()/reset() with executor feed")
+        it = _PrefetchIterator(self._feed_iter(), depth=self.capacity)
+        if self.return_list:
+            return (list(d.values()) for d in it)
+        return iter(it)
+
+    # non-iterable (start/reset) mode: executor pulls via next_feed()
+    def start(self):
+        self._pending = _PrefetchIterator(self._feed_iter(),
+                                          depth=self.capacity)
+
+    def reset(self):
+        self._pending = None
+
+    def next_feed(self):
+        if getattr(self, "_pending", None) is None:
+            raise RuntimeError("call start() first")
+        return next(self._pending)

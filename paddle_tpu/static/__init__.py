@@ -30,3 +30,14 @@ from .optimizer import (  # noqa: F401
     Lamb, ExponentialMovingAverage, L1Decay, L2Decay, GradientClipByValue,
     GradientClipByNorm, GradientClipByGlobalNorm,
 )
+
+from ..io.framework_io import (  # noqa: F401
+    save_vars, save_params, save_persistables, load_vars, load_params,
+    load_persistables, save_inference_model, load_inference_model,
+    set_program_state,
+)
+from ..io.framework_io import static_save as save  # noqa: F401
+from ..io.framework_io import static_load as load  # noqa: F401
+from ..distributed.compiled_program import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+)

@@ -1,0 +1,308 @@
+"""paddle.io tests — datasets, samplers, DataLoader, save/load.
+
+Modeled on the reference's dataloader unittests
+(/root/reference/python/paddle/fluid/tests/unittests/test_batch_sampler.py,
+ test_dataset*.py, test_static_save_load.py) translated to the TPU build.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io as pio
+
+
+class RangeDataset(pio.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.asarray([i, i * 2], dtype=np.float32), np.asarray(
+            i % 3, dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+class StreamDataset(pio.IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset_and_subset():
+    xs = np.arange(20).reshape(10, 2).astype(np.float32)
+    ys = np.arange(10).astype(np.int64)
+    ds = pio.TensorDataset([xs, ys])
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert (x == xs[3]).all() and y == 3
+    sub = pio.Subset(ds, [1, 4])
+    assert len(sub) == 2 and sub[1][1] == 4
+    a, b = pio.random_split(ds, [7, 3], generator=0)
+    assert len(a) == 7 and len(b) == 3
+    seen = sorted(a.indices + b.indices)
+    assert seen == list(range(10))
+
+
+def test_compose_chain_concat():
+    d1, d2 = RangeDataset(5), RangeDataset(5)
+    comp = pio.ComposeDataset([d1, d2])
+    s = comp[2]
+    assert len(s) == 4
+    cat = pio.ConcatDataset([d1, d2])
+    assert len(cat) == 10
+    assert (cat[7][0] == d2[2][0]).all()
+    chain = pio.ChainDataset([StreamDataset(3), StreamDataset(2)])
+    assert [float(x) for x in chain] == [0, 1, 2, 0, 1]
+
+
+def test_samplers():
+    ds = RangeDataset(10)
+    assert list(pio.SequenceSampler(ds)) == list(range(10))
+    r = list(pio.RandomSampler(ds))
+    assert sorted(r) == list(range(10))
+    w = list(pio.WeightedRandomSampler([0.0, 1.0, 0.0], 5))
+    assert w == [1] * 5
+    bs = pio.BatchSampler(ds, batch_size=3, drop_last=False)
+    batches = list(bs)
+    assert len(bs) == 4 and len(batches) == 4
+    assert [len(b) for b in batches] == [3, 3, 3, 1]
+    bs2 = pio.BatchSampler(ds, batch_size=3, drop_last=True)
+    assert len(list(bs2)) == 3 == len(bs2)
+
+
+def test_distributed_batch_sampler():
+    ds = RangeDataset(10)
+    all_idx = []
+    for rank in range(4):
+        s = pio.DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                        rank=rank)
+        batches = list(s)
+        assert len(batches) == len(s)
+        all_idx.extend(i for b in batches for i in b)
+    # every sample covered; padded to equal share per rank
+    assert set(all_idx) == set(range(10)) and len(all_idx) == 12
+    # shuffle must be identical across ranks per epoch
+    s0 = pio.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0,
+                                     shuffle=True)
+    s1 = pio.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1,
+                                     shuffle=True)
+    s0.set_epoch(5), s1.set_epoch(5)
+    i0 = {i for b in s0 for i in b}
+    i1 = {i for b in s1 for i in b}
+    assert i0 | i1 == set(range(10)) and not (i0 & i1 - set(range(10)))
+
+
+def test_dataloader_map_style():
+    ds = RangeDataset(10)
+    dl = pio.DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert np.asarray(x).shape == (4, 2) and np.asarray(y).shape == (4,)
+    x_last = np.asarray(batches[-1][0])
+    assert x_last.shape == (2, 2)
+
+
+def test_dataloader_shuffle_covers_all():
+    ds = RangeDataset(12)
+    dl = pio.DataLoader(ds, batch_size=3, shuffle=True)
+    ys = np.concatenate([np.asarray(y) for _, y in dl])
+    assert ys.shape == (12,)
+
+
+def test_dataloader_workers():
+    ds = RangeDataset(9)
+    dl = pio.DataLoader(ds, batch_size=2, num_workers=2)
+    batches = list(dl)
+    first = np.concatenate([np.asarray(x)[:, 0] for x, _ in batches])
+    assert sorted(first.tolist()) == list(range(9))
+
+
+def test_dataloader_iterable_dataset():
+    dl = pio.DataLoader(StreamDataset(7), batch_size=3, drop_last=False)
+    sizes = [np.asarray(b).shape[0] for b in dl]
+    assert sizes == [3, 3, 1]
+
+
+def test_generator_loader():
+    gl = pio.GeneratorLoader(feed_list=["x", "y"], iterable=True)
+
+    def sample_gen():
+        for i in range(6):
+            yield (np.full((2,), i, np.float32), np.int64(i))
+
+    gl.set_sample_generator(sample_gen, batch_size=2)
+    feeds = list(gl)
+    assert len(feeds) == 3
+    assert set(feeds[0]) == {"x", "y"}
+    assert feeds[0]["x"].shape == (2, 2)
+
+    gl2 = pio.GeneratorLoader(feed_list=["x"], iterable=True)
+    gl2.set_batch_generator(lambda: iter([[np.zeros((4, 2), np.float32)]]))
+    (f,) = list(gl2)
+    assert f["x"].shape == (4, 2)
+
+
+def test_save_load_object(tmp_path):
+    obj = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "meta": {"step": 7}}
+    p = str(tmp_path / "ckpt" / "obj.pdparams")
+    pio.save(obj, p)
+    back = pio.load(p)
+    assert (back["w"] == obj["w"]).all() and back["meta"]["step"] == 7
+
+
+def _build_linear_prog():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.fc(x, 3)
+        loss = layers.mean(y)
+    return main, startup, loss
+
+
+def test_static_save_load_params(tmp_path):
+    import paddle_tpu.static as static
+    main, startup, loss = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    from paddle_tpu.static.executor import global_scope
+    w_name = main.all_parameters()[0].name
+    orig = np.asarray(global_scope().get(w_name))
+
+    d = str(tmp_path / "params")
+    pio.save_params(exe, d, main)
+    global_scope().set(w_name, np.zeros_like(orig))
+    pio.load_params(exe, d, main)
+    assert np.allclose(np.asarray(global_scope().get(w_name)), orig)
+
+    # combined-file format
+    pio.save_persistables(exe, d, main, filename="all.npz")
+    global_scope().set(w_name, np.zeros_like(orig))
+    pio.load_persistables(exe, d, main, filename="all.npz")
+    assert np.allclose(np.asarray(global_scope().get(w_name)), orig)
+
+
+def test_static_save_load_prefix(tmp_path):
+    import paddle_tpu.static as static
+    main, startup, loss = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    from paddle_tpu.static.executor import global_scope
+    w_name = main.all_parameters()[0].name
+    orig = np.asarray(global_scope().get(w_name))
+    prefix = str(tmp_path / "model" / "final")
+    pio.static_save(main, prefix)
+    assert os.path.exists(prefix + ".pdmodel")
+    global_scope().set(w_name, np.zeros_like(orig))
+    pio.static_load(main, prefix)
+    assert np.allclose(np.asarray(global_scope().get(w_name)), orig)
+
+
+def test_save_load_inference_model(tmp_path):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.fc(x, 3, act="relu")
+        loss = layers.mean(y)
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    d = str(tmp_path / "infer")
+    pio.save_inference_model(d, ["x"], [y], exe, main)
+
+    prog, feed_names, fetch_targets = pio.load_inference_model(d, exe)
+    assert feed_names == ["x"]
+    (out,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_targets)
+    assert np.allclose(out, ref, atol=1e-5)
+
+
+def test_dygraph_save_load(tmp_path):
+    was_dynamic = paddle.in_dynamic_mode()
+    paddle.disable_static()
+    try:
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 3)
+        sd = lin.state_dict()
+        p = str(tmp_path / "dy")
+        pio.save_dygraph(sd, p)
+        params, opt = pio.load_dygraph(p)
+        assert opt is None
+        lin2 = nn.Linear(4, 3)
+        lin2.set_state_dict(params)
+        for k in sd:
+            assert np.allclose(np.asarray(sd[k].numpy()),
+                               np.asarray(lin2.state_dict()[k].numpy()))
+    finally:
+        if not was_dynamic:
+            paddle.enable_static()
+
+
+def test_distributed_sampler_heavy_padding():
+    # padding larger than dataset: every rank must still get equal batches
+    ds = RangeDataset(2)
+    lens = []
+    for rank in range(8):
+        s = pio.DistributedBatchSampler(ds, batch_size=1, num_replicas=8,
+                                        rank=rank)
+        batches = list(s)
+        assert len(batches) == len(s)
+        lens.append(len(batches))
+    assert len(set(lens)) == 1
+
+
+def test_combined_file_roundtrip_any_name(tmp_path):
+    import paddle_tpu.static as static
+    main, startup, loss = _build_linear_prog()
+    exe = static.Executor()
+    exe.run(startup)
+    from paddle_tpu.static.executor import global_scope
+    w_name = main.all_parameters()[0].name
+    orig = np.asarray(global_scope().get(w_name))
+    d = str(tmp_path / "c")
+    pio.save_persistables(exe, d, main, filename="__params__")
+    assert os.path.exists(os.path.join(d, "__params__"))
+    global_scope().set(w_name, np.zeros_like(orig))
+    pio.load_persistables(exe, d, main, filename="__params__")
+    assert np.allclose(np.asarray(global_scope().get(w_name)), orig)
+
+
+def test_dataloader_early_break_no_thread_leak():
+    import threading
+    ds = RangeDataset(64)
+    before = threading.active_count()
+    for _ in range(5):
+        for i, batch in enumerate(pio.DataLoader(ds, batch_size=2,
+                                                 num_workers=2)):
+            if i == 1:
+                break
+    import gc, time
+    gc.collect()
+    time.sleep(0.3)
+    assert threading.active_count() <= before + 2
+
+
+def test_random_sampler_short_generator():
+    ds = RangeDataset(10)
+    s = pio.RandomSampler(ds, generator=iter(range(3)))
+    assert list(s) == [0, 1, 2]
+
+
+def test_batch_sampler_validation():
+    ds = RangeDataset(4)
+    with pytest.raises(ValueError):
+        pio.BatchSampler(ds, batch_size=0)
+    with pytest.raises(ValueError):
+        pio.DistributedBatchSampler(ds, batch_size=0, num_replicas=2, rank=0)
