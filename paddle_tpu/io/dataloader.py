@@ -99,10 +99,15 @@ class _PrefetchIterator:
 
     def close(self):
         self._stop.set()
+        self._closed = True
         try:  # drain so a blocked filler can observe the stop flag
             while True:
                 self._q.get_nowait()
         except queue.Empty:
+            pass
+        try:  # unblock a consumer that was already waiting in get()
+            self._q.put_nowait(self._DONE)
+        except queue.Full:
             pass
 
     def __del__(self):
@@ -112,6 +117,8 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
+        if getattr(self, "_closed", False):
+            raise StopIteration
         item = self._q.get()
         if item is self._DONE:
             self.close()
@@ -204,7 +211,17 @@ class DataLoader:
         # overlaps.  (The reference forks worker *processes* because its
         # transforms are GIL-bound Python — dataloader_iter.py.)
         from multiprocessing.dummy import Pool
-        pool = Pool(self.num_workers, initializer=self.worker_init_fn)
+        init = None
+        if self.worker_init_fn is not None:
+            lock = threading.Lock()
+            counter = itertools.count()
+
+            def init():  # API contract: worker_init_fn(worker_id)
+                with lock:
+                    wid = next(counter)
+                self.worker_init_fn(wid)
+
+        pool = Pool(self.num_workers, initializer=init)
         try:
             args = ((self.dataset, indices, self.collate_fn)
                     for indices in self.batch_sampler)

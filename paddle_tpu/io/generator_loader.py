@@ -106,10 +106,14 @@ class GeneratorLoader:
 
     # non-iterable (start/reset) mode: executor pulls via next_feed()
     def start(self):
+        self.reset()
         self._pending = _PrefetchIterator(self._feed_iter(),
                                           depth=self.capacity)
 
     def reset(self):
+        pending = getattr(self, "_pending", None)
+        if pending is not None:
+            pending.close()
         self._pending = None
 
     def next_feed(self):

@@ -1,1 +1,8 @@
+"""paddle.metric — Accuracy / Precision / Recall / Auc."""
+from .metrics import Metric, Accuracy, Precision, Recall, Auc  # noqa: F401
 
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy over a batch (metric_op.py accuracy)."""
+    m = Accuracy(topk=(k,))
+    return m.update(m.compute(input, label))

@@ -1,1 +1,15 @@
+"""paddle.optimizer — 2.0 optimizer API + lr schedulers."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb,
+)
+from . import lr_scheduler  # noqa: F401
+from .lr_scheduler import LRScheduler  # noqa: F401
+from . import lr_scheduler as lr  # noqa: F401  (paddle.optimizer.lr alias)
 
+# 2.0 clip names are the fluid classes (shared eager/static impls)
+from ..static.optimizer import (  # noqa: F401
+    GradientClipByValue as ClipGradByValue,
+    GradientClipByNorm as ClipGradByNorm,
+    GradientClipByGlobalNorm as ClipGradByGlobalNorm,
+)

@@ -1,0 +1,342 @@
+"""paddle.optimizer — the 2.0 optimizer API (dygraph + static).
+
+Reference: /root/reference/python/paddle/optimizer/optimizer.py (Optimizer
+with step/clear_grad/minimize/state_dict) and adam.py/adamw.py/... .
+
+Design: the update rules live once, in the shared op kernels
+(ops/kernels/optimizers.py).  In dygraph, step() feeds each parameter's
+value/grad/accumulators through the kernel eagerly and rebinds the results;
+in static mode the class delegates to its fluid-style twin
+(static/optimizer.py), which appends the same kernels as graph ops — so both
+modes share numerics by construction.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..dygraph.base import in_dygraph_mode
+from ..dygraph.tensor import Tensor
+from ..ops.registry import run_kernel, OpContext
+from .lr_scheduler import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    _op_type: str = None
+    # accumulator spec: (slot_name, state_key, fill, scalar)
+    _accums = ()
+    _static_cls_name = None
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **attrs):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        self._attrs = attrs
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._static_delegate = None
+        if in_dygraph_mode() and self._parameter_list is None:
+            raise ValueError(
+                "parameters must be given when used in dygraph mode")
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("can't set_lr when lr is an LRScheduler")
+        self._learning_rate = float(value)
+        if self._static_delegate is not None:
+            self._static_delegate.set_lr(value)
+
+    # -- accumulators (dygraph) ---------------------------------------------
+    def _acc(self, name, param, fill=0.0, scalar=False):
+        store = self._accumulators.setdefault(name, {})
+        key = param.name
+        if key not in store:
+            staged = getattr(self, "_staged_state", None)
+            skey = f"{key}_{name}"
+            if staged and skey in staged:  # from set_state_dict
+                store[key] = jnp.asarray(staged[skey])
+            else:
+                shape = (1,) if scalar else np.shape(param._value)
+                store[key] = jnp.full(shape, fill, jnp.float32)
+        return store[key]
+
+    def _set_acc(self, name, param, value):
+        self._accumulators[name][param.name] = value
+
+    # -- weight decay / clip (dygraph) --------------------------------------
+    def _apply_decay_to_grad(self, param, grad):
+        """Coupled L2 (reference regularizer.L2Decay): grad += coeff*param.
+        AdamW overrides to use the decoupled kernel path instead."""
+        wd = self._weight_decay
+        if wd is None:
+            return grad
+        coeff = wd if isinstance(wd, (int, float)) else \
+            getattr(wd, "_regularization_coeff", getattr(wd, "coeff", 0.0))
+        if not coeff:
+            return grad
+        return grad + jnp.asarray(coeff, grad.dtype) * param._value.astype(
+            grad.dtype)
+
+    def _clip_grads(self, params_grads):
+        clip = self._grad_clip
+        if clip is None:
+            return params_grads
+        if not hasattr(clip, "_eager_apply"):
+            raise TypeError(f"{type(clip).__name__} does not support dygraph")
+        # params with need_clip=False bypass clipping (fluid/clip.py
+        # ClipGradBase: NeedClip filter) but keep their order
+        to_clip = [(p, g) for p, g in params_grads
+                   if getattr(p, "need_clip", True)]
+        clipped = dict(zip((id(p) for p, _ in to_clip),
+                           (g for _, g in clip._eager_apply(to_clip))))
+        return [(p, clipped.get(id(p), g)) for p, g in params_grads]
+
+    # -- dygraph step -------------------------------------------------------
+    def _kernel_ins(self, param, grad, lr):
+        ins = {"Param": param._value, "Grad": grad,
+               "LearningRate": jnp.asarray([lr], jnp.float32)}
+        for slot, key, fill, scalar in self._accums:
+            ins[slot] = self._acc(key, param, fill, scalar)
+        return ins
+
+    def _apply_outs(self, param, outs):
+        param._value = outs["ParamOut"]
+        for slot, key, fill, scalar in self._accums:
+            out = outs.get(slot + "Out")
+            if out is not None:
+                self._set_acc(key, param, out)
+
+    @property
+    def _params(self) -> List[Tensor]:
+        if self._parameter_list is None:
+            raise ValueError("optimizer has no parameters")
+        return self._parameter_list
+
+    def step(self):
+        lr = self.get_lr()
+        ctx = OpContext()
+        params_grads = [(p, p.grad_) for p in self._params
+                        if not p.stop_gradient and p.grad_ is not None]
+        params_grads = [(p, g._value if isinstance(g, Tensor) else
+                         jnp.asarray(g)) for p, g in params_grads]
+        params_grads = self._clip_grads(params_grads)
+        for p, g in params_grads:
+            g = self._apply_decay_to_grad(p, g)
+            outs = run_kernel(self._op_type, self._kernel_ins(p, g, lr),
+                              dict(self._attrs), ctx)
+            self._apply_outs(p, outs)
+
+    def clear_grad(self):
+        for p in self._params:
+            p.grad_ = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            # grads must already be populated by loss.backward()
+            self.step()
+            return None, [(p, p.grad_) for p in self._params]
+        return self._static().minimize(loss, startup_program,
+                                       parameters, no_grad_set)
+
+    # -- static delegation --------------------------------------------------
+    def _static(self):
+        if self._static_delegate is None:
+            from ..static import optimizer as S
+            cls = getattr(S, self._static_cls_name or type(self).__name__)
+            kw = dict(self._attrs)
+            reg = self._weight_decay
+            if isinstance(reg, (int, float)) and reg:
+                from ..static.optimizer import L2Decay
+                reg = L2Decay(reg)
+            self._static_delegate = cls(
+                learning_rate=self._learning_rate,
+                regularization=reg if not isinstance(reg, (int, float))
+                else None,
+                grad_clip=self._grad_clip, **kw)
+        return self._static_delegate
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self):
+        sd = OrderedDict()
+        for name, store in self._accumulators.items():
+            for pname, val in store.items():
+                sd[f"{pname}_{name}"] = np.asarray(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        sched = state_dict.get("LR_Scheduler")
+        if sched is not None and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(sched)
+        for name, store in self._accumulators.items():
+            for pname in store:
+                key = f"{pname}_{name}"
+                if key in state_dict:
+                    store[pname] = jnp.asarray(state_dict[key])
+        # accumulators not yet materialised: stage for _acc to pick up
+        self._staged_state = dict(state_dict)
+
+
+class SGD(Optimizer):
+    _op_type = "sgd"
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+
+class Momentum(Optimizer):
+    _op_type = "momentum"
+    _accums = (("Velocity", "velocity", 0.0, False),)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, mu=momentum, use_nesterov=use_nesterov)
+
+
+class Adam(Optimizer):
+    _op_type = "adam"
+    _accums = (("Moment1", "moment1", 0.0, False),
+               ("Moment2", "moment2", 0.0, False),
+               ("Beta1Pow", "beta1_pow", None, True),
+               ("Beta2Pow", "beta2_pow", None, True))
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+    def _acc(self, name, param, fill=0.0, scalar=False):
+        if fill is None:  # beta pow accumulators start at beta^1
+            fill = self._attrs["beta1" if "beta1" in name else "beta2"]
+        return Optimizer._acc(self, name, param, fill, scalar)
+
+
+class AdamW(Adam):
+    _op_type = "adamw"
+    _static_cls_name = "AdamW"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, name)
+        # decoupled decay is an attr of the adamw kernel, not a grad rewrite
+        coeff = weight_decay if isinstance(weight_decay, (int, float)) \
+            else getattr(weight_decay, "_regularization_coeff", 0.01)
+        self._attrs["coeff"] = float(coeff)
+
+    def _apply_decay_to_grad(self, param, grad):
+        return grad  # handled by the kernel's coeff
+
+    def _static(self):
+        if self._static_delegate is None:
+            from ..static.optimizer import AdamW as SAdamW
+            a = self._attrs
+            self._static_delegate = SAdamW(
+                learning_rate=self._learning_rate, beta1=a["beta1"],
+                beta2=a["beta2"], epsilon=a["epsilon"],
+                weight_decay=a["coeff"], grad_clip=self._grad_clip)
+        return self._static_delegate
+
+
+class Adamax(Optimizer):
+    _op_type = "adamax"
+    _accums = (("Moment", "moment", 0.0, False),
+               ("InfNorm", "inf_norm", 0.0, False),
+               ("Beta1Pow", "beta1_pow", None, True))
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+    def _acc(self, name, param, fill=0.0, scalar=False):
+        if fill is None:
+            fill = self._attrs["beta1"]
+        return Optimizer._acc(self, name, param, fill, scalar)
+
+
+class Adagrad(Optimizer):
+    _op_type = "adagrad"
+    _accums = (("Moment", "moment", 0.0, False),)
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, epsilon=epsilon)
+        self._init_acc = initial_accumulator_value
+        if initial_accumulator_value:
+            self._accums = (("Moment", "moment",
+                             initial_accumulator_value, False),)
+
+
+class Adadelta(Optimizer):
+    _op_type = "adadelta"
+    _accums = (("AvgSquaredGrad", "avg_squared_grad", 0.0, False),
+               ("AvgSquaredUpdate", "avg_squared_update", 0.0, False))
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, epsilon=epsilon, rho=rho)
+
+
+class RMSProp(Optimizer):
+    _op_type = "rmsprop"
+    _accums = (("MeanSquare", "mean_square", 0.0, False),
+               ("MeanGrad", "mean_grad", 0.0, False),
+               ("Moment", "momentum_acc", 0.0, False))
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, decay=rho, epsilon=epsilon, momentum=momentum,
+                         centered=centered)
+
+
+class Lamb(Optimizer):
+    _op_type = "lamb"
+    _accums = (("Moment1", "moment1", 0.0, False),
+               ("Moment2", "moment2", 0.0, False),
+               ("Beta1Pow", "beta1_pow", None, True),
+               ("Beta2Pow", "beta2_pow", None, True))
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon)
+        # the lamb kernel takes decay as an attr (decoupled, trust-scaled)
+        self._attrs["weight_decay"] = float(lamb_weight_decay)
+
+    def _acc(self, name, param, fill=0.0, scalar=False):
+        if fill is None:
+            fill = self._attrs["beta1" if "beta1" in name else "beta2"]
+        return Optimizer._acc(self, name, param, fill, scalar)

@@ -71,6 +71,11 @@ class GradientClipByValue:
         return [(p, layers.clip(g, self.min, self.max))
                 for p, g in params_grads]
 
+    def _eager_apply(self, params_grads):
+        import jax.numpy as jnp
+        return [(p, jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
 
 class GradientClipByNorm:
     def __init__(self, clip_norm):
@@ -79,6 +84,16 @@ class GradientClipByNorm:
     def apply(self, params_grads):
         return [(p, layers.clip_by_norm(g, self.clip_norm))
                 for p, g in params_grads]
+
+    def _eager_apply(self, params_grads):
+        import jax.numpy as jnp
+        out = []
+        for p, g in params_grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, (g.astype(jnp.float32) * scale).astype(g.dtype)))
+        return out
 
 
 class GradientClipByGlobalNorm:
@@ -93,6 +108,16 @@ class GradientClipByGlobalNorm:
             max_norm,
             layers.elementwise_max(global_norm, max_norm))
         return [(p, layers.elementwise_mul(g, scale))
+                for p, g in params_grads]
+
+    def _eager_apply(self, params_grads):
+        import jax.numpy as jnp
+        if not params_grads:
+            return params_grads
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                          for _, g in params_grads))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(p, (g.astype(jnp.float32) * scale).astype(g.dtype))
                 for p, g in params_grads]
 
 

@@ -1,1 +1,3 @@
-
+"""paddle.text — text datasets (and, via paddle.nn, text model layers)."""
+from . import datasets  # noqa: F401
+from .datasets import Imdb, UCIHousing, FakeSeq2SeqData, FakeLMData  # noqa: F401

@@ -1,0 +1,140 @@
+"""paddle.text.datasets — text dataset loaders.
+
+Reference: /root/reference/python/paddle/text/datasets/{imdb,wmt14,...}.py
+(download + parse).  Zero-egress build: parse local archives under
+DATA_HOME if present, else raise with instructions; FakeSeq2SeqData and
+FakeLMData provide deterministic synthetic corpora for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import os
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from ..vision.datasets import DATA_HOME, _require
+
+__all__ = ["Imdb", "UCIHousing", "FakeSeq2SeqData", "FakeLMData"]
+
+
+class Imdb(Dataset):
+    """IMDB sentiment; parses the standard aclImdb_v1.tar.gz archive."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        data_file = data_file or os.path.join(DATA_HOME, "imdb",
+                                              "aclImdb_v1.tar.gz")
+        _require(data_file, "Imdb archive")
+        self.mode = mode
+        # single decompression pass: collect vocab counts (train split) and
+        # this mode's token docs together (the ~84MB gz is the cost center)
+        from collections import Counter
+        counter = Counter()
+        raw_docs, labels = [], []
+        vocab_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        mode_pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf:
+                in_vocab = vocab_pat.match(m.name)
+                mm = mode_pat.match(m.name)
+                if not in_vocab and not mm:
+                    continue
+                doc = self._tokenize(
+                    tf.extractfile(m).read().decode("utf-8", "ignore"))
+                if in_vocab:
+                    counter.update(doc)
+                if mm:
+                    raw_docs.append(doc)
+                    labels.append(1 if mm.group(1) == "pos" else 0)
+        items = [(w, c) for w, c in counter.items() if c > cutoff]
+        items.sort(key=lambda t: (-t[1], t[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(items)}
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in d],
+                                np.int64) for d in raw_docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def _tokenize(self, text):
+        pat = re.compile(r"[^a-z0-9\s]")
+        return pat.sub("", text.lower()).split()
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (housing.data whitespace table)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = data_file or os.path.join(DATA_HOME, "uci_housing",
+                                              "housing.data")
+        _require(data_file, "UCIHousing data")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats = raw[:, :-1]
+        mn, mx = feats.min(0), feats.max(0)
+        feats = (feats - feats.mean(0)) / np.maximum(mx - mn, 1e-8)
+        n_train = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:n_train], raw[:n_train, -1:]
+        else:
+            self.x, self.y = feats[n_train:], raw[n_train:, -1:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class FakeSeq2SeqData(Dataset):
+    """Deterministic synthetic (src, tgt_in, tgt_out) token triples —
+    stands in for WMT14/16 in the zero-egress environment."""
+
+    def __init__(self, num_samples=1000, src_len=32, tgt_len=32,
+                 vocab_size=1000, seed=0, bos=0, eos=1):
+        self.num_samples = num_samples
+        self.src_len, self.tgt_len = src_len, tgt_len
+        self.vocab_size = vocab_size
+        self.seed, self.bos, self.eos = seed, bos, eos
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed * 1000003 + idx)
+        src = rng.integers(2, self.vocab_size,
+                           size=self.src_len).astype(np.int64)
+        tgt = rng.integers(2, self.vocab_size,
+                           size=self.tgt_len - 1).astype(np.int64)
+        tgt_in = np.concatenate([[self.bos], tgt])
+        tgt_out = np.concatenate([tgt, [self.eos]])
+        return src, tgt_in, tgt_out
+
+    def __len__(self):
+        return self.num_samples
+
+
+class FakeLMData(Dataset):
+    """Deterministic synthetic language-model (ids, labels) pairs."""
+
+    def __init__(self, num_samples=1000, seq_len=128, vocab_size=30522,
+                 seed=0):
+        self.num_samples = num_samples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed * 1000003 + idx)
+        ids = rng.integers(0, self.vocab_size,
+                           size=self.seq_len).astype(np.int64)
+        labels = np.roll(ids, -1)[:, None]
+        return ids, labels
+
+    def __len__(self):
+        return self.num_samples

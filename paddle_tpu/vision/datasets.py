@@ -1,0 +1,166 @@
+"""paddle.vision.datasets — standard dataset loaders.
+
+Reference: /root/reference/python/paddle/vision/datasets/{mnist,cifar}.py and
+/root/reference/python/paddle/dataset/ (download + parse).  This build runs
+with zero egress, so the download step is replaced by: (1) parse local copies
+if present under ~/.cache/paddle/dataset (same layout the reference caches
+to), else (2) raise with instructions — plus a deterministic synthetic
+FakeData for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DATA_HOME"]
+
+DATA_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
+
+
+def _require(path, what):
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what} not found at {path}. This environment has no network "
+            "access — place the standard archive there manually, or use "
+            "paddle_tpu.vision.datasets.FakeData for synthetic samples.")
+    return path
+
+
+class _IdxMNIST(Dataset):
+    """IDX-format parser shared by MNIST and FashionMNIST."""
+
+    _subdir = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend="cv2"):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        tag = "train" if mode == "train" else "t10k"
+        base = os.path.join(DATA_HOME, self._subdir)
+        image_path = image_path or os.path.join(
+            base, f"{tag}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{tag}-labels-idx1-ubyte.gz")
+        self.mode = mode
+        self.transform = transform
+        self.images, self.labels = self._parse(
+            _require(image_path, f"{type(self).__name__} images"),
+            _require(label_path, f"{type(self).__name__} labels"))
+
+    @staticmethod
+    def _parse(image_path, label_path):
+        opener = gzip.open if image_path.endswith(".gz") else open
+        with opener(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8)
+            images = images.reshape(n, rows, cols)
+        opener = gzip.open if label_path.endswith(".gz") else open
+        with opener(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8)
+        return images, labels.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]  # HWC
+        label = np.asarray([self.labels[idx]])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(_IdxMNIST):
+    _subdir = "mnist"
+
+
+class FashionMNIST(_IdxMNIST):
+    _subdir = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    _archive = "cifar-10-python.tar.gz"
+    _prefix = "cifar-10-batches-py"
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2"):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        data_file = data_file or os.path.join(DATA_HOME, "cifar",
+                                              self._archive)
+        _require(data_file, type(self).__name__)
+        self.mode = mode
+        self.transform = transform
+        self.data, self.labels = self._load(data_file)
+
+    def _member_names(self):
+        if self.mode == "train":
+            return [f"{self._prefix}/data_batch_{i}" for i in range(1, 6)]
+        return [f"{self._prefix}/test_batch"]
+
+    def _load(self, data_file):
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for name in self._member_names():
+                f = tf.extractfile(name)
+                batch = pickle.load(f, encoding="bytes")
+                imgs.append(batch[b"data"])
+                labels.extend(batch[self._label_key])
+        data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        return data.transpose(0, 2, 3, 1), np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        label = np.asarray([self.labels[idx]])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _archive = "cifar-100-python.tar.gz"
+    _prefix = "cifar-100-python"
+    _label_key = b"fine_labels"
+
+    def _member_names(self):
+        return [f"{self._prefix}/{'train' if self.mode == 'train' else 'test'}"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (for tests and
+    benchmarks in the zero-egress environment)."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed * 1000003 + idx)
+        img = rng.standard_normal(self.image_shape, dtype=np.float32)
+        label = np.asarray([rng.integers(0, self.num_classes)], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples

@@ -1,0 +1,402 @@
+"""paddle.vision.transforms — numpy-backed image transforms.
+
+Reference: /root/reference/python/paddle/vision/transforms/transforms.py.
+Images are HWC numpy arrays (uint8 or float); ToTensor converts to CHW
+float32 in [0,1].  All randomness uses numpy's global RNG seeded via
+paddle.seed for reproducibility (the reference uses random.random()).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Transpose",
+           "Resize", "RandomResizedCrop", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip", "Pad",
+           "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+           "HueTransform", "ColorJitter", "Grayscale",
+           "to_tensor", "normalize", "resize", "center_crop", "crop",
+           "hflip", "vflip", "pad"]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def _size2d(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+# -- functional ops ---------------------------------------------------------
+def to_tensor(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Resize HWC image with numpy (bilinear or nearest); keeps aspect when
+    `size` is an int (short side scaled), like the reference."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        if (w <= h and w == size) or (h <= w and h == size):
+            return img
+        if w < h:
+            ow, oh = int(size), int(size * h / w)
+        else:
+            oh, ow = int(size), int(size * w / h)
+    else:
+        oh, ow = _size2d(size)
+    if interpolation == "nearest":
+        ys = (np.arange(oh) * h / oh).astype(np.int64).clip(0, h - 1)
+        xs = (np.arange(ow) * w / ow).astype(np.int64).clip(0, w - 1)
+        return img[ys][:, xs]
+    # bilinear, align_corners=False convention
+    dtype = img.dtype
+    fimg = img.astype(np.float32)
+    y = (np.arange(oh) + 0.5) * h / oh - 0.5
+    x = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.floor(y).astype(np.int64)
+    x0 = np.floor(x).astype(np.int64)
+    wy = (y - y0)[:, None, None]
+    wx = (x - x0)[None, :, None]
+    y0c, y1c = y0.clip(0, h - 1), (y0 + 1).clip(0, h - 1)
+    x0c, x1c = x0.clip(0, w - 1), (x0 + 1).clip(0, w - 1)
+    out = (fimg[y0c][:, x0c] * (1 - wy) * (1 - wx)
+           + fimg[y1c][:, x0c] * wy * (1 - wx)
+           + fimg[y0c][:, x1c] * (1 - wy) * wx
+           + fimg[y1c][:, x1c] * wy * wx)
+    if np.issubdtype(dtype, np.integer):
+        out = np.rint(out).clip(np.iinfo(dtype).min,
+                                np.iinfo(dtype).max).astype(dtype)
+    return out
+
+
+def crop(img, top, left, height, width):
+    img = _as_hwc(img)
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    th, tw = _size2d(output_size)
+    h, w = img.shape[:2]
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl = pr = int(padding[0])
+        pt = pb = int(padding[1])
+    else:
+        pl, pt, pr, pb = (int(p) for p in padding)
+    if padding_mode == "constant":
+        return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode="constant",
+                      constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode=mode)
+
+
+# -- transform classes ------------------------------------------------------
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple) and self.keys:
+            out = []
+            for key, data in zip(self.keys, inputs):
+                out.append(self._apply_image(data) if key == "image"
+                           else data)
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = _size2d(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = img.shape[:2]
+        if self.pad_if_needed and w < tw:
+            img = pad(img, (tw - w, 0), self.fill, self.padding_mode)
+        if self.pad_if_needed and h < th:
+            img = pad(img, (0, th - h), self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        top = np.random.randint(0, h - th + 1)
+        left = np.random.randint(0, w - tw + 1)
+        return crop(img, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = _size2d(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self.scale) * area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            tw = int(round(np.sqrt(target_area * aspect)))
+            th = int(round(np.sqrt(target_area / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = np.random.randint(0, h - th + 1)
+                left = np.random.randint(0, w - tw + 1)
+                return resize(crop(img, top, left, th, tw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.random() < self.prob:
+            return hflip(img)
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.random() < self.prob:
+            return vflip(img)
+        return _as_hwc(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+def _blend(a, b, alpha):
+    out = a.astype(np.float32) * alpha + b.astype(np.float32) * (1 - alpha)
+    if np.issubdtype(a.dtype, np.integer):
+        return np.rint(out).clip(0, 255).astype(a.dtype)
+    return out
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return _blend(img, np.zeros_like(img), alpha)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = np.full_like(img, img.astype(np.float32).mean())
+        return _blend(img, mean, alpha)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        alpha = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = img.astype(np.float32).mean(axis=2, keepdims=True)
+        gray = np.broadcast_to(gray, img.shape).astype(img.dtype)
+        return _blend(img, gray, alpha)
+
+
+class HueTransform(BaseTransform):
+    """Cheap hue shift by channel rotation mixing (full HSV round-trip is
+    overkill for augmentation parity tests)."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if img.shape[2] < 3 or self.value == 0:
+            return img
+        shift = np.random.uniform(-self.value, self.value)
+        rolled = np.roll(img, 1, axis=2)
+        return _blend(img, rolled, 1 - abs(shift))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if img.shape[2] == 1:
+            gray = img.astype(np.float32)[:, :, 0]
+        else:
+            gray = (0.299 * img[:, :, 0] + 0.587 * img[:, :, 1]
+                    + 0.114 * img[:, :, 2]).astype(np.float32)
+        if np.issubdtype(img.dtype, np.integer):
+            gray = np.rint(gray).clip(0, 255).astype(img.dtype)
+        out = gray[:, :, None]
+        if self.num_output_channels == 3:
+            out = np.repeat(out, 3, axis=2)
+        return out

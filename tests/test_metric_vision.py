@@ -1,0 +1,134 @@
+"""paddle.metric + paddle.vision tests (reference: test_metrics.py,
+test_vision_models.py, test_transforms.py in the reference unittest tree)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import metric as M
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision import models, datasets
+
+
+def test_accuracy_topk():
+    m = M.Accuracy(topk=(1, 2))
+    pred = np.asarray([[0.1, 0.7, 0.2], [0.6, 0.3, 0.1]], np.float32)
+    label = np.asarray([[1], [2]], np.int64)
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.5)
+    assert top2 == pytest.approx(0.5)
+    m.reset()
+    assert m.count == 0
+
+
+def test_precision_recall():
+    p, r = M.Precision(), M.Recall()
+    preds = np.asarray([0.9, 0.8, 0.2, 0.6], np.float32)
+    labels = np.asarray([1, 0, 1, 1], np.int64)
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+
+
+def test_auc_perfect_and_random():
+    auc = M.Auc()
+    preds = np.stack([1 - np.linspace(0, 1, 100),
+                      np.linspace(0, 1, 100)], axis=1)
+    labels = (np.linspace(0, 1, 100) > 0.5).astype(np.int64)
+    auc.update(preds, labels)
+    assert auc.accumulate() > 0.99
+    auc.reset()
+    assert auc.accumulate() == 0.0
+
+
+def test_functional_accuracy():
+    pred = np.asarray([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    label = np.asarray([[0], [0]], np.int64)
+    assert M.accuracy(pred, label) == pytest.approx(0.5)
+
+
+def test_transforms_pipeline():
+    img = (np.random.RandomState(0).rand(40, 60, 3) * 255).astype(np.uint8)
+    tr = T.Compose([T.Resize(32), T.CenterCrop(32), T.ToTensor(),
+                    T.Normalize([0.5] * 3, [0.5] * 3)])
+    out = tr(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.001 <= out.min() and out.max() <= 1.001
+
+
+def test_transform_geometry():
+    img = np.arange(24, dtype=np.uint8).reshape(4, 6, 1)
+    assert (T.hflip(img) == img[:, ::-1]).all()
+    assert (T.vflip(img) == img[::-1]).all()
+    assert T.pad(img, 2).shape == (8, 10, 1)
+    assert T.crop(img, 1, 2, 2, 3).shape == (2, 3, 1)
+    r = T.resize(img, (8, 12), interpolation="nearest")
+    assert r.shape == (8, 12, 1)
+    rc = T.RandomCrop(3)._apply_image(np.zeros((5, 5, 1), np.uint8))
+    assert rc.shape == (3, 3, 1)
+    g = T.Grayscale(3)._apply_image(np.zeros((4, 4, 3), np.uint8))
+    assert g.shape == (4, 4, 3)
+
+
+def test_color_transforms():
+    img = (np.random.RandomState(1).rand(8, 8, 3) * 255).astype(np.uint8)
+    for tr in (T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+               T.SaturationTransform(0.4), T.HueTransform(0.2),
+               T.ColorJitter(0.4, 0.4, 0.4, 0.2)):
+        out = tr(img)
+        assert out.shape == img.shape and out.dtype == img.dtype
+
+
+def test_lenet_forward():
+    m = models.LeNet()
+    x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype(np.float32))
+    y = m(x)
+    assert y.shape == [2, 10]
+
+
+def test_resnet18_forward():
+    m = models.resnet18(num_classes=7)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    y = m(x)
+    assert y.shape == [1, 7]
+
+
+def test_mobilenet_v2_forward():
+    m = models.mobilenet_v2(num_classes=5)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    y = m(x)
+    assert y.shape == [1, 5]
+
+
+def test_vgg_structure():
+    m = models.vgg11(num_classes=0)
+    x = paddle.to_tensor(np.random.randn(1, 3, 32, 32).astype(np.float32))
+    y = m(x)
+    assert y.shape[1] == 512
+
+
+def test_fake_data():
+    ds = datasets.FakeData(num_samples=4, image_shape=(1, 8, 8),
+                           num_classes=3)
+    img, label = ds[2]
+    img2, label2 = ds[2]
+    assert img.shape == (1, 8, 8) and (img == img2).all()
+    assert 0 <= int(label[0]) < 3
+    from paddle_tpu.text.datasets import FakeLMData, FakeSeq2SeqData
+    lm = FakeLMData(num_samples=3, seq_len=16, vocab_size=50)
+    ids, labels = lm[0]
+    assert ids.shape == (16,) and labels.shape == (16, 1)
+    s2s = FakeSeq2SeqData(num_samples=3, src_len=8, tgt_len=8)
+    src, ti, to = s2s[1]
+    assert src.shape == (8,) and ti.shape == (8,) and to.shape == (8,)
+    assert ti[0] == 0 and to[-1] == 1
+
+
+def test_missing_dataset_raises():
+    with pytest.raises(FileNotFoundError, match="no network"):
+        datasets.MNIST(image_path="/nonexistent/x.gz",
+                       label_path="/nonexistent/y.gz")
