@@ -1,1 +1,17 @@
+"""paddle.amp — automatic mixed precision (static + dygraph).
 
+Static path: `decorate(optimizer)` (contrib/mixed_precision/decorator.py:215
+analog) rewrites the program with bf16 casts and adds loss-scaling ops.
+Dygraph path: `auto_cast()` guard + `GradScaler`
+(imperative/amp_auto_cast.cc + dygraph/amp/loss_scaler.py analogs).
+"""
+from .fp16_lists import AutoMixedPrecisionLists, white_list, black_list, \
+    gray_list  # noqa: F401
+from .fp16_utils import rewrite_program, cast_model_to_fp16  # noqa: F401
+from .decorator import decorate, OptimizerWithMixedPrecision  # noqa: F401
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, GradScaler, AmpScaler, amp_cast_inputs, amp_state,
+)
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+           "AutoMixedPrecisionLists", "rewrite_program", "cast_model_to_fp16"]

@@ -1,0 +1,206 @@
+"""Dygraph AMP: auto_cast context + GradScaler.
+
+Reference: /root/reference/paddle/fluid/imperative/amp_auto_cast.cc (the
+dygraph tracer casts op inputs by white/black list when the AMP guard is on)
+and python/paddle/fluid/dygraph/amp/loss_scaler.py (GradScaler analog:
+scale loss, unscale grads, skip the step on inf/nan, adapt the scale).
+
+TPU note: dtype defaults to bfloat16 (MXU-native).  The cast hook lives in
+dygraph/tracer.trace_op, which calls `amp_cast_inputs` on every eager op —
+the same interception point as the reference tracer
+(imperative/tracer.cc CastPureFp16Inputs).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from .fp16_lists import AutoMixedPrecisionLists
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "amp_cast_inputs",
+           "amp_state"]
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = "bfloat16"
+        self.lists = None
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    prev = (_state.enabled, _state.level, _state.dtype, _state.lists)
+    _state.enabled = bool(enable) and level != "O0"
+    _state.level = level
+    _state.dtype = dtype
+    _state.lists = AutoMixedPrecisionLists(custom_white_list,
+                                           custom_black_list)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.lists) = prev
+
+
+auto_cast = amp_guard  # paddle.amp.auto_cast 2.0 spelling
+
+
+def _cast(v, dtype):
+    if v is None:
+        return v
+    a = jnp.asarray(v)
+    if jnp.issubdtype(a.dtype, jnp.floating) and str(a.dtype) != dtype:
+        return a.astype(dtype)
+    return v
+
+
+def amp_cast_inputs(op_type: str, raw_ins: Dict[str, Any]):
+    """Called from trace_op on every eager op while the guard is active."""
+    if not _state.enabled:
+        return raw_ins
+    lists = _state.lists or AutoMixedPrecisionLists()
+    if _state.level == "O2":
+        low = op_type not in lists.black_list
+        dtype = _state.dtype if low else "float32"
+    elif op_type in lists.white_list:
+        dtype = _state.dtype
+    elif op_type in lists.black_list:
+        dtype = "float32"
+    else:
+        return raw_ins
+    out = {}
+    for slot, v in raw_ins.items():
+        if isinstance(v, list):
+            out[slot] = [_cast(x, dtype) for x in v]
+        else:
+            out[slot] = _cast(v, dtype)
+    return out
+
+
+class GradScaler:
+    """paddle.amp.GradScaler / fluid dygraph AmpScaler parity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+        self._unscaled = False  # guards against double division per step
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def _unscale_and_check(self, optimizer):
+        """Divide grads by the scale; detect non-finite values.  Raises on a
+        second unscale in the same step (the reference AmpScaler contract)."""
+        if self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        self._unscaled = True
+        self._found_inf = False
+        params = getattr(optimizer, "_parameter_list", None)
+        if not params:
+            # 2.0 Optimizer exposes the list as the `_params` property
+            params = getattr(optimizer, "_params", None)
+            if callable(params):
+                params = params()
+        if not params:
+            raise ValueError("optimizer has no parameters to unscale")
+        inv = 1.0 / self._scale
+        for p in params:
+            g = p.grad
+            if g is None:
+                continue
+            raw = g._value if hasattr(g, "_value") else jnp.asarray(g)
+            raw = raw.astype(jnp.float32) * inv
+            if not bool(jnp.all(jnp.isfinite(raw))):
+                self._found_inf = True
+            if hasattr(g, "_value"):
+                g._value = raw
+            else:
+                p.grad = raw
+
+    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
+        """Unscale, skip-on-inf, step, update the dynamic scale."""
+        if not self._enable:
+            return optimizer.minimize(scaled_loss, *args, **kwargs)
+        if not self._unscaled:
+            self._unscale_and_check(optimizer)
+        if not self._found_inf:
+            if hasattr(optimizer, "step"):
+                optimizer.step()
+            else:
+                optimizer.minimize(scaled_loss, *args, **kwargs)
+        self._update()
+        return None
+
+    def step(self, optimizer):
+        """2.0 GradScaler.step + update."""
+        self.minimize(optimizer, None)
+
+    def unscale_(self, optimizer):
+        self._unscale_and_check(optimizer)
+
+    def update(self):
+        self._update()
+
+    def _update(self):
+        self._unscaled = False
+        if not self._use_dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good,
+                "bad_steps": self._bad}
+
+    def load_state_dict(self, d):
+        self._scale = d["scale"]
+        self._good = d.get("good_steps", 0)
+        self._bad = d.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler  # fluid dygraph spelling

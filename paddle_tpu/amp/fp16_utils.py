@@ -1,0 +1,110 @@
+"""Program rewriting for static-graph AMP: cast insertion.
+
+Reference: /root/reference/python/paddle/fluid/contrib/mixed_precision/
+fp16_utils.py — `rewrite_program` walks the block, classifying each op
+white/black/gray and inserting `cast` ops so white ops consume fp16 and
+black ops consume fp32.
+
+TPU design notes: the casts are pure dataflow ops that XLA fuses into the
+adjacent matmul/conv (free on the MXU path), so we insert per-use casts and
+keep parameters fp32 (master weights) rather than maintaining fp16 parameter
+copies like `cast_parameters_to_fp16`.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..core.program import Program, Block, OpDesc, OpRole, unique_name
+from .fp16_lists import AutoMixedPrecisionLists
+
+__all__ = ["rewrite_program", "cast_model_to_fp16"]
+
+_FLOAT = ("float32", "float64")
+
+
+def _is_float_var(block, name):
+    try:
+        v = block.var(name)
+    except KeyError:
+        return False
+    return v.dtype in _FLOAT or v.dtype in ("float16", "bfloat16")
+
+
+def _insert_cast(block, name, src_dtype, dst_dtype, cache, new_ops, uid_fn):
+    key = (name, dst_dtype)
+    if key in cache:
+        return cache[key]
+    out = unique_name(f"{name}.cast_{dst_dtype}")
+    block.create_var(name=out, shape=block.var(name).shape, dtype=dst_dtype,
+                     stop_gradient=block.var(name).stop_gradient)
+    op = OpDesc("cast", {"X": [name]}, {"Out": [out]},
+                {"in_dtype": src_dtype, "out_dtype": dst_dtype,
+                 OpRole.KEY: OpRole.Forward, "op_uid": uid_fn()})
+    new_ops.append(op)
+    cache[key] = out
+    return out
+
+
+def rewrite_program(main_program: Program, amp_lists=None,
+                    dest_dtype: str = "bfloat16"):
+    """fp16_utils.py rewrite_program parity (forward block only — call
+    BEFORE append_backward, as decorate() does)."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    block = main_program.global_block()
+    var_dtype: Dict[str, str] = {}  # rewritten dtype of each var
+    new_ops = []
+    cache: Dict = {}
+    uid_fn = main_program._next_uid
+
+    for op in block.ops:
+        if op.op_role != OpRole.Forward and op.op_role != OpRole.Loss:
+            new_ops.append(op)
+            continue
+        t = op.type
+        if t in amp_lists.white_list and not (
+                amp_lists.black_varnames &
+                set(op.input_names() + op.output_names())):
+            want = dest_dtype
+        elif t in amp_lists.gray_list:
+            # follow inputs: low precision only if every float input already is
+            ins = [n for n in op.input_names() if _is_float_var(block, n)]
+            low = ins and all(
+                var_dtype.get(n, block.var(n).dtype) == dest_dtype
+                for n in ins)
+            want = dest_dtype if low else None
+        else:
+            want = "float32"
+
+        if want is not None:
+            for slot, names in op.inputs.items():
+                out_names = []
+                for n in names:
+                    if not _is_float_var(block, n):
+                        out_names.append(n)
+                        continue
+                    cur = var_dtype.get(n, block.var(n).dtype)
+                    if cur in _FLOAT + ("float16", "bfloat16") and cur != want:
+                        out_names.append(_insert_cast(
+                            block, n, cur, want, cache, new_ops, uid_fn))
+                    else:
+                        out_names.append(n)
+                op.inputs[slot] = out_names
+            for n in op.output_names():
+                if _is_float_var(block, n):
+                    block.var(n).dtype = want
+                    var_dtype[n] = want
+        new_ops.append(op)
+    block.ops = new_ops
+    main_program._fingerprint_cache = None
+    return main_program
+
+
+def cast_model_to_fp16(program: Program, amp_lists=None,
+                       dest_dtype: str = "bfloat16"):
+    """fp16_utils.py cast_model_to_fp16 (pure-fp16 mode O2): every float var
+    and op flipped to the low dtype except the black list."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    lists = AutoMixedPrecisionLists(
+        custom_white_list=amp_lists.gray_list | amp_lists.white_list,
+        custom_black_list=amp_lists.black_list)
+    return rewrite_program(program, lists, dest_dtype)

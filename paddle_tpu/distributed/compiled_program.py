@@ -88,10 +88,15 @@ class ExecutionStrategy:
 
 
 def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
-                          scale=True) -> Program:
+                          scale=True, fp16_allreduce=None) -> Program:
     """Insert c_allreduce_sum (+ 1/N scale) on every Grad input of optimizer
     ops.  Mirrors CreateAllReduceOp insertion
-    (multi_devices_graph_pass.cc:464,:632); returns a rewritten clone."""
+    (multi_devices_graph_pass.cc:464,:632); returns a rewritten clone.
+
+    fp16_allreduce (meta_optimizers/fp16_allreduce_optimizer.py analog):
+    wrap the allreduce in bf16 casts, halving ICI bytes."""
+    if fp16_allreduce is None:
+        fp16_allreduce = getattr(program, "_fp16_allreduce", False)
     p = copy.deepcopy(program)
     block = p.global_block()
     new_ops = []
@@ -104,13 +109,34 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
                 if g in done:
                     new_gnames.append(done[g])
                     continue
+                from ..core.program import OpDesc
+                src = g
+                if fp16_allreduce:
+                    low = unique_name(g + "@BF16")
+                    block.create_var(name=low, stop_gradient=True,
+                                     dtype="bfloat16")
+                    new_ops.append(OpDesc(
+                        "cast", {"X": [g]}, {"Out": [low]},
+                        {"in_dtype": "float32", "out_dtype": "bfloat16",
+                         OpRole.KEY: OpRole.Dist,
+                         "op_uid": p._next_uid()}))
+                    src = low
                 red = unique_name(g + "@ALLREDUCE")
                 block.create_var(name=red, stop_gradient=True)
-                from ..core.program import OpDesc
-                ar = OpDesc("c_allreduce_sum", {"X": [g]}, {"Out": [red]},
+                ar = OpDesc("c_allreduce_sum", {"X": [src]}, {"Out": [red]},
                             {"ring_id": 0, OpRole.KEY: OpRole.Dist,
                              "op_uid": p._next_uid()})
                 new_ops.append(ar)
+                if fp16_allreduce:
+                    back = unique_name(g + "@FP32")
+                    block.create_var(name=back, stop_gradient=True,
+                                     dtype="float32")
+                    new_ops.append(OpDesc(
+                        "cast", {"X": [red]}, {"Out": [back]},
+                        {"in_dtype": "bfloat16", "out_dtype": "float32",
+                         OpRole.KEY: OpRole.Dist,
+                         "op_uid": p._next_uid()}))
+                    red = back
                 if scale:
                     scaled = unique_name(g + "@SCALED")
                     block.create_var(name=scaled, stop_gradient=True)

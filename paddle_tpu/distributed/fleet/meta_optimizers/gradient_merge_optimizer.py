@@ -1,0 +1,107 @@
+"""Gradient merge (accumulation) meta-optimizer.
+
+Reference: meta_optimizers/gradient_merge_optimizer.py + fluid
+GradientMergeOptimizer — grads accumulate in persistable buffers for k
+steps; the real optimizer ops run inside a conditional block on the k-th.
+
+TPU-native redesign (rewrite_utils): the conditional becomes a masked
+update — optimizer ops run every step into temps, `where(mask, ...)`
+commits on the k-th step, accumulators reset by the same mask.  The whole
+step stays one XLA computation.  Note the merged-grad allreduce (inserted
+later by CompiledProgram on the optimizer's Grad input) is then also
+executed every step; XLA overlaps it with compute and psum is linear, so
+numerics match the reference's communicate-on-apply schedule.
+"""
+from __future__ import annotations
+
+from ....core.program import OpDesc, OpRole, default_startup_program, \
+    unique_name
+from .meta_optimizer_base import MetaOptimizerBase
+from .rewrite_utils import (append_masked_step_counter,
+                            retarget_op_outputs_masked, new_tmp_var, _op)
+
+__all__ = ["GradientMergeOptimizer", "apply_gradient_merge"]
+
+
+def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
+    """Rewrite the already-minimized `program` for k-step accumulation."""
+    block = program.global_block()
+    opt_start = next((i for i, op in enumerate(block.ops)
+                      if op.op_role == OpRole.Optimize), len(block.ops))
+    opt_ops = block.ops[opt_start:]
+    block.ops = block.ops[:opt_start]
+
+    mask = append_masked_step_counter(program, startup, k_steps, prefix="gm")
+
+    grad_to_avg = {}   # grad name -> merged (avg) grad fed to optimizer ops
+    grad_to_acc = {}   # grad name -> persistable accumulator
+    for p, g in params_grads:
+        acc = unique_name(g.name + "@GradientMerge")
+        block.create_var(name=acc, shape=g.shape, dtype=g.dtype,
+                         persistable=True, stop_gradient=True)
+        sb = startup.global_block()
+        sb.create_var(name=acc, shape=g.shape, dtype=g.dtype,
+                      persistable=True, stop_gradient=True)
+        sb.ops.append(OpDesc("fill_constant", {}, {"Out": [acc]},
+                             {"shape": list(g.shape or [1]), "value": 0.0,
+                              "dtype": g.dtype,
+                              "op_uid": startup._next_uid()}))
+        # acc += g   (every step)
+        _op(program, block, "elementwise_add", {"X": [acc], "Y": [g.name]},
+            {"Out": [acc]})
+        if avg:
+            avg_name = new_tmp_var(block, like=block.var(g.name),
+                                   name_hint=g.name + "@GM_AVG")
+            _op(program, block, "scale", {"X": [acc]}, {"Out": [avg_name]},
+                {"scale": 1.0 / k_steps, "bias": 0.0})
+        else:
+            avg_name = acc
+        grad_to_avg[g.name] = avg_name
+        grad_to_acc[g.name] = acc
+
+    # optimizer ops: read merged grads, commit only on masked steps.
+    # `rename` keeps intra-group dataflow intact: later ops read the fresh
+    # @MASKED temps of earlier ops in the group, not the stale vars.
+    tail = []
+    rename = {}
+    for op in opt_ops:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(grad_to_avg.get(n, n),
+                                          grad_to_avg.get(n, n))
+                               for n in names]
+        retarget_op_outputs_masked(program, op, mask, tail, rename)
+        block.ops.append(op)
+    block.ops.extend(tail)
+
+    # reset accumulators on masked steps: acc = where(mask, 0, acc)
+    for gname, acc in grad_to_acc.items():
+        zeros = new_tmp_var(block, like=block.var(acc),
+                            name_hint=acc + "@ZERO")
+        gshape = list(block.var(acc).shape or [1])
+        _op(program, block, "fill_constant", {}, {"Out": [zeros]},
+            {"shape": gshape, "value": 0.0, "dtype": block.var(acc).dtype})
+        _op(program, block, "where", {"Condition": [mask], "X": [zeros],
+                                      "Y": [acc]}, {"Out": [acc]})
+    program._fingerprint_cache = None
+    return program, mask
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    def _can_apply(self):
+        s = self.user_defined_strategy
+        return bool(s.gradient_merge) and \
+            s.gradient_merge_configs.get("k_steps", 1) > 1
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.gradient_merge = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        c = self.user_defined_strategy.gradient_merge_configs
+        program = loss.block.program
+        startup = startup_program or default_startup_program()
+        apply_gradient_merge(program, startup, params_grads,
+                             c.get("k_steps", 2), c.get("avg", True))
+        return ops, params_grads

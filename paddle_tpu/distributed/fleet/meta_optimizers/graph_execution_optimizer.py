@@ -1,0 +1,45 @@
+"""Graph-execution meta-optimizer — the outermost collective-mode compiler.
+
+Reference: meta_optimizers/graph_execution_optimizer.py — appends the NCCL
+bootstrap ops to the startup program (`_setup_nccl_op` :52) and wraps the
+main program in a CompiledProgram with multi-trainer build_strategy; it is
+the outermost meta-optimizer in collective mode (fleet_base.py:1032).
+
+TPU-native: no NCCL id bootstrap (mesh formation = jax.distributed /
+Mesh creation); the program is wrapped in
+CompiledProgram.with_data_parallel, whose shard_map tracing lowers the
+inserted c_allreduce ops to psum over the mesh's ICI.
+"""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+__all__ = ["GraphExecutionOptimizer"]
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    def _can_apply(self):
+        # collective mode only (fleet_base decides); a single worker still
+        # compiles fine — allreduce degenerates to identity
+        return True
+
+    def _is_graph_out(self):
+        return True
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        from ...compiled_program import CompiledProgram, BuildStrategy
+        program = loss.block.program
+        strategy = self.user_defined_strategy
+        bs = (strategy.build_strategy if strategy and strategy.build_strategy
+              else BuildStrategy())
+        if self.role_maker is not None:
+            bs.num_trainers = self.role_maker.worker_num()
+            bs.trainer_id = self.role_maker.worker_index()
+            bs.trainers_endpoints = self.role_maker.get_trainer_endpoints()
+        compiled = CompiledProgram(program, build_strategy=bs) \
+            .with_data_parallel(loss_name=loss.name)
+        program._compiled_for_fleet = compiled
+        return ops, params_grads

@@ -1,0 +1,89 @@
+"""LocalSGD meta-optimizer — periodic parameter averaging.
+
+Reference: meta_optimizers/localsgd_optimizer.py:23 (and adaptive variant
+:194) — each worker steps locally; every k steps a generated `Switch` block
+runs `c_allreduce_sum(param) / nranks` to average parameters across workers.
+
+TPU-native redesign: the Switch block becomes a masked update
+(rewrite_utils): every step computes `avg = psum(param)/world` and
+`param = where(mask, avg, param)`.  XLA dead-code-eliminates nothing here —
+the allreduce does run every step — but it overlaps with compute over ICI;
+for the reference cadence semantics run under the multi-process (per-host)
+topology where each process owns its local params between syncs.
+
+NOTE (single-process mesh executor): parameters under shard_map are declared
+replicated; LocalSGD's between-sync divergence therefore only materialises in
+the multi-process topology (one process per host, jax.distributed), which is
+exactly the reference's deployment shape (one process per device).
+"""
+from __future__ import annotations
+
+from ....core.program import OpRole, default_startup_program
+from .meta_optimizer_base import MetaOptimizerBase
+from .rewrite_utils import append_masked_step_counter, new_tmp_var, _op
+
+__all__ = ["LocalSGDOptimizer", "AdaptiveLocalSGDOptimizer",
+           "apply_localsgd"]
+
+
+def apply_localsgd(program, startup, params, k_steps, begin_step=1):
+    """Append masked parameter-averaging ops after the optimizer ops."""
+    block = program.global_block()
+    mask = append_masked_step_counter(program, startup, k_steps,
+                                     begin_step=begin_step, prefix="localsgd")
+    for p in params:
+        summed = new_tmp_var(block, like=block.var(p.name),
+                             name_hint=p.name + "@LSGD_SUM")
+        _op(program, block, "c_allreduce_sum", {"X": [p.name]},
+            {"Out": [summed]}, {"ring_id": 0, OpRole.KEY: OpRole.Dist})
+        avg = new_tmp_var(block, like=block.var(p.name),
+                          name_hint=p.name + "@LSGD_AVG")
+        _op(program, block, "scale_by_world_size", {"X": [summed]},
+            {"Out": [avg]}, {"ring_id": 0})
+        _op(program, block, "where", {"Condition": [mask], "X": [avg],
+                                      "Y": [p.name]}, {"Out": [p.name]})
+    program._fingerprint_cache = None
+    return program
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    _incompatible = ("GraphExecutionOptimizer",)
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.localsgd)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.localsgd = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        c = self.user_defined_strategy.localsgd_configs
+        program = loss.block.program
+        startup = startup_program or default_startup_program()
+        apply_localsgd(program, startup, [p for p, _ in params_grads],
+                       c.get("k_steps", 1), c.get("begin_step", 1))
+        return ops, params_grads
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """adaptive variant (:194) — k adapted from loss decay in the reference;
+    here the initial k is used (adaptation hook kept for parity)."""
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.adaptive_localsgd)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.adaptive_localsgd = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        c = self.user_defined_strategy.adaptive_localsgd_configs
+        program = loss.block.program
+        startup = startup_program or default_startup_program()
+        apply_localsgd(program, startup, [p for p, _ in params_grads],
+                       c.get("init_k_steps", 1), c.get("begin_step", 1))
+        return ops, params_grads

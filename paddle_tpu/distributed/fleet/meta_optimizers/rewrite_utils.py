@@ -1,0 +1,120 @@
+"""Shared program-rewrite helpers for meta-optimizers.
+
+The reference implements k-step behaviours (gradient merge, LocalSGD) with
+`Switch`/conditional_block sub-blocks (meta_optimizers/localsgd_optimizer.py
+:23 — `Switch` blocks holding c_allreduce ops).  TPU-native redesign: XLA
+wants straight-line dataflow, so conditionals become MASKED UPDATES — every
+step computes both branches cheaply and `where(mask, new, old)` selects;
+the mask is a scalar derived from a persistable step counter.  This keeps the
+whole train step one fused XLA computation with no host round-trip.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ....core.program import Program, Block, OpDesc, OpRole, unique_name
+
+__all__ = ["append_masked_step_counter", "retarget_op_outputs_masked",
+           "new_tmp_var"]
+
+
+def _op(program, block, type, ins, outs, attrs=None):
+    d = OpDesc(type, ins, outs, dict(attrs or {}))
+    d.attrs.setdefault("op_uid", program._next_uid())
+    d.attrs.setdefault(OpRole.KEY, OpRole.Optimize)
+    block.ops.append(d)
+    return d
+
+
+def new_tmp_var(block, like=None, name_hint="tmp", dtype="float32",
+                shape=(1,), stop_gradient=True):
+    name = unique_name(name_hint)
+    if like is not None:
+        shape, dtype = like.shape, like.dtype
+    block.create_var(name=name, shape=shape, dtype=dtype,
+                     stop_gradient=stop_gradient)
+    return name
+
+
+def append_masked_step_counter(program: Program, startup: Program,
+                               k_steps: int, begin_step: int = 0,
+                               prefix: str = "gm") -> str:
+    """Append a persistable step counter and return the name of a bool[1]
+    mask var that is True every k-th step (past begin_step).
+
+    Ops appended (all straight-line):
+        step = step + 1                (persistable write-back)
+        mask = (step % k == 0) [& step >= begin]
+    """
+    block = program.global_block()
+    step = unique_name(f"@{prefix}_step")
+    block.create_var(name=step, shape=(1,), dtype="float32",
+                     persistable=True, stop_gradient=True)
+    sb = startup.global_block()
+    sb.create_var(name=step, shape=(1,), dtype="float32", persistable=True,
+                  stop_gradient=True)
+    d = OpDesc("fill_constant", {}, {"Out": [step]},
+               {"shape": [1], "value": 0.0, "dtype": "float32",
+                "op_uid": startup._next_uid()})
+    sb.ops.append(d)
+
+    _op(program, block, "increment", {"X": [step]}, {"Out": [step]},
+        {"step": 1.0})
+    kconst = new_tmp_var(block, name_hint=f"@{prefix}_k")
+    _op(program, block, "fill_constant", {}, {"Out": [kconst]},
+        {"shape": [1], "value": float(k_steps), "dtype": "float32"})
+    rem = new_tmp_var(block, name_hint=f"@{prefix}_rem")
+    _op(program, block, "elementwise_mod", {"X": [step], "Y": [kconst]},
+        {"Out": [rem]})
+    zero = new_tmp_var(block, name_hint=f"@{prefix}_zero")
+    _op(program, block, "fill_constant", {}, {"Out": [zero]},
+        {"shape": [1], "value": 0.0, "dtype": "float32"})
+    mask = new_tmp_var(block, name_hint=f"@{prefix}_mask", dtype="bool")
+    _op(program, block, "equal", {"X": [rem], "Y": [zero]}, {"Out": [mask]})
+    if begin_step > 0:
+        beg = new_tmp_var(block, name_hint=f"@{prefix}_begin")
+        _op(program, block, "fill_constant", {}, {"Out": [beg]},
+            {"shape": [1], "value": float(begin_step), "dtype": "float32"})
+        past = new_tmp_var(block, name_hint=f"@{prefix}_past", dtype="bool")
+        _op(program, block, "greater_equal", {"X": [step], "Y": [beg]},
+            {"Out": [past]})
+        both = new_tmp_var(block, name_hint=f"@{prefix}_both", dtype="bool")
+        _op(program, block, "logical_and", {"X": [mask], "Y": [past]},
+            {"Out": [both]})
+        mask = both
+    return mask
+
+
+def retarget_op_outputs_masked(program: Program, op: OpDesc, mask: str,
+                               insert_after: List[OpDesc],
+                               rename: dict = None):
+    """Rewrite `op` so its outputs land in temps, then append
+    `out = where(mask, temp, out)` write-backs to `insert_after`.
+
+    This is how a conditional_block around an optimizer op (reference
+    Switch/cond) becomes straight-line XLA dataflow: compute the update
+    every step, commit it only on masked steps.
+
+    `rename` (var -> temp) is updated so LATER ops in the same masked group
+    read the freshly computed temps, keeping intra-group dataflow intact
+    (e.g. AMP's update_loss_scaling consuming check_finite's FoundInfinite);
+    the deferred write-backs commit the whole group atomically on the mask.
+    """
+    block = program.global_block()
+    for slot, names in list(op.outputs.items()):
+        new_names = []
+        for n in names:
+            tmp = new_tmp_var(block, like=block.var(n),
+                              name_hint=n + "@MASKED")
+            new_names.append(tmp)
+            if rename is not None:
+                rename[n] = tmp
+            # only persistable state needs the masked commit; plain temps
+            # have no prior value to preserve (readers go through `rename`)
+            if block.var(n).persistable:
+                sel = OpDesc("where", {"Condition": [mask], "X": [tmp],
+                                       "Y": [n]}, {"Out": [n]},
+                             {OpRole.KEY: OpRole.Optimize,
+                              "op_uid": program._next_uid()})
+                insert_after.append(sel)
+        op.outputs[slot] = new_names

@@ -1,0 +1,117 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-host job launcher.
+
+Reference: /root/reference/python/paddle/distributed/fleet/launch.py —
+`launch_collective` (:198) spawns per-device worker subprocesses with the
+PADDLE_* env contract and watches them; `launch_ps` (:248) starts
+pserver+trainer processes for parameter-server mode.
+
+TPU mapping: one worker process per host of the slice (`--nproc_per_node`
+defaults to 1 — a single jax client drives all local chips); `--ips` lists
+slice hosts; rank-0 endpoint doubles as the jax.distributed coordinator.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from .launch_utils import (Cluster, Pod, get_cluster, start_local_trainers,
+                           watch_local_trainers, terminate_procs,
+                           find_free_ports)
+
+__all__ = ["launch_collective", "launch_ps", "main"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips of the slice")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes per host (1 per TPU host)")
+    p.add_argument("--started_port", type=int, default=None)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--run_mode", type=str, default="collective",
+                   choices=["collective", "ps"])
+    p.add_argument("--server_num", type=int, default=None)
+    p.add_argument("--worker_num", type=int, default=None)
+    p.add_argument("--servers", type=str, default="")
+    p.add_argument("--workers", type=str, default="")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch_collective(args):
+    """launch.py:198 parity."""
+    node_ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+    this_ip = os.environ.get("POD_IP", node_ips[0])
+    nproc = args.nproc_per_node
+    if args.started_port is not None:
+        ports = list(range(args.started_port, args.started_port + nproc))
+    else:
+        ports = find_free_ports(nproc)
+    endpoints = [[f"{ip}:{port}" for port in ports] for ip in node_ips]
+    devices_per_proc = [[i] for i in range(nproc)]
+    cluster, pod = get_cluster(node_ips, this_ip, endpoints,
+                               devices_per_proc)
+    procs = start_local_trainers(cluster, pod, args.training_script,
+                                 args.training_script_args,
+                                 log_dir=args.log_dir)
+    try:
+        while True:
+            procs = watch_local_trainers(procs, cluster.trainers_nranks())
+            if not procs:
+                return 0
+            time.sleep(1)
+    except KeyboardInterrupt:
+        terminate_procs(procs)
+        return 1
+
+
+def launch_ps(args):
+    """launch.py:248 parity — spawn pserver + trainer processes with the
+    PADDLE_PORT / PADDLE_PSERVERS_IP_PORT_LIST / TRAINING_ROLE contract."""
+    server_eps = [e for e in args.servers.split(",") if e]
+    worker_eps = [e for e in args.workers.split(",") if e]
+    if not server_eps:
+        n = args.server_num or 1
+        server_eps = [f"127.0.0.1:{p}" for p in find_free_ports(n)]
+    if not worker_eps:
+        n = args.worker_num or 1
+        worker_eps = [f"127.0.0.1:{p}" for p in find_free_ports(n)]
+
+    import subprocess
+    procs = []
+    base_env = dict(os.environ)
+    base_env["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(server_eps)
+    base_env["PADDLE_TRAINERS_NUM"] = str(len(worker_eps))
+    for i, ep in enumerate(server_eps):
+        env = dict(base_env, TRAINING_ROLE="PSERVER",
+                   PADDLE_PORT=ep.split(":")[1], POD_IP=ep.split(":")[0],
+                   PADDLE_TRAINER_ID=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", args.training_script]
+            + args.training_script_args, env=env))
+    for i, ep in enumerate(worker_eps):
+        env = dict(base_env, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i),
+                   PADDLE_CURRENT_ENDPOINT=ep)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", args.training_script]
+            + args.training_script_args, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.run_mode == "ps" or args.server_num or args.servers:
+        return launch_ps(args)
+    return launch_collective(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Dygraph data parallelism + parallel environment bootstrap.
+
+Reference: /root/reference/python/paddle/fluid/dygraph/parallel.py —
+`prepare_context` (:34), `ParallelEnv`, `DataParallel` (:236) with
+`scale_loss` (:337) and `apply_collective_grads` (:449 — coalesce grads into
+chunks, allreduce each chunk, split back); NCCL bootstrap in
+imperative/nccl_context.cc:22-145 (TCP handshake of ncclUniqueId).
+
+TPU-native redesign: there is no NCCL id to hand-shake — multi-host mesh
+formation is `jax.distributed.initialize` (coordination service), driven off
+the same PADDLE_* env contract the reference launcher sets.  Grad coalescing
+(`coalesce_tensors` + split, parallel.py:449) is NOT re-implemented: XLA's
+collective combiner fuses small allreduces; DataParallel simply allreduces
+each grad and lets the compiler bucket.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+from .parallel_env import ParallelEnv
+from .collective import all_reduce, ReduceOp
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "prepare_context", "DataParallel", "ParallelEnv"]
+
+_parallel_ctx_initialized = False
+
+
+def get_rank() -> int:
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    return ParallelEnv().world_size
+
+
+def init_parallel_env():
+    """paddle.distributed.init_parallel_env — bootstrap the collective world.
+
+    On a multi-host TPU slice each launched process (one per host, env
+    contract from fleet.launch) joins the jax.distributed coordination
+    service; rank 0's endpoint is the coordinator.  Single-process: no-op.
+    """
+    global _parallel_ctx_initialized
+    if _parallel_ctx_initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and env.trainer_endpoints:
+        import jax
+        coordinator = env.trainer_endpoints[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except (RuntimeError, ValueError) as e:  # already initialised / local
+            warnings.warn(f"jax.distributed.initialize skipped: {e}")
+    _parallel_ctx_initialized = True
+    return env
+
+
+def prepare_context(strategy=None):
+    """fluid/dygraph/parallel.py:34 legacy alias."""
+    init_parallel_env()
+    return strategy
+
+
+class DataParallel:
+    """Dygraph DP wrapper (parallel.py:236).
+
+    Usage parity:
+        model = DataParallel(model)
+        loss = model.scale_loss(loss)
+        loss.backward()
+        model.apply_collective_grads()
+        opt.minimize(loss)
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, group=None):
+        self._layers = layers
+        self._env = ParallelEnv()
+        self._group = group
+        # comm_buffer_size knobs kept for parity; XLA buckets collectives
+
+    @property
+    def nranks(self):
+        return self._env.world_size
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    __call__ = forward
+
+    def scale_loss(self, loss):
+        """parallel.py:337 — pre-scale loss by 1/nranks so the summed
+        allreduce of grads averages."""
+        if self.nranks <= 1:
+            return loss
+        return loss / float(self.nranks)
+
+    def apply_collective_grads(self):
+        """parallel.py:449 — allreduce every trainable grad.  No manual
+        coalescing: XLA's collective combiner fuses them."""
+        if self.nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.trainable and p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=self._group)
+
+    # -- passthrough to the wrapped Layer ----------------------------------
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, state_dict, *a, **kw):
+        return self._layers.set_state_dict(state_dict, *a, **kw)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)

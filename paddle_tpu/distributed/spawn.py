@@ -1,0 +1,99 @@
+"""paddle.distributed.spawn — start distributed workers via multiprocessing.
+
+Reference: /root/reference/python/paddle/distributed/spawn.py (spawn N
+processes, one per selected GPU, wiring the PADDLE_* env contract and
+collecting results / exceptions).
+
+TPU mapping: one worker process per HOST of a slice (each process drives all
+of its local chips through one jax client), so `nprocs` defaults to 1 and is
+mostly useful for CPU-mesh simulation tests of the multi-host path.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+import sys
+import traceback
+
+__all__ = ["spawn", "get_free_ports"]
+
+
+def get_free_ports(n):
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ParallelEnvArgs:
+    def __init__(self):
+        self.cluster_node_ips = None
+        self.node_ip = None
+        self.use_paddlecloud = None
+        self.started_port = None
+        self.selected_devices = None
+        self.print_config = True
+
+
+def _wrap(func, i, nprocs, endpoints, args, error_queue):
+    env = os.environ
+    env["PADDLE_TRAINER_ID"] = str(i)
+    env["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    env["PADDLE_CURRENT_ENDPOINT"] = endpoints[i]
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+    env["FLAGS_selected_xlas"] = str(i)
+    try:
+        func(*args)
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        error_queue.put(traceback.format_exc())
+        sys.exit(1)
+
+
+class MultiprocessContext:
+    def __init__(self, processes, error_queues):
+        self.processes = processes
+        self.error_queues = error_queues
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        for i, (p, q) in enumerate(zip(self.processes, self.error_queues)):
+            if p.exitcode not in (0, None):
+                msg = q.get() if not q.empty() else f"exitcode {p.exitcode}"
+                for other in self.processes:
+                    if other.is_alive():
+                        other.terminate()
+                raise RuntimeError(
+                    f"worker {i} failed:\n{msg}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """Launch `nprocs` worker processes running `func(*args)` under the
+    PADDLE_* env contract (spawn.py parity)."""
+    if nprocs < 0:
+        nprocs = 1
+    ports = get_free_ports(nprocs)
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    ctx = multiprocessing.get_context("spawn")
+    processes, error_queues = [], []
+    for i in range(nprocs):
+        q = ctx.SimpleQueue()
+        p = ctx.Process(target=_wrap,
+                        args=(func, i, nprocs, endpoints, args, q),
+                        daemon=daemon)
+        p.start()
+        processes.append(p)
+        error_queues.append(q)
+    mp_ctx = MultiprocessContext(processes, error_queues)
+    if join:
+        mp_ctx.join()
+    return mp_ctx

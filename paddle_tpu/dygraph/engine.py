@@ -92,6 +92,11 @@ def _node_grad_ins(node: GradNode, gmap: _GradMap):
     info = get_op_info(node.op_type)
     ins = {}
     for slot in info.inputs:
+        if node.amp_raws is not None and slot.name in node.amp_raws:
+            # AMP forward consumed casted inputs; replay with the same
+            # dtypes so the vjp's cotangent types line up
+            ins[slot.name] = node.amp_raws[slot.name]
+            continue
         v = node.ins.get(slot.name)
         if slot.duplicable:
             ins[slot.name] = [t._value if isinstance(t, Tensor) else t

@@ -27,7 +27,8 @@ class GradNode:
     imperative/layer.h)."""
 
     __slots__ = ("op_type", "ins", "attrs", "outs_raw", "out_tensors",
-                 "seed", "vjp_fn", "n_vjp_inputs", "in_tensors_flat")
+                 "seed", "vjp_fn", "n_vjp_inputs", "in_tensors_flat",
+                 "amp_raws")
 
     def __init__(self, op_type, ins, attrs, outs_raw, out_tensors, seed):
         self.op_type = op_type
@@ -39,6 +40,9 @@ class GradNode:
         self.vjp_fn = None            # set for trace_jax nodes
         self.n_vjp_inputs = 0
         self.in_tensors_flat: List[Tensor] = []
+        # AMP: the casted raw inputs the kernel actually consumed; backward
+        # must replay with these so vjp dtypes match the forward trace
+        self.amp_raws = None
 
     def input_tensors(self) -> List[Tensor]:
         if self.in_tensors_flat:
@@ -95,6 +99,15 @@ def trace_op(op_type: str, ins: Dict[str, Any], attrs: Dict[str, Any],
         else:
             raw_ins[slot.name] = _raw(v) if v is not None else None
 
+    # dygraph AMP interception point (imperative/amp_auto_cast.cc analog)
+    from ..amp.auto_cast import amp_state, amp_cast_inputs
+    amp_casted = None
+    if amp_state().enabled:
+        casted = amp_cast_inputs(op_type, raw_ins)
+        if casted is not raw_ins:
+            amp_casted = casted
+            raw_ins = casted
+
     outs = info.kernel(raw_ins, attrs, ctx)
 
     needs_grad = (is_grad_enabled() and info.has_grad and _requires_grad(ins))
@@ -103,6 +116,7 @@ def trace_op(op_type: str, ins: Dict[str, Any], attrs: Dict[str, Any],
     out_tensors: Dict[str, List[Tensor]] = {}
     if needs_grad:
         node = GradNode(op_type, dict(ins), attrs, outs, out_tensors, seed)
+        node.amp_raws = amp_casted
 
     results = []
     for slot_name in out_slots:

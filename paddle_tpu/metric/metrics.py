@@ -163,11 +163,10 @@ class Auc(Metric):
         labels = _np(labels).reshape(-1)
         bins = (pos_prob * self.num_thresholds).astype(np.int64)
         bins = np.clip(bins, 0, self.num_thresholds)
-        for b, l in zip(bins, labels):
-            if l:
-                self._stat_pos[b] += 1
-            else:
-                self._stat_neg[b] += 1
+        n = self.num_thresholds + 1
+        pos_mask = labels != 0
+        self._stat_pos += np.bincount(bins[pos_mask], minlength=n)
+        self._stat_neg += np.bincount(bins[~pos_mask], minlength=n)
 
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)

@@ -232,6 +232,34 @@ def alltoall(ins, attrs, ctx):
     return {"Out": out.reshape(x.shape)}
 
 
+@register_op("p_send", inputs=["X"], outputs=["Out?"], grad=None,
+             side_effect=True)
+def p_send(ins, attrs, ctx):
+    """Point-to-point send half.  Under SPMD tracing the send/recv pair is a
+    single collective_permute, realised on the recv side; the send is an
+    identity marker (reference: operators/collective send_v2 over NCCL)."""
+    return {"Out": ins["X"]}
+
+
+@register_op("p_recv", inputs=["X"], outputs=["Out"], grad=None,
+             side_effect=True)
+def p_recv(ins, attrs, ctx):
+    """Point-to-point recv: lax.ppermute from `peer` along the ring axis.
+    Degenerates to identity outside a mesh (world of 1)."""
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    n = jax.lax.axis_size(ax)
+    peer = attrs.get("peer", 0)
+    me = attrs.get("me", None)
+    # permutation sending peer -> this rank; built statically over the ring
+    perm = [(peer, i) for i in range(n)] if me is None else [(peer, me)]
+    return {"Out": jax.lax.ppermute(x, ax, [(s % n, d % n)
+                                            for s, d in perm])}
+
+
 @register_op("scale_by_world_size", inputs=["X"], outputs=["Out"], grad=None,
              side_effect=True)
 def scale_by_world_size(ins, attrs, ctx):

@@ -32,6 +32,8 @@ class Optimizer:
     # accumulator spec: (slot_name, state_key, fill, scalar)
     _accums = ()
     _static_cls_name = None
+    # kernel attr name -> static ctor kwarg; value None drops the attr
+    _static_kw = {}
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None, **attrs):
@@ -160,7 +162,11 @@ class Optimizer:
         if self._static_delegate is None:
             from ..static import optimizer as S
             cls = getattr(S, self._static_cls_name or type(self).__name__)
-            kw = dict(self._attrs)
+            kw = {}
+            for k, v in self._attrs.items():
+                k2 = self._static_kw.get(k, k)
+                if k2 is not None:
+                    kw[k2] = v
             reg = self._weight_decay
             if isinstance(reg, (int, float)) and reg:
                 from ..static.optimizer import L2Decay
@@ -207,6 +213,7 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     _op_type = "momentum"
     _accums = (("Velocity", "velocity", 0.0, False),)
+    _static_kw = {"mu": "momentum"}
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -309,6 +316,7 @@ class Adadelta(Optimizer):
 
 class RMSProp(Optimizer):
     _op_type = "rmsprop"
+    _static_kw = {"decay": "rho"}
     _accums = (("MeanSquare", "mean_square", 0.0, False),
                ("MeanGrad", "mean_grad", 0.0, False),
                ("Moment", "momentum_acc", 0.0, False))
@@ -323,6 +331,7 @@ class RMSProp(Optimizer):
 
 class Lamb(Optimizer):
     _op_type = "lamb"
+    _static_kw = {"weight_decay": "lamb_weight_decay"}
     _accums = (("Moment1", "moment1", 0.0, False),
                ("Moment2", "moment2", 0.0, False),
                ("Beta1Pow", "beta1_pow", None, True),

@@ -1,0 +1,391 @@
+"""Fleet / distributed orchestration tests.
+
+Models the reference's distributed test strategy (SURVEY.md §4): meta-
+optimizer program-rewrite assertions + end-to-end convergence on the
+virtual 8-device CPU mesh (conftest.py), replacing the reference's
+two-process NCCL harness (test_dist_base.py / test_collective_base.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+import paddle_tpu.distributed as dist
+
+
+def _linreg_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    return main, startup, loss
+
+
+def _train(exe, program, loss, steps=20, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.rand(8, 1).astype(np.float32)
+    losses = []
+    for _ in range(steps):
+        xb = rng.rand(batch, 8).astype(np.float32)
+        yb = xb @ w_true
+        (lv,) = exe.run(program, feed={"x": xb, "y": yb},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    return losses
+
+
+def _fresh_fleet(is_collective=True):
+    from paddle_tpu.distributed.fleet.base.fleet_base import Fleet
+    f = Fleet()
+    f.init(is_collective=is_collective)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# collective functional API
+# ---------------------------------------------------------------------------
+def test_collective_world1_dygraph_identity():
+    t = paddle_tpu.to_tensor(np.array([1.0, 2.0], np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0])
+    out = []
+    dist.all_gather(out, t)
+    assert len(out) == 1
+    np.testing.assert_allclose(out[0].numpy(), [1.0, 2.0])
+    dist.broadcast(t, src=0)
+    dist.barrier()
+    assert dist.get_rank() == 0 and dist.get_world_size() == 1
+
+
+def test_collective_static_emits_ops():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        out = dist.all_reduce(x)
+        assert out is not None
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+
+
+def test_new_group_ring_ids():
+    g = dist.new_group([0, 1])
+    assert g.id >= 1
+    assert dist.get_group(g.id) is g
+
+
+# ---------------------------------------------------------------------------
+# fleet collective end-to-end (8-dev CPU mesh via conftest)
+# ---------------------------------------------------------------------------
+def test_fleet_collective_minimize_runs():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    with static.program_guard(main, startup):
+        opt = static.SGD(learning_rate=0.05)
+        f.distributed_optimizer(opt, strategy)
+        f.minimize(loss)
+    assert "GraphExecutionOptimizer" in f.applied_meta_list()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = _train(exe, f.main_program, loss)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_fleet_amp_rewrite_and_run():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs["init_loss_scaling"] = 1024.0
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(static.SGD(learning_rate=0.05), strategy)
+        f.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types, types
+    assert "check_finite_and_unscale" in types
+    assert "update_loss_scaling" in types
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = _train(exe, f.main_program, loss)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_fleet_recompute_applies():
+    f = _fresh_fleet()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, size=16, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.recompute = True
+        strategy.recompute_configs = {"checkpoints": [h.name]}
+        f.distributed_optimizer(static.SGD(learning_rate=0.05), strategy)
+        f.minimize(loss)
+    assert "RecomputeOptimizer" in f.applied_meta_list()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 8).astype(np.float32)
+        yb = (xb.sum(1, keepdims=True)).astype(np.float32)
+        l0 = None
+        for _ in range(15):
+            (lv,) = exe.run(f.main_program, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            l0 = l0 if l0 is not None else float(lv)
+        assert float(lv) < l0
+
+
+def test_gradient_merge_numerics():
+    """k=2 merge with identical batches == one step at the merged grad.
+    Compares against a no-merge run stepping every other iteration."""
+    rng = np.random.RandomState(3)
+    xb = rng.rand(8, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+
+    def run(merge):
+        f = _fresh_fleet()
+        main, startup, loss = _linreg_program()
+        strategy = dist.fleet.DistributedStrategy()
+        if merge:
+            strategy.gradient_merge = True
+            strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        with static.program_guard(main, startup):
+            f.distributed_optimizer(static.SGD(learning_rate=0.1), strategy)
+            f.minimize(loss)
+        exe = static.Executor()
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(4):
+                exe.run(f.main_program, feed={"x": xb, "y": yb},
+                        fetch_list=[loss])
+            w = [np.asarray(scope.get(p.name))
+                 for p in main.all_parameters()]
+        return w
+
+    w_merge = run(True)
+    w_plain = run(False)
+    # identical batches: avg of 2 identical grads == grad, applied every
+    # 2nd step → after 4 steps merge took 2 steps, plain took 4.
+    # So compare merge(4 iters) == plain run truncated to 2 steps.
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(static.SGD(learning_rate=0.1),
+                                dist.fleet.DistributedStrategy())
+        f.minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(f.main_program, feed={"x": xb, "y": yb},
+                    fetch_list=[loss])
+        w_two = [np.asarray(scope.get(p.name))
+                 for p in main.all_parameters()]
+    for a, b in zip(w_merge, w_two):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_localsgd_inserts_sync_ops_and_runs():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 2, "begin_step": 1}
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(static.SGD(learning_rate=0.05), strategy)
+        f.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+    assert "scale_by_world_size" in types
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = _train(exe, f.main_program, loss)
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_dgc_momentum_converges():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.5]}
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(
+            static.Momentum(learning_rate=0.05, momentum=0.9), strategy)
+        f.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "dgc" in types
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = _train(exe, f.main_program, loss, steps=30)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_lars_lamb_swap():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.lars = True
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(
+            static.Momentum(learning_rate=0.05, momentum=0.9), strategy)
+        f.minimize(loss)
+    assert "lars_momentum" in [op.type for op in main.global_block().ops]
+
+    f2 = _fresh_fleet()
+    main2, startup2, loss2 = _linreg_program()
+    s2 = dist.fleet.DistributedStrategy()
+    s2.lamb = True
+    with static.program_guard(main2, startup2):
+        f2.distributed_optimizer(static.Adam(learning_rate=1e-3), s2)
+        f2.minimize(loss2)
+    assert "lamb" in [op.type for op in main2.global_block().ops]
+
+
+def test_fp16_allreduce_flag():
+    f = _fresh_fleet()
+    main, startup, loss = _linreg_program()
+    strategy = dist.fleet.DistributedStrategy()
+    strategy.fp16_allreduce = True
+    with static.program_guard(main, startup):
+        f.distributed_optimizer(static.SGD(learning_rate=0.05), strategy)
+        f.minimize(loss)
+    assert getattr(main, "_fp16_allreduce", False)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = _train(exe, f.main_program, loss)
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+# ---------------------------------------------------------------------------
+# role maker / env contract / launcher
+# ---------------------------------------------------------------------------
+def test_rolemaker_collective_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "h0:7000,h1:7000,h2:7000,h3:7000")
+    from paddle_tpu.distributed.fleet.base.role_maker import \
+        PaddleCloudRoleMaker
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    assert rm.worker_num() == 4
+    assert rm.worker_index() == 2
+    assert rm.is_worker() and not rm.is_first_worker()
+
+
+def test_rolemaker_ps_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "127.0.0.1:6000,127.0.0.1:6001")
+    monkeypatch.setenv("POD_IP", "127.0.0.1")
+    monkeypatch.setenv("PADDLE_PORT", "6001")
+    from paddle_tpu.distributed.fleet.base.role_maker import \
+        PaddleCloudRoleMaker
+    rm = PaddleCloudRoleMaker(is_collective=False)
+    assert rm.is_server()
+    assert rm.server_index() == 1
+    assert rm.server_num() == 2
+
+
+def test_launch_cluster_topology():
+    from paddle_tpu.distributed.launch_utils import get_cluster
+    eps = [["10.0.0.1:700", "10.0.0.1:701"], ["10.0.0.2:700", "10.0.0.2:701"]]
+    cluster, pod = get_cluster(["10.0.0.1", "10.0.0.2"], "10.0.0.2", eps,
+                               [[0], [1]])
+    assert cluster.trainers_nranks() == 4
+    assert pod.addr == "10.0.0.2"
+    assert [t.rank for t in pod.trainers] == [2, 3]
+
+
+def test_parallel_env_contract(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    monkeypatch.setenv("PADDLE_CURRENT_ENDPOINT", "h1:7000")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "h0:7000,h1:7000,h2:7000,h3:7000")
+    env = dist.ParallelEnv()
+    assert env.rank == 1
+    assert env.world_size == 4
+    assert env.current_endpoint == "h1:7000"
+    assert len(env.trainer_endpoints) == 4
+
+
+# ---------------------------------------------------------------------------
+# dygraph DataParallel / AMP
+# ---------------------------------------------------------------------------
+def test_dygraph_data_parallel_world1():
+    import paddle_tpu.nn as nn
+    layer = nn.Linear(4, 2)
+    dp = dist.DataParallel(layer)
+    x = paddle_tpu.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    out = dp(x)
+    loss = out.sum()
+    loss2 = dp.scale_loss(loss)
+    loss2.backward()
+    dp.apply_collective_grads()  # world 1: no-op
+    assert layer.weight.grad is not None
+    assert len(dp.parameters()) == len(layer.parameters())
+
+
+def test_dygraph_amp_auto_cast():
+    import paddle_tpu.amp as amp
+    x = paddle_tpu.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    w = paddle_tpu.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    with amp.auto_cast():
+        y = paddle_tpu.matmul(x, w)   # white-list op → bf16 on the MXU
+    assert "bfloat16" in str(y.dtype)
+    y2 = paddle_tpu.matmul(x, w)
+    assert "float32" in str(y2.dtype)
+
+
+def test_dygraph_grad_scaler():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.amp as amp
+    import paddle_tpu.optimizer as opt
+    layer = nn.Linear(4, 1)
+    optimizer = opt.SGD(learning_rate=0.1,
+                        parameters=layer.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=128.0,
+                            use_dynamic_loss_scaling=True)
+    x = paddle_tpu.to_tensor(np.ones((4, 4), np.float32))
+    w0 = layer.weight.numpy().copy()
+    with amp.auto_cast():
+        loss = layer(x).sum()
+    scaled = scaler.scale(loss)
+    assert abs(float(scaled.numpy()) - float(loss.numpy()) * 128.0) < 1e-2
+    scaled.backward()
+    scaler.minimize(optimizer, scaled)
+    assert not np.allclose(layer.weight.numpy(), w0)
+
+
+def test_fleet_metrics_world1():
+    from paddle_tpu.distributed.fleet.metrics import metric
+    assert float(np.sum(metric.sum(np.array([1.0, 2.0])))) == 3.0
+    pos = np.zeros(100)
+    neg = np.zeros(100)
+    pos[80] = 10   # positives score high
+    neg[20] = 10   # negatives score low
+    assert metric.auc(pos, neg) > 0.99
+    assert abs(metric.mae(np.array([4.0]), np.array([8.0])) - 0.5) < 1e-9

@@ -143,3 +143,38 @@ def test_minimize_static_delegation():
         assert ln < l0
     finally:
         paddle.disable_static()
+
+
+def test_static_delegation_attr_translation():
+    """Regression: Momentum/RMSProp/Lamb kernel attrs must translate to the
+    fluid ctor kwargs when a 2.0 optimizer is used in static mode."""
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn as nn
+    params = nn.Linear(2, 2).parameters()
+    for cls, kw in ((opt.Momentum, {"momentum": 0.8}),
+                    (opt.RMSProp, {"rho": 0.9}),
+                    (opt.Lamb, {"lamb_weight_decay": 0.02})):
+        o = cls(learning_rate=0.1, parameters=params, **kw)
+        s = o._static()  # must not raise TypeError
+        assert s is not None
+
+
+def test_adamax_beta1pow_advances():
+    import numpy as np
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn as nn
+    layer = nn.Linear(4, 2)
+    o = opt.Adamax(learning_rate=0.1, beta1=0.9,
+                   parameters=layer.parameters())
+    x = paddle_tpu.to_tensor(np.ones((2, 4), np.float32))
+    for _ in range(3):
+        loss = layer(x).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    b1p = float(np.asarray(
+        o._accumulators["beta1_pow"][layer.weight.name]).reshape(())) \
+        if "beta1_pow" in o._accumulators else None
+    # accumulator starts at beta^1 and advances once per step → beta^4
+    assert b1p is not None and abs(b1p - 0.9 ** 4) < 1e-6, b1p
