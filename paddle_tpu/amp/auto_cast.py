@@ -122,13 +122,14 @@ class GradScaler:
 
     def _unscale_and_check(self, optimizer):
         """Divide grads by the scale; detect non-finite values.  Raises on a
-        second unscale in the same step (the reference AmpScaler contract)."""
+        second unscale in the same step (the reference AmpScaler contract).
+        One finite-ness scalar accumulates on device; a single host sync at
+        the end (not one blocking round-trip per parameter)."""
         if self._unscaled:
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
         self._unscaled = True
-        self._found_inf = False
         params = getattr(optimizer, "_parameter_list", None)
         if not params:
             # 2.0 Optimizer exposes the list as the `_params` property
@@ -138,22 +139,35 @@ class GradScaler:
         if not params:
             raise ValueError("optimizer has no parameters to unscale")
         inv = 1.0 / self._scale
+        all_finite = jnp.asarray(True)
         for p in params:
             g = p.grad
             if g is None:
                 continue
             raw = g._value if hasattr(g, "_value") else jnp.asarray(g)
             raw = raw.astype(jnp.float32) * inv
-            if not bool(jnp.all(jnp.isfinite(raw))):
-                self._found_inf = True
+            all_finite = all_finite & jnp.all(jnp.isfinite(raw))
             if hasattr(g, "_value"):
                 g._value = raw
             else:
                 p.grad = raw
+        self._found_inf = not bool(all_finite)  # single device→host sync
 
     def minimize(self, optimizer, scaled_loss, *args, **kwargs):
         """Unscale, skip-on-inf, step, update the dynamic scale."""
         if not self._enable:
+            return optimizer.minimize(scaled_loss, *args, **kwargs)
+        self.step(optimizer, scaled_loss, *args, **kwargs)
+        self._update()
+        return None
+
+    def step(self, optimizer, scaled_loss=None, *args, **kwargs):
+        """2.0 GradScaler.step: unscale (if not yet) and apply the optimizer
+        step unless non-finite grads were found.  Does NOT advance the
+        dynamic scale — pair with update(), or use minimize()."""
+        if not self._enable:
+            if hasattr(optimizer, "step"):
+                return optimizer.step()
             return optimizer.minimize(scaled_loss, *args, **kwargs)
         if not self._unscaled:
             self._unscale_and_check(optimizer)
@@ -162,12 +176,6 @@ class GradScaler:
                 optimizer.step()
             else:
                 optimizer.minimize(scaled_loss, *args, **kwargs)
-        self._update()
-        return None
-
-    def step(self, optimizer):
-        """2.0 GradScaler.step + update."""
-        self.minimize(optimizer, None)
 
     def unscale_(self, optimizer):
         self._unscale_and_check(optimizer)
@@ -178,6 +186,7 @@ class GradScaler:
     def _update(self):
         self._unscaled = False
         if not self._use_dynamic:
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad += 1
@@ -191,6 +200,7 @@ class GradScaler:
             if self._good >= self._incr_every_n_steps:
                 self._scale *= self._incr_ratio
                 self._good = 0
+        self._found_inf = False  # consumed; next step re-detects
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

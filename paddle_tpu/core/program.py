@@ -31,6 +31,7 @@ __all__ = [
     "VarDesc", "OpDesc", "Block", "Program", "default_main_program",
     "default_startup_program", "program_guard", "unique_name",
     "switch_main_program", "switch_startup_program", "name_scope", "OpRole",
+    "device_guard",
 ]
 
 
@@ -271,6 +272,10 @@ class Block:
                     attrs)
         op.attrs.setdefault("op_uid", self.program._next_uid())
         op.attrs.setdefault(OpRole.KEY, self.program._current_op_role)
+        if self.program._current_device is not None:
+            # pipeline stage annotation (reference fluid device_guard →
+            # op_device attr consumed by PipelineOptimizer)
+            op.attrs.setdefault("op_device", self.program._current_device)
         self.ops.append(op)
         # infer shapes/dtypes of outputs that don't have them yet
         from .infer_shape import infer_shape_for_op
@@ -309,6 +314,7 @@ class Program:
         self._uid = 0
         self._current_block_idx = 0
         self._current_op_role = OpRole.Forward
+        self._current_device: Optional[str] = None  # device_guard state
         self._version = 1
         # populated by append_backward: maps var -> grad var name
         self._grad_map: Dict[str, str] = {}
@@ -502,6 +508,21 @@ def unique_name(key: str = "tmp") -> str:
     n = _names.counters.get(full, 0)
     _names.counters[full] = n + 1
     return f"{full}_{n}"
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """Pipeline stage annotation (reference: fluid.device_guard — ops built
+    inside get attr op_device="gpu:k"; the PipelineOptimizer cuts the program
+    into per-device sections on this attr, trainer_desc section_param).
+    Accepts "gpu:k" / "xla:k" / "tpu:k" / "cpu:k" spellings."""
+    prog = default_main_program()
+    prev = prog._current_device
+    prog._current_device = device
+    try:
+        yield
+    finally:
+        prog._current_device = prev
 
 
 @contextlib.contextmanager

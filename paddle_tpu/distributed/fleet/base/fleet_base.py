@@ -128,8 +128,9 @@ class Fleet:
         candidates = MetaOptimizerFactory()._get_valid_meta_optimizers(
             self._user_defined_optimizer)
         if strategy.pipeline:
-            from ...pipeline.pipeline_optimizer import PipelineOptimizer
-            candidates.insert(-1, PipelineOptimizer(
+            from ....pipeline.pipeline_optimizer import \
+                FleetPipelineOptimizer
+            candidates.insert(-1, FleetPipelineOptimizer(
                 self._user_defined_optimizer))
         if not self._is_collective and self._role_maker and \
                 self._role_maker.get_pserver_endpoints():

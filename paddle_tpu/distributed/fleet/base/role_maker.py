@@ -62,7 +62,11 @@ class RoleMakerBase:
 
     def worker_num(self):
         self._ensure()
-        return max(1, len(self._worker_endpoints))
+        n = len(self._worker_endpoints)
+        if n <= 1:
+            # PS launch sets PADDLE_TRAINERS_NUM without trainer endpoints
+            n = max(n, int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        return max(1, n)
 
     def server_num(self):
         self._ensure()

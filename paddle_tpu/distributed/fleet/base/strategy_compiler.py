@@ -24,11 +24,14 @@ class StrategyCompiler:
             if meta._can_apply():
                 applicable.append(meta)
 
-        # resolve incompatibilities: earlier (inner) optimizer wins
+        # resolve incompatibilities (both directions): earlier (inner)
+        # optimizer wins
         chosen = []
         for meta in applicable:
             name = type(meta).__name__
-            if any(name in m._incompatible for m in chosen):
+            if any(name in m._incompatible or
+                   type(m).__name__ in meta._incompatible
+                   for m in chosen):
                 meta._disable_strategy(user_defined_strategy)
                 continue
             chosen.append(meta)

@@ -10,6 +10,8 @@ degenerate single-trainer behaviour as the reference.
 """
 from __future__ import annotations
 
+import warnings
+
 import numpy as np
 
 __all__ = ["UtilBase", "UtilFactory"]
@@ -40,7 +42,11 @@ class UtilBase:
             gathered = jax.experimental.multihost_utils \
                 .process_allgather(arr)
             return np.asarray(f(gathered, axis=0))
-        except Exception:
+        except Exception as e:
+            warnings.warn(
+                f"fleet.util.all_reduce fell back to the LOCAL value "
+                f"(multihost collective failed: {e}); global metrics "
+                f"will be per-worker only")
             return arr
 
     def barrier(self, comm_world="worker"):
@@ -49,8 +55,9 @@ class UtilBase:
         try:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("fleet_util_barrier")
-        except Exception:
-            pass
+        except Exception as e:
+            warnings.warn(f"fleet.util.barrier skipped "
+                          f"(multihost sync failed: {e})")
 
     def all_gather(self, input, comm_world="worker"):
         n = self._worker_num()
@@ -60,7 +67,9 @@ class UtilBase:
             from jax.experimental import multihost_utils
             out = multihost_utils.process_allgather(np.asarray(input))
             return [out[i] for i in range(out.shape[0])]
-        except Exception:
+        except Exception as e:
+            warnings.warn(f"fleet.util.all_gather returned only the "
+                          f"local value (multihost gather failed: {e})")
             return [input]
 
     # -- fs / program helpers ----------------------------------------------

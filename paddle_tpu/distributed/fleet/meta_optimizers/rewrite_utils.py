@@ -47,34 +47,35 @@ def append_masked_step_counter(program: Program, startup: Program,
         mask = (step % k == 0) [& step >= begin]
     """
     block = program.global_block()
+    # int32 counter: a float32 counter stops advancing at 2**24 steps
     step = unique_name(f"@{prefix}_step")
-    block.create_var(name=step, shape=(1,), dtype="float32",
+    block.create_var(name=step, shape=(1,), dtype="int32",
                      persistable=True, stop_gradient=True)
     sb = startup.global_block()
-    sb.create_var(name=step, shape=(1,), dtype="float32", persistable=True,
+    sb.create_var(name=step, shape=(1,), dtype="int32", persistable=True,
                   stop_gradient=True)
     d = OpDesc("fill_constant", {}, {"Out": [step]},
-               {"shape": [1], "value": 0.0, "dtype": "float32",
+               {"shape": [1], "value": 0, "dtype": "int32",
                 "op_uid": startup._next_uid()})
     sb.ops.append(d)
 
     _op(program, block, "increment", {"X": [step]}, {"Out": [step]},
-        {"step": 1.0})
-    kconst = new_tmp_var(block, name_hint=f"@{prefix}_k")
+        {"step": 1})
+    kconst = new_tmp_var(block, name_hint=f"@{prefix}_k", dtype="int32")
     _op(program, block, "fill_constant", {}, {"Out": [kconst]},
-        {"shape": [1], "value": float(k_steps), "dtype": "float32"})
-    rem = new_tmp_var(block, name_hint=f"@{prefix}_rem")
+        {"shape": [1], "value": int(k_steps), "dtype": "int32"})
+    rem = new_tmp_var(block, name_hint=f"@{prefix}_rem", dtype="int32")
     _op(program, block, "elementwise_mod", {"X": [step], "Y": [kconst]},
         {"Out": [rem]})
-    zero = new_tmp_var(block, name_hint=f"@{prefix}_zero")
+    zero = new_tmp_var(block, name_hint=f"@{prefix}_zero", dtype="int32")
     _op(program, block, "fill_constant", {}, {"Out": [zero]},
-        {"shape": [1], "value": 0.0, "dtype": "float32"})
+        {"shape": [1], "value": 0, "dtype": "int32"})
     mask = new_tmp_var(block, name_hint=f"@{prefix}_mask", dtype="bool")
     _op(program, block, "equal", {"X": [rem], "Y": [zero]}, {"Out": [mask]})
     if begin_step > 0:
-        beg = new_tmp_var(block, name_hint=f"@{prefix}_begin")
+        beg = new_tmp_var(block, name_hint=f"@{prefix}_begin", dtype="int32")
         _op(program, block, "fill_constant", {}, {"Out": [beg]},
-            {"shape": [1], "value": float(begin_step), "dtype": "float32"})
+            {"shape": [1], "value": int(begin_step), "dtype": "int32"})
         past = new_tmp_var(block, name_hint=f"@{prefix}_past", dtype="bool")
         _op(program, block, "greater_equal", {"X": [step], "Y": [beg]},
             {"Out": [past]})

@@ -1,0 +1,4 @@
+"""Pipeline parallelism (SURVEY.md §2.3 — PipelineTrainer/SectionWorker
+analog, TPU-native GPipe over per-stage XLA computations)."""
+from .pipeline_program import PipelineCompiledProgram, assign_stages  # noqa: F401
+from .pipeline_optimizer import PipelineOptimizer  # noqa: F401

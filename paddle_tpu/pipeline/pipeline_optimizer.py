@@ -1,0 +1,78 @@
+"""PipelineOptimizer — fluid wrapper + fleet meta-optimizer.
+
+Reference: fluid optimizer.py PipelineOptimizer (~:4400, cuts the program by
+device_guard annotations into sections, builds TrainerDesc section_param)
+and meta_optimizers/pipeline_optimizer.py:90 (fleet wrapper reading
+strategy.pipeline_configs, inserting inter-stage sync via PipelineHelper).
+
+TPU-native: minimize returns (ops, params_grads) and stores a
+PipelineCompiledProgram on the program; exe.run(<that program>) executes the
+GPipe schedule.  Inter-stage c_broadcast/c_allreduce insertion is not
+needed: boundary tensors move by device_put over ICI.
+"""
+from __future__ import annotations
+
+from ..core.program import default_startup_program
+from .pipeline_program import PipelineCompiledProgram
+
+__all__ = ["PipelineOptimizer", "FleetPipelineOptimizer"]
+
+
+class PipelineOptimizer:
+    """fluid-style: PipelineOptimizer(opt, num_microbatches=4)."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        compiled = PipelineCompiledProgram(
+            program, self._num_microbatches, params_grads)
+        program._pipeline_compiled = compiled
+        return ops, params_grads
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_optimizer"], item)
+
+
+# fleet meta-optimizer form (inserted by fleet_base when strategy.pipeline)
+from ..distributed.fleet.meta_optimizers.meta_optimizer_base import \
+    MetaOptimizerBase
+
+
+class FleetPipelineOptimizer(MetaOptimizerBase):
+    # pipeline owns the executor: DP-over-mesh (GraphExecution) and k-step
+    # rewrites don't compose with the staged scheduler in this round
+    _incompatible = ("GradientMergeOptimizer", "LocalSGDOptimizer",
+                     "GraphExecutionOptimizer")
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.pipeline)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.pipeline = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        c = self.user_defined_strategy.pipeline_configs
+        m = c.get("accumulate_steps", c.get("micro_batch", 1))
+        wrapped = PipelineOptimizer(self.inner_opt, num_microbatches=m)
+        result = wrapped.minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
+        # expose the pipeline program as fleet.main_program
+        program = loss.block.program
+        program._compiled_for_fleet = program._pipeline_compiled
+        return result

@@ -4,6 +4,7 @@ Analog of `paddle.fluid` / `paddle.static`: Program construction, layers,
 Executor, backward, optimizers, initializers (SURVEY.md §2.2 P1-P6).
 """
 from ..core.program import (  # noqa: F401
+    device_guard,
     Program, Block, OpDesc, VarDesc, OpRole, default_main_program,
     default_startup_program, program_guard, name_scope, unique_name,
 )

@@ -172,8 +172,19 @@ class Executor:
             return_numpy=True, use_program_cache=True, fetch_var_name="fetch",
             feed_var_name="feed", use_prune=False):
         from ..distributed.compiled_program import CompiledProgram
-        if isinstance(program, CompiledProgram):
+        if isinstance(program, CompiledProgram) or (
+                program is not None and not isinstance(program, Program)
+                and hasattr(program, "_run")):
+            # CompiledProgram / Pipeline / PS trainer program dispatch
             return program._run(self, feed, fetch_list, scope, return_numpy)
+        if getattr(program, "_ps_server_config", None):
+            # pserver program: exe.run(pserver_prog) == listen_and_serv
+            from ..distributed.ps.kv_server import KVServer
+            cfg = program._ps_server_config
+            server = KVServer(cfg["endpoint"],
+                              num_trainers=cfg.get("num_trainers", 1))
+            server.serve()  # blocks until a SHUTDOWN rpc
+            return []
         program = program if program is not None else default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
