@@ -194,7 +194,16 @@ def scatter(tensor, tensor_list=None, src=0, group=None, use_calc_stream=True):
             tensor._value = (src_t._value if isinstance(src_t, Tensor)
                              else src_t)
             return None
-        stacked = concat(tensor_list, axis=0)
+        # non-src ranks may pass tensor_list=None (collective.py:347
+        # contract); the kernel broadcasts from root, so they contribute a
+        # zero full-shaped buffer (n stacked shards)
+        if tensor_list:
+            stacked = concat(tensor_list, axis=0)
+        else:
+            import jax.numpy as jnp
+            z = jnp.zeros((n * tensor.shape[0],) + tuple(tensor.shape[1:]),
+                          tensor._value.dtype)
+            stacked = Tensor(z)
         out = _eager("c_scatter", stacked, attrs)
         tensor._value = out._value
         return None

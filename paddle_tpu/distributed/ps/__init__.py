@@ -1,0 +1,4 @@
+"""Parameter-server capability tier (reference: C9 operators/distributed RPC
+runtime + P15 fleet PS transpilers, SURVEY.md §2).  TPU deployment note:
+collective (mesh) training is the primary path; the PS tier serves the
+sparse-embedding / CPU-worker capability."""

@@ -1,0 +1,294 @@
+"""Parameter-server KV runtime: TCP server + client.
+
+Reference: /root/reference/paddle/fluid/operators/distributed/ — the
+gRPC/BRPC `RPCServer`/`RPCClient` (grpc_client.h:211 AsyncSendVar/
+AsyncGetVar), `listen_and_serv_op`, and the sync/async/geo communicator
+(communicator.h:183-401).
+
+TPU-native design: the PS tier serves the CPU/sparse capability, so it is a
+host-side service — a threaded TCP server speaking a length-prefixed binary
+protocol (numpy buffers; no pickle-over-the-wire for values).  The dense
+collective path never touches this; XLA collectives own it.
+
+Server-side optimization (sync mode): like the reference pserver running
+optimizer blocks, the server applies `param -= lr * mean(grads)` once all
+trainers' pushes for a step arrive (barrier counting, heart-beat friendly).
+Async mode applies each push immediately (Hogwild, communicator.h
+AsyncCommunicator semantics).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["KVServer", "KVClient"]
+
+_MAGIC = b"PSRPC1\n"
+# ops
+OP_INIT = 1        # set param if absent
+OP_PULL = 2        # get param
+OP_PUSH_SYNC = 3   # push grad; applied when all trainers arrive
+OP_PUSH_ASYNC = 4  # push grad; applied immediately
+OP_BARRIER = 5
+OP_SHUTDOWN = 6
+OP_PING = 7
+OP_SET = 8         # overwrite param (geo-SGD delta merge uses add)
+OP_PUSH_DELTA = 9  # geo: add delta to param
+
+
+def _send_msg(sock, op: int, name: str, arr: Optional[np.ndarray],
+              extra: float = 0.0):
+    name_b = name.encode()
+    if arr is not None:
+        arr = np.ascontiguousarray(arr)
+        dtype_b = str(arr.dtype).encode()
+        shape = arr.shape
+        payload = arr.tobytes()
+    else:
+        dtype_b, shape, payload = b"", (), b""
+    shape_b = ",".join(str(s) for s in shape).encode()
+    header = struct.pack("!BIIIdI", op, len(name_b), len(dtype_b),
+                         len(shape_b), extra, len(payload))
+    sock.sendall(_MAGIC + header + name_b + dtype_b + shape_b + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    magic = _recv_exact(sock, len(_MAGIC))
+    if magic != _MAGIC:
+        raise ConnectionError("bad magic")
+    header = _recv_exact(sock, struct.calcsize("!BIIIdI"))
+    op, nl, dl, sl, extra, pl = struct.unpack("!BIIIdI", header)
+    name = _recv_exact(sock, nl).decode() if nl else ""
+    dtype = _recv_exact(sock, dl).decode() if dl else ""
+    shape_s = _recv_exact(sock, sl).decode() if sl else ""
+    payload = _recv_exact(sock, pl) if pl else b""
+    arr = None
+    if dtype:
+        shape = tuple(int(x) for x in shape_s.split(",")) if shape_s else ()
+        arr = np.frombuffer(payload, dtype=dtype).reshape(shape).copy()
+    return op, name, arr, extra
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: "KVServer" = self.server.kv  # type: ignore
+        sock = self.request
+        try:
+            while True:
+                op, name, arr, extra = _recv_msg(sock)
+                if op == OP_PING:
+                    _send_msg(sock, OP_PING, "", None)
+                elif op == OP_INIT:
+                    with srv._lock:
+                        srv._store.setdefault(name, arr.astype(np.float32))
+                    _send_msg(sock, OP_INIT, name, None)
+                elif op == OP_SET:
+                    with srv._lock:
+                        srv._store[name] = arr.astype(np.float32)
+                    _send_msg(sock, OP_SET, name, None)
+                elif op == OP_PULL:
+                    with srv._lock:
+                        val = srv._store.get(name)
+                    _send_msg(sock, OP_PULL, name, val)
+                elif op == OP_PUSH_ASYNC:
+                    with srv._lock:
+                        srv._apply(name, arr, extra)
+                    _send_msg(sock, OP_PUSH_ASYNC, name, None)
+                elif op == OP_PUSH_DELTA:
+                    with srv._lock:
+                        if name in srv._store:
+                            srv._store[name] = srv._store[name] + \
+                                arr.astype(np.float32)
+                    _send_msg(sock, OP_PUSH_DELTA, name, None)
+                elif op == OP_PUSH_SYNC:
+                    srv._push_sync(name, arr, extra)
+                    _send_msg(sock, OP_PUSH_SYNC, name, None)
+                elif op == OP_BARRIER:
+                    srv._barrier_wait()
+                    _send_msg(sock, OP_BARRIER, "", None)
+                elif op == OP_SHUTDOWN:
+                    _send_msg(sock, OP_SHUTDOWN, "", None)
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+
+class KVServer:
+    """listen_and_serv analog: blocking `serve()`, thread-safe store."""
+
+    def __init__(self, endpoint: str, num_trainers: int = 1):
+        host, port = endpoint.rsplit(":", 1)
+        self.num_trainers = max(1, num_trainers)
+        self._store: Dict[str, np.ndarray] = {}
+        self._lock = threading.RLock()
+        self._pending: Dict[str, List[np.ndarray]] = {}
+        self._push_gen: Dict[str, int] = {}
+        self._sync_cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._tcp = socketserver.ThreadingTCPServer(
+            (host or "127.0.0.1", int(port)), _Handler)
+        # handler threads block in recv on live client connections; never
+        # join them on close (clients own the connection lifetime)
+        self._tcp.daemon_threads = True
+        self._tcp.block_on_close = False
+        self._tcp.kv = self  # type: ignore
+        self.endpoint = f"{host}:{self._tcp.server_address[1]}"
+
+    # server-side sgd (reference pserver optimizer block)
+    def _apply(self, name, grad, lr):
+        if name in self._store and grad is not None:
+            self._store[name] = self._store[name] - \
+                float(lr) * grad.astype(np.float32)
+
+    def _push_sync(self, name, grad, lr):
+        """Accumulate; apply the mean once num_trainers pushes arrive.
+        Per-name generation counter avoids the wake-after-next-round race."""
+        with self._sync_cv:
+            self._pending.setdefault(name, []).append(grad)
+            if len(self._pending[name]) >= self.num_trainers:
+                grads = self._pending.pop(name)
+                with self._lock:
+                    self._apply(name, np.mean(grads, axis=0), lr)
+                self._push_gen[name] = self._push_gen.get(name, 0) + 1
+                self._sync_cv.notify_all()
+            else:
+                my_gen = self._push_gen.get(name, 0)
+                while self._push_gen.get(name, 0) == my_gen:
+                    if not self._sync_cv.wait(timeout=30):
+                        raise TimeoutError(
+                            f"sync push of {name!r}: not all "
+                            f"{self.num_trainers} trainers arrived")
+
+    def _barrier_wait(self):
+        with self._sync_cv:
+            self._barrier_count += 1
+            if self._barrier_count >= self.num_trainers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._sync_cv.notify_all()
+            else:
+                gen = self._barrier_gen
+                while gen == self._barrier_gen:
+                    if not self._sync_cv.wait(timeout=60):
+                        raise TimeoutError("barrier timeout")
+
+    def serve(self):
+        self._tcp.serve_forever(poll_interval=0.1)
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    def get(self, name):
+        with self._lock:
+            return self._store.get(name)
+
+
+class KVClient:
+    """RPCClient analog: one socket per pserver, vars sharded round-robin
+    by name hash (DistributeTranspiler round-robin param placement,
+    transpiler/distribute_transpiler.py:80 VarBlock)."""
+
+    def __init__(self, endpoints: List[str]):
+        self.endpoints = list(endpoints)
+        self._socks: Dict[str, socket.socket] = {}
+
+    def _sock(self, ep) -> socket.socket:
+        s = self._socks.get(ep)
+        if s is None:
+            host, port = ep.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[ep] = s
+        return s
+
+    def _ep_for(self, name: str) -> str:
+        # stable across processes (builtin hash() is seed-randomized and
+        # would shard the same param to different servers per process)
+        import zlib
+        return self.endpoints[zlib.crc32(name.encode())
+                              % len(self.endpoints)]
+
+    def _call(self, ep, op, name="", arr=None, extra=0.0):
+        s = self._sock(ep)
+        _send_msg(s, op, name, arr, extra)
+        return _recv_msg(s)
+
+    def wait_server_ready(self, timeout=60):
+        """rpc wait_server_ready parity: ping until every server answers."""
+        deadline = time.time() + timeout
+        for ep in self.endpoints:
+            while True:
+                try:
+                    self._call(ep, OP_PING)
+                    break
+                except (ConnectionError, OSError):
+                    self._socks.pop(ep, None)
+                    if time.time() > deadline:
+                        raise TimeoutError(f"pserver {ep} not ready")
+                    time.sleep(0.2)
+
+    def init_param(self, name, value):
+        self._call(self._ep_for(name), OP_INIT, name, np.asarray(value))
+
+    def set_param(self, name, value):
+        self._call(self._ep_for(name), OP_SET, name, np.asarray(value))
+
+    def pull(self, name) -> np.ndarray:
+        _, _, arr, _ = self._call(self._ep_for(name), OP_PULL, name)
+        if arr is None:
+            raise KeyError(f"param {name!r} not on server")
+        return arr
+
+    def push_grad(self, name, grad, lr, sync=True):
+        op = OP_PUSH_SYNC if sync else OP_PUSH_ASYNC
+        self._call(self._ep_for(name), op, name, np.asarray(grad),
+                   float(lr))
+
+    def push_delta(self, name, delta):
+        self._call(self._ep_for(name), OP_PUSH_DELTA, name,
+                   np.asarray(delta))
+
+    def barrier(self):
+        for ep in self.endpoints:
+            self._call(ep, OP_BARRIER)
+
+    def shutdown_servers(self):
+        for ep in list(self._socks) or self.endpoints:
+            try:
+                self._call(ep, OP_SHUTDOWN)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._socks.clear()

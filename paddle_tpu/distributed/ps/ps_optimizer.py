@@ -1,0 +1,246 @@
+"""Parameter-server training: transpiler + fleet meta-optimizer + runtime.
+
+Reference: /root/reference/python/paddle/fluid/transpiler/
+distribute_transpiler.py:256 `DistributeTranspiler` (splits a Program into
+trainer/pserver/startup programs; grads sent, params pulled),
+fluid/incubate/fleet/parameter_server, and the communicator modes
+(operators/distributed/communicator.h:183-401 — Sync / HalfAsync(Async) /
+Geo).
+
+TPU-native redesign: the trainer's fwd+bwd stays ONE jitted XLA computation
+(grads come back as fetches); the RPC plane is the host-side KV service
+(kv_server.py).  Modes:
+  * sync  — push grads (server applies mean once all trainers arrive), pull
+  * async — push grads applied immediately (Hogwild), pull
+  * geo   — train locally with the real optimizer; every k steps push the
+            param delta since last sync and pull the merged value
+            (GeoCommunicator, communicator.h geo-SGD)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.program import Program, OpRole
+from ..fleet.meta_optimizers.meta_optimizer_base import MetaOptimizerBase
+
+__all__ = ["ParameterServerOptimizer", "DistributeTranspiler",
+           "DistributeTranspilerConfig", "PSCompiledProgram"]
+
+
+class DistributeTranspilerConfig:
+    """transpiler config parity (slice_var_up etc. accepted, unused)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+
+
+def _strip_optimizer_ops(program: Program) -> Program:
+    """Trainer side keeps fwd+bwd only (transpiler removes opt ops and
+    replaces them with send/recv — here the runtime does push/pull)."""
+    block = program.global_block()
+    block.ops = [op for op in block.ops
+                 if not (op.op_role & OpRole.Optimize
+                         or op.op_role == OpRole.LRSched)]
+    program._fingerprint_cache = None
+    return program
+
+
+class PSCompiledProgram:
+    """Runnable PS trainer program (pass to exe.run).
+
+    fwd+bwd runs jitted; each step: push grads → pull params → scope.
+    geo mode: full local program runs (with optimizer); every k steps the
+    param delta is pushed and the merged value pulled.
+    """
+
+    def __init__(self, program: Program, params_grads, mode: str = "sync",
+                 lr: float = 0.01, geo_k: int = 100, endpoints=None,
+                 trainer_id: int = 0):
+        self._program = program
+        self._params = [p.name for p, _ in params_grads]
+        self._grads = {p.name: g.name for p, g in params_grads}
+        self._mode = mode
+        self._lr = lr
+        self._geo_k = geo_k
+        self._endpoints = endpoints
+        self._trainer_id = trainer_id
+        self._client = None
+        self._inited = False
+        self._step = 0
+        self._last_sync: Dict[str, np.ndarray] = {}
+
+    def _get_client(self):
+        if self._client is None:
+            from .kv_server import KVClient
+            from ..parallel_env import ParallelEnv
+            import os
+            eps = self._endpoints or [
+                e for e in os.environ.get(
+                    "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+            if not eps:
+                raise RuntimeError("no pserver endpoints for PS training")
+            self._client = KVClient(eps)
+            self._client.wait_server_ready()
+        return self._client
+
+    def _init_params(self, scope):
+        client = self._get_client()
+        for p in self._params:
+            v = scope.get(p)
+            if v is not None:
+                client.init_param(p, np.asarray(v))  # first writer wins
+        for p in self._params:
+            val = client.pull(p)
+            scope.set(p, val)
+            self._last_sync[p] = val.copy()
+        self._inited = True
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        from ...static.executor import global_scope
+        scope = scope or global_scope()
+        if not self._inited:
+            self._init_params(scope)
+        client = self._client
+        fetch_list = list(fetch_list or [])
+
+        if self._mode == "geo":
+            # local step with the real optimizer; periodic delta sync
+            res = executor.run(self._program, feed=feed,
+                               fetch_list=fetch_list, scope=scope,
+                               return_numpy=return_numpy)
+            self._step += 1
+            if self._step % self._geo_k == 0:
+                for p in self._params:
+                    cur = np.asarray(scope.get(p))
+                    client.push_delta(p, cur - self._last_sync[p])
+                    merged = client.pull(p)
+                    scope.set(p, merged)
+                    self._last_sync[p] = merged.copy()
+            return res
+
+        # sync/async: fetch grads out of the jitted fwd+bwd step
+        grad_names = [self._grads[p] for p in self._params]
+        all_res = executor.run(self._program, feed=feed,
+                               fetch_list=fetch_list + grad_names,
+                               scope=scope, return_numpy=True)
+        user_res = all_res[: len(fetch_list)]
+        if not return_numpy:
+            import jax.numpy as jnp
+            user_res = [jnp.asarray(r) for r in user_res]
+        grads = all_res[len(fetch_list):]
+        lr = self._current_lr(scope)
+        for p, g in zip(self._params, grads):
+            client.push_grad(p, g, lr, sync=(self._mode == "sync"))
+        for p in self._params:
+            scope.set(p, client.pull(p))
+        self._step += 1
+        return user_res
+
+    def _current_lr(self, scope):
+        for name in scope.keys():
+            if name.startswith("learning_rate"):
+                try:
+                    return float(np.asarray(scope.get(name)).reshape(()))
+                except (TypeError, ValueError):
+                    pass
+        return self._lr
+
+
+class DistributeTranspiler:
+    """fluid.transpiler.DistributeTranspiler API parity."""
+
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program = None
+        self._pserver_endpoint = None
+        self._startup = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  startup_program=None, current_endpoint=""):
+        from ...core.program import default_main_program, \
+            default_startup_program
+        self._program = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        self._pservers = [e for e in pservers.split(",") if e]
+        self._trainers = trainers
+        self._trainer_id = trainer_id
+        self._current_endpoint = current_endpoint
+
+    def get_trainer_program(self, wait_port=True) -> PSCompiledProgram:
+        pgs = getattr(self._program, "_ps_params_grads", None)
+        if pgs is None:
+            raise RuntimeError(
+                "transpile() requires a program minimized by an optimizer "
+                "(params_grads recorded)")
+        if self.config.geo_sgd_mode:
+            mode = "geo"
+            prog = self._program  # geo keeps local optimizer ops
+        else:
+            mode = "sync" if self.config.sync_mode else "async"
+            prog = _strip_optimizer_ops(self._program.clone())
+        return PSCompiledProgram(
+            prog, pgs, mode=mode,
+            geo_k=self.config.geo_sgd_need_push_nums,
+            endpoints=self._pservers, trainer_id=self._trainer_id)
+
+    def get_pserver_program(self, endpoint) -> Program:
+        """A marker program whose execution serves the KV store
+        (listen_and_serv semantics)."""
+        p = Program()
+        p.global_block().append_op(
+            "listen_and_serv", {}, {},
+            {"endpoint": endpoint, "Fanin": self._trainers,
+             OpRole.KEY: OpRole.RPC})
+        p._ps_server_config = {"endpoint": endpoint,
+                               "num_trainers": self._trainers}
+        return p
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), Program()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return startup_program or self._startup
+
+
+class ParameterServerOptimizer(MetaOptimizerBase):
+    """fleet PS meta-optimizer (incubate/fleet/parameter_server analog):
+    minimize → record params_grads, strip opt ops (sync/async) or keep them
+    (geo), produce a PSCompiledProgram as fleet.main_program."""
+
+    def _can_apply(self):
+        return not getattr(self.user_defined_strategy, "_is_collective",
+                           False)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        program._ps_params_grads = params_grads
+        s = self.user_defined_strategy
+        a_sync = bool(s.a_sync)
+        k = s.a_sync_configs.get("k_steps", -1)
+        if a_sync and k > 0:
+            mode = "geo"
+            prog = program  # local optimizer kept
+        elif a_sync:
+            mode = "async"
+            prog = _strip_optimizer_ops(program.clone())
+        else:
+            mode = "sync"
+            prog = _strip_optimizer_ops(program.clone())
+        geo_k = max(1, k) if k > 0 else 100
+        compiled = PSCompiledProgram(
+            prog, params_grads, mode=mode, geo_k=geo_k,
+            trainer_id=self.role_maker.worker_index()
+            if self.role_maker else 0)
+        program._compiled_for_fleet = compiled
+        return ops, params_grads

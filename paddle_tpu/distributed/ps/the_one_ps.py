@@ -1,0 +1,54 @@
+"""PS runtime front-door used by fleet.init_worker/init_server/run_server.
+
+Reference: the fleet PS runtime (pslib / the_one_ps in later paddle;
+here the 1.x capability surface: RoleMaker-driven server start + worker
+communicator init, operators/distributed/communicator.h:183-401).
+
+The actual KV server/client live in kv_server.py (TCP, msgpack-free binary
+protocol) — see that module; this adapter binds them to the Fleet object.
+"""
+from __future__ import annotations
+
+__all__ = ["ps_runtime", "PSRuntime"]
+
+
+class PSRuntime:
+    def __init__(self):
+        self._server = None
+        self._client = None
+
+    # fleet.init_worker()
+    def init_worker(self, fleet):
+        from .kv_server import KVClient
+        eps = fleet.server_endpoints()
+        if not eps:
+            raise RuntimeError("no pserver endpoints configured "
+                               "(PADDLE_PSERVERS_IP_PORT_LIST)")
+        self._client = KVClient(eps)
+        self._client.wait_server_ready()
+        fleet._ps_client = self._client
+
+    # fleet.init_server() / run_server()
+    def init_server(self, fleet, *args, **kwargs):
+        from .kv_server import KVServer
+        idx = fleet.server_index()
+        ep = fleet.server_endpoints()[idx]
+        self._server = KVServer(ep, num_trainers=fleet.worker_num())
+        fleet._ps_server = self._server
+
+    def run_server(self, fleet):
+        if self._server is None:
+            self.init_server(fleet)
+        self._server.serve()  # blocks (listen_and_serv semantics)
+
+    def stop_worker(self, fleet):
+        if self._client is not None:
+            self._client.shutdown_servers()
+            self._client.close()
+
+
+_runtime = PSRuntime()
+
+
+def ps_runtime() -> PSRuntime:
+    return _runtime

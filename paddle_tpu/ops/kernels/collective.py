@@ -107,6 +107,10 @@ def c_scatter(ins, attrs, ctx):
     ax = axes if isinstance(axes, str) else axes[0]
     n = jax.lax.axis_size(ax)
     idx = jax.lax.axis_index(ax)
+    # only the root's buffer is meaningful — broadcast it first so non-root
+    # ranks may contribute an arbitrary (e.g. zero) full-shaped buffer
+    root = attrs.get("root", 0)
+    x = jax.lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axes)
     shard = x.shape[0] // n
     return {"Out": jax.lax.dynamic_slice_in_dim(x, idx * shard, shard, 0)}
 

@@ -98,3 +98,12 @@ def optimization_barrier(ins, attrs, ctx):
         return {"Out": []}
     outs = jax.lax.optimization_barrier(tuple(xs))
     return {"Out": list(outs)}
+
+
+@register_op("listen_and_serv", inputs=["X*"], outputs=[], grad=None,
+             side_effect=True)
+def listen_and_serv(ins, attrs, ctx):
+    """Marker op (reference: operators/distributed_ops/listen_and_serv_op.cc).
+    The executor intercepts programs carrying _ps_server_config and serves
+    the KV store host-side; reaching this kernel directly is a no-op."""
+    return {}

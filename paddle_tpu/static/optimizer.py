@@ -222,6 +222,9 @@ class Optimizer:
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         ops = self.apply_gradients(params_grads)
+        # recorded for the PS transpiler (DistributeTranspiler reads the
+        # param/grad pairing off the program, transpiler flow parity)
+        loss.block.program._ps_params_grads = params_grads
         return ops, params_grads
 
     def _append_optimize_op(self, param, grad, lr):

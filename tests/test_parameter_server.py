@@ -1,0 +1,187 @@
+"""Parameter-server tier tests — in-process loopback, the reference's own
+pattern (operators/distributed/rpc_server_test.cc, collective_server_test.cc
+run client+server in one process)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _start_server(num_trainers=1):
+    from paddle_tpu.distributed.ps.kv_server import KVServer
+    srv = KVServer("127.0.0.1:0", num_trainers=num_trainers)
+    srv.serve_in_thread()
+    return srv
+
+
+def test_kv_roundtrip_and_modes():
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    srv = _start_server()
+    try:
+        c = KVClient([srv.endpoint])
+        c.wait_server_ready()
+        w = np.arange(6, dtype=np.float32).reshape(2, 3)
+        c.init_param("w", w)
+        c.init_param("w", w * 100)  # first writer wins
+        np.testing.assert_allclose(c.pull("w"), w)
+        # async push: applied immediately, p -= lr*g
+        g = np.ones_like(w)
+        c.push_grad("w", g, lr=0.5, sync=False)
+        np.testing.assert_allclose(c.pull("w"), w - 0.5)
+        # sync push with 1 trainer applies directly
+        c.push_grad("w", g, lr=0.5, sync=True)
+        np.testing.assert_allclose(c.pull("w"), w - 1.0)
+        # geo delta
+        c.push_delta("w", np.full_like(w, 0.25))
+        np.testing.assert_allclose(c.pull("w"), w - 0.75)
+        c.barrier()
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_kv_sync_two_trainers():
+    """Two client threads push; server applies the MEAN once both arrive."""
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    srv = _start_server(num_trainers=2)
+    try:
+        c0 = KVClient([srv.endpoint])
+        c0.init_param("w", np.zeros(4, np.float32))
+        results = []
+
+        def trainer(gval):
+            c = KVClient([srv.endpoint])
+            c.push_grad("w", np.full(4, gval, np.float32), lr=1.0,
+                        sync=True)
+            results.append(gval)
+            c.close()
+
+        t0 = threading.Thread(target=trainer, args=(1.0,))
+        t1 = threading.Thread(target=trainer, args=(3.0,))
+        t0.start(); t1.start()
+        t0.join(10); t1.join(10)
+        assert len(results) == 2
+        # mean grad = 2.0, lr 1.0 → w = -2
+        np.testing.assert_allclose(c0.pull("w"), -2.0 * np.ones(4))
+        c0.close()
+    finally:
+        srv.stop()
+
+
+def _linreg():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.parametrize("sync_mode", [True, False])
+def test_ps_transpiler_end_to_end(sync_mode):
+    from paddle_tpu.distributed.ps.ps_optimizer import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+    srv = _start_server(num_trainers=1)
+    try:
+        main, startup, loss = _linreg()
+        cfg = DistributeTranspilerConfig()
+        cfg.sync_mode = sync_mode
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main, pservers=srv.endpoint,
+                    trainers=1)
+        trainer_prog = t.get_trainer_program()
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 8).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        with static.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for _ in range(25):
+                (lv,) = exe.run(trainer_prog, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    finally:
+        srv.stop()
+
+
+def test_ps_geo_mode():
+    from paddle_tpu.distributed.ps.ps_optimizer import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+    srv = _start_server(num_trainers=1)
+    try:
+        main, startup, loss = _linreg()
+        cfg = DistributeTranspilerConfig()
+        cfg.geo_sgd_mode = True
+        cfg.geo_sgd_need_push_nums = 5
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main, pservers=srv.endpoint,
+                    trainers=1)
+        trainer_prog = t.get_trainer_program()
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 8).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        with static.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for _ in range(20):
+                (lv,) = exe.run(trainer_prog, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                losses.append(float(lv))
+            # after a sync point the server holds the merged params
+            wname = main.all_parameters()[0].name
+            assert srv.get(wname) is not None
+        assert losses[-1] < losses[0] * 0.5
+    finally:
+        srv.stop()
+
+
+def test_fleet_ps_mode(monkeypatch):
+    """fleet.init PS flow: role maker env + strategy.a_sync."""
+    srv = _start_server(num_trainers=1)
+    try:
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", srv.endpoint)
+        from paddle_tpu.distributed.fleet.base.fleet_base import Fleet
+        import paddle_tpu.distributed as dist
+        f = Fleet()
+        f.init(is_collective=False)
+        main, startup, loss_prog = static.Program(), static.Program(), None
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 8])
+            y = layers.data("y", [-1, 1])
+            pred = layers.fc(x, size=1)
+            loss = layers.mean(
+                layers.square(layers.elementwise_sub(pred, y)))
+            strategy = dist.fleet.DistributedStrategy()
+            strategy.a_sync = True
+            f.distributed_optimizer(static.SGD(learning_rate=0.05),
+                                    strategy)
+            f.minimize(loss)
+        assert "ParameterServerOptimizer" in f.applied_meta_list()
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(1)
+        xb = rng.rand(16, 8).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        with static.scope_guard(scope):
+            exe.run(startup)
+            l0 = None
+            for _ in range(20):
+                (lv,) = exe.run(f.main_program, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                l0 = l0 if l0 is not None else float(lv)
+        assert float(lv) < l0 * 0.6
+    finally:
+        srv.stop()
