@@ -51,7 +51,7 @@ try:
 except ImportError:
     pass
 try:
-    from .hapi.model import Model  # noqa: F401
+    from .hapi.model import Model, Input  # noqa: F401
 except ImportError:
     pass
 try:

@@ -1,1 +1,7 @@
-
+"""paddle.hapi — high-level Model API (reference: python/paddle/hapi/)."""
+from .model import Model, Input, summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+)
+from .progressbar import ProgressBar  # noqa: F401

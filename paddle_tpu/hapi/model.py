@@ -1,0 +1,312 @@
+"""paddle.Model — the Keras-like high-level train/eval/predict API.
+
+Reference: /root/reference/python/paddle/hapi/model.py:788 `Model` with
+`fit` (:1242), `evaluate`, `predict`, `train_batch`/`eval_batch`,
+`save`/`load`, `summary`; Input specs from hapi; static+dynamic adapters.
+
+TPU note: this implementation drives the dygraph engine (each batch is an
+eager step over jitted kernels); for the big jit-everything path use the
+static API (`paddle_tpu.static`) or wrap the Layer with
+`paddle_tpu.jit.to_static`.  Multi-device data parallelism composes via
+`paddle_tpu.distributed.DataParallel` around the network.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..dygraph.layers import Layer
+from ..dygraph.tensor import Tensor, to_tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "Input", "summary"]
+
+
+class Input:
+    """hapi Input spec (name/shape/dtype), used for summary and
+    save_inference parity."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"Input(name={self.name}, shape={self.shape}, " \
+               f"dtype={self.dtype})"
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _make_loader(data, batch_size, shuffle, drop_last, num_workers):
+    from ..io import DataLoader, Dataset
+    if data is None:
+        return None
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    raise TypeError(f"train_data must be Dataset or DataLoader, "
+                    f"got {type(data)}")
+
+
+class Model:
+    """hapi/model.py:788 parity (dygraph adapter)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._amp = False
+        self._amp_level = "O1"
+        self.stop_training = False
+
+    # -- prepare ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._amp = amp_configs is not None
+        self._amp_level = (amp_configs or {}).get("level", "O1") \
+            if isinstance(amp_configs, dict) else "O1"
+        return self
+
+    # -- single-batch ops ----------------------------------------------------
+    def _forward(self, inputs):
+        ins = [to_tensor(np.asarray(x)) if not isinstance(x, Tensor) else x
+               for x in _to_list(inputs)]
+        out = self.network(*ins)
+        return out
+
+    def _compute_loss(self, outputs, labels):
+        labels = [to_tensor(np.asarray(y)) if not isinstance(y, Tensor)
+                  else y for y in _to_list(labels)]
+        outs = _to_list(outputs)
+        if self._loss is None:
+            raise RuntimeError("prepare(loss=...) required for training")
+        return self._loss(*(outs + labels)), outs, labels
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """hapi model.py train_batch: one fwd/bwd/step."""
+        self.network.train()
+        if self._amp:
+            from ..amp import auto_cast
+            with auto_cast(level=self._amp_level):
+                outputs = self._forward(inputs)
+        else:
+            outputs = self._forward(inputs)
+        loss, outs, lbls = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            if hasattr(self._optimizer, "step"):
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            else:  # fluid-style
+                self._optimizer.minimize(loss)
+                self.network.clear_gradients()
+        metrics = self._update_metrics(outs, lbls)
+        return [float(np.asarray(loss.numpy()))] + metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..dygraph.base import no_grad
+        with no_grad():
+            outputs = self._forward(inputs)
+            loss, outs, lbls = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outs, lbls)
+        return [float(np.asarray(loss.numpy()))] + metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..dygraph.base import no_grad
+        with no_grad():
+            out = self._forward(inputs)
+        return [np.asarray(o.numpy()) for o in _to_list(out)]
+
+    def _update_metrics(self, outs, lbls):
+        """Metric protocol parity (metric/metrics.py): compute(pred, label)
+        → intermediate(s) → update(*intermediates)."""
+        vals = []
+        for m in self._metrics:
+            raw = [np.asarray(t.numpy()) if hasattr(t, "numpy")
+                   else np.asarray(t) for t in (outs + lbls)]
+            inter = m.compute(*raw)
+            if not isinstance(inter, (list, tuple)):
+                inter = (inter,)
+            m.update(*inter)
+            acc = m.accumulate()
+            accs = acc if isinstance(acc, (list, tuple)) else [acc]
+            vals.extend(float(np.asarray(a).reshape(-1)[0]) for a in accs)
+        return vals
+
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name() if callable(getattr(m, "name", None)) else str(m)
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    # -- fit / evaluate / predict -------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        """hapi model.py:1242 parity."""
+        loader = _make_loader(train_data, batch_size, shuffle, drop_last,
+                              num_workers)
+        eval_loader = _make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=["loss"] + self._metric_names())
+        self.stop_training = False
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                res = self.train_batch(ins, lbls)
+                logs = dict(zip(["loss"] + self._metric_names(), res))
+                cbks.on_train_batch_end(step, logs)
+            cbks.on_epoch_end(epoch, logs)
+            history.append(logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=0, callbacks=callbacks)
+        cbks.on_train_end()
+        return history
+
+    def _split_batch(self, batch):
+        batch = _to_list(batch)
+        n_in = max(1, len(self._inputs)) if self._inputs else 1
+        if len(batch) == 1:
+            return batch, []
+        if self._inputs:
+            return batch[:n_in], batch[n_in:]
+        return batch[:-1] if len(batch) > 1 else batch, batch[-1:]
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _make_loader(eval_data, batch_size, False, False,
+                              num_workers)
+        for m in self._metrics:
+            m.reset()
+        cbks = config_callbacks(callbacks, model=self, steps=None,
+                                verbose=verbose,
+                                metrics=["loss"] + self._metric_names(),
+                                force_params=False)
+        cbks.on_eval_begin()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, lbls = self._split_batch(batch)
+            res = self.eval_batch(ins, lbls)
+            losses.append(res[0])
+            logs = dict(zip(["loss"] + self._metric_names(), res))
+        logs["loss"] = float(np.mean(losses)) if losses else 0.0
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=0):
+        loader = _make_loader(test_data, batch_size, False, False,
+                              num_workers)
+        outputs: List[List[np.ndarray]] = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+        # transpose: list over outputs, each a list over batches
+        n_out = len(outputs[0]) if outputs else 0
+        per_out = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            per_out = [np.concatenate(o, axis=0) for o in per_out]
+        return per_out
+
+    # -- save / load / summary ----------------------------------------------
+    def save(self, path, training=True):
+        """model.py save: <path>.pdparams (+ .pdopt when training)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..io.framework_io import save_dygraph
+        save_dygraph(self.network.state_dict(), path)
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            def _host(v):
+                # arrays → numpy; nested dicts (LR_Scheduler state) kept
+                return v if hasattr(v, "keys") else np.asarray(v)
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump({k: _host(v) for k, v in
+                             self._optimizer.state_dict().items()},
+                            f, protocol=4)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..io.framework_io import load_dygraph
+        params, _ = load_dygraph(path)
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            with open(opt_path, "rb") as f:
+                self._optimizer.set_state_dict(pickle.load(f))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size or
+                       [i.shape for i in self._inputs] or None)
+
+
+def summary(net: Layer, input_size=None, dtypes=None):
+    """hapi summary: layer table + parameter counts.  Weight-tied params
+    (e.g. BERT's MLM decoder sharing the word embedding) count once."""
+    rows = []
+    total = 0
+    trainable = 0
+    seen = set()
+    for name, sub in net.named_sublayers(include_self=True):
+        n_params = 0
+        for p in sub.parameters(include_sublayers=False):
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            size = int(np.prod(p.shape))
+            n_params += size
+            if p.trainable:
+                trainable += size
+        total += n_params
+        rows.append((name or type(sub).__name__, type(sub).__name__,
+                     n_params))
+    lines = [f"{'Layer':<40}{'Type':<28}{'Params':>12}", "-" * 80]
+    for r in rows:
+        lines.append(f"{r[0]:<40}{r[1]:<28}{r[2]:>12,}")
+    lines += ["-" * 80, f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,1 +1,11 @@
-
+"""Model families (reference P18 model zoo + the BASELINE.md benchmark
+configs: LeNet/ResNet in paddle_tpu.vision.models; BERT/ERNIE and
+Transformer here)."""
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
+    ErnieModel, ErnieForPretraining, bert_base, bert_large, ernie_base,
+)
+from .transformer import (  # noqa: F401
+    TransformerConfig, TransformerModel, CrossEntropyCriterion,
+    transformer_base, transformer_big,
+)

@@ -1,0 +1,156 @@
+"""hapi Model API + model-family tests (reference: python/paddle/tests/
+test_model.py pattern + book-test convergence assertions)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _toy_dataset(n=64, seed=0):
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 8).astype(np.float32)
+    y = (x[:, :3].argmax(1)).astype(np.int64)[:, None]
+    return TensorDataset([x, y]), x, y
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    from paddle_tpu.metric import Accuracy
+    ds, x, y = _toy_dataset()
+    net = _MLP()
+    model = paddle_tpu.Model(net)
+    model.prepare(opt.Adam(learning_rate=0.05,
+                           parameters=net.parameters()),
+                  nn.CrossEntropyLoss(),
+                  Accuracy())
+    hist = model.fit(ds, batch_size=16, epochs=8, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["acc"] > 0.6, logs
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 3)
+
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    net2 = _MLP()
+    model2 = paddle_tpu.Model(net2)
+    model2.prepare(opt.Adam(learning_rate=0.05,
+                            parameters=net2.parameters()),
+                   nn.CrossEntropyLoss(), Accuracy())
+    model2.load(path)
+    for p1, p2 in zip(net.parameters(), net2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+def test_model_callbacks_early_stopping():
+    from paddle_tpu.hapi.callbacks import EarlyStopping
+    ds, _, _ = _toy_dataset(32)
+    net = _MLP()
+    model = paddle_tpu.Model(net)
+    model.prepare(opt.SGD(learning_rate=0.0,
+                          parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="loss", patience=1, min_delta=1e-12)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 → no improvement → stops early
+
+
+def test_model_summary():
+    net = _MLP()
+    model = paddle_tpu.Model(net)
+    info = model.summary()
+    # 8*16+16 + 16*3+3 = 195
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 3 + 3
+
+
+def test_bert_pretraining_memorizes():
+    from paddle_tpu.models import (BertConfig, BertModel,
+                                   BertForPretraining,
+                                   BertPretrainingCriterion)
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    model = BertForPretraining(BertModel(cfg))
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt.Adam(learning_rate=1e-3,
+                         parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle_tpu.to_tensor(
+        rng.randint(0, 64, (4, 16)).astype(np.int64))
+    labels = paddle_tpu.to_tensor(
+        rng.randint(0, 64, (4, 16)).astype(np.int64))
+    nsp = paddle_tpu.to_tensor(rng.randint(0, 2, (4,)).astype(np.int64))
+    losses = []
+    for _ in range(30):
+        scores, rel = model(ids)
+        loss = crit(scores, rel, labels, nsp)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_transformer_seq2seq_trains():
+    from paddle_tpu.models import (TransformerConfig, TransformerModel,
+                                   CrossEntropyCriterion)
+    cfg = TransformerConfig(src_vocab_size=50, trg_vocab_size=50,
+                            d_model=32, n_head=2, num_encoder_layers=1,
+                            num_decoder_layers=1, d_inner_hid=64,
+                            max_length=32, dropout=0.0)
+    model = TransformerModel(cfg)
+    crit = CrossEntropyCriterion(label_smooth_eps=0.0)
+    optimizer = opt.Adam(learning_rate=2e-3,
+                         parameters=model.parameters())
+    rng = np.random.RandomState(1)
+    src = paddle_tpu.to_tensor(rng.randint(2, 50, (4, 8)).astype(np.int64))
+    trg_in = paddle_tpu.to_tensor(
+        rng.randint(2, 50, (4, 6)).astype(np.int64))
+    trg_out = paddle_tpu.to_tensor(
+        rng.randint(2, 50, (4, 6)).astype(np.int64))
+    losses = []
+    for _ in range(30):
+        logits = model(src, trg_in)
+        loss = crit(logits, trg_out)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    decoded = model.beam_search(src, max_len=5)
+    assert decoded.shape[0] == 4 and decoded.shape[1] <= 5
+
+
+def test_model_with_hapi_vision():
+    """LeNet from the vision zoo through Model.fit (hapi integration)."""
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (32, 1)).astype(np.int64)
+    ds = TensorDataset([x, y])
+    net = LeNet()
+    model = paddle_tpu.Model(net)
+    model.prepare(opt.Adam(learning_rate=1e-3,
+                           parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    hist = model.fit(ds, batch_size=16, epochs=2, verbose=0)
+    assert np.isfinite(hist[-1]["loss"])
