@@ -69,6 +69,10 @@ class BuildStrategy:
         self.cache_runtime_context = True
         self.trainers_endpoints = []
         self.debug_graphviz_path = ""
+        # TPU extension (SURVEY.md §5.7): shard the sequence dim (feed
+        # dim 1) over an "sp" mesh axis of this size; ring_attention ops
+        # with ring_id=1 ride it.  1 = off.
+        self.sequence_parallel_degree = 1
 
 
 class ExecutionStrategy:
@@ -196,7 +200,14 @@ class CompiledProgram:
     def _get_mesh(self) -> Mesh:
         if self._mesh is None:
             devs = np.array(self._devices())
-            self._mesh = Mesh(devs, ("dp",))
+            sp = max(1, int(getattr(self._build_strategy,
+                                    "sequence_parallel_degree", 1)))
+            if sp > 1:
+                dp = len(devs) // sp
+                self._mesh = Mesh(devs[: dp * sp].reshape(dp, sp),
+                                  ("dp", "sp"))
+            else:
+                self._mesh = Mesh(devs, ("dp",))
         return self._mesh
 
     def _get_program(self) -> Program:
@@ -253,14 +264,24 @@ class CompiledProgram:
             from jax.experimental.shard_map import shard_map
         block = program.global_block()
         tracer = BlockTracer(block)
-        axes = ("dp",)
+        axes = tuple(mesh.axis_names)
+        has_sp = "sp" in axes
 
         def step(state, feed, seed):
             # decorrelate RNG across replicas (the reference gives each
             # device worker a distinct seed)
             local_seed = seed + jnp.uint32(jax.lax.axis_index("dp"))
+            if has_sp:
+                local_seed = local_seed * jnp.uint32(7919) + \
+                    jnp.uint32(jax.lax.axis_index("sp"))
+            # ring 0 = dp world (grad allreduce); ring 1 = sequence axis
+            # SP_RING_ID is the reserved sequence ring (not bound without
+            # an sp axis → ring_attention degrades to plain attention);
+            # user groups (ring 1+) keep the default dp world
+            from ..ops.attention import SP_RING_ID
             ctx = OpContext(seed=local_seed, mesh_axes=axes,
-                            dist_info={0: "dp"})
+                            dist_info={0: "dp", SP_RING_ID: "sp"}
+                            if has_sp else {0: "dp", SP_RING_ID: None})
             env = dict(state)
             env.update(feed)
             tracer.run(env, ctx)
@@ -272,14 +293,32 @@ class CompiledProgram:
                 # reference concatenates per-device fetches then users mean
                 # them; mean is what every training loop does with loss)
                 if jnp.issubdtype(v.dtype, jnp.inexact):
-                    v = jax.lax.pmean(v, "dp")
+                    v = jax.lax.pmean(v, axes)
                 else:
-                    v = jax.lax.pmax(v, "dp")
+                    v = jax.lax.pmax(v, axes)
                 fetches.append(v)
             return tuple(fetches), new_state
 
         state_specs = {n: P() for n in state_names}
-        feed_specs = {n: P("dp") for n in feed_names}
+        if has_sp:
+            # batch over dp, sequence (dim 1) over sp; rank-1 feeds
+            # (e.g. flat labels) shard batch only
+            sp_deg = mesh.shape["sp"]
+            feed_specs = {}
+            for n in feed_names:
+                try:
+                    shape = tuple(block.var(n).shape or ())
+                except KeyError:
+                    shape = ()
+                # sequence dim (dim 1) rides sp only when it divides evenly
+                # ([-1, 1] label feeds and ragged dims shard batch only)
+                if len(shape) >= 2 and shape[1] is not None and \
+                        shape[1] > 1 and shape[1] % sp_deg == 0:
+                    feed_specs[n] = P("dp", "sp")
+                else:
+                    feed_specs[n] = P("dp")
+        else:
+            feed_specs = {n: P("dp") for n in feed_names}
         fetch_specs = tuple(P() for _ in fetch_names)
 
         try:

@@ -83,6 +83,25 @@ class MultiHeadAttention(Layer):
                 v = concat([cache.v, v], axis=2)
                 cache = self.Cache(k, v)
 
+        # flash path: Pallas blockwise kernel on the MXU (O(S) memory);
+        # masked / weight-returning / dropout cases use the score matrix
+        from ...ops.attention import flash_enabled
+        if flash_enabled() and attn_mask is None and \
+                not self.need_weights and \
+                not (self.dropout and self.training):
+            from ...ops.attention import flash_attention
+            from ...dygraph.tracer import trace_jax
+            out = trace_jax(
+                lambda q_, k_, v_: flash_attention(q_, k_, v_),
+                [q, k, v], "flash_attention")
+            b, l = out.shape[0], out.shape[2]
+            out = reshape(transpose(out, [0, 2, 1, 3]),
+                          [b, l, self.embed_dim])
+            out = self.out_proj(out)
+            if cache is not None and isinstance(cache, self.Cache):
+                return out, cache
+            return out
+
         scores = M.scale(matmul(q, k, transpose_y=True),
                          scale=self.head_dim ** -0.5)
         mask = _convert_attention_mask(attn_mask, scores.dtype

@@ -1,0 +1,251 @@
+"""Attention kernels: flash attention (Pallas/TPU) + ring attention
+(sequence parallelism over a mesh axis).
+
+Reference capability: the reference's attention exists only as fused
+inference kernels (operators/fused/multihead_matmul_op.cu,
+math/bert_encoder_functor.cu) and it has NO long-context story
+(SURVEY.md §5.7).  This module is the TPU-native upgrade the north star
+requires:
+
+  * `flash_attention` — block-wise online-softmax attention as a Pallas TPU
+    kernel (VMEM-tiled, MXU matmuls, O(S) memory instead of the O(S^2)
+    scores matrix).  Forward is the Pallas kernel; backward recomputes
+    blocks through the reference formulation (jax.vjp), i.e. activation
+    memory stays O(S).
+  * `ring_attention` — sequence-parallel attention: each device of a mesh
+    axis holds a sequence shard; K/V shards rotate around the ring via
+    lax.ppermute while online-softmax statistics accumulate (RingAttention
+    / blockwise-parallel-transformer pattern).  Compute overlaps the ICI
+    transfer of the next shard.
+
+Both degrade gracefully off-TPU: Pallas runs in interpreter mode on CPU,
+ring attention is pure jax and runs under any shard_map mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "ring_attention", "reference_attention",
+           "enable_flash_attention", "flash_enabled"]
+
+# reserved ring id binding the sequence-parallel mesh axis (user groups from
+# paddle.distributed.new_group start at 1 and must not collide)
+SP_RING_ID = 101
+
+_FLASH_STATE = {"enabled": False}
+
+
+def enable_flash_attention(on: bool = True):
+    """Route MultiHeadAttention / scaled_dot_product_attention through the
+    Pallas flash kernel (FLAGS_use_flash_attention analog)."""
+    _FLASH_STATE["enabled"] = bool(on)
+
+
+def flash_enabled() -> bool:
+    import os
+    return _FLASH_STATE["enabled"] or \
+        os.environ.get("FLAGS_use_flash_attention", "") in ("1", "true")
+
+
+# ---------------------------------------------------------------------------
+# reference (used for VJP and as the non-TPU fallback)
+# ---------------------------------------------------------------------------
+def reference_attention(q, k, v, bias=None, causal=False, scale=None):
+    """Plain softmax(QK^T)V.  q,k,v: [B, H, S, D] (float)."""
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (forward kernel)
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, sq,
+                      causal, scale, block_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)  # query-block index (grid: B, H, Sq/block_q)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [block_q, d]
+
+    m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    n_kb = sk // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            # bottom-right aligned (matches reference_attention's
+            # tril(k=sk-sq)): query i attends keys <= i + (sk - sq)
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (sk - sq)
+            kpos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # guard fully-masked rows (m_new == -inf): exp(-inf - -inf) → nan
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m)
+        p = jnp.where(jnp.isfinite(m_new), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # skip key blocks entirely above the (bottom-right) diagonal
+        n_needed = jnp.minimum(
+            n_kb, ((qi + 1) * block_q + (sk - sq) + block_k - 1) // block_k)
+        m, l, acc = jax.lax.fori_loop(0, n_needed, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
+                               sq=sq, causal=causal, scale=scale,
+                               block_q=block_q)
+    grid = (b, h, sq // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, scale):
+    return _flash_fwd(q, k, v, causal, scale, 128, 128,
+                      interpret=not _on_tpu())
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out = _flash(q, k, v, causal, scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    q, k, v = res
+    # backward recomputes through the reference formulation block-free;
+    # activation memory between fwd and bwd stays O(S)
+    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(
+        q_, k_, v_, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    block_q=128, block_k=128):
+    """Flash attention over [B, H, S, D] tensors.  `bias` forces the
+    reference path (arbitrary bias breaks the blockwise max-trick bound
+    chosen here; padding masks should be folded into K by the caller)."""
+    if bias is not None:
+        return reference_attention(q, k, v, bias=bias, causal=causal,
+                                   scale=scale)
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    return _flash(q, k, v, causal, scale)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (sequence parallel)
+# ---------------------------------------------------------------------------
+def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
+    """Sequence-parallel attention inside shard_map: every device holds
+    [B, H, S/n, D] shards (sequence dim sharded over `axis_name`); K/V
+    rotate around the ring while online-softmax stats accumulate.
+
+    Causal masking uses GLOBAL positions: device r's queries are rows
+    [r*S_loc, (r+1)*S_loc); the k-th rotation holds keys of device
+    (r - step) % n.
+    """
+    d = q.shape[-1]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    n = jax.lax.psum(1, axis_name)
+    me = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[2]
+    qf = q.astype(jnp.float32) * scale
+
+    def step_fn(carry, step):
+        m, l, acc, ks, vs = carry
+        src = (me - step) % n  # whose keys we currently hold
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks.astype(jnp.float32))
+        if causal:
+            kpos = src * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 3)
+            qp = me * s_loc + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 2)
+            s = jnp.where(qp >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(m_new), jnp.exp(s - safe_m), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vs.astype(jnp.float32))
+        # rotate K/V to the next device (overlaps with next step's compute)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        ks = jax.lax.ppermute(ks, axis_name, perm)
+        vs = jax.lax.ppermute(vs, axis_name, perm)
+        return (m_new, l_new, acc_new, ks, vs), None
+
+    b, h = q.shape[0], q.shape[1]
+    m0 = jnp.full((b, h, s_loc, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step_fn, (m0, l0, a0, k, v), jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)

@@ -3,6 +3,7 @@ import (analog of /root/reference/paddle/fluid/operators/ — but each kernel is
 one traceable JAX function instead of per-device C++/CUDA code)."""
 from . import (  # noqa: F401
     math,
+    attention,
     elementwise,
     activation,
     reduce,

@@ -1,0 +1,58 @@
+"""Attention ops for the static graph (flash + ring kernels as registry
+ops; see ops/attention.py for the Pallas/ring implementations and the
+reference-capability notes)."""
+from __future__ import annotations
+
+from ..registry import register_op
+from ..attention import (flash_attention, ring_attention,
+                         reference_attention, SP_RING_ID)
+
+
+@register_op("flash_attention", inputs=["Q", "K", "V"], outputs=["Out"],
+             grad="auto")
+def flash_attention_op(ins, attrs, ctx):
+    """Blockwise Pallas attention.  Q/K/V: [B, H, S, D] (full sequence —
+    refuses to run under a sequence-parallel mesh, where shard-local
+    attention would be silently wrong; use ring_attention there)."""
+    if ctx.collective_axes(SP_RING_ID):
+        raise RuntimeError(
+            "flash_attention op under a sequence-parallel mesh would "
+            "attend only within the local shard; use the ring_attention "
+            "op (ring_id=SP_RING_ID) instead")
+    return {"Out": flash_attention(ins["Q"], ins["K"], ins["V"],
+                                   causal=attrs.get("causal", False))}
+
+
+@register_op("ring_attention", inputs=["Q", "K", "V"], outputs=["Out"],
+             grad="auto", side_effect=True)
+def ring_attention_op(ins, attrs, ctx):
+    """Sequence-parallel attention over the mesh axis bound to ring_id 1.
+
+    Q/K/V: [B, S, H*D] with attr num_heads — head split/merge happens
+    INSIDE the kernel where shapes are the local shard's (graph-level
+    reshapes would bake the global sequence length and break under the sp
+    shard; same reason the reference fuses multihead_matmul,
+    operators/fused/multihead_matmul_op.cu).  Outside any mesh this is
+    plain attention (degenerate world of 1).
+    """
+    import jax.numpy as jnp
+    q, k, v = ins["Q"], ins["K"], ins["V"]
+    h = attrs.get("num_heads", 1)
+    causal = attrs.get("causal", False)
+
+    def split(x):
+        b, s, hd = x.shape
+        return jnp.transpose(x.reshape(b, s, h, hd // h), (0, 2, 1, 3))
+
+    def merge(x):
+        b, hh, s, d = x.shape
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, s, hh * d)
+
+    qh, kh, vh = split(q), split(k), split(v)
+    axes = ctx.collective_axes(attrs.get("ring_id", SP_RING_ID))
+    if not axes:
+        out = reference_attention(qh, kh, vh, causal=causal)
+    else:
+        ax = axes if isinstance(axes, str) else axes[0]
+        out = ring_attention(qh, kh, vh, ax, causal=causal)
+    return {"Out": merge(out)}

@@ -96,6 +96,20 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         return layers.transpose(x, [0, 2, 1, 3])  # [b, h, t, d]
 
     q, k, v = _split_heads(queries), _split_heads(keys), _split_heads(values)
+
+    from ..ops.attention import flash_enabled
+    if flash_enabled() and num_heads > 1 and not dropout_rate:
+        # emit the Pallas flash op instead of the score-matrix graph
+        helper = layers.LayerHelper("flash_attention")
+        out = helper.create_variable_for_type_inference(q.dtype)
+        out.shape = tuple(q.shape)
+        helper.append_op("flash_attention",
+                         inputs={"Q": [q], "K": [k], "V": [v]},
+                         outputs={"Out": [out]}, attrs={"causal": False})
+        ctx = layers.transpose(out, [0, 2, 1, 3])
+        t, h, d = ctx.shape[1], ctx.shape[2], ctx.shape[3]
+        return layers.reshape(ctx, [-1, t, h * d])
+
     scaled = layers.scale(q, scale=d_key ** -0.5)
     logits = layers.matmul(scaled, k, transpose_y=True)
     weights = layers.softmax(logits)
