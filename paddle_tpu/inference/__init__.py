@@ -1,1 +1,10 @@
-
+"""paddle.inference — the deployment engine (reference C22,
+paddle/fluid/inference/: AnalysisPredictor + pass pipeline + ZeroCopy API)."""
+from .predictor import (  # noqa: F401
+    Config, AnalysisConfig, Predictor, PaddlePredictor, create_predictor,
+    create_paddle_predictor, ZeroCopyTensor, PrecisionType,
+)
+from .passes import (  # noqa: F401
+    register_pass, get_pass, apply_passes, all_passes, PassContext,
+    DEFAULT_INFERENCE_PASSES,
+)

@@ -1,0 +1,252 @@
+"""AnalysisPredictor analog: load → optimize → jit once → serve.
+
+Reference: /root/reference/paddle/fluid/inference/api/analysis_predictor.h:82
+(AnalysisPredictor: PrepareProgram :184 → OptimizeInferenceProgram :523
+running the analysis pass pipeline → per-Run ZeroCopyTensor exchange) and
+paddle_inference_api.h (Config/Predictor/Tensor surface, 2.x spelling
+create_predictor).
+
+TPU-native: "optimize" = the pass pipeline in passes.py + ONE whole-graph
+jit; each `run()` is a single XLA executable invocation (the reference ran
+an op-by-op executor per request).  Cloned predictors share weights but
+jit independently (per-thread clone parity, analysis_predictor Clone).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .passes import apply_passes, PassContext, DEFAULT_INFERENCE_PASSES
+
+__all__ = ["Config", "AnalysisConfig", "Predictor", "PaddlePredictor",
+           "create_predictor", "create_paddle_predictor", "ZeroCopyTensor",
+           "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
+class Config:
+    """AnalysisConfig parity (inference/api/paddle_analysis_config.h)."""
+
+    Precision = PrecisionType
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_xla = True
+        self._device_id = 0
+        self._ir_optim = True
+        self._passes = list(DEFAULT_INFERENCE_PASSES)
+        self._deleted_passes = set()
+        self._memory_optim = True  # XLA buffer liveness — accepted no-op
+        self._precision = PrecisionType.Float32
+        self._glog_info = False
+
+    # -- device (gpu spellings kept for parity; TPU is the accelerator) ----
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_xla = True
+        self._device_id = device_id
+
+    enable_use_xla = enable_use_gpu
+
+    def disable_gpu(self):
+        self._use_xla = False
+
+    def use_gpu(self):
+        return self._use_xla
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    # -- precision / engine knobs ------------------------------------------
+    def enable_tensorrt_engine(self, workspace_size=1 << 30,
+                               max_batch_size=1, min_subgraph_size=3,
+                               precision_mode=PrecisionType.Float32,
+                               use_static=False, use_calib_mode=False):
+        """TensorRT has no TPU analog; precision request is honoured by
+        lowering matmul/conv dtypes (bf16) in the jitted graph."""
+        self._precision = precision_mode
+
+    def enable_bfloat16(self):
+        self._precision = PrecisionType.Bfloat16
+
+    def precision_mode(self):
+        return self._precision
+
+    # -- pass control (paddle_pass_builder parity) --------------------------
+    def switch_ir_optim(self, on=True):
+        self._ir_optim = on
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def delete_pass(self, name):
+        self._deleted_passes.add(name)
+
+    def pass_builder(self):
+        return self
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_use_feed_fetch_ops(self, on):
+        pass  # feed/fetch ops never exist in the jitted path
+
+    def switch_specify_input_names(self, on=True):
+        pass
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def set_model(self, model_dir_or_prog, params_file=None):
+        if params_file is None:
+            self._model_dir = model_dir_or_prog
+        else:
+            self._prog_file = model_dir_or_prog
+            self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+
+AnalysisConfig = Config
+
+
+class ZeroCopyTensor:
+    """Input/output handle (api/details/zero_copy_tensor.cc parity): numpy
+    in, numpy out — zero host copies beyond the device transfer itself."""
+
+    def __init__(self, name, predictor, is_input):
+        self._name = name
+        self._predictor = predictor
+        self._is_input = is_input
+
+    def name(self):
+        return self._name
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu
+
+    def copy_from_cpu(self, arr):
+        self._predictor._inputs[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._outputs[self._name])
+
+    def shape(self):
+        if self._is_input:
+            return list(np.shape(self._predictor._inputs[self._name]))
+        return list(np.shape(self._predictor._outputs[self._name]))
+
+
+class Predictor:
+    """AnalysisPredictor parity over the jit executor."""
+
+    def __init__(self, config: Config, _shared=None):
+        self._config = config
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+        if _shared is not None:
+            (self._program, self._scope, self._feed_names,
+             self._fetch_names) = _shared
+            self._exe = self._fresh_exe()
+            return
+        self._load_and_optimize()
+
+    def _fresh_exe(self):
+        from ..static.executor import Executor
+        return Executor()
+
+    def _load_and_optimize(self):
+        from ..static.executor import Executor, Scope, scope_guard
+        from ..io.framework_io import load_inference_model
+        self._scope = Scope()
+        self._exe = Executor()
+        with scope_guard(self._scope):
+            prog, feed_names, fetch_targets = load_inference_model(
+                self._config._model_dir,
+                self._exe,
+                model_filename=self._config._prog_file,
+                params_filename=self._config._params_file)
+        self._feed_names = feed_names
+        self._fetch_names = [t.name for t in fetch_targets]
+        if self._config._ir_optim:
+            names = [p for p in self._config._passes
+                     if p not in self._config._deleted_passes]
+            ctx = PassContext(scope=self._scope)
+            prog = apply_passes(prog, names, ctx)
+            self._pass_stats = ctx.stats
+            # passes may rename pruned-through fetch targets
+            self._fetch_names = list(getattr(prog, "_fetch_names",
+                                             self._fetch_names))
+        if self._config._precision == PrecisionType.Bfloat16:
+            from ..amp import rewrite_program
+            rewrite_program(prog)
+        self._program = prog
+
+    # -- 2.x API ------------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name) -> ZeroCopyTensor:
+        return ZeroCopyTensor(name, self, True)
+
+    def get_output_handle(self, name) -> ZeroCopyTensor:
+        return ZeroCopyTensor(name, self, False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """2.x run(): positional inputs optional (else copy_from_cpu)."""
+        from ..static.executor import scope_guard
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n] = np.asarray(a)
+        block = self._program.global_block()
+        fetch_vars = [block.var(n) for n in self._fetch_names]
+        with self._lock, scope_guard(self._scope):
+            res = self._exe.run(self._program, feed=dict(self._inputs),
+                                fetch_list=fetch_vars)
+        self._outputs = dict(zip(self._fetch_names, res))
+        if inputs is not None:
+            return list(res)
+        return True
+
+    def clone(self):
+        """Per-thread clone sharing weights (analysis_predictor Clone)."""
+        return Predictor(self._config,
+                         _shared=(self._program, self._scope,
+                                  self._feed_names, self._fetch_names))
+
+    # -- 1.x PaddlePredictor compat -----------------------------------------
+    def get_input_tensor(self, name):
+        return self.get_input_handle(name)
+
+    def get_output_tensor(self, name):
+        return self.get_output_handle(name)
+
+    def zero_copy_run(self):
+        return self.run(None)
+
+
+PaddlePredictor = Predictor
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def create_paddle_predictor(config: Config) -> Predictor:
+    return Predictor(config)

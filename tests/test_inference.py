@@ -1,0 +1,106 @@
+"""Inference engine tests (reference: inference/api/analysis_predictor
+tests + ir pass testers: build a tiny program, apply a pass, assert graph
+shape + numerics unchanged)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _save_trained_model(tmp_path, with_conv=False):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        if with_conv:
+            x = layers.data("x", [-1, 3, 8, 8])
+            h = layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+            h = layers.batch_norm(h)
+            h = layers.relu(h)
+            h = layers.reshape(h, [-1, 4 * 8 * 8])
+        else:
+            x = layers.data("x", [-1, 8])
+            h = layers.fc(x, 16, act="relu")
+            h = layers.dropout(h, dropout_prob=0.3)
+        out = layers.fc(h, 3, act="softmax")
+        loss = layers.mean(out)
+        static.SGD(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        shape = (4, 3, 8, 8) if with_conv else (4, 8)
+        xb = np.random.RandomState(0).rand(*shape).astype(np.float32)
+        exe.run(main, feed={"x": xb}, fetch_list=[loss])
+        from paddle_tpu.io.framework_io import save_inference_model
+        save_inference_model(str(tmp_path), ["x"], [out], exe, main)
+        # reference output from the raw loaded program (no passes)
+        (ref,) = exe.run(main.clone(for_test=True), feed={"x": xb},
+                         fetch_list=[out])
+    return xb, ref
+
+
+def test_predictor_end_to_end(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    xb, ref = _save_trained_model(tmp_path)
+    config = Config(str(tmp_path))
+    pred = create_predictor(config)
+    assert pred.get_input_names() == ["x"]
+    (out,) = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # ZeroCopy handle path
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xb)
+    pred.run()
+    out2 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, ref, rtol=1e-4, atol=1e-5)
+    # clone shares weights
+    c = pred.clone()
+    (out3,) = c.run([xb])
+    np.testing.assert_allclose(out3, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_passes_fuse_and_simplify(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    xb, ref = _save_trained_model(tmp_path)
+    config = Config(str(tmp_path))
+    pred = create_predictor(config)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "dropout" not in types          # simplify pass removed it
+    assert "fc" in types                   # mul+add fused
+    assert pred._pass_stats.get("fc_fused", 0) >= 1
+    (out,) = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_bn_fold(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    xb, ref = _save_trained_model(tmp_path, with_conv=True)
+    config = Config(str(tmp_path))
+    pred = create_predictor(config)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "batch_norm" not in types
+    (out,) = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pass_registry_and_disable(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor, all_passes
+    assert "fc_fuse_pass" in all_passes()
+    xb, ref = _save_trained_model(tmp_path)
+    config = Config(str(tmp_path))
+    config.delete_pass("fc_fuse_pass")
+    pred = create_predictor(config)
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "mul" in types  # fusion skipped
+    (out,) = pred.run([xb])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_precision(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    xb, ref = _save_trained_model(tmp_path)
+    config = Config(str(tmp_path))
+    config.enable_bfloat16()
+    pred = create_predictor(config)
+    (out,) = pred.run([xb])
+    assert np.allclose(out, ref, rtol=0.05, atol=0.02)
