@@ -16,6 +16,8 @@ from .core.program import (  # noqa: F401
     name_scope,
 )
 from .core.generator import seed  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from .core.monitor import stat_add, stat_get, all_stats  # noqa: F401
 
 # kernel library registers all ops on import
 from .ops import kernels as _kernels  # noqa: F401

@@ -1,0 +1,63 @@
+"""Runtime counters — StatRegistry analog.
+
+Reference: /root/reference/paddle/fluid/platform/monitor.h (StatRegistry
+:77, STAT_ADD :130 — named int64 counters exported through pybind's `stat`
+dict)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
+           "all_stats"]
+
+
+class StatRegistry:
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: Dict[str, int] = {}
+        self._mu = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, value: int = 1):
+        with self._mu:
+            self._stats[name] = self._stats.get(name, 0) + int(value)
+
+    def get(self, name: str) -> int:
+        with self._mu:
+            return self._stats.get(name, 0)
+
+    def reset(self, name: str = None):
+        with self._mu:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._mu:
+            return dict(self._stats)
+
+
+def stat_add(name, value=1):
+    StatRegistry.instance().add(name, value)
+
+
+def stat_get(name):
+    return StatRegistry.instance().get(name)
+
+
+def stat_reset(name=None):
+    StatRegistry.instance().reset(name)
+
+
+def all_stats():
+    return StatRegistry.instance().snapshot()

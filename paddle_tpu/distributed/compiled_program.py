@@ -276,11 +276,14 @@ class CompiledProgram:
                     jnp.uint32(jax.lax.axis_index("sp"))
             # ring 0 = dp world (grad allreduce); ring 1 = sequence axis
             # SP_RING_ID is the reserved sequence ring (not bound without
-            # an sp axis → ring_attention degrades to plain attention);
-            # user groups (ring 1+) keep the default dp world
+            # an sp axis → ring_attention degrades to plain attention).
+            # Under dp×sp, gradients are partial over BOTH axes (batch and
+            # sequence shards), so ring 0 reduces over the whole mesh;
+            # user groups (ring 1+) fall back to the "default" dp world.
             from ..ops.attention import SP_RING_ID
             ctx = OpContext(seed=local_seed, mesh_axes=axes,
-                            dist_info={0: "dp", SP_RING_ID: "sp"}
+                            dist_info={0: ("dp", "sp"), SP_RING_ID: "sp",
+                                       "default": "dp"}
                             if has_sp else {0: "dp", SP_RING_ID: None})
             env = dict(state)
             env.update(feed)

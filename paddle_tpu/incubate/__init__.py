@@ -1,1 +1,3 @@
-
+"""paddle.incubate — pre-stable capability tier (reference
+fluid/incubate/): auto-checkpoint elastic recovery."""
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,77 @@
+"""Numbered checkpoint management.
+
+Reference: /root/reference/python/paddle/fluid/incubate/checkpoint/
+checkpoint_saver.py — CheckpointSaver over an FS abstraction (HDFS in
+production, local in tests): save_checkpoint writes checkpoint.<n>,
+load_checkpoint restores the newest, older ones are pruned.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ...distributed.fleet.utils.fs import FS, LocalFS
+
+__all__ = ["SerializableBase", "CheckpointSaver"]
+
+
+class SerializableBase:
+    def serialize(self, path):
+        raise NotImplementedError
+
+    def deserialize(self, path):
+        raise NotImplementedError
+
+
+class CheckpointSaver:
+    def __init__(self, fs: Optional[FS] = None):
+        self._fs = fs or LocalFS()
+
+    def _ckpt_dirs(self, root):
+        if not self._fs.is_exist(root):
+            return []
+        dirs, _ = self._fs.ls_dir(root)
+        nums = []
+        for d in dirs:
+            if d.startswith("__paddle_checkpoint__"):
+                try:
+                    nums.append(int(d.rsplit(".", 1)[-1]))
+                except ValueError:
+                    continue
+        return sorted(nums)
+
+    def get_last_checkpoint_no(self, root) -> int:
+        nums = self._ckpt_dirs(root)
+        return nums[-1] if nums else -1
+
+    def save_checkpoint(self, path, slists, trainer_id=None,
+                        local_cache_path=".cache", max_keep=3) -> int:
+        """Serialize each object into the next numbered checkpoint dir."""
+        no = self.get_last_checkpoint_no(path) + 1
+        d = os.path.join(path, f"__paddle_checkpoint__.{no}")
+        self._fs.mkdirs(d)
+        for i, s in enumerate(slists):
+            s.serialize(os.path.join(d, f"obj_{i}"))
+        with open(os.path.join(d, "_meta.json"), "w") as f:
+            json.dump({"no": no, "n_objs": len(slists),
+                       "trainer_id": trainer_id}, f)
+        self.clean_redundant_checkpoints(path, max_keep)
+        return no
+
+    def load_checkpoint(self, path, slists, trainer_id=None,
+                        checkpoint_no=None, local_cache_path=".cache"):
+        if checkpoint_no is None:
+            checkpoint_no = self.get_last_checkpoint_no(path)
+        if checkpoint_no < 0:
+            return None
+        d = os.path.join(path, f"__paddle_checkpoint__.{checkpoint_no}")
+        for i, s in enumerate(slists):
+            s.deserialize(os.path.join(d, f"obj_{i}"))
+        return checkpoint_no
+
+    def clean_redundant_checkpoints(self, root, max_keep=3):
+        nums = self._ckpt_dirs(root)
+        for n in nums[:-max_keep]:
+            self._fs.delete(os.path.join(
+                root, f"__paddle_checkpoint__.{n}"))

@@ -47,9 +47,10 @@ def enable_flash_attention(on: bool = True):
 
 
 def flash_enabled() -> bool:
-    import os
-    return _FLASH_STATE["enabled"] or \
-        os.environ.get("FLAGS_use_flash_attention", "") in ("1", "true")
+    if _FLASH_STATE["enabled"]:
+        return True
+    from ..core.flags import flag
+    return bool(flag("use_flash_attention", False))
 
 
 # ---------------------------------------------------------------------------
@@ -134,7 +135,9 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
+    # fall back unless blocks tile evenly AND respect the f32 sublane
+    # multiple of 8 (Mosaic lowering requirement on real TPU)
+    if sq % block_q or sk % block_k or block_q % 8 or block_k % 8:
         return reference_attention(q, k, v, causal=causal, scale=scale)
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
@@ -164,18 +167,18 @@ def _on_tpu():
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, scale):
-    return _flash_fwd(q, k, v, causal, scale, 128, 128,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
                       interpret=not _on_tpu())
 
 
-def _flash_fwd_rule(q, k, v, causal, scale):
-    out = _flash(q, k, v, causal, scale)
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    out = _flash(q, k, v, causal, scale, block_q, block_k)
     return out, (q, k, v)
 
 
-def _flash_bwd_rule(causal, scale, res, g):
+def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
     q, k, v = res
     # backward recomputes through the reference formulation block-free;
     # activation memory between fwd and bwd stays O(S)
@@ -197,7 +200,7 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                                    scale=scale)
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
-    return _flash(q, k, v, causal, scale)
+    return _flash(q, k, v, causal, scale, block_q, block_k)
 
 
 # ---------------------------------------------------------------------------

@@ -82,9 +82,14 @@ class OpContext:
     def collective_axes(self, ring_id: int):
         """Map a reference-style ring_id onto mesh axis name(s).  Ring 0 is
         the data-parallel world by convention (collective_helper.h:62 —
-        NCCLCommContext ring registry)."""
+        NCCLCommContext ring registry).  Unknown rings (user groups from
+        new_group) use the "default" binding when one is set — under a
+        multi-axis mesh that keeps them on the dp world instead of
+        silently spanning every axis."""
         if ring_id in self.dist_info:
             return self.dist_info[ring_id]
+        if "default" in self.dist_info:
+            return self.dist_info["default"]
         return self.mesh_axes or None
 
 

@@ -1,0 +1,177 @@
+"""Profiler front-end: host event recording + device trace + Chrome export.
+
+Reference: /root/reference/paddle/fluid/platform/profiler.{h,cc}
+(EnableProfiler/DisableProfiler :209-213, RAII RecordEvent :127, summary
+tables), python/paddle/fluid/profiler.py (profiler context manager,
+start_profiler/stop_profiler/reset_profiler) and tools/timeline.py (profile
+→ chrome://tracing JSON).
+
+TPU-native: host events are recorded here; DEVICE profiling delegates to
+jax.profiler (XPlane → TensorBoard/perfetto — the CUPTI analog,
+platform/device_tracer.h), started/stopped alongside the host profiler when
+a trace dir is given.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "RecordEvent", "record_event", "cuda_profiler",
+           "npu_profiler", "export_chrome_tracing"]
+
+
+class _Event:
+    __slots__ = ("name", "start", "end", "thread")
+
+    def __init__(self, name, start, end, thread):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.thread = thread
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.enabled = False
+        self.events: List[_Event] = []
+        self.lock = threading.Lock()
+        self.t0 = 0.0
+        self.jax_trace_dir: Optional[str] = None
+
+
+_state = _ProfilerState()
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """profiler.py start_profiler parity.  state: CPU/GPU/All (GPU/All also
+    start the jax device profiler when trace_dir is given)."""
+    with _state.lock:
+        _state.enabled = True
+        _state.events = []
+        _state.t0 = time.perf_counter()
+        if trace_dir and state in ("GPU", "All"):
+            try:
+                import jax
+                jax.profiler.start_trace(trace_dir)
+                _state.jax_trace_dir = trace_dir
+            except (ImportError, RuntimeError):
+                _state.jax_trace_dir = None
+
+
+def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
+    """profiler.py stop_profiler: stop, print the summary table, write the
+    chrome trace next to profile_path."""
+    with _state.lock:
+        _state.enabled = False
+        if _state.jax_trace_dir:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except (ImportError, RuntimeError):
+                pass
+            _state.jax_trace_dir = None
+        events = list(_state.events)
+    _print_summary(events, sorted_key)
+    if profile_path:
+        export_chrome_tracing(profile_path + ".json", events)
+
+
+def reset_profiler():
+    with _state.lock:
+        _state.events = []
+        _state.t0 = time.perf_counter()
+
+
+def _print_summary(events: List[_Event], sorted_key):
+    agg: Dict[str, List[float]] = {}
+    for e in events:
+        agg.setdefault(e.name, []).append(e.end - e.start)
+    rows = []
+    for name, ds in agg.items():
+        rows.append((name, len(ds), sum(ds), sum(ds) / len(ds),
+                     min(ds), max(ds)))
+    key_idx = {"calls": 1, "total": 2, "ave": 3, "min": 4, "max": 5}.get(
+        str(sorted_key), 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    print(f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+          f"{'Min(ms)':>10}{'Max(ms)':>10}")
+    print("-" * 90)
+    for name, calls, tot, ave, mn, mx in rows:
+        print(f"{name:<40}{calls:>8}{tot * 1e3:>12.3f}{ave * 1e3:>10.3f}"
+              f"{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}")
+
+
+def export_chrome_tracing(path: str, events: Optional[List[_Event]] = None):
+    """tools/timeline.py analog: chrome://tracing JSON."""
+    events = events if events is not None else list(_state.events)
+    trace = {"traceEvents": [
+        {"name": e.name, "cat": "host", "ph": "X",
+         "ts": e.start * 1e6, "dur": (e.end - e.start) * 1e6,
+         "pid": 0, "tid": e.thread}
+        for e in events]}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+class RecordEvent:
+    """RAII host annotation (platform/profiler.h:127).  Also usable as a
+    decorator/context; nests with jax's TraceAnnotation so host events
+    appear in the device trace."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t = None
+        self._jax_ctx = None
+
+    def __enter__(self):
+        if _state.enabled:
+            self._t = time.perf_counter() - _state.t0
+        try:
+            import jax
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except (ImportError, AttributeError):
+            self._jax_ctx = None
+        return self
+
+    def __exit__(self, *a):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*a)
+        if self._t is not None:
+            end = time.perf_counter() - _state.t0
+            with _state.lock:
+                _state.events.append(_Event(
+                    self.name, self._t, end, threading.get_ident()))
+        return False
+
+
+record_event = RecordEvent
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path="/tmp/profile",
+             tracer_option="Default", trace_dir=None):
+    """fluid.profiler.profiler context manager parity."""
+    start_profiler(state, tracer_option, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):
+    """No CUDA on TPU; kept for API parity (wraps the jax trace)."""
+    yield
+
+
+npu_profiler = cuda_profiler

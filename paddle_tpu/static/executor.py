@@ -197,14 +197,47 @@ class Executor:
                 as_numpy(scope.get(n)) if return_numpy else scope.get(n)
                 for n in fetch_names]
 
-        import os
-        if os.environ.get("FLAGS_eager_run"):
-            self._run_eager(program, scope, feed, fetch_names)
-            fetched = [scope.get(n) for n in fetch_names]
-            return [as_numpy(f) for f in fetched] if return_numpy else fetched
+        # elastic auto-checkpoint hook (reference executor.py:1194)
+        from ..incubate.checkpoint.auto_checkpoint import _auto_checkpoint
+        _auto_checkpoint(self, program)
 
-        return self._run_compiled(program, scope, feed, fetch_names,
-                                  return_numpy)
+        from ..core.flags import flag
+        from ..core.monitor import stat_add
+        from ..profiler import RecordEvent
+        stat_add("executor_run_times")
+        with RecordEvent("Executor::Run"):
+            if flag("eager_run", False):
+                self._run_eager(program, scope, feed, fetch_names)
+                fetched = [scope.get(n) for n in fetch_names]
+                results = [as_numpy(f) for f in fetched] \
+                    if return_numpy else fetched
+            else:
+                results = self._run_compiled(program, scope, feed,
+                                             fetch_names, return_numpy)
+        if flag("check_nan_inf", False):
+            self._check_nan_inf(fetch_names, results, scope)
+        return results
+
+    def _check_nan_inf(self, fetch_names, results, scope):
+        """FLAGS_check_nan_inf (reference details/nan_inf_utils_detail —
+        per-op output scan; here: fetches + persistable state after the
+        jitted step, which bounds the same failure)."""
+        bad = []
+        for n, v in zip(fetch_names, results or []):
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                bad.append(f"fetch {n!r}")
+        for n in scope.keys():
+            v = scope.get(n)
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                bad.append(f"var {n!r}")
+        if bad:
+            raise RuntimeError(
+                "FLAGS_check_nan_inf: non-finite values in "
+                + ", ".join(bad))
 
     def close(self):
         self._cache.clear()

@@ -1,1 +1,5 @@
-
+"""paddle.utils — debugging & support utilities."""
+from .debugger import (  # noqa: F401
+    draw_block_graphviz, program_to_dot, print_program,
+    prepare_fast_nan_inf_debug,
+)

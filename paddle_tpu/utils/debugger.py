@@ -1,0 +1,102 @@
+"""Program visualization & debugging.
+
+Reference: /root/reference/python/paddle/fluid/debugger.py (graphviz
+program dump), net_drawer.py, and ir/graph_viz_pass.cc (DOT export).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..core.program import Program, OpRole
+
+__all__ = ["draw_block_graphviz", "program_to_dot", "print_program",
+           "prepare_fast_nan_inf_debug"]
+
+_ROLE_COLORS = {
+    OpRole.Forward: "lightblue",
+    int(OpRole.Forward | OpRole.Loss): "gold",
+    OpRole.Backward: "lightpink",
+    OpRole.Optimize: "palegreen",
+    OpRole.Dist: "orange",
+    OpRole.RPC: "tomato",
+    OpRole.LRSched: "palegreen3",
+}
+
+
+def program_to_dot(program: Program, block_idx: int = 0,
+                   highlights=None) -> str:
+    """DOT text of one block (graph_viz_pass.cc analog): ops as boxes
+    colored by role, vars as ellipses (params double-ringed)."""
+    block = program.blocks[block_idx]
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def var_node(name):
+        if name in var_ids:
+            return var_ids[name]
+        vid = f"var_{len(var_ids)}"
+        var_ids[name] = vid
+        try:
+            v = block.var(name)
+            label = f"{name}\\n{v.dtype}{list(v.shape) if v.shape else ''}"
+            shape = "doubleoctagon" if v.is_parameter else "ellipse"
+        except KeyError:
+            label, shape = name, "ellipse"
+        color = ', style=filled, fillcolor="red"' if name in highlights \
+            else ""
+        lines.append(f'  {vid} [label="{label}", shape={shape}{color}];')
+        return vid
+
+    for i, op in enumerate(block.ops):
+        color = _ROLE_COLORS.get(op.attrs.get(OpRole.KEY, OpRole.Forward),
+                                 "white")
+        lines.append(
+            f'  op_{i} [label="{op.type}", shape=box, style=filled, '
+            f'fillcolor="{color}"];')
+        for n in op.input_names():
+            lines.append(f"  {var_node(n)} -> op_{i};")
+        for n in op.output_names():
+            lines.append(f"  op_{i} -> {var_node(n)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block_or_program, highlights=None,
+                        path="./temp.dot"):
+    """fluid.debugger.draw_block_graphviz parity — writes DOT to `path`."""
+    program = (block_or_program.program
+               if hasattr(block_or_program, "program")
+               else block_or_program)
+    idx = getattr(block_or_program, "idx", 0)
+    dot = program_to_dot(program, idx, highlights)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path
+
+
+def print_program(program: Program, skip_vars=False):
+    """Readable program text (debugger pprint analog)."""
+    out = []
+    for b in program.blocks:
+        out.append(f"-- block {b.idx} (parent {b.parent_idx}) --")
+        if not skip_vars:
+            for v in b.vars.values():
+                out.append(f"  {v!r}")
+        for op in b.ops:
+            role = op.attrs.get(OpRole.KEY, 0)
+            out.append(f"  [{role:>3}] {op!r}")
+    text = "\n".join(out)
+    print(text)
+    return text
+
+
+def prepare_fast_nan_inf_debug(program: Program):
+    """check_nan_inf helper (details/nan_inf_utils parity): enable the
+    runtime NaN scan flag for this process."""
+    from ..core.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": True})

@@ -1,0 +1,182 @@
+"""Auxiliary subsystems (SURVEY.md §5): profiler, flags, monitor,
+auto-checkpoint, debugger, NaN check."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def test_flags_get_set_roundtrip():
+    v = paddle_tpu.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    assert v is False
+    paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+    assert paddle_tpu.get_flags(["check_nan_inf"])["check_nan_inf"] is True
+    paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(ValueError):
+        paddle_tpu.get_flags("FLAGS_not_a_flag")
+    # parity flags registered
+    assert paddle_tpu.get_flags("FLAGS_fraction_of_gpu_memory_to_use")
+
+
+def test_monitor_counters():
+    from paddle_tpu.core.monitor import stat_add, stat_get, stat_reset
+    stat_reset()
+    stat_add("my_counter", 3)
+    stat_add("my_counter")
+    assert stat_get("my_counter") == 4
+    stat_reset("my_counter")
+    assert stat_get("my_counter") == 0
+
+
+def test_profiler_records_and_exports(tmp_path, capsys):
+    from paddle_tpu import profiler as prof
+    path = str(tmp_path / "profile")
+    with prof.profiler(state="CPU", profile_path=path):
+        with prof.RecordEvent("my_block"):
+            _ = sum(range(1000))
+    out = capsys.readouterr().out
+    assert "my_block" in out
+    with open(path + ".json") as f:
+        trace = json.load(f)
+    assert any(e["name"] == "my_block" for e in trace["traceEvents"])
+
+
+def test_executor_records_events_and_stats():
+    from paddle_tpu.core.monitor import stat_get, stat_reset
+    stat_reset()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.fc(x, 2)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[y])
+    assert stat_get("executor_run_times") >= 1
+
+
+def test_nan_inf_check_raises():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        y = layers.log(x)  # log(-1) = nan
+    exe = static.Executor()
+    scope = static.Scope()
+    paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with static.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(RuntimeError, match="non-finite"):
+                exe.run(main, feed={"x": -np.ones((2, 2), np.float32)},
+                        fetch_list=[y])
+    finally:
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_debugger_dot_dump(tmp_path):
+    from paddle_tpu.utils import draw_block_graphviz, print_program
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.fc(x, 2)
+        loss = layers.mean(y)
+        static.SGD(learning_rate=0.1).minimize(loss)
+    p = str(tmp_path / "g.dot")
+    draw_block_graphviz(main.global_block(), path=p)
+    dot = open(p).read()
+    assert "digraph G" in dot and "mul" in dot
+    text = print_program(main, skip_vars=True)
+    assert "sgd" in text
+
+
+def test_checkpoint_saver_roundtrip(tmp_path):
+    from paddle_tpu.incubate.checkpoint import (CheckpointSaver,
+                                                SerializableBase)
+
+    class Obj(SerializableBase):
+        def __init__(self, v):
+            self.v = v
+
+        def serialize(self, path):
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "v.json"), "w") as f:
+                json.dump(self.v, f)
+
+        def deserialize(self, path):
+            with open(os.path.join(path, "v.json")) as f:
+                self.v = json.load(f)
+
+    root = str(tmp_path / "ckpt")
+    saver = CheckpointSaver()
+    for i in range(5):
+        saver.save_checkpoint(root, [Obj(i)], max_keep=3)
+    assert saver.get_last_checkpoint_no(root) == 4
+    o = Obj(None)
+    saver.load_checkpoint(root, [o])
+    assert o.v == 4
+    # pruned to max_keep
+    import glob
+    assert len(glob.glob(os.path.join(root, "__paddle_checkpoint__.*"))) == 3
+
+
+def test_auto_checkpoint_resume(tmp_path, monkeypatch):
+    """Kill-and-restart epoch resume (reference test_auto_checkpoint.py)."""
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_JOB_ID", "job_test_1")
+    monkeypatch.setenv("PADDLE_EDL_HDFS_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_EDL_SAVE_CHECKPOINT_INTER", "0")
+    import paddle_tpu.incubate.checkpoint.auto_checkpoint as acp
+    acp.g_checker = None  # re-read env
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(pred))
+        static.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    xb = np.ones((4, 4), np.float32)
+    seen = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for epoch in acp.train_epoch_range(3):
+            seen.append(epoch)
+            exe.run(main, feed={"x": xb}, fetch_list=[loss])
+            if epoch == 1:
+                break  # simulated failure DURING epoch 1 (before its
+                # end-of-epoch checkpoint commits)
+    assert seen == [0, 1]
+
+    w_name = main.all_parameters()[0].name
+    with static.scope_guard(scope):
+        w_trained = np.asarray(scope.get(w_name)).copy()
+
+    # restart: epoch 0 committed, the interrupted epoch 1 re-runs — and
+    # the checkpointed WEIGHTS are restored, not reinitialized
+    acp.g_checker = None
+    seen2 = []
+    scope2 = static.Scope()
+    with static.scope_guard(scope2):
+        exe2 = static.Executor()
+        exe2.run(startup)
+        w_fresh = np.asarray(scope2.get(w_name)).copy()
+        for epoch in acp.train_epoch_range(3):
+            if not seen2:
+                # first executor.run of the resumed job attaches + restores
+                exe2.run(main, feed={"x": xb}, fetch_list=[loss])
+                w_resumed = np.asarray(scope2.get(w_name))
+            else:
+                exe2.run(main, feed={"x": xb}, fetch_list=[loss])
+            seen2.append(epoch)
+    assert seen2 == [1, 2], seen2
+    # resumed weights came from the checkpoint (epoch-0 trained state),
+    # not the fresh same-seed init the startup program produced
+    assert not np.allclose(w_resumed, w_fresh)
