@@ -1,0 +1,133 @@
+// Blocking MPMC byte-buffer queue — the C++ core of the data pipeline.
+//
+// Reference: /root/reference/paddle/fluid/framework/blocking_queue.h
+// (mutex+condvar bounded queue used by readers/executors) and
+// operators/reader/buffered_reader (double-buffered prefetch).  TPU-native
+// role: host-side feed pipeline buffering between dataloader workers and
+// the device feed, off the Python GIL.
+//
+// C ABI (ctypes-consumed; all buffers are copied in, malloc'd out):
+//   ptq_create(capacity)            -> queue*
+//   ptq_push(q, data, len, t_ms)    -> 0 ok | -1 timeout | -2 closed
+//   ptq_pop(q, &out, t_ms)          -> len>=0 | -1 timeout | -2 closed+empty
+//   ptq_free_buf(p)                 free a popped buffer
+//   ptq_close(q)                    wake all, no further pushes
+//   ptq_size(q) / ptq_capacity(q)
+//   ptq_destroy(q)
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct Buf {
+  char* data;
+  size_t len;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap ? cap : 1) {}
+
+  ~BlockingQueue() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& b : q_) delete[] b.data;
+    q_.clear();
+  }
+
+  int Push(const char* data, size_t len, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || q_.size() < cap_; };
+    if (timeout_ms < 0) {
+      not_full_.wait(lk, pred);
+    } else if (!not_full_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+      return -1;
+    }
+    if (closed_) return -2;
+    char* copy = new char[len ? len : 1];
+    std::memcpy(copy, data, len);
+    q_.push_back({copy, len});
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  long long Pop(char** out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || !q_.empty(); };
+    if (timeout_ms < 0) {
+      not_empty_.wait(lk, pred);
+    } else if (!not_empty_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+      return -1;
+    }
+    if (q_.empty()) return -2;  // closed and drained
+    Buf b = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    *out = b.data;
+    return static_cast<long long>(b.len);
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> g(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> g(mu_);
+    return q_.size();
+  }
+
+  size_t Capacity() const { return cap_; }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> g(mu_);
+    return closed_;
+  }
+
+ private:
+  const size_t cap_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<Buf> q_;
+  bool closed_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_create(size_t capacity) { return new BlockingQueue(capacity); }
+
+int ptq_push(void* q, const char* data, size_t len, int timeout_ms) {
+  return static_cast<BlockingQueue*>(q)->Push(data, len, timeout_ms);
+}
+
+long long ptq_pop(void* q, char** out, int timeout_ms) {
+  return static_cast<BlockingQueue*>(q)->Pop(out, timeout_ms);
+}
+
+void ptq_free_buf(char* p) { delete[] p; }
+
+void ptq_close(void* q) { static_cast<BlockingQueue*>(q)->Close(); }
+
+size_t ptq_size(void* q) { return static_cast<BlockingQueue*>(q)->Size(); }
+
+size_t ptq_capacity(void* q) {
+  return static_cast<BlockingQueue*>(q)->Capacity();
+}
+
+int ptq_closed(void* q) {
+  return static_cast<BlockingQueue*>(q)->Closed() ? 1 : 0;
+}
+
+void ptq_destroy(void* q) { delete static_cast<BlockingQueue*>(q); }
+
+}  // extern "C"

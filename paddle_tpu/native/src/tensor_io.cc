@@ -1,0 +1,177 @@
+// Combined tensor file serialization — the C++ checkpoint fast path.
+//
+// Reference: /root/reference/paddle/fluid/framework/save_load_util.cc
+// (version header + per-tensor proto + raw bytes; save_combine /
+// load_combine ops).  TPU-native role: big checkpoint files stream through
+// C++ fwrite/fread with CRC32 integrity, off the Python allocator.
+//
+// File format "PTNT0001" (little-endian):
+//   magic[8]
+//   u32 n_tensors
+//   per tensor:
+//     u32 name_len, name bytes
+//     u32 dtype_len, dtype bytes        (numpy dtype str, e.g. "float32")
+//     u32 ndim, i64 dims[ndim]
+//     u64 nbytes, raw bytes
+//     u32 crc32(raw)
+//
+// C ABI: writer builds the file in one pass; reader exposes an iterator.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[8] = {'P', 'T', 'N', 'T', '0', '0', '0', '1'};
+
+uint32_t Crc32(const unsigned char* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+template <typename T>
+bool WriteOne(FILE* f, T v) {
+  return std::fwrite(&v, sizeof(T), 1, f) == 1;
+}
+
+template <typename T>
+bool ReadOne(FILE* f, T* v) {
+  return std::fread(v, sizeof(T), 1, f) == 1;
+}
+
+struct Reader {
+  FILE* f = nullptr;
+  uint32_t n = 0;
+  uint32_t next = 0;
+  std::string name, dtype;
+  std::vector<int64_t> dims;
+  std::vector<char> data;
+  std::string error;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- writer ---------------------------------------------------------------
+// returns 0 on success, negative on error
+int ptio_save(const char* path, int n, const char** names,
+              const char** dtypes, const int* ndims,
+              const int64_t* dims_flat, const uint64_t* nbytes,
+              const char** data) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int rc = 0;
+  do {
+    if (std::fwrite(kMagic, 1, 8, f) != 8) { rc = -2; break; }
+    if (!WriteOne<uint32_t>(f, static_cast<uint32_t>(n))) { rc = -2; break; }
+    const int64_t* dp = dims_flat;
+    for (int i = 0; i < n && rc == 0; i++) {
+      uint32_t nl = std::strlen(names[i]);
+      uint32_t dl = std::strlen(dtypes[i]);
+      if (!WriteOne(f, nl) || std::fwrite(names[i], 1, nl, f) != nl ||
+          !WriteOne(f, dl) || std::fwrite(dtypes[i], 1, dl, f) != dl ||
+          !WriteOne<uint32_t>(f, static_cast<uint32_t>(ndims[i]))) {
+        rc = -2; break;
+      }
+      for (int d = 0; d < ndims[i]; d++)
+        if (!WriteOne<int64_t>(f, *dp++)) { rc = -2; break; }
+      if (rc) break;
+      if (!WriteOne<uint64_t>(f, nbytes[i]) ||
+          std::fwrite(data[i], 1, nbytes[i], f) != nbytes[i] ||
+          !WriteOne<uint32_t>(
+              f, Crc32(reinterpret_cast<const unsigned char*>(data[i]),
+                       nbytes[i]))) {
+        rc = -2; break;
+      }
+    }
+  } while (false);
+  std::fclose(f);
+  return rc;
+}
+
+// ---- reader ---------------------------------------------------------------
+void* ptio_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[8];
+  if (std::fread(magic, 1, 8, f) != 8 ||
+      std::memcmp(magic, kMagic, 8) != 0) {
+    std::fclose(f);
+    return nullptr;
+  }
+  Reader* r = new Reader;
+  r->f = f;
+  if (!ReadOne(f, &r->n)) {
+    std::fclose(f);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+uint32_t ptio_count(void* h) { return static_cast<Reader*>(h)->n; }
+
+// advance to the next tensor; 1 = ok, 0 = end, negative = error/corrupt
+int ptio_next(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->next >= r->n) return 0;
+  uint32_t nl, dl, nd, crc;
+  uint64_t nb;
+  if (!ReadOne(r->f, &nl)) return -2;
+  r->name.resize(nl);
+  if (nl && std::fread(&r->name[0], 1, nl, r->f) != nl) return -2;
+  if (!ReadOne(r->f, &dl)) return -2;
+  r->dtype.resize(dl);
+  if (dl && std::fread(&r->dtype[0], 1, dl, r->f) != dl) return -2;
+  if (!ReadOne(r->f, &nd)) return -2;
+  r->dims.resize(nd);
+  for (uint32_t i = 0; i < nd; i++)
+    if (!ReadOne(r->f, &r->dims[i])) return -2;
+  if (!ReadOne(r->f, &nb)) return -2;
+  r->data.resize(nb);
+  if (nb && std::fread(r->data.data(), 1, nb, r->f) != nb) return -2;
+  if (!ReadOne(r->f, &crc)) return -2;
+  if (crc != Crc32(reinterpret_cast<unsigned char*>(r->data.data()), nb))
+    return -3;  // corruption detected
+  r->next++;
+  return 1;
+}
+
+const char* ptio_name(void* h) { return static_cast<Reader*>(h)->name.c_str(); }
+const char* ptio_dtype(void* h) {
+  return static_cast<Reader*>(h)->dtype.c_str();
+}
+uint32_t ptio_ndim(void* h) {
+  return static_cast<uint32_t>(static_cast<Reader*>(h)->dims.size());
+}
+const int64_t* ptio_dims(void* h) {
+  return static_cast<Reader*>(h)->dims.data();
+}
+uint64_t ptio_nbytes(void* h) {
+  return static_cast<Reader*>(h)->data.size();
+}
+const char* ptio_data(void* h) { return static_cast<Reader*>(h)->data.data(); }
+
+void ptio_close(void* h) {
+  Reader* r = static_cast<Reader*>(h);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"
