@@ -17,9 +17,10 @@ import numpy as np
 
 
 def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
-                    batch=8):
+                    batch=8, use_amp=True):
     import paddle_tpu.static as static
     from paddle_tpu.static import layers, nets
+    from paddle_tpu import amp
 
     main, startup = static.Program(), static.Program()
     with static.program_guard(main, startup):
@@ -47,7 +48,14 @@ def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
         logits = layers.fc(h, vocab, num_flatten_dims=2)
         loss = layers.mean(
             layers.softmax_with_cross_entropy(logits, labels))
-        static.Adam(learning_rate=1e-4).minimize(loss)
+        opt = static.Adam(learning_rate=1e-4)
+        if use_amp:
+            # bf16 compute on the MXU, fp32 master weights; bf16 shares
+            # fp32's exponent range so no dynamic loss scaling is needed
+            opt = amp.decorate(opt, init_loss_scaling=1.0,
+                               use_dynamic_loss_scaling=False,
+                               dest_dtype="bfloat16")
+        opt.minimize(loss)
     return main, startup, loss
 
 
@@ -58,17 +66,24 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
     import paddle_tpu.static as static
+    from paddle_tpu.ops.attention import enable_flash_attention
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    seq, batch = (512, 8) if on_tpu else (128, 2)
+    seq, batch = (512, 32) if on_tpu else (128, 2)
     layers_n = 12 if on_tpu else 2
     hidden = 768 if on_tpu else 256
     heads = 12 if on_tpu else 4
     vocab = 30522 if on_tpu else 1024
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    seq = int(os.environ.get("BENCH_SEQ", seq))
+    use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
+
+    # route attention through the Pallas flash kernel (graph-build-time gate)
+    enable_flash_attention(True)
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
-                                              heads, batch)
+                                              heads, batch, use_amp=use_amp)
     exe = static.Executor()
     scope = static.Scope()
     rng = np.random.RandomState(0)

@@ -20,6 +20,8 @@ white_list = {
     "matmul", "matmul_v2", "mul", "fc", "bmm", "mv",
     "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
     "depthwise_conv2d",
+    # Pallas attention kernels: MXU-bound, fp32 accumulation inside
+    "flash_attention", "ring_attention",
 }
 
 # Numerically sensitive — keep fp32 (fp16_lists.py black_list analog)

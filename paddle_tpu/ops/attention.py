@@ -74,8 +74,20 @@ def reference_attention(q, k, v, bias=None, causal=False, scale=None):
 # ---------------------------------------------------------------------------
 # Pallas flash attention (forward kernel)
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, sq,
-                      causal, scale, block_q):
+def _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq):
+    """Apply the bottom-right-aligned causal mask to one [block_q, block_k]
+    logits tile: query i attends keys <= i + (sk - sq) — matches
+    reference_attention's tril(k=sk-sq).  Shared by fwd and both bwd
+    kernels so the alignment can never drift between them."""
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) + (sk - sq)
+    kpos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, -jnp.inf)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sk,
+                      sq, causal, scale, block_q):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)  # query-block index (grid: B, H, Sq/block_q)
@@ -97,13 +109,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, sq,
             q, ks, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            # bottom-right aligned (matches reference_attention's
-            # tril(k=sk-sq)): query i attends keys <= i + (sk - sq)
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0) + (sk - sq)
-            kpos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+            s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # guard fully-masked rows (m_new == -inf): exp(-inf - -inf) → nan
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -125,20 +131,28 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, sq,
         m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
 
     o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # logsumexp per query row, saved for the blockwise backward
+    lse = jnp.where(jnp.isfinite(m), m + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    lse_ref[0, 0, :, :] = lse
+
+
+def _tiles_ok(sq, sk, block_q, block_k):
+    """Pallas path requires even tiling and the f32 sublane multiple of 8
+    (Mosaic lowering requirement on real TPU)."""
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    return not (sq % block_q or sk % block_k or block_q % 8 or block_k % 8)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out, lse); lse is [B, H, Sq, 1] float32."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    # fall back unless blocks tile evenly AND respect the f32 sublane
-    # multiple of 8 (Mosaic lowering requirement on real TPU)
-    if sq % block_q or sk % block_k or block_q % 8 or block_k % 8:
-        return reference_attention(q, k, v, causal=causal, scale=scale)
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
                                sq=sq, causal=causal, scale=scale,
@@ -153,11 +167,177 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (backward kernels)
+#
+# Standard flash-attention backward: probabilities are recomputed per
+# (q-block, k-block) tile from q, k and the saved logsumexp, so nothing
+# O(S^2) is ever materialized.  Two kernels because TPU has no atomics:
+#   dq  — grid over q blocks, inner loop over k blocks
+#   dkv — grid over k blocks, inner loop over q blocks
+# Both need D = rowsum(dO * O) (the softmax-jacobian correction), computed
+# once outside as an elementwise reduce that XLA fuses.
+# ---------------------------------------------------------------------------
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                         dq_ref, *, block_k, sk, sq, causal, scale,
+                         block_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
+    do = do_ref[0, 0, :, :].astype(jnp.float32)        # [bq, d]
+    lse = lse_ref[0, 0, :, :]                          # [bq, 1] f32
+    dd = dd_ref[0, 0, :, :]                            # [bq, 1] f32
+    safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+
+    n_kb = sk // block_k
+
+    def body(kb, dq):
+        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - safe_lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - dd)                               # [bq, bk]
+        return dq + jax.lax.dot_general(
+            ds, ks, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    if causal:
+        n_needed = jnp.minimum(
+            n_kb, ((qi + 1) * block_q + (sk - sq) + block_k - 1) // block_k)
+        dq = jax.lax.fori_loop(0, n_needed, body, dq)
+    else:
+        dq = jax.lax.fori_loop(0, n_kb, body, dq)
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                          dk_ref, dv_ref, *, block_k, sk, sq, causal,
+                          scale, block_q):
+    from jax.experimental import pallas as pl
+
+    kb = pl.program_id(2)
+    ks = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+    vs = v_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+
+    n_qb = sq // block_q
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)                         # [bq, d]
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        dd = dd_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
+        p = jnp.where(jnp.isfinite(lse), jnp.exp(s - safe_lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dp = jax.lax.dot_general(
+            do, vs, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - dd)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bk, d]
+        return dk, dv
+
+    dk = jnp.zeros((block_k, ks.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, vs.shape[-1]), jnp.float32)
+    if causal:
+        # first q block that can see this k block: q_pos >= k_pos-(sk-sq)
+        start = jnp.maximum(0, (kb * block_k - (sk - sq)) // block_q)
+        dk, dv = jax.lax.fori_loop(start, n_qb, body, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, n_qb, body, (dk, dv))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+               interpret):
+    # NOTE: like the forward, the non-gridded operands (full K/V here, full
+    # Q/dO/lse in the dkv kernel) are staged whole in VMEM, which caps the
+    # single-chip sequence length at roughly S*D*4B ≲ a few MB (S ≈ 8-16k
+    # at D=64).  Longer sequences are the ring_attention path's job; if a
+    # single-chip >16k case appears, move these operands to ANY memory
+    # space with explicit DMA per block.
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    # D = rowsum(dO * O): elementwise + reduce, XLA fuses; O(S) memory
+    dd = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                 axis=-1, keepdims=True)                 # [b, h, sq, 1]
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    qrow = pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0))
+    full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    full_qrow = pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, i: (bi, hi, 0, 0))
+    full_k = pl.BlockSpec((1, 1, sk, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, sk=sk, sq=sq, causal=causal,
+        scale=scale, block_q=block_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, sq // block_q),
+        in_specs=[qspec, full_k, full_k, qspec, qrow, qrow],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, dd)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_k=block_k, sk=sk, sq=sq, causal=causal,
+        scale=scale, block_q=block_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, sk // block_k),
+        in_specs=[full_q, kspec, kspec, full_q, full_qrow, full_qrow],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, dd)
+    return dq, dk, dv
 
 
 def _on_tpu():
@@ -169,22 +349,31 @@ def _on_tpu():
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                      interpret=not _on_tpu())
+    if not _tiles_ok(q.shape[2], k.shape[2], block_q, block_k):
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        interpret=not _on_tpu())
+    return out
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out = _flash(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    if not _tiles_ok(q.shape[2], k.shape[2], block_q, block_k):
+        out = reference_attention(q, k, v, causal=causal, scale=scale)
+        return out, (q, k, v, None, None)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret=not _on_tpu())
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    # backward recomputes through the reference formulation block-free;
-    # activation memory between fwd and bwd stays O(S)
-    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(
-        q_, k_, v_, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:
+        # non-tiling fallback shapes: reference vjp (small/irregular only)
+        _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(
+            q_, k_, v_, causal=causal, scale=scale), q, k, v)
+        return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                      block_k, interpret=not _on_tpu())
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
