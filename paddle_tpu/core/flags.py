@@ -87,6 +87,8 @@ define_flag("check_nan_inf", False,
             "scan fetches/state for NaN/Inf each step (flags.cc:44)")
 define_flag("eager_run", False,
             "interpret programs op-by-op instead of whole-graph jit")
+define_flag("tensor_array_max_len", 256,
+            "default TensorArray capacity (static-shape buffer bound)")
 define_flag("use_flash_attention", False,
             "route attention through the Pallas flash kernel")
 define_flag("benchmark", False, "sync + time every executor run")

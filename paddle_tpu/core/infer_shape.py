@@ -66,7 +66,8 @@ def infer_shape_for_op(block, op) -> None:
             continue
         res_list = res if isinstance(res, (list, tuple)) else [res]
         for name, st in zip(names, res_list):
-            if st is None:
+            # composite values (e.g. TensorArrayVal) have no single shape
+            if st is None or not hasattr(st, "shape"):
                 continue
             try:
                 v = block.var(name)

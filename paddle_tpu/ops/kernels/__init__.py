@@ -13,6 +13,7 @@ from . import (  # noqa: F401
     random,
     optimizers,
     control,
+    tensor_array,
     metrics,
     collective,
     sequence,

@@ -1,13 +1,169 @@
-"""Control/graph-plumbing ops: feed/fetch, compare, logical, select.
-(reference: /root/reference/paddle/fluid/operators/controlflow/ — feed_op.cc,
-fetch_op.cc, compare_op.cc, logical_op.cc; while/conditional_block are
-handled natively by the executor via lax.while_loop/cond, see
-core/executor.py)."""
+"""Control-flow & graph-plumbing ops.
+
+Reference: /root/reference/paddle/fluid/operators/controlflow/ —
+while_op.cc:1, conditional_block_op.cc:1, feed_op.cc, fetch_op.cc,
+compare_op.cc, logical_op.cc; operators/recurrent_op.cc (StaticRNN).
+
+The sub-block ops (`while`, `cond`, `conditional_block`, `static_rnn`)
+recursively trace their sub-Block with BlockTracer — the OpContext carries
+the owning Program (set by BlockTracer.run_op) — and lower to XLA-native
+control flow: lax.while_loop / lax.cond / masked select / lax.scan.  The
+builders live in static/control_flow.py.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..registry import register_op
+
+
+def _sub_tracer(ctx, block_idx):
+    from ...static.executor import BlockTracer
+    program = getattr(ctx, "program", None)
+    if program is None:
+        raise RuntimeError(
+            "sub-block op executed without a Program on the OpContext — "
+            "control-flow ops must run through BlockTracer")
+    return BlockTracer(program.blocks[block_idx])
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(jnp.bool_)
+
+
+def _env_map(names, vals, op_type):
+    """zip names->values, refusing silent misalignment (the registry drops
+    inputs missing from the env, which would shift everything after)."""
+    if len(names) != len(vals):
+        raise ValueError(
+            f"{op_type}: expected values for {names}, got {len(vals)} — "
+            "some referenced var is missing from the environment")
+    return dict(zip(names, vals))
+
+
+@register_op("while", inputs=["Condition!", "X*!"], outputs=["Out*"],
+             grad=None, side_effect=True)
+def while_op(ins, attrs, ctx):
+    """while_op.cc:1 — run the sub-block until the condition var (updated
+    by the body) is false.  Lowered to jax.lax.while_loop over the dict of
+    loop-carried vars; not reverse-differentiable (train recurrences with
+    static_rnn instead, which scans)."""
+    tracer = _sub_tracer(ctx, attrs["sub_block"])
+    x_names = attrs["x_names"]
+    carry_names = attrs["carry_names"]
+    cond_name = attrs["cond_name"]
+    env0 = _env_map(x_names, ins["X"], "while")
+    env0[cond_name] = ins["Condition"]
+    missing = [n for n in carry_names if n not in env0 or env0[n] is None]
+    if missing:
+        raise ValueError(
+            f"while: loop-carried vars {missing} have no value before the "
+            "loop — assign them first (fluid requires this too)")
+    init = {n: env0[n] for n in carry_names}
+
+    def cond_f(carry):
+        return _scalar_bool(carry[cond_name])
+
+    def body(carry):
+        e = dict(env0)
+        e.update(carry)
+        tracer.run(e, ctx)
+        return {n: e[n] for n in carry_names}
+
+    try:
+        final = jax.lax.while_loop(cond_f, body, init)
+    except TypeError as e:
+        if "pytree" in str(e) or "structure" in str(e) or "shape" in str(e):
+            raise TypeError(
+                "while: a loop-carried value changes shape/structure "
+                "between iterations.  Common cause: the FIRST "
+                "write_to_array to a TensorArray happens inside the loop "
+                "body (the empty array's buffer is reallocated at first "
+                "write).  Do the first array_write(..., max_len=N) before "
+                f"the loop.  Original error: {e}") from e
+        raise
+    return {"Out": [final[n] for n in attrs["carry_names"]]}
+
+
+@register_op("cond", inputs=["Cond!", "Input*"], outputs=["Out*"])
+def cond_op(ins, attrs, ctx):
+    """Two-branch conditional -> jax.lax.cond (XLA Conditional).  Reference
+    builds this from two conditional_block ops + select_input
+    (control_flow.py:1976); here it is one op so XLA sees a real
+    Conditional and only materializes the taken branch."""
+    tb = _sub_tracer(ctx, attrs["true_block"])
+    fb = _sub_tracer(ctx, attrs["false_block"])
+    env0 = _env_map(attrs["input_names"], ins["Input"], "cond")
+    # branches may read the predicate variable itself
+    if attrs.get("cond_name"):
+        env0.setdefault(attrs["cond_name"], ins["Cond"])
+
+    def run(tracer, out_names):
+        def f(env):
+            e = dict(env)
+            tracer.run(e, ctx)
+            return tuple(e[n] for n in out_names)
+        return f
+
+    outs = jax.lax.cond(_scalar_bool(ins["Cond"]),
+                        run(tb, attrs["true_outs"]),
+                        run(fb, attrs["false_outs"]), env0)
+    return {"Out": list(outs)}
+
+
+@register_op("conditional_block", inputs=["Cond!", "Input*"],
+             outputs=["Out*"])
+def conditional_block_op(ins, attrs, ctx):
+    """Single-branch guarded block (conditional_block_op.cc:1), used by
+    Switch.  TPU lowering: the body computes unconditionally and
+    where(cond, new, old) merges — XLA select semantics (see
+    fleet/meta_optimizers/rewrite_utils.py for why this beats host
+    branching on TPU).  The guarded bodies are tiny (LR updates, param
+    averaging), so computing both sides is the right trade."""
+    tracer = _sub_tracer(ctx, attrs["sub_block"])
+    env0 = _env_map(attrs["input_names"], ins["Input"],
+                    "conditional_block")
+    pred = _scalar_bool(ins["Cond"])
+    e = dict(env0)
+    tracer.run(e, ctx)
+    outs = []
+    for n in attrs["out_names"]:
+        new = e[n]
+        old = env0.get(n)
+        if old is None:
+            raise ValueError(
+                f"conditional_block writes {n!r} which has no value before "
+                "the block — initialize it first")
+        outs.append(jnp.where(pred, new, old))
+    return {"Out": outs}
+
+
+@register_op("static_rnn", inputs=["X*"], outputs=["Out*"])
+def static_rnn_op(ins, attrs, ctx):
+    """StaticRNN (recurrent_op.cc) -> jax.lax.scan over the time-major
+    leading axis: compiled recurrence, O(1) graph size in T, reverse-mode
+    differentiable (scan has a VJP; while_loop does not)."""
+    tracer = _sub_tracer(ctx, attrs["sub_block"])
+    env0 = _env_map(attrs["x_names"], ins["X"], "static_rnn")
+    memories = attrs["memories"]          # [boot, pre, updated]
+    scan_inputs = attrs["scan_inputs"]    # [parent_name, in_block_name]
+    step_outputs = attrs["step_outputs"]
+
+    carry0 = {pre: env0[boot] for boot, pre, _ in memories}
+    xs = {inb: env0[pn] for pn, inb in scan_inputs}
+
+    def f(carry, x_slice):
+        e = dict(env0)
+        e.update(carry)
+        e.update(x_slice)
+        tracer.run(e, ctx)
+        new_carry = {pre: e[upd] for _, pre, upd in memories}
+        ys = tuple(e[n] for n in step_outputs)
+        return new_carry, ys
+
+    _, ys = jax.lax.scan(f, carry0, xs)
+    return {"Out": list(ys)}
 
 
 @register_op("feed", inputs=[], outputs=["Out"], grad=None, side_effect=True)

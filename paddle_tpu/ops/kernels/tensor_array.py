@@ -1,0 +1,93 @@
+"""TensorArray ops: create / write_to_array / read_from_array / length.
+
+Reference: /root/reference/paddle/fluid/operators/controlflow/
+tensor_array_read_write_op.cc (WriteToArray/ReadFromArray over
+LoDTensorArray), lod_array_length_op.cc.
+
+TPU redesign: LoDTensorArray is a host-side vector of tensors — impossible
+under XLA's static shapes.  Here an array is a fixed-capacity device
+buffer [capacity, ...] plus an int32 size, registered as a pytree so it
+flows through jit / lax.while_loop carries.  Capacity is fixed at the
+first write (max_len attr, FLAGS_tensor_array_max_len fallback); writes
+are lax.dynamic_update_slice, reads lax.dynamic_index_in_dim — both
+compile to in-place HBM updates under XLA buffer donation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+DEFAULT_MAX_LEN = 256
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayVal:
+    """Fixed-capacity device tensor array: buffer [capacity, ...] + size."""
+
+    def __init__(self, buffer, size):
+        self.buffer = buffer
+        self.size = size
+
+    def tree_flatten(self):
+        return (self.buffer, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def capacity(self):
+        return self.buffer.shape[0]
+
+    def __repr__(self):
+        return (f"TensorArrayVal(capacity={self.buffer.shape[0]}, "
+                f"elem={self.buffer.shape[1:]}, dtype={self.buffer.dtype})")
+
+
+def _empty(dtype):
+    return TensorArrayVal(jnp.zeros((0,), dtype), jnp.zeros((), jnp.int32))
+
+
+@register_op("create_tensor_array", inputs=[], outputs=["Out"], grad=None)
+def create_tensor_array(ins, attrs, ctx):
+    from ...core.dtype import np_dtype
+    return {"Out": _empty(np_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("write_to_array", inputs=["X", "I!", "Array?"],
+             outputs=["Out"], grad=None)
+def write_to_array(ins, attrs, ctx):
+    x, i = ins["X"], ins["I"]
+    arr = ins.get("Array")
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    if arr is None or (arr.buffer.ndim == 1
+                       and arr.buffer.shape[0] == 0):
+        # first write fixes capacity and element shape
+        max_len = int(attrs.get("max_len") or 0)
+        if max_len <= 0:
+            from ...core.flags import flag
+            max_len = int(flag("tensor_array_max_len", DEFAULT_MAX_LEN))
+        buf = jnp.zeros((max_len,) + tuple(x.shape), x.dtype)
+        arr = TensorArrayVal(buf, jnp.zeros((), jnp.int32))
+    zero = jnp.zeros((), i.dtype)
+    buf = jax.lax.dynamic_update_slice(
+        arr.buffer, x[None].astype(arr.buffer.dtype),
+        (i,) + (zero,) * x.ndim)
+    size = jnp.maximum(arr.size, i + 1)
+    return {"Out": TensorArrayVal(buf, size)}
+
+
+@register_op("read_from_array", inputs=["X", "I!"], outputs=["Out"],
+             grad=None)
+def read_from_array(ins, attrs, ctx):
+    arr, i = ins["X"], ins["I"]
+    i = jnp.reshape(i, ()).astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_index_in_dim(arr.buffer, i, 0,
+                                                keepdims=False)}
+
+
+@register_op("lod_array_length", inputs=["X!"], outputs=["Out"], grad=None)
+def lod_array_length(ins, attrs, ctx):
+    return {"Out": jnp.reshape(ins["X"].size, (1,)).astype(jnp.int64)}

@@ -24,6 +24,7 @@ from . import layers  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import nets  # noqa: F401
+from . import control_flow  # noqa: F401
 from .layers import data  # noqa: F401
 
 from .optimizer import (  # noqa: F401

@@ -1,0 +1,517 @@
+"""Control flow: While / cond / case / switch_case / Switch / StaticRNN.
+
+Reference: /root/reference/python/paddle/fluid/layers/control_flow.py
+(`While` :1020, `cond` :1976, `case` :2753, `switch_case` :3331, `Switch`
+:1461, `StaticRNN` :411) and the C++ ops
+/root/reference/paddle/fluid/operators/controlflow/while_op.cc:1,
+conditional_block_op.cc:1, operators/recurrent_op.cc.
+
+TPU-native redesign (NOT a translation of the reference's scope-pushing
+executors):
+
+  * builders create real sub-Blocks in the Program (multi-block IR, same as
+    the reference), recording the sub-block's free variables and
+    parent-variable writes at build time;
+  * the kernels (ops/kernels/control.py) recursively trace the sub-block
+    with BlockTracer and lower to XLA-native control flow:
+        while             -> jax.lax.while_loop   (not differentiable)
+        cond              -> jax.lax.cond         (differentiable)
+        static_rnn        -> jax.lax.scan         (differentiable, the
+                             TPU-idiomatic recurrent lowering: compiled
+                             loop, O(1) graph size, remat-friendly)
+        conditional_block -> masked merge: both sides compute,
+                             where(cond, new, old) selects (the XLA
+                             `select` trade — see
+                             distributed/fleet/meta_optimizers/
+                             rewrite_utils.py for the doctrine)
+  * everything stays inside the ONE whole-block jit of the executor — no
+    host round trips between iterations.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.program import (Block, OpDesc, Program, VarDesc,
+                            default_main_program, unique_name)
+from .layer_helper import LayerHelper
+
+__all__ = ["While", "cond", "case", "switch_case", "Switch", "StaticRNN",
+           "increment", "less_than", "array_write", "array_read",
+           "array_length", "create_array"]
+
+
+# re-exported conveniences (reference keeps these in control_flow.py)
+def increment(x, value=1.0, in_place=True):
+    from . import layers
+    return layers.increment(x, value=value, in_place=in_place)
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    from . import layers
+    return layers.less_than(x, y, cond=cond)
+
+
+# ---------------------------------------------------------------------------
+# sub-block analysis
+# ---------------------------------------------------------------------------
+def _analyze_block(sub: Block) -> Tuple[List[str], List[str]]:
+    """Return (free_vars, written_parent_vars) of a sub-block, in first-use
+    order.
+
+    free: names read before any op in the block writes them — their values
+    must be supplied by the enclosing scope.
+    written_parent: names written by the block that resolve to a variable of
+    an ANCESTOR block (loop-carried / branch-assigned state) — everything
+    else the block writes is a local temporary.
+    """
+    defined: set = set()
+    free: List[str] = []
+    written: List[str] = []
+    for op in sub.ops:
+        for n in op.input_names():
+            if n and n not in defined and n not in free:
+                free.append(n)
+        for n in op.output_names():
+            if n:
+                defined.add(n)
+                if n not in written:
+                    written.append(n)
+
+    def _in_ancestor(name: str) -> bool:
+        b = (sub.program.blocks[sub.parent_idx]
+             if sub.parent_idx >= 0 else None)
+        while b is not None:
+            if name in b.vars:
+                return True
+            b = (sub.program.blocks[b.parent_idx]
+                 if b.parent_idx >= 0 else None)
+        return False
+
+    written_parent = [n for n in written
+                      if n not in sub.vars and _in_ancestor(n)]
+    return free, written_parent
+
+
+@contextlib.contextmanager
+def _sub_block(program: Program):
+    sub = program.create_block()
+    try:
+        yield sub
+    finally:
+        program.rollback()
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+class While:
+    """while-loop over a sub-block (control_flow.py:1020 `While`).
+
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...body ops, must update `cond` (e.g. via layers.less_than
+            with output into cond) or the loop never ends...
+
+    Loop-carried variables are discovered automatically: every parent
+    variable the body writes is carried (it must hold a value before the
+    loop).  Lowered to jax.lax.while_loop — NOT differentiable; train
+    recurrences with StaticRNN (lax.scan) instead.
+    """
+
+    def __init__(self, cond: VarDesc, is_test: bool = False, name=None):
+        if cond.dtype not in ("bool",):
+            raise TypeError("While condition must be a bool variable, got "
+                            f"{cond.dtype}")
+        if cond.shape is not None and tuple(cond.shape) not in ((), (1,)):
+            raise TypeError("While condition must be a scalar (shape [1]), "
+                            f"got {cond.shape}")
+        self.cond_var = cond
+        self.program = (cond.block.program if cond.block is not None
+                        else default_main_program())
+        self.is_test = is_test
+
+    @contextlib.contextmanager
+    def block(self):
+        parent = self.program.current_block()
+        with _sub_block(self.program) as sub:
+            yield
+        free, written = _analyze_block(sub)
+        cond_name = self.cond_var.name
+        if cond_name not in written:
+            raise ValueError(
+                "While body never updates the loop condition "
+                f"{cond_name!r}; the loop would not terminate")
+        # carried vars (written parent state incl. cond) need initial
+        # values, so they are inputs too
+        x_names = list(dict.fromkeys(
+            [n for n in free if n != cond_name] + written))
+        parent.append_op(
+            "while",
+            inputs={"Condition": [cond_name], "X": x_names},
+            outputs={"Out": list(written)},
+            attrs={"sub_block": sub.idx, "x_names": x_names,
+                   "carry_names": list(written), "cond_name": cond_name,
+                   "is_test": self.is_test})
+
+
+# ---------------------------------------------------------------------------
+# cond / case / switch_case
+# ---------------------------------------------------------------------------
+def _flatten_rets(ret):
+    if ret is None:
+        return [], None
+    if isinstance(ret, (list, tuple)):
+        return list(ret), type(ret)
+    return [ret], "single"
+
+
+def cond(pred: VarDesc, true_fn=None, false_fn=None, name=None):
+    """Two-branch conditional (control_flow.py:1976) lowered to
+    jax.lax.cond.  Both branches must return the same structure of
+    same-shape/dtype variables; writes to enclosing-scope variables inside a
+    branch are merged (the other branch keeps the incoming value)."""
+    program = (pred.block.program if pred.block is not None
+               else default_main_program())
+    parent = program.current_block()
+
+    with _sub_block(program) as tb:
+        t_ret = true_fn() if true_fn is not None else None
+    with _sub_block(program) as fb:
+        f_ret = false_fn() if false_fn is not None else None
+
+    t_list, t_kind = _flatten_rets(t_ret)
+    f_list, f_kind = _flatten_rets(f_ret)
+    if len(t_list) != len(f_list):
+        raise ValueError(
+            f"cond branches return different arity: true_fn -> "
+            f"{len(t_list)} values, false_fn -> {len(f_list)}")
+
+    t_free, t_written = _analyze_block(tb)
+    f_free, f_written = _analyze_block(fb)
+    # parent vars written by either branch are extra (merged) outputs
+    extra = [n for n in dict.fromkeys(t_written + f_written)]
+    free = list(dict.fromkeys(t_free + f_free + extra))
+    free = [n for n in free if n != pred.name]
+
+    true_outs = [v.name for v in t_list] + extra
+    false_outs = [v.name for v in f_list] + extra
+
+    out_vars = []
+    for tv in t_list:
+        ov = parent.create_var(name=unique_name("cond_out"),
+                               shape=tv.shape, dtype=tv.dtype,
+                               stop_gradient=tv.stop_gradient)
+        out_vars.append(ov)
+    out_names = [v.name for v in out_vars] + extra
+
+    parent.append_op(
+        "cond",
+        inputs={"Cond": [pred.name], "Input": free},
+        outputs={"Out": out_names},
+        attrs={"true_block": tb.idx, "false_block": fb.idx,
+               "input_names": free, "true_outs": true_outs,
+               "false_outs": false_outs, "cond_name": pred.name})
+
+    if t_kind is None:
+        return None
+    if t_kind == "single":
+        return out_vars[0]
+    return t_kind(out_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """if/elif/else chain (control_flow.py:2753) built from nested cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            # reference: last fn doubles as the default when none is given
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index: VarDesc, branch_fns, default=None, name=None):
+    """Indexed dispatch (control_flow.py:3331).  branch_fns: dict
+    {index: fn} or list of (index, fn) / fns."""
+    from . import layers
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = [(i, f) if not isinstance(f, (tuple, list)) else tuple(f)
+                 for i, f in enumerate(branch_fns)]
+        items = [it if isinstance(it[0], int) else (i, it[1])
+                 for i, it in enumerate(items)]
+    if default is None:
+        default = items[-1][1]
+    pairs = []
+    for idx, fn in items:
+        idx_c = layers.fill_constant([1], branch_index.dtype, idx)
+        pairs.append((layers.equal(branch_index, idx_c), fn))
+    return case(pairs, default)
+
+
+# ---------------------------------------------------------------------------
+# Switch (first-true-wins assignment chain; LR-schedule workhorse)
+# ---------------------------------------------------------------------------
+class Switch:
+    """control_flow.py:1461 `Switch`: sequential cases, first true wins;
+    case bodies assign enclosing-scope variables.
+
+        with layers.Switch() as switch:
+            with switch.case(step < warmup):
+                layers.assign(warm_lr, lr)
+            with switch.default():
+                layers.assign(base_lr, lr)
+
+    Lowering: each case becomes a conditional_block op whose effective
+    predicate is `cond_i AND NOT any(cond_j, j<i)`; the kernel computes the
+    body unconditionally and merges with where(pred, new, old) — XLA select
+    semantics, one fused computation, no host branching.
+    """
+
+    def __init__(self, name=None):
+        self.program = default_main_program()
+        self._prior = None  # var: OR of all previous case conditions
+        self._has_default = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    @contextlib.contextmanager
+    def case(self, condition: VarDesc):
+        from . import layers
+        if self._has_default:
+            raise ValueError("Switch: case() after default()")
+        # effective predicate, built in the PARENT block
+        if self._prior is None:
+            eff = condition
+            self._prior = condition
+        else:
+            eff = layers.logical_and(condition,
+                                     layers.logical_not(self._prior))
+            self._prior = layers.logical_or(self._prior, condition)
+        yield from self._guarded_block(eff)
+
+    @contextlib.contextmanager
+    def default(self):
+        from . import layers
+        if self._prior is None:
+            raise ValueError("Switch: default() before any case()")
+        self._has_default = True
+        eff = layers.logical_not(self._prior)
+        yield from self._guarded_block(eff)
+
+    def _guarded_block(self, eff: VarDesc):
+        parent = self.program.current_block()
+        with _sub_block(self.program) as sub:
+            yield
+        free, written = _analyze_block(sub)
+        if not written:
+            raise ValueError("Switch case body assigns no enclosing-scope "
+                             "variable — nothing to merge")
+        # incoming values of written vars are needed for the merge
+        inputs = list(dict.fromkeys(free + written))
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": [eff.name], "Input": inputs},
+            outputs={"Out": list(written)},
+            attrs={"sub_block": sub.idx, "input_names": inputs,
+                   "out_names": list(written)})
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN -> lax.scan
+# ---------------------------------------------------------------------------
+class StaticRNN:
+    """Recurrent network over a fixed-length (time-major) sequence
+    (control_flow.py:411 `StaticRNN`, C++ operators/recurrent_op.cc).
+
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [T, B, D] time-major
+            h_prev = rnn.memory(init=h0)     # h0: [B, H]
+            h = layers.fc(layers.concat([x_t, h_prev], 1), H, act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        hs = rnn()                           # [T, B, H]
+
+    Lowered to ONE `static_rnn` op executed as jax.lax.scan: compiled
+    recurrence, constant graph size in T, reverse-differentiable (so
+    training works through it — unlike `While`).
+    """
+
+    def __init__(self, name=None):
+        self.program = default_main_program()
+        self._sub: Optional[Block] = None
+        self._scan_inputs: List[Tuple[str, str]] = []  # (parent, in-block)
+        self._memories: List[Tuple[str, str, Optional[str]]] = []
+        self._step_outputs: List[str] = []
+        self._seq_len: Optional[int] = None
+        self._status = "before"
+        self._out_vars: List[VarDesc] = []
+
+    @contextlib.contextmanager
+    def step(self):
+        parent = self.program.current_block()
+        self._status = "in"
+        with _sub_block(self.program) as sub:
+            self._sub = sub
+            yield
+        self._status = "after"
+        self._finalize(parent)
+
+    def _require_in_step(self):
+        if self._status != "in":
+            raise RuntimeError("StaticRNN: call inside `with rnn.step():`")
+
+    def step_input(self, x: VarDesc) -> VarDesc:
+        self._require_in_step()
+        if x.shape is None or len(x.shape) < 1:
+            raise ValueError("step_input needs a [T, ...] time-major var")
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        xt = self._sub.create_var(name=unique_name(x.name + "@step"),
+                                  shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._scan_inputs.append((x.name, xt.name))
+        return xt
+
+    def memory(self, init: Optional[VarDesc] = None, shape=None,
+               batch_ref: Optional[VarDesc] = None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1) -> VarDesc:
+        self._require_in_step()
+        from . import layers
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("StaticRNN.memory needs init= or "
+                                 "(shape=, batch_ref=)")
+            # boot var built in the PARENT block (reference parity)
+            cur = self.program._current_block_idx
+            self.program._current_block_idx = self._sub.parent_idx
+            try:
+                init = layers.fill_constant_batch_size_like(
+                    batch_ref, [-1] + list(shape[1:] if len(shape) > 1
+                                           else shape),
+                    "float32", init_value,
+                    input_dim_idx=ref_batch_dim_idx,
+                    output_dim_idx=init_batch_dim_idx)
+            finally:
+                self.program._current_block_idx = cur
+        pre = self._sub.create_var(name=unique_name(init.name + "@pre"),
+                                   shape=init.shape, dtype=init.dtype)
+        self._memories.append([init.name, pre.name, None])
+        return pre
+
+    def update_memory(self, mem: VarDesc, var: VarDesc):
+        self._require_in_step()
+        for m in self._memories:
+            if m[1] == mem.name:
+                m[2] = var.name
+                return
+        raise ValueError(f"{mem.name!r} is not a StaticRNN memory")
+
+    def step_output(self, o: VarDesc):
+        self._require_in_step()
+        self._step_outputs.append(o.name)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _finalize(self, parent: Block):
+        if not self._step_outputs:
+            raise ValueError("StaticRNN produced no step_output")
+        for boot, pre, upd in self._memories:
+            if upd is None:
+                raise ValueError(f"memory {pre!r} never update_memory()d")
+        free, _ = _analyze_block(self._sub)
+        local = ({p for _, p in self._scan_inputs}
+                 | {pre for _, pre, _ in self._memories})
+        x_names = list(dict.fromkeys(
+            [n for n in free if n not in local]
+            + [pn for pn, _ in self._scan_inputs]
+            + [boot for boot, _, _ in self._memories]))
+        self._out_vars = []
+        for n in self._step_outputs:
+            v = self._sub.var(n)
+            shape = ((self._seq_len,) + tuple(v.shape)
+                     if v.shape is not None and self._seq_len is not None
+                     else None)
+            self._out_vars.append(parent.create_var(
+                name=unique_name("rnn_out"), shape=shape, dtype=v.dtype))
+        parent.append_op(
+            "static_rnn",
+            inputs={"X": x_names},
+            outputs={"Out": [v.name for v in self._out_vars]},
+            attrs={"sub_block": self._sub.idx, "x_names": x_names,
+                   "scan_inputs": [list(p) for p in self._scan_inputs],
+                   "memories": [list(m) for m in self._memories],
+                   "step_outputs": list(self._step_outputs)})
+
+    def __call__(self):
+        if self._status != "after":
+            raise RuntimeError("StaticRNN outputs available after the "
+                               "step() block closes")
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return list(self._out_vars)
+
+
+# ---------------------------------------------------------------------------
+# tensor array (LoDTensorArray analog: fixed-capacity device buffer)
+# ---------------------------------------------------------------------------
+def create_array(dtype, initialized_list=None):
+    """LoDTensorArray analog (layers/tensor.py create_array).  On TPU the
+    array is a fixed-capacity device buffer (see ops/kernels/tensor_array.py
+    TensorArrayVal); capacity is taken at the first array_write."""
+    helper = LayerHelper("create_array")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    out.attrs["is_tensor_array"] = True
+    helper.append_op("create_tensor_array", outputs={"Out": [out]},
+                     attrs={"dtype": out.dtype})
+    if initialized_list:
+        from . import layers
+        i = layers.fill_constant([1], "int64", 0)
+        for x in initialized_list:
+            array_write(x, i, array=out)
+            i = layers.increment(i, in_place=False)
+    return out
+
+
+def array_write(x: VarDesc, i: VarDesc, array=None, max_len=None):
+    """write x at index i (tensor_array_read_write ops).  max_len bounds the
+    buffer capacity when the array is empty (default from
+    FLAGS_tensor_array_max_len, 256)."""
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array",
+                     inputs={"X": [x], "I": [i], "Array": [array]},
+                     outputs={"Out": [array]},
+                     attrs={"max_len": max_len or 0})
+    return array
+
+
+def array_read(array: VarDesc, i: VarDesc):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("read_from_array",
+                     inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array: VarDesc):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out

@@ -121,6 +121,9 @@ class BlockTracer:
         return env
 
     def run_op(self, op, env: Dict[str, Any], ctx: OpContext):
+        # sub-block ops (while/cond/static_rnn/...) reach their Program
+        # through the context and recurse with their own BlockTracer
+        ctx.program = self.block.program
         info = get_op_info(op.type)
         if info is None:
             raise NotImplementedError(

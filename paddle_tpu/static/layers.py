@@ -570,12 +570,37 @@ elementwise_pow = _make_elementwise("elementwise_pow")
 elementwise_mod = _make_elementwise("elementwise_mod")
 elementwise_floordiv = _make_elementwise("elementwise_floordiv")
 
-equal = _make_elementwise("equal")
-not_equal = _make_elementwise("not_equal")
-less_than = _make_elementwise("less_than")
-less_equal = _make_elementwise("less_equal")
-greater_than = _make_elementwise("greater_than")
-greater_equal = _make_elementwise("greater_equal")
+def _make_compare(op_type, has_force_cpu=False):
+    """Comparison layer; `cond=` writes the result into an existing bool
+    var (fluid layers/control_flow.py less_than(..., cond=) parity — the
+    idiom While bodies use to update their loop condition).  Only
+    less_than takes force_cpu positionally, matching the reference
+    signature less_than(x, y, force_cpu=None, cond=None)."""
+
+    def _build(x, y, cond, axis, name):
+        if cond is None:
+            return _binary_op(op_type, x, y, axis=axis)
+        helper = LayerHelper(op_type, name=name)
+        helper.append_op(op_type, inputs={"X": x, "Y": y},
+                         outputs={"Out": cond}, attrs={"axis": axis})
+        return cond
+
+    if has_force_cpu:
+        def f(x, y, force_cpu=None, cond=None, axis=-1, name=None):
+            return _build(x, y, cond, axis, name)
+    else:
+        def f(x, y, cond=None, axis=-1, name=None):
+            return _build(x, y, cond, axis, name)
+    f.__name__ = op_type
+    return f
+
+
+equal = _make_compare("equal")
+not_equal = _make_compare("not_equal")
+less_than = _make_compare("less_than", has_force_cpu=True)
+less_equal = _make_compare("less_equal")
+greater_than = _make_compare("greater_than")
+greater_equal = _make_compare("greater_equal")
 logical_and = _make_elementwise("logical_and")
 logical_or = _make_elementwise("logical_or")
 
@@ -1273,3 +1298,14 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
 
 def batch_norm_stats(*a, **kw):
     raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# control flow (fluid.layers.control_flow parity; see static/control_flow.py)
+# ---------------------------------------------------------------------------
+from .control_flow import (  # noqa: E402,F401
+    While, cond, case, switch_case, Switch, StaticRNN,
+    array_write, array_read, array_length, create_array)
+
+__all__ += ["While", "cond", "case", "switch_case", "Switch", "StaticRNN",
+            "array_write", "array_read", "array_length", "create_array"]

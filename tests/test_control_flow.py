@@ -1,0 +1,311 @@
+"""Control-flow tests: while / cond / case / switch_case / Switch /
+StaticRNN / tensor arrays (reference test models:
+fluid/tests/unittests/test_while_op.py, test_cond.py, test_case.py,
+test_switch.py, test_recurrent_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _run(main, startup, feed=None, fetch=None, steps=1, scope=None):
+    exe = static.Executor()
+    scope = scope or static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            out = exe.run(main, feed=feed or {}, fetch_list=fetch or [])
+    return out, scope
+
+
+def test_while_sum():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 10)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.elementwise_add(acc, layers.cast(i, "float32")),
+                          output=acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond)
+        total = layers.elementwise_add(acc, layers.fill_constant(
+            [1], "float32", 0.0))
+    (out,), _ = _run(main, startup, fetch=[total])
+    assert float(out) == sum(range(10))
+
+
+def test_while_matmul_power():
+    """Loop-carried matrix state (exercises non-scalar carries)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 3)
+        h = layers.elementwise_add(x, layers.fill_constant([1], "float32", 0.0))
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.scale(h, scale=2.0), output=h)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond)
+    xv = np.ones((2, 4), np.float32)
+    (out,), _ = _run(main, startup, feed={"x": xv}, fetch=[h])
+    np.testing.assert_allclose(out, xv * 8.0)
+
+
+def test_cond_value_and_both_branches():
+    for flag_val, expect in ((1.0, 5.0), (-1.0, -6.0)):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 1])
+            pred = layers.greater_than(
+                layers.reduce_sum(x), layers.fill_constant([1], "float32", 0.0))
+            out = layers.cond(
+                pred,
+                lambda: layers.elementwise_add(
+                    x, layers.fill_constant([1], "float32", 4.0)),
+                lambda: layers.elementwise_sub(
+                    x, layers.fill_constant([1], "float32", 5.0)))
+        xv = np.full((1, 1), flag_val, np.float32)
+        (o,), _ = _run(main, startup, feed={"x": xv}, fetch=[out])
+        assert float(o.reshape(())) == pytest.approx(expect)
+
+
+def test_cond_grad_flows():
+    """Gradients must flow through the taken branch (lax.cond vjp)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        x.stop_gradient = False
+        pred = layers.greater_than(layers.reduce_sum(x),
+                                   layers.fill_constant([1], "float32", 0.0))
+        y = layers.cond(pred,
+                        lambda: layers.scale(x, scale=3.0),
+                        lambda: layers.scale(x, scale=7.0))
+        loss = layers.reduce_sum(y)
+        grads = static.gradients([loss], [x])
+    xv = np.ones((1, 2), np.float32)
+    (g,), _ = _run(main, startup, feed={"x": xv}, fetch=[grads[0]])
+    np.testing.assert_allclose(g, np.full((1, 2), 3.0))
+    xv = -np.ones((1, 2), np.float32)
+    (g,), _ = _run(main, startup, feed={"x": xv}, fetch=[grads[0]])
+    np.testing.assert_allclose(g, np.full((1, 2), 7.0))
+
+
+def test_case_chain():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 1])
+        s = layers.reduce_sum(x)
+
+        def branch(v):
+            return lambda: layers.fill_constant([1], "float32", v)
+
+        out = layers.case(
+            [(layers.less_than(s, layers.fill_constant([1], "float32", 0.0)),
+              branch(-1.0)),
+             (layers.less_than(s, layers.fill_constant([1], "float32", 10.0)),
+              branch(1.0))],
+            default=branch(99.0))
+    for xv, expect in ((-5.0, -1.0), (5.0, 1.0), (50.0, 99.0)):
+        (o,), _ = _run(main, startup,
+                       feed={"x": np.full((1, 1), xv, np.float32)},
+                       fetch=[out])
+        assert float(o.reshape(())) == expect
+
+
+def test_switch_case_indexed():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        idx = layers.data("idx", [1], dtype="int64")
+        out = layers.switch_case(
+            idx,
+            {0: lambda: layers.fill_constant([1], "float32", 10.0),
+             1: lambda: layers.fill_constant([1], "float32", 20.0),
+             2: lambda: layers.fill_constant([1], "float32", 30.0)})
+    for i in range(3):
+        (o,), _ = _run(main, startup,
+                       feed={"idx": np.array([i], np.int64)}, fetch=[out])
+        assert float(o.reshape(())) == 10.0 * (i + 1)
+
+
+def test_switch_lr_warmup():
+    """The reference's Switch workhorse: LR warmup schedule over a
+    persistable step counter, one jitted graph, many steps."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        step = layers.create_global_var([1], 0.0, "float32",
+                                        persistable=True, name="gstep")
+        lr = layers.create_global_var([1], 0.0, "float32",
+                                      persistable=True, name="lr")
+        layers.increment(step, value=1)
+        warm_end = layers.fill_constant([1], "float32", 3.0)
+        with layers.Switch() as sw:
+            with sw.case(layers.less_equal(step, warm_end)):
+                layers.assign(layers.scale(step, scale=0.1), output=lr)
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 1.0),
+                              output=lr)
+    exe = static.Executor()
+    scope = static.Scope()
+    seen = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            (lrv,) = exe.run(main, fetch_list=[lr])
+            seen.append(round(float(lrv), 5))
+    assert seen == [0.1, 0.2, 0.3, 1.0, 1.0], seen
+
+
+def test_static_rnn_matches_numpy():
+    T, B, D, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    xv = rng.rand(T, B, D).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [T, B, D])
+        h0 = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc(layers.concat([x_t, h_prev], axis=1), H,
+                          act="tanh",
+                          param_attr=static.ParamAttr(
+                              name="rnn_w",
+                              initializer=static.NumpyArrayInitializer(
+                                  rng.rand(D + H, H).astype(np.float32))),
+                          bias_attr=False)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+    (o,), scope = _run(main, startup, feed={"x": xv}, fetch=[out])
+
+    w = None
+    with static.scope_guard(scope):
+        pass
+    w = np.asarray(scope.get("rnn_w"))
+    hs = []
+    h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        h = np.tanh(np.concatenate([xv[t], h], 1) @ w)
+        hs.append(h)
+    np.testing.assert_allclose(o, np.stack(hs), rtol=1e-5, atol=1e-5)
+
+
+def test_static_rnn_trains():
+    """RNN loop training E2E: memorize a sequence-sum regression task
+    through the scan-lowered recurrence."""
+    T, B, D, H = 6, 8, 3, 8
+    rng = np.random.RandomState(1)
+    xv = rng.rand(T, B, D).astype(np.float32)
+    yv = xv.sum(axis=(0, 2), keepdims=False).reshape(B, 1).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [T, B, D])
+        y = layers.data("y", [B, 1])
+        h0 = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc(layers.concat([x_t, h_prev], axis=1), H,
+                          act="tanh")
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        hs = rnn()
+        last = layers.slice(hs, axes=[0], starts=[T - 1], ends=[T])
+        pred = layers.fc(layers.reshape(last, [B, H]), 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        for i in range(60):
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+    assert float(lv) < first * 0.1, (first, float(lv))
+
+
+def test_tensor_array_write_read():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        layers.array_write(x, i0, array=arr, max_len=8)
+        layers.array_write(layers.scale(x, scale=2.0), i1, array=arr)
+        n = layers.array_length(arr)
+        r0 = layers.array_read(arr, i0)
+        r1 = layers.array_read(arr, i1)
+    xv = np.ones((2, 4), np.float32)
+    (nv, a0, a1), _ = _run(main, startup, feed={"x": xv},
+                           fetch=[n, r0, r1])
+    assert int(nv) == 2
+    np.testing.assert_allclose(a0, xv)
+    np.testing.assert_allclose(a1, xv * 2)
+
+
+def test_tensor_array_in_while_loop():
+    """Decode-loop shape: write one step result per iteration."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        arr = layers.create_array("float32")
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 4)
+        # first write OUTSIDE the loop fixes the buffer capacity
+        layers.array_write(x, i, array=arr, max_len=8)
+        layers.increment(i, value=1)
+        h = layers.elementwise_add(x, layers.fill_constant(
+            [1], "float32", 0.0))
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(layers.scale(h, scale=2.0), output=h)
+            layers.array_write(h, i, array=arr)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond)
+        n_out = layers.array_length(arr)
+        last = layers.array_read(arr, layers.fill_constant([1], "int64", 3))
+    xv = np.ones((1, 2), np.float32)
+    (cnt, lastv), _ = _run(main, startup, feed={"x": xv},
+                           fetch=[n_out, last])
+    assert int(cnt) == 4
+    np.testing.assert_allclose(lastv, xv * 8.0)
+
+
+def test_nested_cond_in_while():
+    """Nested control flow: alternating add inside a loop."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 6)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        two = layers.fill_constant([1], "int64", 2)
+        cond_v = layers.less_than(i, n)
+        w = layers.While(cond_v)
+        with w.block():
+            rem = layers.elementwise_mod(i, two)
+            is_even = layers.equal(rem, layers.fill_constant([1], "int64", 0))
+            delta = layers.cond(
+                is_even,
+                lambda: layers.fill_constant([1], "float32", 1.0),
+                lambda: layers.fill_constant([1], "float32", 10.0))
+            layers.assign(layers.elementwise_add(acc, delta), output=acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond_v)
+    (out,), _ = _run(main, startup, fetch=[acc])
+    assert float(out) == 3 * 1.0 + 3 * 10.0
