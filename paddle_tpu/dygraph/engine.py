@@ -123,12 +123,17 @@ def _final_grad(t: Tensor, gmap: _GradMap):
 
 
 def _run_node(node: GradNode, gmap: _GradMap):
-    if node.vjp_fn is not None:  # trace_jax node
+    if node.vjp_fn is not None:  # trace_jax / to_static node
         ts = node.out_tensors["Out"]
-        g = _final_grad(ts[0], gmap)
-        if g is None:
+        gs = [_final_grad(t, gmap) for t in ts]
+        if all(g is None for g in gs):
             return
-        dins = node.vjp_fn(g)
+        if getattr(node, "vjp_multi", False):
+            gs = [jnp.zeros_like(t._value) if g is None else g
+                  for t, g in zip(ts, gs)]
+            dins = node.vjp_fn(gs)
+        else:
+            dins = node.vjp_fn(gs[0])
         for t, d in zip(node.ins["X"], dins):
             if isinstance(t, Tensor) and not t.stop_gradient:
                 gmap.add(t, d)

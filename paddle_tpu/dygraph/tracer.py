@@ -21,6 +21,10 @@ from .tensor import Tensor
 
 __all__ = ["trace_op", "trace_jax", "GradNode"]
 
+# program capture hook (paddle_tpu.jit to_static): when set, every traced op
+# is also mirrored into a Program (program_desc_tracer.cc analog)
+_PROGRAM_RECORDER = None
+
 
 class GradNode:
     """One recorded op in the reverse graph (OpBase/GradOpNode analog,
@@ -28,7 +32,7 @@ class GradNode:
 
     __slots__ = ("op_type", "ins", "attrs", "outs_raw", "out_tensors",
                  "seed", "vjp_fn", "n_vjp_inputs", "in_tensors_flat",
-                 "amp_raws")
+                 "amp_raws", "vjp_multi")
 
     def __init__(self, op_type, ins, attrs, outs_raw, out_tensors, seed):
         self.op_type = op_type
@@ -43,6 +47,7 @@ class GradNode:
         # AMP: the casted raw inputs the kernel actually consumed; backward
         # must replay with these so vjp dtypes match the forward trace
         self.amp_raws = None
+        self.vjp_multi = False  # vjp_fn takes/returns multi-output tuples
 
     def input_tensors(self) -> List[Tensor]:
         if self.in_tensors_flat:
@@ -142,12 +147,20 @@ def trace_op(op_type: str, ins: Dict[str, Any], attrs: Dict[str, Any],
             out_tensors[slot_name] = [t]
             results.append(t)
 
+    if _PROGRAM_RECORDER is not None:
+        _PROGRAM_RECORDER.record(op_type, ins, attrs, out_tensors)
+
     return results[0] if len(out_slots) == 1 else tuple(results)
 
 
 def trace_jax(fn, in_tensors: List[Tensor], label: str = "jax_fn"):
     """Trace an arbitrary jax function of the given tensors (used for
     indexing and other sugar that has no named op)."""
+    if _PROGRAM_RECORDER is not None:
+        raise NotImplementedError(
+            f"to_static cannot capture raw-jax operation {label!r} "
+            "(tensor indexing sugar etc.) — use named layer/tensor ops "
+            "in a traced forward")
     raws = [t._value for t in in_tensors]
     needs_grad = is_grad_enabled() and any(
         not t.stop_gradient for t in in_tensors)

@@ -1,0 +1,181 @@
+"""paddle_tpu.jit tests: to_static tracing, whole-block jit execution,
+grad bridging to the dygraph tape, save/load round-trip
+(reference: fluid/tests/unittests/dygraph_to_static/, test_jit_save_load.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+from paddle_tpu.jit import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self, din=4, dh=8):
+        super().__init__()
+        self.l1 = nn.Linear(din, dh)
+        self.l2 = nn.Linear(dh, 1)
+
+    def forward(self, x):
+        return self.l2(paddle_tpu.nn.functional.relu(self.l1(x)))
+
+
+def _x(b=3, d=4, seed=0):
+    return paddle_tpu.to_tensor(
+        np.random.RandomState(seed).rand(b, d).astype(np.float32))
+
+
+def test_to_static_function_matches_eager():
+    net = SmallNet()
+    x = _x()
+    eager = net(x).numpy()
+
+    traced = jit.to_static(lambda t: net.forward(t))
+    out = traced(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5, atol=1e-6)
+    # second call hits the signature cache (no retrace)
+    assert len(traced._cache) == 1
+    out2 = traced(_x(seed=1))
+    assert len(traced._cache) == 1
+
+
+def test_to_static_layer_decorator():
+    net = jit.to_static(SmallNet())
+    x = _x()
+    ref = SmallNet()
+    # copy params so outputs are comparable
+    for p_dst, p_src in zip(net.parameters(), ref.parameters()):
+        p_src._value = p_dst._value
+    np.testing.assert_allclose(net(x).numpy(), ref(x).numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_training_updates_params():
+    """backward() through the traced computation must put grads on the
+    eager Parameters and train to convergence (whole-block jit path)."""
+    import paddle_tpu.optimizer as opt
+    net = SmallNet()
+    net.train()
+    traced = jit.to_static(net)
+    optimizer = opt.Adam(learning_rate=0.05,
+                         parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+    x = paddle_tpu.to_tensor(xv)
+    y = paddle_tpu.to_tensor(yv)
+    first = None
+    for i in range(80):
+        pred = traced(x)
+        loss = paddle_tpu.nn.functional.mse_loss(pred, y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    last = float(loss.numpy())
+    assert last < first * 0.05, (first, last)
+
+
+def test_jit_save_load_roundtrip():
+    net = SmallNet()
+    net.eval()
+    x = _x()
+    ref = net(x).numpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model")
+        jit.save(net, path, input_spec=[InputSpec([-1, 4], "float32")])
+        loaded = jit.load(path)
+        loaded.eval()
+        out = loaded(x)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_load_finetune():
+    """Loaded TranslatedLayer parameters are trainable."""
+    import paddle_tpu.optimizer as opt
+    net = SmallNet()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model")
+        jit.save(net, path, input_spec=[InputSpec([-1, 4], "float32")])
+        loaded = jit.load(path)
+    loaded.train()
+    params = loaded.parameters()
+    assert params, "loaded layer exposes no trainable parameters"
+    optimizer = opt.Adam(learning_rate=0.05, parameters=params)
+    rng = np.random.RandomState(1)
+    xv = rng.rand(16, 4).astype(np.float32)
+    yv = (2 * xv.sum(1, keepdims=True)).astype(np.float32)
+    x = paddle_tpu.to_tensor(xv)
+    y = paddle_tpu.to_tensor(yv)
+    first = last = None
+    for i in range(60):
+        out = loaded(x)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        loss = paddle_tpu.nn.functional.mse_loss(out, y)
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first * 0.2, (first, last)
+
+
+def test_to_static_multi_output():
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    net = TwoHead()
+    x = _x()
+    ea, eb = net.a(x).numpy(), net.b(x).numpy()
+    traced = jit.to_static(net)
+    oa, ob = traced(x)
+    np.testing.assert_allclose(oa.numpy(), ea, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ob.numpy(), eb, rtol=1e-5, atol=1e-6)
+
+
+def test_hapi_model_with_to_static():
+    """hapi Model.fit drives its train step through the whole-block jit
+    path when the network is wrapped with jit.to_static (hapi/model.py
+    docstring contract)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(32, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+
+    class DS(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return xv[i], yv[i]
+
+    net = jit.to_static(SmallNet())
+    model = Model(net)
+    model.prepare(opt.Adam(learning_rate=0.05,
+                           parameters=net.parameters()),
+                  paddle_tpu.nn.MSELoss())
+    loader = DataLoader(DS(), batch_size=16, shuffle=False)
+    def _loss(h):
+        v = h["loss"]
+        return float(v[0]) if isinstance(v, (list, tuple)) else float(v)
+
+    h0 = _loss(model.evaluate(loader, verbose=0))
+    model.fit(loader, epochs=15, verbose=0)
+    h1 = _loss(model.evaluate(loader, verbose=0))
+    assert h1 < h0 * 0.2, (h0, h1)
