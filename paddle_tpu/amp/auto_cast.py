@@ -205,12 +205,20 @@ class GradScaler:
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "good_steps": self._good,
-                "bad_steps": self._bad}
+                "bad_steps": self._bad,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf}
 
     def load_state_dict(self, d):
         self._scale = d["scale"]
         self._good = d.get("good_steps", 0)
         self._bad = d.get("bad_steps", 0)
+        self._incr_ratio = d.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = d.get("decr_ratio", self._decr_ratio)
+        self._incr_every_n_steps = d.get("incr_every_n_steps",
+                                         self._incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = d.get("decr_every_n_nan_or_inf",
+                                              self._decr_every_n_nan_or_inf)
 
 
 AmpScaler = GradScaler  # fluid dygraph spelling

@@ -40,6 +40,7 @@ OP_SHUTDOWN = 6
 OP_PING = 7
 OP_SET = 8         # overwrite param (geo-SGD delta merge uses add)
 OP_PUSH_DELTA = 9  # geo: add delta to param
+OP_ERROR = 10      # server-side failure; name carries the message
 
 
 def _send_msg(sock, op: int, name: str, arr: Optional[np.ndarray],
@@ -117,11 +118,19 @@ class _Handler(socketserver.BaseRequestHandler):
                                 arr.astype(np.float32)
                     _send_msg(sock, OP_PUSH_DELTA, name, None)
                 elif op == OP_PUSH_SYNC:
-                    srv._push_sync(name, arr, extra)
-                    _send_msg(sock, OP_PUSH_SYNC, name, None)
+                    try:
+                        srv._push_sync(name, arr, extra)
+                    except TimeoutError as e:
+                        _send_msg(sock, OP_ERROR, str(e), None)
+                    else:
+                        _send_msg(sock, OP_PUSH_SYNC, name, None)
                 elif op == OP_BARRIER:
-                    srv._barrier_wait()
-                    _send_msg(sock, OP_BARRIER, "", None)
+                    try:
+                        srv._barrier_wait()
+                    except TimeoutError as e:
+                        _send_msg(sock, OP_ERROR, str(e), None)
+                    else:
+                        _send_msg(sock, OP_BARRIER, "", None)
                 elif op == OP_SHUTDOWN:
                     _send_msg(sock, OP_SHUTDOWN, "", None)
                     threading.Thread(target=self.server.shutdown,
@@ -134,9 +143,11 @@ class _Handler(socketserver.BaseRequestHandler):
 class KVServer:
     """listen_and_serv analog: blocking `serve()`, thread-safe store."""
 
-    def __init__(self, endpoint: str, num_trainers: int = 1):
+    def __init__(self, endpoint: str, num_trainers: int = 1,
+                 sync_timeout: float = 30.0):
         host, port = endpoint.rsplit(":", 1)
         self.num_trainers = max(1, num_trainers)
+        self.sync_timeout = sync_timeout
         self._store: Dict[str, np.ndarray] = {}
         self._lock = threading.RLock()
         self._pending: Dict[str, List[np.ndarray]] = {}
@@ -174,7 +185,17 @@ class KVServer:
             else:
                 my_gen = self._push_gen.get(name, 0)
                 while self._push_gen.get(name, 0) == my_gen:
-                    if not self._sync_cv.wait(timeout=30):
+                    if not self._sync_cv.wait(timeout=self.sync_timeout):
+                        # withdraw this waiter's grad so the next round's
+                        # mean does not mix in a stale gradient
+                        pend = self._pending.get(name)
+                        if pend is not None:
+                            for i, g in enumerate(pend):
+                                if g is grad:
+                                    del pend[i]
+                                    break
+                            if not pend:
+                                self._pending.pop(name, None)
                         raise TimeoutError(
                             f"sync push of {name!r}: not all "
                             f"{self.num_trainers} trainers arrived")
@@ -237,7 +258,10 @@ class KVClient:
     def _call(self, ep, op, name="", arr=None, extra=0.0):
         s = self._sock(ep)
         _send_msg(s, op, name, arr, extra)
-        return _recv_msg(s)
+        rop, rname, rarr, rextra = _recv_msg(s)
+        if rop == OP_ERROR:
+            raise TimeoutError(rname)
+        return rop, rname, rarr, rextra
 
     def wait_server_ready(self, timeout=60):
         """rpc wait_server_ready parity: ping until every server answers."""

@@ -88,6 +88,18 @@ class OpContext:
         silently spanning every axis."""
         if ring_id in self.dist_info:
             return self.dist_info[ring_id]
+        # A ring minted by new_group(ranks=[...]) is a strict
+        # sub-communicator; widening it to the dp world / full mesh would
+        # silently reduce over ranks outside the group.  Refuse instead.
+        from ..distributed.collective import _groups, _world_size
+        g = _groups.get(ring_id)
+        if g is not None and g.ranks is not None and \
+                sorted(g.ranks) != list(range(_world_size())):
+            raise NotImplementedError(
+                f"collective over sub-group ring_id={ring_id} "
+                f"(ranks={g.ranks}) has no mesh-axis binding: register one "
+                f"in OpContext.dist_info (CompiledProgram ring registry) "
+                f"rather than widening the collective to the whole mesh")
         if "default" in self.dist_info:
             return self.dist_info["default"]
         return self.mesh_axes or None

@@ -199,6 +199,7 @@ class PipelineCompiledProgram:
 
         # split the fed mini-batch into micro-batches along dim 0
         micro_feeds: List[Dict[str, Any]] = [dict() for _ in range(M)]
+        micro_batch_size = None
         for name, val in feed.items():
             arr = jnp.asarray(val)
             try:
@@ -213,6 +214,7 @@ class PipelineCompiledProgram:
                     f"batch dim {arr.shape[0]} of feed {name!r} not "
                     f"divisible by num_microbatches={M}")
             mb = arr.shape[0] // M
+            micro_batch_size = mb
             for m in range(M):
                 micro_feeds[m][name] = arr[m * mb:(m + 1) * mb]
 
@@ -257,7 +259,10 @@ class PipelineCompiledProgram:
             if n in opt_env:
                 scope.set(n, opt_env[n])
 
-        # fetches: average float metrics over micro-batches (loss semantics)
+        # fetches: per-example tensors (leading dim == micro-batch size) are
+        # concatenated back to the full mini-batch; scalar/metric floats are
+        # averaged over micro-batches (loss semantics, matching the reference
+        # section_worker's loss aggregation)
         results = []
         for n in fetch_names:
             vals = [e[n] for e in envs if n in e]
@@ -266,8 +271,12 @@ class PipelineCompiledProgram:
             if not vals:
                 raise KeyError(f"fetch {n!r} not produced by the pipeline")
             v = vals[0]
-            if len(vals) > 1 and jnp.issubdtype(v.dtype, jnp.inexact):
-                v = sum(vals[1:], vals[0]) / float(len(vals))
+            if len(vals) > 1:
+                if (v.ndim >= 1 and micro_batch_size is not None
+                        and v.shape[0] == micro_batch_size):
+                    v = jnp.concatenate(vals, axis=0)
+                elif jnp.issubdtype(v.dtype, jnp.inexact):
+                    v = sum(vals[1:], vals[0]) / float(len(vals))
             results.append(np.asarray(v) if return_numpy else v)
         return results
 

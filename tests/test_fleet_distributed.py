@@ -389,3 +389,14 @@ def test_fleet_metrics_world1():
     neg[20] = 10   # negatives score low
     assert metric.auc(pos, neg) > 0.99
     assert abs(metric.mae(np.array([4.0]), np.array([8.0])) - 0.5) < 1e-9
+
+
+def test_subgroup_collective_refuses_to_widen():
+    """A ring minted by new_group(ranks=[...]) with no mesh-axis binding
+    must refuse to run rather than silently reduce over the whole mesh."""
+    from paddle_tpu.ops.registry import OpContext
+    g = dist.new_group([0, 2])
+    ctx = OpContext(mesh_axes=("dp",), dist_info={0: "dp", "default": "dp"})
+    assert ctx.collective_axes(0) == "dp"
+    with pytest.raises(NotImplementedError):
+        ctx.collective_axes(g.id)

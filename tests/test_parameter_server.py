@@ -185,3 +185,38 @@ def test_fleet_ps_mode(monkeypatch):
         assert float(lv) < l0 * 0.6
     finally:
         srv.stop()
+
+
+def test_sync_push_timeout_withdraws_pending_and_reports():
+    """A sync-push waiter that times out must (a) surface a TimeoutError to
+    the client instead of a dropped connection and (b) withdraw its gradient
+    so the next complete round's mean is not polluted by the stale grad."""
+    from paddle_tpu.distributed.ps.kv_server import KVServer, KVClient
+    srv = KVServer("127.0.0.1:0", num_trainers=2, sync_timeout=0.4)
+    srv.serve_in_thread()
+    try:
+        c = KVClient([srv.endpoint])
+        c.wait_server_ready()
+        c.init_param("w", np.zeros(2, dtype=np.float32))
+        # only 1 of 2 trainers pushes -> timeout, surfaced as TimeoutError
+        with pytest.raises(TimeoutError):
+            c.push_grad("w", np.full(2, 100.0, np.float32), lr=1.0,
+                        sync=True)
+        # stale grad must be withdrawn: a fresh complete round of two
+        # pushes averages only the fresh grads
+        done = []
+
+        def other():
+            c2 = KVClient([srv.endpoint])
+            c2.push_grad("w", np.ones(2, np.float32), lr=1.0, sync=True)
+            done.append(1)
+
+        t = threading.Thread(target=other, daemon=True)
+        t.start()
+        c.push_grad("w", np.ones(2, np.float32), lr=1.0, sync=True)
+        t.join(5)
+        assert done
+        # w = 0 - 1.0 * mean([1, 1]) = -1 (not polluted by the 100s)
+        np.testing.assert_allclose(c.pull("w"), -np.ones(2), atol=1e-6)
+    finally:
+        srv.stop()

@@ -136,3 +136,32 @@ def test_fleet_pipeline_strategy():
                             fetch_list=[loss])
             l0 = l0 if l0 is not None else float(lv)
         assert float(lv) < l0
+
+
+def test_pipeline_per_example_fetch_concatenates():
+    """Per-example fetches (leading dim == micro-batch size) come back
+    concatenated to the full mini-batch, not averaged (section_worker
+    fetch semantics)."""
+    xb = np.random.RandomState(1).rand(8, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    main, startup, loss = _pipeline_model()
+    with static.program_guard(main, startup):
+        from paddle_tpu.pipeline import PipelineOptimizer
+        PipelineOptimizer(static.SGD(learning_rate=0.01),
+                          num_microbatches=4).minimize(loss)
+    pp = main._pipeline_compiled
+    # locate the prediction var (elementwise_sub X input, per-example [B, 1])
+    pred_var = None
+    for op in main.global_block().ops:
+        if op.type == "elementwise_sub":
+            pred_var = op.inputs["X"][0]
+            break
+    assert pred_var is not None
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        pred_out, loss_out = exe.run(pp, feed={"x": xb, "y": yb},
+                                     fetch_list=[pred_var, loss])
+    assert pred_out.shape == (8, 1), pred_out.shape
+    assert np.asarray(loss_out).ndim == 0 or np.asarray(loss_out).size == 1
