@@ -319,6 +319,11 @@ class Program:
         # populated by append_backward: maps var -> grad var name
         self._grad_map: Dict[str, str] = {}
         self._fingerprint_cache = None
+        # explicit two-program contract (reference keeps startup/main as
+        # distinct Program objects; executor.py:474): "startup" programs run
+        # eagerly once, "main" programs take the whole-block jit path.  None
+        # = unknown; the executor falls back to an op-type heuristic.
+        self._role: Optional[str] = None
 
     def _next_uid(self) -> int:
         self._uid += 1
@@ -454,7 +459,9 @@ _TEST_MODE_OPS = {
 class _ProgramState(threading.local):
     def __init__(self):
         self.main = Program()
+        self.main._role = "main"
         self.startup = Program()
+        self.startup._role = "startup"
 
 
 _state = _ProgramState()
@@ -469,11 +476,15 @@ def default_startup_program() -> Program:
 
 
 def switch_main_program(p: Program) -> Program:
+    if p._role is None:
+        p._role = "main"
     prev, _state.main = _state.main, p
     return prev
 
 
 def switch_startup_program(p: Program) -> Program:
+    if p._role is None:
+        p._role = "startup"
     prev, _state.startup = _state.startup, p
     return prev
 

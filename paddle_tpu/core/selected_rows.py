@@ -1,0 +1,60 @@
+"""SelectedRows: sparse row-slice gradients.
+
+TPU-native analog of the reference's SELECTED_ROWS variable type
+(/root/reference/paddle/fluid/framework/selected_rows.h:41 — a {rows,
+value, height} triple used for embedding gradients so the optimizer only
+touches the looked-up rows).
+
+Design notes (deliberately different from the reference):
+  * SelectedRows is a registered JAX pytree, so it flows through the
+    whole-block jit, vjp, and donation machinery like any tensor — no
+    separate variable-type dispatch in the executor.
+  * Duplicate rows are allowed and NOT eagerly merged: XLA's scatter-add
+    (`param.at[rows].add(values)`) combines duplicates in one fused
+    kernel, which is cheaper on TPU than the reference's
+    MergeAdd/merge_selected_rows CPU pass (math/selected_rows_functor.cc).
+  * Optimizers consume it directly (sgd/momentum scatter into the param;
+    adam uses a touched-row mask for lazy_mode semantics) — see
+    ops/kernels/optimizers.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    """rows: int32 [n] indices into a height-`height` table; values:
+    [n, ...] per-row updates. Scatter-add semantics over duplicates."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        return cls(children[0], children[1], height)
+
+    # -- conversions --------------------------------------------------------
+    def to_dense(self):
+        """Densify via scatter-add (merges duplicate rows)."""
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def row_mask(self):
+        """Boolean [height] mask of touched rows."""
+        m = jnp.zeros((self.height,), jnp.bool_)
+        return m.at[self.rows].set(True)
+
+    def __repr__(self):
+        return (f"SelectedRows(n={self.values.shape[0]}, "
+                f"height={self.height}, width={self.values.shape[1:]})")

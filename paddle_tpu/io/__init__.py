@@ -9,6 +9,10 @@ from .sampler import (  # noqa: F401
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .generator_loader import GeneratorLoader  # noqa: F401
+from .bucketing import (  # noqa: F401
+    pad_sequences, mask_from_lengths, bucket_for_length,
+    BucketByLengthSampler,
+)
 from .framework_io import (  # noqa: F401
     save, load, save_vars, save_params, save_persistables, load_vars,
     load_params, load_persistables, save_inference_model,

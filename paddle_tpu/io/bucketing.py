@@ -1,0 +1,111 @@
+"""Padding / length-bucketing utilities — the TPU replacement for LoD.
+
+The reference carries variable-length sequences as LoDTensors (ragged
+rows + offset table, /root/reference/paddle/fluid/framework/lod_tensor.h:114)
+and every sequence op walks the offsets.  XLA wants static shapes, so this
+module provides the documented front-end instead (SURVEY.md §7 "hard
+parts"): pad to a bucket boundary, keep an explicit lengths vector, and
+batch sequences of similar length together so each bucket compiles once
+and wastes little padding.
+
+Typical use:
+
+    sampler = BucketByLengthSampler(lengths, boundaries=[64, 128, 256],
+                                    batch_size=32, shuffle=True, seed=0)
+    for idxs in sampler:
+        batch, lens = pad_sequences([data[i] for i in idxs],
+                                    multiple_of=128)
+        mask = mask_from_lengths(lens, batch.shape[1])
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["pad_sequences", "mask_from_lengths", "bucket_for_length",
+           "BucketByLengthSampler"]
+
+
+def pad_sequences(seqs: Sequence, pad_value=0, multiple_of: int = 1,
+                  max_len: Optional[int] = None, dtype=None):
+    """Pad a list of 1-D (or [T, ...]) sequences into one [B, L, ...] array.
+
+    L = max length rounded up to `multiple_of` (use 128 to align the
+    sequence axis with TPU lanes), or `max_len` (longer sequences are
+    truncated).  Returns (padded, lengths:int32[B])."""
+    arrs = [np.asarray(s) for s in seqs]
+    lens = np.asarray([a.shape[0] for a in arrs], np.int32)
+    tgt = int(max_len) if max_len is not None else int(lens.max(initial=1))
+    if multiple_of > 1:
+        tgt = -(-tgt // multiple_of) * multiple_of
+    trail = arrs[0].shape[1:] if arrs else ()
+    dt = dtype or (arrs[0].dtype if arrs else np.float32)
+    out = np.full((len(arrs), tgt) + trail, pad_value, dtype=dt)
+    for i, a in enumerate(arrs):
+        n = min(a.shape[0], tgt)
+        out[i, :n] = a[:n]
+    return out, np.minimum(lens, tgt)
+
+
+def mask_from_lengths(lengths, max_len: int):
+    """[B, max_len] float32 mask: 1 inside each sequence, 0 in padding."""
+    lengths = np.asarray(lengths)
+    return (np.arange(max_len)[None, :] < lengths[:, None]) \
+        .astype(np.float32)
+
+
+def bucket_for_length(length: int, boundaries: Sequence[int]) -> int:
+    """Index of the first bucket whose boundary >= length (len(boundaries)
+    = overflow bucket)."""
+    for i, b in enumerate(boundaries):
+        if length <= b:
+            return i
+    return len(boundaries)
+
+
+class BucketByLengthSampler:
+    """Batch sampler yielding index lists whose sequences share a length
+    bucket.  One static padded shape per bucket: the jit executor compiles
+    len(boundaries)+1 programs total instead of one per distinct length —
+    the TPU answer to the reference's LoD-driven dynamic batching."""
+
+    def __init__(self, lengths: Sequence[int], boundaries: Sequence[int],
+                 batch_size: int = 32, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False):
+        self.lengths = [int(x) for x in lengths]
+        self.boundaries = list(boundaries)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+
+    def __iter__(self):
+        buckets: List[List[int]] = [[] for _ in
+                                    range(len(self.boundaries) + 1)]
+        order = np.arange(len(self.lengths))
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self._epoch)
+            rng.shuffle(order)
+            self._epoch += 1
+        batches = []
+        for i in order:
+            b = bucket_for_length(self.lengths[i], self.boundaries)
+            buckets[b].append(int(i))
+            if len(buckets[b]) == self.batch_size:
+                batches.append(buckets[b])
+                buckets[b] = []
+        if not self.drop_last:
+            batches.extend(b for b in buckets if b)
+        if self.shuffle:
+            rng.shuffle(batches)
+        return iter(batches)
+
+    def __len__(self):
+        counts = [0] * (len(self.boundaries) + 1)
+        for ln in self.lengths:
+            counts[bucket_for_length(ln, self.boundaries)] += 1
+        if self.drop_last:
+            return sum(c // self.batch_size for c in counts)
+        return sum(-(-c // self.batch_size) for c in counts)

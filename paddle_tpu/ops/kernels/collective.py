@@ -30,10 +30,23 @@ def _c_allreduce(name, op):
     @register_op(name, inputs=["X"], outputs=["Out"], grad="auto",
                  side_effect=True)
     def kernel(ins, attrs, ctx, _op=op):
+        from ...core.selected_rows import SelectedRows
         x = ins["X"]
         axes = _axes(ctx, attrs)
         if not axes:
             return {"Out": x}
+        if isinstance(x, SelectedRows):
+            # sparse allreduce (reference allgathers SelectedRows grads):
+            # psum would sum the int32 row INDICES across replicas —
+            # all_gather rows+values instead; concatenation is the sum
+            # under scatter-add semantics
+            if _op != "sum":
+                raise NotImplementedError(
+                    f"{_op} allreduce over SelectedRows")
+            ax = axes if isinstance(axes, str) else axes[0]
+            rows = jax.lax.all_gather(x.rows, ax, tiled=True)
+            vals = jax.lax.all_gather(x.values, ax, tiled=True)
+            return {"Out": SelectedRows(rows, vals, x.height)}
         if _op == "sum":
             return {"Out": jax.lax.psum(x, axes)}
         if _op == "max":
@@ -273,6 +286,10 @@ def scale_by_world_size(ins, attrs, ctx):
     axes = _axes(ctx, attrs)
     if not axes:
         return {"Out": ins["X"]}
+    from ...core.selected_rows import SelectedRows
     n = jax.lax.psum(1, axes)
     x = ins["X"]
+    if isinstance(x, SelectedRows):
+        return {"Out": SelectedRows(
+            x.rows, x.values / jnp.asarray(n, x.values.dtype), x.height)}
     return {"Out": (x / jnp.asarray(n, x.dtype))}

@@ -350,7 +350,15 @@ def pad_constant_like(ins, attrs, ctx):
 
 @register_op("cast", inputs=["X"], outputs=["Out"])
 def cast(ins, attrs, ctx):
-    return {"Out": ins["X"].astype(np_dtype(attrs["out_dtype"]))}
+    from ...core.selected_rows import SelectedRows
+    x = ins["X"]
+    if isinstance(x, SelectedRows):
+        # fp16_allreduce meta-optimizer casts gradients; cast the row
+        # values, keep the int32 row indices
+        return {"Out": SelectedRows(
+            x.rows, x.values.astype(np_dtype(attrs["out_dtype"])),
+            x.height)}
+    return {"Out": x.astype(np_dtype(attrs["out_dtype"]))}
 
 
 @register_op("assign", inputs=["X"], outputs=["Out"])

@@ -89,7 +89,15 @@ def kron(ins, attrs, ctx):
 
 @register_op("scale", inputs=["X"], outputs=["Out"])
 def scale(ins, attrs, ctx):
+    from ...core.selected_rows import SelectedRows
     x = ins["X"]
+    if isinstance(x, SelectedRows):
+        # scale a sparse gradient in place (bias would densify; the only
+        # framework use on grads is pure scaling)
+        if attrs.get("bias", 0.0) != 0.0:
+            raise ValueError("scale(bias!=0) on SelectedRows would densify")
+        s = jnp.asarray(attrs.get("scale", 1.0), x.values.dtype)
+        return {"Out": SelectedRows(x.rows, x.values * s, x.height)}
     s = jnp.asarray(attrs.get("scale", 1.0), x.dtype)
     b = jnp.asarray(attrs.get("bias", 0.0), x.dtype)
     if attrs.get("bias_after_scale", True):
@@ -99,7 +107,18 @@ def scale(ins, attrs, ctx):
 
 @register_op("sum", inputs=["X*"], outputs=["Out"])
 def sum_op(ins, attrs, ctx):
+    from ...core.selected_rows import SelectedRows
     xs = ins["X"]
+    if any(isinstance(x, SelectedRows) for x in xs):
+        if all(isinstance(x, SelectedRows) for x in xs):
+            # gradient aggregation of two sparse lookups on the same table
+            # (selected_rows_functor.cc MergeAdd): concatenation IS the sum
+            # under scatter-add semantics
+            return {"Out": SelectedRows(
+                jnp.concatenate([x.rows for x in xs]),
+                jnp.concatenate([x.values for x in xs]),
+                xs[0].height)}
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

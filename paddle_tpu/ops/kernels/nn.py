@@ -453,7 +453,34 @@ def dropout(ins, attrs, ctx):
     return {"Out": out, "Mask": keep.astype(jnp.uint8)}
 
 
-@register_op("lookup_table", inputs=["W", "Ids!"], outputs=["Out"])
+def _lookup_table_grad(squeeze_trailing):
+    """Explicit embedding gradient (lookup_table_grad op,
+    lookup_table_op.cc / SelectedRows path selected_rows_functor.cc).
+    is_sparse=True emits a SelectedRows {rows, values} pair — the dense
+    [vocab, width] gradient is never materialized; the optimizer
+    scatter-adds it straight into the parameter."""
+
+    def grad_kernel(ins, attrs, ctx):
+        from ...core.selected_rows import SelectedRows
+        w, ids, og = ins["W"], ins["Ids"], ins["Out@GRAD"]
+        if squeeze_trailing and ids.shape[-1] == 1:
+            ids = jnp.squeeze(ids, -1)
+        rows = ids.reshape(-1).astype(jnp.int32)
+        vals = og.reshape((-1,) + tuple(w.shape[1:])).astype(w.dtype)
+        padding_idx = attrs.get("padding_idx", -1)
+        if padding_idx is not None and padding_idx != -1:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            vals = jnp.where((rows != pid)[:, None], vals,
+                             jnp.zeros_like(vals))
+        if attrs.get("is_sparse", False):
+            return {"W@GRAD": SelectedRows(rows, vals, w.shape[0])}
+        return {"W@GRAD": jnp.zeros_like(w).at[rows].add(vals)}
+
+    return grad_kernel
+
+
+@register_op("lookup_table", inputs=["W", "Ids!"], outputs=["Out"],
+             grad=_lookup_table_grad(squeeze_trailing=True))
 def lookup_table(ins, attrs, ctx):
     w, ids = ins["W"], ins["Ids"]
     ids = jnp.squeeze(ids, -1) if ids.shape[-1] == 1 else ids
@@ -461,7 +488,8 @@ def lookup_table(ins, attrs, ctx):
     return {"Out": out}
 
 
-@register_op("lookup_table_v2", inputs=["W", "Ids!"], outputs=["Out"])
+@register_op("lookup_table_v2", inputs=["W", "Ids!"], outputs=["Out"],
+             grad=_lookup_table_grad(squeeze_trailing=False))
 def lookup_table_v2(ins, attrs, ctx):
     return {"Out": _embedding(ins["W"], ins["Ids"], attrs)}
 
@@ -477,7 +505,8 @@ def _embedding(w, ids, attrs):
     return out
 
 
-@register_op("embedding", inputs=["W", "Ids!"], outputs=["Out"])
+@register_op("embedding", inputs=["W", "Ids!"], outputs=["Out"],
+             grad=_lookup_table_grad(squeeze_trailing=False))
 def embedding(ins, attrs, ctx):
     return {"Out": _embedding(ins["W"], ins["Ids"], attrs)}
 

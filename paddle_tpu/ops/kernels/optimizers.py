@@ -18,10 +18,22 @@ def _lr(ins):
     return ins["LearningRate"].reshape(()).astype(jnp.float32)
 
 
+def _is_selected_rows(g):
+    from ...core.selected_rows import SelectedRows
+    return isinstance(g, SelectedRows)
+
+
 @register_op("sgd", inputs=["Param", "LearningRate!", "Grad"],
              outputs=["ParamOut"], grad=None, side_effect=True)
 def sgd(ins, attrs, ctx):
     p, g = ins["Param"], ins["Grad"]
+    if _is_selected_rows(g):
+        # SelectedRows path (sgd_op.h SparseSGDFunctor): scatter-add the
+        # row updates into the donated param — the [height, width] dense
+        # gradient never exists
+        upd = (-_lr(ins)) * g.values.astype(jnp.float32)
+        return {"ParamOut":
+                p.astype(jnp.float32).at[g.rows].add(upd).astype(p.dtype)}
     return {"ParamOut": (p.astype(jnp.float32) -
                          _lr(ins) * g.astype(jnp.float32)).astype(p.dtype)}
 
@@ -34,6 +46,10 @@ def momentum(ins, attrs, ctx):
     mu = attrs.get("mu", 0.9)
     lr = _lr(ins)
     use_nesterov = attrs.get("use_nesterov", False)
+    if _is_selected_rows(g):
+        # momentum_op.h SparseMomentumFunctor semantics: velocity decays
+        # everywhere, gradient lands only on touched rows
+        g = g.to_dense()
     pf, gf, vf = (x.astype(jnp.float32) for x in (p, g, v))
     v_out = mu * vf + gf
     if use_nesterov:
@@ -83,12 +99,26 @@ def adam(ins, attrs, ctx):
     lr = _lr(ins)
     master = ins.get("MasterParam")
     pf = (master if master is not None else p).astype(jnp.float32)
+    row_mask = None
+    if _is_selected_rows(g):
+        # adam_op.h SparseAdamFunctor: lazy_mode touches only looked-up
+        # rows (moments + param); non-lazy treats missing rows as zero
+        # gradient (moments still decay).  Duplicate rows are merged by
+        # the scatter-add in to_dense().
+        if attrs.get("lazy_mode", False):
+            row_mask = g.row_mask()[(...,) + (None,) * (g.values.ndim - 1)]
+        g = g.to_dense()
     gf = g.astype(jnp.float32)
     m1f, m2f = m1.astype(jnp.float32), m2.astype(jnp.float32)
     m1_out = beta1 * m1f + (1 - beta1) * gf
     m2_out = beta2 * m2f + (1 - beta2) * jnp.square(gf)
+    if row_mask is not None:
+        m1_out = jnp.where(row_mask, m1_out, m1f)
+        m2_out = jnp.where(row_mask, m2_out, m2f)
     lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_out = pf - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    if row_mask is not None:
+        p_out = jnp.where(row_mask, p_out, pf)
     outs = {"ParamOut": p_out.astype(p.dtype),
             "Moment1Out": m1_out.astype(m1.dtype),
             "Moment2Out": m2_out.astype(m2.dtype),

@@ -272,7 +272,10 @@ class PipelineCompiledProgram:
                 raise KeyError(f"fetch {n!r} not produced by the pipeline")
             v = vals[0]
             if len(vals) > 1:
+                # mb==1 is ambiguous with [1]-shaped scalar metrics (mean
+                # emits [1]); treat it as the metric case and average
                 if (v.ndim >= 1 and micro_batch_size is not None
+                        and micro_batch_size > 1
                         and v.shape[0] == micro_batch_size):
                     v = jnp.concatenate(vals, axis=0)
                 elif jnp.issubdtype(v.dtype, jnp.inexact):

@@ -113,11 +113,13 @@ class BlockTracer:
         self.skip_types = set(skip_types)
 
     def run(self, env: Dict[str, Any], ctx: OpContext,
-            ops=None) -> Dict[str, Any]:
+            ops=None, on_op=None) -> Dict[str, Any]:
         for op in (ops if ops is not None else self.block.ops):
             if op.type in self.skip_types:
                 continue
             self.run_op(op, env, ctx)
+            if on_op is not None:
+                on_op(op, env)
         return env
 
     def run_op(self, op, env: Dict[str, Any], ctx: OpContext):
@@ -194,7 +196,7 @@ class Executor:
         fetch_names = [f.name if hasattr(f, "name") else str(f)
                        for f in (fetch_list or [])]
 
-        if self._is_startup_like(program):
+        if self._program_is_startup(program):
             self._run_eager(program, scope, feed, fetch_names)
             return [] if not fetch_names else [
                 as_numpy(scope.get(n)) if return_numpy else scope.get(n)
@@ -242,14 +244,32 @@ class Executor:
                 "FLAGS_check_nan_inf: non-finite values in "
                 + ", ".join(bad))
 
+    @staticmethod
+    def _per_op_nan_scan(op, env):
+        """Eager-mode per-op output scan under FLAGS_check_nan_inf — names
+        the op that produced the first non-finite value (reference
+        details/nan_inf_utils_detail.cc CheckOpHasNanOrInf)."""
+        for n in op.output_names():
+            v = env.get(n)
+            if v is None or not hasattr(v, "dtype"):
+                continue
+            if jnp.issubdtype(v.dtype, jnp.floating) and \
+                    not bool(jnp.isfinite(v).all()):
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: op {op.type!r} produced "
+                    f"non-finite values in output {n!r}")
+
     def close(self):
         self._cache.clear()
 
     # -- eager interpreter (startup / debug) --------------------------------
-    def _is_startup_like(self, program: Program) -> bool:
-        """Heuristic: programs containing only init ops (no feed/data deps)
-        run eagerly once — matches the reference running startup through the
-        plain executor."""
+    def _program_is_startup(self, program: Program) -> bool:
+        """Explicit two-program contract: program_guard / the default-program
+        registry stamp `_role` ("startup" runs eagerly once, "main" takes the
+        jit+donate path).  Unmarked programs (hand-built, deserialized) fall
+        back to the init-op heuristic."""
+        if program._role is not None:
+            return program._role == "startup"
         b = program.global_block()
         init_types = {"fill_constant", "uniform_random", "gaussian_random",
                       "truncated_gaussian_random", "assign_value", "eye",
@@ -257,12 +277,14 @@ class Executor:
         return len(b.ops) > 0 and all(op.type in init_types for op in b.ops)
 
     def _run_eager(self, program: Program, scope: Scope, feed, fetch_names):
+        from ..core.flags import flag
         block = program.global_block()
         env = {k: v for k, v in scope.vars.items() if v is not None}
         for name, val in feed.items():
             env[name] = self._coerce_feed(block, name, val)
         ctx = OpContext(seed=self._seed_for_step(program))
-        BlockTracer(block).run(env, ctx)
+        on_op = self._per_op_nan_scan if flag("check_nan_inf", False) else None
+        BlockTracer(block).run(env, ctx, on_op=on_op)
         self._step += 1
         # write back persistables + fetches
         for n in _persistable_names(program):
@@ -280,8 +302,11 @@ class Executor:
                      for n, v in feed.items()}
         state_names = [n for n in _persistable_names(program)
                        if scope.get(n) is not None]
+        # signature from metadata only — np.asarray here would force a
+        # blocking device->host copy of every feed on every step
         feed_sig = tuple(sorted(
-            (n, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            (n, tuple(getattr(v, "shape", np.shape(v))),
+             str(getattr(v, "dtype", None) or np.asarray(v).dtype))
             for n, v in feed_vals.items()))
         key = (program.fingerprint(), feed_sig, tuple(fetch_names),
                tuple(state_names))

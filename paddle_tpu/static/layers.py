@@ -124,9 +124,11 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32", name=None):
-    """fluid.layers.embedding / fluid.embedding (nn.py:393). is_sparse is
-    accepted for API parity; on TPU gradients are dense segment-sums (XLA
-    scatter-add), SURVEY.md §7 'SelectedRows fallback'."""
+    """fluid.layers.embedding / fluid.embedding (nn.py:393). is_sparse=True
+    routes the gradient through the SelectedRows path
+    (core/selected_rows.py): the backward emits {rows, values} and the
+    optimizer scatter-adds into the table — the dense [vocab, width]
+    gradient never materializes (reference selected_rows.h:41)."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, list(size), dtype,
                                 default_initializer=Xavier())
@@ -134,7 +136,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper.append_op(
         "lookup_table_v2", inputs={"W": w, "Ids": input},
         outputs={"Out": out},
-        attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+        attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
+               "is_sparse": bool(is_sparse)})
     return out
 
 

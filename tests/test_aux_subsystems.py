@@ -180,3 +180,48 @@ def test_auto_checkpoint_resume(tmp_path, monkeypatch):
     # resumed weights came from the checkpoint (epoch-0 trained state),
     # not the fresh same-seed init the startup program produced
     assert not np.allclose(w_resumed, w_fresh)
+
+
+def test_per_op_nan_scan_names_offending_op():
+    """Eager mode + FLAGS_check_nan_inf: the error must name the op that
+    produced the NaN (reference nan_inf_utils_detail per-op scan)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        y = layers.log(x)          # log(-1) = nan  <- offending op
+        z = layers.scale(y, 2.0)   # downstream op must not be blamed
+    exe = static.Executor()
+    scope = static.Scope()
+    paddle_tpu.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_eager_run": True})
+    try:
+        with static.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(RuntimeError, match="op 'log'"):
+                exe.run(main, feed={"x": -np.ones((2, 2), np.float32)},
+                        fetch_list=[z])
+    finally:
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": False,
+                              "FLAGS_eager_run": False})
+
+
+def test_explicit_program_roles():
+    """program_guard stamps the two-program contract: a startup program
+    containing non-init ops still runs eagerly; a main program containing
+    only init ops still takes the jit path."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        layers.scale(x, 2.0)
+    assert main._role == "main" and startup._role == "startup"
+    exe = static.Executor()
+    # a startup program with a non-init op (scale after init) is still
+    # treated as startup
+    with static.program_guard(static.Program(), static.Program()):
+        pass
+    sp = static.Program()
+    sp._role = "startup"
+    assert exe._program_is_startup(sp)
+    mp = static.Program()
+    mp._role = "main"
+    assert not exe._program_is_startup(mp)

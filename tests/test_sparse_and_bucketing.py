@@ -1,0 +1,219 @@
+"""SelectedRows sparse embedding gradients + LoD-replacing bucketing
+utilities (reference: framework/selected_rows.h:41, sgd_op.h SparseSGD,
+adam_op.h SparseAdamFunctor lazy_mode; lod_tensor.h replaced by
+io/bucketing.py per SURVEY.md §7)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _embedding_program(is_sparse, opt_fn, vocab=50, dim=8):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, 4], dtype="int64")
+        y = layers.data("y", [-1, 1])
+        emb = layers.embedding(ids, size=[vocab, dim], is_sparse=is_sparse)
+        pooled = layers.reduce_mean(emb, dim=1)
+        pred = layers.fc(pooled, size=1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        opt_fn().minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, steps=5):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (8, 4)).astype(np.int64)
+    yb = rng.rand(8, 1).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            (lv,) = exe.run(main, feed={"ids": ids, "y": yb},
+                            fetch_list=[loss])
+        emb_name = [p.name for p in main.all_parameters()
+                    if "embedding" in p.name or p.shape == (50, 8)][0]
+        w = np.asarray(scope.get(emb_name))
+    return float(lv), w, ids
+
+
+def test_sparse_sgd_matches_dense():
+    """is_sparse=True must be numerically identical to the dense path —
+    only the gradient representation changes."""
+    l_d, w_d, _ = _train(*_embedding_program(
+        False, lambda: static.SGD(learning_rate=0.1)))
+    l_s, w_s, ids = _train(*_embedding_program(
+        True, lambda: static.SGD(learning_rate=0.1)))
+    np.testing.assert_allclose(l_d, l_s, rtol=1e-5)
+    np.testing.assert_allclose(w_d, w_s, rtol=1e-5, atol=1e-6)
+    # rows never looked up must be untouched vs init
+    main, startup, loss = _embedding_program(
+        True, lambda: static.SGD(learning_rate=0.1))
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        emb_name = [p.name for p in main.all_parameters()
+                    if p.shape == (50, 8)][0]
+        w0 = np.asarray(scope.get(emb_name)).copy()
+        rng = np.random.RandomState(0)
+        feed_ids = rng.randint(0, 50, (8, 4)).astype(np.int64)
+        yb = rng.rand(8, 1).astype(np.float32)
+        exe.run(main, feed={"ids": feed_ids, "y": yb}, fetch_list=[loss])
+        w1 = np.asarray(scope.get(emb_name))
+    untouched = np.setdiff1d(np.arange(50), feed_ids.ravel())
+    assert untouched.size > 0
+    np.testing.assert_array_equal(w0[untouched], w1[untouched])
+
+
+def test_sparse_adam_and_momentum_run():
+    for opt in (lambda: static.Adam(learning_rate=0.05),
+                lambda: static.Momentum(learning_rate=0.05, momentum=0.9)):
+        l_d, w_d, _ = _train(*_embedding_program(False, opt))
+        l_s, w_s, _ = _train(*_embedding_program(True, opt))
+        np.testing.assert_allclose(w_d, w_s, rtol=1e-4, atol=1e-6)
+
+
+def test_selected_rows_merge_and_mask():
+    import jax.numpy as jnp
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows(jnp.asarray([1, 3, 1], jnp.int32),
+                      jnp.asarray([[1.0, 1], [2, 2], [3, 3]]), height=5)
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[1], [4.0, 4.0])  # duplicates merged
+    np.testing.assert_allclose(dense[3], [2.0, 2.0])
+    np.testing.assert_allclose(dense[0], 0.0)
+    mask = np.asarray(sr.row_mask())
+    assert mask.tolist() == [False, True, False, True, False]
+
+
+def test_adam_lazy_mode_touches_only_rows():
+    """lazy_mode: untouched rows keep param AND moments frozen (reference
+    SparseAdamFunctor lazy path)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import run_kernel, OpContext
+    from paddle_tpu.core.selected_rows import SelectedRows
+    p = jnp.ones((6, 3))
+    g = SelectedRows(jnp.asarray([0, 2], jnp.int32),
+                     jnp.full((2, 3), 0.5), height=6)
+    ins = {"Param": p, "Grad": g, "LearningRate": jnp.asarray([0.1]),
+           "Moment1": jnp.full((6, 3), 0.2),
+           "Moment2": jnp.full((6, 3), 0.3),
+           "Beta1Pow": jnp.asarray([0.9]), "Beta2Pow": jnp.asarray([0.999])}
+    out = run_kernel("adam", ins, {"lazy_mode": True}, OpContext())
+    p2, m1 = np.asarray(out["ParamOut"]), np.asarray(out["Moment1Out"])
+    assert (p2[[0, 2]] != 1.0).all()
+    np.testing.assert_array_equal(p2[[1, 3, 4, 5]], 1.0)
+    np.testing.assert_allclose(m1[[1, 3, 4, 5]], 0.2)
+    out2 = run_kernel("adam", ins, {"lazy_mode": False}, OpContext())
+    m1_nl = np.asarray(out2["Moment1Out"])
+    np.testing.assert_allclose(m1_nl[1], 0.9 * 0.2)  # decays everywhere
+
+
+def test_sum_of_selected_rows():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import run_kernel, OpContext
+    from paddle_tpu.core.selected_rows import SelectedRows
+    a = SelectedRows(jnp.asarray([0], jnp.int32), jnp.ones((1, 2)), 4)
+    b = SelectedRows(jnp.asarray([0, 2], jnp.int32), jnp.ones((2, 2)), 4)
+    out = run_kernel("sum", {"X": [a, b]}, {}, OpContext())["Out"]
+    dense = np.asarray(out.to_dense())
+    np.testing.assert_allclose(dense[0], 2.0)
+    np.testing.assert_allclose(dense[2], 1.0)
+    # mixed sparse+dense falls back to dense
+    d = jnp.ones((4, 2))
+    out2 = run_kernel("sum", {"X": [a, d]}, {}, OpContext())["Out"]
+    np.testing.assert_allclose(np.asarray(out2)[0], 2.0)
+
+
+# ---------------------------------------------------------------------------
+# bucketing / padding (LoD replacement)
+# ---------------------------------------------------------------------------
+def test_pad_sequences_and_mask():
+    from paddle_tpu.io import pad_sequences, mask_from_lengths
+    seqs = [np.arange(3), np.arange(7), np.arange(1)]
+    padded, lens = pad_sequences(seqs, pad_value=-1, multiple_of=4)
+    assert padded.shape == (3, 8)          # 7 rounded up to 8
+    assert lens.tolist() == [3, 7, 1]
+    assert padded[0, 3] == -1 and padded[1, 6] == 6
+    mask = mask_from_lengths(lens, 8)
+    assert mask.shape == (3, 8)
+    assert mask[0].sum() == 3 and mask[2].sum() == 1
+    # truncation via max_len
+    p2, l2 = pad_sequences(seqs, max_len=4)
+    assert p2.shape == (3, 4) and l2.tolist() == [3, 4, 1]
+
+
+def test_bucket_sampler_groups_by_length():
+    from paddle_tpu.io import BucketByLengthSampler, bucket_for_length
+    lengths = [5, 60, 7, 120, 200, 6, 61, 130, 8, 9]
+    bs = BucketByLengthSampler(lengths, boundaries=[16, 64, 128],
+                               batch_size=2, shuffle=True, seed=3)
+    batches = list(bs)
+    assert sum(len(b) for b in batches) == len(lengths)
+    for b in batches:
+        buckets = {bucket_for_length(lengths[i], [16, 64, 128]) for i in b}
+        assert len(buckets) == 1, f"mixed-bucket batch {b}"
+    assert len(bs) >= len(batches)
+    # epochs reshuffle
+    assert list(bs) != batches or len(batches) <= 1
+
+
+def test_sparse_grad_data_parallel_matches_single():
+    """SelectedRows grads under the dp mesh: the inserted c_allreduce_sum
+    must all_gather rows+values (NOT psum the row indices) so the dp run
+    matches the single-device trajectory."""
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+
+    def build():
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ids = layers.data("ids", [-1, 4], dtype="int64")
+            y = layers.data("y", [-1, 1])
+            emb = layers.embedding(ids, size=[50, 8], is_sparse=True,
+                                   param_attr=static.ParamAttr(
+                                       initializer=static.Constant(0.05)))
+            pred = layers.fc(layers.reduce_mean(emb, dim=1), size=1,
+                             param_attr=static.ParamAttr(
+                                 initializer=static.Constant(0.1)))
+            loss = layers.mean(
+                layers.square(layers.elementwise_sub(pred, y)))
+            static.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    batches = [(rng.randint(0, 50, (16, 4)).astype(np.int64),
+                rng.rand(16, 1).astype(np.float32)) for _ in range(3)]
+
+    main, startup, loss = build()
+    exe = static.Executor()
+    s1 = static.Scope()
+    with static.scope_guard(s1):
+        exe.run(startup)
+        single = [float(exe.run(main, feed={"ids": ib, "y": yb},
+                                fetch_list=[loss])[0])
+                  for ib, yb in batches]
+
+    main2, startup2, loss2 = build()
+    exe2 = static.Executor()
+    s2 = static.Scope()
+    with static.scope_guard(s2):
+        exe2.run(startup2)
+        cp = CompiledProgram(main2).with_data_parallel(loss_name=loss2.name)
+        par = [float(exe2.run(cp, feed={"ids": ib, "y": yb},
+                              fetch_list=[loss2])[0])
+               for ib, yb in batches]
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_bucket_sampler_len_exact_drop_last():
+    from paddle_tpu.io import BucketByLengthSampler
+    lengths = [5] * 6 + [100] * 6
+    bs = BucketByLengthSampler(lengths, boundaries=[64], batch_size=4,
+                               drop_last=True)
+    assert len(list(bs)) == len(bs) == 2
+    bs2 = BucketByLengthSampler(lengths, boundaries=[64], batch_size=4,
+                                drop_last=False)
+    assert len(list(bs2)) == len(bs2) == 4
