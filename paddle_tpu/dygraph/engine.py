@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 
+from ..core.generator import global_seed
 from ..ops.registry import get_op_info, OpContext
 from .tensor import Tensor
 from .tracer import GradNode
@@ -211,17 +212,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad — PartialGradEngine analog: return grads of `outputs`
-    w.r.t. `inputs` without touching .grad."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double backward) is not supported by the "
-            "tape engine yet; use jax.grad composition via the static path")
+    w.r.t. `inputs` without touching .grad.  create_graph=True returns
+    grads that are themselves on the tape (double backward), implemented
+    by replaying the recorded forward as a pure function and nesting
+    jax.vjp (reference: imperative/partial_grad_engine.cc +
+    the per-op DoubleGradMakers, e.g. operators/conv_op.cc)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused, no_grad_vars)
 
     for out in outputs:
         if out._grad_node is _FREED:
@@ -252,4 +256,160 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             results.append(None)
         else:
             results.append(Tensor(g, stop_gradient=True))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# create_graph=True: replay the tape as a pure function, nest jax.vjp
+# ---------------------------------------------------------------------------
+def _replay_node(node: GradNode, env: Dict[int, object], blocked):
+    """Re-execute one recorded forward op on (possibly traced) env values."""
+    def val_of(t):
+        if not isinstance(t, Tensor):
+            return t
+        if id(t) in blocked:
+            return t._value  # no_grad_vars: sever the dependence
+        return env.get(id(t), t._value)
+
+    if node.vjp_fn is not None:
+        fn = node.replay_fn
+        if fn is None:
+            raise NotImplementedError(
+                f"create_graph over non-replayable node {node.op_type!r}")
+        out = fn(*[val_of(t) for t in node.ins["X"]])
+        ts = node.out_tensors["Out"]
+        if node.vjp_multi:
+            # multi-output vjp node (a previous create_graph grad): bind
+            # every returned grad, not just the first
+            for t, v in zip(ts, out):
+                env[id(t)] = v
+        else:
+            env[id(ts[0])] = out
+        return
+    info = get_op_info(node.op_type)
+    raw_ins = {}
+    for slot in info.inputs:
+        v = node.ins.get(slot.name)
+        if slot.duplicable:
+            raw_ins[slot.name] = [val_of(t) for t in (v or [])]
+        else:
+            raw_ins[slot.name] = val_of(v) if v is not None else None
+    if node.amp_raws is not None:
+        # forward consumed AMP-casted inputs; replay at the same dtypes
+        for k, rv in node.amp_raws.items():
+            cur = raw_ins.get(k)
+            if cur is not None and hasattr(rv, "dtype") \
+                    and hasattr(cur, "dtype") and cur.dtype != rv.dtype:
+                raw_ins[k] = cur.astype(rv.dtype)
+    outs = info.kernel(raw_ins, node.attrs, OpContext(seed=node.seed))
+    for slot, ts in node.out_tensors.items():
+        val = outs.get(slot) if outs else None
+        if val is None:
+            continue
+        if isinstance(val, (list, tuple)):
+            for t, v in zip(ts, val):
+                env[id(t)] = v
+        else:
+            env[id(ts[0])] = val
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused,
+                       no_grad_vars):
+    import jax
+
+    for out in outputs:
+        if out._grad_node is _FREED:
+            raise RuntimeError(
+                "grad(): the graph reaching this output was freed by a "
+                "previous backward(); pass retain_graph=True to backward()")
+    virtual = GradNode("__root__", {"X": list(outputs)}, {}, {}, {}, 0)
+    fwd_nodes = list(reversed(_topo_order(virtual)))  # producers first
+    fwd_nodes = [n for n in fwd_nodes if n is not virtual]
+    blocked = frozenset(id(t) for t in (no_grad_vars or []))
+
+    # unused-input detection: an input is reachable iff some recorded node
+    # consumes it (or it IS an output)
+    consumed = {id(t) for n in fwd_nodes for t in n.input_tensors()}
+    consumed |= {id(o) for o in outputs}
+    unused = [t for t in inputs if id(t) not in consumed]
+    if unused and not allow_unused:
+        raise RuntimeError(
+            f"input {unused[0].name} is unreachable from outputs "
+            "(set allow_unused=True to get None)")
+    used_inputs = [t for t in inputs if id(t) in consumed]
+    in_ids = [id(t) for t in used_inputs]
+
+    # cotangents: differentiable grad_outputs become extra diff arguments
+    cot_tensors: List[Tensor] = []
+    cot_spec = []  # None -> ones_like; ("const", raw); ("arg", idx)
+    for go in grad_outputs:
+        if go is None:
+            cot_spec.append(None)
+        elif isinstance(go, Tensor) and not go.stop_gradient:
+            cot_spec.append(("arg", len(cot_tensors)))
+            cot_tensors.append(go)
+        else:
+            raw = go._value if isinstance(go, Tensor) else jnp.asarray(go)
+            cot_spec.append(("const", raw))
+
+    # every other differentiable leaf of the subgraph (layer weights, ...)
+    # must be a traced argument too — backward() on a function of the
+    # returned grads has to reach them (gradient-penalty training)
+    produced = {id(t) for n in fwd_nodes
+                for ts in n.out_tensors.values() for t in ts}
+    taken = set(in_ids) | {id(t) for t in cot_tensors} | set(blocked)
+    leaf_extras: List[Tensor] = []
+    for n in fwd_nodes:
+        for t in n.input_tensors():
+            if (id(t) in produced or id(t) in taken or t.stop_gradient
+                    or not jnp.issubdtype(jnp.asarray(t._value).dtype,
+                                          jnp.inexact)):
+                continue
+            taken.add(id(t))
+            leaf_extras.append(t)
+    extra_ids = [id(t) for t in leaf_extras]
+    n_in, n_cot = len(used_inputs), len(cot_tensors)
+
+    def replay(in_raws, extra_raws):
+        env = dict(zip(in_ids, in_raws))
+        env.update(zip(extra_ids, extra_raws))
+        for node in fwd_nodes:
+            _replay_node(node, env, blocked)
+        return tuple(env.get(id(o), o._value) for o in outputs)
+
+    def first_grads(*arg_raws):
+        xs = arg_raws[:n_in]
+        cot_args = arg_raws[n_in:n_in + n_cot]
+        extras = arg_raws[n_in + n_cot:]
+        outs, vjp = jax.vjp(lambda *a: replay(a, extras), *xs)
+        cots = []
+        for spec, o in zip(cot_spec, outs):
+            if spec is None:
+                cots.append(jnp.ones_like(o))
+            elif spec[0] == "const":
+                cots.append(spec[1].astype(o.dtype))
+            else:
+                cots.append(cot_args[spec[1]].astype(o.dtype))
+        return vjp(tuple(cots))
+
+    arg_tensors = list(used_inputs) + cot_tensors + leaf_extras
+    out_raws, vjp_fn = jax.vjp(first_grads,
+                               *[t._value for t in arg_tensors])
+
+    # one multi-output tape node makes the first grads differentiable again
+    node = GradNode("__vjp__:grad", {"X": arg_tensors}, {},
+                    {"Out": out_raws}, {}, global_seed())
+    node.vjp_fn = lambda gs: vjp_fn(tuple(gs))
+    node.vjp_multi = True
+    node.replay_fn = first_grads
+    grads = []
+    for r in out_raws:
+        t = Tensor(r, stop_gradient=False)
+        t._grad_node = node
+        grads.append(t)
+    node.out_tensors = {"Out": grads}
+
+    results, gi = [], iter(grads)
+    for t in inputs:
+        results.append(None if id(t) not in consumed else next(gi))
     return results

@@ -32,7 +32,7 @@ class GradNode:
 
     __slots__ = ("op_type", "ins", "attrs", "outs_raw", "out_tensors",
                  "seed", "vjp_fn", "n_vjp_inputs", "in_tensors_flat",
-                 "amp_raws", "vjp_multi")
+                 "amp_raws", "vjp_multi", "replay_fn")
 
     def __init__(self, op_type, ins, attrs, outs_raw, out_tensors, seed):
         self.op_type = op_type
@@ -48,6 +48,8 @@ class GradNode:
         # must replay with these so vjp dtypes match the forward trace
         self.amp_raws = None
         self.vjp_multi = False  # vjp_fn takes/returns multi-output tuples
+        # pure fn for re-tracing this node (create_graph double backward)
+        self.replay_fn = None
 
     def input_tensors(self) -> List[Tensor]:
         if self.in_tensors_flat:
@@ -172,5 +174,6 @@ def trace_jax(fn, in_tensors: List[Tensor], label: str = "jax_fn"):
                     {"Out": out_raw}, {"Out": [t]}, global_seed())
     node.vjp_fn = vjp_fn
     node.n_vjp_inputs = len(in_tensors)
+    node.replay_fn = fn
     t._grad_node = node
     return t

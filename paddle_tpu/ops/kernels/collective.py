@@ -43,9 +43,10 @@ def _c_allreduce(name, op):
             if _op != "sum":
                 raise NotImplementedError(
                     f"{_op} allreduce over SelectedRows")
-            ax = axes if isinstance(axes, str) else axes[0]
-            rows = jax.lax.all_gather(x.rows, ax, tiled=True)
-            vals = jax.lax.all_gather(x.values, ax, tiled=True)
+            rows, vals = x.rows, x.values
+            for ax in ([axes] if isinstance(axes, str) else axes):
+                rows = jax.lax.all_gather(rows, ax, tiled=True)
+                vals = jax.lax.all_gather(vals, ax, tiled=True)
             return {"Out": SelectedRows(rows, vals, x.height)}
         if _op == "sum":
             return {"Out": jax.lax.psum(x, axes)}

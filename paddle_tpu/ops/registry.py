@@ -171,13 +171,24 @@ def register_op(type: str, inputs: Sequence, outputs: Sequence,
 # Grad op slot convention (matches the reference's default GradOpMaker):
 #   inputs : every forward input slot (same names)
 #            every forward output slot (values may be needed by custom grads)
-#            "<out>@GRAD" for every forward output slot
+#            "<out>@GRAD" for every forward output slot — differentiable,
+#            so second-order cotangents can flow through it
 #   outputs: "<in>@GRAD" for every forward input slot with no_grad=False
-def _register_grad(fwd: OpInfo):
+#
+# `depth` registers grads-of-grads: foo_grad itself gets an auto-vjp
+# foo_grad_grad one level deep (the reference's DoubleGradMaker pattern,
+# e.g. conv_op.cc Conv2DDoubleGradMaker) — enough for gradient-penalty
+# training and paddle.grad(create_graph=True) over the static path.
+def _register_grad(fwd: OpInfo, depth: int = 1):
     gtype = fwd.grad_op_type()
     g_inputs = ([Slot(s.name, s.duplicable, True, s.no_grad) for s in fwd.inputs]
-                + [Slot(s.name, s.duplicable, True, True) for s in fwd.outputs]
-                + [Slot(s.name + "@GRAD", s.duplicable, True, True)
+                # forward outputs stay differentiable inputs of the grad op:
+                # custom grad kernels (flash attention bwd) consume them, and
+                # the chain rule needs their cotangent; auto-vjp grad kernels
+                # ignore them so their cotangent is zero
+                + [Slot(s.name, s.duplicable, True, s.no_grad)
+                   for s in fwd.outputs]
+                + [Slot(s.name + "@GRAD", s.duplicable, True, s.no_grad)
                    for s in fwd.outputs])
     g_outputs = [Slot(s.name + "@GRAD", s.duplicable, True)
                  for s in fwd.inputs if not s.no_grad]
@@ -187,8 +198,11 @@ def _register_grad(fwd: OpInfo):
     else:
         kernel = _make_vjp_grad_kernel(fwd)
 
-    _REGISTRY[gtype] = OpInfo(gtype, kernel,
-                              g_inputs, g_outputs, grad=None)
+    ginfo = OpInfo(gtype, kernel, g_inputs, g_outputs,
+                   grad=("auto" if depth > 0 else None))
+    _REGISTRY[gtype] = ginfo
+    if depth > 0:
+        _register_grad(ginfo, depth=depth - 1)
 
 
 def _is_diff(x):

@@ -104,11 +104,21 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         g_loss = block.create_var(
             name=grad_var_name(loss_name), shape=loss.shape,
             dtype=loss.dtype, stop_gradient=True)
-        block.append_op(
-            "fill_constant", outputs={"Out": g_loss},
-            attrs={"shape": (list(loss.shape) if loss.shape is not None
-                             else [1]), "dtype": loss.dtype,
-                   "value": 1.0, OpRole.KEY: OpRole.Backward})
+        static_shape = (loss.shape is not None
+                        and all(d is not None and d >= 0
+                                for d in loss.shape))
+        if static_shape:
+            block.append_op(
+                "fill_constant", outputs={"Out": g_loss},
+                attrs={"shape": list(loss.shape), "dtype": loss.dtype,
+                       "value": 1.0, OpRole.KEY: OpRole.Backward})
+        else:
+            # non-scalar target with a symbolic batch dim (gradients() on
+            # an intermediate grad var): seed ones at the runtime shape
+            block.append_op(
+                "fill_any_like", inputs={"X": [loss_name]},
+                outputs={"Out": g_loss},
+                attrs={"value": 1.0, OpRole.KEY: OpRole.Backward})
 
         # pending grad pieces per var: var -> [grad piece names]
         pending: Dict[str, List[str]] = {loss_name: [g_loss.name]}
@@ -123,9 +133,14 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 grad_map[name] = pieces[0]
                 return pieces[0]
             out = grad_var_name(name)
-            if out in (p for p in pieces):
+            if out in pieces or block.has_var(out):
+                # already taken by a piece or by a previous append_backward
+                # (double grad): never clobber an existing grad var
                 out = unique_name(grad_var_name(name) + "@SUM")
-            v = block.create_var(name=out, stop_gradient=True)
+            # stop_gradient=False: grad vars stay differentiable so a second
+            # append_backward (double grad via <op>_grad_grad) can flow
+            # through them
+            v = block.create_var(name=out, stop_gradient=False)
             block.append_op("sum", inputs={"X": list(pieces)},
                             outputs={"Out": out})
             pending[name] = [out]
@@ -171,7 +186,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                         outs.append("")
                         continue
                     piece = unique_name(grad_var_name(n))
-                    block.create_var(name=piece, stop_gradient=True)
+                    block.create_var(name=piece, stop_gradient=False)
                     pending.setdefault(n, []).append(piece)
                     outs.append(piece)
                 if any(outs):

@@ -1,0 +1,134 @@
+"""Second-order gradients (reference: per-op DoubleGradMakers in
+operators/*_op.cc e.g. conv_op.cc Conv2DDoubleGradMaker, activation_op.cc;
+imperative double grad via partial_grad_engine.cc).  Here: registry
+registers auto-vjp grads for grad ops one level deep (static), and
+paddle.grad(create_graph=True) replays the tape under nested jax.vjp
+(dygraph)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.dygraph as dg
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def test_dygraph_double_grad_polynomial():
+    """y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x."""
+    with dg.guard():
+        x = dg.to_variable(np.array([2.0, -1.0], np.float32))
+        x.stop_gradient = False
+        y = x * x * x
+        (dx,) = dg.grad([y.sum()], [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(dx.numpy()), [12.0, 3.0],
+                                   rtol=1e-5)
+        (ddx,) = dg.grad([dx.sum()], [x])
+        np.testing.assert_allclose(np.asarray(ddx.numpy()), [12.0, -6.0],
+                                   rtol=1e-5)
+
+
+def test_dygraph_double_grad_through_layers():
+    """Gradient-penalty pattern: ||dL/dx||^2 backpropagated into weights."""
+    with dg.guard():
+        from paddle_tpu.nn import Linear
+        lin = Linear(4, 1)
+        x = dg.to_variable(np.random.RandomState(0)
+                           .rand(3, 4).astype(np.float32))
+        x.stop_gradient = False
+        y = lin(x)
+        loss = (y * y).sum()
+        (dx,) = dg.grad([loss], [x], create_graph=True)
+        penalty = (dx * dx).sum()
+        penalty.backward()
+        w_grad = lin.weight.grad
+        assert w_grad is not None
+        # analytic check: y = xW+b, dL/dx = 2yW^T, penalty = 4 sum(y^2 WW^T)
+        W = np.asarray(lin.weight.numpy())
+        b = np.asarray(lin.bias.numpy())
+        xv = np.asarray(x.numpy())
+        yv = xv @ W + b
+        pen_ref = 4.0 * float((yv ** 2).sum()) * float((W * W).sum())
+        np.testing.assert_allclose(float(penalty.numpy()), pen_ref,
+                                   rtol=1e-4)
+        # numeric wgrad via finite differences on the penalty
+        eps = 1e-3
+        num = np.zeros_like(W)
+        for i in range(W.shape[0]):
+            for j in range(W.shape[1]):
+                for s, sign in ((eps, 1), (-eps, -1)):
+                    W2 = W.copy()
+                    W2[i, j] += s
+                    y2 = xv @ W2 + b
+                    d2 = 2 * y2 @ W2.T
+                    num[i, j] += sign * (d2 * d2).sum()
+        num /= (2 * eps)
+        np.testing.assert_allclose(np.asarray(w_grad.numpy()), num,
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_dygraph_double_grad_unused_and_no_grad_vars():
+    with dg.guard():
+        x = dg.to_variable(np.ones(2, np.float32))
+        x.stop_gradient = False
+        z = dg.to_variable(np.ones(2, np.float32))
+        z.stop_gradient = False
+        y = x * x
+        with pytest.raises(RuntimeError):
+            dg.grad([y.sum()], [z], create_graph=True)
+        dx, dz = dg.grad([y.sum()], [x, z], create_graph=True,
+                         allow_unused=True)
+        assert dz is None
+        np.testing.assert_allclose(np.asarray(dx.numpy()), [2.0, 2.0])
+
+
+def test_static_double_grad():
+    """fluid.gradients applied twice: d2(x^3)/dx2 = 6x via registered
+    <op>_grad_grad kernels."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        x.stop_gradient = False
+        y = layers.reduce_sum(layers.elementwise_mul(
+            layers.elementwise_mul(x, x), x))
+        (dx,) = static.gradients([y], [x])
+        assert dx is not None
+        (ddx,) = static.gradients([dx], [x])
+        assert ddx is not None
+    exe = static.Executor()
+    scope = static.Scope()
+    xv = np.array([[2.0, -1.0]], np.float32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        d1, d2 = exe.run(main, feed={"x": xv}, fetch_list=[dx, ddx])
+    np.testing.assert_allclose(d1, [[12.0, 3.0]], rtol=1e-5)
+    np.testing.assert_allclose(d2, [[12.0, -6.0]], rtol=1e-5)
+
+
+def test_grad_op_registry_has_double_grads():
+    from paddle_tpu.ops.registry import get_op_info
+    for op in ("tanh", "matmul", "conv2d", "batch_norm", "relu"):
+        info = get_op_info(op + "_grad")
+        assert info is not None and info.has_grad, op
+        assert get_op_info(op + "_grad_grad") is not None, op
+
+
+def test_dygraph_third_order_grad():
+    """Nested create_graph: d3(x^4)/dx3 = 24x via replaying a grad node
+    with multiple outputs."""
+    with dg.guard():
+        x = dg.to_variable(np.array([1.5, -2.0], np.float32))
+        x.stop_gradient = False
+        z = dg.to_variable(np.array([2.0, 3.0], np.float32))
+        z.stop_gradient = False
+        y = (x * x * x * x).sum() + (z * z).sum()
+        dx, dz = dg.grad([y], [x, z], create_graph=True)
+        ddx, ddz = dg.grad([dx.sum() + dz.sum()], [x, z],
+                           create_graph=True)
+        np.testing.assert_allclose(np.asarray(ddx.numpy()),
+                                   12 * np.array([1.5, -2.0]) ** 2,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(ddz.numpy()), [2.0, 2.0],
+                                   rtol=1e-5)
+        (dddx,) = dg.grad([ddx.sum()], [x])
+        np.testing.assert_allclose(np.asarray(dddx.numpy()),
+                                   24 * np.array([1.5, -2.0]), rtol=1e-4)
