@@ -30,7 +30,7 @@ def _setup_api():
     import importlib
     for mod in ("dygraph", "tensor", "nn", "optimizer", "static",
                 "distributed", "amp", "metric", "io", "vision", "text",
-                "hapi", "jit", "incubate", "profiler", "utils"):
+                "hapi", "jit", "incubate", "profiler", "utils", "slim"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:

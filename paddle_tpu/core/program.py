@@ -450,6 +450,9 @@ _TEST_MODE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
     "sync_batch_norm": ("is_test",),
+    "fake_quantize_moving_average_abs_max": ("is_test",),
+    "fake_quantize_dequantize_moving_average_abs_max": ("is_test",),
+    "moving_average_abs_max_scale": ("is_test",),
 }
 
 

@@ -1,0 +1,175 @@
+"""Fake-quantization ops (reference: /root/reference/paddle/fluid/operators/
+fake_quantize_op.cc — FakeQuantizeAbsMax, FakeChannelWiseQuantizeAbsMax,
+FakeQuantizeMovingAverageAbsMax, MovingAverageAbsMaxScale,
+fake_dequantize_op.cc FakeDequantizeMaxAbs; straight-through-estimator
+gradients registered by the QAT passes, quantization_pass.py).
+
+TPU design: quantization is SIMULATED in float (quant→round→dequant in one
+fused XLA computation) during QAT and calibrated inference; the freeze pass
+(slim/quantization.py) stores weights as real int8 with a dequantize op in
+front — XLA folds the dequant into the consuming matmul/conv.  Gradients of
+the quant_dequant ops are straight-through (identity inside the clip range),
+matching the reference QAT training semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _bin(attrs):
+    # bit_length=8 -> 127 (reference: (1 << (bit_length - 1)) - 1)
+    return float((1 << (attrs.get("bit_length", 8) - 1)) - 1)
+
+
+def _abs_max(x):
+    s = jnp.max(jnp.abs(x))
+    return jnp.maximum(s, 1e-8)
+
+
+def _ste_grad(ins, attrs, ctx):
+    """Straight-through estimator: pass the cotangent through the
+    quant-dequant unchanged inside the representable range."""
+    x, g = ins["X"], ins["Out@GRAD"]
+    if g is None:
+        return {}
+    return {"X@GRAD": g}
+
+
+# -- quantize-only (inference/freeze path) ----------------------------------
+@register_op("fake_quantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], grad=None)
+def fake_quantize_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    scale = _abs_max(x)
+    q = jnp.clip(jnp.round(x / scale * b), -b, b)
+    return {"Out": q, "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], grad=None)
+def fake_channel_wise_quantize_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=red), 1e-8)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    q = jnp.clip(jnp.round(x / scale.reshape(shape) * b), -b, b)
+    return {"Out": q, "OutScale": scale}
+
+
+@register_op("fake_dequantize_max_abs", inputs=["X", "Scale!"],
+             outputs=["Out"], grad=None)
+def fake_dequantize_max_abs(ins, attrs, ctx):
+    x, scale = ins["X"], ins["Scale"]
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x.astype(jnp.float32) * (scale.reshape(()) / max_range)}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=["X", "Scales*"], outputs=["Out"], grad=None)
+def fake_channel_wise_dequantize_max_abs(ins, attrs, ctx):
+    x = ins["X"]
+    scales = ins["Scales"]
+    axis = attrs.get("quant_axis", 0)
+    qb = float(attrs.get("max_range", 127.0))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = x.astype(jnp.float32) * (scales[0].reshape(shape) / qb)
+    if len(scales) > 1:  # second-level (activation) scale
+        out = out * (scales[1].reshape(()) / qb)
+    return {"Out": out}
+
+
+# -- quant+dequant (QAT simulated path, STE gradient) -----------------------
+@register_op("fake_quantize_dequantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], grad=_ste_grad)
+def fake_quantize_dequantize_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    scale = _abs_max(x)
+    out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
+    return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], grad=_ste_grad)
+def fake_channel_wise_quantize_dequantize_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=red), 1e-8)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = scale.reshape(shape)
+    out = jnp.clip(jnp.round(x / s * b), -b, b) * (s / b)
+    return {"Out": out.astype(x.dtype), "OutScale": scale}
+
+
+def _moving_average(ins, attrs, x):
+    """scale tracking: state = rho*state + 1; accum = rho*accum + absmax;
+    scale = accum / state (fake_quantize_op.cc FindMovingAverageAbsMax)."""
+    rho = attrs.get("moving_rate", 0.9)
+    cur = _abs_max(x)
+    in_state = ins.get("InState")
+    in_accum = ins.get("InAccum")
+    state = (rho * in_state.reshape(()) + 1.0) if in_state is not None \
+        else jnp.asarray(1.0)
+    accum = (rho * in_accum.reshape(()) + cur) if in_accum is not None \
+        else cur
+    return accum / state, state, accum
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             inputs=["X", "InScale!", "InState?!", "InAccum?!"],
+             outputs=["Out", "OutScale", "OutState?", "OutAccum?"],
+             grad=None)
+def fake_quantize_moving_average_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = ins["InScale"].reshape(())
+        q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-8) * b), -b, b)
+        return {"Out": q, "OutScale": scale.reshape((1,))}
+    scale, state, accum = _moving_average(ins, attrs, x)
+    q = jnp.clip(jnp.round(x / scale * b), -b, b)
+    return {"Out": q, "OutScale": scale.reshape((1,)),
+            "OutState": state.reshape((1,)), "OutAccum": accum.reshape((1,))}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             inputs=["X", "InScale!", "InState?!", "InAccum?!"],
+             outputs=["Out", "OutScale", "OutState?", "OutAccum?"],
+             grad=_ste_grad)
+def fake_quantize_dequantize_moving_average_abs_max(ins, attrs, ctx):
+    x = ins["X"]
+    b = _bin(attrs)
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = jnp.maximum(ins["InScale"].reshape(()), 1e-8)
+        out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
+        return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,))}
+    scale, state, accum = _moving_average(ins, attrs, x)
+    out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
+    return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,)),
+            "OutState": state.reshape((1,)), "OutAccum": accum.reshape((1,))}
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=["X", "InState?!", "InAccum?!"],
+             outputs=["Out?", "OutScale", "OutState?", "OutAccum?"],
+             grad=_ste_grad)
+def moving_average_abs_max_scale(ins, attrs, ctx):
+    """Observer op: identity on X, tracks the output scale (used by the
+    freeze pass for activation out_threshold attrs)."""
+    x = ins["X"]
+    if attrs.get("is_test", False) or ctx.is_test:
+        return {"Out": x}
+    scale, state, accum = _moving_average(ins, attrs, x)
+    return {"Out": x, "OutScale": scale.reshape((1,)),
+            "OutState": state.reshape((1,)), "OutAccum": accum.reshape((1,))}

@@ -1,0 +1,12 @@
+"""paddle_tpu.slim — model compression (quantization tier).
+
+Reference: /root/reference/python/paddle/fluid/contrib/slim/ — the
+quantization sub-package (quantization_pass.py, post_training_quantization.py,
+quant_int8_mkldnn_pass.py).  Pruning/distillation/NAS from the reference
+slim are orthogonal training recipes and are not part of the runtime
+contract; quantization is, and lives here.
+"""
+from .quantization import (  # noqa: F401
+    QuantizationTransformPass, QuantizationFreezePass,
+    PostTrainingQuantization, QUANTIZABLE_OPS,
+)

@@ -1,0 +1,327 @@
+"""Quantization passes: QAT transform, freeze, and post-training (PTQ).
+
+Reference: /root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py (QuantizationTransformPass:143 inserts fake
+quant/dequant pairs on the inputs of quantizable ops,
+QuantizationFreezePass:700 converts trained weights to int8) and
+post_training_quantization.py (PostTrainingQuantization:102 calibrates
+activation scales from sample data).
+
+TPU design notes:
+  * QAT runs fully inside the whole-block jit: the fake quant_dequant ops
+    are plain traceable kernels with straight-through gradients
+    (ops/kernels/quantize.py), so no separate quantized graph engine is
+    needed — XLA fuses round/clip/scale into the surrounding matmuls.
+  * Freeze stores weights as REAL int8 arrays in the scope with a
+    fake_dequantize_max_abs op in front; XLA folds the dequant into the
+    consumer.  Compute stays on the MXU in bf16/f32 (simulated int8) —
+    native int8 dot lowering is a backend concern, not a graph one.
+  * Activation scales live in persistable vars (moving-average state during
+    QAT; calibrated constants after PTQ), so checkpoint/resume and
+    save_inference_model carry them with no extra machinery.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ..core.program import Program, OpDesc, OpRole, unique_name
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "PostTrainingQuantization", "QUANTIZABLE_OPS"]
+
+# reference QuantizationTransformPass._supported_quantizable_op_type
+QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul", "fc")
+
+# op -> input slots that carry quantizable float tensors
+_QUANT_SLOTS = {
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+    "fc": ("Input", "W"),
+}
+
+# weight slot per op (channel-wise axis 0 for conv filters, 1 for matmul W)
+_WEIGHT_SLOTS = {"Filter": 0, "Y": 1, "W": 1}
+
+
+def _is_param(block, name):
+    try:
+        return block.var(name).is_parameter
+    except KeyError:
+        return False
+
+
+class QuantizationTransformPass:
+    """Insert fake quant-dequant on the inputs of quantizable ops (QAT).
+
+    Apply BEFORE minimize()/append_backward so the STE grad ops are
+    generated for the inserted ops.  `startup_program` receives the
+    fill_constant initializers for the activation-scale state vars."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9, quantizable_op_type=QUANTIZABLE_OPS,
+                 skip_pattern="skip_quant"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
+        self.ops = tuple(quantizable_op_type)
+        self.skip_pattern = skip_pattern
+
+    # -- helpers -------------------------------------------------------------
+    def _quant_weight(self, block, name, axis, new_ops, cache):
+        if name in cache:
+            return cache[name]
+        v = block.var(name)
+        qname = unique_name(name + ".quantized.dequantized")
+        block.create_var(name=qname, shape=v.shape, dtype=v.dtype,
+                         stop_gradient=False)
+        sname = unique_name(name + ".quant_scale")
+        block.create_var(name=sname, stop_gradient=True)
+        if self.weight_quantize_type == "channel_wise_abs_max":
+            op_type = "fake_channel_wise_quantize_dequantize_abs_max"
+            attrs = {"bit_length": self.weight_bits, "quant_axis": axis}
+        else:
+            op_type = "fake_quantize_dequantize_abs_max"
+            attrs = {"bit_length": self.weight_bits}
+        attrs[OpRole.KEY] = OpRole.Forward
+        attrs["op_uid"] = block.program._next_uid()
+        new_ops.append(OpDesc(op_type, {"X": [name]},
+                              {"Out": [qname], "OutScale": [sname]}, attrs))
+        cache[name] = qname
+        return qname
+
+    def _quant_act(self, block, startup, name, new_ops, cache):
+        if name in cache:
+            return cache[name]
+        v = block.var(name)
+        qname = unique_name(name + ".quantized.dequantized")
+        block.create_var(name=qname, shape=v.shape, dtype=v.dtype,
+                         stop_gradient=False)
+        if self.activation_quantize_type == "abs_max":
+            # dynamic per-batch quantization: no tracked state
+            attrs = {"bit_length": self.activation_bits,
+                     OpRole.KEY: OpRole.Forward,
+                     "op_uid": block.program._next_uid()}
+            sname = unique_name(name + ".quant_scale")
+            block.create_var(name=sname, stop_gradient=True)
+            new_ops.append(OpDesc("fake_quantize_dequantize_abs_max",
+                                  {"X": [name]},
+                                  {"Out": [qname], "OutScale": [sname]},
+                                  attrs))
+            cache[name] = qname
+            return qname
+        if self.activation_quantize_type != "moving_average_abs_max":
+            raise ValueError(
+                f"unsupported activation_quantize_type "
+                f"{self.activation_quantize_type!r} (use 'abs_max' or "
+                f"'moving_average_abs_max')")
+        scale = unique_name(name + ".quant_scale")
+        state = unique_name(name + ".quant_state")
+        accum = unique_name(name + ".quant_accum")
+        for n, init in ((scale, 0.001), (state, 1.0), (accum, 0.001)):
+            block.create_var(name=n, shape=(1,), dtype="float32",
+                             persistable=True, stop_gradient=True)
+            if startup is not None:
+                sb = startup.global_block()
+                if not sb.has_var(n):
+                    sb.create_var(name=n, shape=(1,), dtype="float32",
+                                  persistable=True, stop_gradient=True)
+                    sb.append_op("fill_constant", {}, {"Out": [n]},
+                                 {"shape": [1], "dtype": "float32",
+                                  "value": init})
+        attrs = {"bit_length": self.activation_bits,
+                 "moving_rate": self.moving_rate,
+                 OpRole.KEY: OpRole.Forward,
+                 "op_uid": block.program._next_uid()}
+        new_ops.append(OpDesc(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            {"X": [name], "InScale": [scale], "InState": [state],
+             "InAccum": [accum]},
+            {"Out": [qname], "OutScale": [scale], "OutState": [state],
+             "OutAccum": [accum]}, attrs))
+        cache[name] = qname
+        return qname
+
+    # -- entry ---------------------------------------------------------------
+    def apply(self, program: Program,
+              startup_program: Optional[Program] = None) -> Program:
+        block = program.global_block()
+        cache: Dict[str, str] = {}
+        new_ops: List[OpDesc] = []
+        n_quant = 0
+        for op in block.ops:
+            if op.type in self.ops and \
+                    not op.attrs.get(self.skip_pattern, False):
+                slots = _QUANT_SLOTS.get(op.type, ())
+                for slot in slots:
+                    names = op.inputs.get(slot, [])
+                    if not names:
+                        continue
+                    name = names[0]
+                    if _is_param(block, name):
+                        axis = _WEIGHT_SLOTS.get(slot, 0)
+                        q = self._quant_weight(block, name, axis, new_ops,
+                                               cache)
+                    else:
+                        q = self._quant_act(block, startup_program, name,
+                                            new_ops, cache)
+                    op.inputs[slot] = [q]
+                    n_quant += 1
+            new_ops.append(op)
+        block.ops = new_ops
+        program._fingerprint_cache = None
+        program._n_quantized_inputs = n_quant
+        return program
+
+
+class QuantizationFreezePass:
+    """Convert a trained/calibrated QAT inference program: weights become
+    real int8 vars in the scope with a fake_dequantize_max_abs in front
+    (reference QuantizationFreezePass:700 _insert_post_dequant_op)."""
+
+    def __init__(self, weight_bits=8):
+        self.weight_bits = weight_bits
+
+    def apply(self, program: Program, scope) -> Program:
+        block = program.global_block()
+        b = float((1 << (self.weight_bits - 1)) - 1)
+        new_ops: List[OpDesc] = []
+        for op in block.ops:
+            if op.type in ("fake_quantize_dequantize_abs_max",
+                           "fake_channel_wise_quantize_dequantize_abs_max") \
+                    and _is_param(block, op.inputs["X"][0]):
+                from ..ops.registry import run_kernel, OpContext
+                import jax.numpy as jnp
+                wname = op.inputs["X"][0]
+                out = op.outputs["Out"][0]
+                w = jnp.asarray(scope.get(wname))
+                # quantize through the registered kernel so the int8 grid
+                # is bit-identical to what QAT trained against — one source
+                # of truth for scale/round/clip
+                if op.type.startswith("fake_channel_wise"):
+                    axis = op.attrs.get("quant_axis", 0)
+                    r = run_kernel("fake_channel_wise_quantize_abs_max",
+                                   {"X": w},
+                                   {"bit_length": self.weight_bits,
+                                    "quant_axis": axis}, OpContext())
+                    deq_type = "fake_channel_wise_dequantize_max_abs"
+                    sc_slot = "Scales"
+                    attrs = {"max_range": b, "quant_axis": axis}
+                else:
+                    r = run_kernel("fake_quantize_abs_max", {"X": w},
+                                   {"bit_length": self.weight_bits},
+                                   OpContext())
+                    deq_type = "fake_dequantize_max_abs"
+                    sc_slot = "Scale"
+                    attrs = {"max_range": b}
+                q = np.asarray(r["Out"]).astype(np.int8)
+                scale = np.asarray(r["OutScale"])
+                iname = unique_name(wname + ".int8")
+                sname = unique_name(wname + ".deq_scale")
+                block.create_var(name=iname, shape=list(q.shape),
+                                 dtype="int8", persistable=True,
+                                 stop_gradient=True)
+                block.create_var(name=sname, shape=list(np.shape(scale)),
+                                 dtype="float32", persistable=True,
+                                 stop_gradient=True)
+                scope.set(iname, np.asarray(q))
+                scope.set(sname, np.asarray(scale, np.float32))
+                attrs[OpRole.KEY] = OpRole.Forward
+                attrs["op_uid"] = block.program._next_uid()
+                new_ops.append(OpDesc(
+                    deq_type, {"X": [iname], sc_slot: [sname]},
+                    {"Out": [out]}, attrs))
+                # drop the float weight from the frozen PROGRAM only — its
+                # persistables (what save_inference_model stores) shrink
+                # 4x.  The scope keeps the float value so other programs
+                # sharing the scope (the original float/training program)
+                # still run.
+                block.vars.pop(wname, None)
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+        program._fingerprint_cache = None
+        return program
+
+
+class PostTrainingQuantization:
+    """PTQ: run calibration batches through a float inference program,
+    record per-tensor abs-max, emit a quantized program + scope.
+
+    ptq = PostTrainingQuantization(exe, infer_prog, feed_names, scope)
+    quant_prog = ptq.quantize(calib_feed_iter)
+    """
+
+    def __init__(self, executor, program: Program, feed_names: List[str],
+                 scope=None, algo: str = "abs_max", weight_bits=8,
+                 activation_bits=8,
+                 quantizable_op_type=QUANTIZABLE_OPS):
+        from ..static.executor import global_scope
+        self.exe = executor
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.scope = scope or global_scope()
+        assert algo in ("abs_max",), f"unsupported PTQ algo {algo!r}"
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.ops = tuple(quantizable_op_type)
+
+    def _activation_targets(self) -> List[str]:
+        block = self.program.global_block()
+        targets = []
+        for op in block.ops:
+            if op.type not in self.ops:
+                continue
+            for slot in _QUANT_SLOTS.get(op.type, ()):
+                for n in op.inputs.get(slot, []):
+                    if n and not _is_param(block, n) \
+                            and n not in targets:
+                        targets.append(n)
+        return targets
+
+    def quantize(self, calib_feeds: Iterable[Dict[str, np.ndarray]],
+                 max_batches: Optional[int] = None) -> Program:
+        if any(op.type.startswith("fake_quantize")
+               or op.type.startswith("fake_channel_wise_quantize")
+               for op in self.program.global_block().ops):
+            raise ValueError(
+                "PostTrainingQuantization expects a FLOAT inference "
+                "program; this one already contains fake-quant ops (QAT). "
+                "Use QuantizationFreezePass on it directly instead.")
+        targets = self._activation_targets()
+        maxes = {n: 0.0 for n in targets}
+        for i, feed in enumerate(calib_feeds):
+            if max_batches is not None and i >= max_batches:
+                break
+            vals = self.exe.run(self.program, feed=feed,
+                                fetch_list=targets, scope=self.scope)
+            for n, v in zip(targets, vals):
+                maxes[n] = max(maxes[n], float(np.abs(v).max()))
+
+        quant = self.program.clone(for_test=True)
+        tp = QuantizationTransformPass(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            quantizable_op_type=self.ops)
+        tp.apply(quant, startup_program=None)
+        # calibrated scales -> the InScale persistable vars; flip the
+        # activation quant ops to is_test so they consume them
+        block = quant.global_block()
+        for op in block.ops:
+            if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+                src = op.inputs["X"][0]
+                base = src.split(".quantized.dequantized")[0]
+                op.attrs["is_test"] = True
+                self.scope.set(op.inputs["InScale"][0],
+                               np.asarray([max(maxes.get(base, 0.0), 1e-8)],
+                                          np.float32))
+        QuantizationFreezePass(self.weight_bits).apply(quant, self.scope)
+        quant._fingerprint_cache = None
+        return quant

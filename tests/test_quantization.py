@@ -1,0 +1,250 @@
+"""Quantization tier (reference: operators/fake_quantize_op.cc,
+contrib/slim/quantization/quantization_pass.py QuantizationTransformPass /
+QuantizationFreezePass, post_training_quantization.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+# ---------------------------------------------------------------------------
+# op level
+# ---------------------------------------------------------------------------
+def _run(op, ins, attrs):
+    from paddle_tpu.ops.registry import run_kernel, OpContext
+    import jax.numpy as jnp
+    return run_kernel(op, {k: (jnp.asarray(v) if v is not None else None)
+                           for k, v in ins.items()}, attrs, OpContext())
+
+
+def test_fake_quant_dequant_abs_max_roundtrip():
+    x = np.linspace(-2.0, 2.0, 16).astype(np.float32)
+    out = _run("fake_quantize_dequantize_abs_max", {"X": x},
+               {"bit_length": 8})
+    y = np.asarray(out["Out"])
+    assert abs(float(out["OutScale"][0]) - 2.0) < 1e-6
+    np.testing.assert_allclose(y, x, atol=2.0 / 127 + 1e-6)
+    assert not np.allclose(y, x)  # rounding actually happened
+
+
+def test_fake_quant_channel_wise():
+    w = np.stack([np.full((3,), 1.0), np.full((3,), 10.0)]) \
+        .astype(np.float32)
+    out = _run("fake_channel_wise_quantize_abs_max", {"X": w},
+               {"bit_length": 8, "quant_axis": 0})
+    np.testing.assert_allclose(np.asarray(out["OutScale"]), [1.0, 10.0])
+    q = np.asarray(out["Out"])
+    assert q.max() == 127.0
+    deq = _run("fake_channel_wise_dequantize_max_abs",
+               {"X": q, "Scales": [np.asarray(out["OutScale"])]},
+               {"max_range": 127.0, "quant_axis": 0})
+    np.testing.assert_allclose(np.asarray(deq["Out"]), w, rtol=1e-2)
+
+
+def test_quant_dequant_int8_roundtrip():
+    x = np.array([-1.0, -0.5, 0.0, 0.5, 1.0], np.float32)
+    q = _run("fake_quantize_abs_max", {"X": x}, {"bit_length": 8})
+    deq = _run("fake_dequantize_max_abs",
+               {"X": np.asarray(q["Out"]),
+                "Scale": np.asarray(q["OutScale"])},
+               {"max_range": 127.0})
+    np.testing.assert_allclose(np.asarray(deq["Out"]), x, atol=1.0 / 127)
+
+
+def test_moving_average_scale_state():
+    x = np.ones(4, np.float32) * 3.0
+    out = _run("fake_quantize_dequantize_moving_average_abs_max",
+               {"X": x, "InScale": np.asarray([1.0], np.float32),
+                "InState": np.asarray([1.0], np.float32),
+                "InAccum": np.asarray([1.0], np.float32)},
+               {"bit_length": 8, "moving_rate": 0.9})
+    # state = .9*1+1 = 1.9; accum = .9*1+3 = 3.9; scale = 3.9/1.9
+    np.testing.assert_allclose(float(out["OutState"][0]), 1.9, rtol=1e-6)
+    np.testing.assert_allclose(float(out["OutAccum"][0]), 3.9, rtol=1e-6)
+    np.testing.assert_allclose(float(out["OutScale"][0]), 3.9 / 1.9,
+                               rtol=1e-6)
+    # is_test consumes InScale untouched
+    t = _run("fake_quantize_dequantize_moving_average_abs_max",
+             {"X": x, "InScale": np.asarray([4.0], np.float32),
+              "InState": None, "InAccum": None},
+             {"bit_length": 8, "is_test": True})
+    np.testing.assert_allclose(np.asarray(t["Out"]), x, atol=4 / 127)
+
+
+def test_ste_gradient_identity():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import run_kernel, OpContext
+    g = run_kernel("fake_quantize_dequantize_abs_max_grad",
+                   {"X": jnp.asarray([0.3, -0.7]),
+                    "Out@GRAD": jnp.asarray([1.5, -2.5])},
+                   {"bit_length": 8}, OpContext())
+    np.testing.assert_allclose(np.asarray(g["X@GRAD"]), [1.5, -2.5])
+
+
+# ---------------------------------------------------------------------------
+# QAT end-to-end
+# ---------------------------------------------------------------------------
+def _mlp_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    return main, startup, loss, pred
+
+
+def test_qat_transform_and_train():
+    from paddle_tpu.slim import QuantizationTransformPass
+    main, startup, loss, _ = _mlp_program()
+    tp = QuantizationTransformPass()
+    with static.program_guard(main, startup):
+        tp.apply(main, startup)
+        static.Adam(learning_rate=0.01).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    # STE grads appended
+    assert any(t.endswith("_grad") and t.startswith("fake_") for t in types)
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(32, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0]) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    # moving-average scale state actually updated
+    svars = [n for n in scope.keys() if ".quant_scale" in n
+             and scope.get(n) is not None]
+    assert svars and any(float(np.asarray(scope.get(n))[0]) > 0.01
+                         for n in svars)
+
+
+def test_ptq_freeze_and_predict():
+    """PTQ: calibrate a float model, freeze to int8 weights, accuracy of the
+    quantized predictor stays close to float."""
+    from paddle_tpu.slim import PostTrainingQuantization
+    main, startup, loss, pred = _mlp_program()
+    with static.program_guard(main, startup):
+        static.Adam(learning_rate=0.02).minimize(loss)
+    rng = np.random.RandomState(1)
+    xb = rng.rand(64, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(150):
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        # inference clone: strip training roles first (the
+        # save_inference_model recipe — _prune alone keeps optimizer ops
+        # because they write persistables)
+        from paddle_tpu.core.program import OpRole
+        infer = main.clone(for_test=True)
+        blk = infer.global_block()
+        train_roles = (OpRole.Backward, OpRole.Optimize, OpRole.LRSched,
+                       OpRole.Optimize | OpRole.LRSched)
+        blk.ops = [op for op in blk.ops
+                   if op.attrs.get(OpRole.KEY, OpRole.Forward)
+                   not in train_roles]
+        infer = infer._prune([pred.name])
+        float_out = exe.run(infer, feed={"x": xb[:8]},
+                            fetch_list=[pred])[0]
+
+        ptq = PostTrainingQuantization(exe, infer, ["x"], scope=scope)
+        quant = ptq.quantize([{"x": xb[i:i + 8]} for i in range(0, 64, 8)])
+        qtypes = [op.type for op in quant.global_block().ops]
+        assert "fake_channel_wise_dequantize_max_abs" in qtypes
+        # weights now stored int8
+        int8_vars = [n for n in scope.keys() if n.endswith(".int8_0")
+                     or ".int8" in n]
+        assert any(np.asarray(scope.get(n)).dtype == np.int8
+                   for n in int8_vars if scope.get(n) is not None)
+        q_out = exe.run(quant, feed={"x": xb[:8]}, fetch_list=[pred])[0]
+    err = np.abs(q_out - float_out).max() / (np.abs(float_out).max() + 1e-6)
+    assert err < 0.1, f"quantization error too large: {err}"
+
+
+def test_ptq_rejects_qat_program():
+    """PTQ on an already-QAT program would double-quantize; it must refuse
+    and point at the freeze pass."""
+    from paddle_tpu.slim import (QuantizationTransformPass,
+                                 PostTrainingQuantization)
+    main, startup, loss, pred = _mlp_program()
+    with static.program_guard(main, startup):
+        QuantizationTransformPass().apply(main, startup)
+    exe = static.Executor()
+    ptq = PostTrainingQuantization(exe, main, ["x"], scope=static.Scope())
+    with pytest.raises(ValueError, match="QAT"):
+        ptq.quantize([{"x": np.zeros((2, 8), np.float32)}])
+
+
+def test_qat_freeze_roundtrip():
+    """QAT train -> freeze -> int8 inference matches the QAT eval output
+    exactly (same quantization grid)."""
+    from paddle_tpu.slim import (QuantizationTransformPass,
+                                 QuantizationFreezePass)
+    from paddle_tpu.core.program import OpRole
+    main, startup, loss, pred = _mlp_program()
+    with static.program_guard(main, startup):
+        QuantizationTransformPass().apply(main, startup)
+        static.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(2)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(30):
+            exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        blk = infer.global_block()
+        roles = (OpRole.Backward, OpRole.Optimize, OpRole.LRSched,
+                 OpRole.Optimize | OpRole.LRSched)
+        blk.ops = [op for op in blk.ops
+                   if op.attrs.get(OpRole.KEY, OpRole.Forward) not in roles]
+        infer = infer._prune([pred.name])
+        qat_out = exe.run(infer, feed={"x": xb[:4]}, fetch_list=[pred])[0]
+        frozen = QuantizationFreezePass().apply(infer, scope)
+        int8_out = exe.run(frozen, feed={"x": xb[:4]}, fetch_list=[pred])[0]
+    np.testing.assert_allclose(int8_out, qat_out, rtol=1e-5, atol=1e-6)
+
+
+def test_freeze_keeps_float_scope_and_act_types():
+    """Freeze must not delete float weights from the shared scope (the
+    original program still runs); activation_quantize_type='abs_max' emits
+    dynamic quant ops; unknown types raise."""
+    from paddle_tpu.slim import (QuantizationTransformPass,
+                                 QuantizationFreezePass)
+    main, startup, loss, pred = _mlp_program()
+    rng = np.random.RandomState(3)
+    xb = rng.rand(8, 8).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        infer = main._prune([pred.name])
+        f0 = exe.run(infer, feed={"x": xb}, fetch_list=[pred])[0]
+        qat = infer.clone(for_test=True)
+        QuantizationTransformPass(
+            activation_quantize_type="abs_max").apply(qat, None)
+        types = [op.type for op in qat.global_block().ops]
+        assert "fake_quantize_dequantize_abs_max" in types
+        assert "fake_quantize_dequantize_moving_average_abs_max" \
+            not in types
+        QuantizationFreezePass().apply(qat, scope)
+        # original float program still runs on the same scope
+        f1 = exe.run(infer, feed={"x": xb}, fetch_list=[pred])[0]
+        np.testing.assert_allclose(f1, f0, rtol=1e-6)
+    with pytest.raises(ValueError, match="activation_quantize_type"):
+        QuantizationTransformPass(
+            activation_quantize_type="bogus").apply(
+                _mlp_program()[0], None)
