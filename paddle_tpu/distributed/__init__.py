@@ -17,4 +17,7 @@ from .spawn import spawn  # noqa: F401
 from .compiled_program import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,
 )
+from .dataset import (  # noqa: F401
+    DatasetFactory, InMemoryDataset, QueueDataset, MultiSlotDataFeed,
+)
 from . import fleet  # noqa: F401

@@ -1,0 +1,369 @@
+"""Industrial dataset path: InMemoryDataset / QueueDataset + MultiSlot feed.
+
+Reference: /root/reference/python/paddle/fluid/dataset.py (DatasetFactory,
+InMemoryDataset load_into_memory/local_shuffle/global_shuffle,
+QueueDataset), framework/data_feed.h:117,302 (MultiSlotDataFeed text
+format), framework/data_set.h:101-111 (LoadIntoMemory/LocalShuffle/
+GlobalShuffle), and the MultiTrainer/DeviceWorker hot loop
+(framework/multi_trainer.cc, device_worker.cc) consumed by
+Executor.train_from_dataset (executor.py:1345).
+
+TPU-native redesign:
+  * The reference's N hogwild device-workers each pull batches and run the
+    per-op interpreter; one TPU chip wants ONE whole-block jitted step fed
+    fast.  So "threads" become a host-side parse/prefetch producer feeding
+    the native C++ BlockingQueue (native/blocking_queue.cc), and the train
+    loop pops ready batches and runs the jitted step — IO overlaps compute
+    without NUMA worker plumbing.
+  * MultiSlot text format is parsed into numpy batches; variable-length id
+    slots are padded per batch (io/bucketing.py replaces LoD as the ragged
+    carrier; pad value 0 with an explicit <slot>.lod lengths array fed when
+    the program declares it).
+  * global_shuffle: records are hash-partitioned by instance so each
+    trainer keeps a disjoint 1/N shard (data_set.h GlobalShuffle semantics
+    of "each ins lands on exactly one trainer").  With a live PS
+    (fleet.util KV endpoints) records for other trainers would ride the KV
+    server; single-process worlds reduce to a seeded local shuffle.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import pickle
+import threading
+import zlib
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset", "MultiSlotDataFeed"]
+
+
+class MultiSlotDataFeed:
+    """Parser for the MultiSlot text format (data_feed.h:302): each line
+    holds, per slot in order, `<count> v1 ... v<count>`.  Slot dtype comes
+    from the bound use_vars: integer vars are sparse id slots
+    (variable-length), float vars are dense slots."""
+
+    def __init__(self, slot_names: List[str], slot_dtypes: List[str]):
+        self.slot_names = list(slot_names)
+        self.slot_dtypes = list(slot_dtypes)
+
+    def parse_line(self, line: str):
+        toks = line.split()
+        rec, i = [], 0
+        for dt in self.slot_dtypes:
+            if i >= len(toks):
+                raise ValueError(f"truncated MultiSlot line: {line!r}")
+            n = int(toks[i])
+            i += 1
+            vals = toks[i:i + n]
+            if len(vals) != n:
+                raise ValueError(f"slot count {n} exceeds line: {line!r}")
+            i += n
+            if "int" in dt:
+                rec.append(np.asarray([int(v) for v in vals], np.int64))
+            else:
+                rec.append(np.asarray([float(v) for v in vals], np.float32))
+        return rec
+
+
+class DatasetBase:
+    """fluid.dataset.DatasetBase parity: configuration + batch assembly."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.use_vars = []
+        self.pipe_command = None
+        self.seed = 0
+        self._feed: Optional[MultiSlotDataFeed] = None
+
+    # -- reference setters ---------------------------------------------------
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        # reference: N device workers; here: prefetch producer count
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist: List[str]):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+        self._feed = MultiSlotDataFeed(
+            [v.name for v in self.use_vars],
+            [v.dtype or "float32" for v in self.use_vars])
+
+    def set_pipe_command(self, pipe_command: str):
+        # reference pipes each file through a shell command (data_feed
+        # pipe reader); zero-egress images rarely allow this — store it and
+        # refuse at load time so misuse is loud, not silent
+        self.pipe_command = pipe_command
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        raise NotImplementedError(
+            "HDFS-backed filelists are not supported; stage files on "
+            "local disk (fleet.utils.fs LocalFS)")
+
+    # -- record iteration ----------------------------------------------------
+    def _iter_file(self, path: str) -> Iterable[List[np.ndarray]]:
+        if self.pipe_command:
+            raise NotImplementedError(
+                "set_pipe_command preprocessing is not supported on this "
+                "runtime; preprocess files ahead of time")
+        assert self._feed is not None, "call set_use_var first"
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield self._feed.parse_line(line)
+
+    def _records_to_batch(self, records: List[List[np.ndarray]]):
+        """Pad/stack one batch into a feed dict (LoD -> pad + lengths)."""
+        from ..io.bucketing import pad_sequences
+        feed: Dict[str, np.ndarray] = {}
+        for j, v in enumerate(self.use_vars):
+            cols = [r[j] for r in records]
+            dt = v.dtype or "float32"
+            if "int" in dt:
+                ls = [c.shape[0] for c in cols]
+                if min(ls) == max(ls):
+                    padded, lens = pad_sequences(cols, pad_value=0)
+                else:
+                    # ragged slot: pad to a multiple of 8 so the jit
+                    # executor compiles a handful of bucket shapes per
+                    # epoch, not one per distinct batch max-length;
+                    # consumers mask padding (id 0) via <slot>.lod
+                    padded, lens = pad_sequences(cols, pad_value=0,
+                                                 multiple_of=8)
+                feed[v.name] = padded.astype(np.int64)
+                feed[v.name + ".lod"] = lens
+            else:
+                feed[v.name] = np.stack(cols).astype(np.float32)
+        return feed
+
+    def _batches(self, records) -> Iterable[Dict[str, np.ndarray]]:
+        buf = []
+        for r in records:
+            buf.append(r)
+            if len(buf) == self.batch_size:
+                yield self._records_to_batch(buf)
+                buf = []
+        if buf:
+            yield self._records_to_batch(buf)
+
+
+class InMemoryDataset(DatasetBase):
+    """data_set.h:101 InMemoryDataset: load -> shuffle -> train."""
+
+    def __init__(self):
+        super().__init__()
+        self._records: List[List[np.ndarray]] = []
+        self._loaded = False
+        self._preload_thread = None
+
+    def load_into_memory(self):
+        self._records = []
+        for pat in self.filelist:
+            for path in sorted(_glob.glob(pat)) or [pat]:
+                self._records.extend(self._iter_file(path))
+        self._loaded = True
+
+    def preload_into_memory(self, thread_num=None):
+        self._preload_thread = threading.Thread(target=self.load_into_memory,
+                                                daemon=True)
+        self._preload_thread.start()
+
+    def wait_preload_done(self):
+        if self._preload_thread is not None:
+            self._preload_thread.join()
+            self._preload_thread = None
+
+    def local_shuffle(self):
+        rng = np.random.RandomState(self.seed)
+        rng.shuffle(self._records)
+        self.seed += 1
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Hash-partition instances across trainers, then shuffle the own
+        shard (GlobalShuffle: every instance lands on exactly one trainer).
+
+        CONTRACT (documented divergence from the reference): every trainer
+        must have loaded the SAME filelist — partitioning keeps the
+        crc32%N==rank slice of the trainer's own memory and does not
+        redistribute records between trainers the way the reference's
+        PS-routed GlobalShuffle (data_set.h:109) does.  With disjoint
+        per-trainer filelists this would silently drop (N-1)/N of the
+        data; use local_shuffle() there instead."""
+        rank, nranks = 0, 1
+        if fleet is not None:
+            try:
+                rank = fleet.worker_index()
+                nranks = fleet.worker_num()
+            except Exception:
+                pass
+        if nranks > 1:
+            keep = []
+            for i, r in enumerate(self._records):
+                key = zlib.crc32(b"|".join(x.tobytes() for x in r))
+                if key % nranks == rank:
+                    keep.append(r)
+            self._records = keep
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._records = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._records)
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: batches parsed lazily per epoch; no in-memory
+    shuffle (reference QueueDataset.local_shuffle raises)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams from files; use InMemoryDataset for "
+            "local_shuffle (reference dataset.py QueueDataset)")
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        raise NotImplementedError(
+            "QueueDataset does not support global_shuffle; use "
+            "InMemoryDataset")
+
+    def _stream_records(self):
+        for pat in self.filelist:
+            for path in sorted(_glob.glob(pat)) or [pat]:
+                yield from self._iter_file(path)
+
+
+class DatasetFactory:
+    """fluid.DatasetFactory().create_dataset("InMemoryDataset")"""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+# ---------------------------------------------------------------------------
+# prefetching trainer loop (MultiTrainer/DeviceWorker analog)
+# ---------------------------------------------------------------------------
+def _batch_queue(batches: Iterable[Dict[str, np.ndarray]], capacity: int):
+    """Producer thread -> (native, else stdlib) blocking queue of pickled
+    batches; returns (pop, join) callables.  A producer exception is
+    captured and re-raised in the consumer — never a hang (stdlib) or a
+    silently truncated epoch (native)."""
+    err: List[BaseException] = []
+
+    def _raise_if_failed():
+        if err:
+            raise RuntimeError(
+                "dataset producer thread failed") from err[0]
+
+    from ..native import BlockingQueue, available
+    if available():
+        q = BlockingQueue(capacity)
+
+        def produce():
+            try:
+                for b in batches:
+                    q.push(pickle.dumps(b, protocol=4))
+            except BaseException as e:  # noqa: BLE001 - reraised in consumer
+                err.append(e)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+
+        def pop():
+            try:
+                data = q.pop()
+            except EOFError:  # closed and drained
+                _raise_if_failed()
+                return None
+            if data is None:
+                _raise_if_failed()
+                return None
+            return pickle.loads(data)
+
+        return pop, t.join
+    import queue as _q
+    q2: "_q.Queue" = _q.Queue(maxsize=capacity)
+    _DONE = object()
+
+    def produce2():
+        try:
+            for b in batches:
+                q2.put(b)
+        except BaseException as e:  # noqa: BLE001 - reraised in consumer
+            err.append(e)
+        finally:
+            q2.put(_DONE)
+
+    t2 = threading.Thread(target=produce2, daemon=True)
+    t2.start()
+
+    def pop2():
+        item = q2.get()
+        if item is _DONE:
+            _raise_if_failed()
+            return None
+        return item
+
+    return pop2, t2.join
+
+
+def run_from_dataset(executor, program, dataset, scope=None,
+                     fetch_list=None, fetch_info=None, print_period=100,
+                     debug=False):
+    """One pass over the dataset through the jitted executor step — the
+    train_from_dataset/infer_from_dataset hot loop (executor.py:1345,
+    multi_trainer.cc RunFromDataset)."""
+    if isinstance(dataset, InMemoryDataset):
+        if not dataset._loaded:
+            raise RuntimeError(
+                "InMemoryDataset: call load_into_memory() before "
+                "train_from_dataset")
+        records = dataset._records
+    elif isinstance(dataset, QueueDataset):
+        records = dataset._stream_records()
+    else:
+        raise TypeError(f"not a dataset: {dataset!r}")
+
+    # drop feed names the program does not declare (.lod helpers)
+    block = program.global_block()
+    pop, join = _batch_queue(dataset._batches(records),
+                             capacity=max(2, 2 * dataset.thread_num))
+    fetch_list = fetch_list or []
+    fetch_names = [f.name if hasattr(f, "name") else str(f)
+                   for f in fetch_list]
+    step = 0
+    last = []
+    while True:
+        batch = pop()
+        if batch is None:
+            break
+        feed = {k: v for k, v in batch.items()
+                if block.has_var(k)}
+        last = executor.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+        step += 1
+        if debug or (fetch_names and step % print_period == 0):
+            info = fetch_info or fetch_names
+            msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                            for n, v in zip(info, last))
+            print(f"[dataset step {step}] {msg}")
+    join()
+    return last

@@ -29,6 +29,17 @@ def _abs_max(x):
     return jnp.maximum(s, 1e-8)
 
 
+def _quant(x, scale, b):
+    """THE quantization grid — single source of truth for round/clip.
+    `scale` may be scalar or broadcastable (channel-wise)."""
+    return jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-8) * b), -b, b)
+
+
+def _quant_dequant(x, scale, b):
+    return (_quant(x, scale, b) * (jnp.maximum(scale, 1e-8) / b)) \
+        .astype(x.dtype)
+
+
 def _ste_grad(ins, attrs, ctx):
     """Straight-through estimator: pass the cotangent through the
     quant-dequant unchanged inside the representable range."""
@@ -45,7 +56,7 @@ def fake_quantize_abs_max(ins, attrs, ctx):
     x = ins["X"]
     b = _bin(attrs)
     scale = _abs_max(x)
-    q = jnp.clip(jnp.round(x / scale * b), -b, b)
+    q = _quant(x, scale, b)
     return {"Out": q, "OutScale": scale.reshape((1,))}
 
 
@@ -59,7 +70,7 @@ def fake_channel_wise_quantize_abs_max(ins, attrs, ctx):
     scale = jnp.maximum(jnp.max(jnp.abs(x), axis=red), 1e-8)
     shape = [1] * x.ndim
     shape[axis] = -1
-    q = jnp.clip(jnp.round(x / scale.reshape(shape) * b), -b, b)
+    q = _quant(x, scale.reshape(shape), b)
     return {"Out": q, "OutScale": scale}
 
 
@@ -93,8 +104,8 @@ def fake_quantize_dequantize_abs_max(ins, attrs, ctx):
     x = ins["X"]
     b = _bin(attrs)
     scale = _abs_max(x)
-    out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
-    return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,))}
+    out = _quant_dequant(x, scale, b)
+    return {"Out": out, "OutScale": scale.reshape((1,))}
 
 
 @register_op("fake_channel_wise_quantize_dequantize_abs_max", inputs=["X"],
@@ -107,9 +118,8 @@ def fake_channel_wise_quantize_dequantize_abs_max(ins, attrs, ctx):
     scale = jnp.maximum(jnp.max(jnp.abs(x), axis=red), 1e-8)
     shape = [1] * x.ndim
     shape[axis] = -1
-    s = scale.reshape(shape)
-    out = jnp.clip(jnp.round(x / s * b), -b, b) * (s / b)
-    return {"Out": out.astype(x.dtype), "OutScale": scale}
+    out = _quant_dequant(x, scale.reshape(shape), b)
+    return {"Out": out, "OutScale": scale}
 
 
 def _moving_average(ins, attrs, x):
@@ -135,10 +145,10 @@ def fake_quantize_moving_average_abs_max(ins, attrs, ctx):
     b = _bin(attrs)
     if attrs.get("is_test", False) or ctx.is_test:
         scale = ins["InScale"].reshape(())
-        q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-8) * b), -b, b)
+        q = _quant(x, scale, b)
         return {"Out": q, "OutScale": scale.reshape((1,))}
     scale, state, accum = _moving_average(ins, attrs, x)
-    q = jnp.clip(jnp.round(x / scale * b), -b, b)
+    q = _quant(x, scale, b)
     return {"Out": q, "OutScale": scale.reshape((1,)),
             "OutState": state.reshape((1,)), "OutAccum": accum.reshape((1,))}
 
@@ -151,12 +161,12 @@ def fake_quantize_dequantize_moving_average_abs_max(ins, attrs, ctx):
     x = ins["X"]
     b = _bin(attrs)
     if attrs.get("is_test", False) or ctx.is_test:
-        scale = jnp.maximum(ins["InScale"].reshape(()), 1e-8)
-        out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
-        return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,))}
+        scale = ins["InScale"].reshape(())
+        out = _quant_dequant(x, scale, b)
+        return {"Out": out, "OutScale": scale.reshape((1,))}
     scale, state, accum = _moving_average(ins, attrs, x)
-    out = jnp.clip(jnp.round(x / scale * b), -b, b) * (scale / b)
-    return {"Out": out.astype(x.dtype), "OutScale": scale.reshape((1,)),
+    out = _quant_dequant(x, scale, b)
+    return {"Out": out, "OutScale": scale.reshape((1,)),
             "OutState": state.reshape((1,)), "OutAccum": accum.reshape((1,))}
 
 

@@ -244,6 +244,44 @@ class Executor:
                 "FLAGS_check_nan_inf: non-finite values in "
                 + ", ".join(bad))
 
+    # -- dataset-driven training (MultiTrainer path, executor.py:1345) ------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        from ..distributed.dataset import run_from_dataset
+        from ..core.program import default_main_program
+        if fetch_handler is not None:
+            raise NotImplementedError(
+                "fetch_handler callbacks are not supported; poll "
+                "fetch_list/print_period instead")
+        program = program if program is not None else default_main_program()
+        if thread:
+            dataset.set_thread(thread)
+        return run_from_dataset(self, program, dataset, scope,
+                                fetch_list, fetch_info, print_period, debug)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Like train_from_dataset but guaranteed side-effect-free on the
+        parameters (reference executor.py:1476 contract): training-role
+        ops (backward/optimizer/lr-sched) are stripped and test mode is
+        applied before running."""
+        from ..core.program import default_main_program, OpRole
+        program = program if program is not None else default_main_program()
+        infer = program.clone(for_test=True)
+        blk = infer.global_block()
+        train_roles = (OpRole.Backward, OpRole.Optimize, OpRole.LRSched,
+                       OpRole.Optimize | OpRole.LRSched)
+        blk.ops = [op for op in blk.ops
+                   if op.attrs.get(OpRole.KEY, OpRole.Forward)
+                   not in train_roles]
+        return self.train_from_dataset(infer, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     @staticmethod
     def _per_op_nan_scan(op, env):
         """Eager-mode per-op output scan under FLAGS_check_nan_inf — names
