@@ -21,4 +21,7 @@ from . import (  # noqa: F401
     rnn,
     vision,
     quantize,
+    detection,
+    ctc_crf,
+    decode,
 )

@@ -1,0 +1,246 @@
+"""CTC and linear-chain CRF ops.
+
+Reference: /root/reference/paddle/fluid/operators/warpctc_op.cc (wraps the
+warp-ctc CUDA/CPU library), ctc_align_op.cc, linear_chain_crf_op.cc (:23
+the forward algorithm comments), crf_decoding_op.cc (Viterbi).
+
+TPU redesign: the reference binds hand-written CUDA (warp-ctc) because
+cuDNN-era frameworks couldn't differentiate through a dynamic-programming
+recursion.  Under JAX the log-semiring recursions are plain `lax.scan`s —
+the CTC/CRF gradients fall out of `jax.vjp` for free (no bespoke backward
+kernels), and padded batches replace LoD with explicit length tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+_NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    # double-where guard: when both args are -inf-like, the untaken branch
+    # must still see finite inputs or jax.vjp poisons the grads with NaN
+    m = jnp.maximum(a, b)
+    ok = m > _NEG / 2
+    m_safe = jnp.where(ok, m, 0.0)
+    a_s = jnp.where(ok, a - m_safe, 0.0)
+    b_s = jnp.where(ok, b - m_safe, 0.0)
+    return jnp.where(ok, m_safe + jnp.log(jnp.exp(a_s) + jnp.exp(b_s)),
+                     _NEG)
+
+
+def _ctc_loss_one(logp, labels, T, L, blank):
+    """CTC negative log-likelihood for one sequence.
+    logp [Tmax, C] log-softmax; labels [Lmax] int; T, L actual lengths."""
+    Lmax = labels.shape[0]
+    S = 2 * Lmax + 1
+    # extended label sequence: blank l1 blank l2 ... blank
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    pos = jnp.arange(S)
+    live = pos < 2 * L + 1
+    # can we skip from s-2? only onto non-blank positions whose label
+    # differs from s-2's label
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (pos % 2 == 1) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(logp[0, ext[0]])
+    alpha0 = alpha0.at[1].set(jnp.where(L > 0, logp[0, ext[1]], _NEG))
+
+    def step(alpha, lp_t):
+        t, lp = lp_t
+        prev1 = jnp.concatenate([jnp.full((1,), _NEG), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]])
+        a = _logsumexp2(alpha, prev1)
+        a = jnp.where(can_skip, _logsumexp2(a, prev2), a)
+        a = a + lp[ext]
+        a = jnp.where(live, a, _NEG)
+        # frozen once past this sequence's length
+        a = jnp.where(t < T, a, alpha)
+        return a, None
+
+    ts = jnp.arange(1, logp.shape[0])
+    alpha, _ = jax.lax.scan(step, alpha0, (ts, logp[1:]))
+    end1 = alpha[2 * L]
+    end2 = jnp.where(L > 0, alpha[2 * L - 1], _NEG)
+    return -_logsumexp2(end1, end2)
+
+
+@register_op("warpctc",
+             inputs=["Logits", "Label!", "LogitsLength?!",
+                     "LabelLength?!"],
+             outputs=["Loss", "WarpCTCGrad?"])
+def warpctc(ins, attrs, ctx):
+    """warpctc_op.cc parity.  Padded layout: Logits [B, Tmax, C] (or
+    [Tmax, B, C] time-major like warp-ctc when LogitsLength is absent is
+    NOT supported — lengths are required on TPU), Label [B, Lmax] padded
+    with 0/ignored beyond LabelLength.  Loss [B, 1]."""
+    logits = ins["Logits"]
+    labels = ins["Label"]
+    lo_len = ins.get("LogitsLength")
+    la_len = ins.get("LabelLength")
+    blank = attrs.get("blank", 0)
+    norm = attrs.get("norm_by_times", False)
+    if logits.ndim != 3:
+        raise ValueError("warpctc expects padded [B, Tmax, C] logits")
+    B, Tmax, C = logits.shape
+    if labels.ndim == 3 and labels.shape[-1] == 1:
+        labels = labels[..., 0]
+    lo = (lo_len.reshape(-1).astype(jnp.int32) if lo_len is not None
+          else jnp.full((B,), Tmax, jnp.int32))
+    la = (la_len.reshape(-1).astype(jnp.int32) if la_len is not None
+          else jnp.full((B,), labels.shape[1], jnp.int32))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = jax.vmap(_ctc_loss_one, in_axes=(0, 0, 0, 0, None))(
+        logp, labels, lo, la, blank)
+    if norm:
+        loss = loss / jnp.maximum(lo.astype(loss.dtype), 1.0)
+    return {"Loss": loss[:, None]}
+
+
+@register_op("ctc_align", inputs=["Input!", "InputLength?!"],
+             outputs=["Output", "OutputLength?"], grad=None)
+def ctc_align(ins, attrs, ctx):
+    """ctc_align_op.cc — merge repeated tokens then drop blanks.
+    Padded [B, T] in, padded [B, T] out (pad value attr) + lengths."""
+    x = ins["Input"]
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    xl = ins.get("InputLength")
+    blank = attrs.get("blank", 0)
+    pad = attrs.get("padding_value", 0)
+    merge = attrs.get("merge_repeated", True)
+    B, T = x.shape
+    lens = (xl.reshape(-1).astype(jnp.int32) if xl is not None
+            else jnp.full((B,), T, jnp.int32))
+
+    def one(row, n):
+        prev = jnp.concatenate([jnp.full((1,), -1, row.dtype), row[:-1]])
+        keep = (row != blank) & (jnp.arange(T) < n)
+        if merge:
+            keep &= row != prev
+        # stable compaction: target position = cumsum of keeps - 1
+        tgt = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        out = jnp.full((T,), pad, row.dtype)
+        out = out.at[jnp.where(keep, tgt, T)].set(row, mode="drop")
+        return out, jnp.sum(keep).astype(jnp.int32)
+
+    out, out_len = jax.vmap(one)(x, lens)
+    return {"Output": out, "OutputLength": out_len[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+def _crf_scores(emission, transition):
+    """Split the reference's [C+2, C] transition layout: row 0 = start,
+    row 1 = end, rows 2.. = pairwise [C, C]."""
+    start, end, trans = transition[0], transition[1], transition[2:]
+    return start, end, trans
+
+
+def _crf_logz_one(emis, start, end, trans, T):
+    C = emis.shape[-1]
+    a0 = start + emis[0]
+
+    def step(alpha, te):
+        t, e = te
+        nxt = jax.nn.logsumexp(alpha[:, None] + trans, axis=0) + e
+        nxt = jnp.where(t < T, nxt, alpha)
+        return nxt, None
+
+    ts = jnp.arange(1, emis.shape[0])
+    alpha, _ = jax.lax.scan(step, a0, (ts, emis[1:]))
+    return jax.nn.logsumexp(alpha + end)
+
+
+def _crf_path_score_one(emis, label, start, end, trans, T):
+    Tmax = emis.shape[0]
+    idx = jnp.arange(Tmax)
+    lbl = label.astype(jnp.int32)
+    em = jnp.where(idx < T, emis[idx, lbl], 0.0).sum()
+    prev = lbl[:-1]
+    tr = jnp.where(idx[1:] < T, trans[prev, lbl[1:]], 0.0).sum()
+    last = lbl[jnp.maximum(T - 1, 0)]
+    return start[lbl[0]] + em + tr + end[last]
+
+
+@register_op("linear_chain_crf",
+             inputs=["Emission", "Transition", "Label!", "Length?!"],
+             outputs=["LogLikelihood", "EmissionExps?", "TransitionExps?",
+                      "Alpha?"])
+def linear_chain_crf(ins, attrs, ctx):
+    """linear_chain_crf_op.cc — log-likelihood of the gold path.
+    Padded layout: Emission [B, Tmax, C], Label [B, Tmax], Length [B].
+    Transition [C+2, C] with start/end rows (reference layout)."""
+    emission = ins["Emission"].astype(jnp.float32)
+    transition = ins["Transition"].astype(jnp.float32)
+    label = ins["Label"]
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    length = ins.get("Length")
+    B, Tmax, C = emission.shape
+    T = (length.reshape(-1).astype(jnp.int32) if length is not None
+         else jnp.full((B,), Tmax, jnp.int32))
+    start, end, trans = _crf_scores(emission, transition)
+    logz = jax.vmap(_crf_logz_one, in_axes=(0, None, None, None, 0))(
+        emission, start, end, trans, T)
+    gold = jax.vmap(_crf_path_score_one,
+                    in_axes=(0, 0, None, None, None, 0))(
+        emission, label, start, end, trans, T)
+    # reference returns negative log-likelihood as "LogLikelihood"
+    return {"LogLikelihood": (logz - gold)[:, None]}
+
+
+@register_op("crf_decoding",
+             inputs=["Emission!", "Transition!", "Label?!", "Length?!"],
+             outputs=["ViterbiPath"], grad=None)
+def crf_decoding(ins, attrs, ctx):
+    """crf_decoding_op.cc — Viterbi decode; with Label given, outputs a
+    0/1 correctness mask per step (reference behaviour)."""
+    emission = ins["Emission"].astype(jnp.float32)
+    transition = ins["Transition"].astype(jnp.float32)
+    label = ins.get("Label")
+    length = ins.get("Length")
+    B, Tmax, C = emission.shape
+    T = (length.reshape(-1).astype(jnp.int32) if length is not None
+         else jnp.full((B,), Tmax, jnp.int32))
+    start, end, trans = _crf_scores(emission, transition)
+
+    def one(emis, Tb):
+        a0 = start + emis[0]
+
+        def fwd(alpha, te):
+            t, e = te
+            cand = alpha[:, None] + trans            # [C, C]
+            best = jnp.max(cand, axis=0) + e
+            arg = jnp.argmax(cand, axis=0).astype(jnp.int32)
+            best = jnp.where(t < Tb, best, alpha)
+            arg = jnp.where(t < Tb, arg, jnp.arange(C, dtype=jnp.int32))
+            return best, arg
+
+        ts = jnp.arange(1, Tmax)
+        alpha, back = jax.lax.scan(fwd, a0, (ts, emis[1:]))
+        last = jnp.argmax(alpha + end).astype(jnp.int32)
+
+        def bwd(tok, bk_t):
+            t, bk = bk_t
+            prev = bk[tok]
+            tok_new = jnp.where(t < Tb, prev, tok)
+            return tok_new, tok
+
+        tok0, path_rev = jax.lax.scan(bwd, last, (ts[::-1], back[::-1]))
+        # path_rev (reversed) = tokens at t=1..Tmax-1; tok0 = token at t=0
+        path = jnp.concatenate([tok0[None], path_rev[::-1]])
+        return jnp.where(jnp.arange(Tmax) < Tb, path, 0)
+
+    path = jax.vmap(one)(emission, T)
+    if label is not None:
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label[..., 0]
+        path = (path == label.astype(path.dtype)).astype(jnp.int64)
+    return {"ViterbiPath": path}

@@ -1,0 +1,184 @@
+"""Beam-search decode ops + py_func.
+
+Reference: /root/reference/paddle/fluid/operators/beam_search_op.cc (one
+step of beam selection over LoD-grouped candidates),
+beam_search_decode_op.cc (walks the step-by-step LoD arrays back into full
+hypotheses), gather_tree_op.cc, py_func_op.cc (:1 host-python op).
+
+TPU redesign: the reference threads beams through LoD levels; here beams
+are a dense [batch, beam] axis.  One `beam_search` op consumes
+[batch*beam, V] scores and emits the top-`beam` continuations per batch
+group (top_k over the flattened beam*V axis — one XLA fusion, no
+host-side candidate lists).  Full-sequence reconstruction is gather_tree
+(a lax.scan walking parent pointers), matching the paddle 2.x
+fluid.layers.gather_tree contract.  py_func lowers to
+jax.pure_callback — the host function runs under jit without breaking the
+traced graph.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+_NEG = -1e30
+
+
+@register_op("beam_search",
+             inputs=["pre_ids!", "pre_scores", "scores", "ids?!"],
+             outputs=["selected_ids", "selected_scores", "parent_idx?"],
+             grad=None)
+def beam_search(ins, attrs, ctx):
+    """One decode step.  pre_ids [B*W, 1], pre_scores [B*W, 1],
+    scores [B*W, V] log-probs for the next token.  Emits the top-W
+    (id, score, parent beam) per batch group.  Finished beams (pre_id ==
+    end_id) are frozen: they re-emit end_id with unchanged score."""
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs.get("end_id", 1))
+    pre_ids = ins["pre_ids"].reshape(-1)
+    pre_scores = ins["pre_scores"].reshape(-1).astype(jnp.float32)
+    scores = ins["scores"].astype(jnp.float32)
+    BW, V = scores.shape
+    B = BW // beam_size
+    finished = pre_ids == end_id
+    # frozen beams contribute exactly one candidate: end_id at the old
+    # score; live beams add log-probs
+    cand = pre_scores[:, None] + jnp.where(finished[:, None], _NEG, scores)
+    keep_end = jnp.where(finished, pre_scores, _NEG)
+    cand = cand.at[:, end_id].max(keep_end)
+    # first step convention: only beam 0 of each group is live (the rest
+    # duplicate it); detect via attr
+    if attrs.get("first_step", False):
+        mask = (jnp.arange(BW) % beam_size) == 0
+        cand = jnp.where(mask[:, None], cand, _NEG)
+    flat = cand.reshape(B, beam_size * V)
+    top_s, top_i = jax.lax.top_k(flat, beam_size)      # [B, W]
+    parent = top_i // V
+    token = top_i % V
+    parent_global = parent + jnp.arange(B)[:, None] * beam_size
+    return {"selected_ids": token.reshape(-1, 1).astype(jnp.int64),
+            "selected_scores": top_s.reshape(-1, 1),
+            "parent_idx": parent_global.reshape(-1).astype(jnp.int64)}
+
+
+@register_op("gather_tree", inputs=["Ids!", "Parents!"],
+             outputs=["Out"], grad=None)
+def gather_tree(ins, attrs, ctx):
+    """gather_tree_op.cc — [T, B, W] step ids + parent beam indices ->
+    full sequences by walking parents backward from the last step."""
+    ids, parents = ins["Ids"], ins["Parents"]
+    T, B, W = ids.shape
+    beams0 = jnp.broadcast_to(jnp.arange(W, dtype=parents.dtype), (B, W))
+
+    def step(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        beam_prev = jnp.take_along_axis(parents[t], beam, axis=1)
+        return beam_prev, tok
+
+    _, toks_rev = jax.lax.scan(step, beams0, jnp.arange(T)[::-1])
+    return {"Out": toks_rev[::-1]}
+
+
+@register_op("beam_search_decode",
+             inputs=["Ids!", "Scores", "ParentIdx!", "SequenceLength?!"],
+             outputs=["SentenceIds", "SentenceScores"], grad=None)
+def beam_search_decode(ins, attrs, ctx):
+    """beam_search_decode_op.cc — final hypotheses: gather_tree the id
+    tree, then trim everything after the first end_id (padded with
+    end_id)."""
+    ids, parents = ins["Ids"], ins["ParentIdx"]
+    scores = ins["Scores"]
+    end_id = int(attrs.get("end_id", 1))
+    out = gather_tree({"Ids": ids, "Parents": parents}, attrs, ctx)["Out"]
+    # trim strictly AFTER the first end_id: a position is dead iff an
+    # end_id appeared at any earlier step
+    c = jnp.cumsum((out == end_id).astype(jnp.int32), axis=0)
+    prev_ended = jnp.concatenate(
+        [jnp.zeros_like(c[:1]), c[:-1]], axis=0) > 0
+    out = jnp.where(prev_ended, end_id, out)
+    final_scores = scores[-1] if scores.ndim == 3 else scores
+    return {"SentenceIds": out, "SentenceScores": final_scores}
+
+
+# ---------------------------------------------------------------------------
+# py_func — host-python op via pure_callback
+# ---------------------------------------------------------------------------
+_PY_FUNCS: List[Callable] = []
+_PY_FUNC_IDS: Dict[int, int] = {}  # id(fn) -> slot (dedup across rebuilds)
+
+
+def register_py_func(fn: Callable) -> int:
+    """Register a host function; returns the id carried in op attrs
+    (py_func_op.cc PyFuncRegistry).  Registering the same function object
+    again returns the same slot, so rebuilding a program keeps its
+    fingerprint (and the executor's jit cache) stable."""
+    key = id(fn)
+    slot = _PY_FUNC_IDS.get(key)
+    if slot is not None and _PY_FUNCS[slot] is fn:
+        return slot
+    _PY_FUNCS.append(fn)
+    _PY_FUNC_IDS[key] = len(_PY_FUNCS) - 1
+    return len(_PY_FUNCS) - 1
+
+
+def _py_func_kernel(ins, attrs, ctx):
+    fn = _PY_FUNCS[int(attrs["func_id"])]
+    xs = ins["X"] or []
+    shapes = attrs["out_shapes"]
+    dtypes = attrs["out_dtypes"]
+    # resolve symbolic batch dims (-1) against the first input's batch
+    batch = xs[0].shape[0] if xs else 1
+    shapes = [[batch if d == -1 else d for d in s] for s in shapes]
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                    for s, d in zip(shapes, dtypes)]
+
+    def host(*arrs):
+        out = fn(*arrs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o, dtype=np.dtype(d))
+                     for o, d in zip(out, dtypes))
+
+    outs = jax.pure_callback(host, result_shape, *xs)
+    if not isinstance(outs, (list, tuple)):
+        outs = (outs,)
+    return {"Out": list(outs)}
+
+
+def _py_func_grad(ins, attrs, ctx):
+    """Paddle py_func backward contract: backward_func receives forward
+    inputs + forward outputs + output grads, minus the positions named in
+    skip_vars_in_backward_input (encoded as skip indices at build time)."""
+    bid = attrs.get("backward_func_id", -1)
+    if bid < 0:
+        return {}
+    fn = _PY_FUNCS[int(bid)]
+    xs = list(ins["X"] or [])
+    outs = list(ins.get("Out") or [])
+    gs = [g for g in (ins.get("Out@GRAD") or [])]
+    skip = set(attrs.get("backward_skip_ins", []))
+    call_args = [a for i, a in enumerate(xs + outs) if i not in skip] + gs
+    shapes = [tuple(x.shape) for x in xs]
+    dtypes = [np.dtype(str(x.dtype)) for x in xs]
+    result_shape = [jax.ShapeDtypeStruct(s, d)
+                    for s, d in zip(shapes, dtypes)]
+
+    def host(*arrs):
+        out = fn(*arrs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o, dtype=d)
+                     for o, d in zip(out, dtypes))
+
+    douts = jax.pure_callback(host, result_shape, *call_args)
+    if not isinstance(douts, (list, tuple)):
+        douts = (douts,)
+    return {"X@GRAD": list(douts)}
+
+
+register_op("py_func", inputs=["X*"], outputs=["Out*"],
+            grad=_py_func_grad)(_py_func_kernel)

@@ -1,0 +1,376 @@
+"""Detection op family — the static-shape TPU redesign of
+/root/reference/paddle/fluid/operators/detection/ (multiclass_nms_op.cc,
+anchor_generator_op.cc, bipartite_match_op.cc, generate_proposals_op.cc,
+yolov3_loss_op.cc).
+
+The reference emits LoD outputs whose row counts depend on the data
+(variable #detections per image).  XLA wants static shapes, so every op
+here returns FIXED-size outputs padded with sentinel rows (label -1 /
+score -1 / zero boxes) plus an explicit per-image count tensor — the same
+contract paddle 2.x adopted with *RoisNum outputs.  Selection loops are
+`lax.fori_loop`s over fixed trip counts so everything stays on-device.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+
+
+def _iou(box, boxes):
+    """IoU of one [4] box vs [M, 4] boxes (xyxy)."""
+    lt = jnp.maximum(box[:2], boxes[:, :2])
+    rb = jnp.minimum(box[2:], boxes[:, 2:])
+    inter = jnp.prod(jnp.maximum(rb - lt, 0), axis=-1)
+    union = _box_area(box) + _box_area(boxes) - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _nms_fixed(boxes, scores, iou_threshold, max_out, score_threshold):
+    """Greedy NMS with a fixed output count: returns (idx [max_out],
+    keep_scores [max_out]) — idx -1 / score -1 on padded rows."""
+    M = boxes.shape[0]
+    neg = jnp.asarray(-1e30, scores.dtype)
+    live = jnp.where(scores > score_threshold, scores, neg)
+
+    def body(i, carry):
+        live, idx, kept = carry
+        j = jnp.argmax(live)
+        ok = live[j] > neg / 2
+        idx = idx.at[i].set(jnp.where(ok, j, -1))
+        kept = kept.at[i].set(jnp.where(ok, live[j], -1.0))
+        iou = _iou(boxes[j], boxes)
+        live = jnp.where((iou >= iou_threshold) | (jnp.arange(M) == j),
+                         neg, live)
+        live = jnp.where(ok, live, jnp.full_like(live, neg))
+        return live, idx, kept
+
+    _, idx, kept = jax.lax.fori_loop(
+        0, max_out, body,
+        (live, jnp.full((max_out,), -1, jnp.int32),
+         jnp.full((max_out,), -1.0, scores.dtype)))
+    return idx, kept
+
+
+@register_op("multiclass_nms", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "Index?", "NmsRoisNum?"], grad=None)
+def multiclass_nms(ins, attrs, ctx):
+    """multiclass_nms_op.cc — per-class NMS then cross-class top-k.
+    BBoxes [N, M, 4], Scores [N, C, M] -> Out [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2; label=-1 rows are padding),
+    NmsRoisNum [N]."""
+    boxes, scores = ins["BBoxes"], ins["Scores"]
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    if keep_top_k < 0:
+        keep_top_k = nms_top_k
+    bg = attrs.get("background_label", 0)
+    N, C, M = scores.shape
+    per_cls = min(nms_top_k, M)
+
+    def one_image(bx, sc):
+        # dead-score the background class BEFORE the per-class NMS loop so
+        # its fixed-trip-count selection (the expensive part) is not run
+        # just to be discarded afterwards
+        if bg >= 0:
+            sc = sc.at[bg].set(-1e30)
+
+        def one_class(c_scores):
+            idx, kept = _nms_fixed(bx, c_scores, nms_thr, per_cls,
+                                   score_thr)
+            sel = jnp.where(idx[:, None] >= 0,
+                            bx[jnp.maximum(idx, 0)], 0.0)
+            return kept, sel, idx
+
+        kept, sel, idx = jax.vmap(one_class)(sc)
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, per_cls))
+        flat_s = kept.reshape(-1)
+        flat_b = sel.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        flat_i = idx.reshape(-1)            # original input-box row
+        k = min(keep_top_k, flat_s.shape[0])
+        top_s, top_i = jax.lax.top_k(flat_s, k)
+        live = top_s >= 0
+        out = jnp.concatenate(
+            [jnp.where(live[:, None], flat_l[top_i][:, None], -1.0)
+             .astype(bx.dtype),
+             top_s[:, None], flat_b[top_i]], axis=1)
+        index = jnp.where(live, flat_i[top_i], -1).astype(jnp.int32)
+        count = jnp.sum(live).astype(jnp.int32)
+        return out, index, count
+
+    out, index, num = jax.vmap(one_image)(boxes, scores)
+    return {"Out": out, "Index": index[..., None], "NmsRoisNum": num}
+
+
+@register_op("anchor_generator", inputs=["Input!"],
+             outputs=["Anchors", "Variances"], grad=None)
+def anchor_generator(ins, attrs, ctx):
+    """anchor_generator_op.cc — grid of anchors for one feature map.
+    Input [N, C, H, W] -> Anchors [H, W, A, 4], Variances same."""
+    x = ins["Input"]
+    H, W = x.shape[2], x.shape[3]
+    sizes = jnp.asarray(attrs.get("anchor_sizes", [64.0, 128.0, 256.0]),
+                        jnp.float32)
+    ratios = jnp.asarray(attrs.get("aspect_ratios", [0.5, 1.0, 2.0]),
+                         jnp.float32)
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    var = jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                      jnp.float32)
+    # all (ratio, size) combos, ratio-major (reference loop order)
+    r = jnp.repeat(ratios, sizes.shape[0])
+    s = jnp.tile(sizes, ratios.shape[0])
+    w = s * jnp.sqrt(1.0 / r)
+    h = s * jnp.sqrt(r)
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * stride[1]
+    CX, CY = jnp.meshgrid(cx, cy)  # [H, W]
+    anchors = jnp.stack([
+        CX[..., None] - 0.5 * w, CY[..., None] - 0.5 * h,
+        CX[..., None] + 0.5 * w, CY[..., None] + 0.5 * h], axis=-1)
+    A = w.shape[0]
+    variances = jnp.broadcast_to(var, (H, W, A, 4))
+    return {"Anchors": anchors, "Variances": variances}
+
+
+@register_op("bipartite_match", inputs=["DistMat"],
+             outputs=["ColToRowMatchIndices", "ColToRowMatchDist"],
+             grad=None)
+def bipartite_match(ins, attrs, ctx):
+    """bipartite_match_op.cc — greedy max bipartite matching on a
+    [R, C] distance matrix: repeatedly take the global max, bind its
+    row+col.  match_type='per_prediction' also binds unmatched cols whose
+    best row exceeds dist_threshold."""
+    d = ins["DistMat"]
+    if d.ndim == 2:
+        d = d[None]
+    R, C = d.shape[1], d.shape[2]
+
+    def one(dm):
+        neg = jnp.asarray(-1e30, dm.dtype)
+
+        def body(i, carry):
+            m, idx, dist = carry
+            flat = jnp.argmax(m)
+            r, c = flat // C, flat % C
+            ok = m[r, c] > 0
+            idx = idx.at[c].set(jnp.where(ok, r, idx[c]).astype(jnp.int32))
+            dist = dist.at[c].set(jnp.where(ok, m[r, c], dist[c]))
+            m = jnp.where(ok, m.at[r, :].set(neg).at[:, c].set(neg), m)
+            return m, idx, dist
+
+        m0 = (dm, jnp.full((C,), -1, jnp.int32),
+              jnp.zeros((C,), dm.dtype))
+        _, idx, dist = jax.lax.fori_loop(0, min(R, C), body, m0)
+        if attrs.get("match_type", "bipartite") == "per_prediction":
+            thr = attrs.get("dist_threshold", 0.5)
+            best_r = jnp.argmax(dm, axis=0)
+            best_d = jnp.max(dm, axis=0)
+            fill = (idx < 0) & (best_d >= thr)
+            idx = jnp.where(fill, best_r.astype(jnp.int32), idx)
+            dist = jnp.where(fill, best_d, dist)
+        return idx, dist
+
+    idx, dist = jax.vmap(one)(d)
+    return {"ColToRowMatchIndices": idx, "ColToRowMatchDist": dist}
+
+
+@register_op("generate_proposals",
+             inputs=["Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"],
+             outputs=["RpnRois", "RpnRoiProbs", "RpnRoisNum?"], grad=None)
+def generate_proposals(ins, attrs, ctx):
+    """generate_proposals_op.cc — RPN: decode anchor deltas, clip to the
+    image, drop small boxes, top-pre_nms_topN by score, NMS to
+    post_nms_topN.  Outputs are per-image fixed [N, post_nms_topN, ...]
+    with RpnRoisNum giving the live count."""
+    scores = ins["Scores"]          # [N, A, H, W]
+    deltas = ins["BboxDeltas"]      # [N, A*4, H, W]
+    im_info = ins["ImInfo"]         # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"].reshape(-1, 4)      # [H*W*A, 4]
+    variances = ins["Variances"].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = attrs.get("nms_thresh", 0.7)
+    min_size = attrs.get("min_size", 0.1)
+    N, A = scores.shape[0], scores.shape[1]
+    HW = scores.shape[2] * scores.shape[3]
+    K = A * HW
+
+    def one(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(-1)          # [H,W,A] -> flat
+        d = dl.reshape(A, 4, *dl.shape[1:]).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        # decode (box_coder decode_center_size semantics)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + 0.5 * aw
+        acy = anchors[:, 1] + 0.5 * ah
+        cx = variances[:, 0] * d[:, 0] * aw + acx
+        cy = variances[:, 1] * d[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(variances[:, 2] * d[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(variances[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                           cx + 0.5 * w - 1, cy + 0.5 * h - 1], axis=1)
+        # clip to image
+        boxes = jnp.clip(boxes,
+                         jnp.zeros((4,), boxes.dtype),
+                         jnp.asarray([info[1] - 1, info[0] - 1,
+                                      info[1] - 1, info[0] - 1],
+                                     boxes.dtype))
+        # filter small
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size * info[2]) &
+                (boxes[:, 3] - boxes[:, 1] + 1 >= min_size * info[2]))
+        s = jnp.where(keep, s, -1e30)
+        k = min(pre_n, K)
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_b = boxes[top_i]
+        idx, kept = _nms_fixed(top_b, top_s, nms_thr, post_n, -1e29)
+        rois = jnp.where(idx[:, None] >= 0, top_b[jnp.maximum(idx, 0)],
+                         0.0)
+        probs = jnp.maximum(kept, 0.0)
+        return rois, probs, jnp.sum(idx >= 0).astype(jnp.int32)
+
+    rois, probs, num = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None],
+            "RpnRoisNum": num}
+
+
+@register_op("yolov3_loss",
+             inputs=["X", "GTBox!", "GTLabel!", "GTScore?!"],
+             outputs=["Loss", "ObjectnessMask?", "GTMatchMask?"])
+def yolov3_loss(ins, attrs, ctx):
+    """yolov3_loss_op.cc — per-cell anchor loss: coordinate SSE (x,y via
+    sigmoid-BCE, w,h via L1), objectness BCE with ignore threshold, and
+    per-class BCE.  GT boxes are padded rows of zeros (x2<=x1 -> dead)."""
+    x = ins["X"]                    # [N, A*(5+C), H, W]
+    gtbox = ins["GTBox"]            # [N, B, 4] (cx, cy, w, h; 0..1)
+    gtlabel = ins["GTLabel"]        # [N, B]
+    anchors = attrs.get("anchors", [])
+    mask = attrs.get("anchor_mask", list(range(len(anchors) // 2)))
+    class_num = int(attrs["class_num"])
+    ignore_thresh = attrs.get("ignore_thresh", 0.7)
+    downsample = attrs.get("downsample_ratio", 32)
+    N, _, H, W = x.shape
+    A = len(mask)
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)  # [total, 2]
+    anc_m = anc[jnp.asarray(mask)]                           # [A, 2]
+    in_h, in_w = H * downsample, W * downsample
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    px, py = x[:, :, 0], x[:, :, 1]
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    gx = gtbox[..., 0] * W                      # [N, B] in grid units
+    gy = gtbox[..., 1] * H
+    gw = gtbox[..., 2] * in_w
+    gh = gtbox[..., 3] * in_h
+    valid = (gtbox[..., 2] > 0) & (gtbox[..., 3] > 0)
+    gi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+
+    # best anchor (over ALL anchors) per gt by shape IoU
+    inter = jnp.minimum(gw[..., None], anc[:, 0]) * \
+        jnp.minimum(gh[..., None], anc[:, 1])
+    union = gw[..., None] * gh[..., None] + anc[:, 0] * anc[:, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+    # position of best anchor inside this level's mask (-1 if absent)
+    mask_arr = jnp.asarray(mask)
+    in_level = (best[..., None] == mask_arr).astype(jnp.int32)
+    level_a = jnp.argmax(in_level, axis=-1)
+    matched = valid & (in_level.sum(-1) > 0)
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    B = gtbox.shape[1]
+    bidx = jnp.arange(N)[:, None].repeat(B, 1)
+    scale = 2.0 - gtbox[..., 2] * gtbox[..., 3]  # box size weighting
+    # mixup sample weights (yolov3_loss_op.cc GTScore input)
+    gtscore = ins.get("GTScore")
+    if gtscore is not None:
+        scale = scale * gtscore.reshape(scale.shape).astype(x.dtype)
+
+    tx = gx - gi
+    ty = gy - gj
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(anc_m[level_a, 0], 1e-10),
+                             1e-10))
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(anc_m[level_a, 1], 1e-10),
+                             1e-10))
+    sel = (bidx, level_a, gj, gi)
+    m = matched.astype(x.dtype) * scale
+    loss_xy = (bce(px[sel], tx) + bce(py[sel], ty)) * m
+    loss_wh = (jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)) * m
+
+    # objectness: positive at matched cells; negatives everywhere the
+    # predicted box does not overlap any gt above ignore_thresh
+    obj_target = jnp.zeros((N, A, H, W), x.dtype)
+    pos_w = matched.astype(x.dtype)
+    if gtscore is not None:
+        pos_w = pos_w * gtscore.reshape(pos_w.shape).astype(x.dtype)
+    obj_target = obj_target.at[sel].max(pos_w)
+    # predicted boxes for ignore-region computation
+    cgx = (jax.nn.sigmoid(px) +
+           jnp.arange(W, dtype=x.dtype)) / W            # [N,A,H,W]
+    cgy = (jax.nn.sigmoid(py) +
+           jnp.arange(H, dtype=x.dtype)[:, None]) / H
+    cw = jnp.exp(jnp.clip(pw, -10, 10)) * anc_m[:, 0][None, :, None, None] \
+        / in_w
+    chh = jnp.exp(jnp.clip(ph, -10, 10)) * anc_m[:, 1][None, :, None, None]\
+        / in_h
+
+    def pred_gt_iou(cgx, cgy, cw, chh, gt, gtv):
+        # centers/sizes in 0..1; gt [B,4]
+        px1, py1 = cgx - cw / 2, cgy - chh / 2
+        px2, py2 = cgx + cw / 2, cgy + chh / 2
+        gx1 = gt[:, 0] - gt[:, 2] / 2
+        gy1 = gt[:, 1] - gt[:, 3] / 2
+        gx2 = gt[:, 0] + gt[:, 2] / 2
+        gy2 = gt[:, 1] + gt[:, 3] / 2
+        ix = jnp.maximum(
+            jnp.minimum(px2[..., None], gx2) -
+            jnp.maximum(px1[..., None], gx1), 0)
+        iy = jnp.maximum(
+            jnp.minimum(py2[..., None], gy2) -
+            jnp.maximum(py1[..., None], gy1), 0)
+        inter = ix * iy
+        union = (px2 - px1) * (py2 - py1)
+        union = union[..., None] + gt[:, 2] * gt[:, 3] - inter
+        iou = inter / jnp.maximum(union, 1e-10)
+        return jnp.max(jnp.where(gtv, iou, 0.0), axis=-1)
+
+    best_iou = jax.vmap(pred_gt_iou)(cgx, cgy, cw, chh, gtbox, valid)
+    noobj = (best_iou < ignore_thresh) & (obj_target < 0.5)
+    loss_obj = bce(pobj, obj_target) * \
+        (obj_target + noobj.astype(x.dtype))
+
+    cls_t = jax.nn.one_hot(jnp.clip(gtlabel, 0, class_num - 1), class_num,
+                           dtype=x.dtype)
+    if attrs.get("use_label_smooth", False):
+        # yolov3_loss_op.h label_pos/label_neg smoothing
+        delta = min(1.0 / class_num, 1.0 / 40.0)
+        cls_t = cls_t * (1.0 - delta) + (1.0 - cls_t) * delta
+    pc = pcls[bidx, level_a, :, gj, gi]     # [N, B, C]
+    cls_w = matched.astype(x.dtype)
+    if gtscore is not None:
+        cls_w = cls_w * gtscore.reshape(cls_w.shape).astype(x.dtype)
+    loss_cls = jnp.sum(bce(pc, cls_t), -1) * cls_w
+
+    loss = (loss_xy.sum(-1) + loss_wh.sum(-1) + loss_cls.sum(-1)
+            + loss_obj.sum((1, 2, 3)))
+    return {"Loss": loss,
+            "ObjectnessMask": obj_target,
+            "GTMatchMask": matched.astype(jnp.int32)}

@@ -1303,6 +1303,193 @@ def batch_norm_stats(*a, **kw):
     raise NotImplementedError
 
 
+def gather_tree(ids, parents):
+    """fluid.layers.gather_tree (gather_tree_op.cc)."""
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference(ids.dtype)
+    helper.append_op("gather_tree", {"Ids": ids, "Parents": parents},
+                     {"Out": out}, {})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """fluid.layers.warpctc (warpctc_op.cc) — padded [B, Tmax, C] logits +
+    length tensors (the TPU replacement for LoD inputs)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference("float32")
+    ins = {"Logits": input, "Label": label}
+    if input_length is not None:
+        ins["LogitsLength"] = input_length
+    if label_length is not None:
+        ins["LabelLength"] = label_length
+    helper.append_op("warpctc", ins, {"Loss": loss},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None):
+    """fluid.layers.ctc_greedy_decoder: argmax per step then ctc_align."""
+    helper = LayerHelper("ctc_greedy_decoder")
+    am = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int64")
+    ins = {"Input": am}
+    if input_length is not None:
+        ins["InputLength"] = input_length
+    helper.append_op("ctc_align", ins,
+                     {"Output": out, "OutputLength": out_len},
+                     {"blank": blank, "merge_repeated": True})
+    if input_length is not None:
+        return out, out_len
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """fluid.layers.linear_chain_crf (linear_chain_crf_op.cc); creates the
+    [C+2, C] transition parameter (start/end rows + pairwise)."""
+    helper = LayerHelper("linear_chain_crf")
+    size = input.shape[-1]
+    transition = helper.create_parameter(param_attr, [size + 2, size],
+                                         "float32")
+    ll = helper.create_variable_for_type_inference("float32")
+    ins = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        ins["Length"] = length
+    helper.append_op("linear_chain_crf", ins, {"LogLikelihood": ll}, {})
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """fluid.layers.crf_decoding — pass `transition` (the parameter created
+    by linear_chain_crf) or a param_attr naming it."""
+    helper = LayerHelper("crf_decoding")
+    if transition is None:
+        size = input.shape[-1]
+        transition = helper.create_parameter(param_attr, [size + 2, size],
+                                             "float32")
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": input, "Transition": transition}
+    if label is not None:
+        ins["Label"] = label
+    if length is not None:
+        ins["Length"] = length
+    helper.append_op("crf_decoding", ins, {"ViterbiPath": path}, {})
+    return path
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, return_index=False, name=None):
+    """fluid.layers.multiclass_nms — fixed-shape output [N, keep_top_k, 6]
+    with label -1 padding + NmsRoisNum counts."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference("int32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out, "Index": index, "NmsRoisNum": num},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "background_label": background_label})
+    if return_index:
+        return out, index
+    return out
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=None, stride=None, offset=0.5, name=None):
+    """fluid.layers.anchor_generator (anchor_generator_op.cc)."""
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "anchor_generator", {"Input": input},
+        {"Anchors": anchors, "Variances": variances},
+        {"anchor_sizes": list(anchor_sizes or [64.0, 128.0, 256.0]),
+         "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+         "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+         "stride": list(stride or [16.0, 16.0]), "offset": offset})
+    return anchors, variances
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op("bipartite_match", {"DistMat": dist_matrix},
+                     {"ColToRowMatchIndices": idx,
+                      "ColToRowMatchDist": dist},
+                     {"match_type": match_type or "bipartite",
+                      "dist_threshold": dist_threshold or 0.5})
+    return idx, dist
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": bbox_deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"RpnRois": rois, "RpnRoiProbs": probs, "RpnRoisNum": num},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size})
+    if return_rois_num:
+        return rois, probs, num
+    return rois, probs
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=False, name=None):
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x, "GTBox": gt_box, "GTLabel": gt_label}
+    if gt_score is not None:
+        ins["GTScore"] = gt_score
+    helper.append_op("yolov3_loss", ins, {"Loss": loss},
+                     {"anchors": list(anchors),
+                      "anchor_mask": list(anchor_mask),
+                      "class_num": class_num,
+                      "ignore_thresh": ignore_thresh,
+                      "downsample_ratio": downsample_ratio,
+                      "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """fluid.layers.py_func (py_func_op.cc) — run a host-python function as
+    an op; lowers to jax.pure_callback so it composes with jit.  The
+    backward_func receives (forward inputs + forward outputs + out grads)
+    minus skip_vars_in_backward_input, matching the reference contract."""
+    from ..ops.kernels.decode import register_py_func
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    attrs = {"func_id": register_py_func(func),
+             "out_shapes": [list(o.shape) for o in outs],
+             "out_dtypes": [o.dtype or "float32" for o in outs]}
+    if backward_func is not None:
+        attrs["backward_func_id"] = register_py_func(backward_func)
+        skip_names = {v.name if hasattr(v, "name") else str(v)
+                      for v in (skip_vars_in_backward_input or [])}
+        ordered = [v.name for v in list(xs) + list(outs)]
+        attrs["backward_skip_ins"] = [i for i, n in enumerate(ordered)
+                                      if n in skip_names]
+    helper.append_op("py_func", {"X": list(xs)}, {"Out": list(outs)},
+                     attrs)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # control flow (fluid.layers.control_flow parity; see static/control_flow.py)
 # ---------------------------------------------------------------------------
@@ -1311,4 +1498,8 @@ from .control_flow import (  # noqa: E402,F401
     array_write, array_read, array_length, create_array)
 
 __all__ += ["While", "cond", "case", "switch_case", "Switch", "StaticRNN",
-            "array_write", "array_read", "array_length", "create_array"]
+            "array_write", "array_read", "array_length", "create_array",
+            "gather_tree", "warpctc", "ctc_greedy_decoder",
+            "linear_chain_crf", "crf_decoding", "multiclass_nms",
+            "anchor_generator", "bipartite_match", "generate_proposals",
+            "yolov3_loss", "py_func"]

@@ -1,0 +1,421 @@
+"""Op-coverage tail: detection family, CTC, CRF, beam decode, py_func
+(reference operators/detection/, warpctc_op.cc, linear_chain_crf_op.cc,
+beam_search_op.cc, py_func_op.cc).  DP recursions are checked against
+brute-force path enumeration on tiny cases."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _run(op, ins, attrs):
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import run_kernel, OpContext
+
+    def conv(v):
+        if v is None:
+            return None
+        if isinstance(v, list):
+            return [jnp.asarray(x) for x in v]
+        return jnp.asarray(v)
+
+    return run_kernel(op, {k: conv(v) for k, v in ins.items()}, attrs,
+                      OpContext())
+
+
+# ---------------------------------------------------------------------------
+# detection
+# ---------------------------------------------------------------------------
+def test_multiclass_nms_suppresses_and_counts():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30], [50, 50, 60, 60]]], np.float32)
+    # class 0 = background; class 1 scores
+    scores = np.zeros((1, 2, 4), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.01]  # box1 overlaps box0; box3 low
+    out = _run("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+               {"score_threshold": 0.05, "nms_threshold": 0.5,
+                "nms_top_k": 4, "keep_top_k": 4, "background_label": 0})
+    res, num = np.asarray(out["Out"])[0], int(out["NmsRoisNum"][0])
+    assert num == 2  # overlapping box suppressed, low score dropped
+    kept = res[res[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(kept[0, 1], 0.9)
+    np.testing.assert_allclose(kept[0, 2:], [0, 0, 10, 10])
+    np.testing.assert_allclose(kept[1, 2:], [20, 20, 30, 30])
+
+
+def test_anchor_generator_grid():
+    x = np.zeros((1, 8, 2, 3), np.float32)
+    out = _run("anchor_generator", {"Input": x},
+               {"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                "stride": [16.0, 16.0], "offset": 0.5})
+    a = np.asarray(out["Anchors"])
+    assert a.shape == (2, 3, 1, 4)
+    # cell (0,0): center (8, 8), size 32 -> [-8, -8, 24, 24]
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+    # one stride right
+    np.testing.assert_allclose(a[0, 1, 0], [8, -8, 40, 24], atol=1e-5)
+    assert np.asarray(out["Variances"]).shape == (2, 3, 1, 4)
+
+
+def test_bipartite_match_greedy():
+    d = np.array([[0.9, 0.2, 0.1],
+                  [0.8, 0.7, 0.3]], np.float32)  # 2 rows, 3 cols
+    out = _run("bipartite_match", {"DistMat": d}, {})
+    idx = np.asarray(out["ColToRowMatchIndices"])[0]
+    dist = np.asarray(out["ColToRowMatchDist"])[0]
+    # greedy: (0,0)=0.9 binds row0/col0; then (1,1)=0.7
+    assert idx.tolist() == [0, 1, -1]
+    np.testing.assert_allclose(dist[:2], [0.9, 0.7])
+    out2 = _run("bipartite_match", {"DistMat": d},
+                {"match_type": "per_prediction", "dist_threshold": 0.25})
+    idx2 = np.asarray(out2["ColToRowMatchIndices"])[0]
+    assert idx2.tolist() == [0, 1, 1]  # col2 filled by best row >= thr
+
+
+def test_generate_proposals_shapes_and_clip():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng.rand(N, A * 4, H, W).astype(np.float32) - 0.5) * 0.2
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = _run("anchor_generator",
+                   {"Input": np.zeros((N, 1, H, W), np.float32)},
+                   {"anchor_sizes": [16.0, 32.0, 48.0],
+                    "aspect_ratios": [1.0], "stride": [16.0, 16.0]})
+    out = _run("generate_proposals",
+               {"Scores": scores, "BboxDeltas": deltas, "ImInfo": im_info,
+                "Anchors": np.asarray(anchors["Anchors"]),
+                "Variances": np.asarray(anchors["Variances"])},
+               {"pre_nms_topN": 32, "post_nms_topN": 8,
+                "nms_thresh": 0.7, "min_size": 1.0})
+    rois = np.asarray(out["RpnRois"])
+    n = int(out["RpnRoisNum"][0])
+    assert rois.shape == (1, 8, 4)
+    assert 0 < n <= 8
+    live = rois[0, :n]
+    assert (live >= 0).all() and (live <= 63).all()
+    assert (live[:, 2] >= live[:, 0]).all()
+
+
+def test_yolov3_loss_prefers_matching_predictions():
+    rng = np.random.RandomState(0)
+    N, C, H, W = 1, 2, 4, 4
+    anchors = [10, 14, 23, 27, 37, 58]
+    mask = [0, 1, 2]
+    A = 3
+    gt = np.zeros((N, 2, 4), np.float32)
+    gt[0, 0] = [0.4, 0.4, 0.2, 0.2]  # one valid box
+    lbl = np.zeros((N, 2), np.int64)
+    x_rand = rng.randn(N, A * (5 + C), H, W).astype(np.float32)
+    out_r = _run("yolov3_loss", {"X": x_rand, "GTBox": gt, "GTLabel": lbl,
+                                 "GTScore": None},
+                 {"anchors": anchors, "anchor_mask": mask, "class_num": C,
+                  "ignore_thresh": 0.7, "downsample_ratio": 8})
+    l_rand = float(np.asarray(out_r["Loss"])[0])
+    assert np.isfinite(l_rand) and l_rand > 0
+    # gradient flows to X (auto-vjp)
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(xv):
+        return _run("yolov3_loss",
+                    {"X": xv, "GTBox": jnp.asarray(gt),
+                     "GTLabel": jnp.asarray(lbl), "GTScore": None},
+                    {"anchors": anchors, "anchor_mask": mask,
+                     "class_num": C, "ignore_thresh": 0.7,
+                     "downsample_ratio": 8})["Loss"].sum()
+
+    g = jax.grad(loss_fn)(jnp.asarray(x_rand))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+    # a few gradient steps reduce the loss
+    xv = jnp.asarray(x_rand)
+    for _ in range(25):
+        xv = xv - 0.5 * jax.grad(loss_fn)(xv)
+    assert float(loss_fn(xv)) < l_rand * 0.5
+
+
+# ---------------------------------------------------------------------------
+# CTC vs brute force
+# ---------------------------------------------------------------------------
+def _ctc_brute(logits, label, blank=0):
+    """Sum prob over all T-length paths collapsing to `label`."""
+    T, C = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: merge repeats then drop blanks
+        col, prev = [], -1
+        for s in path:
+            if s != prev and s != blank:
+                col.append(s)
+            prev = s
+        if col == list(label):
+            total += np.prod([p[t, s] for t, s in enumerate(path)])
+    return -np.log(total)
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    T, C = 4, 3
+    logits = rng.randn(1, T, C).astype(np.float32)
+    label = np.array([[1, 2]], np.int64)
+    out = _run("warpctc", {"Logits": logits, "Label": label,
+                           "LogitsLength": np.array([T], np.int64),
+                           "LabelLength": np.array([2], np.int64)},
+               {"blank": 0})
+    ref = _ctc_brute(logits[0], [1, 2])
+    np.testing.assert_allclose(float(out["Loss"][0, 0]), ref, rtol=1e-4)
+
+
+def test_warpctc_variable_lengths_and_grad():
+    rng = np.random.RandomState(1)
+    B, T, C = 3, 5, 4
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1, 2, 3], [2, 2, 0], [3, 0, 0]], np.int64)
+    llen = np.array([3, 2, 1], np.int64)
+    tlen = np.array([5, 4, 3], np.int64)
+    out = _run("warpctc", {"Logits": logits, "Label": labels,
+                           "LogitsLength": tlen, "LabelLength": llen},
+               {"blank": 0})
+    loss = np.asarray(out["Loss"])
+    assert loss.shape == (B, 1) and np.isfinite(loss).all()
+    for b in range(B):
+        ref = _ctc_brute(logits[b, :tlen[b]], list(labels[b, :llen[b]]))
+        np.testing.assert_allclose(loss[b, 0], ref, rtol=1e-4)
+    # end-to-end grad through the static layer
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, T, C])
+        x.stop_gradient = False
+        lab = layers.data("lab", [-1, 3], dtype="int64")
+        tl = layers.data("tl", [-1], dtype="int64")
+        ll = layers.data("ll", [-1], dtype="int64")
+        lv = layers.mean(layers.warpctc(x, lab, input_length=tl,
+                                        label_length=ll))
+        static.append_backward(lv)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        (g,) = exe.run(main, feed={"x": logits, "lab": labels,
+                                   "tl": tlen, "ll": llen},
+                       fetch_list=[main._grad_map["x"]])
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3]], np.int64)
+    out = _run("ctc_align", {"Input": x, "InputLength": None}, {"blank": 0})
+    o = np.asarray(out["Output"])[0]
+    n = int(out["OutputLength"][0, 0])
+    assert n == 3
+    assert o[:3].tolist() == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# CRF vs brute force
+# ---------------------------------------------------------------------------
+def _crf_brute(emis, trans_full, T):
+    C = emis.shape[-1]
+    start, end, trans = trans_full[0], trans_full[1], trans_full[2:]
+    scores = {}
+    for path in itertools.product(range(C), repeat=T):
+        s = start[path[0]] + emis[0, path[0]]
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+        s += end[path[-1]]
+        scores[path] = s
+    arr = np.array(list(scores.values()))
+    logz = np.log(np.exp(arr - arr.max()).sum()) + arr.max()
+    best = max(scores, key=scores.get)
+    return logz, scores, best
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, C = 2, 3, 3
+    emis = rng.randn(B, T, C).astype(np.float32)
+    trans = rng.randn(C + 2, C).astype(np.float32)
+    label = np.array([[0, 1, 2], [2, 2, 0]], np.int64)
+    length = np.array([3, 2], np.int64)
+    out = _run("linear_chain_crf",
+               {"Emission": emis, "Transition": trans, "Label": label,
+                "Length": length}, {})
+    nll = np.asarray(out["LogLikelihood"])
+    for b in range(B):
+        Tb = length[b]
+        logz, scores, _ = _crf_brute(emis[b], trans, Tb)
+        gold = scores[tuple(label[b, :Tb])]
+        np.testing.assert_allclose(nll[b, 0], logz - gold, rtol=1e-4)
+
+
+def test_crf_decoding_viterbi():
+    rng = np.random.RandomState(1)
+    T, C = 4, 3
+    emis = rng.randn(1, T, C).astype(np.float32)
+    trans = rng.randn(C + 2, C).astype(np.float32)
+    out = _run("crf_decoding", {"Emission": emis, "Transition": trans,
+                                "Label": None, "Length": None}, {})
+    path = np.asarray(out["ViterbiPath"])[0]
+    _, _, best = _crf_brute(emis[0], trans, T)
+    assert path.tolist() == list(best)
+
+
+def test_crf_layers_end_to_end():
+    """linear_chain_crf + crf_decoding as layers: NLL decreases, decode
+    recovers the training labels on a fixed batch."""
+    rng = np.random.RandomState(0)
+    B, T, C = 4, 5, 3
+    emis = rng.randn(B, T, C).astype(np.float32)
+    label = emis.argmax(-1).astype(np.int64)  # learnable
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, T, C])
+        y = layers.data("y", [-1, T], dtype="int64")
+        nll = layers.linear_chain_crf(
+            x, y, param_attr=static.ParamAttr(name="crf_T"))
+        loss = layers.mean(nll)
+        static.SGD(learning_rate=0.2).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        l0 = None
+        for _ in range(60):
+            (lv,) = exe.run(main, feed={"x": emis, "y": label},
+                            fetch_list=[loss])
+            l0 = float(lv) if l0 is None else l0
+        assert float(lv) < l0
+        # decode with the learned transition
+        dec_main = static.Program()
+        with static.program_guard(dec_main, static.Program()):
+            x2 = layers.data("x", [-1, T, C])
+            path = layers.crf_decoding(
+                x2, param_attr=static.ParamAttr(name="crf_T"))
+        (p,) = exe.run(dec_main, feed={"x": emis}, fetch_list=[path])
+    assert (np.asarray(p) == label).mean() > 0.6
+
+
+# ---------------------------------------------------------------------------
+# beam search / decode / py_func
+# ---------------------------------------------------------------------------
+def test_beam_search_step_and_gather_tree():
+    B, W, V = 1, 2, 4
+    pre_ids = np.array([[2], [3]], np.int64)           # no end yet
+    pre_scores = np.array([[-0.5], [-1.0]], np.float32)
+    step_logp = np.log(np.array(
+        [[0.1, 0.1, 0.6, 0.2], [0.25, 0.25, 0.25, 0.25]], np.float32))
+    out = _run("beam_search",
+               {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                "scores": step_logp, "ids": None},
+               {"beam_size": W, "end_id": 0})
+    ids = np.asarray(out["selected_ids"]).ravel()
+    par = np.asarray(out["parent_idx"]).ravel()
+    sc = np.asarray(out["selected_scores"]).ravel()
+    # best: beam0 token2 (-0.5+log0.6); second: beam0 token3 or beam1 ...
+    assert ids[0] == 2 and par[0] == 0
+    np.testing.assert_allclose(sc[0], -0.5 + np.log(0.6), rtol=1e-5)
+    assert sc[0] >= sc[1]
+
+    # gather_tree: [T, B, W]
+    step_ids = np.array([[[5, 6]], [[7, 8]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+    gt = _run("gather_tree", {"Ids": step_ids, "Parents": parents}, {})
+    o = np.asarray(gt["Out"])
+    # beam0 at t=1 came from parent 1 -> path [6, 7]; beam1 from 0 -> [5, 8]
+    assert o[:, 0, 0].tolist() == [6, 7]
+    assert o[:, 0, 1].tolist() == [5, 8]
+
+
+def test_py_func_forward_and_backward():
+    def forward(a):
+        return a * 2.0
+
+    def backward(a, dy):
+        return dy * 2.0
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 3])
+        x.stop_gradient = False
+        out = main.global_block().create_var(name="pyout", shape=[-1, 3],
+                                             dtype="float32")
+        layers.py_func(forward, x, out, backward_func=backward,
+                       skip_vars_in_backward_input=[out])
+        loss = layers.mean(out)
+        static.append_backward(loss)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+        o, g = exe.run(main, feed={"x": xv},
+                       fetch_list=["pyout", main._grad_map["x"]])
+    np.testing.assert_allclose(o, xv * 2)
+    np.testing.assert_allclose(g, np.full((2, 3), 2.0 / 6))
+
+
+def test_multiclass_nms_index_output():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 1] = [0.3, 0.9]
+    out = _run("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+               {"score_threshold": 0.05, "nms_threshold": 0.5,
+                "nms_top_k": 2, "keep_top_k": 2, "background_label": 0})
+    idx = np.asarray(out["Index"])[0, :, 0]
+    # best detection is input box row 1, second is row 0
+    assert idx.tolist() == [1, 0]
+
+
+def test_beam_search_decode_trims_after_first_end():
+    # one beam emits [5, END, 7]: token after the first END must be erased
+    ids = np.array([[[5]], [[0]], [[7]]], np.int64)
+    parents = np.zeros((3, 1, 1), np.int64)
+    out = _run("beam_search_decode",
+               {"Ids": ids, "ParentIdx": parents,
+                "Scores": np.zeros((3, 1, 1), np.float32),
+                "SequenceLength": None},
+               {"end_id": 0})
+    seq = np.asarray(out["SentenceIds"])[:, 0, 0]
+    assert seq.tolist() == [5, 0, 0]
+
+
+def test_py_func_backward_receives_outputs_and_skips():
+    seen = {}
+
+    def forward(a):
+        return a * a
+
+    def backward(a, y, dy):   # gets input a AND output y
+        seen["shapes"] = (a.shape, y.shape, dy.shape)
+        return dy * 2.0 * a
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        x.stop_gradient = False
+        out = main.global_block().create_var(name="sq", shape=[-1, 2],
+                                             dtype="float32")
+        layers.py_func(forward, x, out, backward_func=backward)
+        loss = layers.reduce_sum(out)
+        static.append_backward(loss)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        xv = np.array([[1.0, 2.0]], np.float32)
+        (g,) = exe.run(main, feed={"x": xv},
+                       fetch_list=[main._grad_map["x"]])
+    np.testing.assert_allclose(g, 2 * xv)
+    assert seen["shapes"] == ((1, 2), (1, 2), (1, 2))
+
+
+def test_register_py_func_dedups():
+    from paddle_tpu.ops.kernels.decode import register_py_func
+
+    def f(a):
+        return a
+
+    assert register_py_func(f) == register_py_func(f)
