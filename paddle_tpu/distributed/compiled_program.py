@@ -73,6 +73,11 @@ class BuildStrategy:
         # dim 1) over an "sp" mesh axis of this size; ring_attention ops
         # with ring_id=1 ride it.  1 = off.
         self.sequence_parallel_degree = 1
+        # fetch semantics across dp replicas: "reduce" (pmean floats /
+        # pmax ints — what a training loop wants for loss metrics) or
+        # "concat" (reference ParallelExecutor semantics: per-device
+        # fetches concatenated along dim 0, scalars stacked to [ndev])
+        self.fetch_aggregation = "reduce"
 
 
 class ExecutionStrategy:
@@ -239,7 +244,8 @@ class CompiledProgram:
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
                                 for n, v in feed_vals.items()))
         key = (program.fingerprint(), feed_sig, tuple(fetch_names),
-               tuple(state_names), n_dev)
+               tuple(state_names), n_dev,
+               getattr(self._build_strategy, "fetch_aggregation", "reduce"))
         fn = self._cache.get(key)
         if fn is None:
             fn = self._compile(program, state_names, sorted(feed_vals),
@@ -266,6 +272,12 @@ class CompiledProgram:
         tracer = BlockTracer(block)
         axes = tuple(mesh.axis_names)
         has_sp = "sp" in axes
+        fetch_aggregation = getattr(self._build_strategy,
+                                    "fetch_aggregation", "reduce")
+        if fetch_aggregation not in ("reduce", "concat"):
+            raise ValueError(
+                f"BuildStrategy.fetch_aggregation must be 'reduce' or "
+                f"'concat', got {fetch_aggregation!r}")
 
         def step(state, feed, seed):
             # decorrelate RNG across replicas (the reference gives each
@@ -292,10 +304,27 @@ class CompiledProgram:
             fetches = []
             for n in fetch_names:
                 v = env[n]
-                # fetch semantics: average across replicas for floats (the
-                # reference concatenates per-device fetches then users mean
-                # them; mean is what every training loop does with loss)
-                if jnp.issubdtype(v.dtype, jnp.inexact):
+                if fetch_aggregation == "concat":
+                    # reference ParallelExecutor semantics: per-device rows
+                    # concatenated along dim 0 (scalars stack to [ndev]).
+                    if has_sp:
+                        if v.ndim >= 2:
+                            # sequence shards reassemble along dim 1
+                            v = jax.lax.all_gather(v, "sp", axis=1,
+                                                   tiled=True)
+                        elif jnp.issubdtype(v.dtype, jnp.inexact):
+                            # per-example reductions (loss) are replicated
+                            # partial means over sp — average them
+                            v = jax.lax.pmean(v, "sp")
+                        else:
+                            v = jax.lax.pmax(v, "sp")
+                    if v.ndim == 0:
+                        v = jax.lax.all_gather(v, "dp")
+                    else:
+                        v = jax.lax.all_gather(v, "dp", tiled=True)
+                elif jnp.issubdtype(v.dtype, jnp.inexact):
+                    # "reduce": average floats (what a training loop wants
+                    # for loss metrics)
                     v = jax.lax.pmean(v, axes)
                 else:
                     v = jax.lax.pmax(v, axes)

@@ -76,14 +76,37 @@ class Model:
 
     # -- prepare ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, use_jit=False):
+        """use_jit=True compiles forward+loss into ONE jitted XLA
+        computation per input signature (paddle_tpu.jit.StaticFunction):
+        loss.backward() then runs the compiled vjp instead of the per-op
+        tape walk — the whole-block fast path for 2.0-API training."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
         self._amp = amp_configs is not None
         self._amp_level = (amp_configs or {}).get("level", "O1") \
             if isinstance(amp_configs, dict) else "O1"
+        self._use_jit = bool(use_jit)
+        # one StaticFunction per inputs/labels split — the split is baked
+        # into each trace, so it must be part of what selects the trace
+        self._jit_fns = {}
         return self
+
+    def _jit_fn_for(self, n_in: int):
+        fn = self._jit_fns.get(n_in)
+        if fn is None:
+            from ..jit import StaticFunction
+
+            def fwd_loss(*args):
+                outs = _to_list(self.network(*args[:n_in]))
+                lbls = list(args[n_in:])
+                loss_t = self._loss(*(outs + lbls))
+                return tuple([loss_t] + outs)
+
+            fn = StaticFunction(fwd_loss, layer=self.network)
+            self._jit_fns[n_in] = fn
+        return fn
 
     # -- single-batch ops ----------------------------------------------------
     def _forward(self, inputs):
@@ -100,16 +123,33 @@ class Model:
             raise RuntimeError("prepare(loss=...) required for training")
         return self._loss(*(outs + labels)), outs, labels
 
+    def _jit_step(self, inputs, labels):
+        ins = [to_tensor(np.asarray(x)) if not isinstance(x, Tensor) else x
+               for x in _to_list(inputs)]
+        lbls = [to_tensor(np.asarray(y)) if not isinstance(y, Tensor)
+                else y for y in _to_list(labels)]
+        res = self._jit_fn_for(len(ins))(*(ins + lbls))
+        return res[0], list(res[1:]), lbls
+
+    def _loss_outs(self, inputs, labels):
+        """(loss, outs, labels) via the jit or eager path, AMP applied to
+        either (jit: the casts are baked into the trace)."""
+        from contextlib import nullcontext
+        if self._amp:
+            from ..amp import auto_cast
+            cm = auto_cast(level=self._amp_level)
+        else:
+            cm = nullcontext()
+        with cm:
+            if getattr(self, "_use_jit", False):
+                return self._jit_step(inputs, labels)
+            outputs = self._forward(inputs)
+        return self._compute_loss(outputs, labels)
+
     def train_batch(self, inputs, labels=None, update=True):
         """hapi model.py train_batch: one fwd/bwd/step."""
         self.network.train()
-        if self._amp:
-            from ..amp import auto_cast
-            with auto_cast(level=self._amp_level):
-                outputs = self._forward(inputs)
-        else:
-            outputs = self._forward(inputs)
-        loss, outs, lbls = self._compute_loss(outputs, labels)
+        loss, outs, lbls = self._loss_outs(inputs, labels)
         loss.backward()
         if update and self._optimizer is not None:
             if hasattr(self._optimizer, "step"):
@@ -125,8 +165,7 @@ class Model:
         self.network.eval()
         from ..dygraph.base import no_grad
         with no_grad():
-            outputs = self._forward(inputs)
-            loss, outs, lbls = self._compute_loss(outputs, labels)
+            loss, outs, lbls = self._loss_outs(inputs, labels)
         metrics = self._update_metrics(outs, lbls)
         return [float(np.asarray(loss.numpy()))] + metrics
 

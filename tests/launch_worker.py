@@ -1,0 +1,82 @@
+"""Worker script for test_multihost_launch — launched by
+distributed/launch.py with the PADDLE_* env contract.  Each "host" is one
+process on a virtual 8-device CPU mesh.  Trains a fixed linreg batch via
+fleet (role_maker from env + graph_execution meta-optimizer), coordinates
+with its peer through the KV server (real cross-process barrier), and
+writes its losses to a JSON file for the test to compare."""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.static as static
+import paddle_tpu.distributed as dist
+from paddle_tpu.static import layers
+
+
+def main():
+    out_dir = sys.argv[1]
+    kv_endpoint = sys.argv[2]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nranks = int(os.environ["PADDLE_TRAINERS_NUM"])
+    endpoints = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(endpoints) == nranks
+    assert os.environ["PADDLE_CURRENT_ENDPOINT"] == endpoints[rank]
+
+    from paddle_tpu.distributed.fleet.base.fleet_base import fleet
+    role = dist.fleet.PaddleCloudRoleMaker(is_collective=True)
+    fleet.init(role)
+    assert fleet.worker_num() == nranks
+    assert fleet.worker_index() == rank
+
+    main_p, startup = static.Program(), static.Program()
+    with static.program_guard(main_p, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, 1, param_attr=static.ParamAttr(
+            initializer=static.Constant(0.0)))
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        strategy = dist.fleet.DistributedStrategy()
+        fleet.distributed_optimizer(static.SGD(learning_rate=0.05),
+                                    strategy)
+        fleet.minimize(loss)
+    assert "GraphExecutionOptimizer" in fleet.applied_meta_list()
+
+    rng = np.random.RandomState(42)  # SAME data on every host
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    losses = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            (lv,) = exe.run(fleet.main_program, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+
+    # real cross-process coordination: barrier + loss exchange via the KV
+    # server the test started (PS rendezvous path)
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    c = KVClient([kv_endpoint])
+    c.wait_server_ready()
+    c.set_param(f"losses_{rank}", np.asarray(losses, np.float32))
+    c.barrier()
+    peer = c.pull(f"losses_{(rank + 1) % nranks}")
+    np.testing.assert_allclose(np.asarray(losses), peer, rtol=1e-5)
+
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "nranks": nranks, "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()

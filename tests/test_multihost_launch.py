@@ -1,0 +1,82 @@
+"""Multi-host rehearsal (VERDICT #10): distributed/launch.py spawns two
+"host" worker processes with the PADDLE_* env contract (reference harness
+pattern fluid/tests/unittests/test_dist_base.py:785); each builds a fleet
+collective job from its env-derived role, trains on a CPU mesh, and
+cross-checks its losses with its peer through the KV server.  The test
+then compares against a single-host run."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+
+def test_launch_two_hosts_losses_match_single(tmp_path):
+    from paddle_tpu.distributed.launch_utils import (
+        find_free_ports, get_cluster, start_local_trainers,
+        terminate_procs)
+    from paddle_tpu.distributed.ps.kv_server import KVServer
+
+    # rendezvous KV server owned by the test (the "PS heart" of the job)
+    srv = KVServer("127.0.0.1:0", num_trainers=2)
+    srv.serve_in_thread()
+
+    script = os.path.join(os.path.dirname(__file__), "launch_worker.py")
+    ports = find_free_ports(2)
+    endpoints = [[f"127.0.0.1:{p}"] for p in ports]
+    # two "hosts" (node ips both local; one proc each)
+    cluster, pod0 = get_cluster(["127.0.0.1", "127.0.0.2"], "127.0.0.1",
+                                endpoints, [[0]])
+    assert cluster.trainers_nranks() == 2
+    procs = []
+    try:
+        for pod in cluster.pods:
+            procs.extend(start_local_trainers(
+                cluster, pod, script, [str(tmp_path), srv.endpoint],
+                log_dir=str(tmp_path / "logs")))
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if all(tp.proc.poll() is not None for tp in procs):
+                break
+            time.sleep(0.5)
+        rcs = [tp.proc.poll() for tp in procs]
+        logs = ""
+        for pod_dir in sorted((tmp_path / "logs").glob("workerlog.*")):
+            logs += f"\n--- {pod_dir}:\n" + pod_dir.read_text()[-2000:]
+        assert all(rc == 0 for rc in rcs), f"worker rcs={rcs}\n{logs}"
+    finally:
+        terminate_procs(procs)
+        srv.stop()
+
+    results = {}
+    for r in range(2):
+        with open(tmp_path / f"rank{r}.json") as f:
+            results[r] = json.load(f)
+    assert results[0]["nranks"] == results[1]["nranks"] == 2
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-5)
+
+    # single-host reference run (same fixed data/seeds)
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    main_p, startup = static.Program(), static.Program()
+    with static.program_guard(main_p, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, 1, param_attr=static.ParamAttr(
+            initializer=static.Constant(0.0)))
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.05).minimize(loss)
+    rng = np.random.RandomState(42)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        single = [float(exe.run(main_p, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0]) for _ in range(5)]
+    np.testing.assert_allclose(results[0]["losses"], single,
+                               rtol=1e-4, atol=1e-6)

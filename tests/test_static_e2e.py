@@ -278,3 +278,77 @@ def test_clone_for_test_distinct_fingerprint():
     fp_train = main.fingerprint()
     test_prog = main.clone(for_test=True)
     assert test_prog.fingerprint() != fp_train
+
+
+def test_fetch_aggregation_concat():
+    """BuildStrategy.fetch_aggregation='concat': per-replica fetch rows come
+    back concatenated (reference ParallelExecutor semantics) instead of
+    averaged."""
+    import jax
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy)
+    ndev = len(jax.devices())
+    main, startup = _fresh_programs()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, 1, param_attr=static.ParamAttr(
+            initializer=static.Constant(0.5)))
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.0).minimize(loss)
+    bs = BuildStrategy()
+    bs.fetch_aggregation = "concat"
+    cp = CompiledProgram(main, build_strategy=bs).with_data_parallel(
+        loss_name=loss.name)
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    xb = rng.rand(2 * ndev, 4).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        pred_out, loss_out = exe.run(cp, feed={"x": xb, "y": yb},
+                                     fetch_list=[pred, loss])
+    # per-example rows concatenated to the full batch; scalar loss stacked
+    assert pred_out.shape == (2 * ndev, 1), pred_out.shape
+    np.testing.assert_allclose(pred_out, xb @ np.full((4, 1), 0.5),
+                               rtol=1e-5)
+    assert np.asarray(loss_out).shape == (ndev,)
+
+
+def test_hapi_model_use_jit_trains():
+    """Model.prepare(use_jit=True): fit drives the whole-block jit path and
+    memorizes a fixed batch like the eager path does."""
+    import paddle_tpu
+    from paddle_tpu.hapi.model import Model
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    with paddle_tpu.dygraph.guard():
+        net = Net()
+        model = Model(net)
+        model.prepare(optimizer=opt.Adam(learning_rate=0.05,
+                                         parameters=net.parameters()),
+                      loss=nn.MSELoss(), use_jit=True)
+        assert model._use_jit
+        first = model.train_batch([xb], [yb])[0]
+        for _ in range(60):
+            last = model.train_batch([xb], [yb])[0]
+        assert last < first * 0.1, (first, last)
+        # jit traced exactly one signature for the step
+        assert len(model._jit_fns) == 1
+        assert len(next(iter(model._jit_fns.values()))._cache) == 1
+        ev = model.eval_batch([xb], [yb])[0]
+        assert abs(ev - last) < max(0.1, 0.5 * last)
