@@ -308,8 +308,18 @@ class CompiledProgram:
                     # reference ParallelExecutor semantics: per-device rows
                     # concatenated along dim 0 (scalars stack to [ndev]).
                     if has_sp:
-                        if v.ndim >= 2:
-                            # sequence shards reassemble along dim 1
+                        # mirror the feed-spec heuristic: only dim-1
+                        # sequence shards reassemble along dim 1; anything
+                        # replicated/reduced over sp is averaged
+                        try:
+                            gshape = tuple(block.var(n).shape or ())
+                        except KeyError:
+                            gshape = ()
+                        sp_sharded = (len(gshape) >= 2
+                                      and gshape[1] is not None
+                                      and gshape[1] > 1
+                                      and gshape[1] % mesh.shape["sp"] == 0)
+                        if v.ndim >= 2 and sp_sharded:
                             v = jax.lax.all_gather(v, "sp", axis=1,
                                                    tiled=True)
                         elif jnp.issubdtype(v.dtype, jnp.inexact):

@@ -142,6 +142,9 @@ class Model:
             cm = nullcontext()
         with cm:
             if getattr(self, "_use_jit", False):
+                if self._loss is None:
+                    raise RuntimeError(
+                        "prepare(loss=...) required for training")
                 return self._jit_step(inputs, labels)
             outputs = self._forward(inputs)
         return self._compute_loss(outputs, labels)

@@ -71,6 +71,7 @@ class _Recorder:
         self.names: Dict[int, str] = {}
         self.keepalive: List[Tensor] = []   # id() stability
         self.params: Dict[str, Tensor] = {}  # persistable captures
+        self.initial_raw: Dict[str, Any] = {}  # value at first capture
 
     def name_of(self, t: Tensor) -> str:
         key = id(t)
@@ -88,6 +89,7 @@ class _Recorder:
         self.names[key] = name
         self.keepalive.append(t)
         self.params[name] = t
+        self.initial_raw[name] = t._value
         return name
 
     def register(self, t: Tensor, name: str):
@@ -123,25 +125,28 @@ class _Recorder:
 # ---------------------------------------------------------------------------
 class ConcreteProgram:
     """One traced signature: Program + feed/fetch names + captured state
-    (program_translator.py ConcreteProgram analog)."""
+    (program_translator.py ConcreteProgram analog).  `updates` maps a
+    captured buffer name -> the program var holding its new value (BN
+    running stats etc., whose dygraph layers rebind via set_value)."""
 
     def __init__(self, program, feed_names, fetch_names, params,
-                 out_struct):
+                 out_struct, updates=None):
         self.program = program
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.params = params            # name -> Tensor (live, mutable)
         self.out_struct = out_struct    # "single" | "tuple" | "list"
+        self.updates = dict(updates or {})
         self._composed = None
 
     def composed(self):
-        """(seed, is_test, param_raws, input_raws) -> fetch raws, jitted."""
+        """(seed, is_test, param_raws, input_raws) ->
+        (fetch raws + buffer-update raws), jitted."""
         if self._composed is None:
             from ..static.executor import BlockTracer
             tracer = BlockTracer(self.program.global_block())
-            pnames, fnames, onames = (list(self.params),
-                                      list(self.feed_names),
-                                      list(self.fetch_names))
+            pnames, fnames = list(self.params), list(self.feed_names)
+            onames = list(self.fetch_names) + list(self.updates.values())
 
             def fn(seed, param_raws, input_raws, is_test):
                 env = dict(zip(pnames, param_raws))
@@ -234,8 +239,25 @@ class StaticFunction:
                     "produced by the traced ops, got "
                     f"{type(t).__name__}")
             fetch_names.append(rec.names[id(t)])
+        # buffer rebindings (BatchNorm running stats): a layer that did
+        # `buffer.set_value(traced_out)` left the buffer's raw value
+        # identical to some traced output's — record the link so replays
+        # keep updating the live buffer (the reference keeps these as
+        # in-place MeanOut/VarianceOut wirings)
+        updates = {}
+        for pname, pt in rec.params.items():
+            for t in rec.keepalive:
+                nm = rec.names.get(id(t))
+                if nm and nm != pname and t is not pt \
+                        and t._value is pt._value:
+                    updates[pname] = nm
+                    # the trace ran the layer eagerly and already applied
+                    # this update; roll it back so the compiled run (which
+                    # always follows) doesn't apply it twice
+                    pt._value = rec.initial_raw[pname]
+                    break
         return ConcreteProgram(program, feed_names, fetch_names,
-                               dict(rec.params), struct)
+                               dict(rec.params), struct, updates)
 
     def __call__(self, *args, **kwargs):
         if kwargs:
@@ -257,19 +279,25 @@ class StaticFunction:
             any(not t.stop_gradient for t in param_ts)
             or any(isinstance(a, Tensor) and not a.stop_gradient
                    for a in args))
+        n_fetch = len(cp.fetch_names)
         if not needs_grad:
             out_raws = fn(seed, param_raws, input_raws, is_test)
-            outs = [Tensor(r) for r in out_raws]
+            outs = [Tensor(r) for r in out_raws[:n_fetch]]
         else:
             out_raws, vjp_fn = jax.vjp(
                 lambda p, i: fn(seed, p, i, is_test),
                 param_raws, input_raws)
-            outs = [Tensor(r, stop_gradient=False) for r in out_raws]
+            outs = [Tensor(r, stop_gradient=False)
+                    for r in out_raws[:n_fetch]]
             in_tensors = param_ts + [a for a in args
                                      if isinstance(a, Tensor)]
+            # buffer-update outputs join the node so the vjp cotangent
+            # structure matches; they carry no user-visible gradient
+            upd_outs = [Tensor(r, stop_gradient=True)
+                        for r in out_raws[n_fetch:]]
             node = dytracer.GradNode(
                 "__to_static__", {"X": in_tensors}, {},
-                {"Out": out_raws}, {"Out": outs}, int(seed))
+                {"Out": out_raws}, {"Out": outs + upd_outs}, int(seed))
 
             def vjp_list(gs):
                 dp, di = vjp_fn(tuple(gs))
@@ -280,6 +308,9 @@ class StaticFunction:
             node.n_vjp_inputs = len(in_tensors)
             for t in outs:
                 t._grad_node = node
+        # write buffer updates (BN running stats) back to the live tensors
+        for pname, raw in zip(cp.updates, out_raws[n_fetch:]):
+            cp.params[pname]._value = raw
         if cp.out_struct == "single":
             return outs[0]
         return tuple(outs) if cp.out_struct == "tuple" else list(outs)

@@ -534,10 +534,17 @@ def unique(ins, attrs, ctx):
 @register_op("unique_with_counts", inputs=["X!"],
              outputs=["Out", "Index", "Count"], grad=None)
 def unique_with_counts(ins, attrs, ctx):
-    out, inv, cnt = jnp.unique(ins["X"], return_inverse=True,
-                               return_counts=True)
+    # fluid v1 semantics: uniques in FIRST-OCCURRENCE order
+    # (unique_with_counts_op.h hash-map insertion), unlike the sorted
+    # paddle-2.x `unique` above
+    out, first, inv, cnt = jnp.unique(ins["X"], return_index=True,
+                                      return_inverse=True,
+                                      return_counts=True)
+    order = jnp.argsort(first)
+    rank = jnp.argsort(order)
     dt = np_dtype(attrs.get("dtype", "int64"))
-    return {"Out": out, "Index": inv.astype(dt), "Count": cnt.astype(dt)}
+    return {"Out": out[order], "Index": rank[inv].astype(dt),
+            "Count": cnt[order].astype(dt)}
 
 
 @register_op("shape", inputs=["Input!"], outputs=["Out"], grad=None)

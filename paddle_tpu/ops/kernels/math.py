@@ -70,8 +70,9 @@ def mv(ins, attrs, ctx):
 
 @register_op("dot", inputs=["X", "Y"], outputs=["Out"])
 def dot(ins, attrs, ctx):
+    # dot_op.cc InferShape: out dims = x dims with last dim -> 1
     x, y = ins["X"], ins["Y"]
-    return {"Out": jnp.sum(x * y, axis=-1)}
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
 
 
 @register_op("addmm", inputs=["Input", "X", "Y"], outputs=["Out"])

@@ -198,14 +198,22 @@ def rnn(ins, attrs, ctx):
             "DropoutState": jnp.zeros((1,), jnp.uint8)}
 
 
-@register_op("edit_distance", inputs=["Hyps!", "Refs!"],
+@register_op("edit_distance",
+             inputs=["Hyps!", "Refs!", "HypsLength?!", "RefsLength?!"],
              outputs=["Out", "SequenceNum"], grad=None)
 def edit_distance(ins, attrs, ctx):
+    """edit_distance_op.cc — Levenshtein distance per pair; `normalized`
+    divides by the reference length (attr default FALSE like the
+    reference).  Dense [b, t] tokens; lengths from the optional length
+    tensors, else inferred from -1 padding."""
     hyp, ref = ins["Hyps"], ins["Refs"]
-    # dense [b, t] int tokens, -1 padding
-    def dist_one(h, r):
-        hl = jnp.sum(h >= 0)
-        rl = jnp.sum(r >= 0)
+    hlen, rlen = ins.get("HypsLength"), ins.get("RefsLength")
+    hls = (hlen.reshape(-1).astype(jnp.int32) if hlen is not None
+           else jnp.sum(hyp >= 0, axis=1).astype(jnp.int32))
+    rls = (rlen.reshape(-1).astype(jnp.int32) if rlen is not None
+           else jnp.sum(ref >= 0, axis=1).astype(jnp.int32))
+
+    def dist_one(h, r, hl, rl):
         maxh, maxr = h.shape[0], r.shape[0]
         row = jnp.arange(maxr + 1).astype(jnp.float32)
 
@@ -220,14 +228,15 @@ def edit_distance(ins, attrs, ctx):
 
             new = jnp.zeros_like(row).at[0].set(i * 1.0)
             _, new = jax.lax.fori_loop(1, maxr + 1, inner, (row, new))
-            return new
+            # rows past this hypothesis' length leave the DP frozen
+            return jnp.where(i <= hl, new, row)
 
         final = jax.lax.fori_loop(1, maxh + 1, outer, row)
         d = final[rl]
-        if attrs.get("normalized", True):
+        if attrs.get("normalized", False):
             d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
         return d
 
-    out = jax.vmap(dist_one)(hyp, ref)
+    out = jax.vmap(dist_one)(hyp, ref, hls, rls)
     return {"Out": out.reshape(-1, 1),
             "SequenceNum": jnp.asarray([hyp.shape[0]], jnp.int64)}

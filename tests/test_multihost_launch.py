@@ -34,9 +34,11 @@ def test_launch_two_hosts_losses_match_single(tmp_path):
     procs = []
     try:
         for pod in cluster.pods:
+            # per-pod log dirs: both pods have local_rank 0, so a shared
+            # dir would interleave their workerlog.0 files
             procs.extend(start_local_trainers(
                 cluster, pod, script, [str(tmp_path), srv.endpoint],
-                log_dir=str(tmp_path / "logs")))
+                log_dir=str(tmp_path / "logs" / f"pod{pod.id}")))
         deadline = time.time() + 240
         while time.time() < deadline:
             if all(tp.proc.poll() is not None for tp in procs):
@@ -44,7 +46,7 @@ def test_launch_two_hosts_losses_match_single(tmp_path):
             time.sleep(0.5)
         rcs = [tp.proc.poll() for tp in procs]
         logs = ""
-        for pod_dir in sorted((tmp_path / "logs").glob("workerlog.*")):
+        for pod_dir in sorted((tmp_path / "logs").glob("*/workerlog.*")):
             logs += f"\n--- {pod_dir}:\n" + pod_dir.read_text()[-2000:]
         assert all(rc == 0 for rc in rcs), f"worker rcs={rcs}\n{logs}"
     finally:
