@@ -9,3 +9,6 @@ from .transformer import (  # noqa: F401
     TransformerConfig, TransformerModel, CrossEntropyCriterion,
     transformer_base, transformer_big,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForGeneration, gpt_small,
+)

@@ -10,6 +10,7 @@ decoding uses fixed-length greedy loop (static shapes — XLA-friendly).
 """
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 import paddle_tpu
@@ -99,30 +100,38 @@ class TransformerModel(Layer):
         logits = paddle_tpu.matmul(out, self._tied_out, transpose_y=True)
         return logits
 
-    def beam_search(self, src_ids, beam_size=1, max_len=None):
-        """Greedy decode (beam_size kept for API parity; 1 = greedy) with a
-        fixed-length loop for static shapes.  The encoder runs ONCE; only
-        the decoder re-runs per emitted token."""
+    def beam_search(self, src_ids, beam_size=1, max_len=None,
+                    length_penalty=0.0):
+        """Beam-search decode over the `beam_search` op (beam_search_op.cc
+        semantics; beam_size=1 degrades to greedy).  The encoder runs ONCE
+        and its memory is tiled per beam; each step scores [B*W, V], the
+        op selects the top-W continuations per batch group, and the
+        candidate histories are re-gathered by parent index (host-side
+        orchestration like the reference's Transformer decode loop)."""
+        import jax.numpy as jnp
+        from ._decode import beam_search_loop
         cfg = self.config
+        W = max(1, int(beam_size))
         max_len = max_len or min(cfg.max_length, src_ids.shape[1] * 2)
         batch = src_ids.shape[0]
         memory = self.transformer.encoder(
             self.pos_enc(self.src_emb(src_ids)), None)
-        trg = np.full((batch, 1), cfg.bos_id, np.int64)
-        finished = np.zeros(batch, bool)
-        for _ in range(max_len - 1):
+        # tile memory rows per beam: [B, S, D] -> [B*W, S, D]
+        mem = paddle_tpu.to_tensor(jnp.repeat(
+            memory._value if hasattr(memory, "_value")
+            else jnp.asarray(memory.numpy()), W, axis=0))
+
+        def step_logits(trg):
             t = self.pos_enc(self.trg_emb(paddle_tpu.to_tensor(trg)))
             out = self.transformer.decoder(
-                t, memory, self._causal_mask(trg.shape[1]), None)
+                t, mem, self._causal_mask(trg.shape[1]), None)
             logits = paddle_tpu.matmul(out, self._tied_out,
                                        transpose_y=True)
-            nxt = np.asarray(logits.numpy())[:, -1].argmax(-1)
-            nxt = np.where(finished, cfg.eos_id, nxt)
-            finished |= nxt == cfg.eos_id
-            trg = np.concatenate([trg, nxt[:, None].astype(np.int64)], 1)
-            if finished.all():
-                break
-        return trg
+            return np.asarray(logits.numpy())[:, -1]
+
+        init = np.full((batch, 1), cfg.bos_id, np.int64)
+        return beam_search_loop(step_logits, init, W, cfg.eos_id,
+                                max_len - 1, length_penalty)
 
 
 class CrossEntropyCriterion(Layer):

@@ -1,0 +1,135 @@
+"""GPT decoder family + model-level beam search (reference decode loop
+over beam_search_op.cc; 2.x generate() contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.dygraph as dg
+
+
+def _tiny_gpt(vocab=50):
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position=64, dropout=0.0)
+    return GPTForGeneration(GPTModel(cfg))
+
+
+def test_gpt_trains_and_causal():
+    """LM loss on a fixed batch decreases; logits at position t must not
+    depend on tokens after t (causal mask)."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    rng = np.random.RandomState(0)
+    ids = rng.randint(2, 50, (4, 12)).astype(np.int64)
+    with dg.guard():
+        m = _tiny_gpt()
+        m.train()
+        ce = nn.CrossEntropyLoss()
+        adam = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+        first = None
+        for _ in range(15):
+            logits = m(paddle_tpu.to_tensor(ids[:, :-1]))
+            loss = ce(logits.reshape([-1, 50]),
+                      paddle_tpu.to_tensor(ids[:, 1:].reshape(-1)))
+            loss.backward()
+            adam.step()
+            adam.clear_grad()
+            first = first or float(loss.numpy())
+        assert float(loss.numpy()) < first
+
+        m.eval()
+        base = np.asarray(m(paddle_tpu.to_tensor(ids)).numpy())
+        ids2 = ids.copy()
+        ids2[:, -1] = (ids2[:, -1] + 7) % 50  # change LAST token only
+        pert = np.asarray(m(paddle_tpu.to_tensor(ids2)).numpy())
+        np.testing.assert_allclose(base[:, :-1], pert[:, :-1],
+                                   rtol=1e-4, atol=1e-5)
+        assert np.abs(base[:, -1] - pert[:, -1]).max() > 1e-4
+
+
+def test_generate_strategies():
+    rng = np.random.RandomState(1)
+    prefix = rng.randint(2, 50, (2, 3)).astype(np.int64)
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        g = m.generate(prefix, max_length=5,
+                       decode_strategy="greedy_search")
+        assert g.shape[0] == 2 and g.shape[1] <= 8
+        np.testing.assert_array_equal(g[:, :3], prefix)
+        # greedy is deterministic
+        g2 = m.generate(prefix, max_length=5,
+                        decode_strategy="greedy_search")
+        np.testing.assert_array_equal(g, g2)
+        s = m.generate(prefix, max_length=5, decode_strategy="sampling",
+                       top_k=5, seed=3)
+        assert s.shape[0] == 2
+        b = m.generate(prefix, max_length=5,
+                       decode_strategy="beam_search", num_beams=3)
+        assert b.shape[0] == 2
+        np.testing.assert_array_equal(b[:, :3], prefix)
+
+
+def _seq_logprob(m, seq):
+    """Sum log p(token_t | tokens_<t) under the model."""
+    logits = np.asarray(m(paddle_tpu.to_tensor(seq[:, :-1])).numpy())
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - \
+        logits.max(-1, keepdims=True)
+    tgt = seq[:, 1:]
+    return np.take_along_axis(lp, tgt[..., None], -1)[..., 0].sum(-1)
+
+
+def test_beam_width_one_is_greedy():
+    """num_beams=1 must reproduce greedy exactly (the degenerate beam), and
+    wider beams must return equal-or-better full-sequence log-prob when
+    both run to the same untruncated length."""
+    rng = np.random.RandomState(2)
+    prefix = rng.randint(2, 50, (3, 2)).astype(np.int64)
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        L = 6
+        g = m.generate(prefix, max_length=L,
+                       decode_strategy="greedy_search")
+        b1 = m.generate(prefix, max_length=L,
+                        decode_strategy="beam_search", num_beams=1)
+        n = min(g.shape[1], b1.shape[1])
+        np.testing.assert_array_equal(g[:, :n], b1[:, :n])
+        # wider beam: compare only when both emitted full length (beam
+        # may legitimately prefer a short EOS path under raw scores)
+        b4 = m.generate(prefix, max_length=L,
+                        decode_strategy="beam_search", num_beams=4)
+        if b4.shape[1] == g.shape[1] and \
+                not (b4[:, -1] == 1).any() and not (g[:, -1] == 1).any():
+            lp_g = _seq_logprob(m, g)
+            lp_b = _seq_logprob(m, b4)
+            assert (lp_b >= lp_g - 1e-4).all(), (lp_b, lp_g)
+
+
+def test_transformer_beam_search_runs():
+    from paddle_tpu.models import TransformerModel, TransformerConfig
+    cfg = TransformerConfig(src_vocab_size=40, trg_vocab_size=40,
+                            d_model=32, n_head=2, num_encoder_layers=1,
+                            num_decoder_layers=1, d_inner_hid=64,
+                            dropout=0.0, max_length=16)
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 40, (2, 6)).astype(np.int64)
+    with dg.guard():
+        model = TransformerModel(cfg)
+        model.eval()
+        out_g = model.beam_search(src, beam_size=1, max_len=6)
+        out_b = model.beam_search(src, beam_size=3, max_len=6)
+    assert out_g.shape[0] == 2 and out_b.shape[0] == 2
+    assert out_b.shape[1] <= 6
+    assert (out_b[:, 0] == cfg.bos_id).all()
+
+
+def test_generate_guards():
+    with dg.guard():
+        m = _tiny_gpt()
+        with pytest.raises(ValueError, match="decode_strategy"):
+            m.generate(np.zeros((1, 2), np.int64),
+                       decode_strategy="top_k_sampling")
+        with pytest.raises(ValueError, match="max_position"):
+            m.generate(np.zeros((1, 60), np.int64), max_length=10)
