@@ -264,10 +264,7 @@ class CompiledProgram:
 
     def _compile(self, program, state_names, feed_names, fetch_names, mesh):
         from ..static.executor import BlockTracer
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
+        from ..utils.shard_map_compat import shard_map_unchecked
         block = program.global_block()
         tracer = BlockTracer(block)
         axes = tuple(mesh.axis_names)
@@ -363,16 +360,7 @@ class CompiledProgram:
             feed_specs = {n: P("dp") for n in feed_names}
         fetch_specs = tuple(P() for _ in fetch_names)
 
-        try:
-            sharded = shard_map(
-                step, mesh=mesh,
-                in_specs=(state_specs, feed_specs, P()),
-                out_specs=(fetch_specs, state_specs),
-                check_vma=False)
-        except TypeError:  # older jax spells it check_rep
-            sharded = shard_map(
-                step, mesh=mesh,
-                in_specs=(state_specs, feed_specs, P()),
-                out_specs=(fetch_specs, state_specs),
-                check_rep=False)
+        sharded = shard_map_unchecked(
+            step, mesh, in_specs=(state_specs, feed_specs, P()),
+            out_specs=(fetch_specs, state_specs))
         return jax.jit(sharded, donate_argnums=(0,))

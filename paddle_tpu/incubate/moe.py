@@ -1,0 +1,118 @@
+"""Mixture-of-Experts with switch (top-1) routing + expert parallelism.
+
+Reference surface: paddle's incubate MoE work grew out of the Fluid-era
+distributed stack (the `alltoall` collective in
+python/paddle/distributed/collective.py and the expert-parallel designs
+layered on it); SURVEY.md §5.7 lists the all-to-all expert path as a
+first-class long-context/scale capability.
+
+TPU-native design:
+  * Routing is fully static-shape: top-1 expert choice, per-expert
+    capacity C, dispatch/combine as scatter/gather into a dense
+    [E, C, D] buffer (tokens over capacity are dropped, standard Switch
+    semantics) — no ragged anything, XLA fuses the one-hot arithmetic.
+  * Expert compute is ONE batched einsum over the expert axis — the MXU
+    sees [E, C, D] x [E, D, H], not E small matmuls.
+  * Expert parallelism: inside shard_map, expert weights are sharded over
+    an `ep` mesh axis and dispatch rides `jax.lax.all_to_all` (the ICI
+    collective the reference reaches via its alltoall op) — tokens travel
+    to their expert's device and back.
+  * Differentiable through routing the standard way: the top-1 choice is
+    a constant of the backward; gradients flow through the gate
+    probability scaling and the experts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["switch_moe", "moe_aux_loss", "init_moe_params"]
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int,
+                    dtype=jnp.float32):
+    """(gate_w, w1, b1, w2, b2) — expert weights carry a leading E axis."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = (2.0 / (d_model + d_hidden)) ** 0.5
+    return (jax.random.normal(k1, (d_model, n_experts), dtype) * 0.02,
+            jax.random.normal(k2, (n_experts, d_model, d_hidden),
+                              dtype) * s1,
+            jnp.zeros((n_experts, d_hidden), dtype),
+            jax.random.normal(k3, (n_experts, d_hidden, d_model),
+                              dtype) * s1,
+            jnp.zeros((n_experts, d_model), dtype))
+
+
+def moe_aux_loss(gates, expert_idx):
+    """Switch load-balancing loss: E * sum_e f_e * p_e (Switch Transformer
+    eq. 4) — pushes the router toward uniform expert load."""
+    E = gates.shape[-1]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=gates.dtype)
+    f = onehot.mean(axis=0)          # fraction of tokens per expert
+    p = gates.mean(axis=0)           # mean router prob per expert
+    return E * jnp.sum(f * p)
+
+
+def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
+               axis_name: Optional[str] = None):
+    """Top-1 MoE feed-forward.  x: [N, D] tokens.
+
+    Without axis_name: w1/w2 hold ALL experts ([E, D, H] / [E, H, D]).
+    With axis_name (inside shard_map): w1/w2 hold this device's expert
+    shard ([E_local, ...]); dispatch all_to_alls tokens across the `ep`
+    axis so each device runs only its local experts.
+
+    Returns (out [N, D], aux_loss scalar)."""
+    N, D = x.shape
+    E = gate_w.shape[1]
+    ep = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    e_local = w1.shape[0]
+    if e_local * ep != E:
+        raise ValueError(
+            f"gate has {E} experts but weights hold {e_local} x ep={ep}")
+
+    gates = jax.nn.softmax(x.astype(jnp.float32) @
+                           gate_w.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)              # [N]
+    prob = jnp.max(gates, axis=-1).astype(x.dtype)       # [N]
+    aux = moe_aux_loss(gates, expert_idx)
+
+    C = max(1, int(capacity_factor * N / E))
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1)  # 1-based
+    keep = pos <= C
+    slot = jnp.where(keep, pos - 1, C)  # C = overflow slot, dropped below
+
+    # dispatch: [E, C, D] (scatter drops the overflow slot)
+    disp = jnp.zeros((E, C, D), x.dtype)
+    disp = disp.at[expert_idx, slot].add(
+        jnp.where(keep[:, None], x, 0), mode="drop")
+
+    if axis_name is not None:
+        # send each expert shard to its owner: [E, C, D] ->
+        # [ep, E_local, C, D]; all_to_all swaps the leading shard axis
+        # across devices, so device d ends with its OWN experts' tokens
+        # from every peer, stacked along dim 0 -> capacity grows ep-fold
+        disp = disp.reshape(ep, e_local, C, D)
+        disp = jax.lax.all_to_all(disp, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        disp = jnp.swapaxes(disp, 0, 1).reshape(e_local, ep * C, D)
+
+    # batched expert FFN on the MXU: [E_local, cap, D] x [E_local, D, H]
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", disp, w1)
+                    + b1[:, None, :])
+    out_e = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+
+    if axis_name is not None:
+        out_e = jnp.swapaxes(
+            out_e.reshape(e_local, ep, C, D), 0, 1)       # [ep, E_l, C, D]
+        out_e = jax.lax.all_to_all(out_e, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        out_e = out_e.reshape(E, C, D)
+
+    # combine: gather each token's slot, scale by its gate prob
+    tok = out_e[expert_idx, slot]
+    out = jnp.where(keep[:, None], tok, 0) * prob[:, None]
+    return out.astype(x.dtype), aux

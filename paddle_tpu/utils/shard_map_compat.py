@@ -1,0 +1,28 @@
+"""One home for the jax shard_map version shims (import location moved
+from jax.experimental to jax; the replication-check kwarg was renamed
+check_rep -> check_vma).  Every mesh-tracing site uses this instead of
+carrying its own copy."""
+from __future__ import annotations
+
+__all__ = ["get_shard_map", "shard_map_unchecked"]
+
+
+def get_shard_map():
+    try:
+        from jax import shard_map as _sm
+        return _sm.shard_map if hasattr(_sm, "shard_map") else _sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        return shard_map
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with replication checking off (outputs whose replication
+    the tracer cannot statically infer — collectives-heavy steps)."""
+    sm = get_shard_map()
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:  # older jax spells it check_rep
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)

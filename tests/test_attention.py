@@ -105,10 +105,7 @@ def test_flash_irregular_len_falls_back():
 
 def test_ring_attention_sharded():
     from jax.sharding import Mesh, PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from paddle_tpu.utils.shard_map_compat import shard_map_unchecked
     q, k, v = _qkv(S=128, D=32)
     mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
 
@@ -118,16 +115,9 @@ def test_ring_attention_sharded():
         def fn(q, k, v, causal=causal):
             return ring_attention(q, k, v, "sp", causal=causal)
 
-        try:
-            sharded = shard_map(fn, mesh=mesh,
-                                in_specs=(P(None, None, "sp", None),) * 3,
-                                out_specs=P(None, None, "sp", None),
-                                check_vma=False)
-        except TypeError:
-            sharded = shard_map(fn, mesh=mesh,
-                                in_specs=(P(None, None, "sp", None),) * 3,
-                                out_specs=P(None, None, "sp", None),
-                                check_rep=False)
+        sharded = shard_map_unchecked(
+            fn, mesh, in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None))
         out = jax.jit(sharded)(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4, atol=1e-5,
