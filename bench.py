@@ -80,7 +80,8 @@ def main():
     use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
 
     # route attention through the Pallas flash kernel (graph-build-time gate)
-    enable_flash_attention(True)
+    enable_flash_attention(
+        os.environ.get("BENCH_NO_FLASH", "") in ("", "0", "false"))
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
                                               heads, batch, use_amp=use_amp)

@@ -1490,6 +1490,112 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     return out
 
 
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, is_reverse=False, name=None):
+    """fluid.layers.dynamic_lstm (lstm_op.cc) over padded dense input.
+
+    `input` is the pre-projected gate sequence [batch, time, size] with
+    ``size = 4 * hidden`` (caller projects with an fc, matching the
+    reference contract); returns (hidden, cell) each [batch, time, hidden].
+    """
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, [hidden, 4 * hidden], input.dtype)
+    b = helper.create_parameter(bias_attr, [1, 4 * hidden], input.dtype,
+                                is_bias=True)
+    h = helper.create_variable_for_type_inference(input.dtype)
+    c = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Weight": w}
+    if b is not None:
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    helper.append_op("lstm", inputs=ins,
+                     outputs={"Hidden": h, "Cell": c, "BatchGate": gate,
+                              "BatchCellPreAct": pre},
+                     attrs={"is_reverse": is_reverse})
+    return h, c
+
+
+def dynamic_gru(input, size, h_0=None, param_attr=None, bias_attr=None,
+                is_reverse=False, name=None):
+    """fluid.layers.dynamic_gru (gru_op.cc) over padded dense input
+    [batch, time, 3*size]; returns hidden [batch, time, size]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    w = helper.create_parameter(param_attr, [size, 3 * size], input.dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * size], input.dtype,
+                                is_bias=True)
+    h = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    rhp = helper.create_variable_for_type_inference(input.dtype)
+    bh = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Weight": w}
+    if b is not None:
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    helper.append_op("gru", inputs=ins,
+                     outputs={"Hidden": h, "BatchGate": gate,
+                              "BatchResetHiddenPrev": rhp,
+                              "BatchHidden": bh},
+                     attrs={"is_reverse": is_reverse})
+    return h
+
+
+def sequence_pool(input, pool_type, length=None, name=None):
+    """fluid.layers.sequence_pool (sequence_pool_op.cc): pool over the time
+    axis of padded [batch, time, d] input; `length` masks the padding."""
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": input}
+    if length is not None:
+        ins["Length"] = length
+    helper.append_op("sequence_pool", inputs=ins, outputs={"Out": out},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding_start=None, param_attr=None, bias_attr=None,
+                  act=None, name=None):
+    """fluid.layers.sequence_conv (sequence_conv_op.cc) on padded input."""
+    helper = LayerHelper("sequence_conv", name=name)
+    w = helper.create_parameter(
+        param_attr, [filter_size * input.shape[-1], num_filters], input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    start = -(filter_size // 2) if padding_start is None else padding_start
+    helper.append_op("sequence_conv",
+                     inputs={"X": input, "Filter": w},
+                     outputs={"Out": out},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": start,
+                            "contextStride": filter_stride})
+    b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        tmp = helper.create_variable_for_type_inference(out.dtype)
+        helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
+                         outputs={"Out": tmp},
+                         attrs={"axis": len(out.shape) - 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def cos_sim(X, Y, name=None):
+    """fluid.layers.cos_sim (cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", name=name)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": X, "Y": Y},
+                     outputs={"Out": out, "XNorm": xn, "YNorm": yn}, attrs={})
+    return out
+
+
 # ---------------------------------------------------------------------------
 # control flow (fluid.layers.control_flow parity; see static/control_flow.py)
 # ---------------------------------------------------------------------------
@@ -1497,7 +1603,9 @@ from .control_flow import (  # noqa: E402,F401
     While, cond, case, switch_case, Switch, StaticRNN,
     array_write, array_read, array_length, create_array)
 
-__all__ += ["While", "cond", "case", "switch_case", "Switch", "StaticRNN",
+__all__ += ["dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
+            "cos_sim",
+            "While", "cond", "case", "switch_case", "Switch", "StaticRNN",
             "array_write", "array_read", "array_length", "create_array",
             "gather_tree", "warpctc", "ctc_greedy_decoder",
             "linear_chain_crf", "crf_decoding", "multiclass_nms",

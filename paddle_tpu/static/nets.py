@@ -57,23 +57,10 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
 
 def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
                        act="sigmoid", pool_type="max", bias_attr=None):
-    from .layer_helper import LayerHelper
-    helper = LayerHelper("sequence_conv_pool")
-    w = helper.create_parameter(
-        param_attr, [filter_size * input.shape[-1], num_filters],
-        input.dtype)
-    conv_out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("sequence_conv",
-                     inputs={"X": input, "Filter": w},
-                     outputs={"Out": conv_out},
-                     attrs={"contextLength": filter_size, "contextStart":
-                            -(filter_size // 2), "contextStride": 1})
-    conv_out = helper.append_activation(conv_out, act)
-    pool_out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("sequence_pool", inputs={"X": conv_out},
-                     outputs={"Out": pool_out},
-                     attrs={"pooltype": pool_type.upper()})
-    return pool_out
+    conv_out = layers.sequence_conv(input, num_filters, filter_size,
+                                    param_attr=param_attr,
+                                    bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(conv_out, pool_type)
 
 
 def glu(input, dim=-1):

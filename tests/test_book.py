@@ -1,0 +1,237 @@
+"""Book-test tier — the reference's end-to-end convergence suite
+(/root/reference/python/paddle/fluid/tests/book/): word2vec,
+understand_sentiment (LSTM), machine_translation (rnn encoder-decoder),
+recommender_system, label_semantic_roles (CRF).  Each builds a model with
+the fluid-style static API, trains a few iterations on synthetic learnable
+data, asserts the loss decreases, and (word2vec) round-trips through
+save/load_inference_model."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _train(main, startup, feeds_fn, loss, iters=30, fetch_extra=()):
+    exe = static.Executor()
+    scope = static.Scope()
+    losses, extras = [], []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for i in range(iters):
+            feed = feeds_fn(i)
+            out = exe.run(main, feed=feed,
+                          fetch_list=[loss, *fetch_extra])
+            losses.append(float(np.asarray(out[0])))
+            if fetch_extra:
+                extras.append([np.asarray(o) for o in out[1:]])
+    return losses, extras, scope
+
+
+def test_word2vec(tmp_path):
+    """book/test_word2vec.py: N-gram next-word prediction; plus an
+    inference-model save/load round trip."""
+    vocab, emb_dim, ctx_n = 50, 16, 4
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ctx = layers.data("ctx", [-1, ctx_n], dtype="int64")
+        nxt = layers.data("next", [-1, 1], dtype="int64")
+        e = layers.embedding(ctx, size=[vocab, emb_dim])          # [b,4,e]
+        flat = layers.reshape(e, [-1, ctx_n * emb_dim])
+        h = layers.fc(flat, size=64, act="relu")
+        logits = layers.fc(h, size=vocab)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, nxt))
+        static.Adam(learning_rate=5e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def feeds(i):
+        c = rng.randint(0, vocab, (32, ctx_n)).astype(np.int64)
+        n = c[:, :1].astype(np.int64)  # next word = first context word
+        return {"ctx": c, "next": n}
+
+    losses, _, scope = _train(main, startup, feeds, loss, iters=60)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+    # save + reload the inference program, predictions must match
+    from paddle_tpu.io import save_inference_model, load_inference_model
+    exe = static.Executor()
+    path = str(tmp_path / "w2v")
+    with static.scope_guard(scope):
+        save_inference_model(path, ["ctx"], [logits], exe,
+                             main_program=main)
+        feed = feeds(999)
+        ref = np.asarray(exe.run(main, feed=feed, fetch_list=[logits])[0])
+        prog2, feed_names, fetch_vars = load_inference_model(path, exe)
+        got = np.asarray(
+            exe.run(prog2, feed={feed_names[0]: feed["ctx"]},
+                    fetch_list=fetch_vars)[0])
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_understand_sentiment_lstm():
+    """book/test_understand_sentiment.py (stacked-LSTM variant, one layer)."""
+    vocab, emb_dim, hid, seq = 30, 16, 16, 8
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        words = layers.data("words", [-1, seq], dtype="int64")
+        label = layers.data("label", [-1, 1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, emb_dim])
+        gates = layers.fc(emb, size=4 * hid, num_flatten_dims=2)
+        h, _c = layers.dynamic_lstm(gates, size=4 * hid)
+        pooled = layers.sequence_pool(h, "max")
+        logits = layers.fc(pooled, size=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        static.Adam(learning_rate=5e-3).minimize(loss)
+
+    rng = np.random.RandomState(1)
+
+    def feeds(i):
+        w = rng.randint(0, vocab, (16, seq)).astype(np.int64)
+        y = (w[:, 0] < vocab // 2).astype(np.int64)[:, None]
+        return {"words": w, "label": y}
+
+    losses, _, _ = _train(main, startup, feeds, loss, iters=40,
+                          fetch_extra=())
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_understand_sentiment_conv():
+    """book/test_understand_sentiment.py (convolution_net variant):
+    embedding → sequence_conv_pool text-CNN → classifier."""
+    import paddle_tpu.static.nets as nets
+    vocab, emb_dim, seq = 30, 16, 8
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        words = layers.data("words", [-1, seq], dtype="int64")
+        label = layers.data("label", [-1, 1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, emb_dim])
+        conv3 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=3,
+                                        act="tanh")
+        conv4 = nets.sequence_conv_pool(emb, num_filters=16, filter_size=4,
+                                        act="tanh")
+        logits = layers.fc(layers.concat([conv3, conv4], axis=1), size=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        static.Adam(learning_rate=5e-3).minimize(loss)
+
+    rng = np.random.RandomState(5)
+
+    def feeds(i):
+        w = rng.randint(0, vocab, (16, seq)).astype(np.int64)
+        # sentiment = presence of the "good" token anywhere in the text —
+        # the bag-of-ngrams signal a text-CNN with max pooling captures
+        y = np.any(w == 0, axis=1).astype(np.int64)[:, None]
+        return {"words": w, "label": y}
+
+    losses, _, _ = _train(main, startup, feeds, loss, iters=60)
+    assert losses[-1] < np.mean(losses[:5]) * 0.8, losses
+
+
+def test_machine_translation_rnn_encoder_decoder():
+    """book/test_rnn_encoder_decoder.py: GRU encoder, teacher-forced GRU
+    decoder conditioned on the encoder summary; learn to copy the source."""
+    vocab, emb_dim, hid, seq = 20, 16, 16, 6
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        src = layers.data("src", [-1, seq], dtype="int64")
+        tgt_in = layers.data("tgt_in", [-1, seq], dtype="int64")
+        tgt_out = layers.data("tgt_out", [-1, seq, 1], dtype="int64")
+        # encoder
+        semb = layers.embedding(src, size=[vocab, emb_dim])
+        egate = layers.fc(semb, size=3 * hid, num_flatten_dims=2)
+        enc = layers.dynamic_gru(egate, size=hid)
+        ctx = layers.sequence_pool(enc, "last")                   # [b, hid]
+        # decoder: context concatenated to every target step
+        temb = layers.embedding(tgt_in, size=[vocab, emb_dim])
+        ctx_t = layers.expand(layers.unsqueeze(ctx, [1]), [1, seq, 1])
+        dec_in = layers.concat([temb, ctx_t], axis=2)
+        dgate = layers.fc(dec_in, size=3 * hid, num_flatten_dims=2)
+        dec = layers.dynamic_gru(dgate, size=hid)
+        logits = layers.fc(dec, size=vocab, num_flatten_dims=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, tgt_out))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(2)
+
+    def feeds(i):
+        s = rng.randint(2, vocab, (16, seq)).astype(np.int64)
+        ti = np.concatenate([np.ones((16, 1), np.int64), s[:, :-1]], axis=1)
+        return {"src": s, "tgt_in": ti, "tgt_out": s[..., None]}
+
+    losses, _, _ = _train(main, startup, feeds, loss, iters=80)
+    assert losses[-1] < losses[0] * 0.85, losses
+
+
+def test_recommender_system():
+    """book/test_recommender_system.py: embed user & item ids, cos_sim
+    scaled to the rating range, square loss."""
+    n_users, n_items, dim = 40, 60, 16
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        uid = layers.data("uid", [-1, 1], dtype="int64")
+        iid = layers.data("iid", [-1, 1], dtype="int64")
+        rating = layers.data("rating", [-1, 1])
+        uvec = layers.reshape(
+            layers.embedding(uid, size=[n_users, dim]), [-1, dim])
+        ivec = layers.reshape(
+            layers.embedding(iid, size=[n_items, dim]), [-1, dim])
+        uvec = layers.fc(uvec, size=dim, act="relu")
+        ivec = layers.fc(ivec, size=dim, act="relu")
+        sim = layers.cos_sim(uvec, ivec)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred,
+                                                                rating)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(3)
+    u_lat = rng.randn(n_users, 4)
+    i_lat = rng.randn(n_items, 4)
+
+    def feeds(i):
+        u = rng.randint(0, n_users, (32, 1)).astype(np.int64)
+        it = rng.randint(0, n_items, (32, 1)).astype(np.int64)
+        r = np.clip((u_lat[u[:, 0]] * i_lat[it[:, 0]]).sum(1), -5, 5)
+        return {"uid": u, "iid": it,
+                "rating": r.astype(np.float32)[:, None]}
+
+    losses, _, _ = _train(main, startup, feeds, loss, iters=60)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_label_semantic_roles_crf():
+    """book/test_label_semantic_roles.py: emission net + linear-chain CRF
+    log-likelihood loss, viterbi decode via crf_decoding."""
+    vocab, n_tags, seq = 25, 5, 6
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        words = layers.data("words", [-1, seq], dtype="int64")
+        tags = layers.data("tags", [-1, seq], dtype="int64")
+        length = layers.data("length", [-1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, 16])
+        feat = layers.fc(emb, size=n_tags, num_flatten_dims=2)
+        ll = layers.linear_chain_crf(feat, tags,
+                                     param_attr=static.ParamAttr(
+                                         name="crf_w"),
+                                     length=length)
+        loss = layers.mean(ll)
+        decoded = layers.crf_decoding(
+            feat, param_attr=static.ParamAttr(name="crf_w"), length=length)
+        static.SGD(learning_rate=5e-2).minimize(loss)
+
+    rng = np.random.RandomState(4)
+
+    def feeds(i):
+        w = rng.randint(0, vocab, (8, seq)).astype(np.int64)
+        t = (w % n_tags).astype(np.int64)
+        ln = np.full((8,), seq, np.int64)
+        return {"words": w, "tags": t, "length": ln}
+
+    losses, extras, _ = _train(main, startup, feeds, loss, iters=40,
+                               fetch_extra=(decoded,))
+    assert losses[-1] < losses[0] * 0.9, losses
+    # decode returns a tag path with the right shape
+    assert extras[-1][0].shape[0] == 8
