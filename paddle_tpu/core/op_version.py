@@ -1,0 +1,104 @@
+"""Op version registry — schema-evolution rules for serialized programs.
+
+Analog of /root/reference/paddle/fluid/framework/op_version_registry.h:129-175
+(REGISTER_OP_VERSION / OpVersionDesc with NewAttr/ModifyAttr/NewInput rules)
+and op_compatible_info.cc.  A saved Program embeds the per-op schema version
+current at save time; on load, any op whose saved version is older than the
+live registry's is upgraded in place by replaying the registered rules.
+
+Rules are data, not code: each version bump declares added attrs (with the
+default that reproduces the old behaviour), renamed attrs, and deleted
+attrs.  That covers every upgrade pattern the reference registry encodes for
+its ~40 versioned ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["register_op_version", "op_version", "saved_op_versions",
+           "upgrade_op", "OpVersionRegistry"]
+
+
+class _Change:
+    __slots__ = ("new_attrs", "renamed_attrs", "deleted_attrs", "note")
+
+    def __init__(self, new_attrs=None, renamed_attrs=None, deleted_attrs=None,
+                 note=""):
+        self.new_attrs: Dict[str, Any] = dict(new_attrs or {})
+        self.renamed_attrs: Dict[str, str] = dict(renamed_attrs or {})
+        self.deleted_attrs: Tuple[str, ...] = tuple(deleted_attrs or ())
+        self.note = note
+
+
+class OpVersionRegistry:
+    def __init__(self):
+        # op type -> ordered list of (version, change); version N's change
+        # upgrades a desc from version N-1 to N
+        self._rules: Dict[str, List[Tuple[int, _Change]]] = {}
+
+    def register(self, op_type: str, version: int, *, new_attrs=None,
+                 renamed_attrs=None, deleted_attrs=None, note=""):
+        rules = self._rules.setdefault(op_type, [])
+        if rules and version <= rules[-1][0]:
+            raise ValueError(
+                f"op {op_type!r} version {version} not greater than "
+                f"registered {rules[-1][0]}")
+        rules.append((version, _Change(new_attrs, renamed_attrs,
+                                       deleted_attrs, note)))
+
+    def version(self, op_type: str) -> int:
+        rules = self._rules.get(op_type)
+        return rules[-1][0] if rules else 1
+
+    def snapshot(self) -> Dict[str, int]:
+        """op type -> current version, for embedding at save time (ops at
+        version 1 are omitted: absent means 1)."""
+        return {t: r[-1][0] for t, r in self._rules.items()}
+
+    def upgrade(self, op_type: str, attrs: Dict[str, Any],
+                saved_version: int) -> Dict[str, Any]:
+        """Replay rules newer than `saved_version` over an op's attrs."""
+        for ver, change in self._rules.get(op_type, ()):
+            if ver <= saved_version:
+                continue
+            for old, new in change.renamed_attrs.items():
+                if old in attrs:
+                    attrs[new] = attrs.pop(old)
+            for name, default in change.new_attrs.items():
+                attrs.setdefault(name, default)
+            for name in change.deleted_attrs:
+                attrs.pop(name, None)
+        return attrs
+
+
+_registry = OpVersionRegistry()
+
+
+def register_op_version(op_type: str, version: int, **kw):
+    _registry.register(op_type, version, **kw)
+
+
+def op_version(op_type: str) -> int:
+    return _registry.version(op_type)
+
+
+def saved_op_versions() -> Dict[str, int]:
+    return _registry.snapshot()
+
+
+def upgrade_op(op_type: str, attrs: Dict[str, Any],
+               saved_version: Optional[int]) -> Dict[str, Any]:
+    return _registry.upgrade(op_type, attrs, saved_version or 1)
+
+
+# ---------------------------------------------------------------------------
+# Version history of this framework's own op schemas.  Version 1 is the
+# round-1 schema; bumps below document attrs added since with the defaults
+# that reproduce version-1 behaviour (mirroring how the reference registers
+# e.g. REGISTER_OP_VERSION(leaky_relu).AddCheckpoint(... NewAttr ...)).
+# ---------------------------------------------------------------------------
+register_op_version(
+    "lookup_table_v2", 2,
+    new_attrs={"is_sparse": False},
+    note="SelectedRows sparse-gradient path added behind is_sparse "
+         "(round 2); programs saved before it load with dense grads")

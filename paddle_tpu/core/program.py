@@ -143,7 +143,7 @@ class VarDesc:
         return f"{kind}[{self.name}: {self.dtype}{list(self.shape) if self.shape else '?'}]"
 
     def to_dict(self):
-        return {
+        d = {
             "name": self.name,
             "shape": list(self.shape) if self.shape is not None else None,
             "dtype": self.dtype,
@@ -155,6 +155,11 @@ class VarDesc:
             "lod_level": self.lod_level,
             "is_data": self.is_data,
         }
+        # SELECTED_ROWS / READER marking (framework.proto:104 VarType);
+        # only emitted when set so dense-program fingerprints are unchanged
+        if self.attrs.get("var_type"):
+            d["var_type"] = self.attrs["var_type"]
+        return d
 
     @staticmethod
     def from_dict(d, block=None):
@@ -162,6 +167,8 @@ class VarDesc:
                     d["stop_gradient"], d["is_parameter"], d.get("initializer"),
                     d.get("trainable", True), d.get("lod_level", 0),
                     d.get("is_data", False), block)
+        if d.get("var_type"):
+            v.attrs["var_type"] = d["var_type"]
         return v
 
 
@@ -411,15 +418,29 @@ class Program:
 
     # -- serialization (P19/C22 parity) -------------------------------------
     def to_dict(self):
+        from .op_version import saved_op_versions
         return {"version": self._version, "random_seed": self.random_seed,
+                "op_versions": saved_op_versions(),
                 "blocks": [b.to_dict() for b in self.blocks]}
 
-    def serialize_to_string(self) -> bytes:
+    def serialize_to_string(self, format: str = "json") -> bytes:
+        """`format="json"` (default, human-diffable) or `format="proto"`
+        (stable binary, core/framework.proto)."""
+        if format == "proto":
+            from .serialization import serialize_program
+            return serialize_program(self)
         return json.dumps(self.to_dict(), sort_keys=True).encode("utf-8")
 
     @staticmethod
     def parse_from_string(data: bytes) -> "Program":
+        """Auto-detects the wire format: JSON starts with '{', anything else
+        is the framework.proto binary form."""
+        if not data.lstrip()[:1] == b"{":
+            from .serialization import deserialize_program
+            return deserialize_program(data)
+        from .op_version import upgrade_op
         d = json.loads(data.decode("utf-8"))
+        saved_vers = d.get("op_versions", {})
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p._version = d.get("version", 1)
@@ -428,7 +449,11 @@ class Program:
             b = Block(p, bd["idx"], bd["parent_idx"])
             for vd in bd["vars"]:
                 b.vars[vd["name"]] = VarDesc.from_dict(vd, b)
-            b.ops = [OpDesc.from_dict(od) for od in bd["ops"]]
+            for od in bd["ops"]:
+                op = OpDesc.from_dict(od)
+                op.attrs = upgrade_op(op.type, op.attrs,
+                                      saved_vers.get(op.type, 1))
+                b.ops.append(op)
             p.blocks.append(b)
         p._uid = max((op.attrs.get("op_uid", 0)
                       for b in p.blocks for op in b.ops), default=0)

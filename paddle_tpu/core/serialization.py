@@ -1,0 +1,150 @@
+"""Program <-> binary proto conversion (framework.proto analog).
+
+The JSON dict form (program.py to_dict/from_dict) stays the default wire
+format; this module adds the stable binary format for model artifacts —
+the role the reference's framework.proto ProgramDesc bytes play in
+save_inference_model (/root/reference/python/paddle/fluid/io.py:1164,
+framework/program_desc.cc).  Attr values round-trip through a typed oneof
+with a JSON fallback for nested structures.
+
+Load-time op upgrades: the saved per-op schema versions (op_version.py) are
+diffed against the live registry and upgrade rules replayed, matching the
+reference's op_version_registry / op_compatible_info flow.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from . import framework_pb2 as pb
+from .op_version import saved_op_versions, upgrade_op
+
+__all__ = ["program_to_proto", "program_from_proto",
+           "serialize_program", "deserialize_program"]
+
+_VAR_TYPES = {"DENSE_TENSOR": pb.VarDesc.DENSE_TENSOR,
+              "SELECTED_ROWS": pb.VarDesc.SELECTED_ROWS,
+              "READER": pb.VarDesc.READER}
+
+
+def _set_attr(msg: "pb.Attribute", value: Any) -> None:
+    if isinstance(value, bool):
+        msg.b = value
+    elif isinstance(value, int):
+        msg.i = value
+    elif isinstance(value, float):
+        msg.f = value
+    elif isinstance(value, str):
+        msg.s = value
+    elif isinstance(value, (list, tuple)):
+        vals = list(value)
+        # homogeneous lists only — mixed types (e.g. [1, 2.5]) take the JSON
+        # fallback so the proto format preserves exactly what JSON would
+        if not vals:
+            msg.strings.SetInParent()  # empty list, element type irrelevant
+        elif all(type(v) is bool for v in vals):
+            msg.bools.val.extend(vals)
+        elif all(type(v) is int for v in vals):
+            msg.ints.val.extend(vals)
+        elif all(type(v) is float for v in vals):
+            msg.floats.val.extend(vals)
+        elif all(isinstance(v, str) for v in vals):
+            msg.strings.val.extend(vals)
+        else:
+            msg.json = json.dumps(vals).encode()
+    else:
+        msg.json = json.dumps(value, default=str).encode()
+
+
+def _get_attr(msg: "pb.Attribute") -> Any:
+    kind = msg.WhichOneof("value")
+    if kind == "ints":
+        return list(msg.ints.val)
+    if kind == "floats":
+        return list(msg.floats.val)
+    if kind == "strings":
+        return list(msg.strings.val)
+    if kind == "bools":
+        return list(msg.bools.val)
+    if kind == "json":
+        return json.loads(msg.json.decode())
+    if kind is None:
+        return None
+    return getattr(msg, kind)
+
+
+def program_to_proto(program) -> "pb.ProgramDesc":
+    p = pb.ProgramDesc(version=program._version,
+                       random_seed=program.random_seed)
+    for t, v in saved_op_versions().items():
+        p.op_versions[t] = v
+    for block in program.blocks:
+        b = p.blocks.add(idx=block.idx, parent_idx=block.parent_idx)
+        for var in block.vars.values():
+            vd = b.vars.add(name=var.name, dtype=var.dtype or "",
+                            persistable=var.persistable,
+                            stop_gradient=var.stop_gradient,
+                            is_parameter=var.is_parameter,
+                            trainable=var.trainable,
+                            lod_level=var.lod_level,
+                            is_data=var.is_data)
+            if var.shape is not None:
+                vd.has_shape = True
+                vd.shape.extend(int(s) for s in var.shape)
+            if var.initializer is not None:
+                vd.initializer_json = json.dumps(
+                    var.initializer, default=str).encode()
+            vd.type = _VAR_TYPES.get(
+                var.attrs.get("var_type", "DENSE_TENSOR"),
+                pb.VarDesc.DENSE_TENSOR)
+        for op in block.ops:
+            od = b.ops.add(type=op.type)
+            for slot, names in op.inputs.items():
+                od.inputs[slot].names.extend(names)
+            for slot, names in op.outputs.items():
+                od.outputs[slot].names.extend(names)
+            for name, value in sorted(op.attrs.items()):
+                _set_attr(od.attrs.add(name=name), value)
+    return p
+
+
+def program_from_proto(proto: "pb.ProgramDesc"):
+    from .program import Program, Block, VarDesc, OpDesc
+    prog = Program()
+    prog._version = proto.version
+    prog.random_seed = proto.random_seed
+    saved_vers = dict(proto.op_versions)
+    prog.blocks = []
+    for bd in proto.blocks:
+        b = Block(prog, bd.idx, bd.parent_idx)
+        for vd in bd.vars:
+            v = VarDesc(vd.name,
+                        list(vd.shape) if vd.has_shape else None,
+                        vd.dtype or None, vd.persistable, vd.stop_gradient,
+                        vd.is_parameter,
+                        json.loads(vd.initializer_json.decode())
+                        if vd.initializer_json else None,
+                        vd.trainable, vd.lod_level, vd.is_data, b)
+            if vd.type != pb.VarDesc.DENSE_TENSOR:
+                v.attrs["var_type"] = pb.VarDesc.VarType.Name(vd.type)
+            b.vars[v.name] = v
+        for od in bd.ops:
+            attrs = {a.name: _get_attr(a) for a in od.attrs}
+            attrs = upgrade_op(od.type, attrs, saved_vers.get(od.type, 1))
+            b.ops.append(OpDesc(
+                od.type,
+                {s: list(nl.names) for s, nl in od.inputs.items()},
+                {s: list(nl.names) for s, nl in od.outputs.items()},
+                attrs))
+        prog.blocks.append(b)
+    prog._uid = max((op.attrs.get("op_uid", 0)
+                     for b in prog.blocks for op in b.ops), default=0)
+    return prog
+
+
+def serialize_program(program) -> bytes:
+    return program_to_proto(program).SerializeToString()
+
+
+def deserialize_program(data: bytes):
+    return program_from_proto(pb.ProgramDesc.FromString(data))

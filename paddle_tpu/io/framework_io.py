@@ -166,7 +166,7 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         program_only=False):
+                         program_only=False, model_format="json"):
     import copy
     from ..core.program import default_main_program, OpRole
     prog = main_program or default_main_program()
@@ -187,12 +187,22 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     inference._fetch_names = fetch_names
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or "__model__")
-    payload = {"program": inference.to_dict(),
-               "feed_names": list(feeded_var_names),
-               "fetch_names": fetch_names}
     import json
-    with open(model_path, "w") as f:
-        json.dump(payload, f, sort_keys=True)
+    if model_format == "proto":
+        # binary container: magic + length-prefixed JSON feed/fetch header,
+        # then the framework.proto ProgramDesc bytes (core/serialization.py)
+        header = json.dumps({"feed_names": list(feeded_var_names),
+                             "fetch_names": fetch_names}).encode()
+        body = inference.serialize_to_string(format="proto")
+        with open(model_path, "wb") as f:
+            f.write(b"PTIM" + len(header).to_bytes(4, "little") +
+                    header + body)
+    else:
+        payload = {"program": inference.to_dict(),
+                   "feed_names": list(feeded_var_names),
+                   "fetch_names": fetch_names}
+        with open(model_path, "w") as f:
+            json.dump(payload, f, sort_keys=True)
     if not program_only:
         save_persistables(executor, dirname, inference,
                           filename=params_filename)
@@ -204,10 +214,16 @@ def load_inference_model(dirname, executor, model_filename=None,
     import json
     from ..core.program import Program
     model_path = os.path.join(dirname, model_filename or "__model__")
-    with open(model_path) as f:
-        payload = json.load(f)
-    prog = Program.parse_from_string(
-        json.dumps(payload["program"]).encode())
+    with open(model_path, "rb") as f:
+        raw = f.read()
+    if raw[:4] == b"PTIM":  # binary proto container (model_format="proto")
+        hlen = int.from_bytes(raw[4:8], "little")
+        payload = json.loads(raw[8:8 + hlen].decode())
+        prog = Program.parse_from_string(raw[8 + hlen:])
+    else:
+        payload = json.loads(raw.decode())
+        prog = Program.parse_from_string(
+            json.dumps(payload["program"]).encode())
     feed_names = payload["feed_names"]
     fetch_names = payload["fetch_names"]
     load_persistables(executor, dirname, prog, filename=params_filename)

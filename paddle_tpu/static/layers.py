@@ -1563,6 +1563,10 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
                   padding_start=None, param_attr=None, bias_attr=None,
                   act=None, name=None):
     """fluid.layers.sequence_conv (sequence_conv_op.cc) on padded input."""
+    if filter_stride != 1:
+        # the kernel computes stride-1 context windows (so does the
+        # reference op: sequence_conv_op.cc enforces contextStride == 1)
+        raise ValueError("sequence_conv only supports filter_stride=1")
     helper = LayerHelper("sequence_conv", name=name)
     w = helper.create_parameter(
         param_attr, [filter_size * input.shape[-1], num_filters], input.dtype)

@@ -1,0 +1,133 @@
+"""Binary (proto) Program serialization + op-version upgrade tests
+(reference: framework.proto round-trips in framework/program_desc_test.cc,
+op_version_registry_test.cc)."""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+from paddle_tpu.core.program import Program
+from paddle_tpu.core import op_version
+
+
+def _small_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        # constant init so both programs start from identical weights
+        h = layers.fc(x, 16, act="relu",
+                      param_attr=static.ParamAttr(
+                          initializer=static.Constant(0.3)))
+        pred = layers.fc(h, 1,
+                         param_attr=static.ParamAttr(
+                             initializer=static.Constant(0.1)))
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_proto_roundtrip_runs_identically():
+    main, startup, loss = _small_program()
+    data = main.serialize_to_string(format="proto")
+    assert not data.lstrip().startswith(b"{")  # actually binary
+    clone = Program.parse_from_string(data)
+    # structural identity
+    assert clone.fingerprint() == main.fingerprint()
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(4, 8).astype(np.float32)
+    yb = rng.rand(4, 1).astype(np.float32)
+    exe = static.Executor()
+    outs = []
+    for prog in (main, clone):
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            losses = [float(np.asarray(
+                exe.run(prog, feed={"x": xb, "y": yb},
+                        fetch_list=[loss.name])[0])) for _ in range(3)]
+            outs.append(losses)
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+
+
+def test_json_and_proto_agree():
+    main, _, _ = _small_program()
+    via_json = Program.parse_from_string(main.serialize_to_string())
+    via_proto = Program.parse_from_string(
+        main.serialize_to_string(format="proto"))
+    assert via_json.fingerprint() == via_proto.fingerprint()
+
+
+def test_attr_type_fidelity():
+    p = Program()
+    b = p.global_block()
+    b.create_var("x", [2, 2])
+    attrs = {"i": 7, "f": 0.5, "s": "hello", "b_true": True, "b_false": False,
+             "ints": [1, 2, 3], "floats": [1.5, 2.5], "strs": ["a", "b"],
+             "bools": [True, False], "empty": [],
+             "nested": {"k": [1, 2], "s": "v"}, "none": None}
+    b.append_op("scale", {"X": ["x"]}, {"Out": ["x"]}, dict(attrs))
+    clone = Program.parse_from_string(p.serialize_to_string(format="proto"))
+    got = clone.global_block().ops[0].attrs
+    for k, v in attrs.items():
+        assert got[k] == v, (k, got[k], v)
+    assert isinstance(got["i"], int) and isinstance(got["f"], float)
+    assert got["b_true"] is True and got["none"] is None
+
+
+def test_mixed_type_lists_and_var_type_roundtrip():
+    p = Program()
+    b = p.global_block()
+    v = b.create_var("rows", [10, 4])
+    v.attrs["var_type"] = "SELECTED_ROWS"
+    b.append_op("scale", {"X": ["rows"]}, {"Out": ["rows"]},
+                {"mixed_if": [1, 2.5], "mixed_bi": [True, 2]})
+    for fmt in ("json", "proto"):
+        clone = Program.parse_from_string(p.serialize_to_string(format=fmt))
+        got = clone.global_block().ops[0].attrs
+        assert got["mixed_if"] == [1, 2.5], (fmt, got)
+        assert got["mixed_bi"] == [True, 2], (fmt, got)
+        assert clone.global_block().var("rows").attrs["var_type"] == \
+            "SELECTED_ROWS", fmt
+        # survives a second serialize (write side reads the same place)
+        again = Program.parse_from_string(
+            clone.serialize_to_string(format=fmt))
+        assert again.global_block().var("rows").attrs["var_type"] == \
+            "SELECTED_ROWS", fmt
+
+
+def test_op_version_upgrade_on_load():
+    # a program saved before lookup_table_v2 v2 (no is_sparse attr, no
+    # op_versions map) must load with the v1-behaviour default filled in
+    p = Program()
+    b = p.global_block()
+    b.create_var("W", [10, 4], is_parameter=True, persistable=True)
+    b.create_var("Ids", [2, 3], dtype="int64")
+    b.create_var("Out", [2, 3, 4])
+    b.append_op("lookup_table_v2", {"W": ["W"], "Ids": ["Ids"]},
+                {"Out": ["Out"]}, {"padding_idx": -1})
+    import json
+    d = json.loads(p.serialize_to_string().decode())
+    d.pop("op_versions", None)                      # simulate v1 artifact
+    for od in d["blocks"][0]["ops"]:
+        od["attrs"].pop("is_sparse", None)
+    clone = Program.parse_from_string(json.dumps(d).encode())
+    op = clone.global_block().ops[0]
+    assert op.attrs["is_sparse"] is False
+
+
+def test_op_version_registry_rules():
+    reg = op_version.OpVersionRegistry()
+    reg.register("myop", 2, renamed_attrs={"old": "new"})
+    reg.register("myop", 3, new_attrs={"extra": 5}, deleted_attrs=["dead"])
+    assert reg.version("myop") == 3
+    assert reg.version("other") == 1
+    attrs = reg.upgrade("myop", {"old": 1, "dead": 2}, saved_version=1)
+    assert attrs == {"new": 1, "extra": 5}
+    # already-current attrs untouched
+    attrs = reg.upgrade("myop", {"new": 1, "extra": 9}, saved_version=3)
+    assert attrs == {"new": 1, "extra": 9}
+    # monotonic version enforcement
+    import pytest
+    with pytest.raises(ValueError):
+        reg.register("myop", 3)
