@@ -1,0 +1,135 @@
+"""Graph pass framework — registry + pipeline over Program IR.
+
+Analog of /root/reference/paddle/fluid/framework/ir/pass.h:40-60
+(`Pass::Apply`, REGISTER_PASS) generalized from the inference-only pipeline
+it started as: passes here rewrite ANY Program — training graphs included —
+before the executor jits them.  The reference runs ~92 passes; under XLA
+most (fusion, memory planning, inplace) are subsumed by the compiler, so
+this registry holds the passes that change graph *semantics*:
+inference folds (inference/passes.py), distributed rewrites
+(sync_batch_norm), diagnostics (graph_viz), and cleanup (DCE).
+
+`PassContext` carries the scope for weight-rewriting passes plus per-pass
+hit statistics (pass.h records similar stats via PADDLE_ENFORCE checks).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from .program import Program, OpDesc, OpRole
+
+__all__ = ["register_pass", "get_pass", "apply_passes", "PassContext",
+           "all_passes"]
+
+_PASSES: Dict[str, Callable] = {}
+
+
+class PassContext:
+    """Carries the scope (loaded params) for weight-rewriting passes, free
+    attributes for pass-specific knobs (e.g. graphviz path), and stats."""
+
+    def __init__(self, scope=None, **attrs):
+        self.scope = scope
+        self.stats: Dict[str, int] = {}
+        self.attrs: Dict[str, object] = dict(attrs)
+
+    def hit(self, name, n=1):
+        self.stats[name] = self.stats.get(name, 0) + n
+
+
+def register_pass(name: str):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def get_pass(name: str) -> Callable:
+    return _PASSES[name]
+
+
+def all_passes() -> List[str]:
+    return sorted(_PASSES)
+
+
+def apply_passes(program: Program, names: List[str],
+                 ctx: Optional[PassContext] = None) -> Program:
+    ctx = ctx or PassContext()
+    for n in names:
+        program = _PASSES[n](program, ctx)
+        program._fingerprint_cache = None
+    return program
+
+
+# ---------------------------------------------------------------------------
+# general (training-graph) passes
+# ---------------------------------------------------------------------------
+@register_pass("sync_batch_norm_pass")
+def sync_batch_norm_pass(program: Program, ctx: PassContext) -> Program:
+    """ir/sync_batch_norm_pass.cc:56 — rewrite every training-mode
+    batch_norm into sync_batch_norm so batch statistics are reduced across
+    the data-parallel mesh axis (the kernel psums count/sum/sumsq over the
+    ring bound to ring_id, ops/kernels/nn.py sync_batch_norm)."""
+    for block in program.blocks:
+        # a batch_norm_grad replays the *forward* kernel under vjp
+        # (ops/registry.py auto-grad), so it must be rewritten in lockstep
+        # with its forward op or gradients use local instead of synced stats
+        rewritten_outs = set()
+        for op in block.ops:
+            if op.type == "batch_norm" and not op.attrs.get("is_test"):
+                op.type = "sync_batch_norm"
+                op.attrs.setdefault("ring_id", 0)
+                rewritten_outs.update(op.output_names())
+                ctx.hit("sync_batch_norm_pass")
+            elif op.type == "batch_norm_grad" and \
+                    not op.attrs.get("is_test") and \
+                    any(n in rewritten_outs for n in op.input_names()):
+                op.type = "sync_batch_norm_grad"
+                op.attrs.setdefault("ring_id", 0)
+    return program
+
+
+@register_pass("graph_viz_pass")
+def graph_viz_pass(program: Program, ctx: PassContext) -> Program:
+    """ir/graph_viz_pass.cc — dump the graph as DOT.  Path comes from
+    PassContext(graph_viz_path=...); defaults to ./program.dot."""
+    from ..utils.debugger import program_to_dot
+    path = ctx.attrs.get("graph_viz_path", "program.dot")
+    dot = program_to_dot(program)
+    with open(path, "w") as f:
+        f.write(dot)
+    ctx.hit("graph_viz_pass")
+    return program
+
+
+@register_pass("dead_code_elimination_pass")
+def dead_code_elimination_pass(program: Program,
+                               ctx: PassContext) -> Program:
+    """Remove ops none of whose outputs are consumed, fetched, or
+    persistable (the graph-level half of the reference's
+    eager_deletion/reference_count memory passes — buffer lifetime itself
+    is XLA's job here, so only genuinely dead *ops* are cut)."""
+    from ..ops.registry import get_op_info
+    fetches = set(getattr(program, "_fetch_names", ()) or ())
+    block = program.global_block()
+    changed = True
+    while changed:
+        changed = False
+        consumed = set()
+        for op in block.ops:
+            consumed.update(op.input_names())
+        kept = []
+        for op in block.ops:
+            info = get_op_info(op.type)
+            side_effect = info is not None and info.side_effect
+            live = side_effect or any(
+                n in consumed or n in fetches or
+                (block.has_var(n) and block.var(n).persistable)
+                for n in op.output_names())
+            if live:
+                kept.append(op)
+            else:
+                ctx.hit("dead_code_elimination_pass")
+                changed = True
+        block.ops = kept
+    return program

@@ -432,14 +432,12 @@ class Program:
         return json.dumps(self.to_dict(), sort_keys=True).encode("utf-8")
 
     @staticmethod
-    def parse_from_string(data: bytes) -> "Program":
-        """Auto-detects the wire format: JSON starts with '{', anything else
-        is the framework.proto binary form."""
-        if not data.lstrip()[:1] == b"{":
-            from .serialization import deserialize_program
-            return deserialize_program(data)
+    def from_dict(d: dict) -> "Program":
+        """Reconstruct from the to_dict() form, replaying op-version
+        upgrade rules (core/op_version.py) for ops saved under an older
+        schema.  Both wire formats (JSON and framework.proto binary)
+        funnel through here so load-time behavior can never diverge."""
         from .op_version import upgrade_op
-        d = json.loads(data.decode("utf-8"))
         saved_vers = d.get("op_versions", {})
         p = Program()
         p.random_seed = d.get("random_seed", 0)
@@ -458,6 +456,15 @@ class Program:
         p._uid = max((op.attrs.get("op_uid", 0)
                       for b in p.blocks for op in b.ops), default=0)
         return p
+
+    @staticmethod
+    def parse_from_string(data: bytes) -> "Program":
+        """Auto-detects the wire format: JSON starts with '{', anything else
+        is the framework.proto binary form."""
+        if not data.lstrip()[:1] == b"{":
+            from .serialization import deserialize_program
+            return deserialize_program(data)
+        return Program.from_dict(json.loads(data.decode("utf-8")))
 
     def __repr__(self):
         lines = [f"Program(blocks={len(self.blocks)})"]

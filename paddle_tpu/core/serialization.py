@@ -17,7 +17,7 @@ import json
 from typing import Any
 
 from . import framework_pb2 as pb
-from .op_version import saved_op_versions, upgrade_op
+from .op_version import saved_op_versions
 
 __all__ = ["program_to_proto", "program_from_proto",
            "serialize_program", "deserialize_program"]
@@ -108,38 +108,43 @@ def program_to_proto(program) -> "pb.ProgramDesc":
     return p
 
 
-def program_from_proto(proto: "pb.ProgramDesc"):
-    from .program import Program, Block, VarDesc, OpDesc
-    prog = Program()
-    prog._version = proto.version
-    prog.random_seed = proto.random_seed
-    saved_vers = dict(proto.op_versions)
-    prog.blocks = []
+def _proto_to_dict(proto: "pb.ProgramDesc") -> dict:
+    """Lower the proto to the to_dict() form; Program.from_dict does the
+    actual reconstruction (single shared path with the JSON format)."""
+    d = {"version": proto.version, "random_seed": proto.random_seed,
+         "op_versions": dict(proto.op_versions), "blocks": []}
     for bd in proto.blocks:
-        b = Block(prog, bd.idx, bd.parent_idx)
+        vars_ = []
         for vd in bd.vars:
-            v = VarDesc(vd.name,
-                        list(vd.shape) if vd.has_shape else None,
-                        vd.dtype or None, vd.persistable, vd.stop_gradient,
-                        vd.is_parameter,
-                        json.loads(vd.initializer_json.decode())
-                        if vd.initializer_json else None,
-                        vd.trainable, vd.lod_level, vd.is_data, b)
+            v = {"name": vd.name,
+                 "shape": list(vd.shape) if vd.has_shape else None,
+                 "dtype": vd.dtype or None,
+                 "persistable": vd.persistable,
+                 "stop_gradient": vd.stop_gradient,
+                 "is_parameter": vd.is_parameter,
+                 "initializer": (json.loads(vd.initializer_json.decode())
+                                 if vd.initializer_json else None),
+                 "trainable": vd.trainable,
+                 "lod_level": vd.lod_level,
+                 "is_data": vd.is_data}
             if vd.type != pb.VarDesc.DENSE_TENSOR:
-                v.attrs["var_type"] = pb.VarDesc.VarType.Name(vd.type)
-            b.vars[v.name] = v
-        for od in bd.ops:
-            attrs = {a.name: _get_attr(a) for a in od.attrs}
-            attrs = upgrade_op(od.type, attrs, saved_vers.get(od.type, 1))
-            b.ops.append(OpDesc(
-                od.type,
-                {s: list(nl.names) for s, nl in od.inputs.items()},
-                {s: list(nl.names) for s, nl in od.outputs.items()},
-                attrs))
-        prog.blocks.append(b)
-    prog._uid = max((op.attrs.get("op_uid", 0)
-                     for b in prog.blocks for op in b.ops), default=0)
-    return prog
+                v["var_type"] = pb.VarDesc.VarType.Name(vd.type)
+            vars_.append(v)
+        ops = [{"type": od.type,
+                "inputs": {s: list(nl.names)
+                           for s, nl in od.inputs.items()},
+                "outputs": {s: list(nl.names)
+                            for s, nl in od.outputs.items()},
+                "attrs": {a.name: _get_attr(a) for a in od.attrs}}
+               for od in bd.ops]
+        d["blocks"].append({"idx": bd.idx, "parent_idx": bd.parent_idx,
+                            "vars": vars_, "ops": ops})
+    return d
+
+
+def program_from_proto(proto: "pb.ProgramDesc"):
+    from .program import Program
+    return Program.from_dict(_proto_to_dict(proto))
 
 
 def serialize_program(program) -> bytes:

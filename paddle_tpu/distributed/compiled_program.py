@@ -216,14 +216,28 @@ class CompiledProgram:
         return self._mesh
 
     def _get_program(self) -> Program:
-        if not self._is_data_parallel:
-            return self._program
         if self._rewritten is None:
             n = len(self._devices())
-            scale = (self._build_strategy.gradient_scale_strategy ==
-                     GradientScaleStrategy.CoeffNumDevice and n > 1)
-            self._rewritten = insert_grad_allreduce(self._program,
-                                                    scale=scale)
+            if self._is_data_parallel:
+                scale = (self._build_strategy.gradient_scale_strategy ==
+                         GradientScaleStrategy.CoeffNumDevice and n > 1)
+                rewritten = insert_grad_allreduce(self._program, scale=scale)
+            else:
+                rewritten = self._program
+            # BuildStrategy-driven graph passes (build_strategy.cc:58-237
+            # pass-pipeline assembly analog; core/pass_framework.py)
+            from ..core.pass_framework import apply_passes, PassContext
+            names = []
+            if self._build_strategy.sync_batch_norm and \
+                    self._is_data_parallel and n > 1:
+                names.append("sync_batch_norm_pass")
+            if getattr(self._build_strategy, "debug_graphviz_path", ""):
+                names.append("graph_viz_pass")
+            if names:
+                ctx = PassContext(graph_viz_path=self._build_strategy
+                                  .debug_graphviz_path or "program.dot")
+                rewritten = apply_passes(rewritten, names, ctx)
+            self._rewritten = rewritten
         return self._rewritten
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):

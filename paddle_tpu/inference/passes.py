@@ -15,51 +15,18 @@ loaded scope).
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
 from ..core.program import Program, OpDesc, OpRole
+# the framework core lives in core/pass_framework.py (shared with training
+# passes); re-exported here for API compatibility
+from ..core.pass_framework import (register_pass, get_pass, apply_passes,
+                                   PassContext, all_passes)
 
 __all__ = ["register_pass", "get_pass", "apply_passes", "PassContext",
            "all_passes", "DEFAULT_INFERENCE_PASSES"]
-
-_PASSES: Dict[str, Callable] = {}
-
-
-class PassContext:
-    """Carries the scope (loaded params) for weight-rewriting passes."""
-
-    def __init__(self, scope=None):
-        self.scope = scope
-        self.stats: Dict[str, int] = {}
-
-    def hit(self, name, n=1):
-        self.stats[name] = self.stats.get(name, 0) + n
-
-
-def register_pass(name: str):
-    def deco(fn):
-        _PASSES[name] = fn
-        return fn
-    return deco
-
-
-def get_pass(name: str) -> Callable:
-    return _PASSES[name]
-
-
-def all_passes() -> List[str]:
-    return sorted(_PASSES)
-
-
-def apply_passes(program: Program, names: List[str],
-                 ctx: Optional[PassContext] = None) -> Program:
-    ctx = ctx or PassContext()
-    for n in names:
-        program = _PASSES[n](program, ctx)
-        program._fingerprint_cache = None
-    return program
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,124 @@
+"""Pass-framework tests (core/pass_framework.py — the generalized C16
+registry: training-graph passes + BuildStrategy wiring; reference pattern:
+ir/*_tester.cc build a tiny graph, apply a pass, assert graph shape)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+from paddle_tpu.core.pass_framework import (apply_passes, PassContext,
+                                            all_passes, get_pass)
+
+
+def test_registry_is_shared_with_inference():
+    # inference passes and training passes live in one registry
+    import paddle_tpu.inference.passes as ip
+    names = all_passes()
+    assert "fc_fuse_pass" in names            # inference-side
+    assert "sync_batch_norm_pass" in names    # training-side
+    assert ip.all_passes() == names
+
+
+def test_sync_batch_norm_pass_rewrites_training_bn():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4, 8, 8])
+        h = layers.conv2d(x, 8, 3, padding=1)
+        h = layers.batch_norm(h)
+        test_h = layers.batch_norm(h)
+        test_op = main.global_block().ops[-1]
+        test_op.attrs["is_test"] = True       # inference bn must be left alone
+    ctx = PassContext()
+    out = apply_passes(main, ["sync_batch_norm_pass"], ctx)
+    types = [op.type for op in out.global_block().ops]
+    assert types.count("sync_batch_norm") == 1
+    assert types.count("batch_norm") == 1
+    assert ctx.stats["sync_batch_norm_pass"] == 1
+    sbn = next(op for op in out.global_block().ops
+               if op.type == "sync_batch_norm")
+    assert sbn.attrs["ring_id"] == 0
+
+
+def test_sync_batch_norm_via_build_strategy_runs():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 8)
+        h = layers.batch_norm(h)
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.01).minimize(loss)
+    bs = BuildStrategy()
+    bs.sync_batch_norm = True
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        cp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            (lv,) = exe.run(cp, feed={
+                "x": rng.rand(16, 4).astype(np.float32),
+                "y": rng.rand(16, 1).astype(np.float32)},
+                fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv)).all()
+    # the executed program really got the rewrite — including the grad op,
+    # whose vjp replays the forward and must see the synced statistics
+    types = [op.type for op in cp._get_program().global_block().ops]
+    assert "sync_batch_norm" in types and "batch_norm" not in types
+    assert "sync_batch_norm_grad" in types and \
+        "batch_norm_grad" not in types
+
+
+def test_graphviz_without_data_parallel(tmp_path):
+    # BuildStrategy knobs must work on a plain CompiledProgram too
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        layers.fc(x, 2)
+    bs = BuildStrategy()
+    path = str(tmp_path / "plain.dot")
+    bs.debug_graphviz_path = path
+    cp = CompiledProgram(main, build_strategy=bs)
+    cp._get_program()
+    assert "digraph" in open(path).read()
+
+
+def test_dead_code_elimination():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        live = layers.fc(x, 2)
+        dead = layers.scale(layers.fc(x, 3), scale=2.0)  # nothing reads it
+    main._fetch_names = [live.name]
+    n_before = len(main.global_block().ops)
+    ctx = PassContext()
+    out = apply_passes(main, ["dead_code_elimination_pass"], ctx)
+    n_after = len(out.global_block().ops)
+    assert ctx.stats["dead_code_elimination_pass"] >= 2  # fc chain + scale
+    assert n_after < n_before
+    names = {n for op in out.global_block().ops for n in op.output_names()}
+    assert dead.name not in names
+    assert live.name in names
+
+
+def test_graph_viz_pass(tmp_path):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        layers.fc(x, 2)
+    path = str(tmp_path / "g.dot")
+    apply_passes(main, ["graph_viz_pass"], PassContext(graph_viz_path=path))
+    dot = open(path).read()
+    assert "digraph" in dot and "mul" in dot
