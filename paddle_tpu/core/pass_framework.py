@@ -108,9 +108,16 @@ def dead_code_elimination_pass(program: Program,
     """Remove ops none of whose outputs are consumed, fetched, or
     persistable (the graph-level half of the reference's
     eager_deletion/reference_count memory passes — buffer lifetime itself
-    is XLA's job here, so only genuinely dead *ops* are cut)."""
+    is XLA's job here, so only genuinely dead *ops* are cut).
+
+    Fetch roots come from PassContext(fetch_names=...) or the program's
+    own _fetch_names; with no roots at all the pass refuses to run (it
+    would otherwise delete the whole graph of a forward-only program)."""
     from ..ops.registry import get_op_info
-    fetches = set(getattr(program, "_fetch_names", ()) or ())
+    fetches = set(ctx.attrs.get("fetch_names", ()) or
+                  getattr(program, "_fetch_names", ()) or ())
+    if not fetches:
+        return program
     block = program.global_block()
     changed = True
     while changed:

@@ -39,6 +39,11 @@ class DistributeTranspilerConfig:
         self.sync_mode = True
         self.geo_sgd_mode = False
         self.geo_sgd_need_push_nums = 100
+        # True → get_trainer_program returns a plain Program carrying
+        # send/fetch_barrier/recv GRAPH OPS (reference transpiler shape,
+        # distribute_transpiler.py:256); False → the runtime-managed
+        # PSCompiledProgram push/pull path
+        self.use_graph_ops = False
 
 
 def _strip_optimizer_ops(program: Program) -> Program:
@@ -172,13 +177,30 @@ class DistributeTranspiler:
         self._trainers = trainers
         self._trainer_id = trainer_id
         self._current_endpoint = current_endpoint
+        if self.config.use_graph_ops and self.config.geo_sgd_mode:
+            raise ValueError(
+                "use_graph_ops does not support geo_sgd_mode (geo's "
+                "every-k-steps delta push is runtime-managed; use the "
+                "PSCompiledProgram path)")
+        if self.config.use_graph_ops:
+            # rewrite the startup program NOW (reference transpiler shape:
+            # startup carries init send → barrier → recv so exe.run(startup)
+            # works no matter when get_trainer_program() is called)
+            pgs = getattr(self._program, "_ps_params_grads", None)
+            if pgs is None:
+                raise RuntimeError(
+                    "transpile() requires a program minimized by an "
+                    "optimizer (params_grads recorded)")
+            self._rewrite_startup_with_graph_ops(pgs)
 
-    def get_trainer_program(self, wait_port=True) -> PSCompiledProgram:
+    def get_trainer_program(self, wait_port=True):
         pgs = getattr(self._program, "_ps_params_grads", None)
         if pgs is None:
             raise RuntimeError(
                 "transpile() requires a program minimized by an optimizer "
                 "(params_grads recorded)")
+        if self.config.use_graph_ops and not self.config.geo_sgd_mode:
+            return self._transpile_with_graph_ops(pgs)
         if self.config.geo_sgd_mode:
             mode = "geo"
             prog = self._program  # geo keeps local optimizer ops
@@ -189,6 +211,80 @@ class DistributeTranspiler:
             prog, pgs, mode=mode,
             geo_k=self.config.geo_sgd_need_push_nums,
             endpoints=self._pservers, trainer_id=self._trainer_id)
+
+    def _transpile_with_graph_ops(self, params_grads) -> Program:
+        """Reference transpiler shape (distribute_transpiler.py:256): the
+        returned trainer Program itself carries `send` (grads out) →
+        `fetch_barrier` → `recv` (params in) ops; exe.run of the program IS
+        the PS step.  Startup gets a mode="init" send pushing initial
+        params to the server (pserver-side startup analog)."""
+        # read the exact lr var off the optimizer ops before stripping them
+        lr_var = next(
+            (op.inputs["LearningRate"][0]
+             for op in self._program.global_block().ops
+             if (op.op_role & OpRole.Optimize) and
+             op.inputs.get("LearningRate")), None)
+        prog = _strip_optimizer_ops(self._program.clone())
+        block = prog.global_block()
+        param_names = [p.name for p, _ in params_grads]
+        grad_names = [g.name for _, g in params_grads]
+        mode = "grad_sync" if self.config.sync_mode else "grad_async"
+        if lr_var is not None and not block.has_var(lr_var):
+            lr_var = None
+        if lr_var is None:
+            lr_var = next((v.name for v in block.vars.values()
+                           if v.persistable and
+                           v.name.startswith("learning_rate")), None)
+        send_ins = {"X": grad_names}
+        if lr_var:
+            send_ins["LearningRate"] = [lr_var]
+        dummy = block.create_var(shape=[1], dtype="float32")
+        block.append_op("send", send_ins, {"Dummy": [dummy.name]},
+                        {"send_varnames": param_names,
+                         "endpoints": list(self._pservers),
+                         "mode": mode, OpRole.KEY: OpRole.RPC})
+        block.append_op("fetch_barrier", {"X": [dummy.name]}, {},
+                        {"endpoints": list(self._pservers),
+                         OpRole.KEY: OpRole.RPC})
+        block.append_op(
+            "recv", {"Dummy": [dummy.name]}, {"Out": param_names},
+            {"recv_varnames": param_names,
+             "endpoints": list(self._pservers),
+             "shapes": [list(block.var(n).shape) for n in param_names],
+             "dtypes": [block.var(n).dtype for n in param_names],
+             OpRole.KEY: OpRole.RPC})
+        return prog
+
+    def _rewrite_startup_with_graph_ops(self, params_grads):
+        """Startup push of locally-initialized params (first writer wins)
+        followed by a pull of the winning values so every trainer starts
+        identical (reference distribute_transpiler startup rewrite);
+        guarded so repeated transpile() calls don't stack duplicate ops."""
+        if getattr(self._startup, "_ps_startup_transpiled", False):
+            return
+        mb = self._program.global_block()
+        param_names = [p.name for p, _ in params_grads]
+        sb = self._startup.global_block()
+        for n in param_names:
+            if not sb.has_var(n):
+                sb.create_var(n, mb.var(n).shape, mb.var(n).dtype,
+                              persistable=True)
+        sdummy = sb.create_var(shape=[1], dtype="float32")
+        sb.append_op("send", {"X": param_names}, {"Dummy": [sdummy.name]},
+                     {"send_varnames": param_names,
+                      "endpoints": list(self._pservers),
+                      "mode": "init", OpRole.KEY: OpRole.RPC})
+        sb.append_op("fetch_barrier", {"X": [sdummy.name]}, {},
+                     {"endpoints": list(self._pservers),
+                      OpRole.KEY: OpRole.RPC})
+        sb.append_op(
+            "recv", {"Dummy": [sdummy.name]}, {"Out": param_names},
+            {"recv_varnames": param_names,
+             "endpoints": list(self._pservers),
+             "shapes": [list(mb.var(n).shape) for n in param_names],
+             "dtypes": [mb.var(n).dtype for n in param_names],
+             OpRole.KEY: OpRole.RPC})
+        self._startup._ps_startup_transpiled = True
 
     def get_pserver_program(self, endpoint) -> Program:
         """A marker program whose execution serves the KV store

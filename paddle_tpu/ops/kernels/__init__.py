@@ -24,4 +24,5 @@ from . import (  # noqa: F401
     detection,
     ctc_crf,
     decode,
+    distributed_ops,
 )

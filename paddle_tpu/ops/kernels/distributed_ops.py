@@ -1,0 +1,102 @@
+"""Parameter-server graph ops: send / recv / fetch_barrier.
+
+Reference: /root/reference/paddle/fluid/operators/distributed_ops/
+{send_op.cc, recv_op.cc, fetch_barrier_op.cc} — the transpiled trainer
+program carries its PS communication as ops (grads flow out through `send`,
+fresh params flow in through `recv`).
+
+TPU-native redesign: the trainer step stays ONE jitted XLA computation;
+the RPC plane is reached through `jax.experimental.io_callback`
+(ordered=True), so XLA schedules the host round-trip inside the step with
+send → barrier → recv ordering preserved.  The wire protocol is the
+host-side KV service (distributed/ps/kv_server.py), not gRPC — same
+capability, one less moving part.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..registry import register_op
+
+_CLIENTS: Dict[Tuple[str, ...], object] = {}
+
+
+def _client(endpoints):
+    key = tuple(endpoints)
+    if key not in _CLIENTS:
+        from ...distributed.ps.kv_server import KVClient
+        c = KVClient(list(endpoints))
+        c.wait_server_ready()
+        _CLIENTS[key] = c
+    return _CLIENTS[key]
+
+
+def _reset_clients():
+    _CLIENTS.clear()
+
+
+@register_op("send", inputs=["X*", "LearningRate?"], outputs=["Dummy?"],
+             grad=None, side_effect=True)
+def send(ins, attrs, ctx):
+    """Push grads (mode grad_sync/grad_async: server applies SGD), initial
+    params (mode init: first writer wins), or geo deltas (mode delta)."""
+    names = list(attrs["send_varnames"])
+    endpoints = tuple(attrs["endpoints"])
+    mode = attrs.get("mode", "grad_sync")
+    lr_attr = float(attrs.get("lr", 0.01))
+    xs = list(ins["X"] or [])
+    lr_in = ins.get("LearningRate")
+    lr_arr = (lr_in.reshape(()) if lr_in is not None
+              else jnp.asarray(lr_attr, jnp.float32))
+
+    def host(lr, *arrs):
+        c = _client(endpoints)
+        for n, a in zip(names, arrs):
+            a = np.asarray(a)
+            if mode == "init":
+                c.init_param(n, a)
+            elif mode == "delta":
+                c.push_delta(n, a)
+            else:
+                c.push_grad(n, a, float(lr), sync=(mode == "grad_sync"))
+        return np.zeros((1,), np.float32)
+
+    dummy = io_callback(host, jax.ShapeDtypeStruct((1,), jnp.float32),
+                        lr_arr, *xs, ordered=True)
+    return {"Dummy": dummy}
+
+
+@register_op("recv", inputs=["Dummy?!"], outputs=["Out*"], grad=None,
+             side_effect=True)
+def recv(ins, attrs, ctx):
+    """Pull fresh parameter values; outputs write the param vars (the
+    executor threads persistable outputs into the step's new state, same
+    path optimizer ops use)."""
+    names = list(attrs["recv_varnames"])
+    endpoints = tuple(attrs["endpoints"])
+    shapes = [tuple(s) for s in attrs["shapes"]]
+    dtypes = [np.dtype(d) for d in attrs["dtypes"]]
+
+    def host():
+        c = _client(endpoints)
+        return tuple(np.asarray(c.pull(n), dtype=d)
+                     for n, d in zip(names, dtypes))
+
+    result = [jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)]
+    outs = io_callback(host, tuple(result), ordered=True)
+    return {"Out": list(outs)}
+
+
+@register_op("fetch_barrier", inputs=["X*!"], outputs=[], grad=None,
+             side_effect=True)
+def fetch_barrier(ins, attrs, ctx):
+    """fetch_barrier_op.cc parity: ordering marker between send and recv.
+    io_callback(ordered=True) already serializes the host round-trips, so
+    this is a no-op that exists so transpiled programs keep the reference's
+    op sequence."""
+    return {}

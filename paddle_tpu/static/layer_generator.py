@@ -1,0 +1,106 @@
+"""Auto-generated layer functions from the op registry.
+
+Analog of /root/reference/python/paddle/fluid/layers/
+layer_function_generator.py — the reference autogenerates ~half its layer
+surface from each op's OpProto; here the registry's slot declarations
+(ops/registry.py Slot) play the OpProto role.  Only mechanically-shaped ops
+(var inputs + a single `Out`) are generated; anything needing parameter
+creation or multi-output plumbing gets a hand-written layer in layers.py.
+
+Generated signature: positional args bind the op's declared input slots in
+order; keyword args become op attrs; `name=` picks the output var name
+prefix.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..ops.registry import all_ops, get_op_info
+from .layer_helper import LayerHelper
+
+__all__ = ["generate_layer_fns"]
+
+# ops that are internal machinery or already exposed through a dedicated
+# API surface (collectives → paddle.distributed, IO ops → executor/io)
+_SKIP_PREFIXES = (
+    "c_", "p_", "fake_", "fused_", "fusion_", "pull_", "push_", "partial_",
+    "create_", "save", "load", "send", "recv", "listen", "fetch", "feed",
+    "read", "write_to_array", "read_from_array", "enqueue", "dequeue",
+    "queue", "gen_", "checkpoint", "distributed_", "lookup_sparse",
+    "merge_", "split_ids", "ref_by", "moving_average_abs",
+)
+_SKIP_EXACT = {
+    "allreduce", "alltoall", "broadcast", "barrier", "cast_with_ptr",
+    "print", "assert", "delete_var", "run_program", "while",
+    "conditional_block", "select_input", "select_output",
+    # autodiff/collective internals — not user layers
+    "grad_add", "scale_by_world_size", "share_data",
+}
+
+# output dtype when it differs from the first input's
+_OUT_DTYPE = {
+    "arg_max": "int64", "arg_min": "int64", "argsort": "int64",
+    "equal_all": "bool", "isfinite": "bool", "isfinite_v2": "bool",
+    "isinf_v2": "bool", "isnan_v2": "bool", "is_empty": "bool",
+    "allclose": "bool",
+    "shape": "int32", "size": "int64",
+}
+
+
+def _make_layer_fn(op_type: str):
+    info = get_op_info(op_type)
+    slot_names = [s.name for s in info.inputs]
+
+    def fn(*args, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        if len(args) > len(slot_names):
+            raise TypeError(
+                f"{op_type} takes at most {len(slot_names)} tensor args "
+                f"({slot_names}), got {len(args)}")
+        inputs = {}
+        first = None
+        for slot, arg in zip(info.inputs, args):
+            if arg is None:
+                continue
+            vs = list(arg) if isinstance(arg, (list, tuple)) else [arg]
+            if first is None and vs:
+                first = vs[0]
+            inputs[slot.name] = vs
+        dtype = _OUT_DTYPE.get(op_type)
+        if dtype is None:
+            dtype = first.dtype if first is not None else "float32"
+        out = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(op_type, inputs=inputs, outputs={"Out": [out]},
+                         attrs=attrs)
+        return out
+
+    fn.__name__ = op_type
+    fn.__qualname__ = op_type
+    fn.__doc__ = (
+        f"Layer for op `{op_type}` (auto-generated from the op registry; "
+        f"layer_function_generator.py analog).  Positional args: "
+        f"{slot_names}; keyword args become op attrs.")
+    return fn
+
+
+def generate_layer_fns(namespace: dict, existing) -> List[str]:
+    """Install generated layer functions for every mechanically-shaped op
+    not already covered; returns the generated names."""
+    made = []
+    existing = set(existing)
+    for op_type in all_ops():
+        if op_type.endswith("_grad") or op_type in existing:
+            continue
+        if op_type.startswith(_SKIP_PREFIXES) or op_type in _SKIP_EXACT:
+            continue
+        info = get_op_info(op_type)
+        # exactly one plain `Out` (duplicable Out* / optional Out? ops need
+        # hand-written plumbing — e.g. static_rnn's sub_block attrs)
+        if len(info.outputs) != 1 or not info.inputs:
+            continue
+        out = info.outputs[0]
+        if out.name != "Out" or out.duplicable or out.optional:
+            continue
+        namespace[op_type] = _make_layer_fn(op_type)
+        made.append(op_type)
+    return made

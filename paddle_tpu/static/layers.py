@@ -1615,3 +1615,13 @@ __all__ += ["dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
             "linear_chain_crf", "crf_decoding", "multiclass_nms",
             "anchor_generator", "bipartite_match", "generate_proposals",
             "yolov3_loss", "py_func"]
+
+
+# ---------------------------------------------------------------------------
+# auto-generated tail: one layer fn per mechanically-shaped registered op
+# (fluid layer_function_generator.py analog; see static/layer_generator.py)
+# ---------------------------------------------------------------------------
+from .layer_generator import generate_layer_fns as _generate_layer_fns  # noqa: E402
+
+_GENERATED_LAYERS = _generate_layer_fns(globals(), dir())
+__all__ += _GENERATED_LAYERS

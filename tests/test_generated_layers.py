@@ -1,0 +1,107 @@
+"""Auto-generated layer surface (static/layer_generator.py —
+layer_function_generator.py analog): build + execute a representative
+sample through the static executor."""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _run(build_fn, feeds):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        outs = build_fn()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed=feeds, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_generated_count():
+    assert len(layers._GENERATED_LAYERS) >= 100
+    # hand-written layers are never shadowed by generated ones
+    assert "fc" not in layers._GENERATED_LAYERS
+    assert "dropout" not in layers._GENERATED_LAYERS
+
+
+def test_generated_unary_binary():
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    y = np.random.RandomState(1).rand(4, 5).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", [-1, 5])
+        yv = layers.data("y", [-1, 5])
+        return (layers.acos(layers.clip(xv, min=-0.9, max=0.9)),
+                layers.dot(xv, yv),
+                layers.erf(xv))
+
+    a, d, e = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(a, np.arccos(np.clip(x, -0.9, 0.9)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(d.reshape(-1), (x * y).sum(1), rtol=1e-5)
+
+
+def test_generated_attr_ops():
+    x = np.random.RandomState(2).rand(3, 7).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", [-1, 7])
+        return (layers.arg_max(xv, axis=1),
+                layers.flip(xv, axis=[1]),
+                layers.log_loss(layers.sigmoid(xv[:, :1]),
+                                layers.ones([3, 1], "float32"))
+                if hasattr(layers, "log_loss") else layers.arg_min(xv,
+                                                                   axis=1),
+                )
+
+    am, fl, _ = _run(build, {"x": x})
+    np.testing.assert_array_equal(am.reshape(-1), x.argmax(1))
+    np.testing.assert_allclose(fl, x[:, ::-1], rtol=1e-6)
+
+
+def test_generated_matmul_family():
+    rng = np.random.RandomState(3)
+    a = rng.rand(2, 3, 4).astype(np.float32)
+    b = rng.rand(2, 4, 5).astype(np.float32)
+
+    def build():
+        av = layers.data("a", [-1, 3, 4])
+        bv = layers.data("b", [-1, 4, 5])
+        return (layers.bmm(av, bv),)
+
+    (out,) = _run(build, {"a": a, "b": b})
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_generated_interp():
+    x = np.random.RandomState(4).rand(1, 3, 8, 8).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", [-1, 3, 8, 8])
+        return (layers.bilinear_interp_v2(xv, None, None, None,
+                                          out_h=16, out_w=16),)
+
+    (out,) = _run(build, {"x": x})
+    assert out.shape == (1, 3, 16, 16)
+
+
+def test_generated_grad_flows():
+    # generated layers participate in autodiff like hand-written ones
+    x = np.random.RandomState(5).rand(4, 5).astype(np.float32)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        xv = layers.data("x", [-1, 5])
+        h = layers.fc(xv, 6)
+        loss = layers.mean(layers.erf(h))
+        static.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        l0 = float(np.asarray(
+            exe.run(main, feed={"x": x}, fetch_list=[loss])[0]))
+        for _ in range(10):
+            lv = exe.run(main, feed={"x": x}, fetch_list=[loss])[0]
+    assert float(np.asarray(lv)) < l0

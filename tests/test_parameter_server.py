@@ -220,3 +220,47 @@ def test_sync_push_timeout_withdraws_pending_and_reports():
         np.testing.assert_allclose(c.pull("w"), -np.ones(2), atol=1e-6)
     finally:
         srv.stop()
+
+
+@pytest.mark.parametrize("sync_mode", [True, False])
+def test_ps_transpiler_graph_ops(sync_mode):
+    """C8 parity: the transpiled trainer program carries send →
+    fetch_barrier → recv GRAPH OPS (reference distributed_ops/send_op.cc);
+    exe.run of the plain Program is the whole PS step."""
+    from paddle_tpu.distributed.ps.ps_optimizer import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+    srv = _start_server(num_trainers=1)
+    try:
+        main, startup, loss = _linreg()
+        cfg = DistributeTranspilerConfig()
+        cfg.sync_mode = sync_mode
+        cfg.use_graph_ops = True
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main, pservers=srv.endpoint,
+                    trainers=1, startup_program=startup)
+        trainer_prog = t.get_trainer_program()
+        from paddle_tpu.core.program import Program
+        assert isinstance(trainer_prog, Program)
+        types = [op.type for op in trainer_prog.global_block().ops]
+        assert "send" in types and "recv" in types and \
+            "fetch_barrier" in types
+        assert types.index("send") < types.index("fetch_barrier") < \
+            types.index("recv")
+
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.rand(16, 8).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        with static.scope_guard(scope):
+            exe.run(startup)   # includes the init-mode send
+            losses = []
+            for _ in range(25):
+                (lv,) = exe.run(trainer_prog, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    finally:
+        srv.stop()
+        from paddle_tpu.ops.kernels.distributed_ops import _reset_clients
+        _reset_clients()

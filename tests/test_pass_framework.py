@@ -113,6 +113,16 @@ def test_dead_code_elimination():
     assert live.name in names
 
 
+def test_dead_code_elimination_refuses_without_roots():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        layers.fc(x, 2)
+    n = len(main.global_block().ops)
+    out = apply_passes(main, ["dead_code_elimination_pass"], PassContext())
+    assert len(out.global_block().ops) == n  # no roots -> no-op, not wipeout
+
+
 def test_graph_viz_pass(tmp_path):
     main, startup = static.Program(), static.Program()
     with static.program_guard(main, startup):
