@@ -235,7 +235,15 @@ class DistributeTranspiler:
             lr_var = next((v.name for v in block.vars.values()
                            if v.persistable and
                            v.name.startswith("learning_rate")), None)
-        send_ins = {"X": grad_names}
+        self._append_ps_graph_ops(block, block, grad_names, param_names,
+                                  mode, lr_var=lr_var)
+        return prog
+
+    def _append_ps_graph_ops(self, block, shape_block, x_names, param_names,
+                             mode, lr_var=None):
+        """Append the send → fetch_barrier → recv triple (one wire-attr
+        construction shared by the per-step and startup rewrites)."""
+        send_ins = {"X": x_names}
         if lr_var:
             send_ins["LearningRate"] = [lr_var]
         dummy = block.create_var(shape=[1], dtype="float32")
@@ -250,10 +258,10 @@ class DistributeTranspiler:
             "recv", {"Dummy": [dummy.name]}, {"Out": param_names},
             {"recv_varnames": param_names,
              "endpoints": list(self._pservers),
-             "shapes": [list(block.var(n).shape) for n in param_names],
-             "dtypes": [block.var(n).dtype for n in param_names],
+             "shapes": [list(shape_block.var(n).shape)
+                        for n in param_names],
+             "dtypes": [shape_block.var(n).dtype for n in param_names],
              OpRole.KEY: OpRole.RPC})
-        return prog
 
     def _rewrite_startup_with_graph_ops(self, params_grads):
         """Startup push of locally-initialized params (first writer wins)
@@ -269,21 +277,7 @@ class DistributeTranspiler:
             if not sb.has_var(n):
                 sb.create_var(n, mb.var(n).shape, mb.var(n).dtype,
                               persistable=True)
-        sdummy = sb.create_var(shape=[1], dtype="float32")
-        sb.append_op("send", {"X": param_names}, {"Dummy": [sdummy.name]},
-                     {"send_varnames": param_names,
-                      "endpoints": list(self._pservers),
-                      "mode": "init", OpRole.KEY: OpRole.RPC})
-        sb.append_op("fetch_barrier", {"X": [sdummy.name]}, {},
-                     {"endpoints": list(self._pservers),
-                      OpRole.KEY: OpRole.RPC})
-        sb.append_op(
-            "recv", {"Dummy": [sdummy.name]}, {"Out": param_names},
-            {"recv_varnames": param_names,
-             "endpoints": list(self._pservers),
-             "shapes": [list(mb.var(n).shape) for n in param_names],
-             "dtypes": [mb.var(n).dtype for n in param_names],
-             OpRole.KEY: OpRole.RPC})
+        self._append_ps_graph_ops(sb, mb, param_names, param_names, "init")
         self._startup._ps_startup_transpiled = True
 
     def get_pserver_program(self, endpoint) -> Program:

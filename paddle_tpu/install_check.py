@@ -1,0 +1,67 @@
+"""Installation sanity check.
+
+Analog of /root/reference/python/paddle/fluid/install_check.py — `run_check`
+trains a one-layer model for a couple of steps on one device, then (when a
+multi-device mesh is visible) repeats it data-parallel, mirroring the
+reference's single-GPU + 2-GPU parallel check.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def _build():
+    from . import static
+    from .static import layers
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, size=1,
+                         param_attr=static.ParamAttr(
+                             initializer=static.Constant(0.1)))
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def run_check():
+    """Raises on failure; prints the reference-style success lines."""
+    import jax
+    from . import static
+
+    rng = np.random.RandomState(0)
+    xb = rng.rand(8, 4).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+    if not np.isfinite(np.asarray(lv)).all():
+        raise RuntimeError("install check produced non-finite loss")
+    print("Your paddle_tpu works well on SINGLE device.")
+
+    if len(jax.devices()) > 1:
+        from .distributed.compiled_program import CompiledProgram
+        main2, startup2, loss2 = _build()
+        scope2 = static.Scope()
+        with static.scope_guard(scope2):
+            exe.run(startup2)
+            cp = CompiledProgram(main2).with_data_parallel(
+                loss_name=loss2.name)
+            for _ in range(2):
+                (lv,) = exe.run(cp, feed={"x": xb, "y": yb},
+                                fetch_list=[loss2])
+        if not np.isfinite(np.asarray(lv)).all():
+            raise RuntimeError(
+                "install check produced non-finite loss (data parallel)")
+        print(f"Your paddle_tpu works well on "
+              f"{len(jax.devices())} devices.")
+    print("Your paddle_tpu is installed successfully!")

@@ -19,3 +19,4 @@ from .framework_io import (  # noqa: F401
     load_inference_model, save_dygraph, load_dygraph, is_persistable,
     static_save, static_load, set_program_state,
 )
+from .data_feeder import DataFeeder  # noqa: E402,F401

@@ -1,0 +1,60 @@
+"""DataFeeder — minibatch rows → feed dict.
+
+Analog of /root/reference/python/paddle/fluid/data_feeder.py (`DataFeeder`
+:268, `convert_dtype` / `check_variable_and_dtype` helpers): takes an
+iterable of per-example tuples ordered like `feed_list` and produces the
+dense numpy feed dict the executor wants, casting to each var's dtype and
+padding the batch dim.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["DataFeeder"]
+
+_NP_DTYPES = {
+    "float32": np.float32, "float64": np.float64, "float16": np.float16,
+    "bfloat16": np.float32,  # host-side staging; device cast happens in-graph
+    "int32": np.int32, "int64": np.int64, "bool": np.bool_,
+    "uint8": np.uint8, "int8": np.int8, "int16": np.int16,
+}
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_vars = list(feed_list)
+        self.place = place
+
+    def _names(self) -> List[str]:
+        return [v.name if hasattr(v, "name") else str(v)
+                for v in self.feed_vars]
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: one minibatch — a list of per-example tuples, each
+        tuple ordered like feed_list.  Returns {var name: batched array}."""
+        rows = list(iterable)
+        if not rows:
+            raise ValueError("empty minibatch")
+        n_slots = len(self.feed_vars)
+        cols = [[] for _ in range(n_slots)]
+        for row in rows:
+            if len(row) != n_slots:
+                raise ValueError(
+                    f"example has {len(row)} fields, feed_list expects "
+                    f"{n_slots}")
+            for i, v in enumerate(row):
+                cols[i].append(np.asarray(v))
+        out = {}
+        for var, name, col in zip(self.feed_vars, self._names(), cols):
+            dtype = _NP_DTYPES.get(getattr(var, "dtype", "float32"),
+                                   np.float32)
+            arr = np.stack(col).astype(dtype)
+            shape = getattr(var, "shape", None)
+            # vars declared [-1, d] but fed flat rows of d: keep batch dim
+            if shape is not None and arr.ndim == len(shape) - 1:
+                arr = arr.reshape((arr.shape[0],) + tuple(
+                    int(s) for s in shape[1:]))
+            out[name] = arr
+        return out

@@ -3,3 +3,4 @@ from .debugger import (  # noqa: F401
     draw_block_graphviz, program_to_dot, print_program,
     prepare_fast_nan_inf_debug,
 )
+from .average import WeightedAverage  # noqa: F401

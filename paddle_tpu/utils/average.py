@@ -1,0 +1,34 @@
+"""WeightedAverage — running weighted mean of fetched metrics.
+
+Analog of /root/reference/python/paddle/fluid/average.py (WeightedAverage
+:30): accumulate scalar (or array-mean) values with weights, read back the
+weighted mean; `reset()` between epochs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total = 0.0
+        self._weight = 0.0
+
+    def add(self, value, weight=1):
+        value = np.asarray(value)
+        if value.size != 1:
+            value = value.mean()
+        self._total += float(value) * float(weight)
+        self._weight += float(weight)
+
+    def eval(self):
+        if self._weight == 0:
+            raise ValueError(
+                "WeightedAverage.eval() before any add() — nothing to "
+                "average")
+        return self._total / self._weight

@@ -225,3 +225,45 @@ def test_explicit_program_roles():
     mp = static.Program()
     mp._role = "main"
     assert not exe._program_is_startup(mp)
+
+
+def test_install_check():
+    from paddle_tpu.install_check import run_check
+    run_check()  # raises on failure
+
+
+def test_data_feeder():
+    import numpy as np
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.io import DataFeeder
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = layers.data("img", [-1, 4])
+        lbl = layers.data("lbl", [-1, 1], dtype="int64")
+        pred = layers.fc(img, 3)
+    feeder = DataFeeder(feed_list=[img, lbl])
+    batch = [(np.ones(4) * i, [i % 3]) for i in range(5)]
+    feed = feeder.feed(batch)
+    assert feed["img"].shape == (5, 4) and feed["img"].dtype == np.float32
+    assert feed["lbl"].shape == (5, 1) and feed["lbl"].dtype == np.int64
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (out,) = exe.run(main, feed=feed, fetch_list=[pred])
+    assert np.asarray(out).shape == (5, 3)
+
+
+def test_weighted_average():
+    import pytest
+    from paddle_tpu.utils import WeightedAverage
+    wa = WeightedAverage()
+    with pytest.raises(ValueError):
+        wa.eval()
+    wa.add(1.0, weight=1)
+    wa.add(3.0, weight=3)
+    assert abs(wa.eval() - 2.5) < 1e-9
+    wa.reset()
+    wa.add([2.0, 4.0])  # arrays reduce to their mean
+    assert abs(wa.eval() - 3.0) < 1e-9
