@@ -27,10 +27,10 @@ white_list = {
 # Numerically sensitive — keep fp32 (fp16_lists.py black_list analog)
 black_list = {
     "exp", "log", "log1p", "square", "rsqrt",
-    "softmax", "log_softmax", "cross_entropy",
+    "cross_entropy",
     "cross_entropy2", "bce_loss", "nll_loss", "sigmoid_cross_entropy_with_logits",
     "mean", "reduce_mean", "reduce_sum", "sum",
-    "layer_norm", "batch_norm", "sync_batch_norm", "instance_norm",
+    "batch_norm", "sync_batch_norm", "instance_norm",
     "group_norm", "norm", "p_norm", "frobenius_norm", "squared_l2_norm",
     "cos_sim", "kldiv_loss", "huber_loss", "smooth_l1_loss",
     "cumsum", "logsumexp", "erf",
@@ -38,11 +38,13 @@ black_list = {
 
 # Dtype follows the inputs (fp16_lists.py gray_list analog)
 gray_list = {
-    # its kernel upcasts to fp32 internally (ops/kernels/loss.py
-    # _compute_dtype), so bf16 logits reach it directly — same math as
-    # black-listing, minus the materialized [b, s, vocab] fp32 cast and
-    # the fp32 dlogits cotangent (the two largest tensors in an LM step)
-    "softmax_with_cross_entropy",
+    # these kernels upcast to fp32 INTERNALLY (loss.py _compute_dtype,
+    # nn.py softmax/layer_norm, activation.py log_softmax), so bf16
+    # activations reach them directly — same math as black-listing, minus
+    # the materialized fp32 casts of the largest tensors in an LM step
+    # (logits, attention scores, residual-stream layer_norm inputs) and
+    # their fp32 cotangents
+    "softmax_with_cross_entropy", "softmax", "log_softmax", "layer_norm",
     "elementwise_add", "elementwise_sub", "elementwise_mul",
     "elementwise_div", "elementwise_max", "elementwise_min",
     "elementwise_pow", "relu", "gelu", "sigmoid", "tanh", "relu6",

@@ -12,14 +12,9 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-__all__ = ["DataFeeder"]
+from ..core.dtype import np_dtype
 
-_NP_DTYPES = {
-    "float32": np.float32, "float64": np.float64, "float16": np.float16,
-    "bfloat16": np.float32,  # host-side staging; device cast happens in-graph
-    "int32": np.int32, "int64": np.int64, "bool": np.bool_,
-    "uint8": np.uint8, "int8": np.int8, "int16": np.int16,
-}
+__all__ = ["DataFeeder"]
 
 
 class DataFeeder:
@@ -48,8 +43,7 @@ class DataFeeder:
                 cols[i].append(np.asarray(v))
         out = {}
         for var, name, col in zip(self.feed_vars, self._names(), cols):
-            dtype = _NP_DTYPES.get(getattr(var, "dtype", "float32"),
-                                   np.float32)
+            dtype = np_dtype(getattr(var, "dtype", None) or "float32")
             arr = np.stack(col).astype(dtype)
             shape = getattr(var, "shape", None)
             # vars declared [-1, d] but fed flat rows of d: keep batch dim

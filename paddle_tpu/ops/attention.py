@@ -91,7 +91,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sk,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)  # query-block index (grid: B, H, Sq/block_q)
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [block_q, d]
+    # operands stay in their storage dtype (bf16 under AMP) so the MXU runs
+    # at low-precision rate; accumulation is fp32 via preferred_element_type
+    # and the scale folds into the fp32 scores
+    q = q_ref[0, 0, :, :]                              # [block_q, d]
 
     m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -101,13 +104,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sk,
 
     def body(kb, carry):
         m, l, acc = carry
-        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
-        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
+        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -118,7 +119,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sk,
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = alpha * acc + jax.lax.dot_general(
-            p, vs, (((1,), (0,)), ((), ())),
+            p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -137,12 +138,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sk,
     lse_ref[0, 0, :, :] = lse
 
 
+def _fit_block(n, want):
+    """Largest block size <= `want` that tiles `n` evenly and satisfies the
+    Mosaic sublane constraint (multiple of 8); None if impossible.  A bare
+    min() would reroute e.g. sq=384 with want=256 to the O(S^2) fallback
+    even though 128 tiles it."""
+    for b in range(min(want, n), 7, -1):
+        if n % b == 0 and b % 8 == 0:
+            return b
+    return None
+
+
 def _tiles_ok(sq, sk, block_q, block_k):
-    """Pallas path requires even tiling and the f32 sublane multiple of 8
-    (Mosaic lowering requirement on real TPU)."""
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    return not (sq % block_q or sk % block_k or block_q % 8 or block_k % 8)
+    return _fit_block(sq, block_q) is not None and \
+        _fit_block(sk, block_k) is not None
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -151,8 +160,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k, sk=sk,
                                sq=sq, causal=causal, scale=scale,
@@ -198,8 +207,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
-    do = do_ref[0, 0, :, :].astype(jnp.float32)        # [bq, d]
+    q = q_ref[0, 0, :, :]                              # [bq, d] storage dtype
+    do = do_ref[0, 0, :, :]                            # [bq, d]
     lse = lse_ref[0, 0, :, :]                          # [bq, 1] f32
     dd = dd_ref[0, 0, :, :]                            # [bq, 1] f32
     safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
@@ -207,22 +216,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     n_kb = sk // block_k
 
     def body(kb, dq):
-        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
-        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
+        ks = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        vs = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
         p = jnp.where(jnp.isfinite(lse), jnp.exp(s - safe_lse), 0.0)
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - dd)                               # [bq, bk]
+            preferred_element_type=jnp.float32)          # [bq, bk] f32
+        ds = p * (dp - dd)                               # [bq, bk] f32
         return dq + jax.lax.dot_general(
-            ds, ks, (((1,), (0,)), ((), ())),
+            ds.astype(ks.dtype), ks, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
@@ -241,33 +248,31 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     from jax.experimental import pallas as pl
 
     kb = pl.program_id(2)
-    ks = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
-    vs = v_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+    ks = k_ref[0, 0, :, :]                              # [bk, d] storage dtype
+    vs = v_ref[0, 0, :, :]                              # [bk, d]
 
     n_qb = sq // block_q
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
-            .astype(jnp.float32)                         # [bq, d]
-        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
-            .astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :]   # [bq, d]
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         dd = dd_ref[0, 0, pl.ds(qi * block_q, block_q), :]
         safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             s = _causal_mask_block(s, qi, kb, block_q, block_k, sk, sq)
         p = jnp.where(jnp.isfinite(lse), jnp.exp(s - safe_lse), 0.0)
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, d]
         dp = jax.lax.dot_general(
             do, vs, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, bk]
-        ds = p * (dp - dd)
+            preferred_element_type=jnp.float32)          # [bq, bk] f32
+        ds = (p * (dp - dd)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bk, d]
@@ -297,8 +302,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(sq, block_q)
+    block_k = _fit_block(sk, block_k)
 
     # D = rowsum(dO * O): elementwise + reduce, XLA fuses; O(S) memory
     dd = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -380,7 +385,7 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, bias=None, causal=False, scale=None,
-                    block_q=128, block_k=128):
+                    block_q=256, block_k=512):
     """Flash attention over [B, H, S, D] tensors.  `bias` forces the
     reference path (arbitrary bias breaks the blockwise max-trick bound
     chosen here; padding masks should be folded into K by the caller)."""
@@ -409,12 +414,14 @@ def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
     n = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
-    qf = q.astype(jnp.float32) * scale
 
     def step_fn(carry, step):
         m, l, acc, ks, vs = carry
         src = (me - step) % n  # whose keys we currently hold
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, ks.astype(jnp.float32))
+        # operands stay in storage dtype (bf16 MXU rate); scores accumulate
+        # fp32 and the scale folds in afterwards
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, ks,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             kpos = src * s_loc + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 3)
@@ -427,7 +434,8 @@ def ring_attention(q, k, v, axis_name: str, causal=False, scale=None):
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = alpha * acc + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vs.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p.astype(vs.dtype), vs,
+            preferred_element_type=jnp.float32)
         # rotate K/V to the next device (overlaps with next step's compute)
         perm = [(i, (i + 1) % n) for i in range(n)]
         ks = jax.lax.ppermute(ks, axis_name, perm)

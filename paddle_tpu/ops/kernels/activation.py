@@ -71,7 +71,14 @@ _act("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
 _act("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
     x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)))
 _act("silu", lambda x, a: jax.nn.silu(x))
-_act("log_softmax", lambda x, a: jax.nn.log_softmax(x, axis=a.get("axis", -1)))
+def _log_softmax(x, a):
+    # fp32 internals for low-precision inputs (see softmax in nn.py)
+    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    return jax.nn.log_softmax(x.astype(cdt),
+                              axis=a.get("axis", -1)).astype(x.dtype)
+
+
+_act("log_softmax", _log_softmax)
 
 
 @register_op("prelu", inputs=["X", "Alpha"], outputs=["Out"])

@@ -431,7 +431,14 @@ def spectral_norm(ins, attrs, ctx):
 # ---------------------------------------------------------------------------
 @register_op("softmax", inputs=["X"], outputs=["Out"])
 def softmax(ins, attrs, ctx):
-    return {"Out": jax.nn.softmax(ins["X"], axis=attrs.get("axis", -1))}
+    # bf16 in/out with fp32 internals: the max-subtract/exp/sum runs in
+    # fp32 registers (XLA fuses the casts), so bf16 graphs keep fp32
+    # numerics without materializing fp32 copies of the activations —
+    # this is the attention-score hot path under AMP
+    x = ins["X"]
+    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    out = jax.nn.softmax(x.astype(cdt), axis=attrs.get("axis", -1))
+    return {"Out": out.astype(x.dtype)}
 
 
 @register_op("dropout", inputs=["X", "Seed?!"], outputs=["Out", "Mask"])

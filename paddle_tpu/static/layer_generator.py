@@ -37,6 +37,20 @@ _SKIP_EXACT = {
     "grad_add", "scale_by_world_size", "share_data",
 }
 
+# fallback output dtype when shape inference bails (infer_shape_for_op
+# normally overwrites the declared dtype from abstract kernel evaluation,
+# but returns early on unknown input shapes — the static dtype must still
+# be right for AMP cast insertion and recv shape/dtype attrs)
+_OUT_DTYPE = {
+    "arg_max": "int64", "arg_min": "int64", "argsort": "int64",
+    "equal_all": "bool", "isfinite": "bool", "isfinite_v2": "bool",
+    "isinf_v2": "bool", "isnan_v2": "bool", "is_empty": "bool",
+    "allclose": "bool", "shape": "int32", "size": "int64",
+    "multinomial": "int64", "where_index": "int64", "sampling_id": "int64",
+    "histogram": "int64",
+}
+
+
 def _make_layer_fn(op_type: str):
     info = get_op_info(op_type)
     slot_names = [s.name for s in info.inputs]
@@ -48,15 +62,17 @@ def _make_layer_fn(op_type: str):
                 f"{op_type} takes at most {len(slot_names)} tensor args "
                 f"({slot_names}), got {len(args)}")
         inputs = {}
+        first = None
         for slot, arg in zip(info.inputs, args):
             if arg is None:
                 continue
-            inputs[slot.name] = (list(arg)
-                                 if isinstance(arg, (list, tuple))
-                                 else [arg])
-        # placeholder dtype only: append_op's infer_shape_for_op overwrites
-        # it from abstract kernel evaluation (core/infer_shape.py)
-        out = helper.create_variable_for_type_inference("float32")
+            vs = list(arg) if isinstance(arg, (list, tuple)) else [arg]
+            if first is None and vs:
+                first = vs[0]
+            inputs[slot.name] = vs
+        dtype = _OUT_DTYPE.get(op_type) or (
+            first.dtype if first is not None else "float32")
+        out = helper.create_variable_for_type_inference(dtype)
         helper.append_op(op_type, inputs=inputs, outputs={"Out": [out]},
                          attrs=attrs)
         return out
