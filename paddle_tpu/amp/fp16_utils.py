@@ -21,6 +21,20 @@ __all__ = ["rewrite_program", "cast_model_to_fp16"]
 
 _FLOAT = ("float32", "float64")
 
+# Slot-level dtype semantics: these output slots stay fp32 regardless of
+# the op's precision decision (their kernels always emit fp32 — statistics
+# and loss values), so the rewrite must not declare them low-precision.
+_FP32_OUT_SLOTS = {
+    "softmax_with_cross_entropy": {"Loss"},
+    "layer_norm": {"Mean", "Variance"},
+}
+
+# Gray ops whose kernels upcast internally and accept fp32 parameters
+# alongside low-precision activations (layer_norm casts Scale/Bias to the
+# compute dtype itself) — persistable float inputs don't block the
+# low-precision decision and are left as fp32 master weights.
+_PARAM_TOLERANT = {"layer_norm"}
+
 
 def _is_float_var(block, name):
     try:
@@ -66,11 +80,14 @@ def rewrite_program(main_program: Program, amp_lists=None,
                 set(op.input_names() + op.output_names())):
             want = dest_dtype
         elif t in amp_lists.gray_list:
-            # follow inputs: low precision only if every float input already is
+            # reference gray semantics (fp16_utils.py _rewrite): the op
+            # follows a low-precision producer — if ANY float input is
+            # already dest_dtype, run low and cast the remaining float
+            # inputs down (e.g. the fp32 bias param of an fc's bias-add);
+            # with no low-precision producer, stay fp32
             ins = [n for n in op.input_names() if _is_float_var(block, n)]
-            low = ins and all(
-                var_dtype.get(n, block.var(n).dtype) == dest_dtype
-                for n in ins)
+            low = any(var_dtype.get(n, block.var(n).dtype) == dest_dtype
+                      for n in ins)
             want = dest_dtype if low else None
         else:
             want = "float32"
@@ -79,7 +96,9 @@ def rewrite_program(main_program: Program, amp_lists=None,
             for slot, names in op.inputs.items():
                 out_names = []
                 for n in names:
-                    if not _is_float_var(block, n):
+                    if not _is_float_var(block, n) or (
+                            t in _PARAM_TOLERANT and
+                            block.var(n).persistable):
                         out_names.append(n)
                         continue
                     cur = var_dtype.get(n, block.var(n).dtype)
@@ -89,10 +108,17 @@ def rewrite_program(main_program: Program, amp_lists=None,
                     else:
                         out_names.append(n)
                 op.inputs[slot] = out_names
-            for n in op.output_names():
-                if _is_float_var(block, n):
-                    block.var(n).dtype = want
-                    var_dtype[n] = want
+            fp32_slots = _FP32_OUT_SLOTS.get(t, ())
+            for slot, names in op.outputs.items():
+                for n in names:
+                    if not _is_float_var(block, n):
+                        continue
+                    if slot in fp32_slots:
+                        block.var(n).dtype = "float32"
+                        var_dtype[n] = "float32"
+                    else:
+                        block.var(n).dtype = want
+                        var_dtype[n] = want
         new_ops.append(op)
     block.ops = new_ops
     main_program._fingerprint_cache = None

@@ -394,6 +394,11 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                                    scale=scale)
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    # the Pallas kernels keep operands in storage dtype for MXU rate, so
+    # mixed q/k/v dtypes (bf16 queries over an fp32 KV cache) promote to a
+    # common dtype first — lax.dot_general requires identical operands
+    cdt = jnp.promote_types(jnp.promote_types(q.dtype, k.dtype), v.dtype)
+    q, k, v = q.astype(cdt), k.astype(cdt), v.astype(cdt)
     return _flash(q, k, v, causal, scale, block_q, block_k)
 
 

@@ -73,8 +73,8 @@ _act("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
 _act("silu", lambda x, a: jax.nn.silu(x))
 def _log_softmax(x, a):
     # fp32 internals for low-precision inputs (see softmax in nn.py)
-    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
-    return jax.nn.log_softmax(x.astype(cdt),
+    from .loss import _compute_dtype
+    return jax.nn.log_softmax(x.astype(_compute_dtype(x)),
                               axis=a.get("axis", -1)).astype(x.dtype)
 
 

@@ -436,8 +436,7 @@ def softmax(ins, attrs, ctx):
     # numerics without materializing fp32 copies of the activations —
     # this is the attention-score hot path under AMP
     x = ins["X"]
-    cdt = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
-    out = jax.nn.softmax(x.astype(cdt), axis=attrs.get("axis", -1))
+    out = jax.nn.softmax(x.astype(_cdt(x)), axis=attrs.get("axis", -1))
     return {"Out": out.astype(x.dtype)}
 
 

@@ -42,12 +42,14 @@ _SKIP_EXACT = {
 # but returns early on unknown input shapes — the static dtype must still
 # be right for AMP cast insertion and recv shape/dtype attrs)
 _OUT_DTYPE = {
-    "arg_max": "int64", "arg_min": "int64", "argsort": "int64",
+    "arg_max": "int64", "arg_min": "int64",
     "equal_all": "bool", "isfinite": "bool", "isfinite_v2": "bool",
     "isinf_v2": "bool", "isnan_v2": "bool", "is_empty": "bool",
     "allclose": "bool", "shape": "int32", "size": "int64",
     "multinomial": "int64", "where_index": "int64", "sampling_id": "int64",
-    "histogram": "int64",
+    "histogram": "int64", "lod_array_length": "int64",
+    # int input, float output — the first-input-dtype fallback is wrong
+    "one_hot_v2": "float32",
 }
 
 

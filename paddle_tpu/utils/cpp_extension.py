@@ -1,0 +1,144 @@
+"""Out-of-tree custom ops: build + load C++ op libraries at runtime.
+
+Reference capability: /root/reference/python/paddle/fluid/tests/custom_op/
+(relu_op.cc compiled out-of-tree, loaded with `fluid.load_op_library`) and
+the `REGISTER_OPERATOR` plugin seam (framework/op_registry.h).
+
+TPU-native redesign: custom device kernels belong in Pallas/JAX (register
+a Python kernel with ops.registry.register_op — that IS the plugin API and
+it fuses into the jitted step).  This module covers the remaining case the
+reference serves with .cc files: wrapping an existing native library.  The
+C ABI is deliberately small — elementwise f32 forward (+ optional
+backward) — and the wrapped function runs as a host callback inside the
+jitted step (same mechanism as py_func / the PS send/recv ops):
+
+    extern "C" {
+      int         ptpu_num_ops();
+      const char* ptpu_op_name(int i);
+      void ptpu_forward(int i, const float* x, float* y, int64_t n);
+      // optional: dx from (x, dy); export ptpu_has_backward returning 1
+      int  ptpu_has_backward(int i);
+      void ptpu_backward(int i, const float* x, const float* dy,
+                         float* dx, int64_t n);
+    }
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["load_op_library", "CppExtension", "build_op_library"]
+
+
+def build_op_library(source_path: str, output_path: str = None) -> str:
+    """Compile a single .cc file into a shared library with the host
+    toolchain (g++ -shared -fPIC); returns the .so path."""
+    if output_path is None:
+        output_path = os.path.splitext(source_path)[0] + ".so"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         source_path, "-o", output_path],
+        check=True, capture_output=True)
+    return output_path
+
+
+def load_op_library(path: str) -> List[str]:
+    """Load a custom-op shared library and register each exported op with
+    the kernel registry (fluid.load_op_library parity).  Returns the op
+    names registered; each is immediately usable from append_op / the
+    generated layer surface of the NEXT interpreter (this session: use
+    LayerHelper.append_op or ops directly)."""
+    from ..ops.registry import register_op
+
+    lib = ctypes.CDLL(os.path.abspath(path))
+    lib.ptpu_num_ops.restype = ctypes.c_int
+    lib.ptpu_op_name.restype = ctypes.c_char_p
+    lib.ptpu_op_name.argtypes = [ctypes.c_int]
+    lib.ptpu_forward.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    has_bwd_fn = getattr(lib, "ptpu_has_backward", None)
+    if has_bwd_fn is not None:
+        has_bwd_fn.restype = ctypes.c_int
+        has_bwd_fn.argtypes = [ctypes.c_int]
+        lib.ptpu_backward.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def _fwd_host(idx):
+        def call(x):
+            x = np.ascontiguousarray(x, dtype=np.float32)
+            y = np.empty_like(x)
+            lib.ptpu_forward(
+                idx, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                x.size)
+            return y
+        return call
+
+    def _bwd_host(idx):
+        def call(x, dy):
+            x = np.ascontiguousarray(x, dtype=np.float32)
+            dy = np.ascontiguousarray(dy, dtype=np.float32)
+            dx = np.empty_like(x)
+            lib.ptpu_backward(
+                idx, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                dy.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                dx.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                x.size)
+            return dx
+        return call
+
+    names = []
+    for i in range(lib.ptpu_num_ops()):
+        op_name = lib.ptpu_op_name(i).decode()
+        fwd = _fwd_host(i)
+        has_bwd = bool(has_bwd_fn and has_bwd_fn(i))
+        bwd = _bwd_host(i) if has_bwd else None
+
+        def make_kernel(fwd_call):
+            def kernel(ins, attrs, ctx):
+                x = ins["X"]
+                out = jax.pure_callback(
+                    fwd_call, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                    x.astype(jnp.float32))
+                return {"Out": out.astype(x.dtype)}
+            return kernel
+
+        def make_grad(bwd_call):
+            def grad_kernel(ins, attrs, ctx):
+                x, dy = ins["X"], ins["Out@GRAD"]
+                dx = jax.pure_callback(
+                    bwd_call, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                    x.astype(jnp.float32), dy.astype(jnp.float32))
+                return {"X@GRAD": dx.astype(x.dtype)}
+            return grad_kernel
+
+        register_op(op_name, inputs=["X"], outputs=["Out"],
+                    grad=make_grad(bwd) if has_bwd else None)(
+                        make_kernel(fwd))
+        names.append(op_name)
+    return names
+
+
+class CppExtension:
+    """paddle.utils.cpp_extension.CppExtension-shaped convenience: compile
+    then load in one step."""
+
+    def __init__(self, sources: List[str]):
+        self.sources = list(sources)
+
+    def load(self):
+        out = []
+        for src in self.sources:
+            so = build_op_library(src)
+            out += load_op_library(so)
+        return out

@@ -400,3 +400,54 @@ def test_subgroup_collective_refuses_to_widen():
     assert ctx.collective_axes(0) == "dp"
     with pytest.raises(NotImplementedError):
         ctx.collective_axes(g.id)
+
+
+def test_amp_static_dtype_consistency():
+    """The AMP rewrite's declared var dtypes must match what the kernels
+    actually emit: Loss/Mean/Variance slots stay fp32, layer_norm follows
+    bf16 activations while its Scale/Bias params stay fp32 master weights."""
+    import numpy as np
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.amp.fp16_utils import rewrite_program
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8, 16])
+        lbl = layers.data("lbl", [-1, 8, 1], dtype="int64")
+        h = layers.fc(x, 16, num_flatten_dims=2)
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        logits = layers.fc(h, 10, num_flatten_dims=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+    rewrite_program(main, dest_dtype="bfloat16")
+    block = main.global_block()
+    ln = next(op for op in block.ops if op.type == "layer_norm")
+    ce = next(op for op in block.ops
+              if op.type == "softmax_with_cross_entropy")
+    # layer_norm ran in bf16: Y bf16, stats fp32, params untouched fp32
+    assert block.var(ln.outputs["Y"][0]).dtype == "bfloat16"
+    assert block.var(ln.outputs["Mean"][0]).dtype == "float32"
+    assert block.var(ln.outputs["Variance"][0]).dtype == "float32"
+    assert block.var(ln.inputs["Scale"][0]).dtype == "float32"
+    assert block.var(ln.inputs["Bias"][0]).dtype == "float32"
+    # no cast was inserted on the params
+    for op in block.ops:
+        if op.type == "cast":
+            assert ln.inputs["Scale"][0] not in op.input_names()
+    # CE: Softmax follows logits (bf16), Loss stays fp32
+    assert block.var(ce.outputs["Softmax"][0]).dtype == "bfloat16"
+    assert block.var(ce.outputs["Loss"][0]).dtype == "float32"
+
+    # and the rewritten program actually runs with finite loss
+    with static.program_guard(main, startup):
+        static.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={
+            "x": rng.rand(2, 8, 16).astype(np.float32),
+            "lbl": rng.randint(0, 10, (2, 8, 1)).astype(np.int64)},
+            fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
