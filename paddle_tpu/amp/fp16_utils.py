@@ -66,6 +66,7 @@ def rewrite_program(main_program: Program, amp_lists=None,
     amp_lists = amp_lists or AutoMixedPrecisionLists()
     block = main_program.global_block()
     var_dtype: Dict[str, str] = {}  # rewritten dtype of each var
+    black_out = set()  # vars produced by black ops — fp32 for a REASON
     new_ops = []
     cache: Dict = {}
     uid_fn = main_program._next_uid
@@ -80,17 +81,26 @@ def rewrite_program(main_program: Program, amp_lists=None,
                 set(op.input_names() + op.output_names())):
             want = dest_dtype
         elif t in amp_lists.gray_list:
-            # reference gray semantics (fp16_utils.py _rewrite): the op
-            # follows a low-precision producer — if ANY float input is
-            # already dest_dtype, run low and cast the remaining float
-            # inputs down (e.g. the fp32 bias param of an fc's bias-add);
-            # with no low-precision producer, stay fp32
+            # reference gray semantics (fp16_utils.py _rewrite): a black
+            # producer wins (its fp32 output is protected — don't cast it
+            # back down); otherwise follow any low-precision producer,
+            # casting the remaining float inputs (e.g. the fp32 bias param
+            # of an fc's bias-add); with neither, stay fp32
             ins = [n for n in op.input_names() if _is_float_var(block, n)]
-            low = any(var_dtype.get(n, block.var(n).dtype) == dest_dtype
-                      for n in ins)
-            want = dest_dtype if low else None
+            if any(n in black_out for n in ins):
+                want = None
+                black_out.update(
+                    n for n in op.output_names()
+                    if _is_float_var(block, n))
+            elif any(var_dtype.get(n, block.var(n).dtype) == dest_dtype
+                     for n in ins):
+                want = dest_dtype
+            else:
+                want = None
         else:
             want = "float32"
+            black_out.update(n for n in op.output_names()
+                             if _is_float_var(block, n))
 
         if want is not None:
             for slot, names in op.inputs.items():

@@ -78,14 +78,17 @@ class _Recorder:
         if key in self.names:
             return self.names[key]
         # unseen tensor: a parameter or an eagerly-created constant —
-        # either way it becomes persistable state of the program
+        # either way it becomes persistable state of the program; captures
+        # always land in block 0 so sub-block recording (dy2static cond)
+        # keeps them visible from every block
         name = t.name if t.persistable else unique_name("@captured")
-        self.block.create_var(name=name, shape=tuple(t.shape),
-                              dtype=t.dtype, persistable=True,
-                              stop_gradient=t.stop_gradient)
+        gb = self.program.global_block()
+        gb.create_var(name=name, shape=tuple(t.shape),
+                      dtype=t.dtype, persistable=True,
+                      stop_gradient=t.stop_gradient)
         if not t.stop_gradient:
-            self.block.vars[name].is_parameter = True
-            self.block.vars[name].trainable = getattr(t, "trainable", True)
+            gb.vars[name].is_parameter = True
+            gb.vars[name].trainable = getattr(t, "trainable", True)
         self.names[key] = name
         self.keepalive.append(t)
         self.params[name] = t
@@ -152,6 +155,8 @@ class ConcreteProgram:
                 env = dict(zip(pnames, param_raws))
                 env.update(zip(fnames, input_raws))
                 ctx = OpContext(seed=seed, is_test=is_test)
+                # sub-block ops (dy2static cond) resolve their blocks here
+                ctx.program = self.program
                 tracer.run(env, ctx)
                 return tuple(env[n] for n in onames)
 
@@ -166,10 +171,30 @@ class StaticFunction:
     jax.vjp over the whole computation."""
 
     def __init__(self, fn, input_spec=None, layer: Optional[Layer] = None):
-        self._fn = fn
+        self._fn = self._maybe_ast_transform(fn)
         self._input_spec = input_spec
         self._layer = layer
         self._cache: Dict[Tuple, ConcreteProgram] = {}
+
+    @staticmethod
+    def _maybe_ast_transform(fn):
+        """Rewrite tensor-dependent `if`s into recorded cond ops
+        (dy2static.py); anything the transform can't express falls back to
+        pure tracing — jax.jit's trace-time-specialization contract."""
+        import inspect as _inspect
+        from .dy2static import ast_transform
+        target = fn.__func__ if _inspect.ismethod(fn) else fn
+        try:
+            new = ast_transform(target)
+        except Exception:
+            # any transform failure (unsupported construct, unparseable
+            # lambda source, empty closure cell, ...) falls back to pure
+            # tracing — to_static must never be stricter than the tracer
+            return fn
+        if _inspect.ismethod(fn):
+            import types as _types
+            return _types.MethodType(new, fn.__self__)
+        return new
 
     @property
     def __name__(self):

@@ -1,0 +1,401 @@
+"""Dygraph→static AST transformation: tensor-dependent Python `if`.
+
+Reference: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+(ifelse_transformer.py, convert_operators.py convert_ifelse — the
+reference rewrites 24 AST transformer files because its dygraph can't be
+captured mid-flight).
+
+TPU-native scope: the trace-based `to_static` already handles everything
+whose control flow is resolvable at trace time (jax.jit's contract).  The
+one thing tracing CANNOT express is a branch on a traced tensor value —
+this module adds exactly that:
+
+  * `ast_transform(fn)` rewrites `if` statements into `convert_ifelse`
+    calls (branches hoisted to closures returning the union of assigned
+    names).
+  * `convert_ifelse(pred, true_fn, false_fn)`:
+      - plain-Python predicate → normal short-circuit execution;
+      - dygraph-Tensor predicate outside a trace → eager bool();
+      - Tensor predicate INSIDE a to_static trace → both branches are
+        traced into fresh sub-blocks, a real `cond` op (the static
+        control-flow op, ops/kernels/control.py) is recorded, and the
+        eager values merge via jnp.where — so the captured Program carries
+        true data-dependent control flow, jit.save/load included, and the
+        composed XLA computation lowers it to lax.cond.
+
+Unsupported inside a tensor-`if` (transformer raises, to_static falls
+back to pure tracing): `return`/`break`/`continue` in a branch.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List
+
+import jax.numpy as jnp
+
+__all__ = ["ast_transform", "convert_ifelse", "Undefined", "Dy2StaticError"]
+
+
+class Dy2StaticError(Exception):
+    pass
+
+
+class _UndefinedVar:
+    """Placeholder for a name one branch assigns and the other doesn't
+    (reference dygraph_to_static UndefinedVar).  Any use raises."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def _die(self):
+        raise NameError(
+            f"variable {self.name!r} is only assigned in one branch of a "
+            f"tensor-dependent `if` and the taken path did not define it")
+
+    def __getattr__(self, item):
+        self._die()
+
+    def __bool__(self):
+        self._die()
+
+    def __repr__(self):
+        return f"Undefined({self.name})"
+
+
+Undefined = _UndefinedVar
+
+
+def _grab(thunk, name):
+    """Evaluate a branch output, tolerating it being undefined."""
+    try:
+        return thunk()
+    except NameError:
+        return _UndefinedVar(name)
+
+
+def _to_bool(pred):
+    if hasattr(pred, "_value"):  # eager dygraph Tensor outside a trace
+        import numpy as np
+        return bool(np.asarray(pred._value).reshape(()))
+    return bool(pred)  # plain python truthiness, whatever the type
+
+
+def convert_ifelse(pred, true_fn, false_fn, env=()):
+    """`env` carries the current values of every name either branch
+    assigns (branch functions take them as parameters so Python's
+    assignment-makes-local rule can't break read-before-write)."""
+    from ..dygraph.tensor import Tensor
+    from ..dygraph import tracer as dytracer
+
+    rec = dytracer._PROGRAM_RECORDER
+    if not isinstance(pred, Tensor) or rec is None:
+        return true_fn(*env) if _to_bool(pred) else false_fn(*env)
+    return _record_cond(rec, pred, lambda: true_fn(*env),
+                        lambda: false_fn(*env))
+
+
+def _record_cond(rec, pred, true_fn, false_fn):
+    from ..dygraph.tensor import Tensor
+    from ..core.program import unique_name
+    from ..static.control_flow import _analyze_block
+
+    program = rec.program
+    parent = rec.block
+    pred_name = rec.name_of(pred)
+
+    def run_branch(fn):
+        sub = program.create_block(parent_idx=parent.idx)
+        program.rollback()
+        saved, rec.block = rec.block, sub
+        try:
+            ret = fn()
+        finally:
+            rec.block = saved
+        return sub, ret
+
+    tb, t_ret = run_branch(true_fn)
+    fb, f_ret = run_branch(false_fn)
+    t_list = list(t_ret) if isinstance(t_ret, tuple) else [t_ret]
+    f_list = list(f_ret) if isinstance(f_ret, tuple) else [f_ret]
+    if len(t_list) != len(f_list):
+        raise Dy2StaticError(
+            f"tensor-if branches return different arity ({len(t_list)} vs "
+            f"{len(f_list)})")
+
+    pred_raw = jnp.reshape(pred._value, ()).astype(bool)
+    out_tensors, t_outs, f_outs = [], [], []
+    for tv, fv in zip(t_list, f_list):
+        if isinstance(tv, _UndefinedVar) or isinstance(fv, _UndefinedVar):
+            und = tv if isinstance(tv, _UndefinedVar) else fv
+            if isinstance(tv, _UndefinedVar) and isinstance(
+                    fv, _UndefinedVar):
+                out_tensors.append(und)
+                t_outs.append(None)
+                f_outs.append(None)
+                continue
+            raise Dy2StaticError(
+                f"variable {und.name!r} is assigned in only one branch of "
+                f"a tensor-dependent `if`; assign it in both (or before "
+                f"the `if`)")
+        if not isinstance(tv, Tensor) or not isinstance(fv, Tensor):
+            # non-tensor branch results must agree and stay python-level
+            if tv is not fv and tv != fv:
+                raise Dy2StaticError(
+                    "non-tensor values returned from a tensor-dependent "
+                    f"`if` must be equal in both branches, got {tv!r} vs "
+                    f"{fv!r}")
+            out_tensors.append(tv)
+            t_outs.append(None)
+            f_outs.append(None)
+            continue
+        if tuple(tv.shape) != tuple(fv.shape) or tv.dtype != fv.dtype:
+            raise Dy2StaticError(
+                f"tensor-if branch outputs disagree: {tuple(tv.shape)}/"
+                f"{tv.dtype} vs {tuple(fv.shape)}/{fv.dtype}")
+        merged = Tensor(jnp.where(pred_raw, tv._value, fv._value),
+                        stop_gradient=tv.stop_gradient and
+                        fv.stop_gradient)
+        out_tensors.append(merged)
+        t_outs.append(rec.name_of(tv))
+        f_outs.append(rec.name_of(fv))
+
+    # free vars of both branches + branch outputs defined outside them
+    t_free, _ = _analyze_block(tb)
+    f_free, _ = _analyze_block(fb)
+    defined = {n for blk in (tb, fb) for op in blk.ops
+               for n in op.output_names()}
+    extra_free = [n for n in t_outs + f_outs
+                  if n is not None and n not in defined]
+    free = [n for n in dict.fromkeys(t_free + f_free + extra_free)
+            if n != pred_name]
+
+    out_names = []
+    for t, tn in zip(out_tensors, t_outs):
+        if tn is None:
+            continue
+        name = unique_name("dy2st_cond_out")
+        parent.create_var(name=name, shape=tuple(t.shape), dtype=t.dtype,
+                          stop_gradient=t.stop_gradient)
+        rec.register(t, name)
+        out_names.append(name)
+
+    parent.append_op(
+        "cond",
+        inputs={"Cond": [pred_name], "Input": free},
+        outputs={"Out": out_names},
+        attrs={"true_block": tb.idx, "false_block": fb.idx,
+               "input_names": free,
+               "true_outs": [n for n in t_outs if n is not None],
+               "false_outs": [n for n in f_outs if n is not None],
+               "cond_name": pred_name})
+    return tuple(out_tensors)
+
+
+# ---------------------------------------------------------------------------
+# AST transformer
+# ---------------------------------------------------------------------------
+def _assigned_names(stmts) -> List[str]:
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def _target(self, t):
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+
+        # don't descend into nested function/class scopes
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_AsyncFunctionDef(self, node):
+            pass
+
+        def visit_ClassDef(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return list(dict.fromkeys(names))
+
+
+def _has_flow_escape(stmts) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_While(self, node):
+            pass  # break/continue inside a nested loop are fine
+
+        def visit_For(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _IfTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.count = 0
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            raise Dy2StaticError(
+                "return/break/continue inside a branch is not supported "
+                "by the dy2static if-transform")
+        outs = _assigned_names(node.body + node.orelse)
+        i = self.count
+        self.count += 1
+        tname, fname = f"_ptpu_true_{i}", f"_ptpu_false_{i}"
+
+        def branch_fn(name, body):
+            # branch takes the assigned-name union as PARAMETERS (so an
+            # in-branch `x = x * 2` reads the parameter, not an unbound
+            # local) and returns all of them
+            rets = ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in outs],
+                ctx=ast.Load())
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in outs],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=(body or [ast.Pass()]) +
+                [ast.Return(value=rets)],
+                decorator_list=[])
+
+        # current values of the assigned names (UndefinedVar when a name
+        # doesn't exist yet), evaluated lazily at the call site
+        env = ast.Tuple(
+            elts=[ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="_ptpu_jst", ctx=ast.Load()),
+                    attr="_grab", ctx=ast.Load()),
+                args=[ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=ast.Name(id=n, ctx=ast.Load())),
+                    ast.Constant(value=n)],
+                keywords=[]) for n in outs],
+            ctx=ast.Load())
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id="_ptpu_jst", ctx=ast.Load()),
+                attr="convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  env],
+            keywords=[])
+        if outs:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in outs],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [branch_fn(tname, node.body),
+                branch_fn(fname, node.orelse), assign]
+
+
+def ast_transform(fn):
+    """Rewrite `if` statements of `fn` into convert_ifelse calls; returns
+    the new function, or raises Dy2StaticError when the source is
+    unavailable or uses unsupported constructs (caller falls back to pure
+    tracing)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise Dy2StaticError(f"source unavailable: {e}")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # e.g. a lambda extracted mid-statement
+        raise Dy2StaticError(f"unparseable source: {e}")
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise Dy2StaticError("not a plain function")
+    # only the to_static/declarative decorators may be stripped — anything
+    # else would silently vanish from the recompiled function
+    for dec in fdef.decorator_list:
+        names = {n.attr if isinstance(n, ast.Attribute) else
+                 getattr(n, "id", None)
+                 for n in ast.walk(dec) if isinstance(n, (ast.Attribute,
+                                                          ast.Name))}
+        if not names & {"to_static", "declarative"}:
+            raise Dy2StaticError(
+                "function carries decorators other than to_static; "
+                "falling back to tracing")
+    fdef.decorator_list = []
+    if not any(isinstance(n, ast.If) for n in ast.walk(fdef)):
+        raise Dy2StaticError("no if statements — nothing to transform")
+    _IfTransformer().visit(fdef)
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # rebind the closure: wrap the transformed def in an outer function
+        # taking the free variables as args (values snapshotted from the
+        # original cells at transform time)
+        outer = ast.FunctionDef(
+            name="__dy2st_outer__",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fdef,
+                  ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+            decorator_list=[])
+        tree.body = [outer]
+    ast.fix_missing_locations(tree)
+    code = compile(tree, f"<dy2static {getattr(fn, '__qualname__', fn)}>",
+                   "exec")
+    from . import dy2static as _jst_mod
+    glb = dict(fn.__globals__)
+    glb["_ptpu_jst"] = _jst_mod
+    loc = {}
+    exec(code, glb, loc)
+    if freevars:
+        cells = dict(zip(fn.__code__.co_freevars, fn.__closure__))
+        try:
+            vals = [cells[n].cell_contents for n in freevars]
+        except ValueError as e:  # cell still empty at decoration time
+            raise Dy2StaticError(f"closure cell not yet filled: {e}")
+        new_fn = loc["__dy2st_outer__"](*vals)
+    else:
+        new_fn = loc[fdef.name]
+    new_fn.__wrapped__ = fn
+    return new_fn

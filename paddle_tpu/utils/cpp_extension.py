@@ -42,10 +42,13 @@ def build_op_library(source_path: str, output_path: str = None) -> str:
     toolchain (g++ -shared -fPIC); returns the .so path."""
     if output_path is None:
         output_path = os.path.splitext(source_path)[0] + ".so"
-    subprocess.run(
+    proc = subprocess.run(
         ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
          source_path, "-o", output_path],
-        check=True, capture_output=True)
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"g++ failed building {source_path}:\n{proc.stderr}")
     return output_path
 
 

@@ -213,3 +213,116 @@ def test_to_static_updates_batchnorm_running_stats():
     np.testing.assert_allclose(y_j, y_e, rtol=1e-4, atol=1e-5)
     # the stats actually moved from init (0 mean / 1 var)
     assert np.abs(m_j).max() > 0.05
+
+
+# ---------------------------------------------------------------------------
+# dy2static: tensor-dependent `if` recorded as a real cond op
+# (jit/dy2static.py; reference dygraph_to_static/ifelse_transformer.py)
+# ---------------------------------------------------------------------------
+def test_dy2static_tensor_if_both_paths():
+    import paddle_tpu.tensor as pt
+
+    def f(x):
+        if pt.mean(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 3.0
+        return y
+
+    traced = jit.to_static(f)
+    xp = paddle_tpu.to_tensor(np.full((2, 3), 1.0, np.float32))
+    xn = paddle_tpu.to_tensor(np.full((2, 3), -1.0, np.float32))
+    # ONE trace serves BOTH branches — the program carries a real cond op
+    np.testing.assert_allclose(traced(xp).numpy(), np.full((2, 3), 2.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(traced(xn).numpy(), np.full((2, 3), -4.0),
+                               rtol=1e-6)
+    assert len(traced._cache) == 1
+    cp = next(iter(traced._cache.values()))
+    types = [op.type for b in cp.program.blocks for op in b.ops]
+    assert "cond" in types
+    assert len(cp.program.blocks) >= 3  # global + two branch blocks
+
+
+def test_dy2static_python_if_unaffected():
+    def f(x, flag=True):
+        if flag:
+            return x * 3.0
+        return x
+
+    traced = jit.to_static(lambda t: f(t))
+    x = _x()
+    np.testing.assert_allclose(traced(x).numpy(), x.numpy() * 3.0,
+                               rtol=1e-6)
+
+
+def test_dy2static_branch_var_merging():
+    import paddle_tpu.tensor as pt
+
+    def f(x):
+        scale = x * 0.0 + 1.0
+        if pt.sum(x) > 10.0:
+            scale = scale * 5.0
+            shift = x * 0.0 + 1.0
+        else:
+            shift = x * 0.0
+        return x * scale + shift
+
+    traced = jit.to_static(f)
+    big = paddle_tpu.to_tensor(np.full((2, 4), 9.0, np.float32))
+    small = paddle_tpu.to_tensor(np.full((2, 4), 0.5, np.float32))
+    np.testing.assert_allclose(traced(big).numpy(),
+                               np.full((2, 4), 46.0), rtol=1e-6)
+    np.testing.assert_allclose(traced(small).numpy(),
+                               np.full((2, 4), 0.5), rtol=1e-6)
+    assert len(traced._cache) == 1
+
+
+def test_dy2static_gradients_through_cond():
+    import paddle_tpu.tensor as pt
+
+    net = SmallNet()
+
+    def f(x):
+        h = net.forward(x)
+        if pt.mean(h) > 0:
+            return h * 2.0
+        else:
+            return h * 0.5
+
+    traced = jit.to_static(f)
+    x = _x()
+    out = traced(x)
+    loss = paddle_tpu.tensor.mean(out)
+    loss.backward()
+    g = net.l1.weight.grad
+    assert g is not None and np.isfinite(np.asarray(g)).all()
+    assert float(np.abs(np.asarray(g)).sum()) > 0
+
+
+def test_dy2static_save_load_keeps_cond(tmp_path):
+    import paddle_tpu.tensor as pt
+
+    class CondNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if pt.mean(h) > 0:
+                y = h * 2.0
+            else:
+                y = -h
+            return y
+
+    net = CondNet()
+    traced = jit.to_static(net)
+    x = _x()
+    ref = traced.forward(x).numpy()
+    path = str(tmp_path / "condnet")
+    jit.save(net, path, input_spec=[InputSpec([3, 4])])
+    loaded = jit.load(path)
+    got = loaded(x)
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref, rtol=1e-5,
+                               atol=1e-6)
