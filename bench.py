@@ -64,6 +64,24 @@ def main():
     if os.environ.get("BENCH_FORCE_CPU"):
         import jax
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # the axon TPU tunnel can hang indefinitely when the remote end is
+        # down — and the hang sits inside a C call, so an in-process alarm
+        # can't interrupt it.  Probe device discovery in a SUBPROCESS with
+        # a hard timeout and fall back to a CPU run instead of hanging.
+        import subprocess
+        probe_s = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180"))
+        try:
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=probe_s, check=True, capture_output=True)
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+            os.environ["BENCH_FORCE_CPU"] = "1"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.stderr.write(
+                "bench: TPU backend unreachable (device discovery timed "
+                "out); re-running on CPU\n")
+            os.execv(sys.executable, [sys.executable, __file__])
     import jax
     import paddle_tpu.static as static
     from paddle_tpu.ops.attention import enable_flash_attention

@@ -215,12 +215,34 @@ def _assigned_names(stmts) -> List[str]:
             self._target(node.target)
             self.generic_visit(node)
 
+        def visit_For(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_AsyncFor(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._target(item.optional_vars)
+            self.generic_visit(node)
+
+        visit_AsyncWith = visit_With
+
+        def visit_NamedExpr(self, node):  # walrus :=
+            self._target(node.target)
+            self.generic_visit(node)
+
         def _target(self, t):
             if isinstance(t, ast.Name):
                 names.append(t.id)
             elif isinstance(t, (ast.Tuple, ast.List)):
                 for e in t.elts:
                     self._target(e)
+            elif isinstance(t, ast.Starred):
+                self._target(t.value)
 
         # don't descend into nested function/class scopes
         def visit_FunctionDef(self, node):
@@ -239,25 +261,38 @@ def _assigned_names(stmts) -> List[str]:
 
 
 def _has_flow_escape(stmts) -> bool:
+    """True when a branch contains control flow that can't live inside a
+    hoisted closure: `return` ANYWHERE (even in a nested loop — the
+    closure would swallow it), or break/continue not enclosed by a loop
+    within the branch."""
     class V(ast.NodeVisitor):
         found = False
+        loop_depth = 0
 
         def visit_Return(self, node):
             self.found = True
 
         def visit_Break(self, node):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
 
         def visit_Continue(self, node):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_While = _loop
+        visit_For = _loop
+        visit_AsyncFor = _loop
 
         def visit_FunctionDef(self, node):
             pass
 
-        def visit_While(self, node):
-            pass  # break/continue inside a nested loop are fine
-
-        def visit_For(self, node):
+        def visit_AsyncFunctionDef(self, node):
             pass
 
     v = V()
@@ -384,8 +419,11 @@ def ast_transform(fn):
     code = compile(tree, f"<dy2static {getattr(fn, '__qualname__', fn)}>",
                    "exec")
     from . import dy2static as _jst_mod
-    glb = dict(fn.__globals__)
-    glb["_ptpu_jst"] = _jst_mod
+    # exec against the function's REAL globals (late binding preserved —
+    # names defined or monkeypatched after decoration must resolve), with
+    # one collision-safe helper injected
+    glb = fn.__globals__
+    glb.setdefault("_ptpu_jst", _jst_mod)
     loc = {}
     exec(code, glb, loc)
     if freevars:

@@ -326,3 +326,60 @@ def test_dy2static_save_load_keeps_cond(tmp_path):
     got = loaded(x)
     np.testing.assert_allclose(np.asarray(got.numpy()), ref, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_dy2static_return_in_nested_loop_falls_back():
+    # `return` inside a for within an if-branch can't be hoisted — the
+    # transform must refuse and fall back to tracing with correct values
+    def f(x, flag=True):
+        if flag:
+            for _ in range(1):
+                return x * 2.0
+        return x
+
+    traced = jit.to_static(lambda t: f(t))
+    x = _x()
+    np.testing.assert_allclose(traced(x).numpy(), x.numpy() * 2.0,
+                               rtol=1e-6)
+
+
+def test_dy2static_for_target_propagates():
+    # names bound by for-loops inside a branch must survive past the if
+    def g(x, flag=True):
+        if flag:
+            vals = []
+            for i in range(3):
+                vals.append(i)
+        return x * float(i)
+
+    traced = jit.to_static(lambda t: g(t))
+    x = _x()
+    np.testing.assert_allclose(traced(x).numpy(), x.numpy() * 2.0,
+                               rtol=1e-6)
+
+
+def test_dy2static_late_bound_global():
+    # a global defined AFTER decoration must still resolve (late binding)
+    import types
+    mod = types.ModuleType("dy2st_late_mod")
+    src = (
+        "import paddle_tpu.tensor as pt\n"
+        "def h(x):\n"
+        "    if _flag:\n"
+        "        y = x * 2.0\n"
+        "    else:\n"
+        "        y = x\n"
+        "    return y\n")
+    exec(src, mod.__dict__)
+    import sys as _sys
+    import linecache
+    linecache.cache["<dy2st_late_mod>"] = (
+        len(src), None, src.splitlines(True), "<dy2st_late_mod>")
+    # re-exec with a filename so inspect.getsource works
+    code = compile(src, "<dy2st_late_mod>", "exec")
+    exec(code, mod.__dict__)
+    traced = jit.to_static(mod.h)
+    mod._flag = True  # defined only after to_static
+    x = _x()
+    np.testing.assert_allclose(traced(x).numpy(), x.numpy() * 2.0,
+                               rtol=1e-6)
