@@ -41,6 +41,7 @@ OP_PING = 7
 OP_SET = 8         # overwrite param (geo-SGD delta merge uses add)
 OP_PUSH_DELTA = 9  # geo: add delta to param
 OP_ERROR = 10      # server-side failure; name carries the message
+OP_HEARTBEAT = 11  # trainer liveness ping; extra carries the trainer id
 
 
 def _send_msg(sock, op: int, name: str, arr: Optional[np.ndarray],
@@ -95,6 +96,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 op, name, arr, extra = _recv_msg(sock)
                 if op == OP_PING:
                     _send_msg(sock, OP_PING, "", None)
+                elif op == OP_HEARTBEAT:
+                    with srv._sync_cv:
+                        srv._trainer_seen[int(extra)] = time.time()
+                        srv._sync_cv.notify_all()
+                    _send_msg(sock, OP_HEARTBEAT, "", None)
                 elif op == OP_INIT:
                     with srv._lock:
                         srv._store.setdefault(name, arr.astype(np.float32))
@@ -144,10 +150,16 @@ class KVServer:
     """listen_and_serv analog: blocking `serve()`, thread-safe store."""
 
     def __init__(self, endpoint: str, num_trainers: int = 1,
-                 sync_timeout: float = 30.0):
+                 sync_timeout: float = 30.0, heartbeat_timeout: float = 10.0):
         host, port = endpoint.rsplit(":", 1)
         self.num_trainers = max(1, num_trainers)
         self.sync_timeout = sync_timeout
+        # heart_beat_monitor.h parity: trainers that registered a heartbeat
+        # but have gone silent longer than this are treated as dead, so
+        # sync pushes / barriers complete over the survivors instead of
+        # hanging the whole job
+        self.heartbeat_timeout = heartbeat_timeout
+        self._trainer_seen: Dict[int, float] = {}
         self._store: Dict[str, np.ndarray] = {}
         self._lock = threading.RLock()
         self._pending: Dict[str, List[np.ndarray]] = {}
@@ -171,47 +183,68 @@ class KVServer:
             self._store[name] = self._store[name] - \
                 float(lr) * grad.astype(np.float32)
 
+    def _effective_trainers(self) -> int:
+        """Fanin for sync rounds: only trainers that REGISTERED a heartbeat
+        and then went silent count as dead — a trainer that hasn't
+        connected yet (startup staggering) is presumed alive, otherwise
+        the first booter would complete rounds alone and break sync-SGD
+        semantics (heart_beat_monitor.h counts the same way)."""
+        now = time.time()
+        dead = sum(1 for t in self._trainer_seen.values()
+                   if now - t >= self.heartbeat_timeout)
+        return max(1, self.num_trainers - dead)
+
     def _push_sync(self, name, grad, lr):
-        """Accumulate; apply the mean once num_trainers pushes arrive.
-        Per-name generation counter avoids the wake-after-next-round race."""
+        """Accumulate; apply the mean once every LIVE trainer's push has
+        arrived.  Per-name generation counter avoids the
+        wake-after-next-round race; the fanin re-evaluates each second so
+        a trainer dying mid-round shrinks the barrier instead of hanging
+        everyone until sync_timeout."""
+        deadline = time.time() + self.sync_timeout
         with self._sync_cv:
             self._pending.setdefault(name, []).append(grad)
-            if len(self._pending[name]) >= self.num_trainers:
-                grads = self._pending.pop(name)
-                with self._lock:
-                    self._apply(name, np.mean(grads, axis=0), lr)
-                self._push_gen[name] = self._push_gen.get(name, 0) + 1
-                self._sync_cv.notify_all()
-            else:
-                my_gen = self._push_gen.get(name, 0)
-                while self._push_gen.get(name, 0) == my_gen:
-                    if not self._sync_cv.wait(timeout=self.sync_timeout):
-                        # withdraw this waiter's grad so the next round's
-                        # mean does not mix in a stale gradient
-                        pend = self._pending.get(name)
-                        if pend is not None:
-                            for i, g in enumerate(pend):
-                                if g is grad:
-                                    del pend[i]
-                                    break
-                            if not pend:
-                                self._pending.pop(name, None)
-                        raise TimeoutError(
-                            f"sync push of {name!r}: not all "
-                            f"{self.num_trainers} trainers arrived")
+            my_gen = self._push_gen.get(name, 0)
+            while True:
+                if self._push_gen.get(name, 0) != my_gen:
+                    return  # a round (including this grad) was applied
+                pend = self._pending.get(name, [])
+                if len(pend) >= self._effective_trainers():
+                    grads = self._pending.pop(name)
+                    with self._lock:
+                        self._apply(name, np.mean(grads, axis=0), lr)
+                    self._push_gen[name] = my_gen + 1
+                    self._sync_cv.notify_all()
+                    return
+                self._sync_cv.wait(timeout=1.0)
+                if time.time() > deadline:
+                    # withdraw this waiter's grad so the next round's
+                    # mean does not mix in a stale gradient
+                    pend = self._pending.get(name)
+                    if pend is not None:
+                        for i, g in enumerate(pend):
+                            if g is grad:
+                                del pend[i]
+                                break
+                        if not pend:
+                            self._pending.pop(name, None)
+                    raise TimeoutError(
+                        f"sync push of {name!r}: not all "
+                        f"{self.num_trainers} trainers arrived")
 
     def _barrier_wait(self):
+        deadline = time.time() + 60
         with self._sync_cv:
             self._barrier_count += 1
-            if self._barrier_count >= self.num_trainers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                self._sync_cv.notify_all()
-            else:
-                gen = self._barrier_gen
-                while gen == self._barrier_gen:
-                    if not self._sync_cv.wait(timeout=60):
-                        raise TimeoutError("barrier timeout")
+            gen = self._barrier_gen
+            while gen == self._barrier_gen:
+                if self._barrier_count >= self._effective_trainers():
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._sync_cv.notify_all()
+                    return
+                self._sync_cv.wait(timeout=1.0)
+                if time.time() > deadline:
+                    raise TimeoutError("barrier timeout")
 
     def serve(self):
         self._tcp.serve_forever(poll_interval=0.1)
@@ -238,6 +271,7 @@ class KVClient:
     def __init__(self, endpoints: List[str]):
         self.endpoints = list(endpoints)
         self._socks: Dict[str, socket.socket] = {}
+        self._hb_stop: Optional[threading.Event] = None
 
     def _sock(self, ep) -> socket.socket:
         s = self._socks.get(ep)
@@ -302,6 +336,41 @@ class KVClient:
         for ep in self.endpoints:
             self._call(ep, OP_BARRIER)
 
+    # -- trainer liveness (heart_beat_monitor.h parity) --------------------
+    def start_heartbeat(self, trainer_id: int,
+                        interval: float = 2.0) -> threading.Event:
+        """Background thread pinging every pserver with this trainer's id;
+        the server drops silent trainers from sync fanins.  Uses its own
+        sockets (the client's aren't thread-safe).  Returns the stop
+        Event (also stopped by close())."""
+        if self._hb_stop is not None:
+            return self._hb_stop
+        stop = threading.Event()
+        endpoints = list(self.endpoints)
+
+        def loop():
+            hb = KVClient(endpoints)
+            try:
+                while not stop.is_set():
+                    for ep in endpoints:
+                        try:
+                            hb._call(ep, OP_HEARTBEAT,
+                                     extra=float(trainer_id))
+                        except (ConnectionError, OSError):
+                            hb._socks.pop(ep, None)
+                    stop.wait(interval)
+            finally:
+                hb.close()
+
+        threading.Thread(target=loop, daemon=True).start()
+        self._hb_stop = stop
+        return stop
+
+    def stop_heartbeat(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+
     def shutdown_servers(self):
         for ep in list(self._socks) or self.endpoints:
             try:
@@ -310,6 +379,7 @@ class KVClient:
                 pass
 
     def close(self):
+        self.stop_heartbeat()
         for s in self._socks.values():
             try:
                 s.close()

@@ -93,6 +93,9 @@ class PSCompiledProgram:
                 raise RuntimeError("no pserver endpoints for PS training")
             self._client = KVClient(eps)
             self._client.wait_server_ready()
+            # liveness registration: if this trainer dies, the servers
+            # shrink sync fanins instead of stalling the others
+            self._client.start_heartbeat(self._trainer_id)
         return self._client
 
     def _init_params(self, scope):
@@ -250,7 +253,8 @@ class DistributeTranspiler:
         block.append_op("send", send_ins, {"Dummy": [dummy.name]},
                         {"send_varnames": param_names,
                          "endpoints": list(self._pservers),
-                         "mode": mode, OpRole.KEY: OpRole.RPC})
+                         "mode": mode, "trainer_id": self._trainer_id,
+                         OpRole.KEY: OpRole.RPC})
         block.append_op("fetch_barrier", {"X": [dummy.name]}, {},
                         {"endpoints": list(self._pservers),
                          OpRole.KEY: OpRole.RPC})
@@ -258,6 +262,7 @@ class DistributeTranspiler:
             "recv", {"Dummy": [dummy.name]}, {"Out": param_names},
             {"recv_varnames": param_names,
              "endpoints": list(self._pservers),
+             "trainer_id": self._trainer_id,
              "shapes": [list(shape_block.var(n).shape)
                         for n in param_names],
              "dtypes": [shape_block.var(n).dtype for n in param_names],

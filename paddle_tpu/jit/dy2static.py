@@ -281,9 +281,14 @@ def _has_flow_escape(stmts) -> bool:
                 self.found = True
 
         def _loop(self, node):
+            # a break/continue in the loop's else: clause binds to an
+            # ENCLOSING loop, so orelse stays at the outer depth
             self.loop_depth += 1
-            self.generic_visit(node)
+            for child in node.body:
+                self.visit(child)
             self.loop_depth -= 1
+            for child in node.orelse:
+                self.visit(child)
 
         visit_While = _loop
         visit_For = _loop

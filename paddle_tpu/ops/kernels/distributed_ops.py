@@ -26,17 +26,26 @@ from ..registry import register_op
 _CLIENTS: Dict[Tuple[str, ...], object] = {}
 
 
-def _client(endpoints):
+def _client(endpoints, trainer_id=None):
     key = tuple(endpoints)
     if key not in _CLIENTS:
+        import os
         from ...distributed.ps.kv_server import KVClient
         c = KVClient(list(endpoints))
         c.wait_server_ready()
+        if trainer_id is None:
+            # fall back to the launcher env contract if the graph didn't
+            # carry the id (hand-built programs)
+            trainer_id = os.environ.get("PADDLE_TRAINER_ID")
+        if trainer_id is not None:
+            c.start_heartbeat(int(trainer_id))
         _CLIENTS[key] = c
     return _CLIENTS[key]
 
 
 def _reset_clients():
+    for c in _CLIENTS.values():
+        c.close()  # stops the heartbeat thread too
     _CLIENTS.clear()
 
 
@@ -49,13 +58,14 @@ def send(ins, attrs, ctx):
     endpoints = tuple(attrs["endpoints"])
     mode = attrs.get("mode", "grad_sync")
     lr_attr = float(attrs.get("lr", 0.01))
+    trainer_id = attrs.get("trainer_id")
     xs = list(ins["X"] or [])
     lr_in = ins.get("LearningRate")
     lr_arr = (lr_in.reshape(()) if lr_in is not None
               else jnp.asarray(lr_attr, jnp.float32))
 
     def host(lr, *arrs):
-        c = _client(endpoints)
+        c = _client(endpoints, trainer_id)
         for n, a in zip(names, arrs):
             a = np.asarray(a)
             if mode == "init":
@@ -79,11 +89,12 @@ def recv(ins, attrs, ctx):
     path optimizer ops use)."""
     names = list(attrs["recv_varnames"])
     endpoints = tuple(attrs["endpoints"])
+    trainer_id = attrs.get("trainer_id")
     shapes = [tuple(s) for s in attrs["shapes"]]
     dtypes = [np.dtype(d) for d in attrs["dtypes"]]
 
     def host():
-        c = _client(endpoints)
+        c = _client(endpoints, trainer_id)
         return tuple(np.asarray(c.pull(n), dtype=d)
                      for n, d in zip(names, dtypes))
 

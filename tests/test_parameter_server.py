@@ -264,3 +264,64 @@ def test_ps_transpiler_graph_ops(sync_mode):
         srv.stop()
         from paddle_tpu.ops.kernels.distributed_ops import _reset_clients
         _reset_clients()
+
+
+def test_heartbeat_monitor_shrinks_sync_fanin():
+    """heart_beat_monitor.h parity: a trainer that stops heartbeating is
+    dropped from the sync fanin, so the survivor's push completes instead
+    of hanging until sync_timeout."""
+    from paddle_tpu.distributed.ps.kv_server import KVServer, KVClient
+    srv = KVServer("127.0.0.1:0", num_trainers=2, sync_timeout=30.0,
+                   heartbeat_timeout=1.5)
+    srv.serve_in_thread()
+    try:
+        alive = KVClient([srv.endpoint])
+        dead = KVClient([srv.endpoint])
+        alive.wait_server_ready()
+        alive.start_heartbeat(0, interval=0.3)
+        dead.start_heartbeat(1, interval=0.3)
+        alive.init_param("w", np.ones(4, np.float32))
+        time.sleep(0.6)           # both registered as alive
+        dead.stop_heartbeat()     # trainer 1 "dies"
+        t0 = time.time()
+        alive.push_grad("w", np.ones(4, np.float32), lr=0.5, sync=True)
+        dt = time.time() - t0
+        # completed once the dead trainer aged out (~1.5s), well before
+        # the 30s sync timeout — and with the survivor's grad alone
+        assert dt < 10, dt
+        np.testing.assert_allclose(alive.pull("w"),
+                                   np.full(4, 0.5, np.float32))
+        alive.close()
+        dead.close()
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_absent_keeps_configured_fanin():
+    # nobody heartbeats -> classic behavior: both pushes required
+    from paddle_tpu.distributed.ps.kv_server import KVServer, KVClient
+    import threading as th
+    srv = KVServer("127.0.0.1:0", num_trainers=2, sync_timeout=15.0)
+    srv.serve_in_thread()
+    try:
+        c0, c1 = KVClient([srv.endpoint]), KVClient([srv.endpoint])
+        c0.wait_server_ready()
+        c0.init_param("w", np.zeros(2, np.float32))
+        done = []
+
+        def push(c):
+            c.push_grad("w", np.ones(2, np.float32), lr=1.0, sync=True)
+            done.append(1)
+
+        t0 = th.Thread(target=push, args=(c0,))
+        t0.start()
+        time.sleep(0.5)
+        assert not done          # still waiting for trainer 2
+        push(c1)
+        t0.join(timeout=10)
+        assert len(done) == 2
+        np.testing.assert_allclose(c0.pull("w"),
+                                   np.full(2, -1.0, np.float32))
+        c0.close(); c1.close()
+    finally:
+        srv.stop()
