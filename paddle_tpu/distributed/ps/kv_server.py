@@ -205,6 +205,8 @@ class KVServer:
             self._pending.setdefault(name, []).append(grad)
             my_gen = self._push_gen.get(name, 0)
             while True:
+                # completion checks FIRST so a round landing right at the
+                # deadline is reported as success, not TimeoutError
                 if self._push_gen.get(name, 0) != my_gen:
                     return  # a round (including this grad) was applied
                 pend = self._pending.get(name, [])
@@ -215,7 +217,6 @@ class KVServer:
                     self._push_gen[name] = my_gen + 1
                     self._sync_cv.notify_all()
                     return
-                self._sync_cv.wait(timeout=1.0)
                 if time.time() > deadline:
                     # withdraw this waiter's grad so the next round's
                     # mean does not mix in a stale gradient
@@ -230,21 +231,27 @@ class KVServer:
                     raise TimeoutError(
                         f"sync push of {name!r}: not all "
                         f"{self.num_trainers} trainers arrived")
+                self._sync_cv.wait(timeout=1.0)
 
     def _barrier_wait(self):
         deadline = time.time() + 60
         with self._sync_cv:
             self._barrier_count += 1
             gen = self._barrier_gen
-            while gen == self._barrier_gen:
+            while True:
+                if gen != self._barrier_gen:
+                    return  # released (checked before the deadline raise)
                 if self._barrier_count >= self._effective_trainers():
                     self._barrier_count = 0
                     self._barrier_gen += 1
                     self._sync_cv.notify_all()
                     return
-                self._sync_cv.wait(timeout=1.0)
                 if time.time() > deadline:
+                    # withdraw this waiter so a later barrier attempt
+                    # doesn't release early on the leaked count
+                    self._barrier_count -= 1
                     raise TimeoutError("barrier timeout")
+                self._sync_cv.wait(timeout=1.0)
 
     def serve(self):
         self._tcp.serve_forever(poll_interval=0.1)
@@ -268,8 +275,9 @@ class KVClient:
     by name hash (DistributeTranspiler round-robin param placement,
     transpiler/distribute_transpiler.py:80 VarBlock)."""
 
-    def __init__(self, endpoints: List[str]):
+    def __init__(self, endpoints: List[str], sock_timeout: float = 60.0):
         self.endpoints = list(endpoints)
+        self.sock_timeout = sock_timeout
         self._socks: Dict[str, socket.socket] = {}
         self._hb_stop: Optional[threading.Event] = None
 
@@ -277,7 +285,8 @@ class KVClient:
         s = self._socks.get(ep)
         if s is None:
             host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.sock_timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[ep] = s
         return s
@@ -349,7 +358,10 @@ class KVClient:
         endpoints = list(self.endpoints)
 
         def loop():
-            hb = KVClient(endpoints)
+            # short socket timeout: one hung pserver must not stall the
+            # heartbeats to the healthy ones past heartbeat_timeout (which
+            # would mark THIS live trainer dead on those servers)
+            hb = KVClient(endpoints, sock_timeout=min(2.0, interval))
             try:
                 while not stop.is_set():
                     for ep in endpoints:
