@@ -159,6 +159,9 @@ class VarDesc:
         # only emitted when set so dense-program fingerprints are unchanged
         if self.attrs.get("var_type"):
             d["var_type"] = self.attrs["var_type"]
+        # tensor-parallel sharding annotation (tensor_parallel.shard_param)
+        if self.attrs.get("dist_attr"):
+            d["dist_attr"] = list(self.attrs["dist_attr"])
         return d
 
     @staticmethod
@@ -169,6 +172,8 @@ class VarDesc:
                     d.get("is_data", False), block)
         if d.get("var_type"):
             v.attrs["var_type"] = d["var_type"]
+        if d.get("dist_attr"):
+            v.attrs["dist_attr"] = list(d["dist_attr"])
         return v
 
 

@@ -97,6 +97,10 @@ def program_to_proto(program) -> "pb.ProgramDesc":
             vd.type = _VAR_TYPES.get(
                 var.attrs.get("var_type", "DENSE_TENSOR"),
                 pb.VarDesc.DENSE_TENSOR)
+            da = var.attrs.get("dist_attr")
+            if da:
+                vd.shard_axis = str(da[0])
+                vd.shard_dim = int(da[1])
         for op in block.ops:
             od = b.ops.add(type=op.type)
             for slot, names in op.inputs.items():
@@ -129,6 +133,8 @@ def _proto_to_dict(proto: "pb.ProgramDesc") -> dict:
                  "is_data": vd.is_data}
             if vd.type != pb.VarDesc.DENSE_TENSOR:
                 v["var_type"] = pb.VarDesc.VarType.Name(vd.type)
+            if vd.shard_axis:
+                v["dist_attr"] = [vd.shard_axis, vd.shard_dim]
             vars_.append(v)
         ops = [{"type": od.type,
                 "inputs": {s: list(nl.names)

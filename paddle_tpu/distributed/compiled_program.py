@@ -73,6 +73,10 @@ class BuildStrategy:
         # dim 1) over an "sp" mesh axis of this size; ring_attention ops
         # with ring_id=1 ride it.  1 = off.
         self.sequence_parallel_degree = 1
+        # TPU extension: Megatron-style tensor parallelism over a "tp"
+        # mesh axis (distributed/tensor_parallel.py col/row layers;
+        # params annotated dist_attr shard over it).  1 = off.
+        self.tensor_parallel_degree = 1
         # fetch semantics across dp replicas: "reduce" (pmean floats /
         # pmax ints — what a training loop wants for loss metrics) or
         # "concat" (reference ParallelExecutor semantics: per-device
@@ -207,10 +211,20 @@ class CompiledProgram:
             devs = np.array(self._devices())
             sp = max(1, int(getattr(self._build_strategy,
                                     "sequence_parallel_degree", 1)))
+            tp = max(1, int(getattr(self._build_strategy,
+                                    "tensor_parallel_degree", 1)))
+            if sp > 1 and tp > 1:
+                raise NotImplementedError(
+                    "sequence_parallel_degree and tensor_parallel_degree "
+                    "cannot both exceed 1 in one CompiledProgram")
             if sp > 1:
                 dp = len(devs) // sp
                 self._mesh = Mesh(devs[: dp * sp].reshape(dp, sp),
                                   ("dp", "sp"))
+            elif tp > 1:
+                dp = len(devs) // tp
+                self._mesh = Mesh(devs[: dp * tp].reshape(dp, tp),
+                                  ("dp", "tp"))
             else:
                 self._mesh = Mesh(devs, ("dp",))
         return self._mesh
@@ -283,6 +297,7 @@ class CompiledProgram:
         tracer = BlockTracer(block)
         axes = tuple(mesh.axis_names)
         has_sp = "sp" in axes
+        has_tp = "tp" in axes
         fetch_aggregation = getattr(self._build_strategy,
                                     "fetch_aggregation", "reduce")
         if fetch_aggregation not in ("reduce", "concat"):
@@ -292,7 +307,8 @@ class CompiledProgram:
 
         def step(state, feed, seed):
             # decorrelate RNG across replicas (the reference gives each
-            # device worker a distinct seed)
+            # device worker a distinct seed).  NOT across tp: tp shards
+            # see the same batch and must draw identical dropout masks.
             local_seed = seed + jnp.uint32(jax.lax.axis_index("dp"))
             if has_sp:
                 local_seed = local_seed * jnp.uint32(7919) + \
@@ -302,12 +318,26 @@ class CompiledProgram:
             # an sp axis → ring_attention degrades to plain attention).
             # Under dp×sp, gradients are partial over BOTH axes (batch and
             # sequence shards), so ring 0 reduces over the whole mesh;
-            # user groups (ring 1+) fall back to the "default" dp world.
+            # under dp×tp, grads reduce over dp ONLY (tp shards either
+            # hold disjoint weight shards or identical replicated grads)
+            # and TP_RING_ID binds the Megatron collectives to "tp".
             from ..ops.attention import SP_RING_ID
+            from .tensor_parallel import TP_RING_ID
+            # TP_RING_ID binds to None when no tp axis exists: the weights
+            # are then unsharded, every shard computes the full product,
+            # and the Megatron collectives must degrade to identity (like
+            # SP_RING_ID) — falling through to the dp axis would psum
+            # complete outputs across batch shards
+            if has_sp:
+                dist_info = {0: ("dp", "sp"), SP_RING_ID: "sp",
+                             TP_RING_ID: None, "default": "dp"}
+            elif has_tp:
+                dist_info = {0: "dp", SP_RING_ID: None,
+                             TP_RING_ID: "tp", "default": "dp"}
+            else:
+                dist_info = {0: "dp", SP_RING_ID: None, TP_RING_ID: None}
             ctx = OpContext(seed=local_seed, mesh_axes=axes,
-                            dist_info={0: ("dp", "sp"), SP_RING_ID: "sp",
-                                       "default": "dp"}
-                            if has_sp else {0: "dp", SP_RING_ID: None})
+                            dist_info=dist_info)
             env = dict(state)
             env.update(feed)
             tracer.run(env, ctx)
@@ -353,6 +383,34 @@ class CompiledProgram:
             return tuple(fetches), new_state
 
         state_specs = {n: P() for n in state_names}
+        if has_tp:
+            # param sharding from dist_attr annotations
+            # (tensor_parallel.py shard_param); optimizer accumulators
+            # inherit their param's sharding by name prefix + equal shape
+            annotated = {}
+            for n in state_names:
+                try:
+                    v = block.var(n)
+                except KeyError:
+                    continue
+                da = v.attrs.get("dist_attr")
+                if da:
+                    axis, dim = da
+                    spec = [None] * len(v.shape or ())
+                    spec[int(dim)] = axis
+                    state_specs[n] = P(*spec)
+                    annotated[n] = (tuple(v.shape or ()), P(*spec))
+            for n in state_names:
+                if n in annotated:
+                    continue
+                try:
+                    shape = tuple(block.var(n).shape or ())
+                except KeyError:
+                    continue
+                for pname, (pshape, pspec) in annotated.items():
+                    if n.startswith(pname + "_") and shape == pshape:
+                        state_specs[n] = pspec
+                        break
         if has_sp:
             # batch over dp, sequence (dim 1) over sp; rank-1 feeds
             # (e.g. flat labels) shard batch only

@@ -1,0 +1,110 @@
+"""Tensor (model) parallelism: Megatron-style column/row parallel layers.
+
+The reference has NO tensor parallelism (SURVEY.md §2.3 "NOT present");
+this is the TPU-native extension the north star calls for: weights are
+sharded over a "tp" mesh axis, shard_map splits/reassembles the global
+arrays held in the scope (checkpointing sees full tensors), and the two
+collectives are the classic conjugate pair:
+
+  * column-parallel fc — weight [in, out/tp]; the input passes through
+    `c_identity` (fwd identity, bwd allreduce over tp — the Megatron "f");
+    output stays sharded on the feature dim unless `gather_output`.
+  * row-parallel fc — weight [in/tp, out] consuming a feature-sharded
+    input; the partial products `c_allreduce_sum` over tp (the "g"; its
+    backward is the broadcast identity).
+
+Sharding is declared on the VarDesc (`dist_attr = [axis_name, dim]`);
+CompiledProgram turns the annotation into shard_map in/out specs for the
+parameter state (optimizer moments inherit by name prefix + shape).
+
+Composes as in Megatron MLP/attention blocks: col(fc) → activation →
+row(fc) leaves activations replicated again at block boundaries.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.program import VarDesc
+from ..static.layer_helper import LayerHelper
+
+__all__ = ["col_parallel_fc", "row_parallel_fc", "TP_RING_ID",
+           "shard_param"]
+
+# reserved ring binding the tensor-parallel mesh axis (sp uses 101)
+TP_RING_ID = 102
+
+
+def shard_param(var: VarDesc, dim: int, axis: str = "tp") -> VarDesc:
+    """Annotate a parameter as sharded over `axis` at `dim`."""
+    var.attrs["dist_attr"] = [axis, int(dim)]
+    return var
+
+
+def col_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
+                    bias_attr=None, act=None, gather_output=False,
+                    name=None):
+    """fc with the OUTPUT features split over tp.  `size` is the GLOBAL
+    output width (must divide by the tp degree); the runtime shard is
+    size/tp.  Output is feature-sharded unless gather_output."""
+    helper = LayerHelper("col_parallel_fc", name=name)
+    in_features = int(np.prod(input.shape[num_flatten_dims:]))
+    w = helper.create_parameter(param_attr, [in_features, size],
+                                input.dtype)
+    shard_param(w, dim=1)
+    # Megatron f: identity fwd, allreduce-over-tp bwd (grads of the
+    # replicated input must sum the per-shard contributions)
+    xid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("c_identity", {"X": [input]}, {"Out": [xid]},
+                     {"ring_id": TP_RING_ID})
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("mul", {"X": [xid], "Y": [w]}, {"Out": [out]},
+                     {"x_num_col_dims": num_flatten_dims,
+                      "y_num_col_dims": 1})
+    b = helper.create_parameter(bias_attr, [size], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        shard_param(b, dim=0)
+        tmp = helper.create_variable_for_type_inference(out.dtype)
+        helper.append_op("elementwise_add", {"X": [out], "Y": [b]},
+                         {"Out": [tmp]}, {"axis": len(out.shape) - 1})
+        out = tmp
+    if gather_output:
+        g = helper.create_variable_for_type_inference(out.dtype)
+        helper.append_op("c_concat", {"X": [out]}, {"Out": [g]},
+                         {"ring_id": TP_RING_ID})
+        out = g
+    return helper.append_activation(out, act)
+
+
+def row_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
+                    bias_attr=None, act=None, input_is_parallel=True,
+                    name=None):
+    """fc with the INPUT features split over tp (consumes a
+    col_parallel_fc output); the partial results allreduce over tp, so
+    the output is replicated.  Weight global shape is [in, size] with in
+    = the GLOBAL feature width."""
+    helper = LayerHelper("row_parallel_fc", name=name)
+    if not input_is_parallel:
+        raise NotImplementedError(
+            "row_parallel_fc expects a tp-sharded input "
+            "(col_parallel_fc output); scatter-on-entry is not built")
+    in_features = int(np.prod(input.shape[num_flatten_dims:]))
+    w = helper.create_parameter(param_attr, [in_features, size],
+                                input.dtype)
+    shard_param(w, dim=0)
+    part = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("mul", {"X": [input], "Y": [w]}, {"Out": [part]},
+                     {"x_num_col_dims": num_flatten_dims,
+                      "y_num_col_dims": 1})
+    # Megatron g: sum the partial products; backward is identity
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("mp_allreduce_sum", {"X": [part]}, {"Out": [out]},
+                     {"ring_id": TP_RING_ID})
+    b = helper.create_parameter(bias_attr, [size], input.dtype,
+                                is_bias=True)
+    if b is not None:  # replicated bias, added after the reduce
+        tmp = helper.create_variable_for_type_inference(out.dtype)
+        helper.append_op("elementwise_add", {"X": [out], "Y": [b]},
+                         {"Out": [tmp]}, {"axis": len(out.shape) - 1})
+        out = tmp
+    return helper.append_activation(out, act)

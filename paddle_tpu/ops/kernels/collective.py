@@ -184,7 +184,39 @@ def c_split(ins, attrs, ctx):
                                                 x.ndim - 1)}
 
 
-@register_op("c_identity", inputs=["X"], outputs=["Out"], side_effect=True)
+def _mp_allreduce_grad(ins, attrs, ctx):
+    """Megatron g-operator backward: the forward psum's cotangent is
+    replicated, and each shard's input contributed once — identity (NOT
+    another psum, which would scale grads by the tp degree)."""
+    return {"X@GRAD": ins["Out@GRAD"]}
+
+
+@register_op("mp_allreduce_sum", inputs=["X"], outputs=["Out"],
+             grad=_mp_allreduce_grad, side_effect=True)
+def mp_allreduce_sum(ins, attrs, ctx):
+    """Model-parallel partial-sum reduction (paddle mp_allreduce_sum):
+    same forward as c_allreduce_sum, differentiable with identity
+    backward."""
+    x = ins["X"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": x}
+    return {"Out": jax.lax.psum(x, axes)}
+
+
+def _c_identity_grad(ins, attrs, ctx):
+    """Reference model-parallel semantics (_c_identity in paddle's mp
+    helpers): identity forward, allreduce backward over the bound ring —
+    the Megatron f-operator guarding a column-parallel layer's input."""
+    g = ins["Out@GRAD"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"X@GRAD": g}
+    return {"X@GRAD": jax.lax.psum(g, axes)}
+
+
+@register_op("c_identity", inputs=["X"], outputs=["Out"],
+             grad=_c_identity_grad, side_effect=True)
 def c_identity(ins, attrs, ctx):
     return {"Out": ins["X"]}
 
