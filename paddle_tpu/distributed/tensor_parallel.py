@@ -27,8 +27,8 @@ import numpy as np
 from ..core.program import VarDesc
 from ..static.layer_helper import LayerHelper
 
-__all__ = ["col_parallel_fc", "row_parallel_fc", "TP_RING_ID",
-           "shard_param"]
+__all__ = ["col_parallel_fc", "row_parallel_fc", "parallel_attention",
+           "TP_RING_ID", "shard_param"]
 
 # reserved ring binding the tensor-parallel mesh axis (sp uses 101)
 TP_RING_ID = 102
@@ -78,17 +78,21 @@ def col_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
 
 def row_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
                     bias_attr=None, act=None, input_is_parallel=True,
-                    name=None):
+                    in_features=None, name=None):
     """fc with the INPUT features split over tp (consumes a
     col_parallel_fc output); the partial results allreduce over tp, so
     the output is replicated.  Weight global shape is [in, size] with in
-    = the GLOBAL feature width."""
+    = the GLOBAL feature width — inferred from the build-time input shape
+    (which col_parallel_fc keeps global), or passed via `in_features`
+    when the build-time shape is already the local shard (e.g. the
+    reshaped per-head context in parallel_attention)."""
     helper = LayerHelper("row_parallel_fc", name=name)
     if not input_is_parallel:
         raise NotImplementedError(
             "row_parallel_fc expects a tp-sharded input "
             "(col_parallel_fc output); scatter-on-entry is not built")
-    in_features = int(np.prod(input.shape[num_flatten_dims:]))
+    if in_features is None:
+        in_features = int(np.prod(input.shape[num_flatten_dims:]))
     w = helper.create_parameter(param_attr, [in_features, size],
                                 input.dtype)
     shard_param(w, dim=0)
@@ -96,10 +100,19 @@ def row_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
     helper.append_op("mul", {"X": [input], "Y": [w]}, {"Out": [part]},
                      {"x_num_col_dims": num_flatten_dims,
                       "y_num_col_dims": 1})
+    if part.shape is None:
+        # abstract eval can't reconcile a local-shard input width with the
+        # global weight (e.g. parallel_attention's reshaped context) —
+        # the out shape is known regardless
+        part.shape = tuple(input.shape[:num_flatten_dims]) + (size,)
+        part.dtype = input.dtype
     # Megatron g: sum the partial products; backward is identity
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("mp_allreduce_sum", {"X": [part]}, {"Out": [out]},
                      {"ring_id": TP_RING_ID})
+    if out.shape is None:
+        out.shape = part.shape
+        out.dtype = part.dtype
     b = helper.create_parameter(bias_attr, [size], input.dtype,
                                 is_bias=True)
     if b is not None:  # replicated bias, added after the reduce
@@ -108,3 +121,62 @@ def row_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
                          {"Out": [tmp]}, {"axis": len(out.shape) - 1})
         out = tmp
     return helper.append_activation(out, act)
+
+
+def parallel_attention(x, hidden, num_heads, tp_degree, dropout_rate=0.0,
+                       param_attrs=None, name=None):
+    """Megatron parallel self-attention block: three column-parallel
+    q/k/v projections (each head shard lands whole on one tp rank — a
+    fused qkv column shard would slice across q/k/v), local multi-head
+    attention over num_heads/tp heads, row-parallel output projection.
+
+    `tp_degree` is needed at BUILD time because the per-shard reshape
+    dims (heads/tp) are static attrs; x is [batch, time, hidden]
+    replicated, the return is [batch, time, hidden] replicated.
+    """
+    from ..static import layers
+    if num_heads % tp_degree:
+        raise ValueError(
+            f"num_heads={num_heads} must divide by tp_degree={tp_degree}")
+    if hidden % num_heads:
+        raise ValueError("hidden must divide by num_heads")
+    if x.shape[1] is None or x.shape[1] == -1:
+        raise ValueError(
+            "parallel_attention needs a static time dim (x.shape[1]) — "
+            "the per-head reshape bakes it into the graph")
+    if param_attrs is not None and len(param_attrs) != 4:
+        raise ValueError(
+            "param_attrs must hold exactly 4 entries (q, k, v, out "
+            f"projections), got {len(param_attrs)}")
+    pa = list(param_attrs) if param_attrs else [None] * 4
+    pfx = (name + "_") if name else ""
+    q = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[0],
+                        name=pfx + "q" if pfx else None)
+    k = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[1],
+                        name=pfx + "k" if pfx else None)
+    v = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[2],
+                        name=pfx + "v" if pfx else None)
+
+    h_loc = num_heads // tp_degree
+    d_key = hidden // num_heads
+    t = x.shape[1]
+
+    def _split(z):  # [b, t, h_loc*d] local -> [b, h_loc, t, d]
+        z = layers.reshape(z, [-1, t, h_loc, d_key])
+        # build-time shapes upstream are GLOBAL while these dims are the
+        # local shard — abstract eval bails, but the target is known
+        z.shape = (-1, t, h_loc, d_key)
+        return layers.transpose(z, [0, 2, 1, 3])
+
+    qh, kh, vh = _split(q), _split(k), _split(v)
+    scaled = layers.scale(qh, scale=d_key ** -0.5)
+    logits = layers.matmul(scaled, kh, transpose_y=True)
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, vh)              # [b, h_loc, t, d]
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [-1, t, h_loc * d_key])  # local width
+    return row_parallel_fc(ctx, hidden, num_flatten_dims=2,
+                           in_features=hidden, param_attr=pa[3],
+                           name=pfx + "out" if pfx else None)

@@ -143,3 +143,82 @@ def test_tp_and_sp_exclusive():
                                                  build_strategy=bs)
     with pytest.raises(NotImplementedError):
         cp._get_mesh()
+
+
+def test_parallel_attention_matches_single_device():
+    """Megatron parallel attention at tp=2 must equal the identical
+    single-device attention graph — weights are overwritten post-startup
+    with the SAME seeded global arrays in both runs, so a head/column
+    mis-slicing would show up immediately."""
+    _need_devices(8)
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy)
+    from paddle_tpu.distributed.tensor_parallel import parallel_attention
+    import paddle_tpu.static.nets as nets
+
+    HID, HEADS, T = 16, 4, 6
+
+    def build_plain():
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, T, HID])
+            y = layers.data("y", [-1, T, HID])
+            q = layers.fc(x, HID, num_flatten_dims=2)
+            k = layers.fc(x, HID, num_flatten_dims=2)
+            v = layers.fc(x, HID, num_flatten_dims=2)
+            ctx = nets.scaled_dot_product_attention(q, k, v,
+                                                    num_heads=HEADS)
+            out = layers.fc(ctx, HID, num_flatten_dims=2)
+            loss = layers.mean(layers.square(
+                layers.elementwise_sub(out, y)))
+            static.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    def build_tp(tp):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, T, HID])
+            y = layers.data("y", [-1, T, HID])
+            out = parallel_attention(x, HID, HEADS, tp_degree=tp)
+            loss = layers.mean(layers.square(
+                layers.elementwise_sub(out, y)))
+            static.SGD(learning_rate=0.05).minimize(loss)
+        return main, startup, loss
+
+    def seeded_weights(program):
+        # same global arrays by position (plain and tp have matching
+        # parameter orders: q w,b / k w,b / v w,b / out w,b)
+        ws = {}
+        for i, p in enumerate(program.all_parameters()):
+            rng = np.random.RandomState(100 + i)
+            ws[p.name] = (rng.rand(*p.shape).astype(np.float32) - 0.5) * 0.4
+        return ws
+
+    rng = np.random.RandomState(3)
+    batches = [(rng.rand(8, T, HID).astype(np.float32),
+                rng.rand(8, T, HID).astype(np.float32))
+               for _ in range(4)]
+
+    def run(main, startup, loss, compiled=None):
+        exe = static.Executor()
+        scope = static.Scope()
+        out = []
+        with static.scope_guard(scope):
+            exe.run(startup)
+            for name, arr in seeded_weights(main).items():
+                scope.set(name, arr)
+            target = compiled if compiled is not None else main
+            for xb, yb in batches:
+                (lv,) = exe.run(target, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                out.append(float(np.asarray(lv)))
+        return out
+
+    single = run(*build_plain())
+    main, startup, loss = build_tp(2)
+    bs = BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name,
+                                                 build_strategy=bs)
+    par = run(main, startup, loss, compiled=cp)
+    np.testing.assert_allclose(single, par, rtol=3e-4, atol=1e-5)
