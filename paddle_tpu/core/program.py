@@ -374,6 +374,20 @@ class Program:
     def list_vars(self):
         return [v for b in self.blocks for v in b.vars.values()]
 
+    # runtime attachments (fleet/pipeline compiled executors) hold device
+    # handles and jitted functions — graph copies must not drag them along
+    # (jax Device objects aren't even picklable)
+    _RUNTIME_ATTACHMENTS = ("_compiled_for_fleet", "_pipeline_compiled")
+
+    def __deepcopy__(self, memo):
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            new.__dict__[k] = (None if k in self._RUNTIME_ATTACHMENTS
+                               else copy.deepcopy(v, memo))
+        return new
+
     def clone(self, for_test: bool = False) -> "Program":
         p = copy.deepcopy(self)
         p._fingerprint_cache = None

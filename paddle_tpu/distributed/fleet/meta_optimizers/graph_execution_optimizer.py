@@ -39,6 +39,14 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
             bs.num_trainers = self.role_maker.worker_num()
             bs.trainer_id = self.role_maker.worker_index()
             bs.trainers_endpoints = self.role_maker.get_trainer_endpoints()
+        # DistributedStrategy parallelism degrees flow into the mesh shape
+        if getattr(strategy, "sequence_parallel", False):
+            bs.sequence_parallel_degree = int(
+                strategy.sequence_parallel_configs.get("degree", 1))
+        if getattr(strategy, "tensor_parallel", False):
+            bs.tensor_parallel_degree = int(
+                strategy.tensor_parallel_configs.get(
+                    "tensor_parallel_degree", 1))
         compiled = CompiledProgram(program, build_strategy=bs) \
             .with_data_parallel(loss_name=loss.name)
         program._compiled_for_fleet = compiled

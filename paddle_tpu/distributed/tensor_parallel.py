@@ -40,12 +40,26 @@ def shard_param(var: VarDesc, dim: int, axis: str = "tp") -> VarDesc:
     return var
 
 
+def tp_identity(input, name=None):
+    """The Megatron f-operator standalone: identity forward, allreduce
+    over tp backward.  Apply ONCE per replicated block input when several
+    column-parallel projections share it (parallel_attention's q/k/v) —
+    the autodiff then sums their input grads before a single allreduce."""
+    helper = LayerHelper("tp_identity", name=name)
+    xid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("c_identity", {"X": [input]}, {"Out": [xid]},
+                     {"ring_id": TP_RING_ID})
+    return xid
+
+
 def col_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
                     bias_attr=None, act=None, gather_output=False,
-                    name=None):
+                    input_is_identity=False, name=None):
     """fc with the OUTPUT features split over tp.  `size` is the GLOBAL
     output width (must divide by the tp degree); the runtime shard is
-    size/tp.  Output is feature-sharded unless gather_output."""
+    size/tp.  Output is feature-sharded unless gather_output.
+    `input_is_identity`: the caller already applied tp_identity (shared
+    block input) — skip the per-layer f-op."""
     helper = LayerHelper("col_parallel_fc", name=name)
     in_features = int(np.prod(input.shape[num_flatten_dims:]))
     w = helper.create_parameter(param_attr, [in_features, size],
@@ -53,9 +67,7 @@ def col_parallel_fc(input, size, num_flatten_dims=1, param_attr=None,
     shard_param(w, dim=1)
     # Megatron f: identity fwd, allreduce-over-tp bwd (grads of the
     # replicated input must sum the per-shard contributions)
-    xid = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("c_identity", {"X": [input]}, {"Out": [xid]},
-                     {"ring_id": TP_RING_ID})
+    xid = input if input_is_identity else tp_identity(input)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("mul", {"X": [xid], "Y": [w]}, {"Out": [out]},
                      {"x_num_col_dims": num_flatten_dims,
@@ -150,11 +162,17 @@ def parallel_attention(x, hidden, num_heads, tp_degree, dropout_rate=0.0,
             f"projections), got {len(param_attrs)}")
     pa = list(param_attrs) if param_attrs else [None] * 4
     pfx = (name + "_") if name else ""
-    q = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[0],
+    # ONE f-op for the shared block input: q/k/v input grads sum before a
+    # single tp allreduce instead of three
+    xid = tp_identity(x, name=pfx + "f" if pfx else None)
+    q = col_parallel_fc(xid, hidden, num_flatten_dims=2, param_attr=pa[0],
+                        input_is_identity=True,
                         name=pfx + "q" if pfx else None)
-    k = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[1],
+    k = col_parallel_fc(xid, hidden, num_flatten_dims=2, param_attr=pa[1],
+                        input_is_identity=True,
                         name=pfx + "k" if pfx else None)
-    v = col_parallel_fc(x, hidden, num_flatten_dims=2, param_attr=pa[2],
+    v = col_parallel_fc(xid, hidden, num_flatten_dims=2, param_attr=pa[2],
+                        input_is_identity=True,
                         name=pfx + "v" if pfx else None)
 
     h_loc = num_heads // tp_degree
@@ -168,15 +186,10 @@ def parallel_attention(x, hidden, num_heads, tp_degree, dropout_rate=0.0,
         z.shape = (-1, t, h_loc, d_key)
         return layers.transpose(z, [0, 2, 1, 3])
 
-    qh, kh, vh = _split(q), _split(k), _split(v)
-    scaled = layers.scale(qh, scale=d_key ** -0.5)
-    logits = layers.matmul(scaled, kh, transpose_y=True)
-    weights = layers.softmax(logits)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, vh)              # [b, h_loc, t, d]
-    ctx = layers.transpose(ctx, [0, 2, 1, 3])
-    ctx = layers.reshape(ctx, [-1, t, h_loc * d_key])  # local width
+    from ..static import nets
+    ctx = nets.attention_core(_split(q), _split(k), _split(v), d_key,
+                              dropout_rate,
+                              merge_shape=(t, h_loc * d_key))
     return row_parallel_fc(ctx, hidden, num_flatten_dims=2,
                            in_features=hidden, param_attr=pa[3],
                            name=pfx + "out" if pfx else None)

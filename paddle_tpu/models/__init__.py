@@ -12,3 +12,4 @@ from .transformer import (  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForGeneration, gpt_small,
 )
+from .static_lm import build_transformer_lm  # noqa: F401

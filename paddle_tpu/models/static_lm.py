@@ -1,0 +1,68 @@
+"""Static-graph transformer LM builder with optional tensor parallelism.
+
+The v5e-32-scale rehearsal config: assembles embedding → N pre-LN
+transformer blocks → LM head as ONE static Program.  With
+`tensor_parallel_degree > 1` every block uses the Megatron layers
+(distributed/tensor_parallel.py): column/row-parallel attention + MLP,
+weights annotated for the "tp" mesh axis — run it under
+CompiledProgram(BuildStrategy.tensor_parallel_degree=tp) or through
+fleet's DistributedStrategy.tensor_parallel.
+
+(The dygraph model families live in models/gpt.py / models/bert.py; this
+is the static counterpart the ERNIE-style pretrain configs use.)
+"""
+from __future__ import annotations
+
+from ..static import layers
+
+__all__ = ["build_transformer_lm"]
+
+
+def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
+                         tensor_parallel_degree=1):
+    """Returns (main_program, startup_program, loss, logits); feeds are
+    int64 `ids` [batch, seq_len], `pos` [batch, seq_len] (position ids,
+    typically np.tile(np.arange(seq_len), (batch, 1))), and `labels`
+    [batch, seq_len, 1]."""
+    import paddle_tpu.static as static
+    from ..distributed.tensor_parallel import (parallel_attention,
+                                               col_parallel_fc,
+                                               row_parallel_fc)
+    import paddle_tpu.static.nets as nets
+
+    tp = max(1, int(tensor_parallel_degree))
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, seq_len], dtype="int64")
+        pos = layers.data("pos", [-1, seq_len], dtype="int64")
+        labels = layers.data("labels", [-1, seq_len, 1], dtype="int64")
+        h = layers.elementwise_add(
+            layers.embedding(ids, size=[vocab_size, hidden]),
+            layers.embedding(pos, size=[seq_len, hidden]))
+        for _ in range(num_layers):
+            a_in = layers.layer_norm(h, begin_norm_axis=2)
+            if tp > 1:
+                attn = parallel_attention(a_in, hidden, num_heads, tp)
+            else:
+                q = layers.fc(a_in, hidden, num_flatten_dims=2)
+                k = layers.fc(a_in, hidden, num_flatten_dims=2)
+                v = layers.fc(a_in, hidden, num_flatten_dims=2)
+                ctx = nets.scaled_dot_product_attention(
+                    q, k, v, num_heads=num_heads)
+                attn = layers.fc(ctx, hidden, num_flatten_dims=2)
+            h = layers.elementwise_add(h, attn)
+            m_in = layers.layer_norm(h, begin_norm_axis=2)
+            if tp > 1:
+                m = col_parallel_fc(m_in, hidden * 4, num_flatten_dims=2,
+                                    act="gelu")
+                m = row_parallel_fc(m, hidden, num_flatten_dims=2)
+            else:
+                m = layers.fc(m_in, hidden * 4, num_flatten_dims=2,
+                              act="gelu")
+                m = layers.fc(m, hidden, num_flatten_dims=2)
+            h = layers.elementwise_add(h, m)
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        logits = layers.fc(h, vocab_size, num_flatten_dims=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, labels))
+    return main, startup, loss, logits

@@ -6,7 +6,7 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
-           "glu", "scaled_dot_product_attention"]
+           "glu", "scaled_dot_product_attention", "attention_core"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
@@ -68,11 +68,41 @@ def glu(input, dim=-1):
     return layers.elementwise_mul(a, layers.sigmoid(b))
 
 
+def attention_core(q, k, v, d_key, dropout_rate=0.0, merge_shape=None):
+    """Attention over already-head-split [b, h, t, d] tensors; dispatches
+    to the Pallas flash op when enabled.  Returns merged [b, t, h*d]
+    (`merge_shape` overrides the build-time (t, h*d) when the runtime
+    tensors are shards — tensor_parallel.parallel_attention)."""
+    from ..ops.attention import flash_enabled
+    if flash_enabled() and not dropout_rate:
+        # emit the Pallas flash op instead of the score-matrix graph
+        helper = layers.LayerHelper("flash_attention")
+        ctx = helper.create_variable_for_type_inference(q.dtype)
+        ctx.shape = tuple(q.shape)
+        helper.append_op("flash_attention",
+                         inputs={"Q": [q], "K": [k], "V": [v]},
+                         outputs={"Out": [ctx]}, attrs={"causal": False})
+    else:
+        scaled = layers.scale(q, scale=d_key ** -0.5)
+        logits = layers.matmul(scaled, k, transpose_y=True)
+        weights = layers.softmax(logits)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate)
+        ctx = layers.matmul(weights, v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])  # [b, t, h, d]
+    if merge_shape is None:
+        t, h, d = ctx.shape[1], ctx.shape[2], ctx.shape[3]
+        merge_shape = (t, h * d)
+    out = layers.reshape(ctx, [-1, merge_shape[0], merge_shape[1]])
+    out.shape = (-1,) + tuple(merge_shape)
+    return out
+
+
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
                                  dropout_rate=0.0):
     """Multi-head attention built from primitive ops (nets.py:503).  The
-    flash/ring Pallas kernel lives in paddle_tpu.ops.pallas; this is the
-    graph-API form."""
+    flash/ring Pallas kernel lives in paddle_tpu.ops.attention; this is
+    the graph-API form."""
     d_key = queries.shape[-1] // num_heads
 
     def _split_heads(x):
@@ -83,28 +113,11 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         return layers.transpose(x, [0, 2, 1, 3])  # [b, h, t, d]
 
     q, k, v = _split_heads(queries), _split_heads(keys), _split_heads(values)
-
-    from ..ops.attention import flash_enabled
-    if flash_enabled() and num_heads > 1 and not dropout_rate:
-        # emit the Pallas flash op instead of the score-matrix graph
-        helper = layers.LayerHelper("flash_attention")
-        out = helper.create_variable_for_type_inference(q.dtype)
-        out.shape = tuple(q.shape)
-        helper.append_op("flash_attention",
-                         inputs={"Q": [q], "K": [k], "V": [v]},
-                         outputs={"Out": [out]}, attrs={"causal": False})
-        ctx = layers.transpose(out, [0, 2, 1, 3])
-        t, h, d = ctx.shape[1], ctx.shape[2], ctx.shape[3]
-        return layers.reshape(ctx, [-1, t, h * d])
-
-    scaled = layers.scale(q, scale=d_key ** -0.5)
-    logits = layers.matmul(scaled, k, transpose_y=True)
-    weights = layers.softmax(logits)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)
     if num_heads == 1:
-        return ctx
-    ctx = layers.transpose(ctx, [0, 2, 1, 3])  # [b, t, h, d]
-    t, h, d = ctx.shape[1], ctx.shape[2], ctx.shape[3]
-    return layers.reshape(ctx, [-1, t, h * d])
+        scaled = layers.scale(q, scale=d_key ** -0.5)
+        logits = layers.matmul(scaled, k, transpose_y=True)
+        weights = layers.softmax(logits)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate)
+        return layers.matmul(weights, v)
+    return attention_core(q, k, v, d_key, dropout_rate)
