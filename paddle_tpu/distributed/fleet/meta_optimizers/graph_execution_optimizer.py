@@ -39,14 +39,19 @@ class GraphExecutionOptimizer(MetaOptimizerBase):
             bs.num_trainers = self.role_maker.worker_num()
             bs.trainer_id = self.role_maker.worker_index()
             bs.trainers_endpoints = self.role_maker.get_trainer_endpoints()
-        # DistributedStrategy parallelism degrees flow into the mesh shape
+        # DistributedStrategy parallelism degrees flow into the mesh shape;
+        # only a degree the user actually set (> 1) overrides — a bare
+        # flag with default configs must not clobber a degree already on
+        # a user-supplied BuildStrategy
         if getattr(strategy, "sequence_parallel", False):
-            bs.sequence_parallel_degree = int(
-                strategy.sequence_parallel_configs.get("degree", 1))
+            deg = int(strategy.sequence_parallel_configs.get("degree", 1))
+            if deg > 1:
+                bs.sequence_parallel_degree = deg
         if getattr(strategy, "tensor_parallel", False):
-            bs.tensor_parallel_degree = int(
-                strategy.tensor_parallel_configs.get(
-                    "tensor_parallel_degree", 1))
+            deg = int(strategy.tensor_parallel_configs.get(
+                "tensor_parallel_degree", 1))
+            if deg > 1:
+                bs.tensor_parallel_degree = deg
         compiled = CompiledProgram(program, build_strategy=bs) \
             .with_data_parallel(loss_name=loss.name)
         program._compiled_for_fleet = compiled

@@ -28,7 +28,7 @@ from ..core.program import VarDesc
 from ..static.layer_helper import LayerHelper
 
 __all__ = ["col_parallel_fc", "row_parallel_fc", "parallel_attention",
-           "TP_RING_ID", "shard_param"]
+           "tp_identity", "TP_RING_ID", "shard_param"]
 
 # reserved ring binding the tensor-parallel mesh axis (sp uses 101)
 TP_RING_ID = 102

@@ -23,7 +23,11 @@ def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
     """Returns (main_program, startup_program, loss, logits); feeds are
     int64 `ids` [batch, seq_len], `pos` [batch, seq_len] (position ids,
     typically np.tile(np.arange(seq_len), (batch, 1))), and `labels`
-    [batch, seq_len, 1]."""
+    [batch, seq_len, 1].
+
+    Attention is BIDIRECTIONAL (BERT/ERNIE-style MLM rehearsal — the
+    bench's north-star config): feed masked-token labels, not shifted
+    next-token labels.  For causal decoding use models.GPTModel."""
     import paddle_tpu.static as static
     from ..distributed.tensor_parallel import (parallel_attention,
                                                col_parallel_fc,

@@ -23,7 +23,10 @@ def test_linear_layer():
     out = lin(x)
     assert out.shape == [2, 4]
     ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
-    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+    # atol guards near-zero elements against reassociation-order noise
+    # (XLA may pick a different matmul algorithm depending on what the
+    # process compiled earlier — observed 2.7e-8 drift in full-suite runs)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
 
 
 def test_conv_bn_pool_stack():
