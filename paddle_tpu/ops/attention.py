@@ -388,7 +388,12 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
                     block_q=256, block_k=512):
     """Flash attention over [B, H, S, D] tensors.  `bias` forces the
     reference path (arbitrary bias breaks the blockwise max-trick bound
-    chosen here; padding masks should be folded into K by the caller)."""
+    chosen here; padding masks should be folded into K by the caller).
+
+    Fully-masked rows (causal with sq > sk leaves the first sq-sk queries
+    without any visible key) output ZERO here, while the reference path's
+    finfo.min masking degrades to a uniform average of V — both values
+    are semantically undefined; don't consume those rows."""
     if bias is not None:
         return reference_attention(q, k, v, bias=bias, causal=causal,
                                    scale=scale)

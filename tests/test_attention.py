@@ -247,3 +247,36 @@ def test_flash_cross_length_causal():
     ref = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_flash_sq_gt_sk_causal_valid_rows():
+    """Bottom-right causal with MORE queries than keys: the first sq-sk
+    rows see no key at all (undefined — flash outputs zero); every valid
+    row must match the reference exactly."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    sq, sk = 128, 64
+    q = jnp.asarray(rng.randn(1, 2, sq, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, sk, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, sk, 32), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    # valid rows (those with >= 1 visible key) agree
+    np.testing.assert_allclose(np.asarray(out[:, :, sq - sk:]),
+                               np.asarray(ref[:, :, sq - sk:]),
+                               rtol=1e-5, atol=1e-5)
+    # undefined rows are zero by convention
+    np.testing.assert_allclose(np.asarray(out[:, :, : sq - sk]), 0.0,
+                               atol=1e-6)
+    # dq, dk AND dv agree (the dkv kernel's causal start index goes
+    # through its negative sk-sq branch exactly in this configuration)
+    gs = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, causal=True)[:, :, sq - sk:].sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    grs = jax.grad(lambda a, b, c: reference_attention(
+        a, b, c, causal=True)[:, :, sq - sk:].sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, gr, tag in zip(gs, grs, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-5, err_msg=tag)

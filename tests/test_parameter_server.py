@@ -83,6 +83,29 @@ def _linreg():
     return main, startup, loss
 
 
+def _transpile_and_train(cfg, endpoints, iters=25):
+    """Shared scaffold for the PS e2e tests: build linreg, transpile with
+    `cfg` against `endpoints`, train `iters` steps on a fixed batch;
+    returns (losses, main_program)."""
+    from paddle_tpu.distributed.ps.ps_optimizer import DistributeTranspiler
+    main, startup, loss = _linreg()
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, pservers=endpoints, trainers=1,
+                startup_program=startup)
+    prog = t.get_trainer_program()
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(prog, feed={"x": xb, "y": yb},
+                                           fetch_list=[loss])[0]))
+                  for _ in range(iters)]
+    return losses, main
+
+
 @pytest.mark.parametrize("sync_mode", [True, False])
 def test_ps_transpiler_end_to_end(sync_mode):
     from paddle_tpu.distributed.ps.ps_optimizer import (
@@ -336,26 +359,10 @@ def test_multi_pserver_sharding_end_to_end():
     srv_a = _start_server(num_trainers=1)
     srv_b = _start_server(num_trainers=1)
     try:
-        main, startup, loss = _linreg()
         cfg = DistributeTranspilerConfig()
         cfg.use_graph_ops = True
-        t = DistributeTranspiler(cfg)
-        eps = f"{srv_a.endpoint},{srv_b.endpoint}"
-        t.transpile(trainer_id=0, program=main, pservers=eps, trainers=1,
-                    startup_program=startup)
-        prog = t.get_trainer_program()
-        exe = static.Executor()
-        scope = static.Scope()
-        rng = np.random.RandomState(0)
-        xb = rng.rand(16, 8).astype(np.float32)
-        yb = xb.sum(1, keepdims=True).astype(np.float32)
-        with static.scope_guard(scope):
-            exe.run(startup)
-            losses = []
-            for _ in range(25):
-                (lv,) = exe.run(prog, feed={"x": xb, "y": yb},
-                                fetch_list=[loss])
-                losses.append(float(np.asarray(lv)))
+        losses, main = _transpile_and_train(
+            cfg, f"{srv_a.endpoint},{srv_b.endpoint}")
         assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
         # every param lives on exactly one server, and both got some
         # (with >1 param the crc32 split puts w and b apart or together —
