@@ -1,30 +1,50 @@
-"""Dygraph→static AST transformation: tensor-dependent Python `if`.
+"""Dygraph→static AST transformation: tensor-dependent `if` / `while` /
+`for` (+ `break`/`continue`).
 
 Reference: /root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
-(ifelse_transformer.py, convert_operators.py convert_ifelse — the
-reference rewrites 24 AST transformer files because its dygraph can't be
-captured mid-flight).
+(ifelse_transformer.py, loop_transformer.py:367 LoopTransformer,
+break_continue_transformer.py:86, convert_operators.py convert_ifelse /
+convert_while_loop — the reference rewrites 24 AST transformer files
+because its dygraph can't be captured mid-flight).
 
 TPU-native scope: the trace-based `to_static` already handles everything
-whose control flow is resolvable at trace time (jax.jit's contract).  The
-one thing tracing CANNOT express is a branch on a traced tensor value —
-this module adds exactly that:
+whose control flow is resolvable at trace time (jax.jit's contract).  What
+tracing CANNOT express is control flow on a traced tensor value — this
+module adds exactly that:
 
   * `ast_transform(fn)` rewrites `if` statements into `convert_ifelse`
     calls (branches hoisted to closures returning the union of assigned
-    names).
+    names), and `while`/`for` statements into `convert_while_loop` calls
+    (test and body hoisted to closures over the loop-variable union).
+    `break`/`continue` are rewritten into boolean flag variables with
+    guard-`if`s (break_continue_transformer.py semantics) BEFORE the
+    loop is hoisted, so they compose with tensor conditions.
   * `convert_ifelse(pred, true_fn, false_fn)`:
       - plain-Python predicate → normal short-circuit execution;
       - dygraph-Tensor predicate outside a trace → eager bool();
       - Tensor predicate INSIDE a to_static trace → both branches are
         traced into fresh sub-blocks, a real `cond` op (the static
         control-flow op, ops/kernels/control.py) is recorded, and the
-        eager values merge via jnp.where — so the captured Program carries
-        true data-dependent control flow, jit.save/load included, and the
-        composed XLA computation lowers it to lax.cond.
+        eager values merge via jnp.where.  Python-scalar branch results
+        that differ (e.g. a break flag set to True in one branch) are
+        lifted to fill_constant tensors and merged the same way.
+  * `convert_while_loop(test_fn, body_fn, names, init)`:
+      - plain-Python condition → normal Python loop (unrolled under
+        tracing: jax.jit's contract);
+      - Tensor condition INSIDE a trace → the body is traced into a
+        sub-block ending in assigns back to the loop-carried parent
+        vars, a real `while` op is recorded (lowered to
+        jax.lax.while_loop / bounded lax.scan by ops/kernels/control.py),
+        and the returned values come from an eager replay of the loop so
+        tracing semantics stay exact.
 
-Unsupported inside a tensor-`if` (transformer raises, to_static falls
-back to pure tracing): `return`/`break`/`continue` in a branch.
+NOTE: converting a tensor-dependent loop executes its body a few times at
+trace time (discovery + record + eager replay) — Python side effects in
+the body (list.append, prints) follow jax tracing rules and may repeat.
+
+Unsupported (transformer raises, to_static falls back to pure tracing):
+`return` inside a tensor branch or loop body, `while`/`for` `else:`
+clauses.
 """
 from __future__ import annotations
 
@@ -53,8 +73,11 @@ class _UndefinedVar:
 
     def _die(self):
         raise NameError(
-            f"variable {self.name!r} is only assigned in one branch of a "
-            f"tensor-dependent `if` and the taken path did not define it")
+            f"variable {self.name!r} has no defined value here: it is "
+            "assigned only in one branch of a tensor-dependent `if` "
+            "(assign it in both branches, or before the `if`) or only "
+            "inside a tensor-dependent loop body (assign it before the "
+            "loop so it becomes loop-carried)")
 
     def __getattr__(self, item):
         self._die()
@@ -130,19 +153,51 @@ def _record_cond(rec, pred, true_fn, false_fn):
     out_tensors, t_outs, f_outs = [], [], []
     for tv, fv in zip(t_list, f_list):
         if isinstance(tv, _UndefinedVar) or isinstance(fv, _UndefinedVar):
+            # assigned in one branch only (or neither): the merged value
+            # is undefined — any later USE raises NameError with the
+            # assign-it-in-both-branches guidance; unused branch-local
+            # temporaries (loop helpers, scratch names) stay harmless
             und = tv if isinstance(tv, _UndefinedVar) else fv
-            if isinstance(tv, _UndefinedVar) and isinstance(
-                    fv, _UndefinedVar):
-                out_tensors.append(und)
-                t_outs.append(None)
-                f_outs.append(None)
-                continue
-            raise Dy2StaticError(
-                f"variable {und.name!r} is assigned in only one branch of "
-                f"a tensor-dependent `if`; assign it in both (or before "
-                f"the `if`)")
+            out_tensors.append(und)
+            t_outs.append(None)
+            f_outs.append(None)
+            continue
         if not isinstance(tv, Tensor) or not isinstance(fv, Tensor):
-            # non-tensor branch results must agree and stay python-level
+            # python-scalar results that DIFFER (a break/continue flag set
+            # True in one branch) get lifted to fill_constant tensors in
+            # each sub-block and merged like tensors
+            # (break_continue_transformer.py makes the reference's flags
+            # real bool variables for the same reason)
+            if (not isinstance(tv, Tensor) and not isinstance(fv, Tensor)
+                    and isinstance(tv, (bool, int, float))
+                    and isinstance(fv, (bool, int, float)) and tv != fv):
+                dt = _scalar_dtype(tv, fv)
+                tv, tn = _lift_scalar(rec, tb, tv, dtype=dt)
+                fv, fn_ = _lift_scalar(rec, fb, fv, dtype=dt)
+                merged = Tensor(jnp.where(pred_raw, tv._value, fv._value))
+                out_tensors.append(merged)
+                t_outs.append(tn)
+                f_outs.append(fn_)
+                continue
+            if isinstance(tv, Tensor) != isinstance(fv, Tensor) and \
+                    isinstance(tv if not isinstance(tv, Tensor) else fv,
+                               (bool, int, float)):
+                # one side tensor, other a python scalar: lift the scalar
+                # into its block with the tensor side's shape/dtype
+                if isinstance(tv, Tensor):
+                    fv, fname = _lift_scalar(rec, fb, fv, like=tv)
+                    f_outs_name = fname
+                    t_outs_name = rec.name_of(tv)
+                else:
+                    tv, tname = _lift_scalar(rec, tb, tv, like=fv)
+                    t_outs_name = tname
+                    f_outs_name = rec.name_of(fv)
+                merged = Tensor(jnp.where(pred_raw, tv._value, fv._value))
+                out_tensors.append(merged)
+                t_outs.append(t_outs_name)
+                f_outs.append(f_outs_name)
+                continue
+            # remaining non-tensor branch results must agree, stay python
             if tv is not fv and tv != fv:
                 raise Dy2StaticError(
                     "non-tensor values returned from a tensor-dependent "
@@ -193,6 +248,368 @@ def _record_cond(rec, pred, true_fn, false_fn):
                "false_outs": [n for n in f_outs if n is not None],
                "cond_name": pred_name})
     return tuple(out_tensors)
+
+
+def _scalar_dtype(*vals):
+    """fill_constant dtype for lifted python scalars."""
+    if all(isinstance(v, bool) for v in vals):
+        return "bool"
+    if all(isinstance(v, (bool, int)) for v in vals):
+        return "int64"
+    return "float32"
+
+
+def _lift_scalar(rec, block, value, dtype=None, like=None):
+    """Materialize a python scalar as a fill_constant op inside an
+    already-closed sub-block; returns (eager Tensor, var name)."""
+    from ..dygraph.tensor import Tensor
+    from ..core.program import unique_name
+    if like is not None:
+        shape, dtype = tuple(like.shape), str(like.dtype)
+    else:
+        shape, dtype = (), (dtype or _scalar_dtype(value, value))
+    name = unique_name("dy2st_lift")
+    block.create_var(name=name, shape=shape, dtype=dtype,
+                     stop_gradient=True)
+    block.append_op("fill_constant", inputs={}, outputs={"Out": [name]},
+                    attrs={"shape": list(shape), "dtype": dtype,
+                           "value": value})
+    from ..core.dtype import np_dtype
+    t = Tensor(jnp.full(shape, value, np_dtype(dtype)))
+    return t, name
+
+
+# ---------------------------------------------------------------------------
+# loop conversion (loop_transformer.py / convert_operators.py analogs)
+# ---------------------------------------------------------------------------
+def _is_tensor(v):
+    from ..dygraph.tensor import Tensor
+    return isinstance(v, Tensor)
+
+
+def convert_logical_and(*operands):
+    """Lazy tensor-aware `and` (convert_operators.py convert_logical_and).
+    Operands may be values or thunks.  Pure-python operands keep python's
+    value semantics (`a and b` returns the deciding operand, lazily);
+    tensor operands combine via the logical_and op."""
+    from ..dygraph import tracer as dytracer
+    vals, last = [], True
+    for f in operands:
+        v = f() if callable(f) and not _is_tensor(f) else f
+        if not _is_tensor(v):
+            if not v:
+                return v if not vals else False
+            last = v
+        else:
+            vals.append(v)
+    if not vals:
+        return last
+    out = vals[0]
+    for v in vals[1:]:
+        out = dytracer.trace_op("logical_and", {"X": out, "Y": v}, {},
+                                ["Out"])
+    return out
+
+
+def convert_logical_or(*operands):
+    from ..dygraph import tracer as dytracer
+    vals, last = [], False
+    for f in operands:
+        v = f() if callable(f) and not _is_tensor(f) else f
+        if not _is_tensor(v):
+            if v:
+                return v if not vals else True
+            last = v
+        else:
+            vals.append(v)
+    if not vals:
+        return last
+    out = vals[0]
+    for v in vals[1:]:
+        out = dytracer.trace_op("logical_or", {"X": out, "Y": v}, {},
+                                ["Out"])
+    return out
+
+
+def convert_logical_not(x):
+    from ..dygraph import tracer as dytracer
+    if _is_tensor(x):
+        return dytracer.trace_op("logical_not", {"X": x}, {}, ["Out"])
+    return not x
+
+
+def convert_not_any(*flags):
+    """not (f1 or f2 or ...) — the break/continue guard predicate."""
+    return convert_logical_not(convert_logical_or(*flags))
+
+
+def convert_lt(a, b):
+    if _is_tensor(a):
+        return a < b
+    if _is_tensor(b):
+        return b > a
+    return a < b
+
+
+def convert_idx_inc(i):
+    return i + 1
+
+
+def convert_range_setup(*args):
+    """Normalize range(...) args into (("range", start, step), n) where n
+    is a python int for static bounds or a Tensor for tensor bounds."""
+    from ..dygraph import tracer as dytracer
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+    if not any(_is_tensor(v) for v in (start, stop, step)):
+        return ("range", int(start), int(step)), len(
+            range(int(start), int(stop), int(step)))
+    # ceil((stop-start)/step) == -floor((start-stop)/step); floor-division
+    # semantics match between python and jnp for ints
+    d = start - stop            # tensor arithmetic via op sugar
+    q = d // step if _is_tensor(d) else _rsub_floordiv(d, step)
+    n = 0 - q
+    zero = _const_like(n, 0)
+    n = dytracer.trace_op("elementwise_max", {"X": n, "Y": zero}, {},
+                          ["Out"])
+    return ("range", start, step), n
+
+
+def _rsub_floordiv(d, step):
+    # d python, step tensor: route through the tensor's reverse op
+    from ..dygraph.tensor import Tensor
+    if not isinstance(step, Tensor):
+        return d // step
+    return Tensor(jnp.asarray(d, step._value.dtype)) // step
+
+
+def _const_like(t, value):
+    from ..dygraph.tensor import Tensor
+    return Tensor(jnp.asarray(value, t._value.dtype))
+
+
+def convert_for_setup(it):
+    """(iterable, length) for the for→while rewrite.  Tensors iterate
+    their leading axis (static length → the loop unrolls under tracing,
+    jax-idiomatic); plain python iterables are materialized if needed."""
+    import collections.abc
+    if _is_tensor(it):
+        if not it.shape:
+            raise Dy2StaticError("cannot iterate a 0-d tensor")
+        return it, int(it.shape[0])
+    if isinstance(it, range):
+        return ("range", it.start, it.step), len(it)
+    if isinstance(it, collections.abc.Sequence):
+        return it, len(it)  # positionally indexable (list/tuple/str/...)
+    # mappings iterate their KEYS; generators/sets/etc. materialize
+    seq = list(it)
+    return seq, len(seq)
+
+
+def convert_iter_item(it, idx):
+    from ..dygraph import tracer as dytracer
+    from ..dygraph.tensor import Tensor
+    if isinstance(it, tuple) and len(it) == 3 and it[0] == "range":
+        _, start, step = it
+        return start + idx * step
+    if _is_tensor(it):
+        idx_t = idx if _is_tensor(idx) else Tensor(
+            jnp.asarray(idx, jnp.int32))
+        return dytracer.trace_op("gather", {"X": it, "Index": idx_t},
+                                 {"axis": 0}, ["Out"])
+    if _is_tensor(idx):
+        raise Dy2StaticError(
+            "tensor loop index over a plain python sequence — materialize "
+            "the sequence as a tensor first")
+    return it[idx]
+
+
+def convert_while_loop(test_fn, body_fn, names, init):
+    """convert_operators.py convert_while_loop analog.  `names` is the
+    loop-variable union (assigned in body), `init` their current values
+    (Undefined when not yet bound).  Dispatch: python condition → normal
+    loop (unrolls under tracing); Tensor condition inside a to_static
+    trace → record a real `while` op."""
+    from ..dygraph import tracer as dytracer
+    vals = list(init)
+    pred = test_fn(*vals)
+    rec = dytracer._PROGRAM_RECORDER
+    if rec is not None and _is_tensor(pred):
+        return _record_while(rec, pred, test_fn, body_fn, names, vals)
+    while _to_bool(pred):
+        vals = list(_as_tuple(body_fn(*vals), len(names)))
+        pred = test_fn(*vals)
+        if dytracer._PROGRAM_RECORDER is not None and _is_tensor(pred):
+            # the condition became tensor-dependent mid-unroll (e.g. a
+            # tensor break flag inside a python-bounded for): the unrolled
+            # prefix was decided by python-only state, so it is
+            # input-independent — record a `while` op for the remainder
+            return _record_while(dytracer._PROGRAM_RECORDER, pred,
+                                 test_fn, body_fn, names, vals)
+    return tuple(vals)
+
+
+def _as_tuple(v, n):
+    if n == 1 and not isinstance(v, tuple):
+        return (v,)
+    return v
+
+
+def _record_while(rec, pred0, test_fn, body_fn, names, vals):
+    """Trace the loop body into a sub-block ending in assigns back to the
+    loop-carried parent vars, append a `while` op (while_op.cc:1 analog),
+    and return eager-replay final values registered to the carried names."""
+    from ..dygraph.tensor import Tensor
+    from ..dygraph import tracer as dytracer
+
+    program, parent = rec.program, rec.block
+    pred_name = rec.name_of(pred0)
+
+    # 1. discovery + eager replay: run the loop with true trace-time
+    #    semantics (recorder off), tracking at EVERY iteration which
+    #    python-scalar loop vars change or become tensors — a counter that
+    #    only moves in iteration 3 still needs lifting.  A forced single
+    #    body probe covers traces whose replay runs zero iterations.
+    n_vars = len(names)
+    tensor_like = [None] * n_vars    # tensor a python var became
+    observed = [list() for _ in range(n_vars)]  # python values seen
+    bad_type = [None] * n_vars
+
+    def _track(prev, new):
+        for i, (o, n) in enumerate(zip(prev, new)):
+            if isinstance(o, _UndefinedVar) or _is_tensor(o):
+                continue
+            if _is_tensor(n):
+                if tensor_like[i] is None:
+                    tensor_like[i] = n
+            elif isinstance(n, (bool, int, float)):
+                if n != o:
+                    observed[i].append(n)
+            elif n is not o and n != o:
+                bad_type[i] = type(o).__name__
+
+    saved = dytracer._PROGRAM_RECORDER
+    dytracer._PROGRAM_RECORDER = None
+    try:
+        cur = list(vals)
+        p = pred0
+        if not _to_bool(p):
+            # zero-iteration trace: force ONE body probe so lift
+            # discovery still observes the body (best effort — the body
+            # may legitimately fail outside the loop's guard)
+            try:
+                _track(vals, list(_as_tuple(body_fn(*vals), n_vars)))
+            except Exception:
+                pass
+        while _to_bool(p):
+            new = list(_as_tuple(body_fn(*cur), n_vars))
+            _track(cur, new)
+            cur = new
+            p = test_fn(*cur)
+    finally:
+        dytracer._PROGRAM_RECORDER = saved
+
+    lifted = list(vals)
+    for i, old in enumerate(vals):
+        if isinstance(old, _UndefinedVar) or _is_tensor(old):
+            continue
+        if bad_type[i] is not None:
+            raise Dy2StaticError(
+                f"loop variable {names[i]!r} is a python {bad_type[i]} "
+                "that changes across iterations of a tensor-dependent "
+                "while — only scalars can be lifted to loop-carried "
+                "tensors")
+        if tensor_like[i] is None and not observed[i]:
+            continue  # never changes — stays a python constant
+        # a python scalar that changes across iterations (loop counter)
+        # or becomes a tensor (break flag merged in a tensor-if) must
+        # itself become loop-carried device state
+        if not isinstance(old, (bool, int, float)):
+            raise Dy2StaticError(
+                f"loop variable {names[i]!r} starts as "
+                f"{type(old).__name__} but becomes a Tensor")
+        if tensor_like[i] is not None:
+            dt = str(tensor_like[i].dtype)
+            shape = tuple(tensor_like[i].shape)
+        else:
+            dt, shape = _scalar_dtype(old, *observed[i]), ()
+        t = dytracer.trace_op(
+            "fill_constant", {},
+            {"shape": list(shape), "dtype": dt, "value": old},
+            ["Out"])
+        lifted[i] = t
+
+    # 2. record the body into a sub-block
+    sub = program.create_block(parent_idx=parent.idx)
+    program.rollback()
+    saved_block = rec.block
+    rec.block = sub
+    try:
+        new_vals = list(_as_tuple(body_fn(*lifted), len(names)))
+        new_pred = test_fn(*new_vals)
+    finally:
+        rec.block = saved_block
+    if not _is_tensor(new_pred):
+        raise Dy2StaticError(
+            "while condition is a Tensor on entry but not after one "
+            "iteration — condition type must be stable")
+
+    carried_ix = {}
+    for i, (old, new) in enumerate(zip(lifted, new_vals)):
+        if isinstance(old, _UndefinedVar) or not _is_tensor(old):
+            continue
+        if not _is_tensor(new):
+            raise Dy2StaticError(
+                f"loop variable {names[i]!r} is a Tensor before the loop "
+                f"but {type(new).__name__} after one iteration")
+        pname = rec.name_of(old)
+        nname = rec.name_of(new)
+        if nname == pname:
+            continue  # unchanged — read-only free var
+        if tuple(new.shape) != tuple(old.shape) or \
+                str(new.dtype) != str(old.dtype):
+            raise Dy2StaticError(
+                f"loop variable {names[i]!r} changes shape/dtype across "
+                f"iterations: {tuple(old.shape)}/{old.dtype} -> "
+                f"{tuple(new.shape)}/{new.dtype}")
+        sub.append_op("assign", inputs={"X": [nname]},
+                      outputs={"Out": [pname]}, attrs={})
+        carried_ix[i] = pname
+    np_name = rec.name_of(new_pred)
+    sub.append_op("assign", inputs={"X": [np_name]},
+                  outputs={"Out": [pred_name]}, attrs={})
+
+    if not carried_ix:
+        raise Dy2StaticError(
+            "tensor-dependent while body updates no loop variable — the "
+            "loop would never terminate")
+    from ..static.control_flow import append_while_op
+    append_while_op(parent, sub, pred_name)
+
+    # 3. outputs: the replay already produced the true trace-time finals
+    outs = []
+    for i, name in enumerate(names):
+        init_v = lifted[i]
+        if isinstance(init_v, _UndefinedVar) or not _is_tensor(init_v):
+            # loop-local (no pre-loop value) or unchanged python value —
+            # loop-locals would read stale trace values downstream, so any
+            # later use raises via Undefined
+            outs.append(_UndefinedVar(name)
+                        if isinstance(init_v, _UndefinedVar) else init_v)
+            continue
+        if i in carried_ix:
+            fin = cur[i]
+            t = fin if _is_tensor(fin) else Tensor(
+                jnp.asarray(fin, init_v._value.dtype))
+            rec.register(t, carried_ix[i])
+            outs.append(t)
+        else:
+            outs.append(init_v)
+    return tuple(outs)
 
 
 # ---------------------------------------------------------------------------
@@ -306,11 +723,294 @@ def _has_flow_escape(stmts) -> bool:
     return v.found
 
 
+def _jst_attr(name):
+    return ast.Attribute(value=ast.Name(id="_ptpu_jst", ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _jst_call(name, args):
+    return ast.Call(func=_jst_attr(name), args=args, keywords=[])
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                         kw_defaults=[], defaults=[])
+
+
+def _lambda0(body_expr):
+    return ast.Lambda(args=_no_args(), body=body_expr)
+
+
+class _BCFinder(ast.NodeVisitor):
+    """Break/Continue bound to the CURRENT loop level (nested loops own
+    theirs)."""
+
+    def __init__(self):
+        self.brk = self.cont = False
+
+    def visit_Break(self, n):
+        self.brk = True
+
+    def visit_Continue(self, n):
+        self.cont = True
+
+    def visit_While(self, n):
+        pass
+
+    def visit_For(self, n):
+        pass
+
+    visit_AsyncFor = visit_For
+
+    def visit_FunctionDef(self, n):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _BCReplacer(ast.NodeTransformer):
+    def __init__(self, bflag, cflag):
+        self.bflag, self.cflag = bflag, cflag
+
+    def _set(self, flag):
+        return ast.Assign(
+            targets=[ast.Name(id=flag, ctx=ast.Store())],
+            value=ast.Constant(value=True))
+
+    def visit_Break(self, n):
+        return self._set(self.bflag)
+
+    def visit_Continue(self, n):
+        return self._set(self.cflag)
+
+    def visit_While(self, n):
+        return n
+
+    def visit_For(self, n):
+        return n
+
+    visit_AsyncFor = visit_For
+
+    def visit_FunctionDef(self, n):
+        return n
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _sets_flags(stmt, flags) -> bool:
+    for n in ast.walk(stmt):
+        if isinstance(n, (ast.While, ast.For, ast.FunctionDef,
+                          ast.AsyncFunctionDef)) and n is not stmt:
+            continue  # flags of THIS loop never live inside those
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id in flags:
+                    return True
+    return False
+
+
+def _guard_stmts(stmts, flags):
+    """After any statement that may set a break/continue flag, wrap the
+    remaining statements in `if not any(flags):`
+    (break_continue_transformer.py's guard construction)."""
+    out = []
+    for k, s in enumerate(stmts):
+        s = _guard_in_stmt(s, flags)
+        out.append(s)
+        if _sets_flags(s, flags) and k + 1 < len(stmts):
+            rest = _guard_stmts(stmts[k + 1:], flags)
+            out.append(ast.If(
+                test=_jst_call("convert_not_any",
+                               [ast.Name(id=f, ctx=ast.Load())
+                                for f in flags]),
+                body=rest, orelse=[]))
+            break
+    return out
+
+
+def _guard_in_stmt(s, flags):
+    if isinstance(s, ast.If):
+        s.body = _guard_stmts(s.body, flags)
+        s.orelse = _guard_stmts(s.orelse, flags) if s.orelse else []
+    elif isinstance(s, (ast.With, ast.AsyncWith)):
+        s.body = _guard_stmts(s.body, flags)
+    elif isinstance(s, ast.Try):
+        s.body = _guard_stmts(s.body, flags)
+        s.orelse = _guard_stmts(s.orelse, flags) if s.orelse else []
+        s.finalbody = (_guard_stmts(s.finalbody, flags)
+                       if s.finalbody else [])
+        for h in s.handlers:
+            h.body = _guard_stmts(h.body, flags)
+    return s
+
+
 class _IfTransformer(ast.NodeTransformer):
     def __init__(self):
         self.count = 0
+        self.loop_count = 0
+
+    # -- loops (loop_transformer.py:367 LoopTransformer analog) -----------
+    def visit_While(self, node):
+        if node.orelse:
+            raise Dy2StaticError("while-else is not supported by the "
+                                 "dy2static loop transform")
+        return self._transform_loop(node.test, node.body, [])
+
+    def visit_For(self, node):
+        if node.orelse:
+            raise Dy2StaticError("for-else is not supported by the "
+                                 "dy2static loop transform")
+        i = self.loop_count
+        self.loop_count += 1
+        it_n, n_n, idx_n = (f"_ptpu_it_{i}", f"_ptpu_n_{i}",
+                            f"_ptpu_idx_{i}")
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords):
+            setup_call = _jst_call("convert_range_setup", it.args)
+        else:
+            setup_call = _jst_call("convert_for_setup", [it])
+        setup = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=it_n, ctx=ast.Store()),
+                      ast.Name(id=n_n, ctx=ast.Store())],
+                ctx=ast.Store())],
+            value=setup_call)
+        idx_init = ast.Assign(
+            targets=[ast.Name(id=idx_n, ctx=ast.Store())],
+            value=ast.Constant(value=0))
+        target_assign = ast.Assign(
+            targets=[node.target],
+            value=_jst_call("convert_iter_item",
+                            [ast.Name(id=it_n, ctx=ast.Load()),
+                             ast.Name(id=idx_n, ctx=ast.Load())]))
+        inc = ast.Assign(
+            targets=[ast.Name(id=idx_n, ctx=ast.Store())],
+            value=_jst_call("convert_idx_inc",
+                            [ast.Name(id=idx_n, ctx=ast.Load())]))
+        test = _jst_call("convert_lt",
+                         [ast.Name(id=idx_n, ctx=ast.Load()),
+                          ast.Name(id=n_n, ctx=ast.Load())])
+        stmts = self._transform_loop(test, [target_assign] + node.body,
+                                     [inc])
+        return [setup, idx_init] + stmts
+
+    def _transform_loop(self, test, body, post):
+        test = self._rewrite_cond_boolops(test)
+        # 1. this loop's break/continue -> flag vars + guard ifs
+        finder = _BCFinder()
+        for s in body:
+            finder.visit(s)
+        pre = []
+        i = self.loop_count
+        self.loop_count += 1
+        if finder.brk or finder.cont:
+            bflag, cflag = f"_ptpu_brk_{i}", f"_ptpu_cont_{i}"
+            rep = _BCReplacer(bflag, cflag)
+            body = [rep.visit(s) for s in body]
+            flags = [f for f, on in ((bflag, finder.brk),
+                                     (cflag, finder.cont)) if on]
+            body = _guard_stmts(body, flags)
+            if finder.cont:
+                body.insert(0, ast.Assign(
+                    targets=[ast.Name(id=cflag, ctx=ast.Store())],
+                    value=ast.Constant(value=False)))
+            if finder.brk:
+                pre.append(ast.Assign(
+                    targets=[ast.Name(id=bflag, ctx=ast.Store())],
+                    value=ast.Constant(value=False)))
+                # flag FIRST: after a python-level break fires, lazy
+                # short-circuit must not re-evaluate the original test
+                # (Python never evaluates the test after break)
+                test = _jst_call(
+                    "convert_logical_and",
+                    [_lambda0(_jst_call("convert_logical_not",
+                                        [ast.Name(id=bflag,
+                                                  ctx=ast.Load())])),
+                     _lambda0(test)])
+        # 2. recurse (nested loops, ifs including the guard ifs)
+        new_body = []
+        for s in body + post:
+            r = self.visit(s)
+            if isinstance(r, list):
+                new_body.extend(r)
+            elif r is not None:
+                new_body.append(r)
+        test = self.visit(test)
+        if _has_flow_escape(new_body):
+            raise Dy2StaticError(
+                "return inside a loop body is not supported by the "
+                "dy2static loop transform")
+        # 3. hoist into test/body closures over the loop-variable union
+        names = _assigned_names(new_body)
+        tname, bname = f"_ptpu_wtest_{i}", f"_ptpu_wbody_{i}"
+
+        def make_fn(name, stmts, ret_expr):
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in names],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=stmts + [ast.Return(value=ret_expr)],
+                decorator_list=[])
+
+        test_def = make_fn(tname, [], test)
+        body_def = make_fn(
+            bname, new_body,
+            ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                            for n in names], ctx=ast.Load()))
+        call = _jst_call(
+            "convert_while_loop",
+            [ast.Name(id=tname, ctx=ast.Load()),
+             ast.Name(id=bname, ctx=ast.Load()),
+             ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                       ctx=ast.Load()),
+             self._grab_env(names)])
+        if names:
+            assign = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store())
+                          for n in names], ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return pre + [test_def, body_def, assign]
+
+    @staticmethod
+    def _grab_env(names):
+        return ast.Tuple(
+            elts=[ast.Call(
+                func=_jst_attr("_grab"),
+                args=[_lambda0(ast.Name(id=n, ctx=ast.Load())),
+                      ast.Constant(value=n)],
+                keywords=[]) for n in names],
+            ctx=ast.Load())
+
+    # -- boolean operators (logical_transformer.py analog) -----------------
+    @classmethod
+    def _rewrite_cond_boolops(cls, expr):
+        """Rewrite `and`/`or`/`not` along the boolean SPINE of a condition
+        expression into the lazy tensor-aware converters — `a and b` on
+        traced tensors would otherwise concretize through __bool__ at
+        trace time, baking the trace input's outcome into the program.
+        Only condition positions are rewritten (value-context BoolOps like
+        `y = x or default` keep exact python value semantics), and the
+        rewrite does not descend past the spine (operands of comparisons,
+        calls, etc. are left untouched)."""
+        if isinstance(expr, ast.BoolOp):
+            fn = ("convert_logical_and" if isinstance(expr.op, ast.And)
+                  else "convert_logical_or")
+            return _jst_call(fn, [
+                _lambda0(cls._rewrite_cond_boolops(v))
+                for v in expr.values])
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            return _jst_call("convert_logical_not",
+                             [cls._rewrite_cond_boolops(expr.operand)])
+        return expr
 
     def visit_If(self, node):
+        node.test = self._rewrite_cond_boolops(node.test)
         self.generic_visit(node)
         if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
             raise Dy2StaticError(
@@ -340,19 +1040,7 @@ class _IfTransformer(ast.NodeTransformer):
 
         # current values of the assigned names (UndefinedVar when a name
         # doesn't exist yet), evaluated lazily at the call site
-        env = ast.Tuple(
-            elts=[ast.Call(
-                func=ast.Attribute(
-                    value=ast.Name(id="_ptpu_jst", ctx=ast.Load()),
-                    attr="_grab", ctx=ast.Load()),
-                args=[ast.Lambda(
-                    args=ast.arguments(posonlyargs=[], args=[],
-                                       kwonlyargs=[], kw_defaults=[],
-                                       defaults=[]),
-                    body=ast.Name(id=n, ctx=ast.Load())),
-                    ast.Constant(value=n)],
-                keywords=[]) for n in outs],
-            ctx=ast.Load())
+        env = self._grab_env(outs)
         call = ast.Call(
             func=ast.Attribute(
                 value=ast.Name(id="_ptpu_jst", ctx=ast.Load()),
@@ -402,19 +1090,31 @@ def ast_transform(fn):
                 "function carries decorators other than to_static; "
                 "falling back to tracing")
     fdef.decorator_list = []
-    if not any(isinstance(n, ast.If) for n in ast.walk(fdef)):
-        raise Dy2StaticError("no if statements — nothing to transform")
+    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+               for n in ast.walk(fdef)):
+        raise Dy2StaticError(
+            "no if/while/for statements — nothing to transform")
     _IfTransformer().visit(fdef)
 
     freevars = fn.__code__.co_freevars
     if freevars:
         # rebind the closure: wrap the transformed def in an outer function
-        # taking the free variables as args (values snapshotted from the
-        # original cells at transform time)
+        # taking the original CELL objects as args; the inner function
+        # re-reads cell_contents on every call, so later rebinds of a free
+        # variable stay visible (late binding, matching the untransformed
+        # function)
+        cell_params = [f"_ptpu_cell_{n}" for n in freevars]
+        deref = [ast.Assign(
+            targets=[ast.Name(id=n, ctx=ast.Store())],
+            value=ast.Attribute(
+                value=ast.Name(id=c, ctx=ast.Load()),
+                attr="cell_contents", ctx=ast.Load()))
+            for n, c in zip(freevars, cell_params)]
+        fdef.body = deref + fdef.body
         outer = ast.FunctionDef(
             name="__dy2st_outer__",
             args=ast.arguments(
-                posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                posonlyargs=[], args=[ast.arg(arg=c) for c in cell_params],
                 kwonlyargs=[], kw_defaults=[], defaults=[]),
             body=[fdef,
                   ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))],
@@ -433,11 +1133,7 @@ def ast_transform(fn):
     exec(code, glb, loc)
     if freevars:
         cells = dict(zip(fn.__code__.co_freevars, fn.__closure__))
-        try:
-            vals = [cells[n].cell_contents for n in freevars]
-        except ValueError as e:  # cell still empty at decoration time
-            raise Dy2StaticError(f"closure cell not yet filled: {e}")
-        new_fn = loc["__dy2st_outer__"](*vals)
+        new_fn = loc["__dy2st_outer__"](*[cells[n] for n in freevars])
     else:
         new_fn = loc[fdef.name]
     new_fn.__wrapped__ = fn

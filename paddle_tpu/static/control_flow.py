@@ -102,6 +102,24 @@ def _sub_block(program: Program):
         program.rollback()
 
 
+def append_while_op(parent: Block, sub: Block, cond_name: str,
+                    is_test: bool = False):
+    """Analyze a closed while sub-block and append the `while` op to the
+    parent (single producer of the op schema — While.block() and the
+    dy2static loop recorder both route here).  Returns (free, written)."""
+    free, written = _analyze_block(sub)
+    x_names = list(dict.fromkeys(
+        [n for n in free if n != cond_name] + written))
+    parent.append_op(
+        "while",
+        inputs={"Condition": [cond_name], "X": x_names},
+        outputs={"Out": list(written)},
+        attrs={"sub_block": sub.idx, "x_names": x_names,
+               "carry_names": list(written), "cond_name": cond_name,
+               "is_test": is_test})
+    return free, written
+
+
 # ---------------------------------------------------------------------------
 # While
 # ---------------------------------------------------------------------------
@@ -139,23 +157,15 @@ class While:
         parent = self.program.current_block()
         with _sub_block(self.program) as sub:
             yield
-        free, written = _analyze_block(sub)
         cond_name = self.cond_var.name
+        _, written = _analyze_block(sub)
         if cond_name not in written:
             raise ValueError(
                 "While body never updates the loop condition "
                 f"{cond_name!r}; the loop would not terminate")
         # carried vars (written parent state incl. cond) need initial
         # values, so they are inputs too
-        x_names = list(dict.fromkeys(
-            [n for n in free if n != cond_name] + written))
-        parent.append_op(
-            "while",
-            inputs={"Condition": [cond_name], "X": x_names},
-            outputs={"Out": list(written)},
-            attrs={"sub_block": sub.idx, "x_names": x_names,
-                   "carry_names": list(written), "cond_name": cond_name,
-                   "is_test": self.is_test})
+        append_while_op(parent, sub, cond_name, self.is_test)
 
 
 # ---------------------------------------------------------------------------

@@ -383,3 +383,303 @@ def test_dy2static_late_bound_global():
     x = _x()
     np.testing.assert_allclose(traced(x).numpy(), x.numpy() * 2.0,
                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# dy2static loop conversion (reference loop_transformer.py:367,
+# break_continue_transformer.py:86)
+# ---------------------------------------------------------------------------
+def _t(arr, dtype=np.float32):
+    return paddle_tpu.to_tensor(np.asarray(arr, dtype))
+
+
+def test_dy2static_while_records_while_op_and_reuses():
+    @jit.to_static
+    def countdown(x):
+        s = x * 0.0
+        while x.sum() > 0:
+            s = s + x
+            x = x - 1.0
+        return s
+
+    def ref(xv):
+        s = xv * 0
+        while xv.sum() > 0:
+            s = s + xv
+            xv = xv - 1
+        return s
+
+    out = countdown(_t([3.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), ref(np.array([3.0, 2.0])),
+                               rtol=1e-6)
+    cp = countdown.concrete_program(_t([3.0, 2.0]))
+    types = [op.type for op in cp.program.global_block().ops]
+    assert "while" in types, types
+    assert len(cp.program.blocks) >= 2
+    # the SAME compiled program must be right for a different trip count —
+    # the point of a real while op vs trace-time unrolling
+    out2 = countdown(_t([5.0, 1.0]))
+    np.testing.assert_allclose(out2.numpy(), ref(np.array([5.0, 1.0])),
+                               rtol=1e-6)
+    assert len(countdown._cache) == 1
+
+
+def test_dy2static_decode_loop_with_break():
+    # GPT-style greedy decode shape: fixed buffer, tensor stop condition,
+    # data-dependent break
+    @jit.to_static
+    def decode(seed, buf, i):
+        while i.sum() < 6:
+            tok = (seed + i).sum() % 5.0
+            if tok > 3.0:
+                break
+            buf = buf + tok
+            i = i + 1
+        return buf, i
+
+    def ref(sv, bv, iv):
+        while iv.sum() < 6:
+            tok = (sv + iv).sum() % 5.0
+            if tok > 3.0:
+                break
+            bv = bv + tok
+            iv = iv + 1
+        return bv, iv
+
+    for sv in (1.0, 2.0):
+        out, iend = decode(_t([sv]), _t(np.zeros(4)), _t([0.0]))
+        ro, ri = ref(np.array([sv], np.float32), np.zeros(4, np.float32),
+                     np.array([0.0], np.float32))
+        np.testing.assert_allclose(out.numpy(), ro, rtol=1e-6)
+        np.testing.assert_allclose(iend.numpy(), ri, rtol=1e-6)
+    cp = decode.concrete_program(_t([1.0]), _t(np.zeros(4)), _t([0.0]))
+    types = [op.type for op in cp.program.global_block().ops]
+    assert "while" in types, types
+    assert len(decode._cache) == 1
+
+
+def test_dy2static_continue_in_while():
+    @jit.to_static
+    def skip_odd(x):
+        s = x * 0.0
+        k = x.sum() * 0.0
+        while k < 5:
+            k = k + 1
+            if (k % 2) > 0:
+                continue
+            s = s + k
+        return s
+
+    got = skip_odd(_t([0.0]))
+    np.testing.assert_allclose(got.numpy(), [6.0], rtol=1e-6)  # 2 + 4
+    cp = skip_odd.concrete_program(_t([0.0]))
+    assert "while" in [op.type for op in cp.program.global_block().ops]
+
+
+def test_dy2static_for_range_tensor_bound():
+    @jit.to_static
+    def tsum(n, x):
+        acc = x * 0.0
+        for _ in range(n):
+            acc = acc + x
+        return acc
+
+    got = tsum(_t(4, np.int32), _t([1.5]))
+    np.testing.assert_allclose(got.numpy(), [6.0], rtol=1e-6)
+    cp = tsum.concrete_program(_t(4, np.int32), _t([1.5]))
+    assert "while" in [op.type for op in cp.program.global_block().ops]
+    # same compiled program, different bound
+    got2 = tsum(_t(7, np.int32), _t([2.0]))
+    np.testing.assert_allclose(got2.numpy(), [14.0], rtol=1e-6)
+    assert len(tsum._cache) == 1
+
+
+def test_dy2static_for_over_tensor_unrolls_with_gather():
+    @jit.to_static
+    def rowsum(m):
+        acc = m.sum(axis=0) * 0.0
+        for row in m:
+            acc = acc + row
+        return acc
+
+    m = np.arange(6, dtype=np.float32).reshape(3, 2)
+    got = rowsum(_t(m))
+    np.testing.assert_allclose(got.numpy(), m.sum(0), rtol=1e-6)
+    cp = rowsum.concrete_program(_t(m))
+    types = [op.type for op in cp.program.global_block().ops]
+    assert "gather" in types  # leading-axis iteration via named op
+
+
+def test_dy2static_nested_while():
+    @jit.to_static
+    def nested(x):
+        total = x * 0.0
+        i = x.sum() * 0.0
+        while i < 3:
+            j = x.sum() * 0.0
+            while j < 2:
+                total = total + 1.0
+                j = j + 1
+            i = i + 1
+        return total
+
+    got = nested(_t([0.0]))
+    np.testing.assert_allclose(got.numpy(), [6.0], rtol=1e-6)
+    cp = nested.concrete_program(_t([0.0]))
+    # outer while in block 0, inner while inside the outer sub-block
+    assert "while" in [op.type for op in cp.program.global_block().ops]
+    sub_types = [op.type for b in cp.program.blocks[1:] for op in b.ops]
+    assert "while" in sub_types
+
+
+def test_dy2static_python_condition_unrolls():
+    # plain python bounds stay trace-time (jax.jit contract): no while op
+    @jit.to_static
+    def unrolled(x):
+        for _ in range(3):
+            x = x * 2.0
+        return x
+
+    got = unrolled(_t([1.0]))
+    np.testing.assert_allclose(got.numpy(), [8.0], rtol=1e-6)
+    cp = unrolled.concrete_program(_t([1.0]))
+    types = [op.type for op in cp.program.global_block().ops]
+    assert "while" not in types
+    assert types.count("elementwise_mul") == 3
+
+
+def test_dy2static_loop_save_load_roundtrip():
+    @jit.to_static
+    def triple_until(x):
+        while x.sum() < 20:
+            x = x * 3.0
+        return x
+
+    out = triple_until(_t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [27.0], rtol=1e-6)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "loopmod")
+        jit.save(triple_until, path,
+                 input_spec=[InputSpec([1], "float32")])
+        loaded = jit.load(path)
+        got = loaded(_t([2.0]))
+        got = got[0] if isinstance(got, (list, tuple)) else got
+        np.testing.assert_allclose(got.numpy(), [54.0], rtol=1e-6)
+
+
+def test_dy2static_late_changing_python_loop_var():
+    # a python counter that only moves in later iterations must still be
+    # lifted to loop-carried state (multi-iteration discovery)
+    @jit.to_static
+    def late_k(x):
+        k = 0.0
+        while x.sum() < 4:
+            x = x + 1.0
+            if x.sum() > 2:
+                k = k + 1.0
+        return x + k
+
+    def ref(xv):
+        k = 0.0
+        while xv.sum() < 4:
+            xv = xv + 1.0
+            if xv.sum() > 2:
+                k = k + 1.0
+        return xv + k
+
+    for v in (0.0, 1.0):
+        got = late_k(_t([v]))
+        np.testing.assert_allclose(got.numpy(), ref(np.array([v],
+                                                            np.float32)))
+    assert len(late_k._cache) == 1
+
+
+def test_dy2static_tensor_break_in_python_for():
+    # condition becomes tensor-dependent mid-unroll: the unrolled prefix
+    # is python-decided, the remainder must become a real while op
+    @jit.to_static
+    def for_break(x):
+        for _ in range(5):
+            if x.sum() > 3.0:
+                break
+            x = x + 1.0
+        return x
+
+    def ref(xv):
+        for _ in range(5):
+            if xv.sum() > 3.0:
+                break
+            xv = xv + 1.0
+        return xv
+
+    # trace with an input that breaks immediately, then reuse with one
+    # that runs all iterations — the cached program must be right
+    got = for_break(_t([3.5]))
+    np.testing.assert_allclose(got.numpy(), ref(np.array([3.5],
+                                                         np.float32)))
+    got = for_break(_t([0.0]))
+    np.testing.assert_allclose(got.numpy(), ref(np.array([0.0],
+                                                         np.float32)))
+    assert len(for_break._cache) == 1
+
+
+def test_dy2static_boolop_condition():
+    # python `and` in the loop condition must not concretize the tensor
+    # operands at trace time
+    @jit.to_static
+    def both(x, y):
+        s = x * 0.0
+        while x.sum() > 0 and y.sum() > 0:
+            s = s + 1.0
+            x = x - 1.0
+            y = y - 1.0
+        return s
+
+    def ref(xv, yv):
+        s = xv * 0
+        while xv.sum() > 0 and yv.sum() > 0:
+            s = s + 1.0
+            xv = xv - 1.0
+            yv = yv - 1.0
+        return s
+
+    got = both(_t([3.0]), _t([1.0]))
+    np.testing.assert_allclose(
+        got.numpy(), ref(np.array([3.0], np.float32),
+                         np.array([1.0], np.float32)))
+    got = both(_t([1.0]), _t([3.0]))
+    np.testing.assert_allclose(
+        got.numpy(), ref(np.array([1.0], np.float32),
+                         np.array([3.0], np.float32)))
+    assert len(both._cache) == 1
+
+
+def test_dy2static_for_over_dict_and_value_boolop():
+    cfg = {"a": 1.0, "b": 2.0}
+
+    @jit.to_static
+    def dict_iter(x):
+        for k in cfg:           # mappings iterate keys, not positions
+            x = x + cfg[k]
+        y = x or 123.0          # value-context BoolOp: python semantics
+        return y + 0.0
+
+    got = dict_iter(_t([0.0]))
+    np.testing.assert_allclose(got.numpy(), [3.0])
+
+
+def test_dy2static_break_does_not_reevaluate_test():
+    data = [1.0, 2.0, 3.0]
+
+    @jit.to_static
+    def walk(x):
+        i = 0
+        while data[i] > 0:      # would IndexError if re-evaluated at i==3
+            x = x + data[i]
+            i = i + 1
+            if i == len(data):
+                break
+        return x
+
+    got = walk(_t([0.0]))
+    np.testing.assert_allclose(got.numpy(), [6.0])
