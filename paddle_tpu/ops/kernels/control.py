@@ -42,34 +42,85 @@ def _env_map(names, vals, op_type):
     return dict(zip(names, vals))
 
 
-@register_op("while", inputs=["Condition!", "X*!"], outputs=["Out*"],
-             grad=None, side_effect=True)
+def _while_grad(ins, attrs, ctx):
+    """WhileGradOp analog (while_op.cc:167).  Only the bounded form is
+    reverse-differentiable: with max_iters set the forward lowers to a
+    masked lax.scan, and this kernel is the auto-vjp of that scan (the
+    reference instead replays per-iteration scopes off a tape — a
+    host-side structure that has no XLA equivalent)."""
+    if int(attrs.get("max_iters", 0) or 0) <= 0:
+        raise ValueError(
+            "while is not reverse-differentiable without an iteration "
+            "bound: build it as While(cond, max_iters=N) / "
+            "layers.while_loop(..., max_iters=N) (lowered to a masked "
+            "lax.scan), or use StaticRNN for fixed-length recurrence")
+    from ..registry import _make_vjp_grad_kernel, get_op_info
+    return _make_vjp_grad_kernel(get_op_info("while"))(ins, attrs, ctx)
+
+
+@register_op("while", inputs=["Condition!", "X*"], outputs=["Out*"],
+             grad=_while_grad, side_effect=True)
 def while_op(ins, attrs, ctx):
     """while_op.cc:1 — run the sub-block until the condition var (updated
-    by the body) is false.  Lowered to jax.lax.while_loop over the dict of
-    loop-carried vars; not reverse-differentiable (train recurrences with
-    static_rnn instead, which scans)."""
+    by the body) is false.
+
+    Lowering: without a bound, jax.lax.while_loop over the dict of
+    loop-carried vars (not reverse-differentiable).  With attrs[max_iters]
+    set, a masked lax.scan of fixed length: every step computes the body,
+    `where(alive, new, old)` freezes the carry once the condition drops —
+    same results for any trip count <= max_iters, and reverse-mode
+    differentiable (grad: _while_grad), the TPU replacement for the
+    reference's scope-tape WhileGradOp."""
     tracer = _sub_tracer(ctx, attrs["sub_block"])
     x_names = attrs["x_names"]
     carry_names = attrs["carry_names"]
     cond_name = attrs["cond_name"]
     env0 = _env_map(x_names, ins["X"], "while")
-    env0[cond_name] = ins["Condition"]
-    missing = [n for n in carry_names if n not in env0 or env0[n] is None]
+    # carry inits may live under snapshot names (@PRELOOP, see
+    # append_while_op) when the loop is differentiable
+    carry_srcs = attrs.get("carry_srcs") or carry_names
+    cond_src = (carry_srcs[carry_names.index(cond_name)]
+                if cond_name in carry_names else cond_name)
+    env0.setdefault(cond_src, ins["Condition"])
+    missing = [s for s in carry_srcs if s not in env0 or env0[s] is None]
     if missing:
         raise ValueError(
             f"while: loop-carried vars {missing} have no value before the "
             "loop — assign them first (fluid requires this too)")
-    init = {n: env0[n] for n in carry_names}
-
-    def cond_f(carry):
-        return _scalar_bool(carry[cond_name])
+    init = {n: env0[s] for n, s in zip(carry_names, carry_srcs)}
+    max_iters = int(attrs.get("max_iters", 0) or 0)
 
     def body(carry):
         e = dict(env0)
         e.update(carry)
         tracer.run(e, ctx)
         return {n: e[n] for n in carry_names}
+
+    if max_iters > 0:
+        def step(carry, _):
+            alive = _scalar_bool(carry[cond_name])
+            # lax.cond, not where-masking: dead iterations must not
+            # execute the body at all — a body that is only valid while
+            # the condition holds (z / i with i hitting 0) would emit
+            # inf/NaN whose cotangent poisons every gradient even though
+            # the primal is masked (0 * inf = NaN in reverse mode)
+            return jax.lax.cond(alive, body, lambda c: c, carry), None
+
+        final, _ = jax.lax.scan(step, init, None, length=max_iters)
+        # truncation detector: if the condition is STILL true after
+        # max_iters, results differ from the unbounded semantics — say so
+        # at runtime instead of silently returning the truncated state
+        jax.lax.cond(
+            _scalar_bool(final[cond_name]),
+            lambda: jax.debug.print(
+                "WARNING: while(max_iters={m}) stopped with its condition "
+                "still true — the loop was truncated; raise max_iters",
+                m=max_iters),
+            lambda: None)
+        return {"Out": [final[n] for n in carry_names]}
+
+    def cond_f(carry):
+        return _scalar_bool(carry[cond_name])
 
     try:
         final = jax.lax.while_loop(cond_f, body, init)

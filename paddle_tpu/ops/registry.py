@@ -239,18 +239,23 @@ def _make_vjp_grad_kernel(fwd: OpInfo):
             outs = fwd.kernel(merged, attrs2, ctx)
             # cotangents only flow through floating outputs — integer
             # outputs (top_k Indices, argsort Indices) would need float0
-            # cotangents, so exclude them from the vjp entirely
+            # cotangents, so exclude them from the vjp.  Duplicable slots
+            # are filtered PER ELEMENT (a while op's Out list mixes float
+            # state with its bool condition — the float entries must still
+            # carry gradient), keyed by position so the cotangent
+            # assembly below can realign.
             flat = {}
             for slot in fwd.outputs:
                 o = outs.get(slot.name)
                 if o is None:
                     continue
                 if isinstance(o, (list, tuple)):
-                    if not all(_is_diff(x) for x in o):
-                        continue
-                elif not _is_diff(o):
-                    continue
-                flat[slot.name] = o
+                    sel = {str(i): x for i, x in enumerate(o)
+                           if _is_diff(x)}
+                    if sel:
+                        flat[slot.name] = sel
+                elif _is_diff(o):
+                    flat[slot.name] = o
             return flat
 
         diff_ins = {n: fwd_vals[n] for n in diff_names}
@@ -263,12 +268,14 @@ def _make_vjp_grad_kernel(fwd: OpInfo):
                 continue
             g = ins.get(slot.name + "@GRAD")
             ref = outs[slot.name]
-            if slot.duplicable:
-                gs = []
-                for i, r in enumerate(ref):
-                    gi = g[i] if g is not None and i < len(g) and g[i] is not None \
-                        else None
-                    gs.append(gi if gi is not None else jnp.zeros_like(r))
+            if isinstance(ref, dict):
+                # duplicable slot: float elements keyed by position
+                gs = {}
+                for k, r in ref.items():
+                    i = int(k)
+                    gi = (g[i] if g is not None and i < len(g)
+                          and g[i] is not None else None)
+                    gs[k] = gi if gi is not None else jnp.zeros_like(r)
                 cts[slot.name] = gs
             else:
                 cts[slot.name] = g if g is not None else jnp.zeros_like(ref)

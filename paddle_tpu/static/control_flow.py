@@ -36,7 +36,7 @@ from ..core.program import (Block, OpDesc, Program, VarDesc,
                             default_main_program, unique_name)
 from .layer_helper import LayerHelper
 
-__all__ = ["While", "cond", "case", "switch_case", "Switch", "StaticRNN",
+__all__ = ["While", "while_loop", "cond", "case", "switch_case", "Switch", "StaticRNN",
            "increment", "less_than", "array_write", "array_read",
            "array_length", "create_array"]
 
@@ -103,20 +103,85 @@ def _sub_block(program: Program):
 
 
 def append_while_op(parent: Block, sub: Block, cond_name: str,
-                    is_test: bool = False):
+                    is_test: bool = False, max_iters: int = 0):
     """Analyze a closed while sub-block and append the `while` op to the
-    parent (single producer of the op schema — While.block() and the
-    dy2static loop recorder both route here).  Returns (free, written)."""
+    parent (single producer of the op schema — While.block(), while_loop
+    and the dy2static loop recorder all route here).  max_iters > 0 makes
+    the loop reverse-differentiable (masked lax.scan lowering).  Returns
+    (free, written)."""
     free, written = _analyze_block(sub)
+    if cond_name not in written:
+        raise ValueError(
+            "While body never updates the loop condition "
+            f"{cond_name!r}; the loop would not terminate")
+    snap_of: Dict[str, str] = {}
+    if max_iters:
+        # The while op overwrites its carried vars IN PLACE (fluid
+        # semantics), so by backward time their pre-loop values are gone
+        # and the grad op's forward replay would start from the FINAL
+        # state (condition already false → zero iterations → zero grads).
+        # Snapshot each carried input through a differentiable assign;
+        # the while reads its carry inits from the snapshots, and
+        # assign_grad routes the init cotangent back to the real
+        # producer.  (The reference preserves per-iteration scopes
+        # instead — while_op.cc:167 WhileGradOp — a host-side tape with
+        # no XLA equivalent.)  Unused snapshots are DCE'd by XLA.
+        for c in written:
+            try:
+                v = parent.var(c)
+            except KeyError:
+                continue
+            snap = unique_name(c + "@PRELOOP")
+            parent.create_var(name=snap, shape=v.shape, dtype=v.dtype,
+                              stop_gradient=v.stop_gradient)
+            parent.append_op("assign", inputs={"X": [c]},
+                             outputs={"Out": [snap]}, attrs={})
+            snap_of[c] = snap
     x_names = list(dict.fromkeys(
-        [n for n in free if n != cond_name] + written))
+        [snap_of.get(n, n) for n in free if n != cond_name]
+        + [snap_of.get(n, n) for n in written]))
+    carry_srcs = [snap_of.get(n, n) for n in written]
     parent.append_op(
         "while",
-        inputs={"Condition": [cond_name], "X": x_names},
+        inputs={"Condition": [snap_of.get(cond_name, cond_name)],
+                "X": x_names},
         outputs={"Out": list(written)},
         attrs={"sub_block": sub.idx, "x_names": x_names,
-               "carry_names": list(written), "cond_name": cond_name,
-               "is_test": is_test})
+               "carry_names": list(written), "carry_srcs": carry_srcs,
+               "cond_name": cond_name,
+               "is_test": is_test, "max_iters": int(max_iters or 0)})
+    if max_iters and not is_test:
+        # differentiable (bounded) loop: loop vars are usually created by
+        # fill_constant, whose output carries stop_gradient=True — but the
+        # while WRITES them with values that depend on its inputs, so the
+        # float carried state must become gradient-bearing whenever any
+        # input requires grad, or append_backward's requires-grad sweep
+        # (backward.py _requires_grad_vars) never reaches past the loop.
+        # Only vars produced by constant INITIALIZER ops are flipped — a
+        # carried var the user computed and explicitly froze keeps its
+        # stop_gradient=True.
+        _init_ops = {"fill_constant", "fill_constant_batch_size_like",
+                     "fill_zeros_like", "fill_any_like", "assign_value",
+                     "zeros_like", "ones_like"}
+        init_produced = {n for op in parent.ops if op.type in _init_ops
+                         for n in op.output_names()}
+
+        def _requires(name):
+            try:
+                v = parent.var(name)
+            except KeyError:
+                return False
+            return (v.is_parameter and v.trainable) or not v.stop_gradient
+        if any(_requires(n) for n in x_names):
+            for n in written:
+                if n not in init_produced:
+                    continue
+                try:
+                    v = parent.var(n)
+                except KeyError:
+                    continue
+                if v.dtype in ("float32", "float64", "float16", "bfloat16"):
+                    v.stop_gradient = False
     return free, written
 
 
@@ -140,7 +205,8 @@ class While:
     recurrences with StaticRNN (lax.scan) instead.
     """
 
-    def __init__(self, cond: VarDesc, is_test: bool = False, name=None):
+    def __init__(self, cond: VarDesc, is_test: bool = False, name=None,
+                 max_iters: int = 0):
         if cond.dtype not in ("bool",):
             raise TypeError("While condition must be a bool variable, got "
                             f"{cond.dtype}")
@@ -151,21 +217,60 @@ class While:
         self.program = (cond.block.program if cond.block is not None
                         else default_main_program())
         self.is_test = is_test
+        self.max_iters = int(max_iters or 0)
 
     @contextlib.contextmanager
     def block(self):
         parent = self.program.current_block()
         with _sub_block(self.program) as sub:
             yield
-        cond_name = self.cond_var.name
-        _, written = _analyze_block(sub)
-        if cond_name not in written:
-            raise ValueError(
-                "While body never updates the loop condition "
-                f"{cond_name!r}; the loop would not terminate")
         # carried vars (written parent state incl. cond) need initial
-        # values, so they are inputs too
-        append_while_op(parent, sub, cond_name, self.is_test)
+        # values, so they are inputs too; append_while_op validates that
+        # the body updates the condition
+        append_while_op(parent, sub, self.cond_var.name, self.is_test,
+                        self.max_iters)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               max_iters: int = 0):
+    """Functional while (reference layers/control_flow.py while_loop):
+    `cond(*loop_vars) -> bool scalar var`, `body(*loop_vars) -> new vars`;
+    returns the final loop vars.
+
+        i, s = while_loop(lambda i, s: layers.less_than(i, n),
+                          lambda i, s: (layers.increment(i, in_place=False),
+                                        layers.elementwise_add(s, x)),
+                          [i0, s0], max_iters=16)
+
+    With max_iters > 0 the loop lowers to a masked lax.scan and is
+    reverse-differentiable — append_backward trains straight through it
+    (the reference's WhileGradOp capability, while_op.cc:167, rebuilt
+    without the per-iteration scope tape).
+    """
+    if not loop_vars:
+        raise ValueError("while_loop needs at least one loop var")
+    if not callable(cond) or not callable(body):
+        raise TypeError("while_loop cond and body must be callable")
+    from . import layers
+    init_cond = cond(*loop_vars)
+    if init_cond.dtype != "bool":
+        raise TypeError("while_loop cond must return a bool scalar var, "
+                        f"got {init_cond.dtype}")
+    w = While(init_cond, is_test=is_test, name=name, max_iters=max_iters)
+    with w.block():
+        new_vars = body(*loop_vars)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        if len(new_vars) != len(loop_vars):
+            raise ValueError(
+                f"while_loop body returned {len(new_vars)} vars, expected "
+                f"{len(loop_vars)}")
+        for new, old in zip(new_vars, loop_vars):
+            if new is not old:
+                layers.assign(new, output=old)
+        next_cond = cond(*loop_vars)
+        layers.assign(next_cond, output=init_cond)
+    return list(loop_vars)
 
 
 # ---------------------------------------------------------------------------

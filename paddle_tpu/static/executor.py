@@ -134,7 +134,17 @@ class BlockTracer:
         for slot in info.inputs:
             names = op.inputs.get(slot.name, [])
             if slot.duplicable:
-                ins[slot.name] = [env[n] for n in names if n and n in env]
+                if slot.name.endswith("@GRAD"):
+                    # cotangent lists must stay POSITION-ALIGNED with the
+                    # forward output slot — an absent grad ('' name, e.g.
+                    # a while's non-differentiable carried cond) is None,
+                    # not dropped, or every grad after it lands on the
+                    # wrong output
+                    ins[slot.name] = [env.get(n) if n else None
+                                      for n in names]
+                else:
+                    ins[slot.name] = [env[n] for n in names
+                                      if n and n in env]
             else:
                 n = names[0] if names else None
                 ins[slot.name] = env.get(n) if n else None

@@ -1604,12 +1604,12 @@ def cos_sim(X, Y, name=None):
 # control flow (fluid.layers.control_flow parity; see static/control_flow.py)
 # ---------------------------------------------------------------------------
 from .control_flow import (  # noqa: E402,F401
-    While, cond, case, switch_case, Switch, StaticRNN,
+    While, while_loop, cond, case, switch_case, Switch, StaticRNN,
     array_write, array_read, array_length, create_array)
 
 __all__ += ["dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
             "cos_sim",
-            "While", "cond", "case", "switch_case", "Switch", "StaticRNN",
+            "While", "while_loop", "cond", "case", "switch_case", "Switch", "StaticRNN",
             "array_write", "array_read", "array_length", "create_array",
             "gather_tree", "warpctc", "ctc_greedy_decoder",
             "linear_chain_crf", "crf_decoding", "multiclass_nms",
