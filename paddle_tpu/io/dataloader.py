@@ -1,23 +1,33 @@
 """DataLoader — host-side input pipeline.
 
 Reference: /root/reference/python/paddle/fluid/reader.py:147 DataLoader and
-/root/reference/python/paddle/fluid/dataloader/dataloader_iter.py (worker
-processes + blocking queue + ParentWatchDog).
+/root/reference/python/paddle/fluid/dataloader/dataloader_iter.py:436
+(_DataLoaderIterMultiProcess: worker processes + blocking queue +
+ParentWatchDog :106).
 
 TPU-native design notes:
   * The device feed is one host→device transfer of an already-collated,
     statically-shaped numpy batch per step — there is no per-op feed path to
     overlap with, so the pipeline's job is only to keep batches ready on the
-    host.  A multiprocessing pool (fork) prepares batches ahead of time and a
-    prefetch thread keeps a bounded queue full (the reference's
-    _reader_process_loop + LoDTensorBlockingQueue collapse into this).
+    host.  At TPU step rates a GIL-bound pipeline stalls the chip, so
+    `num_workers > 0` runs real worker PROCESSES (the reference's contract):
+    spawn-context (fork would deadlock the multithreaded jax runtime),
+    per-worker index queues, a shared result queue with order restoration,
+    and a ParentWatchDog so orphaned workers exit when the parent dies.
+  * Datasets/collate_fns that cannot pickle (closures, locks) fall back to
+    a thread pool with a warning — numpy/IO release the GIL, so overlap
+    still happens, just not for pure-python transforms.
   * Batches are numpy; in dygraph mode they are wrapped as eager Tensors.
 """
 from __future__ import annotations
 
 import itertools
+import os
+import pickle
 import queue
 import threading
+import time
+import warnings
 from typing import Callable, Optional
 
 import numpy as np
@@ -25,7 +35,7 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "ParentWatchDog"]
 
 
 def default_collate_fn(batch):
@@ -53,6 +63,167 @@ def _fetch_batch(args):
     # module-level so it pickles for the worker pool
     dataset, indices, collate = args
     return collate([dataset[i] for i in indices])
+
+
+# ---------------------------------------------------------------------------
+# multiprocess workers (dataloader_iter.py:436 _DataLoaderIterMultiProcess)
+# ---------------------------------------------------------------------------
+class ParentWatchDog:
+    """dataloader_iter.py:106 — a worker polls this and exits once its
+    parent process is gone (re-parented to init), so dead trainers never
+    leak worker processes."""
+
+    def __init__(self):
+        self._parent_pid = os.getppid()
+        self._alive = True
+
+    def is_alive(self) -> bool:
+        if self._alive:
+            self._alive = os.getppid() == self._parent_pid
+        return self._alive
+
+
+_WORKER_POLL_S = 1.0
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
+                 worker_id):
+    """Worker-process main (dataloader_iter.py _worker_loop analog):
+    receive (batch_idx, indices), emit (batch_idx, batch, error)."""
+    watchdog = ParentWatchDog()
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        while watchdog.is_alive():
+            try:
+                item = index_queue.get(timeout=_WORKER_POLL_S)
+            except queue.Empty:
+                continue
+            if item is None:  # shutdown sentinel
+                break
+            bidx, indices = item
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                data_queue.put((bidx, batch, None))
+            except Exception:
+                import traceback
+                data_queue.put((bidx, None, traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class _UnspawnableError(RuntimeError):
+    """Worker args failed to pickle for the spawn context — the caller
+    falls back to the thread pool."""
+
+
+class _MultiprocessIter:
+    """Order-preserving fan-out over spawn-context worker processes."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        self._nw = loader.num_workers
+        self._data_q = ctx.Queue()
+        self._index_qs = [ctx.Queue() for _ in range(self._nw)]
+        self._workers = []
+        self._closed = False
+        for wid in range(self._nw):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_qs[wid], self._data_q,
+                      loader.collate_fn, loader.worker_init_fn, wid),
+                daemon=True)
+            try:
+                p.start()
+            except (pickle.PicklingError, TypeError, AttributeError) as e:
+                # unpicklable dataset/collate/init: clean up any workers
+                # already started and let DataLoader fall back to threads
+                self.close()
+                raise _UnspawnableError(str(e)) from e
+            self._workers.append(p)
+        self._sampler_it = iter(loader.batch_sampler)
+        self._send_idx = 0
+        self._rcv_idx = 0
+        self._reorder = {}
+        self._timeout = float(loader.timeout or 0)
+        # keep 2 batches in flight per worker (reference's
+        # _outstanding_capacity)
+        for _ in range(2 * self._nw):
+            self._dispatch()
+
+    def _dispatch(self):
+        try:
+            indices = next(self._sampler_it)
+        except StopIteration:
+            return False
+        self._index_qs[self._send_idx % self._nw].put(
+            (self._send_idx, list(indices)))
+        self._send_idx += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._rcv_idx >= self._send_idx:
+            self.close()
+            raise StopIteration
+        waited = 0.0
+        while self._rcv_idx not in self._reorder:
+            try:
+                bidx, batch, err = self._data_q.get(timeout=_WORKER_POLL_S)
+            except queue.Empty:
+                waited += _WORKER_POLL_S
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead:
+                    self.close()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) "
+                        f"{[w.pid for w in dead]} exited unexpectedly")
+                if self._timeout and waited >= self._timeout:
+                    self.close()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s "
+                        "waiting for a batch")
+                continue
+            if err is not None:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader worker raised:\n{err}")
+            self._reorder[bidx] = batch
+        batch = self._reorder.pop(self._rcv_idx)
+        self._rcv_idx += 1
+        self._dispatch()
+        return batch
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._index_qs:
+            try:
+                q.put_nowait(None)
+            except Exception:
+                pass
+        deadline = time.time() + 2.0
+        for w in self._workers:
+            w.join(timeout=max(0.0, deadline - time.time()))
+        for w in self._workers:
+            if w.is_alive():
+                w.terminate()
+        for q in self._index_qs + [self._data_q]:
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _PrefetchIterator:
@@ -144,6 +315,7 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
+        self._spawn_ok = None
         self.use_buffer_reader = use_buffer_reader
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
@@ -206,10 +378,9 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def _iter_map_workers(self):
-        # Thread pool, not fork: the jax runtime is multithreaded and fork
-        # deadlocks; numpy/IO release the GIL so host-side batch prep still
-        # overlaps.  (The reference forks worker *processes* because its
-        # transforms are GIL-bound Python — dataloader_iter.py.)
+        # Thread-pool FALLBACK for unpicklable datasets: numpy/IO release
+        # the GIL so host-side batch prep still overlaps, but pure-python
+        # transforms serialize.  The primary path is _MultiprocessIter.
         from multiprocessing.dummy import Pool
         init = None
         if self.worker_init_fn is not None:
@@ -235,7 +406,22 @@ class DataLoader:
         if self._iterable_mode:
             it = self._iter_iterable()
         elif self.num_workers > 0:
-            it = self._iter_map_workers()
+            it = None
+            if self._spawn_ok is not False:
+                # attempt worker processes directly — spawn pickles the
+                # args itself, so no separate (full-dataset!) pickle probe
+                try:
+                    it = _MultiprocessIter(self)
+                    self._spawn_ok = True
+                except _UnspawnableError as e:
+                    warnings.warn(
+                        "DataLoader(num_workers>0): dataset/collate_fn/"
+                        f"worker_init_fn not picklable ({e}); falling "
+                        "back to a thread pool — python-level transforms "
+                        "will be GIL-bound", RuntimeWarning)
+                    self._spawn_ok = False
+            if it is None:
+                it = self._iter_map_workers()
         else:
             it = self._iter_map_sync()
         if not self.use_buffer_reader:

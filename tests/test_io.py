@@ -281,17 +281,38 @@ def test_combined_file_roundtrip_any_name(tmp_path):
 
 def test_dataloader_early_break_no_thread_leak():
     import threading
+    import warnings as _w
     ds = RangeDataset(64)
     before = threading.active_count()
-    for _ in range(5):
-        for i, batch in enumerate(pio.DataLoader(ds, batch_size=2,
-                                                 num_workers=2)):
-            if i == 1:
-                break
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        for _ in range(5):
+            # lambda collate forces the thread-pool path — this test
+            # covers thread cleanup; process cleanup is covered below
+            for i, batch in enumerate(pio.DataLoader(
+                    ds, batch_size=2, num_workers=2,
+                    collate_fn=lambda b: pio.default_collate_fn(b))):
+                if i == 1:
+                    break
     import gc, time
     gc.collect()
     time.sleep(0.3)
     assert threading.active_count() <= before + 2
+
+
+def test_dataloader_early_break_terminates_worker_processes():
+    import multiprocessing as mp
+    import gc
+    import time
+    for i, batch in enumerate(pio.DataLoader(PidDataset(64), batch_size=2,
+                                             num_workers=2)):
+        if i == 1:
+            break
+    gc.collect()
+    deadline = time.time() + 10
+    while mp.active_children() and time.time() < deadline:
+        time.sleep(0.2)
+    assert not mp.active_children()
 
 
 def test_random_sampler_short_generator():
@@ -306,3 +327,66 @@ def test_batch_sampler_validation():
         pio.BatchSampler(ds, batch_size=0)
     with pytest.raises(ValueError):
         pio.DistributedBatchSampler(ds, batch_size=0, num_replicas=2, rank=0)
+
+
+# ---------------------------------------------------------------------------
+# multiprocess DataLoader workers
+# (reference dataloader_iter.py:436 _DataLoaderIterMultiProcess)
+# ---------------------------------------------------------------------------
+class PidDataset(pio.Dataset):
+    """Samples carry the producing pid so tests can prove process
+    isolation (module-level: spawn workers unpickle it by import)."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.asarray(i, np.int64), np.asarray(os.getpid(), np.int64))
+
+
+class FailingDataset(pio.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.asarray(i, np.int64)
+
+
+def _worker_seed_init(worker_id):
+    # runs inside the worker process
+    os.environ["PTPU_TEST_WORKER_ID"] = str(worker_id)
+
+
+def test_dataloader_workers_are_processes_and_ordered():
+    dl = pio.DataLoader(PidDataset(24), batch_size=4, num_workers=2,
+                        shuffle=False)
+    order, pids = [], set()
+    for batch in dl:
+        order.extend(np.asarray(batch[0]).tolist())
+        pids.update(np.asarray(batch[1]).tolist())
+    assert order == list(range(24))          # order restored across workers
+    assert os.getpid() not in pids           # NOT the parent process
+    assert len(pids) == 2                    # one pid per worker
+
+
+def test_dataloader_worker_exception_propagates():
+    dl = pio.DataLoader(FailingDataset(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(dl)
+
+
+def test_dataloader_unpicklable_falls_back_to_threads():
+    import warnings as _w
+    ds = RangeDataset(8)
+    dl = pio.DataLoader(ds, batch_size=2, num_workers=2,
+                        collate_fn=lambda b: b)  # lambda: unpicklable
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        batches = list(dl)
+    assert len(batches) == 4
+    assert any("thread pool" in str(r.message) for r in rec)
