@@ -741,6 +741,29 @@ def _lambda0(body_expr):
     return ast.Lambda(args=_no_args(), body=body_expr)
 
 
+def _contains_return(stmts) -> bool:
+    """Return anywhere in the subtree (nested functions excluded) — a
+    loop containing one cannot be hoisted into a closure."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, n):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
 class _BCFinder(ast.NodeVisitor):
     """Break/Continue bound to the CURRENT loop level (nested loops own
     theirs)."""
@@ -850,16 +873,23 @@ class _IfTransformer(ast.NodeTransformer):
         self.loop_count = 0
 
     # -- loops (loop_transformer.py:367 LoopTransformer analog) -----------
+    def _leave_untransformed(self, node):
+        """A loop the transform can't hoist (return inside, else-clause)
+        stays a plain python loop — trace-time unrolling, exactly the
+        pre-transform behaviour — so the REST of the function (tensor-ifs,
+        other loops) still converts instead of the whole transform
+        aborting to the tracing fallback."""
+        self.generic_visit(node)
+        return node
+
     def visit_While(self, node):
-        if node.orelse:
-            raise Dy2StaticError("while-else is not supported by the "
-                                 "dy2static loop transform")
+        if node.orelse or _contains_return(node.body):
+            return self._leave_untransformed(node)
         return self._transform_loop(node.test, node.body, [])
 
     def visit_For(self, node):
-        if node.orelse:
-            raise Dy2StaticError("for-else is not supported by the "
-                                 "dy2static loop transform")
+        if node.orelse or _contains_return(node.body):
+            return self._leave_untransformed(node)
         i = self.loop_count
         self.loop_count += 1
         it_n, n_n, idx_n = (f"_ptpu_it_{i}", f"_ptpu_n_{i}",
@@ -1013,9 +1043,11 @@ class _IfTransformer(ast.NodeTransformer):
         node.test = self._rewrite_cond_boolops(node.test)
         self.generic_visit(node)
         if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
-            raise Dy2StaticError(
-                "return/break/continue inside a branch is not supported "
-                "by the dy2static if-transform")
+            # return (or unrewritten break/continue) in a branch can't be
+            # hoisted into a closure — leave THIS if untransformed (plain
+            # python: trace-time branch resolution, the pre-transform
+            # behaviour) so the rest of the function still converts
+            return node
         outs = _assigned_names(node.body + node.orelse)
         i = self.count
         self.count += 1

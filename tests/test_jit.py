@@ -683,3 +683,25 @@ def test_dy2static_break_does_not_reevaluate_test():
 
     got = walk(_t([0.0]))
     np.testing.assert_allclose(got.numpy(), [6.0])
+
+
+def test_dy2static_return_loop_keeps_if_conversion():
+    # a python loop containing `return` stays untransformed, but the
+    # tensor-if elsewhere in the SAME function must still convert —
+    # cache reuse with the opposite branch has to be correct
+    @jit.to_static
+    def f(x):
+        for v in [1.0, 2.0]:
+            if v > 5.0:
+                return x
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    got = f(_t([3.0]))
+    np.testing.assert_allclose(got.numpy(), [6.0])
+    got = f(_t([-3.0]))   # cached program, other branch
+    np.testing.assert_allclose(got.numpy(), [-4.0])
+    assert len(f._cache) == 1
