@@ -162,6 +162,9 @@ class VarDesc:
         # tensor-parallel sharding annotation (tensor_parallel.shard_param)
         if self.attrs.get("dist_attr"):
             d["dist_attr"] = list(self.attrs["dist_attr"])
+        # optimizer accumulator → param link (_add_accumulator)
+        if self.attrs.get("accum_of"):
+            d["accum_of"] = self.attrs["accum_of"]
         return d
 
     @staticmethod
@@ -174,6 +177,8 @@ class VarDesc:
             v.attrs["var_type"] = d["var_type"]
         if d.get("dist_attr"):
             v.attrs["dist_attr"] = list(d["dist_attr"])
+        if d.get("accum_of"):
+            v.attrs["accum_of"] = d["accum_of"]
         return v
 
 

@@ -53,7 +53,20 @@ def _set_attr(msg: "pb.Attribute", value: Any) -> None:
         else:
             msg.json = json.dumps(vals).encode()
     else:
-        msg.json = json.dumps(value, default=str).encode()
+        msg.json = json.dumps(value, default=_np_scalar_item).encode()
+
+
+def _np_scalar_item(value):
+    """json fallback encoder: numpy scalars round-trip as their python
+    number; anything else raises LOUDLY (the old default=str silently
+    stringified values, so numbers reloaded as strings — diverging from
+    the JSON wire format which raises for the same case)."""
+    import numpy as _np
+    if isinstance(value, _np.generic):
+        return value.item()
+    raise TypeError(
+        f"attr value of type {type(value).__name__!r} is not "
+        "proto-serializable")
 
 
 def _get_attr(msg: "pb.Attribute") -> Any:
@@ -101,6 +114,8 @@ def program_to_proto(program) -> "pb.ProgramDesc":
             if da:
                 vd.shard_axis = str(da[0])
                 vd.shard_dim = int(da[1])
+            if var.attrs.get("accum_of"):
+                vd.accum_of = str(var.attrs["accum_of"])
         for op in block.ops:
             od = b.ops.add(type=op.type)
             for slot, names in op.inputs.items():
@@ -135,6 +150,8 @@ def _proto_to_dict(proto: "pb.ProgramDesc") -> dict:
                 v["var_type"] = pb.VarDesc.VarType.Name(vd.type)
             if vd.shard_axis:
                 v["dist_attr"] = [vd.shard_axis, vd.shard_dim]
+            if vd.accum_of:
+                v["accum_of"] = vd.accum_of
             vars_.append(v)
         ops = [{"type": od.type,
                 "inputs": {s: list(nl.names)

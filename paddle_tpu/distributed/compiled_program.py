@@ -404,8 +404,19 @@ class CompiledProgram:
                 if n in annotated:
                     continue
                 try:
-                    shape = tuple(block.var(n).shape or ())
+                    v = block.var(n)
                 except KeyError:
+                    continue
+                shape = tuple(v.shape or ())
+                # explicit accumulator→param link (set by
+                # Optimizer._add_accumulator) — the old name-prefix+shape
+                # heuristic could match an unrelated var whose name
+                # happened to extend an annotated param's
+                owner = v.attrs.get("accum_of")
+                if owner is not None:
+                    hit = annotated.get(owner)
+                    if hit is not None and shape == hit[0]:
+                        state_specs[n] = hit[1]
                     continue
                 for pname, (pshape, pspec) in annotated.items():
                     if n.startswith(pname + "_") and shape == pshape:

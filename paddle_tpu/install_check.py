@@ -33,7 +33,11 @@ def run_check():
     from . import static
 
     rng = np.random.RandomState(0)
-    xb = rng.rand(8, 4).astype(np.float32)
+    # batch sized as a multiple of the device count so the data-parallel
+    # check shards evenly on ANY host (6 visible chips must not fail the
+    # install check with a sharding error)
+    batch = 2 * max(1, len(jax.devices()))
+    xb = rng.rand(batch, 4).astype(np.float32)
     yb = xb.sum(1, keepdims=True).astype(np.float32)
 
     main, startup, loss = _build()

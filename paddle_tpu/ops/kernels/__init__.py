@@ -25,4 +25,5 @@ from . import (  # noqa: F401
     ctc_crf,
     decode,
     distributed_ops,
+    sampled_loss,
 )

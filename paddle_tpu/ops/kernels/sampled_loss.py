@@ -1,0 +1,203 @@
+"""Large-vocab sampled-loss family: nce / hierarchical_sigmoid /
+sample_logits.
+
+Reference: /root/reference/paddle/fluid/operators/nce_op.cc:316 +
+nce_op.h:84 (sampled sigmoid with NCE correction),
+hierarchical_sigmoid_op.cc:60 + hierarchical_sigmoid_op.h:70 (binary-tree
+logistic path loss over math/matrix_bit_code.h SimpleCode),
+sample_logits_op.cc (per-row class subsampling feeding
+softmax_with_cross_entropy).
+
+TPU-native design: each op is ONE traceable jax function — sampling uses
+the per-op folded rng key (ctx.key), so the auto-vjp grad replay draws
+the SAME negatives as the forward (the reference instead materializes
+SampleLabels and threads it to the grad kernel).  The batched
+gather+einsum over sampled rows maps onto the MXU as a tall-skinny
+matmul; nothing touches the full [B, V] logits except sample_logits,
+whose contract (reference parity) takes precomputed logits.
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _as_2d_labels(label):
+    lab = label.astype(jnp.int32)
+    if lab.ndim == 1:
+        lab = lab[:, None]
+    return lab
+
+
+def _log_uniform_sample(key, shape, vocab):
+    """Zipfian sampler (reference math/sampler.cc LogUniformSampler):
+    P(k) = log((k+2)/(k+1)) / log(V+1); inverse-CDF draw."""
+    u = jax.random.uniform(key, shape)
+    s = jnp.exp(u * _pymath.log(vocab + 1.0)) - 1.0
+    return jnp.clip(s.astype(jnp.int32), 0, vocab - 1)
+
+
+def _log_uniform_prob(k, vocab):
+    kf = k.astype(jnp.float32)
+    return jnp.log((kf + 2.0) / (kf + 1.0)) / _pymath.log(vocab + 1.0)
+
+
+@register_op("nce",
+             inputs=["Input", "Label!", "Weight", "Bias?",
+                     "SampleWeight?!", "CustomDistProbs?!",
+                     "CustomDistAlias?!", "CustomDistAliasProbs?!"],
+             outputs=["Cost", "SampleLogits", "SampleLabels!"])
+def nce(ins, attrs, ctx):
+    """nce_op.h:84 — per (row, sampled class): o = sigmoid(x·w_c + b_c),
+    b = P(c)·S; cost = -log(o/(o+b)) for true classes,
+    -log(b/(o+b)) for negatives; Cost[i] sums the row."""
+    x = ins["Input"]                       # [B, D]
+    labels = _as_2d_labels(ins["Label"])   # [B, T]
+    w = ins["Weight"]                      # [V, D]
+    bias = ins.get("Bias")
+    vocab = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10) or 10)
+    sampler = int(attrs.get("sampler", 0) or 0)
+    bsz, num_true = labels.shape
+
+    key = ctx.key(attrs)
+    if sampler == 1:  # log_uniform
+        negs = _log_uniform_sample(key, (bsz, num_neg), vocab)
+    elif sampler == 2 and ins.get("CustomDistProbs") is not None:
+        probs = ins["CustomDistProbs"].astype(jnp.float32)
+        negs = jax.random.categorical(
+            key, jnp.log(jnp.maximum(probs, 1e-20))[None, :],
+            shape=(bsz, num_neg)).astype(jnp.int32)
+    else:  # uniform
+        negs = jax.random.randint(key, (bsz, num_neg), 0, vocab,
+                                  dtype=jnp.int32)
+    sample_labels = jnp.concatenate([labels, negs], axis=1)  # [B, T+S]
+
+    w_rows = jnp.take(w, sample_labels, axis=0)              # [B, T+S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_rows)
+    if bias is not None:
+        logits = logits + jnp.take(
+            bias.reshape(-1), sample_labels, axis=0)
+    o = jax.nn.sigmoid(logits)
+
+    if sampler == 1:
+        p = _log_uniform_prob(sample_labels, vocab)
+    elif sampler == 2 and ins.get("CustomDistProbs") is not None:
+        p = jnp.take(ins["CustomDistProbs"].astype(jnp.float32),
+                     sample_labels, axis=0)
+    else:
+        p = jnp.full(sample_labels.shape, 1.0 / vocab, jnp.float32)
+    b = (p * num_neg).astype(o.dtype)
+
+    eps = jnp.asarray(1e-12, o.dtype)
+    cost_true = -jnp.log(o / (o + b) + eps)
+    cost_neg = -jnp.log(b / (o + b) + eps)
+    is_true = jnp.arange(sample_labels.shape[1]) < num_true
+    cost = jnp.where(is_true[None, :], cost_true, cost_neg)
+    total = jnp.sum(cost, axis=1, keepdims=True)             # [B, 1]
+    sw = ins.get("SampleWeight")
+    if sw is not None:
+        total = total * sw.reshape(bsz, 1).astype(total.dtype)
+    return {"Cost": total, "SampleLogits": o,
+            "SampleLabels": sample_labels.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid",
+             inputs=["X", "W", "Label!", "PathTable?!", "PathCode?!",
+                     "Bias?"],
+             outputs=["Out", "PreOut"])
+def hierarchical_sigmoid(ins, attrs, ctx):
+    """hierarchical_sigmoid_op.h:70 — logistic loss over each label's
+    root-to-leaf path in a complete binary tree (SimpleCode,
+    matrix_bit_code.h:106: code = label + num_classes, weight row j =
+    (code >> (j+1)) - 1, branch bit j = (code >> j) & 1), or over an
+    explicit PathTable/PathCode (CustomCode).  Out-of-path positions keep
+    pre_out = 0 and contribute log(2) exactly like the reference (the
+    kernel's documented TODO — kept for numerical parity)."""
+    x = ins["X"]                           # [B, D]
+    w = ins["W"]                           # [num_nodes, D]
+    label = ins["Label"].reshape(-1).astype(jnp.int32)   # [B]
+    bias = ins.get("Bias")
+    path_table = ins.get("PathTable")
+    if path_table is not None:
+        idx = path_table.astype(jnp.int32)               # [B, L]
+        bits = ins["PathCode"].astype(x.dtype)           # [B, L]
+        valid = idx >= 0
+        idx_safe = jnp.where(valid, idx, 0)
+    else:
+        num_classes = int(attrs["num_classes"])
+        code_len = (num_classes - 1).bit_length()  # FindLastSet(V-1)
+        c = label + num_classes                    # [B]
+        j = jnp.arange(code_len)                   # [L]
+        idx = (c[:, None] >> (j[None, :] + 1)) - 1
+        valid = (c[:, None] >> (j[None, :] + 1)) > 0
+        bits = ((c[:, None] >> j[None, :]) & 1).astype(x.dtype)
+        idx_safe = jnp.where(valid, idx, 0)
+
+    w_rows = jnp.take(w, idx_safe, axis=0)               # [B, L, D]
+    pre = jnp.einsum("bd,bld->bl", x, w_rows)
+    if bias is not None:
+        pre = pre + jnp.take(bias.reshape(-1), idx_safe, axis=0)
+    pre = jnp.clip(pre, -40.0, 40.0)
+    pre = jnp.where(valid, pre, jnp.zeros_like(pre))     # PreOut
+    # Σ_j log(1 + e^p) - Σ_{bit_j=1} p  (softrelu CE, reference :118-124)
+    loss = jnp.sum(jnp.log1p(jnp.exp(pre)), axis=1, keepdims=True) \
+        - jnp.sum(jnp.where(valid, bits * pre, jnp.zeros_like(pre)),
+                  axis=1, keepdims=True)
+    return {"Out": loss, "PreOut": pre}
+
+
+@register_op("sample_logits",
+             inputs=["Logits", "Labels!", "CustomizedSamples?!",
+                     "CustomizedProbabilities?!"],
+             outputs=["Samples!", "Probabilities!", "SampledLogits",
+                      "SampledLabels!"])
+def sample_logits(ins, attrs, ctx):
+    """sample_logits_op.cc — subsample num_samples classes per row
+    (log-uniform), gather their logits, subtract log Q(class) (sampled
+    softmax correction), and remap labels to their position in the
+    sampled set.  Feeds softmax_with_cross_entropy for the full
+    sampled-softmax loss."""
+    logits = ins["Logits"]                 # [B, V]
+    labels = _as_2d_labels(ins["Labels"])  # [B, T]
+    vocab = logits.shape[1]
+    num_samples = int(attrs.get("num_samples", 100) or 100)
+    use_custom = ins.get("CustomizedSamples") is not None
+    bsz, num_true = labels.shape
+
+    if use_custom:
+        samples = ins["CustomizedSamples"].astype(jnp.int32)
+        probs = ins["CustomizedProbabilities"].astype(logits.dtype)
+    else:
+        key = ctx.key(attrs)
+        negs = _log_uniform_sample(key, (bsz, num_samples), vocab)
+        samples = jnp.concatenate([labels, negs], axis=1)   # [B, T+S]
+        probs = _log_uniform_prob(samples, vocab).astype(logits.dtype)
+
+    # NOTE divergence from the reference: negatives are drawn WITH
+    # replacement (the reference's uniq=True dedups per row); duplicate
+    # columns slightly over-weight their class in the softmax
+    # denominator.  Static shapes rule out per-row unique sets; callers
+    # needing exact uniq semantics pass CustomizedSamples.
+    sampled_logits = jnp.take_along_axis(logits, samples, axis=1)
+    if attrs.get("remove_accidental_hits", True):
+        # negatives that equal a true label get -1e20 so softmax ignores
+        hit = (samples[:, :, None] ==
+               labels[:, None, :]).any(-1)
+        is_true_col = jnp.arange(samples.shape[1]) < num_true
+        kill = hit & ~is_true_col[None, :]
+        sampled_logits = jnp.where(kill,
+                                   jnp.asarray(-1e20, sampled_logits.dtype),
+                                   sampled_logits)
+    # sampled-softmax correction: subtract log Q
+    sampled_logits = sampled_logits - jnp.log(
+        jnp.maximum(probs, jnp.asarray(1e-20, probs.dtype)))
+    sampled_labels = jnp.tile(jnp.arange(num_true, dtype=jnp.int64),
+                              (bsz, 1))
+    return {"Samples": samples.astype(jnp.int64), "Probabilities": probs,
+            "SampledLogits": sampled_logits,
+            "SampledLabels": sampled_labels}

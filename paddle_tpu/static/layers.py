@@ -45,7 +45,8 @@ __all__ = [
     "logical_or", "logical_not", "where", "arange", "linspace", "create_tensor",
     "create_global_var", "unstack", "_binary_op", "sequence_mask", "cumsum",
     "maxout", "lrn", "resize_bilinear", "resize_nearest", "roi_align", "nce",
-    "row_conv", "beam_search", "batch_norm_stats",
+    "hsigmoid", "sampled_softmax_with_cross_entropy",
+    "row_conv", "beam_search",
 ]
 
 
@@ -1270,11 +1271,120 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
     return helper.append_activation(out, act)
 
 
+_NCE_SAMPLERS = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}
+
+
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
-        bias_attr=None, num_neg_samples=None, name=None, **kw):
-    # negative sampling loss reduces to sampled softmax on TPU; provide the
-    # API, implement via sampled dense matmul
-    raise NotImplementedError("nce: use sampled_softmax on TPU")
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """fluid.layers.nce (nce_op.cc:316): noise-contrastive estimation loss
+    over sampled negatives.  is_sparse is accepted for parity (gradients
+    here are dense gathers — XLA scatters are already sparse-shaped)."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    num_neg_samples = int(num_neg_samples or 10)
+    if sampler not in _NCE_SAMPLERS:
+        raise ValueError(f"nce sampler must be one of {set(_NCE_SAMPLERS)}")
+    w = helper.create_parameter(param_attr, [num_total_classes, dim],
+                                input.dtype)
+    inputs = {"Input": input, "Label": label, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_total_classes, 1],
+                                    input.dtype, is_bias=True)
+        inputs["Bias"] = b
+    if sample_weight is not None:
+        inputs["SampleWeight"] = sample_weight
+    if sampler == "custom_dist":
+        if custom_dist is None:
+            raise ValueError("nce(sampler='custom_dist') needs custom_dist")
+        import numpy as _np
+        probs_var = assign(_np.asarray(custom_dist, _np.float32))
+        inputs["CustomDistProbs"] = probs_var
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits_v = helper.create_variable_for_type_inference(input.dtype)
+    sample_labels_v = helper.create_variable_for_type_inference("int64",
+                                                                True)
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": cost, "SampleLogits": sample_logits_v,
+                 "SampleLabels": sample_labels_v},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples,
+               "sampler": _NCE_SAMPLERS[sampler], "seed": seed,
+               "is_sparse": is_sparse})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """fluid.layers.hsigmoid (hierarchical_sigmoid_op.cc:60): logistic
+    loss over the label's root-to-leaf path of a complete binary tree
+    (or a custom PathTable/PathCode tree)."""
+    helper = LayerHelper("hsigmoid", name=name)
+    dim = input.shape[-1]
+    if is_custom and (path_table is None or path_code is None):
+        raise ValueError("hsigmoid(is_custom=True) needs path_table and "
+                         "path_code")
+    if not is_custom and (num_classes is None or num_classes < 2):
+        raise ValueError("hsigmoid needs num_classes >= 2")
+    # custom trees index rows by the table's node ids; default trees use
+    # the num_classes-1 internal nodes
+    rows = num_classes if is_custom else num_classes - 1
+    w = helper.create_parameter(param_attr, [rows, dim], input.dtype)
+    inputs = {"X": input, "W": w, "Label": label}
+    if is_custom:
+        inputs["PathTable"] = path_table
+        inputs["PathCode"] = path_code
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [rows, 1], input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": out, "PreOut": pre_out},
+        attrs={"num_classes": num_classes, "is_sparse": is_sparse})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """fluid.layers.sampled_softmax_with_cross_entropy
+    (sample_logits_op.cc): softmax CE over the true classes plus
+    num_samples log-uniform negatives, with log-Q correction."""
+    helper = LayerHelper("sampled_softmax_with_cross_entropy")
+    samples = helper.create_variable_for_type_inference("int64", True)
+    probabilities = helper.create_variable_for_type_inference(logits.dtype,
+                                                              True)
+    sampled_logits_v = helper.create_variable_for_type_inference(
+        logits.dtype)
+    sampled_labels_v = helper.create_variable_for_type_inference("int64",
+                                                                 True)
+    inputs = {"Logits": logits, "Labels": label}
+    if use_customized_samples:
+        if customized_samples is None or customized_probabilities is None:
+            raise ValueError(
+                "sampled_softmax_with_cross_entropy("
+                "use_customized_samples=True) needs customized_samples "
+                "AND customized_probabilities")
+        inputs["CustomizedSamples"] = customized_samples
+        inputs["CustomizedProbabilities"] = customized_probabilities
+    helper.append_op(
+        "sample_logits", inputs=inputs,
+        outputs={"Samples": samples, "Probabilities": probabilities,
+                 "SampledLogits": sampled_logits_v,
+                 "SampledLabels": sampled_labels_v},
+        attrs={"num_samples": num_samples,
+               "remove_accidental_hits": remove_accidental_hits,
+               "seed": seed})
+    return softmax_with_cross_entropy(sampled_logits_v, sampled_labels_v)
 
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
@@ -1297,10 +1407,6 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     if return_parent_idx:
         return selected_ids, selected_scores, parent_idx
     return selected_ids, selected_scores
-
-
-def batch_norm_stats(*a, **kw):
-    raise NotImplementedError
 
 
 def gather_tree(ids, parents):

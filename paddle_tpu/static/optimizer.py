@@ -179,6 +179,9 @@ class Optimizer:
             name=unique_name(f"{param.name}_{name}"),
             shape=shape or param.shape,
             dtype=dtype or "float32", persistable=True, stop_gradient=True)
+        # explicit accumulator→param link so sharding inheritance
+        # (compiled_program state_specs) never guesses from name prefixes
+        v.attrs["accum_of"] = param.name
         Constant(fill_value)(v, helper.startup_program.global_block())
         acc[param.name] = v
         return v

@@ -131,3 +131,26 @@ def test_op_version_registry_rules():
     import pytest
     with pytest.raises(ValueError):
         reg.register("myop", 3)
+
+
+def test_accumulator_link_survives_binary_roundtrip():
+    # accum_of (optimizer accumulator -> param) feeds sharding inheritance
+    # in CompiledProgram; it must survive serialization or the name-prefix
+    # heuristic silently comes back
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        loss = layers.mean(layers.square(layers.fc(x, 1) - y))
+        static.Adam(1e-3).minimize(loss)
+    links = {v.name: v.attrs["accum_of"]
+             for b in main.blocks for v in b.vars.values()
+             if v.attrs.get("accum_of")}
+    assert links, "Adam must register accumulator links"
+    m2 = static.Program.parse_from_string(main.serialize_to_string())
+    links2 = {v.name: v.attrs.get("accum_of")
+              for b in m2.blocks for v in b.vars.values()
+              if v.attrs.get("accum_of")}
+    assert links2 == links
