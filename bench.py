@@ -59,29 +59,57 @@ def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
     return main, startup, loss
 
 
-def main():
-    # allow CPU fallback benchmarking when no TPU is reachable
-    if os.environ.get("BENCH_FORCE_CPU"):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        # the axon TPU tunnel can hang indefinitely when the remote end is
-        # down — and the hang sits inside a C call, so an in-process alarm
-        # can't interrupt it.  Probe device discovery in a SUBPROCESS with
-        # a hard timeout and fall back to a CPU run instead of hanging.
-        import subprocess
-        probe_s = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180"))
+_FALLBACK_NOTE = ""
+
+
+def _probe_tpu():
+    """Device discovery over the axon tunnel can hang inside a C call, so
+    probe in SUBPROCESSES with hard timeouts.  A CPU fallback is a FAILED
+    perf run (VERDICT r2: the probe must retry, not silently fall back) —
+    retry with backoff for a total budget >= 10 min before giving up, and
+    carry the reason into the emitted JSON."""
+    import subprocess
+    retries = int(os.environ.get("BENCH_TPU_PROBE_RETRIES", "5"))
+    probe_s = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+    last = "unknown"
+    for attempt in range(1, retries + 1):
         try:
             subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=probe_s, check=True, capture_output=True)
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+            return True, ""
+        except subprocess.TimeoutExpired:
+            last = f"device discovery timed out ({probe_s}s)"
+        except subprocess.CalledProcessError as e:
+            tail = (e.stderr or b"")[-200:].decode("utf-8", "replace")
+            last = f"device discovery failed: {tail!r}"
+        sys.stderr.write(
+            f"bench: TPU probe attempt {attempt}/{retries} failed "
+            f"({last})\n")
+        if attempt < retries:
+            time.sleep(min(30 * attempt, 120))
+    return False, last
+
+
+def main():
+    global _FALLBACK_NOTE
+    # allow CPU fallback benchmarking only when explicitly requested or
+    # after the full retry budget is exhausted
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        ok, reason = _probe_tpu()
+        if not ok:
             os.environ["BENCH_FORCE_CPU"] = "1"
             os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["BENCH_FALLBACK_NOTE"] = (
+                f"TPU unreachable after retries: {reason}")
             sys.stderr.write(
-                "bench: TPU backend unreachable (device discovery timed "
-                "out); re-running on CPU\n")
+                "bench: TPU unreachable after full retry budget; "
+                "re-running on CPU (recorded as a FAILED perf run)\n")
             os.execv(sys.executable, [sys.executable, __file__])
+    _FALLBACK_NOTE = os.environ.get("BENCH_FALLBACK_NOTE", "")
     import jax
     import paddle_tpu.static as static
     from paddle_tpu.ops.attention import enable_flash_attention
@@ -121,33 +149,47 @@ def main():
     with static.scope_guard(scope):
         exe.run(startup_p)
         feed = batch_feed()
-        # warmup/compile
+        # warmup/compile BOTH step signatures (fetch + no-fetch differ in
+        # cache key; compiling inside the timed loop would poison dt)
         exe.run(main_p, feed=feed, fetch_list=[loss])
-        n_steps = 10 if on_tpu else 3
+        exe.run(main_p, feed=feed, fetch_list=[])
+        n_steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 10))
         t0 = time.time()
-        for _ in range(n_steps):
-            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+        # steps WITHOUT per-step fetches: state buffers are donated and
+        # stay on device, dispatch runs ahead of the chip; only the last
+        # step fetches the loss (forces completion of the whole chain)
+        for _ in range(n_steps - 1):
+            exe.run(main_p, feed=feed, fetch_list=[])
+        out = exe.run(main_p, feed=feed, fetch_list=[loss])
         np.asarray(out[0])
         dt = time.time() - t0
 
     tokens_per_sec = n_steps * batch * seq / dt
 
-    # param count for MFU
+    # MFU accounting: 6 * params * tokens (fwd+bwd matmul flops) PLUS the
+    # attention score/context matmuls the params-only count misses —
+    # QK^T and PV are each 2*s*hidden flops per token per layer forward,
+    # 3x that with backward: 12 * L * s * hidden per token
     n_params = sum(
         int(np.prod(v.shape)) for v in main_p.all_parameters()
         if v.shape is not None)
-    flops_per_token = 6 * n_params
+    flops_per_token = 6 * n_params + 12 * layers_n * seq * hidden
     achieved = tokens_per_sec * flops_per_token
     peak = 197e12 if on_tpu else 0  # v5e bf16 peak
     mfu = achieved / peak if peak else 0.0
 
-    print(json.dumps({
+    result = {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
                   if on_tpu else "bert_tiny_cpu_tokens_per_sec",
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
-    }))
+    }
+    if on_tpu:
+        result["mfu"] = round(mfu, 4)
+    if _FALLBACK_NOTE:
+        result["note"] = _FALLBACK_NOTE
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -12,13 +12,15 @@ import numpy as np
 from ..registry import register_op
 
 
-def _acc_type(x):
-    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
-
-
 def _matmul(x, y):
-    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
-    return out.astype(x.dtype)
+    # Low-precision dots run PLAIN (bf16 x bf16 -> bf16): the TPU MXU
+    # accumulates bf16 dots in f32 internally and rounds the output, so an
+    # explicit preferred_element_type=f32 + astype round-trip produces
+    # IDENTICAL forward numerics — but its vjp routes the cotangent
+    # through the f32 convert, silently turning every backward matmul
+    # into f32 (measured: 34/51 bench dots f32 = the whole backward,
+    # ~4x off bf16 MXU peak on v5e).
+    return jnp.matmul(x, y)
 
 
 @register_op("matmul", inputs=["X", "Y"], outputs=["Out"])

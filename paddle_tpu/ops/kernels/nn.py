@@ -51,19 +51,18 @@ def _conv(x, w, attrs, ndims, feature_group_count=None, transpose=False):
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
         (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
-    acc = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+    # no preferred_element_type=f32 + astype: the MXU already
+    # f32-accumulates low-precision convs, and the explicit round-trip
+    # forces the conv's vjp into f32 (see math._matmul)
     if transpose:
         out = jax.lax.conv_transpose(
             x, jnp.swapaxes(w, 0, 1), strides, padding,
             rhs_dilation=dilations, dimension_numbers=dn,
-            transpose_kernel=True,
-            preferred_element_type=acc)
+            transpose_kernel=True)
     else:
         out = jax.lax.conv_general_dilated(
             x, w, strides, padding, rhs_dilation=dilations,
-            dimension_numbers=dn, feature_group_count=groups,
-            preferred_element_type=acc)
-    out = out.astype(x.dtype)
+            dimension_numbers=dn, feature_group_count=groups)
     if fmt in ("NHWC", "NDHWC"):
         out = jnp.moveaxis(out, 1, -1)
     return out
@@ -525,9 +524,8 @@ def fc(ins, attrs, ctx):
     x, w = ins["Input"], ins["W"]
     in_num_col_dims = attrs.get("in_num_col_dims", 1)
     x2 = x.reshape((int(np.prod(x.shape[:in_num_col_dims])), -1))
-    out = jnp.matmul(x2, w, preferred_element_type=jnp.float32
-                     if x.dtype in (jnp.bfloat16, jnp.float16) else None)
-    out = out.astype(x.dtype)
+    # plain dot: bf16 vjp stays bf16 (see math._matmul)
+    out = jnp.matmul(x2, w)
     if ins.get("Bias") is not None:
         out = out + ins["Bias"]
     act = attrs.get("activation_type", "")
