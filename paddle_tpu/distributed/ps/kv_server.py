@@ -42,6 +42,8 @@ OP_SET = 8         # overwrite param (geo-SGD delta merge uses add)
 OP_PUSH_DELTA = 9  # geo: add delta to param
 OP_ERROR = 10      # server-side failure; name carries the message
 OP_HEARTBEAT = 11  # trainer liveness ping; extra carries the trainer id
+OP_PULL_ROWS = 12  # sparse pull: arr carries int64 LOCAL row ids
+OP_PUSH_ROWS = 13  # sparse push: ids message then values message (2-part)
 
 
 def _send_msg(sock, op: int, name: str, arr: Optional[np.ndarray],
@@ -123,6 +125,31 @@ class _Handler(socketserver.BaseRequestHandler):
                             srv._store[name] = srv._store[name] + \
                                 arr.astype(np.float32)
                     _send_msg(sock, OP_PUSH_DELTA, name, None)
+                elif op == OP_PULL_ROWS:
+                    # sparse table pull: arr = local row ids of this shard
+                    with srv._lock:
+                        tab = srv._store.get(name)
+                        rows = (None if tab is None
+                                else tab[arr.astype(np.int64)])
+                    _send_msg(sock, OP_PULL_ROWS, name, rows)
+                elif op == OP_PUSH_ROWS:
+                    # two-part message: ids (this one, extra = lr) then
+                    # values on the same socket; server-side sparse SGD
+                    # applies immediately (Hogwild — reference async PS
+                    # sparse-table semantics, distributed/ps tables)
+                    vop, _, vals, _ = _recv_msg(sock)
+                    ids = arr.astype(np.int64)
+                    with srv._lock:
+                        tab = srv._store.get(name)
+                        if tab is not None and vals is not None:
+                            # copy-on-write: OP_PULL sends store refs
+                            # outside the lock, so never mutate in place
+                            tab = tab.copy()
+                            np.subtract.at(
+                                tab, ids,
+                                float(extra) * vals.astype(np.float32))
+                            srv._store[name] = tab
+                    _send_msg(sock, OP_PUSH_ROWS, name, None)
                 elif op == OP_PUSH_SYNC:
                     try:
                         srv._push_sync(name, arr, extra)
@@ -340,6 +367,57 @@ class KVClient:
     def push_delta(self, name, delta):
         self._call(self._ep_for(name), OP_PUSH_DELTA, name,
                    np.asarray(delta))
+
+    # -- sparse (row-sharded) tables ---------------------------------------
+    # Row r of a distributed table lives on pserver (r % n_eps) at local
+    # row (r // n_eps) — the reference's block-partitioned
+    # distributed_lookup_table (distributed_lookup_table_op.cc), with
+    # modulo placement instead of contiguous blocks so shards stay
+    # balanced under skewed id distributions.
+    def init_sparse_table(self, name, value):
+        """Split [V, D] rows across pservers (first writer wins)."""
+        value = np.asarray(value)
+        n = len(self.endpoints)
+        for e, ep in enumerate(self.endpoints):
+            self._call(ep, OP_INIT, name, value[e::n])
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        """Gather rows `ids` (global) from the sharded table."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        n = len(self.endpoints)
+        out = None
+        for e, ep in enumerate(self.endpoints):
+            mask = (ids % n) == e
+            if not mask.any():
+                continue
+            local = ids[mask] // n
+            _, _, rows, _ = self._call(ep, OP_PULL_ROWS, name, local)
+            if rows is None:
+                raise KeyError(
+                    f"sparse table {name!r} shard {e} not on {ep}")
+            if out is None:
+                out = np.zeros((ids.size,) + rows.shape[1:], rows.dtype)
+            out[mask] = rows
+        if out is None:  # ids empty
+            raise ValueError("pull_sparse with no ids")
+        return out
+
+    def push_sparse(self, name, ids, grads, lr):
+        """Scatter row grads back; server applies rows -= lr * grad."""
+        ids = np.asarray(ids).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads)
+        n = len(self.endpoints)
+        for e, ep in enumerate(self.endpoints):
+            mask = (ids % n) == e
+            if not mask.any():
+                continue
+            local = ids[mask] // n
+            s = self._sock(ep)
+            _send_msg(s, OP_PUSH_ROWS, name, local, float(lr))
+            _send_msg(s, OP_PUSH_ROWS, name, grads[mask])
+            rop, rname, _, _ = _recv_msg(s)
+            if rop == OP_ERROR:
+                raise TimeoutError(rname)
 
     def barrier(self):
         for ep in self.endpoints:

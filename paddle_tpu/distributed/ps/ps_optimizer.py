@@ -204,6 +204,12 @@ class DistributeTranspiler:
                 "(params_grads recorded)")
         if self.config.use_graph_ops and not self.config.geo_sgd_mode:
             return self._transpile_with_graph_ops(pgs)
+        if self._distributed_tables(self._program):
+            raise ValueError(
+                "is_distributed embedding tables need the graph-op "
+                "transpiler (DistributeTranspilerConfig.use_graph_ops = "
+                "True); the runtime-managed PSCompiledProgram path would "
+                "replicate and dense-sync the whole table")
         if self.config.geo_sgd_mode:
             mode = "geo"
             prog = self._program  # geo keeps local optimizer ops
@@ -215,22 +221,59 @@ class DistributeTranspiler:
             geo_k=self.config.geo_sgd_need_push_nums,
             endpoints=self._pservers, trainer_id=self._trainer_id)
 
+    _LOOKUP_TYPES = ("lookup_table", "lookup_table_v2", "embedding")
+
+    def _distributed_tables(self, program) -> set:
+        """Tables marked is_distributed on their lookup ops — these shard
+        row-wise across pservers instead of replicating."""
+        tables = set()
+        for op in program.global_block().ops:
+            if op.type in self._LOOKUP_TYPES and \
+                    op.attrs.get("is_distributed"):
+                if not op.attrs.get("is_sparse"):
+                    raise ValueError(
+                        "distributed embedding tables need "
+                        "is_sparse=True (the SelectedRows gradient is "
+                        "what gets pushed row-wise)")
+                tables.add(op.inputs["W"][0])
+        return tables
+
     def _transpile_with_graph_ops(self, params_grads) -> Program:
         """Reference transpiler shape (distribute_transpiler.py:256): the
         returned trainer Program itself carries `send` (grads out) →
         `fetch_barrier` → `recv` (params in) ops; exe.run of the program IS
         the PS step.  Startup gets a mode="init" send pushing initial
-        params to the server (pserver-side startup analog)."""
+        params to the server (pserver-side startup analog).
+
+        Distributed (row-sharded) embedding tables take the sparse path:
+        their forward lookups become `distributed_lookup_table` (pull only
+        the touched rows), their SelectedRows grads go out through a
+        sparse `send` (server-side row SGD), and they are EXCLUDED from
+        the dense send/recv round — the [V, D] table never crosses the
+        wire whole (reference distributed_lookup_table_op.cc)."""
         # read the exact lr var off the optimizer ops before stripping them
         lr_var = next(
             (op.inputs["LearningRate"][0]
              for op in self._program.global_block().ops
              if (op.op_role & OpRole.Optimize) and
              op.inputs.get("LearningRate")), None)
+        dist_tables = self._distributed_tables(self._program)
         prog = _strip_optimizer_ops(self._program.clone())
         block = prog.global_block()
-        param_names = [p.name for p, _ in params_grads]
-        grad_names = [g.name for _, g in params_grads]
+        for op in block.ops:
+            if op.type in self._LOOKUP_TYPES and \
+                    op.inputs.get("W", [None])[0] in dist_tables:
+                op.type = "distributed_lookup_table"
+                op.attrs.update({
+                    "table_name": op.inputs["W"][0],
+                    "endpoints": list(self._pservers),
+                    "trainer_id": self._trainer_id})
+        param_names = [p.name for p, _ in params_grads
+                       if p.name not in dist_tables]
+        grad_names = [g.name for p, g in params_grads
+                      if p.name not in dist_tables]
+        sparse_pgs = [(p, g) for p, g in params_grads
+                      if p.name in dist_tables]
         mode = "grad_sync" if self.config.sync_mode else "grad_async"
         if lr_var is not None and not block.has_var(lr_var):
             lr_var = None
@@ -238,8 +281,20 @@ class DistributeTranspiler:
             lr_var = next((v.name for v in block.vars.values()
                            if v.persistable and
                            v.name.startswith("learning_rate")), None)
-        self._append_ps_graph_ops(block, block, grad_names, param_names,
-                                  mode, lr_var=lr_var)
+        for p, g in sparse_pgs:
+            send_ins = {"X": [g.name]}
+            if lr_var:
+                send_ins["LearningRate"] = [lr_var]
+            dummy = block.create_var(shape=[1], dtype="float32")
+            block.append_op(
+                "send", send_ins, {"Dummy": [dummy.name]},
+                {"send_varnames": [p.name],
+                 "endpoints": list(self._pservers),
+                 "mode": "sparse_grad", "trainer_id": self._trainer_id,
+                 OpRole.KEY: OpRole.RPC})
+        if param_names:
+            self._append_ps_graph_ops(block, block, grad_names,
+                                      param_names, mode, lr_var=lr_var)
         return prog
 
     def _append_ps_graph_ops(self, block, shape_block, x_names, param_names,
@@ -276,13 +331,28 @@ class DistributeTranspiler:
         if getattr(self._startup, "_ps_startup_transpiled", False):
             return
         mb = self._program.global_block()
-        param_names = [p.name for p, _ in params_grads]
+        dist_tables = self._distributed_tables(self._program)
+        param_names = [p.name for p, _ in params_grads
+                       if p.name not in dist_tables]
+        sparse_names = [p.name for p, _ in params_grads
+                        if p.name in dist_tables]
         sb = self._startup.global_block()
-        for n in param_names:
+        for n in param_names + sparse_names:
             if not sb.has_var(n):
                 sb.create_var(n, mb.var(n).shape, mb.var(n).dtype,
                               persistable=True)
-        self._append_ps_graph_ops(sb, mb, param_names, param_names, "init")
+        for n in sparse_names:
+            # row-shard the locally initialized table across pservers
+            # (first writer wins, like the dense init)
+            dummy = sb.create_var(shape=[1], dtype="float32")
+            sb.append_op(
+                "send", {"X": [n]}, {"Dummy": [dummy.name]},
+                {"send_varnames": [n], "endpoints": list(self._pservers),
+                 "mode": "init_sparse", "trainer_id": self._trainer_id,
+                 OpRole.KEY: OpRole.RPC})
+        if param_names:
+            self._append_ps_graph_ops(sb, mb, param_names, param_names,
+                                      "init")
         self._startup._ps_startup_transpiled = True
 
     def get_pserver_program(self, endpoint) -> Program:

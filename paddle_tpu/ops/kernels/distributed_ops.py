@@ -64,6 +64,9 @@ def send(ins, attrs, ctx):
     lr_arr = (lr_in.reshape(()) if lr_in is not None
               else jnp.asarray(lr_attr, jnp.float32))
 
+    if mode in ("sparse_grad", "init_sparse"):
+        return _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr)
+
     def host(lr, *arrs):
         c = _client(endpoints, trainer_id)
         for n, a in zip(names, arrs):
@@ -78,6 +81,42 @@ def send(ins, attrs, ctx):
 
     dummy = io_callback(host, jax.ShapeDtypeStruct((1,), jnp.float32),
                         lr_arr, *xs, ordered=True)
+    return {"Dummy": dummy}
+
+
+def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr):
+    """Row-sharded table traffic: init pushes the full local init split
+    across pservers; sparse_grad pushes SelectedRows {rows, values} the
+    embedding backward produced (reference
+    distributed_lookup_table_op.cc + SelectedRows send path)."""
+    from ...core.selected_rows import SelectedRows
+    flats = []
+    for x in xs:
+        if isinstance(x, SelectedRows):
+            flats.extend([x.rows, x.values])
+        elif mode == "init_sparse":
+            flats.extend([jnp.zeros((0,), jnp.int32), x])
+        else:
+            # a dense grad here means the SelectedRows path was lost
+            # (densified by an aggregation/pass) — dropping it would
+            # silently stop the table from training
+            raise TypeError(
+                "sparse_grad send expects a SelectedRows gradient; got a "
+                "dense array — build the embedding with is_sparse=True "
+                "and keep its gradient un-densified")
+
+    def host(lr, *arrs):
+        c = _client(endpoints, trainer_id)
+        for n, i in zip(names, range(0, len(arrs), 2)):
+            rows, vals = np.asarray(arrs[i]), np.asarray(arrs[i + 1])
+            if mode == "init_sparse":
+                c.init_sparse_table(n, vals)
+            elif rows.size:
+                c.push_sparse(n, rows, vals, float(lr))
+        return np.zeros((1,), np.float32)
+
+    dummy = io_callback(host, jax.ShapeDtypeStruct((1,), jnp.float32),
+                        lr_arr, *flats, ordered=True)
     return {"Dummy": dummy}
 
 
@@ -101,6 +140,49 @@ def recv(ins, attrs, ctx):
     result = [jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)]
     outs = io_callback(host, tuple(result), ordered=True)
     return {"Out": list(outs)}
+
+
+@register_op("distributed_lookup_table", inputs=["Ids!", "W!"],
+             outputs=["Out"], grad=None, side_effect=True)
+def distributed_lookup_table(ins, attrs, ctx):
+    """distributed_lookup_table_op.cc — embedding forward whose table
+    lives row-sharded on the pservers: pull exactly the rows this batch
+    touches.  The local W shadow supplies shape/dtype only; the grad op
+    stays the ordinary lookup_table_grad (SelectedRows), which the
+    transpiler routes into a sparse `send` (server applies the row SGD).
+    Non-differentiable itself: the transpiled program decouples forward
+    pulls from backward pushes exactly like the reference."""
+    ids, w = ins["Ids"], ins["W"]
+    endpoints = tuple(attrs["endpoints"])
+    table_name = attrs["table_name"]
+    trainer_id = attrs.get("trainer_id")
+    squeeze = ids.ndim > 1 and ids.shape[-1] == 1
+    ids_eff = jnp.squeeze(ids, -1) if squeeze else ids
+    dim = w.shape[1]
+    n_flat = int(np.prod(ids_eff.shape))
+    # padding handling matches _embedding (ops/kernels/nn.py): padded
+    # positions must return ZERO rows, and their (possibly negative) ids
+    # must never hit the modulo sharding
+    padding_idx = attrs.get("padding_idx", -1)
+    pad_mask = None
+    if padding_idx is not None and padding_idx != -1:
+        pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+        pad_mask = ids_eff == pid
+        ids_eff = jnp.where(pad_mask, 0, ids_eff)
+
+    def host(ids_arr):
+        c = _client(endpoints, trainer_id)
+        rows = c.pull_sparse(table_name,
+                             np.asarray(ids_arr).reshape(-1))
+        return rows.astype(np.float32)
+
+    flat = io_callback(host,
+                       jax.ShapeDtypeStruct((n_flat, dim), jnp.float32),
+                       ids_eff, ordered=True)
+    out = flat.reshape(tuple(ids_eff.shape) + (dim,)).astype(w.dtype)
+    if pad_mask is not None:
+        out = jnp.where(pad_mask[..., None], jnp.zeros_like(out), out)
+    return {"Out": out}
 
 
 @register_op("fetch_barrier", inputs=["X*!"], outputs=[], grad=None,

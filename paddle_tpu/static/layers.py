@@ -138,7 +138,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         "lookup_table_v2", inputs={"W": w, "Ids": input},
         outputs={"Out": out},
         attrs={"padding_idx": -1 if padding_idx is None else padding_idx,
-               "is_sparse": bool(is_sparse)})
+               "is_sparse": bool(is_sparse),
+               "is_distributed": bool(is_distributed)})
     return out
 
 
@@ -1359,6 +1360,13 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
     """fluid.layers.sampled_softmax_with_cross_entropy
     (sample_logits_op.cc): softmax CE over the true classes plus
     num_samples log-uniform negatives, with log-Q correction."""
+    label_width = (label.shape[-1] if label.shape is not None
+                   and len(label.shape) > 1 else 1)
+    if label_width != num_true:
+        raise ValueError(
+            f"num_true={num_true} does not match the label width "
+            f"{label_width} — the label's last dim IS the true-class "
+            "count")
     helper = LayerHelper("sampled_softmax_with_cross_entropy")
     samples = helper.create_variable_for_type_inference("int64", True)
     probabilities = helper.create_variable_for_type_inference(logits.dtype,
