@@ -374,3 +374,64 @@ def yolov3_loss(ins, attrs, ctx):
     return {"Loss": loss,
             "ObjectnessMask": obj_target,
             "GTMatchMask": matched.astype(jnp.int32)}
+
+
+@register_op("density_prior_box", inputs=["Input!", "Image!"],
+             outputs=["Boxes", "Variances"], grad=None)
+def density_prior_box(ins, attrs, ctx):
+    """density_prior_box_op.h:23 — dense anchors from fixed sizes/ratios/
+    densities per feature-map cell.  Pure function of STATIC shapes +
+    attrs, so the grid is computed trace-time in numpy and lands in the
+    program as a constant (XLA folds it)."""
+    import numpy as np
+    feat, img = ins["Input"], ins["Image"]
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    fixed_sizes = list(attrs.get("fixed_sizes", []))
+    fixed_ratios = list(attrs.get("fixed_ratios", []))
+    densities = [int(d) for d in attrs.get("densities", [])]
+    variances = list(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]))
+    clip = bool(attrs.get("clip", False))
+    offset = float(attrs.get("offset", 0.5))
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    if len(fixed_sizes) != len(densities):
+        raise ValueError(
+            f"density_prior_box: fixed_sizes ({len(fixed_sizes)}) and "
+            f"densities ({len(densities)}) must pair up one-to-one")
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    step_avg = int((sw + sh) * 0.5)
+
+    # per-cell relative layout is identical across the grid: build it once
+    # [P, 4] = (dx, dy, bw, bh), then broadcast-add the center grid
+    rel = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = int(step_avg / density)
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            for di in range(density):
+                for dj in range(density):
+                    rel.append((-step_avg / 2.0 + shift / 2.0 + dj * shift,
+                                -step_avg / 2.0 + shift / 2.0 + di * shift,
+                                bw, bh))
+    rel = np.asarray(rel, np.float32)            # [P, 4]
+    cx = ((np.arange(fw) + offset) * sw).astype(np.float32)   # [W]
+    cy = ((np.arange(fh) + offset) * sh).astype(np.float32)   # [H]
+    x = cx[None, :, None] + rel[None, None, :, 0]  # [1, W, P]
+    y = cy[:, None, None] + rel[None, None, :, 1]  # [H, 1, P]
+    x = np.broadcast_to(x, (fh, fw, rel.shape[0]))
+    y = np.broadcast_to(y, (fh, fw, rel.shape[0]))
+    bw = rel[None, None, :, 2]
+    bh = rel[None, None, :, 3]
+    boxes = np.stack([
+        np.maximum((x - bw / 2.0) / iw, 0.0),
+        np.maximum((y - bh / 2.0) / ih, 0.0),
+        np.minimum((x + bw / 2.0) / iw, 1.0),
+        np.minimum((y + bh / 2.0) / ih, 1.0)], axis=-1).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.tile(np.asarray(variances, np.float32),
+                    (fh, fw, rel.shape[0], 1))
+    return {"Boxes": jnp.asarray(boxes), "Variances": jnp.asarray(vars_)}

@@ -109,3 +109,130 @@ def mean_iou(ins, attrs, ctx):
     mean_iou_val = jnp.sum(iou) / jnp.maximum(valid, 1.0)
     return {"OutMeanIou": mean_iou_val.astype(jnp.float32),
             "OutWrong": wrong_pred + wrong_label, "OutCorrect": correct}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval (chunk_eval_op.h:41 GetSegments / :89 ChunkEnd / :102
+# ChunkBegin) — sequence chunking precision/recall/F1 over IOB / IOE /
+# IOBES / plain tag schemes.  Pure host-side metric: segment extraction is
+# inherently sequential python, so it runs through jax.pure_callback with
+# scalar outputs (the reference's CPU-only kernel has the same shape).
+# ---------------------------------------------------------------------------
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_segments(seq, num_chunk_types, scheme):
+    import numpy as np
+    ntag, t_b, t_i, t_e, t_s = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(ptag, ptype, tag, typ):
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        if ptag == t_b or ptag == t_i:
+            return tag == t_b or tag == t_s
+        if ptag == t_e or ptag == t_s:
+            return True
+        return False
+
+    def chunk_begin(ptag, ptype, tag, typ):
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == t_b or tag == t_s:
+            return True
+        if tag == t_i or tag == t_e:
+            return ptag == t_e or ptag == t_s
+        return False
+
+    segments = []
+    start, in_chunk = 0, False
+    tag, typ = -1, other
+    for i, lab in enumerate(np.asarray(seq).tolist()):
+        ptag, ptype = tag, typ
+        tag, typ = int(lab) % ntag, int(lab) // ntag
+        if in_chunk and chunk_end(ptag, ptype, tag, typ):
+            segments.append((start, i - 1, ptype))
+            in_chunk = False
+        if chunk_begin(ptag, ptype, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segments.append((start, len(np.asarray(seq)) - 1, typ))
+    return segments
+
+
+def _chunk_counts(inf, lab, lengths, num_chunk_types, scheme, excluded):
+    import numpy as np
+    inf, lab = np.asarray(inf), np.asarray(lab)
+    n_inf = n_lab = n_corr = 0
+    for b in range(inf.shape[0]):
+        L = int(lengths[b]) if lengths is not None else inf.shape[1]
+        segs_i = {s for s in _chunk_segments(inf[b, :L], num_chunk_types,
+                                             scheme)
+                  if s[2] not in excluded}
+        segs_l = {s for s in _chunk_segments(lab[b, :L], num_chunk_types,
+                                             scheme)
+                  if s[2] not in excluded}
+        n_inf += len(segs_i)
+        n_lab += len(segs_l)
+        n_corr += len(segs_i & segs_l)
+    return (np.int64(n_inf), np.int64(n_lab), np.int64(n_corr))
+
+
+@register_op("chunk_eval",
+             inputs=["Inference!", "Label!", "SeqLength?!"],
+             outputs=["Precision", "Recall", "F1-Score",
+                      "NumInferChunks", "NumLabelChunks",
+                      "NumCorrectChunks"], grad=None)
+def chunk_eval(ins, attrs, ctx):
+    inf, lab = ins["Inference"], ins["Label"]
+    if inf.ndim == 3 and inf.shape[-1] == 1:
+        inf, lab = jnp.squeeze(inf, -1), jnp.squeeze(lab, -1)
+    lengths = ins.get("SeqLength")
+    scheme = attrs.get("chunk_scheme", "IOB")
+    if scheme not in _CHUNK_SCHEMES:
+        raise ValueError(f"unknown chunk scheme {scheme!r}")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    excluded = set(attrs.get("excluded_chunk_types", []) or [])
+
+    from jax import dtypes as _dtypes
+    idt = _dtypes.canonicalize_dtype(jnp.int64)  # int32 w/o x64
+
+    def host(inf_a, lab_a, len_a):
+        import numpy as np
+        c = _chunk_counts(inf_a, lab_a,
+                          None if len_a.shape == (0,) else len_a,
+                          num_chunk_types, scheme, excluded)
+        return tuple(np.asarray(v, idt) for v in c)
+
+    len_arg = (lengths if lengths is not None
+               else jnp.zeros((0,), jnp.int32))
+    n_inf, n_lab, n_corr = jax.pure_callback(
+        host, (jax.ShapeDtypeStruct((), idt),
+               jax.ShapeDtypeStruct((), idt),
+               jax.ShapeDtypeStruct((), idt)),
+        inf, lab, len_arg)
+    n_inf_f = n_inf.astype(jnp.float32)
+    n_lab_f = n_lab.astype(jnp.float32)
+    n_corr_f = n_corr.astype(jnp.float32)
+    precision = jnp.where(n_inf_f > 0, n_corr_f / jnp.maximum(n_inf_f, 1),
+                          0.0)
+    recall = jnp.where(n_lab_f > 0, n_corr_f / jnp.maximum(n_lab_f, 1),
+                       0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall
+                   / jnp.maximum(precision + recall, 1e-12), 0.0)
+    return {"Precision": precision, "Recall": recall, "F1-Score": f1,
+            "NumInferChunks": n_inf, "NumLabelChunks": n_lab,
+            "NumCorrectChunks": n_corr}

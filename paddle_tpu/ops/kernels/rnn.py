@@ -240,3 +240,51 @@ def edit_distance(ins, attrs, ctx):
     out = jax.vmap(dist_one)(hyp, ref, hls, rls)
     return {"Out": out.reshape(-1, 1),
             "SequenceNum": jnp.asarray([hyp.shape[0]], jnp.int64)}
+
+
+@register_op("lstmp",
+             inputs=["Input", "H0?", "C0?", "Weight", "ProjWeight",
+                     "Bias?"],
+             outputs=["Projection", "Cell", "BatchGate",
+                      "BatchCellPreAct", "BatchHidden"])
+def lstmp(ins, attrs, ctx):
+    """LSTM with recurrent projection (lstmp_op.cc / lstmp_op.h): the
+    recurrence feeds the PROJECTED hidden r = act(h @ ProjWeight) back
+    into the gates — Weight is [proj, 4*hidden], ProjWeight is
+    [hidden, proj].  Input is the pre-projected gate sequence
+    [b, t, 4*hidden] (caller fc-projects, same contract as `lstm`)."""
+    x = ins["Input"]                       # [b, t, 4d]
+    w = ins["Weight"]                      # [p, 4d]
+    pw = ins["ProjWeight"]                 # [d, p]
+    d = pw.shape[0]
+    p = pw.shape[1]
+    b_sz = x.shape[0]
+    h0 = ins.get("H0")                    # [b, p] projected initial
+    c0 = ins.get("C0")
+    r0 = jnp.zeros((b_sz, p), x.dtype) if h0 is None else h0
+    c0 = jnp.zeros((b_sz, d), x.dtype) if c0 is None else c0
+    bias = ins.get("Bias")
+    proj_act = attrs.get("proj_activation", "tanh")
+    act = {"tanh": jnp.tanh, "identity": lambda v: v,
+           "relu": jax.nn.relu}.get(proj_act, jnp.tanh)
+
+    def step(carry, xt):
+        r, c = carry
+        gates = xt + r @ w + (bias[:, :4 * d].reshape(1, -1)
+                              if bias is not None else 0.0)
+        i, f, cand, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        r_new = act(h_new @ pw)
+        return (r_new, c_new), (r_new, c_new)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, 0)
+    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), xs)
+    if attrs.get("is_reverse", False):
+        rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    return {"Projection": rs, "Cell": cs, "BatchGate": x,
+            "BatchCellPreAct": cs, "BatchHidden": rs}

@@ -368,3 +368,97 @@ def yolo_box(ins, attrs, ctx):
     scores = jnp.moveaxis(probs, 2, -1).reshape(n, -1, class_num)
     mask = (conf > conf_thresh).reshape(n, -1, 1)
     return {"Boxes": boxes * mask, "Scores": scores * mask}
+
+
+# ---------------------------------------------------------------------------
+# modulated deformable convolution (deformable_conv_op.cc:108, v2 with
+# per-sample modulation mask; deformable_conv_v1 without).  TPU-native
+# lowering: bilinear sampling becomes four batched gathers + interpolation
+# weights, the conv itself one einsum over the sampled patch tensor — no
+# im2col scratch, fully differentiable through auto-vjp (offsets get
+# gradients through the bilinear weights).
+# ---------------------------------------------------------------------------
+def _deform_sample(x, offset, mask, attrs, kh, kw, dg):
+    n, c, h, w = x.shape
+    stride = attrs.get("strides", [1, 1])
+    pad = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    ho = (h + 2 * pad[0] - (dil[0] * (kh - 1) + 1)) // stride[0] + 1
+    wo = (w + 2 * pad[1] - (dil[1] * (kw - 1) + 1)) // stride[1] + 1
+    # base sampling grid [kh, kw, ho, wo]
+    ys = (jnp.arange(ho) * stride[0] - pad[0])[None, None, :, None] \
+        + (jnp.arange(kh) * dil[0])[:, None, None, None]
+    xs = (jnp.arange(wo) * stride[1] - pad[1])[None, None, None, :] \
+        + (jnp.arange(kw) * dil[1])[None, :, None, None]
+    ys = jnp.broadcast_to(ys, (kh, kw, ho, wo)).astype(x.dtype)
+    xs = jnp.broadcast_to(xs, (kh, kw, ho, wo)).astype(x.dtype)
+    # offsets [n, 2*dg*kh*kw, ho, wo] -> y/x per (dg, kh, kw)
+    off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+    off_y = off[:, :, :, 0].reshape(n, dg, kh, kw, ho, wo)
+    off_x = off[:, :, :, 1].reshape(n, dg, kh, kw, ho, wo)
+    py = ys[None, None] + off_y            # [n, dg, kh, kw, ho, wo]
+    px = xs[None, None] + off_x
+    # bilinear corners with zero padding outside
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def gather(yi, xi):
+        # x grouped by dg: [n, dg, c/dg, h, w]; index [n, dg, kh,kw,ho,wo]
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        xg = x.reshape(n, dg, c // dg, h, w)
+        ni = jnp.arange(n)[:, None, None, None, None, None]
+        gi = jnp.arange(dg)[None, :, None, None, None, None]
+        # channels last, then one advanced-index gather over (n, dg, y, x)
+        xgl = jnp.moveaxis(xg, 2, -1)      # [n, dg, h, w, c/dg]
+        vals = xgl[ni, gi, yc, xc]         # [n, dg, kh,kw,ho,wo, c/dg]
+        return jnp.where(valid[..., None], vals, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wy_ = wy[..., None]
+    wx_ = wx[..., None]
+    sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+               + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    if mask is not None:
+        m = mask.reshape(n, dg, kh, kw, ho, wo)
+        sampled = sampled * m[..., None]
+    return sampled, ho, wo  # [n, dg, kh, kw, ho, wo, c/dg]
+
+
+def _deform_conv(ins, attrs, ctx, with_mask):
+    x, w_f = ins["Input"], ins["Filter"]
+    offset = ins["Offset"]
+    mask = ins.get("Mask") if with_mask else None
+    cout, cin_g, kh, kw = w_f.shape
+    dg = int(attrs.get("deformable_groups", 1))
+    groups = int(attrs.get("groups", 1))
+    n, c = x.shape[0], x.shape[1]
+    sampled, ho, wo = _deform_sample(x, offset, mask, attrs, kh, kw, dg)
+    # [n, dg, kh, kw, ho, wo, c/dg] -> [n, c, kh, kw, ho, wo]
+    sampled = jnp.moveaxis(sampled, -1, 2).reshape(
+        n, c, kh, kw, ho, wo)
+    # grouped conv: split channels
+    sampled = sampled.reshape(n, groups, c // groups, kh, kw, ho, wo)
+    wg = w_f.reshape(groups, cout // groups, cin_g, kh, kw)
+    out = jnp.einsum("ngcijhw,gocij->ngohw", sampled, wg)
+    return {"Output": out.reshape(n, cout, ho, wo)}
+
+
+@register_op("deformable_conv",
+             inputs=["Input", "Offset", "Mask", "Filter"],
+             outputs=["Output"])
+def deformable_conv(ins, attrs, ctx):
+    return _deform_conv(ins, attrs, ctx, with_mask=True)
+
+
+@register_op("deformable_conv_v1",
+             inputs=["Input", "Offset", "Filter"],
+             outputs=["Output"])
+def deformable_conv_v1(ins, attrs, ctx):
+    return _deform_conv(ins, attrs, ctx, with_mask=False)

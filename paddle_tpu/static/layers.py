@@ -46,7 +46,8 @@ __all__ = [
     "create_global_var", "unstack", "_binary_op", "sequence_mask", "cumsum",
     "maxout", "lrn", "resize_bilinear", "resize_nearest", "roi_align", "nce",
     "hsigmoid", "sampled_softmax_with_cross_entropy",
-    "row_conv", "beam_search",
+    "row_conv", "beam_search", "dynamic_lstmp", "chunk_eval",
+    "deformable_conv", "density_prior_box",
 ]
 
 
@@ -1739,3 +1740,137 @@ from .layer_generator import generate_layer_fns as _generate_layer_fns  # noqa: 
 
 _GENERATED_LAYERS = _generate_layer_fns(globals(), dir())
 __all__ += _GENERATED_LAYERS
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  is_reverse=False, proj_activation="tanh", name=None,
+                  h_0=None, c_0=None, proj_param_attr=None):
+    """fluid.layers.dynamic_lstmp (lstmp_op.cc): LSTM with recurrent
+    projection over padded dense input [b, t, 4*hidden] (size = 4*hidden,
+    caller pre-projects with an fc, same contract as dynamic_lstm).
+    Returns (projection [b,t,proj_size], cell [b,t,hidden]).
+
+    param_attr configures the [proj, 4*hidden] recurrent weight;
+    proj_param_attr the [hidden, proj] projection weight (it gets only a
+    derived NAME from param_attr when unset — initializers are
+    shape-specific and must not be shared across differently-shaped
+    weights)."""
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    hidden = size // 4
+    w = helper.create_parameter(param_attr, [proj_size, 4 * hidden],
+                                input.dtype)
+    if proj_param_attr is None and isinstance(param_attr, ParamAttr) \
+            and param_attr.name:
+        proj_param_attr = ParamAttr(name=param_attr.name + "_proj")
+    pw = helper.create_parameter(proj_param_attr, [hidden, proj_size],
+                                 input.dtype)
+    b = helper.create_parameter(bias_attr, [1, 4 * hidden], input.dtype,
+                                is_bias=True)
+    proj = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    hid = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Weight": w, "ProjWeight": pw}
+    if b is not None:
+        ins["Bias"] = b
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    helper.append_op("lstmp", inputs=ins,
+                     outputs={"Projection": proj, "Cell": cell,
+                              "BatchGate": gate, "BatchCellPreAct": pre,
+                              "BatchHidden": hid},
+                     attrs={"is_reverse": is_reverse,
+                            "proj_activation": proj_activation})
+    return proj, cell
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """fluid.layers.chunk_eval (chunk_eval_op.cc:22): chunking
+    precision/recall/F1 over IOB/IOE/IOBES/plain tag schemes; padded
+    [B, T] sequences with optional seq_length (the LoD replacement)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32", True)
+    recall = helper.create_variable_for_type_inference("float32", True)
+    f1 = helper.create_variable_for_type_inference("float32", True)
+    n_inf = helper.create_variable_for_type_inference("int64", True)
+    n_lab = helper.create_variable_for_type_inference("int64", True)
+    n_corr = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        ins["SeqLength"] = seq_length
+    helper.append_op(
+        "chunk_eval", inputs=ins,
+        outputs={"Precision": precision, "Recall": recall,
+                 "F1-Score": f1, "NumInferChunks": n_inf,
+                 "NumLabelChunks": n_lab, "NumCorrectChunks": n_corr},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return precision, recall, f1, n_inf, n_lab, n_corr
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """fluid.layers.deformable_conv (deformable_conv_op.cc:108):
+    modulated (v2) when mask is given, v1 otherwise."""
+    helper = LayerHelper("deformable_conv", name=name)
+    c_in = input.shape[1]
+    ks = _pair(filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_filters, c_in // groups] + ks, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    op_type = "deformable_conv_v1"
+    if modulated:
+        if mask is None:
+            raise ValueError("modulated deformable_conv needs mask "
+                             "(use modulated=False for v1)")
+        ins["Mask"] = mask
+        op_type = "deformable_conv"
+    helper.append_op(
+        op_type, inputs=ins, outputs={"Output": out},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups,
+               "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out2 = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add",
+                         inputs={"X": out, "Y": b},
+                         outputs={"Out": out2}, attrs={"axis": 1})
+        return out2
+    return out
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=None, clip=False,
+                      steps=None, offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """fluid.layers.density_prior_box (density_prior_box_op.h:23)."""
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference("float32", True)
+    vars_ = helper.create_variable_for_type_inference("float32", True)
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        "density_prior_box",
+        inputs={"Input": input, "Image": image},
+        outputs={"Boxes": boxes, "Variances": vars_},
+        attrs={"densities": [int(d) for d in (densities or [])],
+               "fixed_sizes": [float(s) for s in (fixed_sizes or [])],
+               "fixed_ratios": [float(r) for r in (fixed_ratios or [])],
+               "variances": [float(v) for v in
+                             (variance or [0.1, 0.1, 0.2, 0.2])],
+               "clip": clip, "step_w": float(steps[0]),
+               "step_h": float(steps[1]), "offset": float(offset)})
+    if flatten_to_2d:
+        boxes = reshape(boxes, [-1, 4])
+        vars_ = reshape(vars_, [-1, 4])
+    return boxes, vars_

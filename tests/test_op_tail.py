@@ -419,3 +419,229 @@ def test_register_py_func_dedups():
         return a
 
     assert register_py_func(f) == register_py_func(f)
+
+
+# ---------------------------------------------------------------------------
+# round-3 op tail: deformable_conv, chunk_eval, lstmp, density_prior_box
+# ---------------------------------------------------------------------------
+def _run_prog(main, startup, feed=None, fetch=None):
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed or {}, fetch_list=fetch or []), scope
+
+
+def test_deformable_conv_zero_offset_matches_standard_conv():
+    # zero offsets + ones mask == ordinary convolution
+    N, C, H, W, F, K = 2, 4, 6, 6, 3, 3
+    rng = np.random.RandomState(0)
+    xv = rng.rand(N, C, H, W).astype(np.float32)
+    wv = rng.rand(F, C, K, K).astype(np.float32)
+    off = np.zeros((N, 2 * K * K, H, W), np.float32)
+    msk = np.ones((N, K * K, H, W), np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, C, H, W])
+        o = layers.data("o", [-1, 2 * K * K, H, W])
+        m = layers.data("m", [-1, K * K, H, W])
+        out = layers.deformable_conv(
+            x, o, m, F, K, padding=1, bias_attr=False,
+            param_attr=static.ParamAttr(
+                name="dcw", initializer=static.NumpyArrayInitializer(wv)))
+        ref = layers.conv2d(
+            x, F, K, padding=1, bias_attr=False,
+            param_attr=static.ParamAttr(
+                name="rcw", initializer=static.NumpyArrayInitializer(wv)))
+    (got, want), _ = _run_prog(main, startup,
+                          feed={"x": xv, "o": off, "m": msk},
+                          fetch=[out, ref])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    # a +1 x-offset on every kernel tap equals convolving the x-shifted
+    # image (interior pixels)
+    N, C, H, W, K = 1, 2, 8, 8, 3
+    rng = np.random.RandomState(1)
+    xv = rng.rand(N, C, H, W).astype(np.float32)
+    wv = rng.rand(1, C, K, K).astype(np.float32)
+    off = np.zeros((N, 2 * K * K, H, W), np.float32)
+    off[:, 1::2] = 1.0  # x offsets (odd channels) = +1
+    msk = np.ones((N, K * K, H, W), np.float32)
+    x_shift = np.zeros_like(xv)
+    x_shift[:, :, :, :-1] = xv[:, :, :, 1:]
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, C, H, W])
+        o = layers.data("o", [-1, 2 * K * K, H, W])
+        m = layers.data("m", [-1, K * K, H, W])
+        out = layers.deformable_conv(
+            x, o, m, 1, K, padding=1, bias_attr=False,
+            param_attr=static.ParamAttr(
+                name="dcw2", initializer=static.NumpyArrayInitializer(wv)))
+        ref = layers.conv2d(
+            x, 1, K, padding=1, bias_attr=False,
+            param_attr=static.ParamAttr(
+                name="rcw2", initializer=static.NumpyArrayInitializer(wv)))
+    (got,), _ = _run_prog(main, startup,
+                     feed={"x": xv, "o": off, "m": msk}, fetch=[out])
+    (want,), _ = _run_prog(main, startup,
+                      feed={"x": x_shift, "o": np.zeros_like(off),
+                            "m": msk}, fetch=[ref])
+    # interior only: the shifted-image trick differs at the right border
+    np.testing.assert_allclose(np.asarray(got)[:, :, 1:-1, 1:-2],
+                               np.asarray(want)[:, :, 1:-1, 1:-2],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_grads_flow_to_offsets():
+    N, C, H, W, K = 1, 2, 5, 5, 3
+    rng = np.random.RandomState(2)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, C, H, W])
+        o = layers.data("o", [-1, 2 * K * K, H, W])
+        o.stop_gradient = False
+        m = layers.data("m", [-1, K * K, H, W])
+        out = layers.deformable_conv(x, o, m, 2, K, padding=1,
+                                     bias_attr=False)
+        loss = layers.reduce_sum(out)
+        grads = static.gradients([loss], [o])
+    (g,), _ = _run_prog(main, startup,
+                   feed={"x": rng.rand(N, C, H, W).astype(np.float32),
+                         "o": 0.3 * rng.rand(N, 2 * K * K, H, W)
+                         .astype(np.float32),
+                         "m": np.ones((N, K * K, H, W), np.float32)},
+                   fetch=[grads[0]])
+    g = np.asarray(g)
+    assert g.shape == (N, 2 * K * K, H, W)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_chunk_eval_iob_counts():
+    # IOB with 2 chunk types: tags B-0=0, I-0=1, B-1=2, I-1=3, O=4
+    lab = np.array([[0, 1, 4, 2, 3, 4]], np.int64)       # chunks: (0,1,t0),(3,4,t1)
+    inf = np.array([[0, 1, 4, 2, 4, 4]], np.int64)       # chunks: (0,1,t0),(3,3,t1)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = layers.data("i", [-1, 6], dtype="int64")
+        l = layers.data("l", [-1, 6], dtype="int64")
+        p, r, f1, ni, nl, nc = layers.chunk_eval(
+            i, l, chunk_scheme="IOB", num_chunk_types=2)
+    out, _ = _run_prog(main, startup, feed={"i": inf, "l": lab},
+                  fetch=[p, r, f1, ni, nl, nc])
+    p, r, f1, ni, nl, nc = [np.asarray(v) for v in out]
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+    assert p == pytest.approx(0.5) and r == pytest.approx(0.5)
+    assert f1 == pytest.approx(0.5)
+
+
+def test_chunk_eval_seq_length_masks_padding():
+    lab = np.array([[0, 1, 4, 0, 0, 0]], np.int64)
+    inf = np.array([[0, 1, 4, 0, 0, 0]], np.int64)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = layers.data("i", [-1, 6], dtype="int64")
+        l = layers.data("l", [-1, 6], dtype="int64")
+        sl = layers.data("sl", [-1], dtype="int64")
+        outs = layers.chunk_eval(i, l, chunk_scheme="IOB",
+                                 num_chunk_types=2, seq_length=sl)
+    out, _ = _run_prog(main, startup,
+                  feed={"i": inf, "l": lab,
+                        "sl": np.array([3], np.int64)},
+                  fetch=[outs[3], outs[4], outs[5]])
+    ni, nl, nc = [int(np.asarray(v)) for v in out]
+    assert ni == nl == nc == 1  # padding tags (B-0 runs) not counted
+
+
+def test_lstmp_matches_numpy():
+    B, T, D, P = 2, 4, 5, 3
+    rng = np.random.RandomState(0)
+    xv = rng.rand(B, T, 4 * D).astype(np.float32)
+    wv = rng.rand(P, 4 * D).astype(np.float32) * 0.3
+    pwv = rng.rand(D, P).astype(np.float32) * 0.3
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, T, 4 * D])
+        proj, cell = layers.dynamic_lstmp(
+            x, 4 * D, P, bias_attr=False,
+            param_attr=static.ParamAttr(
+                name="lw", initializer=static.NumpyArrayInitializer(wv)),
+            proj_param_attr=static.ParamAttr(
+                name="lw_proj",
+                initializer=static.NumpyArrayInitializer(pwv)))
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        got_p, got_c = exe.run(main, feed={"x": xv}, fetch_list=[proj, cell])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    r = np.zeros((B, P), np.float32)
+    c = np.zeros((B, D), np.float32)
+    ps, cs = [], []
+    for t in range(T):
+        gates = xv[:, t] + r @ wv
+        i, f, cand, o = np.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(cand)
+        h = sig(o) * np.tanh(c)
+        r = np.tanh(h @ pwv)
+        ps.append(r.copy())
+        cs.append(c.copy())
+    np.testing.assert_allclose(np.asarray(got_p),
+                               np.stack(ps, 1), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_c),
+                               np.stack(cs, 1), rtol=1e-4, atol=1e-5)
+
+
+def test_density_prior_box_matches_numpy():
+    N, C, H, W = 1, 3, 2, 2
+    IH, IW = 16, 16
+    densities = [2]
+    fixed_sizes = [4.0]
+    fixed_ratios = [1.0]
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        feat = layers.data("feat", [-1, C, H, W])
+        img = layers.data("img", [-1, 3, IH, IW])
+        boxes, vars_ = layers.density_prior_box(
+            feat, img, densities=densities, fixed_sizes=fixed_sizes,
+            fixed_ratios=fixed_ratios, clip=True)
+    (b, v), _ = _run_prog(main, startup,
+                     feed={"feat": np.zeros((N, C, H, W), np.float32),
+                           "img": np.zeros((N, 3, IH, IW), np.float32)},
+                     fetch=[boxes, vars_])
+    b, v = np.asarray(b), np.asarray(v)
+    assert b.shape == (H, W, 4, 4)  # 1 size * 1 ratio * 2^2 density
+    assert v.shape == b.shape
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # independent numpy replica of the reference loop (density_prior_box_op.h)
+    sw, sh = IW / W, IH / H
+    step_avg = int((sw + sh) * 0.5)
+    shift = int(step_avg / densities[0])
+    exp = np.zeros((H, W, 4, 4), np.float32)
+    for h in range(H):
+        for w in range(W):
+            cx, cy = (w + 0.5) * sw, (h + 0.5) * sh
+            idx = 0
+            bw = fixed_sizes[0] * np.sqrt(fixed_ratios[0])
+            bh = fixed_sizes[0] / np.sqrt(fixed_ratios[0])
+            dcx = cx - step_avg / 2.0 + shift / 2.0
+            dcy = cy - step_avg / 2.0 + shift / 2.0
+            for di in range(2):
+                for dj in range(2):
+                    xx, yy = dcx + dj * shift, dcy + di * shift
+                    exp[h, w, idx] = [
+                        max((xx - bw / 2) / IW, 0),
+                        max((yy - bh / 2) / IH, 0),
+                        min((xx + bw / 2) / IW, 1),
+                        min((yy + bh / 2) / IH, 1)]
+                    idx += 1
+    np.testing.assert_allclose(b, np.clip(exp, 0, 1), rtol=1e-5)
