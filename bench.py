@@ -125,12 +125,14 @@ def main():
     seq = int(os.environ.get("BENCH_SEQ", seq))
     use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
 
-    # At seq 512 XLA's fused attention beats the Pallas flash kernel on
-    # v5e (measured: 61.5k vs 43.5k tok/s) — flash earns its keep at long
-    # sequence where the O(S^2) score matrix stops fitting; the long-seq
-    # configs (ring attention tests, __graft_entry__ sp mesh) keep it on.
-    enable_flash_attention(
-        os.environ.get("BENCH_FLASH", "0") not in ("", "0", "false"))
+    # Flash dispatch is seq-length AUTO by default (crossover flag
+    # flash_min_seq_len, tools/tune_flash.py pins it on hardware):
+    # at seq 512 XLA's fused attention wins on v5e (measured r2: 61.5k vs
+    # 43.5k tok/s), flash takes over at long sequence.  BENCH_FLASH=1/0
+    # forces it for A/B runs.
+    if os.environ.get("BENCH_FLASH", "") != "":
+        enable_flash_attention(
+            os.environ["BENCH_FLASH"] not in ("0", "false"))
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
                                               heads, batch, use_amp=use_amp)

@@ -85,8 +85,8 @@ class MultiHeadAttention(Layer):
 
         # flash path: Pallas blockwise kernel on the MXU (O(S) memory);
         # masked / weight-returning / dropout cases use the score matrix
-        from ...ops.attention import flash_enabled
-        if flash_enabled() and attn_mask is None and \
+        from ...ops.attention import use_flash_for
+        if use_flash_for(int(q.shape[2])) and attn_mask is None and \
                 not self.need_weights and \
                 not (self.dropout and self.training):
             from ...ops.attention import flash_attention

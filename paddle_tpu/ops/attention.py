@@ -31,26 +31,57 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["flash_attention", "ring_attention", "reference_attention",
-           "enable_flash_attention", "flash_enabled"]
+           "enable_flash_attention", "flash_enabled", "use_flash_for",
+           "set_flash_min_seq_len"]
 
 # reserved ring id binding the sequence-parallel mesh axis (user groups from
 # paddle.distributed.new_group start at 1 and must not collide)
 SP_RING_ID = 101
 
-_FLASH_STATE = {"enabled": False}
+# mode: "auto" dispatches per call on sequence length — XLA's fused
+# attention wins at short sequence on v5e (measured r2: 61.5k vs 43.5k
+# tok/s at seq 512), flash wins once the O(S^2) scores matrix stops
+# fitting; the crossover threshold is a flag so TPU sweeps
+# (tools/tune_flash.py) can pin it empirically.
+_FLASH_STATE = {"mode": "auto", "min_seq_len": 2048}
 
 
 def enable_flash_attention(on: bool = True):
-    """Route MultiHeadAttention / scaled_dot_product_attention through the
-    Pallas flash kernel (FLAGS_use_flash_attention analog)."""
-    _FLASH_STATE["enabled"] = bool(on)
+    """Force MultiHeadAttention / scaled_dot_product_attention through
+    (on=True) or away from (on=False) the Pallas flash kernel,
+    overriding the seq-length auto-dispatch
+    (FLAGS_use_flash_attention analog)."""
+    _FLASH_STATE["mode"] = "on" if on else "off"
+
+
+def set_flash_min_seq_len(n: int):
+    """Auto-dispatch crossover: sequences >= n take the flash kernel."""
+    _FLASH_STATE["min_seq_len"] = int(n)
 
 
 def flash_enabled() -> bool:
-    if _FLASH_STATE["enabled"]:
+    """True when flash is FORCED on (legacy probe; prefer
+    use_flash_for(seq_len))."""
+    if _FLASH_STATE["mode"] == "on":
         return True
     from ..core.flags import flag
     return bool(flag("use_flash_attention", False))
+
+
+def use_flash_for(seq_len) -> bool:
+    """Per-callsite dispatch decision: forced on/off wins; in auto mode a
+    STATIC sequence length >= the crossover threshold selects flash."""
+    if _FLASH_STATE["mode"] == "on":
+        return True
+    from ..core.flags import flag
+    if bool(flag("use_flash_attention", False)):
+        return True
+    if _FLASH_STATE["mode"] == "off":
+        return False
+    if seq_len is None or not isinstance(seq_len, int) or seq_len <= 0:
+        return False  # dynamic/unknown seq: keep the XLA path
+    thr = int(flag("flash_min_seq_len", _FLASH_STATE["min_seq_len"]))
+    return seq_len >= thr
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +428,9 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
     if bias is not None:
         return reference_attention(q, k, v, bias=bias, causal=causal,
                                    scale=scale)
+    from ..core.flags import flag
+    block_q = int(flag("flash_block_q", block_q))
+    block_k = int(flag("flash_block_k", block_k))
     d = q.shape[-1]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
     # the Pallas kernels keep operands in storage dtype for MXU rate, so

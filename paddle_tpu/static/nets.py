@@ -73,8 +73,10 @@ def attention_core(q, k, v, d_key, dropout_rate=0.0, merge_shape=None):
     to the Pallas flash op when enabled.  Returns merged [b, t, h*d]
     (`merge_shape` overrides the build-time (t, h*d) when the runtime
     tensors are shards — tensor_parallel.parallel_attention)."""
-    from ..ops.attention import flash_enabled
-    if flash_enabled() and not dropout_rate:
+    from ..ops.attention import use_flash_for
+    seq = q.shape[2] if q.shape is not None and len(q.shape) > 2 else None
+    seq = seq if isinstance(seq, int) and seq > 0 else None
+    if use_flash_for(seq) and not dropout_rate:
         # emit the Pallas flash op instead of the score-matrix graph
         helper = layers.LayerHelper("flash_attention")
         ctx = helper.create_variable_for_type_inference(q.dtype)

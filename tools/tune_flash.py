@@ -1,0 +1,93 @@
+"""Flash-attention tuning sweep (run on real TPU hardware).
+
+Measures the Pallas flash kernel vs XLA's fused attention across sequence
+lengths and flash block sizes, prints a table plus the measured crossover,
+and suggests the `flash_min_seq_len` / `flash_block_q` / `flash_block_k`
+flag settings to pin.
+
+    python tools/tune_flash.py                 # default sweep
+    SEQS=512,1024,2048,4096 BLOCKS=128x256,256x512 python tools/tune_flash.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench_fn(fn, *args, iters=20):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.attention import (flash_attention,
+                                          reference_attention)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})", flush=True)
+    b, h, d = 4, 12, 64
+    seqs = [int(s) for s in os.environ.get(
+        "SEQS", "512,1024,2048,4096,8192").split(",")]
+    blocks = [tuple(int(x) for x in bl.split("x")) for bl in os.environ.get(
+        "BLOCKS", "128x128,128x256,256x256,256x512,512x512").split(",")]
+
+    crossover = None
+    for seq in seqs:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.rand(b, h, seq, d), jnp.bfloat16)
+        k = jnp.asarray(rng.rand(b, h, seq, d), jnp.bfloat16)
+        v = jnp.asarray(rng.rand(b, h, seq, d), jnp.bfloat16)
+        try:
+            t_ref = bench_fn(jax.jit(
+                lambda q, k, v: reference_attention(q, k, v, causal=True)),
+                q, k, v)
+        except Exception as e:  # O(S^2) OOM at long seq — flash territory
+            print(f"seq {seq}: XLA reference failed ({type(e).__name__})")
+            t_ref = float("inf")
+        best = (float("inf"), None)
+        for bq, bk in blocks:
+            if bq > seq or bk > seq:
+                continue
+            try:
+                t = bench_fn(jax.jit(
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk)),
+                    q, k, v)
+            except Exception as e:
+                print(f"  seq {seq} block {bq}x{bk}: "
+                      f"{type(e).__name__}: {e}")
+                continue
+            if t < best[0]:
+                best = (t, (bq, bk))
+        tok_ref = b * seq / t_ref if t_ref != float("inf") else 0
+        tok_fl = b * seq / best[0] if best[1] else 0
+        win = "FLASH" if best[0] < t_ref else "xla"
+        print(f"seq {seq:6d}: xla {t_ref*1e3:8.2f}ms ({tok_ref:9.0f} "
+              f"tok/s) | flash {best[0]*1e3:8.2f}ms ({tok_fl:9.0f} "
+              f"tok/s) block {best[1]} -> {win}", flush=True)
+        if crossover is None and best[0] < t_ref:
+            crossover = (seq, best[1])
+
+    if crossover:
+        seq, (bq, bk) = crossover
+        print(f"\ncrossover: flash wins from seq {seq}; suggest flags:")
+        print(f"  FLAGS_flash_min_seq_len={seq}")
+        print(f"  FLAGS_flash_block_q={bq} FLAGS_flash_block_k={bk}")
+    else:
+        print("\nflash never won in this sweep — keep the XLA path "
+              "(raise flash_min_seq_len above the largest measured seq)")
+
+
+if __name__ == "__main__":
+    main()
