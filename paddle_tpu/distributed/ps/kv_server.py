@@ -127,11 +127,19 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send_msg(sock, OP_PUSH_DELTA, name, None)
                 elif op == OP_PULL_ROWS:
                     # sparse table pull: arr = local row ids of this shard
-                    with srv._lock:
-                        tab = srv._store.get(name)
-                        rows = (None if tab is None
-                                else tab[arr.astype(np.int64)])
-                    _send_msg(sock, OP_PULL_ROWS, name, rows)
+                    try:
+                        with srv._lock:
+                            tab = srv._store.get(name)
+                            rows = (None if tab is None
+                                    else tab[arr.astype(np.int64)])
+                    except (IndexError, ValueError) as e:
+                        # e.g. out-of-range row id: reply a typed error
+                        # instead of dying and leaving the client with an
+                        # opaque ConnectionError
+                        _send_msg(sock, OP_ERROR,
+                                  f"pull_rows({name}): {e}", None)
+                    else:
+                        _send_msg(sock, OP_PULL_ROWS, name, rows)
                 elif op == OP_PUSH_ROWS:
                     # two-part message: ids (this one, extra = lr) then
                     # values on the same socket; server-side sparse SGD
@@ -139,17 +147,25 @@ class _Handler(socketserver.BaseRequestHandler):
                     # sparse-table semantics, distributed/ps tables)
                     vop, _, vals, _ = _recv_msg(sock)
                     ids = arr.astype(np.int64)
-                    with srv._lock:
-                        tab = srv._store.get(name)
-                        if tab is not None and vals is not None:
-                            # copy-on-write: OP_PULL sends store refs
-                            # outside the lock, so never mutate in place
-                            tab = tab.copy()
-                            np.subtract.at(
-                                tab, ids,
-                                float(extra) * vals.astype(np.float32))
-                            srv._store[name] = tab
-                    _send_msg(sock, OP_PUSH_ROWS, name, None)
+                    try:
+                        with srv._lock:
+                            tab = srv._store.get(name)
+                            if tab is None:
+                                raise KeyError(
+                                    f"sparse table {name!r} not on this "
+                                    f"server — push dropped")
+                            if vals is not None:
+                                # copy-on-write: OP_PULL sends store refs
+                                # outside the lock, never mutate in place
+                                tab = tab.copy()
+                                np.subtract.at(
+                                    tab, ids,
+                                    float(extra) * vals.astype(np.float32))
+                                srv._store[name] = tab
+                    except (KeyError, IndexError, ValueError) as e:
+                        _send_msg(sock, OP_ERROR, str(e), None)
+                    else:
+                        _send_msg(sock, OP_PUSH_ROWS, name, None)
                 elif op == OP_PUSH_SYNC:
                     try:
                         srv._push_sync(name, arr, extra)
@@ -300,11 +316,31 @@ class KVServer:
 class KVClient:
     """RPCClient analog: one socket per pserver, vars sharded round-robin
     by name hash (DistributeTranspiler round-robin param placement,
-    transpiler/distribute_transpiler.py:80 VarBlock)."""
+    transpiler/distribute_transpiler.py:80 VarBlock).
 
-    def __init__(self, endpoints: List[str], sock_timeout: float = 60.0):
+    Transport failures retry with bounded exponential backoff inside an
+    rpc_deadline budget (FLAGS_rpc_deadline parity,
+    /root/reference/paddle/fluid/operators/distributed/grpc/grpc_client.h:211
+    — deadline + error callbacks); each retry drops the cached socket and
+    reconnects, so a pserver restart is survived transparently.  Push-type
+    ops are at-least-once under retry (a push that was applied just before
+    the connection died may re-apply), matching the reference's async RPC
+    semantics."""
+
+    def __init__(self, endpoints: List[str], sock_timeout: float = 60.0,
+                 rpc_deadline: Optional[float] = None,
+                 max_retries: int = 8):
         self.endpoints = list(endpoints)
         self.sock_timeout = sock_timeout
+        if rpc_deadline is None:
+            try:
+                from ...core.flags import get_flags
+                rpc_deadline = float(
+                    get_flags("rpc_deadline")["rpc_deadline"]) / 1000.0
+            except Exception:
+                rpc_deadline = 180.0
+        self.rpc_deadline = rpc_deadline
+        self.max_retries = max_retries
         self._socks: Dict[str, socket.socket] = {}
         self._hb_stop: Optional[threading.Event] = None
 
@@ -325,21 +361,81 @@ class KVClient:
         return self.endpoints[zlib.crc32(name.encode())
                               % len(self.endpoints)]
 
-    def _call(self, ep, op, name="", arr=None, extra=0.0):
-        s = self._sock(ep)
-        _send_msg(s, op, name, arr, extra)
-        rop, rname, rarr, rextra = _recv_msg(s)
+    def _with_retry(self, ep, fn, idempotent=True, deadline=None,
+                    max_retries=None):
+        """Run fn(sock) against ep, reconnecting with exponential backoff
+        on transport errors until rpc_deadline/max_retries runs out.
+
+        idempotent=False (OP_PUSH_SYNC, OP_BARRIER — ops the server
+        COUNTS): once the request hit the wire a retry could double-count
+        this trainer in the sync fanin, so only failures raised before
+        the send (connection establishment) are retried; a mid-flight
+        failure propagates to the caller instead of corrupting the
+        round's average."""
+        deadline = time.time() + (self.rpc_deadline if deadline is None
+                                  else deadline)
+        retries = self.max_retries if max_retries is None else max_retries
+        delay = 0.05
+        last: Exception = ConnectionError("no attempt made")
+        for attempt in range(retries):
+            sent = False
+            try:
+                s = self._sock(ep)
+
+                def guard_send(*a, **kw):
+                    nonlocal sent
+                    sent = True
+                    return _send_msg(*a, **kw)
+
+                return fn(s, guard_send)
+            except (ConnectionError, OSError, socket.timeout) as e:
+                last = e
+                # the socket is in an unknown state: drop and reconnect
+                s = self._socks.pop(ep, None)
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                if sent and not idempotent:
+                    raise ConnectionError(
+                        f"non-idempotent rpc to {ep} failed mid-flight "
+                        f"(not retried to avoid double-apply): {e}") from e
+                now = time.time()
+                if now >= deadline or attempt == retries - 1:
+                    break
+                time.sleep(min(delay, max(deadline - now, 0.0)))
+                delay = min(delay * 2, 5.0)
+        raise ConnectionError(
+            f"rpc to pserver {ep} failed after {retries} "
+            f"attempts / {self.rpc_deadline:.0f}s deadline: {last}")
+
+    # ops where a post-send retry could double-count on the server
+    _NON_IDEMPOTENT = (OP_PUSH_SYNC, OP_BARRIER)
+
+    def _call(self, ep, op, name="", arr=None, extra=0.0, deadline=None,
+              max_retries=None):
+        def roundtrip(s, send):
+            send(s, op, name, arr, extra)
+            return _recv_msg(s)
+
+        rop, rname, rarr, rextra = self._with_retry(
+            ep, roundtrip, idempotent=op not in self._NON_IDEMPOTENT,
+            deadline=deadline, max_retries=max_retries)
         if rop == OP_ERROR:
             raise TimeoutError(rname)
         return rop, rname, rarr, rextra
 
     def wait_server_ready(self, timeout=60):
-        """rpc wait_server_ready parity: ping until every server answers."""
+        """rpc wait_server_ready parity: ping until every server answers.
+        Each ping gets a SHORT single-attempt budget so the outer
+        `timeout` stays authoritative (the general rpc_deadline retry
+        loop would otherwise stretch one dead endpoint to ~3x it)."""
         deadline = time.time() + timeout
         for ep in self.endpoints:
             while True:
                 try:
-                    self._call(ep, OP_PING)
+                    self._call(ep, OP_PING, deadline=1.0, max_retries=1)
                     break
                 except (ConnectionError, OSError):
                     self._socks.pop(ep, None)
@@ -402,20 +498,29 @@ class KVClient:
             raise ValueError("pull_sparse with no ids")
         return out
 
-    def push_sparse(self, name, ids, grads, lr):
-        """Scatter row grads back; server applies rows -= lr * grad."""
+    def push_sparse(self, name, ids, grads, lr, grad_scale=1.0):
+        """Scatter row grads back; server applies rows -= lr * grad.
+        grad_scale: in sync mode the trainer passes 1/num_trainers so N
+        trainers' immediate row updates average like the dense
+        _push_sync path instead of stepping N x (Hogwild) — the
+        reference pserver merges sparse grads before applying."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         grads = np.asarray(grads)
         n = len(self.endpoints)
+        eff_lr = float(lr) * float(grad_scale)
         for e, ep in enumerate(self.endpoints):
             mask = (ids % n) == e
             if not mask.any():
                 continue
             local = ids[mask] // n
-            s = self._sock(ep)
-            _send_msg(s, OP_PUSH_ROWS, name, local, float(lr))
-            _send_msg(s, OP_PUSH_ROWS, name, grads[mask])
-            rop, rname, _, _ = _recv_msg(s)
+            vals = grads[mask]
+
+            def roundtrip(s, send, local=local, vals=vals):
+                send(s, OP_PUSH_ROWS, name, local, eff_lr)
+                send(s, OP_PUSH_ROWS, name, vals)
+                return _recv_msg(s)
+
+            rop, rname, _, _ = self._with_retry(ep, roundtrip)
             if rop == OP_ERROR:
                 raise TimeoutError(rname)
 

@@ -291,6 +291,11 @@ class DistributeTranspiler:
                 {"send_varnames": [p.name],
                  "endpoints": list(self._pservers),
                  "mode": "sparse_grad", "trainer_id": self._trainer_id,
+                 # sync mode: N trainers' immediate row pushes must
+                 # average like the dense _push_sync fanin, not step N x
+                 # (reference pserver merges sparse grads before apply)
+                 "grad_scale": (1.0 / self._trainers
+                                if self.config.sync_mode else 1.0),
                  OpRole.KEY: OpRole.RPC})
         if param_names:
             self._append_ps_graph_ops(block, block, grad_names,

@@ -45,7 +45,7 @@ def _nms_fixed(boxes, scores, iou_threshold, max_out, score_threshold):
         live, idx, kept = carry
         j = jnp.argmax(live)
         ok = live[j] > neg / 2
-        idx = idx.at[i].set(jnp.where(ok, j, -1))
+        idx = idx.at[i].set(jnp.where(ok, j, -1).astype(jnp.int32))
         kept = kept.at[i].set(jnp.where(ok, live[j], -1.0))
         iou = _iou(boxes[j], boxes)
         live = jnp.where((iou >= iou_threshold) | (jnp.arange(M) == j),

@@ -1,0 +1,1392 @@
+"""Detection op tail — static-shape TPU redesigns of the remaining
+/root/reference/paddle/fluid/operators/detection/ ops (matrix_nms_op.cc,
+locality_aware_nms_op.cc, retinanet_detection_output_op.cc,
+rpn_target_assign_op.cc, target_assign_op.h, mine_hard_examples_op.cc,
+collect_fpn_proposals_op.cc, distribute_fpn_proposals_op.cc,
+box_decoder_and_assign_op.h, polygon_box_transform_op.cc,
+generate_proposal_labels_op.cc, generate_mask_labels_op.cc) plus
+psroi_pool_op.h, prroi_pool_op.h, roi_perspective_transform_op.cc and
+detection_map_op.cc from operators/.
+
+Same contract as detection.py: the reference emits LoD outputs with
+data-dependent row counts; here every op returns FIXED-size outputs padded
+with sentinel rows (-1 index / -1 label / zero box) plus an explicit count
+tensor, so the whole graph stays one XLA computation.  Selection loops are
+`lax.fori_loop`/`top_k` with fixed trip counts; the pooling ops are phrased
+as einsums over per-bin weight matrices so they land on the MXU instead of
+gather-heavy scalar code.  Only the two inherently host-side ops
+(polygon-mask rasterisation, stateful mAP accumulation) go through
+jax.pure_callback, mirroring the reference's CPU-only kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op
+from .detection import _iou, _nms_fixed
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _pairwise_iou(a, b, normalized=True):
+    """IoU matrix of [M,4] x [G,4] boxes. normalized=False adds +1 to
+    widths/heights (pixel-box convention, bbox_util.h JaccardOverlap)."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + off, 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def _box_to_delta(ex, gt, weights=None, normalized=False):
+    """Encode gt boxes against example boxes (bbox_util.h:54 BoxToDelta)."""
+    off = 0.0 if normalized else 1.0
+    ew = ex[..., 2] - ex[..., 0] + off
+    eh = ex[..., 3] - ex[..., 1] + off
+    ecx = ex[..., 0] + 0.5 * ew
+    ecy = ex[..., 1] + 0.5 * eh
+    gw = gt[..., 2] - gt[..., 0] + off
+    gh = gt[..., 3] - gt[..., 1] + off
+    gcx = gt[..., 0] + 0.5 * gw
+    gcy = gt[..., 1] + 0.5 * gh
+    d = jnp.stack([(gcx - ecx) / jnp.maximum(ew, 1e-10),
+                   (gcy - ecy) / jnp.maximum(eh, 1e-10),
+                   jnp.log(jnp.maximum(gw, 1e-10) / jnp.maximum(ew, 1e-10)),
+                   jnp.log(jnp.maximum(gh, 1e-10) / jnp.maximum(eh, 1e-10))],
+                  axis=-1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)
+    return d
+
+
+def _random_topk_mask(key, eligible, k):
+    """Pick up to k True positions of `eligible` uniformly at random (the
+    XLA analog of the reference's ReservoirSampling): random priority keys
+    on the eligible set, prefix of the sorted order.  With key=None picks
+    the lowest indices (the deterministic use_random=False path, matching
+    the reference's unshuffled resize).  k may be a traced scalar.
+    Returns a bool mask."""
+    n = eligible.shape[0]
+    if key is None:
+        pri = jnp.where(eligible,
+                        -jnp.arange(n, dtype=jnp.float32), -jnp.inf)
+    else:
+        pri = jnp.where(eligible,
+                        jax.random.uniform(key, (n,)), -jnp.inf)
+    k_arr = jnp.minimum(jnp.asarray(k, jnp.int32),
+                        jnp.sum(eligible).astype(jnp.int32))
+    _, idx = jax.lax.top_k(pri, n)
+    sel = jnp.zeros((n,), bool).at[idx].set(jnp.arange(n) < k_arr)
+    return sel & eligible
+
+
+# ---------------------------------------------------------------------------
+# matrix_nms — parallel soft-NMS (matrix_nms_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("matrix_nms", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "Index?", "RoisNum?"], grad=None)
+def matrix_nms(ins, attrs, ctx):
+    """matrix_nms_op.cc — NMSMatrix: per class, sort top nms_top_k by
+    score, decay each score by min_j decay(iou_ij, max_iou_j) (gaussian or
+    linear), keep decayed > post_threshold; cross-class top keep_top_k.
+    Unlike greedy NMS the decay is a closed-form matrix computation — it
+    maps to dense [K,K] math on the MXU with no sequential loop at all.
+    BBoxes [N,M,4], Scores [N,C,M] -> Out [N,keep,6], Index [N,keep,1],
+    RoisNum [N]."""
+    boxes = jnp.asarray(ins["BBoxes"])
+    scores = jnp.asarray(ins["Scores"])
+    score_thr = attrs.get("score_threshold", 0.0)
+    post_thr = attrs.get("post_threshold", 0.0)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    bg = attrs.get("background_label", 0)
+    use_gaussian = bool(attrs.get("use_gaussian", False))
+    sigma = attrs.get("gaussian_sigma", 2.0)
+    normalized = bool(attrs.get("normalized", True))
+    N, C, M = scores.shape
+    K = min(nms_top_k if nms_top_k > 0 else M, M)
+    if keep_top_k < 0:
+        keep_top_k = C * K
+
+    def one_class(bx, sc):
+        # top-K by score; dead entries (score <= threshold) get -inf keys
+        live = jnp.where(sc > score_thr, sc, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(live, K)
+        valid = jnp.isfinite(top_s)
+        b = bx[top_i]
+        iou = _pairwise_iou(b, b, normalized)          # [K, K] sorted order
+        tril = jnp.tril(jnp.ones((K, K), bool), k=-1)  # j < i
+        iou_l = jnp.where(tril, iou, 0.0)
+        # iou_max[j] = max IoU of box j vs higher-scored boxes (j'<j)
+        iou_max = jnp.max(iou_l, axis=1)
+        if use_gaussian:
+            decay = jnp.exp((iou_max[None, :] ** 2 - iou_l ** 2) * sigma)
+        else:
+            decay = (1.0 - iou_l) / jnp.maximum(1.0 - iou_max[None, :],
+                                                1e-10)
+        decay = jnp.where(tril, decay, 1.0)
+        min_decay = jnp.min(decay, axis=1)
+        ds = min_decay * jnp.where(valid, top_s, 0.0)
+        keep = valid & (ds > post_thr)
+        return jnp.where(keep, ds, -1.0), top_i, keep
+
+    def one_image(bx, sc):
+        if bg >= 0:
+            sc = sc.at[bg].set(-jnp.inf)
+        ds, idx, keep = jax.vmap(lambda s: one_class(bx, s))(sc)  # [C,K]
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, K))
+        flat_s = jnp.where(keep, ds, -1.0).reshape(-1)
+        flat_i = idx.reshape(-1)
+        flat_l = labels.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        top_s, sel = jax.lax.top_k(flat_s, k)
+        live = top_s >= 0
+        out = jnp.concatenate(
+            [jnp.where(live, flat_l[sel], -1).astype(bx.dtype)[:, None],
+             top_s[:, None],
+             jnp.where(live[:, None], bx[flat_i[sel]], 0.0)], axis=1)
+        index = jnp.where(live, flat_i[sel], -1).astype(jnp.int32)
+        return out, index, jnp.sum(live).astype(jnp.int32)
+
+    out, index, num = jax.vmap(one_image)(boxes, scores)
+    return {"Out": out, "Index": index[..., None], "RoisNum": num}
+
+
+# ---------------------------------------------------------------------------
+# locality_aware_nms (locality_aware_nms_op.cc — EAST text detection)
+# ---------------------------------------------------------------------------
+
+@register_op("locality_aware_nms", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "RoisNum?"], grad=None)
+def locality_aware_nms(ins, attrs, ctx):
+    """locality_aware_nms_op.cc — a sequential scan first merges runs of
+    consecutive overlapping boxes (score-weighted average, scores summed),
+    then standard greedy NMS + cross-class keep_top_k.  The merge pass is
+    order-dependent by definition, so it is a lax.scan over the M boxes
+    (M is a compile-time constant); axis-aligned 4-coord boxes only (the
+    reference's polygon path rides the descoped gpc/poly_util)."""
+    boxes = jnp.asarray(ins["BBoxes"])
+    scores = jnp.asarray(ins["Scores"])
+    score_thr = attrs.get("score_threshold", 0.0)
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    bg = attrs.get("background_label", -1)
+    normalized = bool(attrs.get("normalized", True))
+    N, C, M = scores.shape
+    per_cls = min(nms_top_k if nms_top_k > 0 else M, M)
+    if keep_top_k < 0:
+        keep_top_k = C * per_cls
+
+    def merge_pass(bx, sc):
+        """Scan boxes in input order; merge box i into the running box when
+        IoU > nms_thr, else emit the running box.  Emitted rows are written
+        back at the running box's index; swallowed rows get score 0."""
+        off = 0.0 if normalized else 1.0
+
+        def iou1(a, b):
+            lt = jnp.maximum(a[:2], b[:2])
+            rb = jnp.minimum(a[2:], b[2:])
+            wh = jnp.maximum(rb - lt + off, 0)
+            inter = wh[0] * wh[1]
+            aa = jnp.maximum(a[2] - a[0] + off, 0) * \
+                jnp.maximum(a[3] - a[1] + off, 0)
+            ab = jnp.maximum(b[2] - b[0] + off, 0) * \
+                jnp.maximum(b[3] - b[1] + off, 0)
+            return jnp.where(aa + ab - inter > 0,
+                             inter / jnp.maximum(aa + ab - inter, 1e-10), 0.0)
+
+        def step(carry, i):
+            cur_box, cur_s, wp, out_b, out_s = carry
+            b, s = bx[i], sc[i]
+            ov = iou1(b, cur_box)
+            merge = (cur_s > 0) & (ov > nms_thr)
+            # weighted merge (PolyWeightedMerge): new = (b*s + cur*cur_s)/(s+cur_s)
+            m_box = (b * s + cur_box * cur_s) / jnp.maximum(s + cur_s, 1e-10)
+            m_s = cur_s + s
+            # on no-merge: flush the finished run at the write cursor
+            # (wp <= i always, merges only shrink the emitted count)
+            flush = (~merge) & (cur_s > 0)
+            out_b = jnp.where(flush, out_b.at[wp].set(cur_box), out_b)
+            out_s = jnp.where(flush, out_s.at[wp].set(cur_s), out_s)
+            wp = wp + flush.astype(jnp.int32)
+            cur_box = jnp.where(merge, m_box, b)
+            cur_s = jnp.where(merge, m_s, s)
+            return (cur_box, cur_s, wp, out_b, out_s), None
+
+        init = (jnp.zeros((4,), bx.dtype), jnp.zeros((), sc.dtype),
+                jnp.zeros((), jnp.int32), jnp.zeros_like(bx),
+                jnp.zeros_like(sc))
+        (cur_box, cur_s, wp, out_b, out_s), _ = jax.lax.scan(
+            step, init, jnp.arange(M))
+        # flush the trailing run
+        out_b = jnp.where(cur_s > 0, out_b.at[wp].set(cur_box), out_b)
+        out_s = jnp.where(cur_s > 0, out_s.at[wp].set(cur_s), out_s)
+        return out_b, out_s
+
+    def one_class(bx, sc):
+        mb, ms = merge_pass(bx, sc)
+        idx, kept = _nms_fixed(mb, jnp.where(ms > score_thr, ms, -1e30),
+                               nms_thr, per_cls, score_thr)
+        sel = jnp.where(idx[:, None] >= 0, mb[jnp.maximum(idx, 0)], 0.0)
+        return kept, sel
+
+    def one_image(bx, sc):
+        if bg >= 0:
+            sc = sc.at[bg].set(0.0)
+        kept, sel = jax.vmap(lambda s: one_class(bx, s))(sc)
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, per_cls))
+        flat_s = kept.reshape(-1)
+        flat_b = sel.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        top_s, sel_i = jax.lax.top_k(flat_s, k)
+        live = top_s >= 0
+        out = jnp.concatenate(
+            [jnp.where(live, flat_l[sel_i], -1).astype(bx.dtype)[:, None],
+             jnp.maximum(top_s, -1.0)[:, None], flat_b[sel_i]], axis=1)
+        return out, jnp.sum(live).astype(jnp.int32)
+
+    out, num = jax.vmap(one_image)(boxes, scores)
+    return {"Out": out, "RoisNum": num}
+
+
+# ---------------------------------------------------------------------------
+# retinanet_detection_output (retinanet_detection_output_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("retinanet_detection_output",
+             inputs=["BBoxes*", "Scores*", "Anchors*", "ImInfo"],
+             outputs=["Out", "RoisNum?"], grad=None)
+def retinanet_detection_output(ins, attrs, ctx):
+    """retinanet_detection_output_op.cc — per FPN level: flatten [A,C]
+    sigmoid scores, take top nms_top_k above score_threshold, decode those
+    anchors (variance-free, +1 pixel widths, /im_scale, clip to the
+    un-scaled image); concat levels, per-class greedy NMS, cross-class top
+    keep_top_k.  BBoxes/Scores/Anchors are per-level lists:
+    BBoxes[l] [N,A_l,4], Scores[l] [N,A_l,C] -> Out [N,keep,6]."""
+    bboxes = [jnp.asarray(b) for b in ins["BBoxes"]]
+    scores = [jnp.asarray(s) for s in ins["Scores"]]
+    anchors = [jnp.asarray(a) for a in ins["Anchors"]]
+    im_info = jnp.asarray(ins["ImInfo"])
+    score_thr = attrs.get("score_threshold", 0.05)
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    C = scores[0].shape[-1]
+
+    def decode_level(deltas, anc, info):
+        """RetinanetDetectionOutput DeltaScoreToPrediction: +1 widths,
+        no variances, /im_scale, clip to round(im/scale)-1."""
+        ih = jnp.round(info[0] / info[2])
+        iw = jnp.round(info[1] / info[2])
+        aw = anc[:, 2] - anc[:, 0] + 1
+        ah = anc[:, 3] - anc[:, 1] + 1
+        acx = anc[:, 0] + aw / 2
+        acy = anc[:, 1] + ah / 2
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+        box = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=1) / info[2]
+        hi = jnp.stack([iw - 1, ih - 1, iw - 1, ih - 1])
+        return jnp.clip(box, 0.0, hi)
+
+    # loop over batch (N is small for detection inference); per level take
+    # top nms_top_k candidates over the flattened [A*C] score grid
+    N = scores[0].shape[0]
+    outs, nums = [], []
+    for n in range(N):
+        bx_l = []
+        sc_l = []
+        for l in range(len(scores)):
+            A = scores[l].shape[1]
+            flat = jnp.where(scores[l][n] > score_thr, scores[l][n],
+                             -jnp.inf).reshape(-1)
+            k = min(nms_top_k, A * C)
+            top_s, top_i = jax.lax.top_k(flat, k)
+            a_idx = top_i // C
+            c_idx = top_i % C
+            boxes = decode_level(bboxes[l][n][a_idx], anchors[l][a_idx],
+                                 im_info[n])
+            bx_l.append((boxes,
+                         jnp.where(jnp.isfinite(top_s), top_s, -1.0),
+                         c_idx))
+        b = jnp.concatenate([t[0] for t in bx_l])
+        s = jnp.concatenate([t[1] for t in bx_l])
+        c = jnp.concatenate([t[2] for t in bx_l])
+        per_cls = min(keep_top_k, b.shape[0])
+
+        def one_class(cls, b=b, s=s, c=c, per_cls=per_cls):
+            cs = jnp.where((c == cls) & (s > 0), s, -1e30)
+            idx, kept = _nms_fixed(b, cs, nms_thr, per_cls, 0.0)
+            sel = jnp.where(idx[:, None] >= 0, b[jnp.maximum(idx, 0)], 0.0)
+            return kept, sel
+
+        kept, sel = jax.vmap(one_class)(jnp.arange(C))
+        labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, per_cls))
+        flat_s = kept.reshape(-1)
+        flat_b = sel.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        k = min(keep_top_k, flat_s.shape[0])
+        top_s, sel_i = jax.lax.top_k(flat_s, k)
+        live = top_s >= 0
+        outs.append(jnp.concatenate(
+            [jnp.where(live, flat_l[sel_i], -1).astype(b.dtype)[:, None],
+             jnp.maximum(top_s, -1.0)[:, None], flat_b[sel_i]], axis=1))
+        nums.append(jnp.sum(live).astype(jnp.int32))
+    return {"Out": jnp.stack(outs), "RoisNum": jnp.stack(nums)}
+
+
+# ---------------------------------------------------------------------------
+# target_assign (target_assign_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("target_assign",
+             inputs=["X", "MatchIndices!", "NegIndices?!"],
+             outputs=["Out", "OutWeight"], grad=None)
+def target_assign(ins, attrs, ctx):
+    """target_assign_op.h — scatter per-image gt rows onto prior slots by
+    MatchIndices.  The reference's X is a LoD tensor [sum_gt, P, K]; the
+    padded redesign takes X [N, B, K] (per-image gt rows, zero-padded).
+    Out[n, m] = X[n, MatchIndices[n, m]] where matched (weight 1), else
+    mismatch_value (weight 0).  NegIndices [N, M'] (-1 padded) zeroes the
+    listed prior slots to mismatch_value with weight 1."""
+    x = jnp.asarray(ins["X"])                      # [N, B, K] or [N, B] -> K=1
+    mi = jnp.asarray(ins["MatchIndices"])          # [N, M] int32, -1 = unmatched
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[..., None]
+    mismatch = attrs.get("mismatch_value", 0)
+    matched = mi >= 0
+    gathered = jnp.take_along_axis(
+        x, jnp.maximum(mi, 0)[..., None], axis=1)
+    out = jnp.where(matched[..., None], gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = matched.astype(jnp.float32)
+    neg = ins.get("NegIndices")
+    if neg is not None:
+        neg = jnp.asarray(neg)
+    if neg is not None:
+        # rows listed in NegIndices: out = mismatch_value, weight = 1
+        M = mi.shape[1]
+        neg_mask = jnp.zeros(mi.shape, bool)
+        valid = neg >= 0
+        n_idx = jnp.broadcast_to(
+            jnp.arange(mi.shape[0])[:, None], neg.shape)
+        neg_mask = neg_mask.at[n_idx, jnp.clip(neg, 0, M - 1)].max(valid)
+        out = jnp.where(neg_mask[..., None],
+                        jnp.asarray(mismatch, x.dtype), out)
+        wt = jnp.where(neg_mask, 1.0, wt)
+    if squeeze:
+        out = out[..., 0]
+    return {"Out": out, "OutWeight": wt[..., None]}
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples (mine_hard_examples_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("mine_hard_examples",
+             inputs=["ClsLoss!", "LocLoss?!", "MatchIndices!", "MatchDist!"],
+             outputs=["NegIndices", "UpdatedMatchIndices", "NegNum?"],
+             grad=None)
+def mine_hard_examples(ins, attrs, ctx):
+    """mine_hard_examples_op.cc — OHEM for SSD.  max_negative: among
+    unmatched priors with match_dist < neg_dist_threshold, take the
+    neg_pos_ratio * #positives highest-cls-loss ones as negatives.
+    hard_example: rank ALL priors by cls+loc loss, keep top sample_size;
+    positives outside the kept set get match index -1.  NegIndices is
+    [N, M] -1-padded (reference: LoD rows) + NegNum counts; indices are
+    emitted in ascending prior order (the reference sorts the selected
+    set)."""
+    cls_loss = jnp.asarray(ins["ClsLoss"])
+    loc_loss = ins.get("LocLoss")
+    if loc_loss is not None:
+        loc_loss = jnp.asarray(loc_loss)
+    mi = jnp.asarray(ins["MatchIndices"])
+    dist = jnp.asarray(ins["MatchDist"])
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_dist_thr = attrs.get("neg_dist_threshold", 0.5)
+    sample_size = int(attrs.get("sample_size", 0))
+    mining = attrs.get("mining_type", "max_negative")
+    N, M = mi.shape
+
+    loss = cls_loss
+    if mining == "hard_example" and loc_loss is not None:
+        loss = cls_loss + loc_loss
+
+    def one(loss_r, mi_r, dist_r):
+        if mining == "max_negative":
+            eligible = (mi_r == -1) & (dist_r < neg_dist_thr)
+            num_pos = jnp.sum(mi_r != -1)
+            neg_sel = jnp.minimum((num_pos * ratio).astype(jnp.int32),
+                                  jnp.sum(eligible).astype(jnp.int32))
+        else:  # hard_example
+            eligible = jnp.ones((M,), bool)
+            neg_sel = jnp.minimum(sample_size if sample_size > 0 else M,
+                                  M)
+            neg_sel = jnp.asarray(neg_sel, jnp.int32)
+        key = jnp.where(eligible, loss_r, -jnp.inf)
+        _, order = jax.lax.top_k(key, M)
+        sel_mask = jnp.zeros((M,), bool).at[order].set(
+            (jnp.arange(M) < neg_sel) & jnp.isfinite(key[order]))
+        if mining == "hard_example":
+            upd = jnp.where((mi_r > -1) & ~sel_mask, -1, mi_r)
+            neg_mask = sel_mask & (mi_r == -1)
+        else:
+            upd = mi_r
+            neg_mask = sel_mask
+        # ascending prior order, -1 padded
+        pos = jnp.where(neg_mask, jnp.arange(M), M)
+        srt = jnp.sort(pos)
+        neg_idx = jnp.where(srt < M, srt, -1).astype(jnp.int32)
+        return neg_idx, upd, jnp.sum(neg_mask).astype(jnp.int32)
+
+    neg_idx, upd, nn = jax.vmap(one)(loss, mi, dist)
+    return {"NegIndices": neg_idx, "UpdatedMatchIndices": upd,
+            "NegNum": nn}
+
+
+# ---------------------------------------------------------------------------
+# collect_fpn_proposals / distribute_fpn_proposals
+# ---------------------------------------------------------------------------
+
+@register_op("collect_fpn_proposals",
+             inputs=["MultiLevelRois*", "MultiLevelScores*",
+                     "MultiLevelRoIsNum*?!"],
+             outputs=["FpnRois", "RoisNum?"], grad=None)
+def collect_fpn_proposals(ins, attrs, ctx):
+    """collect_fpn_proposals_op.cc — concat per-level RoIs+scores, keep the
+    global top post_nms_topN by score.  Padded redesign: each level is
+    [N, R_l, 4] with scores [N, R_l] (dead rows score -1); output
+    [N, post_nms_topN, 4] + live count."""
+    rois_l = [jnp.asarray(r) for r in ins["MultiLevelRois"]]
+    scores_l = [jnp.asarray(s) for s in ins["MultiLevelScores"]]
+    post_n = int(attrs.get("post_nms_topN", 100))
+    rois = jnp.concatenate(rois_l, axis=1)          # [N, R, 4]
+    scores = jnp.concatenate(
+        [s.reshape(s.shape[0], -1) for s in scores_l], axis=1)
+
+    def one(r, s):
+        k = min(post_n, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k)
+        live = top_s > -0.5
+        out = jnp.where(live[:, None], r[top_i], 0.0)
+        return out, jnp.sum(live).astype(jnp.int32)
+
+    out, num = jax.vmap(one)(rois, scores)
+    return {"FpnRois": out, "RoisNum": num}
+
+
+@register_op("distribute_fpn_proposals",
+             inputs=["FpnRois", "RoisNum?!"],
+             outputs=["MultiFpnRois*", "RestoreIndex",
+                      "MultiLevelRoIsNum*?"], grad=None)
+def distribute_fpn_proposals(ins, attrs, ctx):
+    """distribute_fpn_proposals_op.cc — route each RoI to FPN level
+    floor(refer_level + log2(sqrt(area)/refer_scale)), clamped to
+    [min_level, max_level].  Per-level outputs are fixed [N, R, 4] padded
+    (a RoI keeps its batch row; rows not on the level are zero), plus
+    RestoreIndex mapping the concatenated per-level order back to input
+    order.  RoisNum [N] marks live rows of FpnRois [N, R, 4] (area<=0 rows
+    are dead padding)."""
+    rois = jnp.asarray(ins["FpnRois"])            # [N, R, 4]
+    if rois.ndim == 2:
+        rois = rois[None]
+    min_l = int(attrs.get("min_level", 2))
+    max_l = int(attrs.get("max_level", 5))
+    refer_l = int(attrs.get("refer_level", 4))
+    refer_s = int(attrs.get("refer_scale", 224))
+    n_levels = max_l - min_l + 1
+    N, R, _ = rois.shape
+    num = ins.get("RoisNum")
+    if num is not None:
+        num = jnp.asarray(num)
+
+    w = rois[..., 2] - rois[..., 0]
+    h = rois[..., 3] - rois[..., 1]
+    live = (w > 0) & (h > 0)
+    if num is not None:
+        live = live & (jnp.arange(R)[None, :] < num[:, None])
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(refer_l + jnp.log2(scale / refer_s + 1e-8))
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    lvl = jnp.where(live, lvl, -1)
+
+    multi = []
+    nums = []
+    for li in range(min_l, max_l + 1):
+        on = lvl == li
+        multi.append(jnp.where(on[..., None], rois, 0.0))
+        nums.append(jnp.sum(on, axis=1).astype(jnp.int32))
+    # RestoreIndex: position of each input RoI in the concatenated
+    # per-level live-row ordering (reference: argsort of the gather order).
+    # Our padded layout keeps rows in place, so restore is the stable
+    # argsort by (level, row) over live rows.
+    def one(lv):
+        order_key = jnp.where(lv >= 0, lv * (R + 1), n_levels * (R + 1)) \
+            + jnp.arange(R)
+        order = jnp.argsort(order_key)           # concat order -> input row
+        restore = jnp.argsort(order)             # input row -> concat pos
+        return order.astype(jnp.int32), restore.astype(jnp.int32)
+
+    order, restore = jax.vmap(one)(lvl)
+    return {"MultiFpnRois": multi, "RestoreIndex": restore[..., None],
+            "MultiLevelRoIsNum": nums}
+
+
+# ---------------------------------------------------------------------------
+# box_decoder_and_assign (box_decoder_and_assign_op.h)
+# ---------------------------------------------------------------------------
+
+@register_op("box_decoder_and_assign",
+             inputs=["PriorBox!", "PriorBoxVar!", "TargetBox", "BoxScore"],
+             outputs=["DecodeBox", "OutputAssignBox"], grad=None)
+def box_decoder_and_assign(ins, attrs, ctx):
+    """box_decoder_and_assign_op.h — decode per-class deltas against prior
+    boxes (+1 pixel widths, var-scaled, exp clipped at box_clip), then
+    assign each RoI the decoded box of its argmax non-background class
+    (falls back to the prior box when no positive class wins)."""
+    prior = jnp.asarray(ins["PriorBox"])    # [R, 4]
+    var = jnp.asarray(ins["PriorBoxVar"])   # [4]
+    target = jnp.asarray(ins["TargetBox"])  # [R, C*4]
+    score = jnp.asarray(ins["BoxScore"])    # [R, C]
+    clip = attrs.get("box_clip", 2.302585)  # ln(10)
+    R, C = score.shape
+    d = target.reshape(R, C, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1
+    ph = prior[:, 3] - prior[:, 1] + 1
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    dw = jnp.minimum(var[2] * d[..., 2], clip)
+    dh = jnp.minimum(var[3] * d[..., 3], clip)
+    cx = var[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = var[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)
+    # argmax over classes j>0 (background 0 excluded)
+    sc = score.at[:, 0].set(-jnp.inf)
+    best = jnp.argmax(sc, axis=1)
+    assign = jnp.where((best > 0)[:, None],
+                       jnp.take_along_axis(
+                           decoded, best[:, None, None].repeat(4, 2),
+                           axis=1)[:, 0], prior)
+    return {"DecodeBox": decoded.reshape(R, C * 4),
+            "OutputAssignBox": assign}
+
+
+# ---------------------------------------------------------------------------
+# polygon_box_transform (polygon_box_transform_op.cc — EAST geometry head)
+# ---------------------------------------------------------------------------
+
+@register_op("polygon_box_transform", inputs=["Input"],
+             outputs=["Output"], grad=None)
+def polygon_box_transform(ins, attrs, ctx):
+    """polygon_box_transform_op.cc — convert EAST per-pixel offsets to
+    absolute quad coords: even channels (x offsets) -> 4*w - v, odd
+    channels (y offsets) -> 4*h - v."""
+    x = jnp.asarray(ins["Input"])                 # [N, G, H, W], G even
+    N, G, H, W = x.shape
+    ww = jnp.arange(W, dtype=x.dtype) * 4
+    hh = jnp.arange(H, dtype=x.dtype)[:, None] * 4
+    even = jnp.arange(G) % 2 == 0
+    out = jnp.where(even[None, :, None, None], ww - x, hh - x)
+    return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# psroi_pool (psroi_pool_op.h) — position-sensitive RoI average pooling
+# ---------------------------------------------------------------------------
+
+def _bin_weights(start, end, size):
+    """[P] bin [start_p, end_p) -> 0/1 overlap weights over `size` integer
+    cells: w[p, i] = 1 if floor-start <= i < ceil-end (after clipping)."""
+    i = jnp.arange(size, dtype=jnp.float32)
+    lo = jnp.clip(jnp.floor(start), 0, size)
+    hi = jnp.clip(jnp.ceil(end), 0, size)
+    return ((i[None, :] >= lo[:, None]) &
+            (i[None, :] < hi[:, None])).astype(jnp.float32)
+
+
+@register_op("psroi_pool", inputs=["X", "ROIs!", "RoisNum?!"],
+             outputs=["Out"])
+def psroi_pool(ins, attrs, ctx):
+    """psroi_pool_op.h — R-FCN position-sensitive average pooling: output
+    channel c at bin (ph,pw) averages input channel (c*PH+ph)*PW+pw over
+    the bin's cells.  Phrased as two einsum contractions over per-bin 0/1
+    weight vectors so it's dense MXU math instead of per-cell gathers;
+    empty bins produce 0 (reference: is_empty -> 0).  ROIs are [R, 5]
+    (batch_idx, x1, y1, x2, y2) — the LoD batch mapping carried as an
+    explicit leading column in the padded redesign."""
+    x = jnp.asarray(ins["X"])        # [N, C_in, H, W]
+    rois = jnp.asarray(ins["ROIs"])  # [R, 5]
+    ph_n = int(attrs.get("pooled_height", 7))
+    pw_n = int(attrs.get("pooled_width", 7))
+    scale = attrs.get("spatial_scale", 1.0)
+    out_c = int(attrs.get("output_channels"))
+    N, C_in, H, W = x.shape
+    assert C_in == out_c * ph_n * pw_n, \
+        f"psroi_pool: channels {C_in} != {out_c}*{ph_n}*{pw_n}"
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph_n, rw / pw_n
+        hs = jnp.arange(ph_n) * bh + y1
+        he = (jnp.arange(ph_n) + 1) * bh + y1
+        ws = jnp.arange(pw_n) * bw + x1
+        we = (jnp.arange(pw_n) + 1) * bw + x1
+        wy = _bin_weights(hs, he, H)             # [PH, H]
+        wx = _bin_weights(ws, we, W)             # [PW, W]
+        cnt = jnp.einsum("ph,qw->pq", wy, wx)    # cells per bin
+        feat = x[b].reshape(out_c, ph_n, pw_n, H, W)
+        # each output bin reads ITS OWN input channel slice
+        s = jnp.einsum("cpqhw,ph,qw->cpq", feat, wy, wx)
+        return s / jnp.maximum(cnt, 1.0) * (cnt > 0)
+
+    out = jax.vmap(one)(rois)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# prroi_pool (prroi_pool_op.h) — precise RoI pooling (integral of bilinear)
+# ---------------------------------------------------------------------------
+
+def _hat_integral(a, b, size):
+    """[P] windows [a_p, b_p] -> integral of the unit hat function centred
+    at each integer cell i over the window: w[p, i] = ∫_{a_p}^{b_p}
+    max(0, 1-|x-i|) dx, in closed form via the hat antiderivative.  The 2-D
+    integral of a bilinear interpolant over a box separates into a product
+    of these 1-D terms (prroi_pool_op.h PrRoIPoolingMatCalculation computes
+    the same quantity cell-by-cell)."""
+    i = jnp.arange(size, dtype=jnp.float32)
+
+    def G(t):
+        # antiderivative of hat_i evaluated at t: 0 below i-1, quadratics
+        # on [i-1,i] and [i,i+1], 1 above
+        u = jnp.clip(t[:, None] - (i[None, :] - 1.0), 0.0, 1.0)
+        v = jnp.clip(t[:, None] - i[None, :], 0.0, 1.0)
+        return 0.5 * u * u + v - 0.5 * v * v
+
+    return G(b) - G(a)
+
+
+@register_op("prroi_pool", inputs=["X", "ROIs!", "BatchRoINums?!"],
+             outputs=["Out"])
+def prroi_pool(ins, attrs, ctx):
+    """prroi_pool_op.h — Precise RoI Pooling (PrRoI): each output bin is
+    the exact integral of the bilinearly-interpolated feature over the bin
+    divided by the bin area.  The bilinear interpolant is a sum of
+    separable hat functions, so the 2-D integral collapses to
+    out[c,p,q] = Σ_h Σ_w f[c,h,w]·Iy[p,h]·Ix[q,w] / area — two dense
+    contractions on the MXU.  Differentiable (auto-vjp gives the exact
+    continuous gradient, matching the paper's key property).  ROIs [R, 5]
+    with leading batch index."""
+    x = jnp.asarray(ins["X"])
+    rois = jnp.asarray(ins["ROIs"])
+    ph_n = int(attrs.get("pooled_height", 7))
+    pw_n = int(attrs.get("pooled_width", 7))
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1 = roi[1] * scale, roi[2] * scale
+        x2, y2 = roi[3] * scale, roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bw, bh = rw / pw_n, rh / ph_n
+        ws = jnp.arange(pw_n) * bw + x1
+        we = ws + bw
+        hs = jnp.arange(ph_n) * bh + y1
+        he = hs + bh
+        Ix = _hat_integral(ws, we, W)            # [PW, W]
+        Iy = _hat_integral(hs, he, H)            # [PH, H]
+        area = jnp.maximum(bw * bh, 1e-10)
+        return jnp.einsum("chw,ph,qw->cpq", x[b], Iy, Ix) / area
+
+    return {"Out": jax.vmap(one)(rois)}
+
+
+# ---------------------------------------------------------------------------
+# roi_perspective_transform (roi_perspective_transform_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("roi_perspective_transform", inputs=["X", "ROIs!"],
+             outputs=["Out", "Mask?", "TransformMatrix?",
+                      "Out2InIdx?", "Out2InWeights?"])
+def roi_perspective_transform(ins, attrs, ctx):
+    """roi_perspective_transform_op.cc — warp a quad RoI (8 coords:
+    x0..y3 clockwise from top-left) to a [transformed_h, transformed_w]
+    rectangle by the estimated perspective matrix, bilinear sampling, 0
+    outside the image.  Mask marks output cells inside the normalized quad
+    extent.  ROIs [R, 9]: (batch_idx, x0, y0, ..., x3, y3)."""
+    x = jnp.asarray(ins["X"])        # [N, C, H, W]
+    rois = jnp.asarray(ins["ROIs"])  # [R, 9]
+    th = int(attrs.get("transformed_height"))
+    tw = int(attrs.get("transformed_width"))
+    scale = attrs.get("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+
+    def one(roi):
+        b = roi[0].astype(jnp.int32)
+        rx = roi[1::2] * scale       # [4]
+        ry = roi[2::2] * scale
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        norm_h = max(2, th)
+        norm_w_f = jnp.round(est_w * (norm_h - 1) /
+                             jnp.maximum(est_h, 1e-5)) + 1
+        norm_w = jnp.clip(norm_w_f, 2, tw)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+        m8 = jnp.asarray(1.0, x.dtype)
+        m3 = (y1 - y0 + m6 * (norm_w - 1) * y1) / (norm_w - 1)
+        m4 = (y3 - y0 + m7 * (norm_h - 1) * y3) / (norm_h - 1)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (norm_w - 1) * x1) / (norm_w - 1)
+        m1 = (x3 - x0 + m7 * (norm_h - 1) * x3) / (norm_h - 1)
+        m2 = x0
+        matrix = jnp.stack([m0, m1, m2, m3, m4, m5, m6, m7, m8])
+        # output grid -> input coords
+        oy = jnp.arange(th, dtype=x.dtype)
+        ox = jnp.arange(tw, dtype=x.dtype)
+        OX, OY = jnp.meshgrid(ox, oy)            # [th, tw]
+        wdn = m6 * OX + m7 * OY + m8
+        ix = (m0 * OX + m1 * OY + m2) / wdn
+        iy = (m3 * OX + m4 * OY + m5) / wdn
+        in_quad = (OX <= norm_w - 1) & (OY <= norm_h - 1)
+        inside = (ix > -0.5) & (ix < W - 0.5) & \
+            (iy > -0.5) & (iy < H - 0.5) & in_quad
+        # bilinear sample (0 padding outside)
+        x_f = jnp.floor(ix)
+        y_f = jnp.floor(iy)
+        ax_ = ix - x_f
+        ay = iy - y_f
+
+        def tap(yy, xx):
+            ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            v = x[b][:, jnp.clip(yy, 0, H - 1).astype(jnp.int32),
+                     jnp.clip(xx, 0, W - 1).astype(jnp.int32)]
+            return jnp.where(ok, v, 0.0)
+
+        v = (tap(y_f, x_f) * (1 - ax_) * (1 - ay) +
+             tap(y_f, x_f + 1) * ax_ * (1 - ay) +
+             tap(y_f + 1, x_f) * (1 - ax_) * ay +
+             tap(y_f + 1, x_f + 1) * ax_ * ay)
+        out = jnp.where(inside[None], v, 0.0)
+        return out, inside.astype(jnp.int32), matrix
+
+    out, mask, mat = jax.vmap(one)(rois)
+    return {"Out": out, "Mask": mask[:, None], "TransformMatrix": mat}
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign / retinanet_target_assign (rpn_target_assign_op.cc)
+# ---------------------------------------------------------------------------
+
+def _rpn_assign_core(anchors, gt, is_crowd, info, key,
+                     straddle_thresh, pos_overlap, neg_overlap,
+                     batch_per_im, fg_frac, use_random):
+    """Shared anchor->gt matching (rpn_target_assign_op.cc ScoreAssign,
+    Detectron convention): fg = (anchor holds some gt's max IoU) or
+    (max IoU >= pos_overlap); bg = max IoU < neg_overlap; sample
+    fg_frac*batch fg and batch-fg bg.  Returns per-anchor label (-1 ignore
+    / 0 bg / 1 fg), matched gt index, and the fg/bg masks."""
+    A = anchors.shape[0]
+    inside = jnp.ones((A,), bool)
+    if straddle_thresh >= 0:
+        inside = ((anchors[:, 0] >= -straddle_thresh) &
+                  (anchors[:, 1] >= -straddle_thresh) &
+                  (anchors[:, 2] < info[1] + straddle_thresh) &
+                  (anchors[:, 3] < info[0] + straddle_thresh))
+    gt_valid = (~(is_crowd > 0)) & \
+        ((gt[:, 2] > gt[:, 0]) | (gt[:, 3] > gt[:, 1]))
+    iou = _pairwise_iou(anchors, gt, normalized=True)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    iou = jnp.where(inside[:, None], iou, -1.0)
+    a2g_max = jnp.max(iou, axis=1)
+    a2g_arg = jnp.argmax(iou, axis=1)
+    g2a_max = jnp.max(iou, axis=0)
+    # anchor carries some gt's best overlap (within epsilon)
+    eps = 1e-5
+    is_best = jnp.any(
+        (jnp.abs(iou - g2a_max[None, :]) < eps) & gt_valid[None, :] &
+        (g2a_max[None, :] > 0), axis=1)
+    fg_cand = inside & (is_best | (a2g_max >= pos_overlap))
+    bg_cand = inside & (a2g_max < neg_overlap) & (a2g_max >= 0)
+    if batch_per_im > 0 and fg_frac > 0:
+        fg_k = int(fg_frac * batch_per_im)
+        kf = None
+        kb = None
+        if use_random and key is not None:
+            kf, kb = jax.random.split(key)
+        fg_mask = _random_topk_mask(kf if use_random else None, fg_cand,
+                                    fg_k)
+        n_fg = jnp.sum(fg_mask)
+        bg_k = batch_per_im
+        bg_mask = _random_topk_mask(kb if use_random else None, bg_cand,
+                                    jnp.asarray(batch_per_im) - n_fg)
+    else:
+        fg_mask = fg_cand
+        bg_mask = bg_cand
+    # bg overwrites fg on conflict (the reference's two-pass label write)
+    fg_mask = fg_mask & ~bg_mask
+    return fg_mask, bg_mask, a2g_arg, a2g_max
+
+
+@register_op("rpn_target_assign",
+             inputs=["Anchor!", "GtBoxes!", "IsCrowd!", "ImInfo!"],
+             outputs=["LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight", "LocCount?",
+                      "ScoreCount?"], grad=None)
+def rpn_target_assign(ins, attrs, ctx):
+    """rpn_target_assign_op.cc — sample fg/bg anchors per image and emit
+    flattened index/target arrays for the RPN losses.  Fixed-shape
+    redesign: LocationIndex/ScoreIndex are [N*rpn_batch_size_per_im]
+    padded with -1 (+ LocCount/ScoreCount live counts); indices are global
+    (i * A + anchor) like the reference's offset convention.  GtBoxes
+    [N, B, 4] zero-padded, IsCrowd [N, B] (pad rows flagged crowd)."""
+    anchors = jnp.asarray(ins["Anchor"])          # [A, 4]
+    gt = jnp.asarray(ins["GtBoxes"])              # [N, B, 4]
+    crowd = jnp.asarray(ins["IsCrowd"])           # [N, B]
+    info = jnp.asarray(ins["ImInfo"])             # [N, 3]
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    pos_ov = attrs.get("rpn_positive_overlap", 0.7)
+    neg_ov = attrs.get("rpn_negative_overlap", 0.3)
+    fg_frac = attrs.get("rpn_fg_fraction", 0.5)
+    use_random = bool(attrs.get("use_random", True))
+    N = gt.shape[0]
+    A = anchors.shape[0]
+    cap = batch_per_im if batch_per_im > 0 else A
+
+    base_key = ctx.key(attrs) if use_random else None
+
+    def one(i, gt_i, crowd_i, info_i):
+        key = None
+        if base_key is not None:
+            key = jax.random.fold_in(base_key, i)
+        fg, bg, a2g_arg, _ = _rpn_assign_core(
+            anchors, gt_i, crowd_i, info_i, key, straddle, pos_ov, neg_ov,
+            batch_per_im, fg_frac, use_random)
+        # fixed-size index lists: fg first, then bg (ScoreIndex order)
+        fg_pos = jnp.where(fg, jnp.arange(A), A)
+        fg_srt = jnp.sort(fg_pos)[:cap]
+        n_fg = jnp.sum(fg).astype(jnp.int32)
+        loc_idx = jnp.where(fg_srt < A, i * A + fg_srt, -1)
+        bg_pos = jnp.where(bg, jnp.arange(A), A)
+        bg_srt = jnp.sort(bg_pos)[:cap]
+        n_bg = jnp.sum(bg).astype(jnp.int32)
+        # score index = fg then bg, padded to cap
+        slots = jnp.arange(cap)
+        fg_part = jnp.where(slots < jnp.minimum(n_fg, cap), fg_srt, A)
+        bg_slot = slots - n_fg
+        bg_part = jnp.where((bg_slot >= 0) & (bg_slot < n_bg),
+                            bg_srt[jnp.clip(bg_slot, 0, cap - 1)], A)
+        sc_local = jnp.where(fg_part < A, fg_part, bg_part)
+        score_idx = jnp.where(sc_local < A, i * A + sc_local, -1)
+        label = jnp.where(slots < n_fg, 1,
+                          jnp.where(sc_local < A, 0, -1)).astype(jnp.int32)
+        # bbox targets for the fg slots
+        m_gt = gt_i[a2g_arg[jnp.clip(fg_srt, 0, A - 1)]]
+        m_anc = anchors[jnp.clip(fg_srt, 0, A - 1)]
+        tgt = _box_to_delta(m_anc, m_gt, normalized=False)
+        live_loc = (fg_srt < A)[:, None]
+        tgt = jnp.where(live_loc, tgt, 0.0)
+        inw = jnp.where(live_loc, 1.0, 0.0) * jnp.ones((1, 4))
+        n_score = jnp.minimum(n_fg + n_bg, cap).astype(jnp.int32)
+        return (loc_idx.astype(jnp.int32), score_idx.astype(jnp.int32),
+                tgt, label, inw, jnp.minimum(n_fg, cap).astype(jnp.int32),
+                n_score)
+
+    loc, sc, tgt, lbl, inw, nloc, nsc = jax.vmap(one)(
+        jnp.arange(N), gt, crowd, info)
+    return {"LocationIndex": loc.reshape(-1),
+            "ScoreIndex": sc.reshape(-1),
+            "TargetBBox": tgt.reshape(-1, 4),
+            "TargetLabel": lbl.reshape(-1, 1),
+            "BBoxInsideWeight": inw.reshape(-1, 4),
+            "LocCount": nloc, "ScoreCount": nsc}
+
+
+@register_op("retinanet_target_assign",
+             inputs=["Anchor!", "GtBoxes!", "GtLabels!", "IsCrowd!",
+                     "ImInfo!"],
+             outputs=["LocationIndex", "ScoreIndex", "TargetBBox",
+                      "TargetLabel", "BBoxInsideWeight",
+                      "ForegroundNumber"], grad=None)
+def retinanet_target_assign(ins, attrs, ctx):
+    """rpn_target_assign_op.cc RetinanetTargetAssignKernel — like RPN
+    assign but NO sampling (every fg/bg anchor contributes), labels carry
+    the matched gt class (bg = 0), and ForegroundNumber feeds the focal
+    loss normalizer.  Outputs fixed [N*A] with -1 padding."""
+    anchors = jnp.asarray(ins["Anchor"])
+    gt = jnp.asarray(ins["GtBoxes"])
+    gt_lbl = jnp.asarray(ins["GtLabels"])         # [N, B] int32 (1..C)
+    crowd = jnp.asarray(ins["IsCrowd"])
+    info = jnp.asarray(ins["ImInfo"])
+    pos_ov = attrs.get("positive_overlap", 0.5)
+    neg_ov = attrs.get("negative_overlap", 0.4)
+    N = gt.shape[0]
+    A = anchors.shape[0]
+
+    def one(gt_i, lbl_i, crowd_i, info_i):
+        fg, bg, a2g_arg, _ = _rpn_assign_core(
+            anchors, gt_i, crowd_i, info_i, None, -1.0, pos_ov, neg_ov,
+            0, 0.0, False)
+        fg_pos = jnp.where(fg, jnp.arange(A), A)
+        fg_srt = jnp.sort(fg_pos)
+        n_fg = jnp.sum(fg).astype(jnp.int32)
+        loc_idx = jnp.where(fg_srt < A, fg_srt, -1)
+        slots = jnp.arange(A)
+        bg_pos = jnp.where(bg, jnp.arange(A), A)
+        bg_srt = jnp.sort(bg_pos)
+        n_bg = jnp.sum(bg).astype(jnp.int32)
+        bg_slot = slots - n_fg
+        bg_part = jnp.where((bg_slot >= 0) & (bg_slot < n_bg),
+                            bg_srt[jnp.clip(bg_slot, 0, A - 1)], A)
+        sc_local = jnp.where(slots < n_fg, fg_srt, bg_part)
+        score_idx = jnp.where(sc_local < A, sc_local, -1)
+        safe = jnp.clip(fg_srt, 0, A - 1)
+        safe_sc = jnp.clip(sc_local, 0, A - 1)
+        label = jnp.where(slots < n_fg,
+                          lbl_i[a2g_arg[safe_sc]].astype(jnp.int32),
+                          jnp.where(sc_local < A, 0, -1))
+        tgt = _box_to_delta(anchors[safe], gt_i[a2g_arg[safe]],
+                            normalized=False)
+        live = (fg_srt < A)[:, None]
+        return (loc_idx.astype(jnp.int32), score_idx.astype(jnp.int32),
+                jnp.where(live, tgt, 0.0), label.astype(jnp.int32),
+                jnp.where(live, 1.0, 0.0) * jnp.ones((1, 4)),
+                n_fg)
+
+    loc, sc, tgt, lbl, inw, nfg = jax.vmap(one)(gt, gt_lbl, crowd, info)
+    return {"LocationIndex": loc.reshape(-1),
+            "ScoreIndex": sc.reshape(-1),
+            "TargetBBox": tgt.reshape(-1, 4),
+            "TargetLabel": lbl.reshape(-1, 1),
+            "BBoxInsideWeight": inw.reshape(-1, 4),
+            "ForegroundNumber": nfg[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# generate_proposal_labels (generate_proposal_labels_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("generate_proposal_labels",
+             inputs=["RpnRois!", "GtClasses!", "IsCrowd!", "GtBoxes!",
+                     "ImInfo!"],
+             outputs=["Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights",
+                      "RoisNum?"], grad=None)
+def generate_proposal_labels(ins, attrs, ctx):
+    """generate_proposal_labels_op.cc — second-stage RoI sampling: append
+    gts to proposals, match by IoU, sample fg (>= fg_thresh) up to
+    fg_fraction*batch and bg (bg_thresh_lo <= iou < bg_thresh_hi) for the
+    rest, emit class labels + per-class expanded box targets.  Fixed-shape
+    redesign: everything is [N, batch_size_per_im, ...] with RoisNum
+    counts; rows beyond the count are zero/label -1.  RpnRois [N, R, 4]
+    (image-local coords), GtBoxes [N, B, 4] zero-padded."""
+    rois_in = jnp.asarray(ins["RpnRois"])         # [N, R, 4]
+    gt_cls = jnp.asarray(ins["GtClasses"])        # [N, B]
+    crowd = jnp.asarray(ins["IsCrowd"])           # [N, B]
+    gt = jnp.asarray(ins["GtBoxes"])              # [N, B, 4]
+    info = jnp.asarray(ins["ImInfo"])             # [N, 3]
+    batch = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thr = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    weights = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    is_cascade = bool(attrs.get("is_cascade_rcnn", False))
+    is_agnostic = bool(attrs.get("is_cls_agnostic", False))
+    N, R, _ = rois_in.shape
+    B = gt.shape[1]
+    base_key = ctx.key(attrs) if use_random else None
+
+    def one(i, rois_i, gt_i, cls_i, crowd_i, info_i):
+        # boxes arrive in scaled coords; gts are image coords * im_scale
+        # in the reference pipeline — the caller is responsible for a
+        # consistent frame, we match them as given.
+        if not is_cascade:
+            cand = jnp.concatenate([rois_i, gt_i], axis=0)   # [R+B, 4]
+        else:
+            cand = rois_i
+        M = cand.shape[0]
+        live_cand = (cand[:, 2] > cand[:, 0]) | (cand[:, 3] > cand[:, 1])
+        gt_valid = (~(crowd_i > 0)) & \
+            ((gt_i[:, 2] > gt_i[:, 0]) | (gt_i[:, 3] > gt_i[:, 1]))
+        iou = _pairwise_iou(cand, gt_i, normalized=True)
+        iou = jnp.where(gt_valid[None, :] & live_cand[:, None], iou, -1.0)
+        max_ov = jnp.max(iou, axis=1)
+        argmax = jnp.argmax(iou, axis=1)
+        fg_cand = max_ov >= fg_thr
+        bg_cand = (max_ov >= bg_lo) & (max_ov < bg_hi) & live_cand
+        fg_k = int(fg_frac * batch)
+        key = jax.random.fold_in(base_key, i) if base_key is not None \
+            else None
+        kf = kb = None
+        if key is not None:
+            kf, kb = jax.random.split(key)
+        fg_mask = _random_topk_mask(kf, fg_cand, fg_k)
+        n_fg = jnp.sum(fg_mask).astype(jnp.int32)
+        bg_mask = _random_topk_mask(kb, bg_cand,
+                                    jnp.asarray(batch) - n_fg)
+        n_bg = jnp.sum(bg_mask).astype(jnp.int32)
+        # pack fg rows then bg rows into the fixed [batch] output
+        fg_pos = jnp.sort(jnp.where(fg_mask, jnp.arange(M), M))[:batch]
+        bg_pos = jnp.sort(jnp.where(bg_mask, jnp.arange(M), M))[:batch]
+        slots = jnp.arange(batch)
+        bg_slot = slots - n_fg
+        row = jnp.where(slots < n_fg,
+                        fg_pos[jnp.clip(slots, 0, batch - 1)],
+                        jnp.where((bg_slot >= 0) & (bg_slot < n_bg),
+                                  bg_pos[jnp.clip(bg_slot, 0, batch - 1)],
+                                  M))
+        live = row < M
+        safe = jnp.clip(row, 0, M - 1)
+        out_rois = jnp.where(live[:, None], cand[safe], 0.0)
+        is_fg = slots < n_fg
+        label = jnp.where(is_fg,
+                          cls_i[argmax[safe]].astype(jnp.int32),
+                          jnp.where(live, 0, -1)).astype(jnp.int32)
+        # per-class expanded targets
+        tgt = _box_to_delta(cand[safe], gt_i[argmax[safe]],
+                            weights=weights, normalized=False)
+        tgt = jnp.where(is_fg[:, None], tgt, 0.0)
+        slot_cls = jnp.where(is_agnostic, jnp.minimum(label, 1), label)
+        onehot = jax.nn.one_hot(jnp.clip(slot_cls, 0, class_nums - 1),
+                                class_nums, dtype=tgt.dtype)
+        onehot = onehot * is_fg[:, None]
+        expanded = (onehot[:, :, None] * tgt[:, None, :]).reshape(
+            batch, class_nums * 4)
+        inw = (onehot[:, :, None] * jnp.ones((1, 1, 4))).reshape(
+            batch, class_nums * 4)
+        cnt = jnp.minimum(n_fg + n_bg, batch).astype(jnp.int32)
+        return out_rois, label, expanded, inw, inw, cnt
+
+    rois, lbl, tgt, inw, outw, cnt = jax.vmap(one)(
+        jnp.arange(N), rois_in, gt, gt_cls, crowd, info)
+    return {"Rois": rois, "LabelsInt32": lbl[..., None],
+            "BboxTargets": tgt, "BboxInsideWeights": inw,
+            "BboxOutsideWeights": outw, "RoisNum": cnt}
+
+
+# ---------------------------------------------------------------------------
+# generate_mask_labels (generate_mask_labels_op.cc) — host rasterisation
+# ---------------------------------------------------------------------------
+
+def _poly_to_mask_np(polys, box, M):
+    """Rasterise polygons (image coords) cropped to `box` onto an MxM grid
+    — numpy reimplementation of mask_util.cc Poly2MaskWrapper's
+    crop-and-rescale + even-odd fill."""
+    x1, y1, x2, y2 = box
+    w = max(x2 - x1, 1e-5)
+    h = max(y2 - y1, 1e-5)
+    yy, xx = np.mgrid[0:M, 0:M]
+    # grid cell centers in image coords
+    gx = x1 + (xx + 0.5) * w / M
+    gy = y1 + (yy + 0.5) * h / M
+    mask = np.zeros((M, M), bool)
+    for poly in polys:
+        if len(poly) < 6:
+            continue
+        px = np.asarray(poly[0::2], np.float64)
+        py = np.asarray(poly[1::2], np.float64)
+        # even-odd rule point-in-polygon, vectorised over the grid
+        inside = np.zeros((M, M), bool)
+        j = len(px) - 1
+        for i in range(len(px)):
+            cond = ((py[i] > gy) != (py[j] > gy))
+            xint = (px[j] - px[i]) * (gy - py[i]) / \
+                (py[j] - py[i] + 1e-12) + px[i]
+            inside ^= cond & (gx < xint)
+            j = i
+        mask |= inside
+    return mask.astype(np.int32)
+
+
+@register_op("generate_mask_labels",
+             inputs=["ImInfo!", "GtClasses!", "IsCrowd!", "GtSegms!",
+                     "Rois!", "LabelsInt32!", "RoisNum?!"],
+             outputs=["MaskRois", "RoiHasMaskInt32", "MaskInt32",
+                      "MaskRoisNum?"], grad=None)
+def generate_mask_labels(ins, attrs, ctx):
+    """generate_mask_labels_op.cc — for each fg RoI pick the gt whose box
+    best overlaps, rasterise that gt's polygons cropped to the RoI onto a
+    resolution x resolution grid, and expand into the class slot
+    (MaskInt32 [P, num_classes*M*M], -1 on non-slot cells like the
+    reference's mask expansion).  Polygon rasterisation is host-side
+    numpy via pure_callback (the reference kernel is CPU-only,
+    mask_util.cc) — it feeds the mask head's labels, not the hot path.
+    GtSegms is the padded redesign of the LoD polygon nest: [B, V, 2]
+    vertex lists with NaN padding, one polygon per gt row."""
+    info = jnp.asarray(ins["ImInfo"])             # [N, 3] (unused scale path: coords
+    gt_cls = jnp.asarray(ins["GtClasses"])        # [N, B]              already image)
+    crowd = jnp.asarray(ins["IsCrowd"])           # [N, B]
+    segms = jnp.asarray(ins["GtSegms"])           # [N, B, V, 2] NaN-padded
+    rois = jnp.asarray(ins["Rois"])               # [N, P, 4]
+    labels = jnp.asarray(ins["LabelsInt32"])      # [N, P, 1] or [N, P]
+    num_cls = int(attrs.get("num_classes", 81))
+    M = int(attrs.get("resolution", 14))
+    N, P = rois.shape[0], rois.shape[1]
+    B = segms.shape[1]
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+
+    def host(info_h, cls_h, crowd_h, segms_h, rois_h, labels_h):
+        info_h = np.asarray(info_h)
+        out_rois = np.zeros((N, P, 4), np.float32)
+        has = np.zeros((N, P), np.int32)
+        masks = np.full((N, P, num_cls * M * M), -1, np.int32)
+        nums = np.zeros((N,), np.int32)
+        for n in range(N):
+            k = 0
+            for p in range(P):
+                lbl = int(labels_h[n, p])
+                if lbl <= 0:
+                    continue
+                roi = rois_h[n, p]
+                if roi[2] <= roi[0] and roi[3] <= roi[1]:
+                    continue
+                # best-overlap gt of the same class
+                best, best_ov = -1, -1.0
+                for b in range(B):
+                    if crowd_h[n, b] > 0 or int(cls_h[n, b]) != lbl:
+                        continue
+                    poly = segms_h[n, b]
+                    pts = poly[~np.isnan(poly[:, 0])]
+                    if pts.shape[0] < 3:
+                        continue
+                    gx1, gy1 = pts.min(0)
+                    gx2, gy2 = pts.max(0)
+                    ix = max(0, min(roi[2], gx2) - max(roi[0], gx1))
+                    iy = max(0, min(roi[3], gy2) - max(roi[1], gy1))
+                    inter = ix * iy
+                    area = max((gx2 - gx1) * (gy2 - gy1) +
+                               (roi[2] - roi[0]) * (roi[3] - roi[1]) -
+                               inter, 1e-10)
+                    ov = inter / area
+                    if ov > best_ov:
+                        best_ov, best = ov, b
+                if best < 0:
+                    continue
+                poly = segms_h[n, best]
+                pts = poly[~np.isnan(poly[:, 0])]
+                m = _poly_to_mask_np([pts.reshape(-1)], roi, M)
+                out_rois[n, k] = roi
+                has[n, k] = 1
+                row = np.full((num_cls, M * M), -1, np.int32)
+                row[lbl] = m.reshape(-1)
+                masks[n, k] = row.reshape(-1)
+                k += 1
+            nums[n] = k
+        return out_rois, has, masks, nums
+
+    shapes = (jax.ShapeDtypeStruct((N, P, 4), jnp.float32),
+              jax.ShapeDtypeStruct((N, P), jnp.int32),
+              jax.ShapeDtypeStruct((N, P, num_cls * M * M), jnp.int32),
+              jax.ShapeDtypeStruct((N,), jnp.int32))
+    out_rois, has, masks, nums = jax.pure_callback(
+        host, shapes, info, gt_cls, crowd, segms, rois, labels)
+    return {"MaskRois": out_rois, "RoiHasMaskInt32": has[..., None],
+            "MaskInt32": masks, "MaskRoisNum": nums}
+
+
+# ---------------------------------------------------------------------------
+# detection_map (operators/detection_map_op.cc) — stateful mAP metric
+# ---------------------------------------------------------------------------
+
+@register_op("detection_map",
+             inputs=["DetectRes!", "Label!", "HasState?!", "PosCount?!",
+                     "TruePos?!", "FalsePos?!"],
+             outputs=["AccumPosCount", "AccumTruePos", "AccumFalsePos",
+                      "MAP"], grad=None, side_effect=True)
+def detection_map(ins, attrs, ctx):
+    """detection_map_op.cc — VOC mAP ('integral' or '11point') with
+    accumulation state.  Padded redesign of the LoD contract: DetectRes
+    [N, D, 6] (label, score, box; label<0 pad), Label [N, G, 6 or 5]
+    (label, [difficult], box; label<0 pad).  State tensors are fixed-size:
+    PosCount [C,1], TruePos/FalsePos [C, S, 2] (score, tp/fp flag;
+    score<0 pad).  Sequential match logic runs host-side via
+    pure_callback, like the reference's CPU-only kernel."""
+    det = jnp.asarray(ins["DetectRes"])
+    label = jnp.asarray(ins["Label"])
+    class_num = int(attrs.get("class_num"))
+    overlap_t = attrs.get("overlap_threshold", 0.5)
+    ap_type = attrs.get("ap_type", "integral")
+    eval_difficult = bool(attrs.get("evaluate_difficult", True))
+    bg = attrs.get("background_label", 0)
+    S = int(attrs.get("state_capacity", 1024))
+    has_state = ins.get("HasState")
+    pos_in = ins.get("PosCount")
+    tp_in = ins.get("TruePos")
+    fp_in = ins.get("FalsePos")
+    N = det.shape[0]
+
+    def host(det_h, lbl_h, st, pc, tp, fp):
+        det_h = np.asarray(det_h)
+        lbl_h = np.asarray(lbl_h)
+        pos = np.zeros((class_num,), np.int64)
+        tps = [[] for _ in range(class_num)]
+        fps = [[] for _ in range(class_num)]
+        if st is not None and int(np.asarray(st).reshape(-1)[0]) != 0:
+            pos += np.asarray(pc).reshape(-1)[:class_num].astype(np.int64)
+            for c in range(class_num):
+                for s, f in np.asarray(tp)[c]:
+                    if s >= 0:
+                        tps[c].append((float(s), int(f)))
+                for s, f in np.asarray(fp)[c]:
+                    if s >= 0:
+                        fps[c].append((float(s), int(f)))
+        lbl_w = lbl_h.shape[-1]
+        for n in range(N):
+            gts = lbl_h[n]
+            gts = gts[gts[:, 0] >= 0]
+            if lbl_w == 6:
+                g_lbl = gts[:, 0].astype(int)
+                g_dif = gts[:, 1].astype(int)
+                g_box = gts[:, 2:6]
+            else:
+                g_lbl = gts[:, 0].astype(int)
+                g_dif = np.zeros_like(g_lbl)
+                g_box = gts[:, 1:5]
+            for c, dif in zip(g_lbl, g_dif):
+                if eval_difficult or not dif:
+                    pos[c] += 1
+            dets = det_h[n]
+            dets = dets[dets[:, 0] >= 0]
+            visited = np.zeros(len(g_lbl), bool)
+            # per class, score-descending
+            for c in range(class_num):
+                if c == bg:
+                    continue
+                rows = dets[dets[:, 0].astype(int) == c]
+                rows = rows[np.argsort(-rows[:, 1], kind="stable")]
+                g_idx = np.where(g_lbl == c)[0]
+                for r in rows:
+                    score, box = float(r[1]), r[2:6]
+                    best_ov, best_g = -1.0, -1
+                    for gi in g_idx:
+                        gb = g_box[gi]
+                        ix = max(0, min(box[2], gb[2]) -
+                                 max(box[0], gb[0]))
+                        iy = max(0, min(box[3], gb[3]) -
+                                 max(box[1], gb[1]))
+                        inter = ix * iy
+                        union = max((box[2] - box[0]) * (box[3] - box[1]) +
+                                    (gb[2] - gb[0]) * (gb[3] - gb[1]) -
+                                    inter, 1e-10)
+                        ov = inter / union
+                        if ov > best_ov:
+                            best_ov, best_g = ov, gi
+                    if best_ov > overlap_t:
+                        if eval_difficult or not g_dif[best_g]:
+                            if not visited[best_g]:
+                                tps[c].append((score, 1))
+                                visited[best_g] = True
+                            else:
+                                fps[c].append((score, 1))
+                    else:
+                        fps[c].append((score, 1))
+        # mAP
+        aps, n_cls = [], 0
+        for c in range(class_num):
+            if c == bg or pos[c] == 0:
+                continue
+            n_cls += 1
+            if not tps[c] and not fps[c]:
+                aps.append(0.0)
+                continue
+            events = [(s, 1, f) for s, f in tps[c]] + \
+                [(s, 0, f) for s, f in fps[c]]
+            events.sort(key=lambda e: -e[0])
+            tp_c = np.cumsum([e[1] * e[2] for e in events])
+            fp_c = np.cumsum([(1 - e[1]) * e[2] for e in events])
+            prec = tp_c / np.maximum(tp_c + fp_c, 1e-10)
+            rec = tp_c / pos[c]
+            if ap_type == "11point":
+                ap = 0.0
+                for t in np.arange(0, 1.01, 0.1):
+                    p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                    ap += p / 11.0
+            else:
+                mrec = np.concatenate([[0], rec])
+                ap = float(np.sum((mrec[1:] - mrec[:-1]) * prec))
+            aps.append(float(ap))
+        m_ap = float(np.mean(aps)) if aps else 0.0
+        # pack state back to fixed shapes
+        pc_o = pos.reshape(class_num, 1).astype(np.float32)
+        tp_o = np.full((class_num, S, 2), -1.0, np.float32)
+        fp_o = np.full((class_num, S, 2), -1.0, np.float32)
+        for c in range(class_num):
+            if len(tps[c]) > S or len(fps[c]) > S:
+                # fixed-shape state cannot hold the full event list —
+                # the next accumulation step would under-count recall.
+                # Keep the HIGHEST-scored events (they dominate the AP
+                # integral) and tell the user to raise the capacity.
+                import warnings
+                warnings.warn(
+                    f"detection_map: class {c} accumulated "
+                    f"{len(tps[c])} TP / {len(fps[c])} FP events but "
+                    f"state_capacity={S}; keeping the top-{S} by score "
+                    f"— raise attr state_capacity for exact "
+                    f"accumulated mAP", RuntimeWarning)
+                tps[c] = sorted(tps[c], key=lambda e: -e[0])[:S]
+                fps[c] = sorted(fps[c], key=lambda e: -e[0])[:S]
+            for j, (s, f) in enumerate(tps[c][:S]):
+                tp_o[c, j] = (s, f)
+            for j, (s, f) in enumerate(fps[c][:S]):
+                fp_o[c, j] = (s, f)
+        return (pc_o, tp_o, fp_o, np.float32(m_ap))
+
+    shapes = (jax.ShapeDtypeStruct((class_num, 1), jnp.float32),
+              jax.ShapeDtypeStruct((class_num, S, 2), jnp.float32),
+              jax.ShapeDtypeStruct((class_num, S, 2), jnp.float32),
+              jax.ShapeDtypeStruct((), jnp.float32))
+    args = [det, label,
+            has_state if has_state is not None else jnp.zeros((1,),
+                                                              jnp.int32),
+            pos_in if pos_in is not None else jnp.zeros(
+                (class_num, 1), jnp.float32),
+            tp_in if tp_in is not None else jnp.full(
+                (class_num, S, 2), -1.0, jnp.float32),
+            fp_in if fp_in is not None else jnp.full(
+                (class_num, S, 2), -1.0, jnp.float32)]
+    pc, tp, fp, m_ap = jax.pure_callback(host, shapes, *args)
+    return {"AccumPosCount": pc, "AccumTruePos": tp,
+            "AccumFalsePos": fp, "MAP": m_ap.reshape(1)}

@@ -65,7 +65,8 @@ def send(ins, attrs, ctx):
               else jnp.asarray(lr_attr, jnp.float32))
 
     if mode in ("sparse_grad", "init_sparse"):
-        return _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr)
+        return _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr,
+                            grad_scale=float(attrs.get("grad_scale", 1.0)))
 
     def host(lr, *arrs):
         c = _client(endpoints, trainer_id)
@@ -84,7 +85,8 @@ def send(ins, attrs, ctx):
     return {"Dummy": dummy}
 
 
-def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr):
+def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr,
+                 grad_scale=1.0):
     """Row-sharded table traffic: init pushes the full local init split
     across pservers; sparse_grad pushes SelectedRows {rows, values} the
     embedding backward produced (reference
@@ -112,7 +114,8 @@ def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr):
             if mode == "init_sparse":
                 c.init_sparse_table(n, vals)
             elif rows.size:
-                c.push_sparse(n, rows, vals, float(lr))
+                c.push_sparse(n, rows, vals, float(lr),
+                              grad_scale=grad_scale)
         return np.zeros((1,), np.float32)
 
     dummy = io_callback(host, jax.ShapeDtypeStruct((1,), jnp.float32),

@@ -29,7 +29,10 @@ def accuracy(ins, attrs, ctx):
              side_effect=True)
 def auc(ins, attrs, ctx):
     pred, label = ins["Predict"], ins["Label"].ravel()
-    stat_pos, stat_neg = ins["StatPos"], ins["StatNeg"]
+    # the fluid layer materialises stats as [1, T+1] (auc_op.cc output
+    # shape); the bucket math is 1-d — flatten in, restore on the way out
+    stat_shape = ins["StatPos"].shape
+    stat_pos, stat_neg = ins["StatPos"].ravel(), ins["StatNeg"].ravel()
     num_thresholds = attrs.get("num_thresholds", 4095)
     pos_prob = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
         else pred.ravel()
@@ -48,8 +51,9 @@ def auc(ins, attrs, ctx):
     area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
     auc_val = jnp.where(tot_pos * tot_neg > 0,
                         area / (tot_pos * tot_neg), 0.0)
-    return {"AUC": auc_val.astype(jnp.float64), "StatPosOut": stat_pos,
-            "StatNegOut": stat_neg}
+    return {"AUC": auc_val.astype(jnp.float64),
+            "StatPosOut": stat_pos.reshape(stat_shape),
+            "StatNegOut": stat_neg.reshape(stat_shape)}
 
 
 @register_op("precision_recall",

@@ -165,11 +165,43 @@ def sequence_enumerate(ins, attrs, ctx):
     return {"Out": jnp.stack(outs, axis=-1).reshape(x.shape + (win,))}
 
 
-@register_op("sequence_erase", inputs=["X!"], outputs=["Out"], grad=None)
+@register_op("sequence_erase", inputs=["X!", "Length?!"],
+             outputs=["Out", "OutLength?"], grad=None)
 def sequence_erase(ins, attrs, ctx):
-    raise NotImplementedError(
-        "sequence_erase has data-dependent output shape; use host-side "
-        "io.lod.sequence_erase")
+    """sequence_erase_op.cc — drop the listed token ids from each
+    sequence.  The reference compacts the LoD rows (data-dependent
+    shape); the padded redesign keeps [B, T], left-compacts the
+    survivors per row, fills the tail with pad_value, and emits the new
+    per-row lengths — the same fixed-shape contract as sequence_pad."""
+    x = jnp.asarray(ins["X"])
+    tokens = attrs.get("tokens", [])
+    pad_value = attrs.get("pad_value", 0)
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None]
+    B, T = x.shape[0], x.shape[-1]
+    flat = x.reshape(-1, T)
+    erase = jnp.zeros(flat.shape, bool)
+    for t in tokens:
+        erase = erase | (flat == t)
+    length_in = ins.get("Length")
+    if length_in is not None:
+        valid = jnp.arange(T)[None, :] < \
+            jnp.asarray(length_in).reshape(-1, 1)
+        erase = erase | ~valid
+    keep = ~erase
+    # stable left-compaction: sort by (erased, position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T),
+                        axis=1)
+    gathered = jnp.take_along_axis(flat, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    live = jnp.arange(T)[None, :] < new_len[:, None]
+    out = jnp.where(live, gathered, jnp.asarray(pad_value, x.dtype))
+    out = out.reshape(x.shape)
+    if squeeze:
+        out = out[0]
+    return {"Out": out,
+            "OutLength": new_len.reshape(x.shape[:-1]).astype(jnp.int64)}
 
 
 @register_op("sequence_slice", inputs=["X", "Offset!", "Length!"],

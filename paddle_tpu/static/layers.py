@@ -1581,6 +1581,485 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return loss
 
 
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=False, name=None):
+    """fluid.layers.matrix_nms (detection.py:3542; matrix_nms_op.cc) —
+    fixed-shape [N, keep_top_k, 6] with label -1 padding."""
+    helper = LayerHelper("matrix_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    index = helper.create_variable_for_type_inference("int32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("matrix_nms", {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out, "Index": index, "RoisNum": num},
+                     {"score_threshold": score_threshold,
+                      "post_threshold": post_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "use_gaussian": use_gaussian,
+                      "gaussian_sigma": gaussian_sigma,
+                      "background_label": background_label,
+                      "normalized": normalized})
+    rets = [out]
+    if return_index:
+        rets.append(index)
+    if return_rois_num:
+        rets.append(num)
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """fluid.layers.locality_aware_nms (detection.py:3412) — EAST-style
+    merge-then-NMS; fixed-shape [N, keep_top_k, 6]."""
+    helper = LayerHelper("locality_aware_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op("locality_aware_nms",
+                     {"BBoxes": bboxes, "Scores": scores},
+                     {"Out": out, "RoisNum": num},
+                     {"score_threshold": score_threshold,
+                      "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                      "nms_threshold": nms_threshold,
+                      "normalized": normalized,
+                      "background_label": background_label})
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    """fluid.layers.retinanet_detection_output (detection.py:3101) —
+    multi-level decode + per-class NMS; fixed [N, keep_top_k, 6]."""
+    helper = LayerHelper("retinanet_detection_output", name=name)
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "retinanet_detection_output",
+        {"BBoxes": list(bboxes), "Scores": list(scores),
+         "Anchors": list(anchors), "ImInfo": im_info},
+        {"Out": out, "RoisNum": num},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold})
+    return out
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """fluid.layers.target_assign (detection.py:1410; target_assign_op.h).
+    input [N, B, K] padded gt rows; matched_indices [N, M]."""
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    wt = helper.create_variable_for_type_inference("float32")
+    ins = {"X": input, "MatchIndices": matched_indices}
+    if negative_indices is not None:
+        ins["NegIndices"] = negative_indices
+    helper.append_op("target_assign", ins,
+                     {"Out": out, "OutWeight": wt},
+                     {"mismatch_value": mismatch_value})
+    return out, wt
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       sample_size=0, mining_type="max_negative",
+                       name=None):
+    """mine_hard_examples_op.cc — SSD OHEM; NegIndices [N, M] -1-padded."""
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg = helper.create_variable_for_type_inference("int32")
+    upd = helper.create_variable_for_type_inference("int32")
+    num = helper.create_variable_for_type_inference("int32")
+    ins = {"ClsLoss": cls_loss, "MatchIndices": match_indices,
+           "MatchDist": match_dist}
+    if loc_loss is not None:
+        ins["LocLoss"] = loc_loss
+    helper.append_op("mine_hard_examples", ins,
+                     {"NegIndices": neg, "UpdatedMatchIndices": upd,
+                      "NegNum": num},
+                     {"neg_pos_ratio": neg_pos_ratio,
+                      "neg_dist_threshold": neg_dist_threshold,
+                      "sample_size": sample_size,
+                      "mining_type": mining_type})
+    return neg, upd
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """fluid.layers.collect_fpn_proposals (detection.py:3869)."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    n = max_level - min_level + 1
+    out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    ins = {"MultiLevelRois": list(multi_rois)[:n],
+           "MultiLevelScores": list(multi_scores)[:n]}
+    if rois_num_per_level is not None:
+        ins["MultiLevelRoIsNum"] = list(rois_num_per_level)[:n]
+    helper.append_op("collect_fpn_proposals", ins,
+                     {"FpnRois": out, "RoisNum": num},
+                     {"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """fluid.layers.distribute_fpn_proposals (detection.py:3669)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n = max_level - min_level + 1
+    multi = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+             for _ in range(n)]
+    restore = helper.create_variable_for_type_inference("int32")
+    nums = [helper.create_variable_for_type_inference("int32")
+            for _ in range(n)]
+    ins = {"FpnRois": fpn_rois}
+    if rois_num is not None:
+        ins["RoisNum"] = rois_num
+    helper.append_op("distribute_fpn_proposals", ins,
+                     {"MultiFpnRois": multi, "RestoreIndex": restore,
+                      "MultiLevelRoIsNum": nums},
+                     {"min_level": min_level, "max_level": max_level,
+                      "refer_level": refer_level,
+                      "refer_scale": refer_scale})
+    return multi, restore
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=2.302585, name=None):
+    """fluid.layers.box_decoder_and_assign (detection.py:3794)."""
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decode = helper.create_variable_for_type_inference(target_box.dtype)
+    assign = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op("box_decoder_and_assign",
+                     {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                      "TargetBox": target_box, "BoxScore": box_score},
+                     {"DecodeBox": decode, "OutputAssignBox": assign},
+                     {"box_clip": box_clip})
+    return decode, assign
+
+
+def polygon_box_transform(input, name=None):
+    """fluid.layers.polygon_box_transform (detection.py:969)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", {"Input": input},
+                     {"Output": out}, {})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """fluid.layers.psroi_pool (nn.py:13759; psroi_pool_op.h).  rois are
+    [R, 5] with a leading batch index (the padded-LoD redesign)."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("psroi_pool", {"X": input, "ROIs": rois},
+                     {"Out": out},
+                     {"output_channels": output_channels,
+                      "spatial_scale": spatial_scale,
+                      "pooled_height": pooled_height,
+                      "pooled_width": pooled_width})
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """fluid.layers.prroi_pool (nn.py:13829; prroi_pool_op.h)."""
+    helper = LayerHelper("prroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"X": input, "ROIs": rois}
+    if batch_roi_nums is not None:
+        ins["BatchRoINums"] = batch_roi_nums
+    helper.append_op("prroi_pool", ins, {"Out": out},
+                     {"spatial_scale": spatial_scale,
+                      "pooled_height": pooled_height,
+                      "pooled_width": pooled_width})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """fluid.layers.roi_perspective_transform (detection.py:2508).  rois
+    are [R, 9]: batch index + 4 quad corners."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    mat = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("roi_perspective_transform",
+                     {"X": input, "ROIs": rois},
+                     {"Out": out, "Mask": mask, "TransformMatrix": mat},
+                     {"transformed_height": transformed_height,
+                      "transformed_width": transformed_width,
+                      "spatial_scale": spatial_scale})
+    return out, mask, mat
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """fluid.layers.rpn_target_assign (detection.py:310) — emits sampled
+    index/target tensors then gathers the matching predictions.  Gathers
+    use clip-to-0 on the -1 padding; padded rows carry weight/label -1 so
+    downstream losses mask them."""
+    helper = LayerHelper("rpn_target_assign")
+    loc_idx = helper.create_variable_for_type_inference("int32")
+    score_idx = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    tgt_lbl = helper.create_variable_for_type_inference("int32")
+    inw = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    loc_n = helper.create_variable_for_type_inference("int32")
+    score_n = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "rpn_target_assign",
+        {"Anchor": anchor_box, "GtBoxes": gt_boxes, "IsCrowd": is_crowd,
+         "ImInfo": im_info},
+        {"LocationIndex": loc_idx, "ScoreIndex": score_idx,
+         "TargetBBox": tgt_bbox, "TargetLabel": tgt_lbl,
+         "BBoxInsideWeight": inw, "LocCount": loc_n,
+         "ScoreCount": score_n},
+        {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+         "rpn_straddle_thresh": rpn_straddle_thresh,
+         "rpn_fg_fraction": rpn_fg_fraction,
+         "rpn_positive_overlap": rpn_positive_overlap,
+         "rpn_negative_overlap": rpn_negative_overlap,
+         "use_random": use_random})
+    pred_loc = gather(reshape(bbox_pred, [-1, 4]), relu(loc_idx))
+    pred_score = gather(reshape(cls_logits, [-1, 1]), relu(score_idx))
+    return (pred_score, pred_loc, tgt_lbl, tgt_bbox, inw)
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """fluid.layers.retinanet_target_assign (detection.py:69)."""
+    helper = LayerHelper("retinanet_target_assign")
+    loc_idx = helper.create_variable_for_type_inference("int32")
+    score_idx = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    tgt_lbl = helper.create_variable_for_type_inference("int32")
+    inw = helper.create_variable_for_type_inference(bbox_pred.dtype)
+    fg_num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "retinanet_target_assign",
+        {"Anchor": anchor_box, "GtBoxes": gt_boxes, "GtLabels": gt_labels,
+         "IsCrowd": is_crowd, "ImInfo": im_info},
+        {"LocationIndex": loc_idx, "ScoreIndex": score_idx,
+         "TargetBBox": tgt_bbox, "TargetLabel": tgt_lbl,
+         "BBoxInsideWeight": inw, "ForegroundNumber": fg_num},
+        {"positive_overlap": positive_overlap,
+         "negative_overlap": negative_overlap})
+    pred_loc = gather(reshape(bbox_pred, [-1, 4]), relu(loc_idx))
+    pred_score = gather(reshape(cls_logits, [-1, num_classes]),
+                        relu(score_idx))
+    return (pred_score, pred_loc, tgt_lbl, tgt_bbox, inw, fg_num)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """fluid.layers.generate_proposal_labels (detection.py:2600)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgt = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    outw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "generate_proposal_labels",
+        {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+         "IsCrowd": is_crowd, "GtBoxes": gt_boxes, "ImInfo": im_info},
+        {"Rois": rois, "LabelsInt32": labels, "BboxTargets": tgt,
+         "BboxInsideWeights": inw, "BboxOutsideWeights": outw,
+         "RoisNum": num},
+        {"batch_size_per_im": batch_size_per_im,
+         "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+         "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+         "bbox_reg_weights": list(bbox_reg_weights),
+         "class_nums": class_nums or 81, "use_random": use_random,
+         "is_cls_agnostic": is_cls_agnostic,
+         "is_cascade_rcnn": is_cascade_rcnn})
+    return rois, labels, tgt, inw, outw
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """fluid.layers.generate_mask_labels (detection.py:2738).  gt_segms is
+    the padded polygon nest [N, B, V, 2] (NaN-padded vertices)."""
+    helper = LayerHelper("generate_mask_labels")
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype)
+    has_mask = helper.create_variable_for_type_inference("int32")
+    mask = helper.create_variable_for_type_inference("int32")
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "generate_mask_labels",
+        {"ImInfo": im_info, "GtClasses": gt_classes, "IsCrowd": is_crowd,
+         "GtSegms": gt_segms, "Rois": rois,
+         "LabelsInt32": labels_int32},
+        {"MaskRois": mask_rois, "RoiHasMaskInt32": has_mask,
+         "MaskInt32": mask, "MaskRoisNum": num},
+        {"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, has_mask, mask
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None,
+                  out_states=None, ap_version="integral"):
+    """fluid.layers.detection_map (detection.py:1224) — VOC mAP with
+    accumulation state; padded DetectRes [N, D, 6] / Label [N, G, 5|6]."""
+    helper = LayerHelper("detection_map")
+    m_ap = helper.create_variable_for_type_inference("float32")
+    if out_states is not None:
+        # the caller's accumulation variables receive the updated state
+        # (reference detection.py contract driven by the DetectionMAP
+        # metric: out_states aliases input_states across batches)
+        pc, tp, fp = out_states
+    else:
+        pc = helper.create_variable_for_type_inference("float32")
+        tp = helper.create_variable_for_type_inference("float32")
+        fp = helper.create_variable_for_type_inference("float32")
+    ins = {"DetectRes": detect_res, "Label": label}
+    if has_state is not None:
+        ins["HasState"] = has_state
+    if input_states is not None:
+        ins["PosCount"], ins["TruePos"], ins["FalsePos"] = input_states
+    helper.append_op(
+        "detection_map", ins,
+        {"AccumPosCount": pc, "AccumTruePos": tp, "AccumFalsePos": fp,
+         "MAP": m_ap},
+        {"class_num": class_num, "background_label": background_label,
+         "overlap_threshold": overlap_threshold,
+         "evaluate_difficult": evaluate_difficult,
+         "ap_type": ap_version})
+    return m_ap
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """fluid.layers.continuous_value_model (nn.py:14026; cvm_op.h) —
+    show/click counter transform ahead of the CTR tower."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cvm", {"X": input, "CVM": cvm}, {"Y": out},
+                     {"use_cvm": use_cvm})
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """fluid.layers.filter_by_instag (nn.py:10140) — padded redesign:
+    kept rows pass through, dropped rows zeroed + LossWeight 0."""
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    lw = helper.create_variable_for_type_inference("float32")
+    imap = helper.create_variable_for_type_inference("int64")
+    helper.append_op("filter_by_instag",
+                     {"Ins": ins, "Ins_tag": ins_tag,
+                      "Filter_tag": filter_tag},
+                     {"Out": out, "LossWeight": lw, "IndexMap": imap},
+                     {"is_lod": is_lod,
+                      "out_val_if_empty": out_val_if_empty})
+    return out, lw
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """fluid.layers.hash (nn.py:12917; hash_op.h)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hash", {"X": input}, {"Out": out},
+                     {"mod_by": hash_size, "num_hash": num_hash})
+    return out
+
+
+def shuffle_batch(x, seed=None):
+    """fluid.contrib.layers.shuffle_batch (contrib/layers/nn.py:785)."""
+    helper = LayerHelper("shuffle_batch")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64")
+    seed_out = helper.create_variable_for_type_inference("int64")
+    ins = {"X": x}
+    attrs = {}  # op_uid auto-assigned by Program.append (program.py:290)
+    if seed is not None:
+        if isinstance(seed, int):
+            attrs["startup_seed"] = seed
+        else:
+            ins["Seed"] = seed
+    helper.append_op("shuffle_batch", ins,
+                     {"Out": out, "ShuffleIdx": idx, "SeedOut": seed_out},
+                     attrs)
+    return out
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent=0.0, is_training=True,
+                        use_filter=False, white_list_len=0,
+                        black_list_len=0, seed=0, lr=1.0, param_attr=None,
+                        param_attr_wl=None, param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """fluid.contrib.layers.search_pyramid_hash (contrib nn.py:669;
+    pyramid_hash_op.cc).  input [B, S] padded token ids."""
+    helper = LayerHelper("pyramid_hash", name=name)
+    w = helper.create_parameter(param_attr, [space_len + rand_len], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    drop = helper.create_variable_for_type_inference("int32")
+    helper.append_op("pyramid_hash", {"X": input, "W": w},
+                     {"Out": out, "DropPos": drop},
+                     {"num_emb": num_emb, "space_len": space_len,
+                      "pyramid_layer": pyramid_layer,
+                      "rand_len": rand_len, "lr": lr,
+                      "drop_out_percent": drop_out_percent})
+    return out
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """fluid.contrib.layers.tdm_child (contrib nn.py:1019) — the
+    TreeInfo table is a learnable-shaped parameter the caller fills via
+    initializer (same contract as the reference's embedding-style
+    param)."""
+    helper = LayerHelper("tdm_child")
+    info = helper.create_parameter(param_attr, [node_nums, 3 + child_nums],
+                                   "int32")
+    child = helper.create_variable_for_type_inference(dtype)
+    mask = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("tdm_child", {"X": x, "TreeInfo": info},
+                     {"Child": child, "LeafMask": mask},
+                     {"child_nums": child_nums})
+    return child, mask
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """fluid.contrib.layers.tdm_sampler (contrib nn.py:1104)."""
+    helper = LayerHelper("tdm_sampler")
+    n_layers = len(layer_node_num_list)
+    travel = helper.create_parameter(tree_travel_attr,
+                                     [leaf_node_num, n_layers], tree_dtype)
+    layer = helper.create_parameter(tree_layer_attr,
+                                    [n_layers, max(layer_node_num_list)],
+                                    tree_dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    labels = helper.create_variable_for_type_inference(dtype)
+    mask = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("tdm_sampler",
+                     {"X": x, "Travel": travel, "Layer": layer},
+                     {"Out": out, "Labels": labels, "Mask": mask},
+                     {"neg_samples_num_list": list(neg_samples_num_list),
+                      "layer_node_num_list": list(layer_node_num_list),
+                      "output_positive": output_positive, "seed": seed})
+    return out, labels, mask
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """fluid.layers.py_func (py_func_op.cc) — run a host-python function as
     an op; lowers to jax.pure_callback so it composes with jit.  The

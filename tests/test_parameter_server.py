@@ -449,3 +449,170 @@ def test_distributed_lookup_table_two_pservers():
         srv1.stop()
         from paddle_tpu.ops.kernels.distributed_ops import _reset_clients
         _reset_clients()
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance: client retry/backoff + pserver restart (VERDICT r3 #5)
+# ---------------------------------------------------------------------------
+
+def test_kv_client_retries_through_server_restart():
+    """Kill the pserver mid-session and restart it on the same port with
+    its store restored (the auto-checkpoint resume contract): the
+    client's next call must reconnect and succeed instead of dying on
+    the first dropped connection (grpc_client.h FLAGS_rpc_deadline +
+    retry parity)."""
+    from paddle_tpu.distributed.ps.kv_server import KVServer, KVClient
+    srv = KVServer("127.0.0.1:0", num_trainers=1)
+    srv.serve_in_thread()
+    port = int(srv.endpoint.rsplit(":", 1)[1])
+    c = KVClient([srv.endpoint], sock_timeout=5.0, rpc_deadline=20.0)
+    try:
+        c.wait_server_ready()
+        w = np.arange(8, dtype=np.float32).reshape(2, 4)
+        c.init_param("w", w)
+        np.testing.assert_allclose(c.pull("w"), w)
+        snapshot = {k: v.copy() for k, v in srv._store.items()}
+
+        # hard-kill the server, then restart it shortly after on the
+        # SAME port with the snapshot restored, while the client is
+        # already retrying its pull
+        srv.stop()
+
+        def restart():
+            time.sleep(0.8)
+            srv2 = KVServer(f"127.0.0.1:{port}", num_trainers=1)
+            srv2._store.update(snapshot)
+            srv2.serve_in_thread()
+            restart.srv = srv2
+
+        t = threading.Thread(target=restart)
+        t.start()
+        got = c.pull("w")  # first attempt hits a dead port -> retries
+        t.join()
+        np.testing.assert_allclose(got, w)
+        # pushes also survive
+        c.push_grad("w", np.ones_like(w), lr=0.5, sync=False)
+        np.testing.assert_allclose(c.pull("w"), w - 0.5)
+    finally:
+        c.close()
+        try:
+            restart.srv.stop()
+        except Exception:
+            pass
+
+
+def test_kv_client_deadline_gives_typed_error():
+    """With no server at all, the retry loop must fail with a clear
+    ConnectionError once the deadline budget is spent - not hang."""
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    c = KVClient(["127.0.0.1:1"], sock_timeout=0.3, rpc_deadline=1.0,
+                 max_retries=3)
+    t0 = time.time()
+    with pytest.raises(ConnectionError, match="failed after"):
+        c.pull("nope")
+    assert time.time() - t0 < 10.0
+
+
+def test_kv_push_rows_missing_table_errors():
+    """ADVICE r3: a sparse push to a table the server does not hold must
+    reply OP_ERROR (surfaced as TimeoutError) instead of silently
+    dropping the gradient."""
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    srv = _start_server()
+    try:
+        c = KVClient([srv.endpoint], rpc_deadline=5.0)
+        c.wait_server_ready()
+        with pytest.raises((TimeoutError, KeyError),
+                           match="not on this server"):
+            c.push_sparse("ghost_table", np.array([0, 1]),
+                          np.ones((2, 4), np.float32), lr=0.1)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_sync_sparse_push_scaled_by_trainer_count():
+    """ADVICE r3 (medium): in sync mode the sparse row update must step
+    by the trainer-average, not N independent full-lr steps."""
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    srv = _start_server(num_trainers=2)
+    try:
+        c = KVClient([srv.endpoint], rpc_deadline=5.0)
+        c.wait_server_ready()
+        tab = np.zeros((4, 2), np.float32)
+        c.init_sparse_table("tab", tab)
+        g = np.ones((2, 2), np.float32)
+        # two trainers push the same rows with grad_scale = 1/2
+        c.push_sparse("tab", np.array([0, 1]), g, lr=1.0, grad_scale=0.5)
+        c.push_sparse("tab", np.array([0, 1]), g, lr=1.0, grad_scale=0.5)
+        got = c.pull_sparse("tab", np.array([0, 1]))
+        # average of two unit grads at lr 1 -> -1.0, not -2.0
+        np.testing.assert_allclose(got, -np.ones((2, 2)), atol=1e-6)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_training_survives_pserver_restart():
+    """End-to-end: transpiled trainer keeps stepping while the pserver
+    is killed and resurrected with its store intact (simulates the
+    auto-checkpoint recovery path)."""
+    from paddle_tpu.distributed.ps.kv_server import KVServer
+    from paddle_tpu.distributed.ps.ps_optimizer import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+    from paddle_tpu.ops.kernels.distributed_ops import _reset_clients
+
+    srv = KVServer("127.0.0.1:0", num_trainers=1)
+    srv.serve_in_thread()
+    port = int(srv.endpoint.rsplit(":", 1)[1])
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 4])
+            y = layers.data("y", [-1, 1])
+            pred = layers.fc(x, 1)
+            loss = layers.mean(layers.square(pred - y))
+            static.SGD(learning_rate=0.1).minimize(loss)
+        cfg = DistributeTranspilerConfig()
+        cfg.use_graph_ops = True
+        cfg.sync_mode = False
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main,
+                    pservers=f"127.0.0.1:{port}", trainers=1,
+                    startup_program=startup)
+        prog = t.get_trainer_program()
+
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = (xb @ np.array([[1.0], [-2.0], [0.5], [3.0]],
+                            np.float32)).astype(np.float32)
+        with static.scope_guard(scope):
+            exe.run(startup)
+            losses = []
+            for _ in range(5):
+                (lv,) = exe.run(prog, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+            # kill + restart with state carried over
+            snapshot = {k: v.copy() for k, v in srv._store.items()}
+            srv.stop()
+            time.sleep(0.3)
+            srv2 = KVServer(f"127.0.0.1:{port}", num_trainers=1)
+            srv2._store.update(snapshot)
+            srv2.serve_in_thread()
+            try:
+                for _ in range(10):
+                    (lv,) = exe.run(prog, feed={"x": xb, "y": yb},
+                                    fetch_list=[loss])
+                    losses.append(float(np.asarray(lv)))
+            finally:
+                srv2.stop()
+        assert losses[-1] < losses[0] * 0.5, losses
+    finally:
+        _reset_clients()
+        try:
+            srv.stop()
+        except Exception:
+            pass
