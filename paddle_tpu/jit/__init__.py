@@ -263,7 +263,19 @@ class StaticFunction:
                     "to_static: traced function must return Tensors "
                     "produced by the traced ops, got "
                     f"{type(t).__name__}")
-            fetch_names.append(rec.names[id(t)])
+            nm = rec.names[id(t)]
+            if not program.global_block().has_var(nm):
+                # e.g. a value list.append'ed inside a tensor-dependent
+                # loop body: its op lives in the while sub-block, so it
+                # cannot escape the loop (only assigned names are
+                # loop-carried)
+                raise TypeError(
+                    f"to_static: returned tensor {nm!r} was produced "
+                    "inside a tensor-dependent loop body and is not "
+                    "loop-carried — assign it to a variable before the "
+                    "loop (loop-carried state) or accumulate through "
+                    "static.layers.create_array/array_write")
+            fetch_names.append(nm)
         # buffer rebindings (BatchNorm running stats): a layer that did
         # `buffer.set_value(traced_out)` left the buffer's raw value
         # identical to some traced output's — record the link so replays

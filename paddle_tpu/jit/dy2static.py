@@ -351,6 +351,69 @@ def convert_lt(a, b):
     return a < b
 
 
+def _recording():
+    from ..dygraph import tracer as dytracer
+    return dytracer._PROGRAM_RECORDER is not None
+
+
+def convert_print(*args, **kwargs):
+    """print_transformer.py analog.  Under a to_static trace (program
+    recorder active) tensor args record `print` ops so the print fires on
+    EVERY execution of the cached program, not once at trace; plain
+    eager/python values keep builtin print."""
+    import numpy as np
+    from ..dygraph.tensor import Tensor
+    if _recording() and any(isinstance(a, Tensor) for a in args):
+        from ..dygraph import tracer as dytracer
+        msg_parts = [a for a in args if not isinstance(a, Tensor)]
+        message = kwargs.get("sep", " ").join(str(p) for p in msg_parts)
+        for a in args:
+            if isinstance(a, Tensor):
+                dytracer.trace_op("print", {"In": a},
+                                  {"message": message}, ["Out"])
+        return
+    print(*[np.asarray(a._value) if isinstance(a, Tensor) else a
+            for a in args], **kwargs)
+
+
+def convert_assert(cond, msg=None):
+    """assert_transformer.py analog.  Under a to_static trace a tensor
+    condition records an `assert` op (host-side runtime check, the
+    reference Assert op's abort contract); eager values assert
+    immediately with python semantics."""
+    import numpy as np
+    from ..dygraph.tensor import Tensor
+    if isinstance(cond, Tensor) and _recording():
+        from ..dygraph import tracer as dytracer
+        dytracer.trace_op(
+            "assert", {"Cond": cond},
+            {"message": str(msg) if msg is not None else "Assert failed"},
+            [])
+        return
+    if isinstance(cond, Tensor):
+        assert bool(np.all(np.asarray(cond._value))), msg
+    else:
+        assert cond, msg
+
+
+def convert_var_dtype(x, kind):
+    """cast_transformer.py analog.  Under a to_static trace int()/float()
+    /bool() on a tensor becomes a cast op (stays in the program); in
+    plain eager or on python values, python semantics."""
+    import numpy as np
+    from ..dygraph.tensor import Tensor
+    py = {"int": int, "float": float, "bool": bool}[kind]
+    if isinstance(x, Tensor):
+        if _recording():
+            from ..dygraph import tracer as dytracer
+            dt = {"int": "int64", "float": "float32",
+                  "bool": "bool"}[kind]
+            return dytracer.trace_op("cast", {"X": x},
+                                     {"out_dtype": dt}, ["Out"])
+        return py(np.asarray(x._value).item())
+    return py(x)
+
+
 def convert_idx_inc(i):
     return i + 1
 
@@ -872,6 +935,29 @@ class _IfTransformer(ast.NodeTransformer):
         self.count = 0
         self.loop_count = 0
 
+    # -- print/assert/cast (print_transformer.py, assert_transformer.py,
+    #    cast_transformer.py analogs) --------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and not node.keywords and \
+                len(node.args) == 1 and \
+                node.func.id in ("int", "float", "bool") and \
+                not isinstance(node.args[0], ast.Starred):
+            return _jst_call("convert_var_dtype",
+                             [node.args[0],
+                              ast.Constant(value=node.func.id)])
+        if isinstance(node.func, ast.Name) and node.func.id == "print" \
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.args):
+            return ast.Call(func=_jst_attr("convert_print"),
+                            args=node.args, keywords=node.keywords)
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test] + ([node.msg] if node.msg is not None else [])
+        return ast.Expr(value=_jst_call("convert_assert", args))
+
     # -- loops (loop_transformer.py:367 LoopTransformer analog) -----------
     def _leave_untransformed(self, node):
         """A loop the transform can't hoist (return inside, else-clause)
@@ -1122,10 +1208,16 @@ def ast_transform(fn):
                 "function carries decorators other than to_static; "
                 "falling back to tracing")
     fdef.decorator_list = []
-    if not any(isinstance(n, (ast.If, ast.While, ast.For))
-               for n in ast.walk(fdef)):
+    def _convertible(n):
+        if isinstance(n, (ast.If, ast.While, ast.For, ast.Assert)):
+            return True
+        return (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id in ("print", "int", "float", "bool"))
+
+    if not any(_convertible(n) for n in ast.walk(fdef)):
         raise Dy2StaticError(
-            "no if/while/for statements — nothing to transform")
+            "no if/while/for/assert/print/cast constructs — nothing to "
+            "transform")
     _IfTransformer().visit(fdef)
 
     freevars = fn.__code__.co_freevars

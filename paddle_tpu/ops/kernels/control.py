@@ -18,6 +18,41 @@ import jax.numpy as jnp
 from ..registry import register_op
 
 
+def _host_check(ok, message):
+    """Ordered host-side check that raises `message` when `ok` is false.
+    io_callback has no JVP/VJP rules, so the callback is wrapped in a
+    custom_vjp identity over a float flag — the check survives inside
+    differentiated computations (assert in a trainable sub-block, strict
+    bounded while under _while_grad) where a bare io_callback would crash
+    jax.vjp with 'IO callbacks do not support JVP'."""
+    from jax.experimental import io_callback
+
+    def _emit(flag_f):
+        def _die(f):
+            import numpy as _np
+            if float(f) < 0.5:
+                raise AssertionError(message)
+            return _np.bool_(True)
+
+        io_callback(_die, jax.ShapeDtypeStruct((), jnp.bool_), flag_f,
+                    ordered=True)
+
+    @jax.custom_vjp
+    def chk(flag_f):
+        _emit(flag_f)
+        return flag_f
+
+    def fwd(flag_f):
+        _emit(flag_f)
+        return flag_f, None
+
+    def bwd(_, g):
+        return (jnp.zeros_like(g),)
+
+    chk.defvjp(fwd, bwd)
+    chk(jnp.asarray(ok).astype(jnp.float32).reshape(()))
+
+
 def _sub_tracer(ctx, block_idx):
     from ...static.executor import BlockTracer
     program = getattr(ctx, "program", None)
@@ -108,15 +143,26 @@ def while_op(ins, attrs, ctx):
 
         final, _ = jax.lax.scan(step, init, None, length=max_iters)
         # truncation detector: if the condition is STILL true after
-        # max_iters, results differ from the unbounded semantics — say so
-        # at runtime instead of silently returning the truncated state
-        jax.lax.cond(
-            _scalar_bool(final[cond_name]),
-            lambda: jax.debug.print(
-                "WARNING: while(max_iters={m}) stopped with its condition "
-                "still true — the loop was truncated; raise max_iters",
-                m=max_iters),
-            lambda: None)
+        # max_iters, results differ from the unbounded semantics.
+        # strict_truncation (ADVICE r3): abort the step with a host-side
+        # error so training cannot silently proceed on truncated values;
+        # default: a runtime warning print.
+        truncated = _scalar_bool(final[cond_name])
+        if bool(attrs.get("strict_truncation", False)) or \
+                bool(attrs.get("strict", False)):
+            _host_check(
+                jnp.logical_not(truncated),
+                f"while(max_iters={max_iters}) truncated: the loop "
+                "condition was still true at the bound — raise "
+                "max_iters (strict_truncation=True)")
+        else:
+            jax.lax.cond(
+                truncated,
+                lambda: jax.debug.print(
+                    "WARNING: while(max_iters={m}) stopped with its "
+                    "condition still true — the loop was truncated; "
+                    "raise max_iters", m=max_iters),
+                lambda: None)
         return {"Out": [final[n] for n in carry_names]}
 
     def cond_f(carry):
@@ -287,6 +333,14 @@ def print_op(ins, attrs, ctx):
 @register_op("assert", inputs=["Cond!", "Data*?"], outputs=[], grad=None,
              side_effect=True)
 def assert_op(ins, attrs, ctx):
+    """assert_op.cc parity: host-side check that aborts the step when the
+    condition is false.  Ordered io_callback (custom_vjp-shielded, see
+    _host_check) so it survives DCE under jit, composes with
+    differentiation, and the AssertionError propagates to whoever
+    consumes the step's results (the reference op PADDLE_ENFORCEs at
+    run time)."""
+    _host_check(jnp.all(jnp.asarray(ins["Cond"])),
+                attrs.get("message", "Assert failed"))
     return {}
 
 

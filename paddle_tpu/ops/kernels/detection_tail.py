@@ -962,14 +962,16 @@ def retinanet_target_assign(ins, attrs, ctx):
     N = gt.shape[0]
     A = anchors.shape[0]
 
-    def one(gt_i, lbl_i, crowd_i, info_i):
+    def one(i, gt_i, lbl_i, crowd_i, info_i):
         fg, bg, a2g_arg, _ = _rpn_assign_core(
             anchors, gt_i, crowd_i, info_i, None, -1.0, pos_ov, neg_ov,
             0, 0.0, False)
         fg_pos = jnp.where(fg, jnp.arange(A), A)
         fg_srt = jnp.sort(fg_pos)
         n_fg = jnp.sum(fg).astype(jnp.int32)
-        loc_idx = jnp.where(fg_srt < A, fg_srt, -1)
+        # global indices (i * A + local) like rpn_target_assign — the
+        # layer wrapper gathers from batch-flattened predictions
+        loc_idx = jnp.where(fg_srt < A, i * A + fg_srt, -1)
         slots = jnp.arange(A)
         bg_pos = jnp.where(bg, jnp.arange(A), A)
         bg_srt = jnp.sort(bg_pos)
@@ -978,7 +980,7 @@ def retinanet_target_assign(ins, attrs, ctx):
         bg_part = jnp.where((bg_slot >= 0) & (bg_slot < n_bg),
                             bg_srt[jnp.clip(bg_slot, 0, A - 1)], A)
         sc_local = jnp.where(slots < n_fg, fg_srt, bg_part)
-        score_idx = jnp.where(sc_local < A, sc_local, -1)
+        score_idx = jnp.where(sc_local < A, i * A + sc_local, -1)
         safe = jnp.clip(fg_srt, 0, A - 1)
         safe_sc = jnp.clip(sc_local, 0, A - 1)
         label = jnp.where(slots < n_fg,
@@ -992,7 +994,8 @@ def retinanet_target_assign(ins, attrs, ctx):
                 jnp.where(live, 1.0, 0.0) * jnp.ones((1, 4)),
                 n_fg)
 
-    loc, sc, tgt, lbl, inw, nfg = jax.vmap(one)(gt, gt_lbl, crowd, info)
+    loc, sc, tgt, lbl, inw, nfg = jax.vmap(one)(
+        jnp.arange(N), gt, gt_lbl, crowd, info)
     return {"LocationIndex": loc.reshape(-1),
             "ScoreIndex": sc.reshape(-1),
             "TargetBBox": tgt.reshape(-1, 4),

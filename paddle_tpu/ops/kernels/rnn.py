@@ -264,17 +264,39 @@ def lstmp(ins, attrs, ctx):
     r0 = jnp.zeros((b_sz, p), x.dtype) if h0 is None else h0
     c0 = jnp.zeros((b_sz, d), x.dtype) if c0 is None else c0
     bias = ins.get("Bias")
-    proj_act = attrs.get("proj_activation", "tanh")
-    act = {"tanh": jnp.tanh, "identity": lambda v: v,
-           "relu": jax.nn.relu}.get(proj_act, jnp.tanh)
+    _acts = {"tanh": jnp.tanh, "identity": lambda v: v,
+             "relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid}
+    act = _acts.get(attrs.get("proj_activation", "tanh"), jnp.tanh)
+    act_gate = _acts.get(attrs.get("gate_activation", "sigmoid"),
+                         jax.nn.sigmoid)
+    act_cand = _acts.get(attrs.get("candidate_activation", "tanh"),
+                         jnp.tanh)
+    act_cell = _acts.get(attrs.get("cell_activation", "tanh"), jnp.tanh)
+    # use_peepholes=True (the reference lstmp default, lstmp_op.h): Bias
+    # carries [1, 7*hidden] — 4d gate bias then the diagonal peephole
+    # weights W_ic, W_if (on c_prev) and W_oc (on c_new)
+    use_peep = bool(attrs.get("use_peepholes", False))
+    if use_peep and (bias is None or bias.reshape(-1).shape[0] < 7 * d):
+        raise ValueError(
+            "lstmp: use_peepholes=True needs a [1, 7*hidden] Bias "
+            "(4d gate bias + W_ic/W_if/W_oc peephole diagonals)")
+    if use_peep:
+        flat_b = bias.reshape(-1)
+        w_ic, w_if, w_oc = (flat_b[4 * d:5 * d], flat_b[5 * d:6 * d],
+                            flat_b[6 * d:7 * d])
 
     def step(carry, xt):
         r, c = carry
-        gates = xt + r @ w + (bias[:, :4 * d].reshape(1, -1)
+        gates = xt + r @ w + (bias.reshape(-1)[:4 * d].reshape(1, -1)
                               if bias is not None else 0.0)
         i, f, cand, o = jnp.split(gates, 4, axis=-1)
-        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cand)
-        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        if use_peep:
+            i = i + w_ic * c
+            f = f + w_if * c
+        c_new = act_gate(f) * c + act_gate(i) * act_cand(cand)
+        if use_peep:
+            o = o + w_oc * c_new
+        h_new = act_gate(o) * act_cell(c_new)
         r_new = act(h_new @ pw)
         return (r_new, c_new), (r_new, c_new)
 

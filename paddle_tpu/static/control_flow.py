@@ -103,7 +103,8 @@ def _sub_block(program: Program):
 
 
 def append_while_op(parent: Block, sub: Block, cond_name: str,
-                    is_test: bool = False, max_iters: int = 0):
+                    is_test: bool = False, max_iters: int = 0,
+                    strict_truncation: bool = False):
     """Analyze a closed while sub-block and append the `while` op to the
     parent (single producer of the op schema — While.block(), while_loop
     and the dy2static loop recorder all route here).  max_iters > 0 makes
@@ -149,7 +150,8 @@ def append_while_op(parent: Block, sub: Block, cond_name: str,
         attrs={"sub_block": sub.idx, "x_names": x_names,
                "carry_names": list(written), "carry_srcs": carry_srcs,
                "cond_name": cond_name,
-               "is_test": is_test, "max_iters": int(max_iters or 0)})
+               "is_test": is_test, "max_iters": int(max_iters or 0),
+               "strict_truncation": bool(strict_truncation)})
     if max_iters and not is_test:
         # differentiable (bounded) loop: loop vars are usually created by
         # fill_constant, whose output carries stop_gradient=True — but the
@@ -206,7 +208,7 @@ class While:
     """
 
     def __init__(self, cond: VarDesc, is_test: bool = False, name=None,
-                 max_iters: int = 0):
+                 max_iters: int = 0, strict_truncation: bool = False):
         if cond.dtype not in ("bool",):
             raise TypeError("While condition must be a bool variable, got "
                             f"{cond.dtype}")
@@ -218,6 +220,7 @@ class While:
                         else default_main_program())
         self.is_test = is_test
         self.max_iters = int(max_iters or 0)
+        self.strict_truncation = bool(strict_truncation)
 
     @contextlib.contextmanager
     def block(self):
@@ -228,11 +231,12 @@ class While:
         # values, so they are inputs too; append_while_op validates that
         # the body updates the condition
         append_while_op(parent, sub, self.cond_var.name, self.is_test,
-                        self.max_iters)
+                        self.max_iters,
+                        strict_truncation=self.strict_truncation)
 
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None,
-               max_iters: int = 0):
+               max_iters: int = 0, strict_truncation: bool = False):
     """Functional while (reference layers/control_flow.py while_loop):
     `cond(*loop_vars) -> bool scalar var`, `body(*loop_vars) -> new vars`;
     returns the final loop vars.
@@ -256,7 +260,8 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
     if init_cond.dtype != "bool":
         raise TypeError("while_loop cond must return a bool scalar var, "
                         f"got {init_cond.dtype}")
-    w = While(init_cond, is_test=is_test, name=name, max_iters=max_iters)
+    w = While(init_cond, is_test=is_test, name=name, max_iters=max_iters,
+              strict_truncation=strict_truncation)
     with w.block():
         new_vars = body(*loop_vars)
         if not isinstance(new_vars, (list, tuple)):

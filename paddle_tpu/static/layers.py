@@ -2060,6 +2060,46 @@ def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
     return out, labels, mask
 
 
+def switch_moe(x, num_experts, d_hidden, capacity_factor=1.25,
+               ep_ring_id=None, param_attr=None, name=None):
+    """Switch (top-1) Mixture-of-Experts feed-forward as a static-graph
+    layer (VERDICT r3: MoE as a framework citizen).  Shares the
+    incubate/moe.py core; under a mesh executor `ep_ring_id` binds the
+    expert axis to a mesh axis so dispatch rides all_to_all over ICI.
+    x [..., D] -> (out [..., D], aux_loss scalar)."""
+    helper = LayerHelper("switch_moe", name=name)
+    d_model = int(x.shape[-1])
+
+    def _sub_attr(suffix):
+        # a NAMED param_attr must not be shared across differently-shaped
+        # weights (create_parameter would silently overwrite); derive a
+        # per-weight name like dynamic_lstmp's proj derivation
+        if isinstance(param_attr, ParamAttr) and param_attr.name:
+            return ParamAttr(name=param_attr.name + suffix)
+        return param_attr
+
+    gate_w = helper.create_parameter(_sub_attr("_gate"),
+                                     [d_model, num_experts], x.dtype)
+    w1 = helper.create_parameter(_sub_attr("_w1"),
+                                 [num_experts, d_model, d_hidden], x.dtype)
+    b1 = helper.create_parameter(None, [num_experts, d_hidden], x.dtype,
+                                 is_bias=True)
+    w2 = helper.create_parameter(_sub_attr("_w2"),
+                                 [num_experts, d_hidden, d_model], x.dtype)
+    b2 = helper.create_parameter(None, [num_experts, d_model], x.dtype,
+                                 is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    attrs = {"capacity_factor": capacity_factor}
+    if ep_ring_id is not None:
+        attrs["ep_ring_id"] = int(ep_ring_id)
+    helper.append_op("switch_moe",
+                     {"X": x, "GateW": gate_w, "W1": w1, "B1": b1,
+                      "W2": w2, "B2": b2},
+                     {"Out": out, "AuxLoss": aux}, attrs)
+    return out, aux
+
+
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """fluid.layers.py_func (py_func_op.cc) — run a host-python function as
     an op; lowers to jax.pure_callback so it composes with jit.  The
@@ -2222,8 +2262,10 @@ __all__ += _GENERATED_LAYERS
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
-                  is_reverse=False, proj_activation="tanh", name=None,
-                  h_0=None, c_0=None, proj_param_attr=None):
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  name=None, h_0=None, c_0=None, proj_param_attr=None):
     """fluid.layers.dynamic_lstmp (lstmp_op.cc): LSTM with recurrent
     projection over padded dense input [b, t, 4*hidden] (size = 4*hidden,
     caller pre-projects with an fc, same contract as dynamic_lstm).
@@ -2243,7 +2285,10 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         proj_param_attr = ParamAttr(name=param_attr.name + "_proj")
     pw = helper.create_parameter(proj_param_attr, [hidden, proj_size],
                                  input.dtype)
-    b = helper.create_parameter(bias_attr, [1, 4 * hidden], input.dtype,
+    # peepholes (the reference lstmp default): bias widens to 7*hidden —
+    # 4d gate bias + the W_ic/W_if/W_oc diagonal peephole weights
+    b_width = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(bias_attr, [1, b_width], input.dtype,
                                 is_bias=True)
     proj = helper.create_variable_for_type_inference(input.dtype)
     cell = helper.create_variable_for_type_inference(input.dtype)
@@ -2262,6 +2307,10 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
                               "BatchGate": gate, "BatchCellPreAct": pre,
                               "BatchHidden": hid},
                      attrs={"is_reverse": is_reverse,
+                            "use_peepholes": use_peepholes,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation,
                             "proj_activation": proj_activation})
     return proj, cell
 

@@ -640,3 +640,26 @@ def test_faster_rcnn_head_builds_and_runs():
     assert np.asarray(outs[0]).shape == (1, 8, 4)
     assert np.asarray(outs[1]).shape == (1, 8, 1)
     assert np.isfinite(np.asarray(outs[2])).all()
+
+
+def test_retinanet_target_assign_batch_offsets():
+    """Review r4: with N=2 images the Location/Score indices must carry
+    the i*A global offset (they gather from batch-flattened preds)."""
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 45, 45]], np.float32)
+    gt = np.array([[[0, 0, 10, 10], [0, 0, 0, 0]],
+                   [[0, 0, 10, 10], [0, 0, 0, 0]]], np.float32)
+    lbl = np.array([[1, 0], [2, 0]], np.int32)
+    crowd = np.array([[0, 1], [0, 1]], np.int32)
+    info = np.array([[50, 50, 1], [50, 50, 1]], np.float32)
+    out = _run("retinanet_target_assign",
+               {"Anchor": anchors, "GtBoxes": gt, "GtLabels": lbl,
+                "IsCrowd": crowd, "ImInfo": info},
+               {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    loc = np.asarray(out["LocationIndex"])
+    # image 0's fg anchor is global 0; image 1's fg anchor is global 2
+    # (= 1 * A + 0 with A=2)
+    live = loc[loc >= 0]
+    assert live.tolist() == [0, 2]
+    labels = np.asarray(out["TargetLabel"])[:, 0]
+    # per-image label blocks: [cls, bg] for each image
+    assert labels.tolist() == [1, 0, 2, 0]

@@ -569,7 +569,7 @@ def test_lstmp_matches_numpy():
     with static.program_guard(main, startup):
         x = layers.data("x", [-1, T, 4 * D])
         proj, cell = layers.dynamic_lstmp(
-            x, 4 * D, P, bias_attr=False,
+            x, 4 * D, P, bias_attr=False, use_peepholes=False,
             param_attr=static.ParamAttr(
                 name="lw", initializer=static.NumpyArrayInitializer(wv)),
             proj_param_attr=static.ParamAttr(
@@ -645,3 +645,110 @@ def test_density_prior_box_matches_numpy():
                         min((yy + bh / 2) / IH, 1)]
                     idx += 1
     np.testing.assert_allclose(b, np.clip(exp, 0, 1), rtol=1e-5)
+
+
+def test_lstmp_peepholes_match_numpy():
+    """ADVICE r3: use_peepholes=True (the reference default) — bias
+    widens to [1, 7*hidden] with W_ic/W_if/W_oc diagonals."""
+    B, T, D, P = 2, 3, 4, 3
+    rng = np.random.RandomState(1)
+    xv = rng.rand(B, T, 4 * D).astype(np.float32)
+    wv = rng.rand(P, 4 * D).astype(np.float32) * 0.3
+    pwv = rng.rand(D, P).astype(np.float32) * 0.3
+    bv = rng.rand(1, 7 * D).astype(np.float32) * 0.2
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, T, 4 * D])
+        proj, cell = layers.dynamic_lstmp(
+            x, 4 * D, P, use_peepholes=True,
+            param_attr=static.ParamAttr(
+                name="plw", initializer=static.NumpyArrayInitializer(wv)),
+            bias_attr=static.ParamAttr(
+                name="plb", initializer=static.NumpyArrayInitializer(bv)),
+            proj_param_attr=static.ParamAttr(
+                name="plw_proj",
+                initializer=static.NumpyArrayInitializer(pwv)))
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        got_p, got_c = exe.run(main, feed={"x": xv},
+                               fetch_list=[proj, cell])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    gate_b = bv[0, :4 * D]
+    w_ic, w_if, w_oc = (bv[0, 4 * D:5 * D], bv[0, 5 * D:6 * D],
+                        bv[0, 6 * D:7 * D])
+    r = np.zeros((B, P), np.float32)
+    c = np.zeros((B, D), np.float32)
+    ps, cs = [], []
+    for t in range(T):
+        gates = xv[:, t] + r @ wv + gate_b
+        i, f, cand, o = np.split(gates, 4, axis=-1)
+        i = i + w_ic * c
+        f = f + w_if * c
+        c = sig(f) * c + sig(i) * np.tanh(cand)
+        o = o + w_oc * c
+        h = sig(o) * np.tanh(c)
+        r = np.tanh(h @ pwv)
+        ps.append(r.copy())
+        cs.append(c.copy())
+    np.testing.assert_allclose(np.asarray(got_p), np.stack(ps, 1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_c), np.stack(cs, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_while_strict_truncation_aborts():
+    """ADVICE r3: strict_truncation surfaces a runtime error instead of
+    silently training on a truncated loop state."""
+    from paddle_tpu.static.control_flow import while_loop
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        i = layers.fill_constant([1], "float32", 0.0)
+        n = layers.fill_constant([1], "float32", 100.0)
+        (i_out,) = while_loop(
+            lambda i: layers.less_than(i, n),
+            lambda i: (layers.elementwise_add(
+                i, layers.fill_constant([1], "float32", 1.0)),),
+            [i], max_iters=3, strict_truncation=True)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(Exception, match="truncated"):
+            out = exe.run(main, fetch_list=[i_out])
+            np.asarray(out[0])
+
+
+def test_while_strict_truncation_differentiable():
+    """Review r4: the strict host check must not break the bounded
+    while's reverse-mode path (io_callback is custom_vjp-shielded)."""
+    from paddle_tpu.static.control_flow import while_loop
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2])
+        i = layers.fill_constant([1], "float32", 0.0)
+        n = layers.fill_constant([1], "float32", 3.0)
+        s = layers.reshape(layers.reduce_sum(x), [1])
+        i_out, s_out = while_loop(
+            lambda i, s: layers.less_than(i, n),
+            lambda i, s: (layers.elementwise_add(
+                i, layers.fill_constant([1], "float32", 1.0)),
+                layers.elementwise_mul(
+                    s, layers.fill_constant([1], "float32", 2.0))),
+            [i, s], max_iters=8, strict_truncation=True)
+        loss = layers.mean(s_out)
+        static.SGD(0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (lv,) = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                        fetch_list=[loss])
+    # 3 doublings of sum(x)=4 -> 32; loop NOT truncated so no abort, and
+    # backward compiled fine through the shielded check
+    np.testing.assert_allclose(float(np.asarray(lv)), 32.0, rtol=1e-5)
