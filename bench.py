@@ -133,6 +133,11 @@ def main():
     if os.environ.get("BENCH_FLASH", "") != "":
         enable_flash_attention(
             os.environ["BENCH_FLASH"] not in ("0", "false"))
+    # BENCH_FUSED_CE=1: route the [tokens, vocab] cross-entropy through
+    # the Pallas online fused kernel for A/B (tools/tune_fused_xent.py)
+    if os.environ.get("BENCH_FUSED_CE", "") not in ("", "0", "false"):
+        from paddle_tpu.ops.fused_xent import enable_fused_xent
+        enable_fused_xent(True)
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
                                               heads, batch, use_amp=use_amp)

@@ -365,12 +365,24 @@ def convert_print(*args, **kwargs):
     from ..dygraph.tensor import Tensor
     if _recording() and any(isinstance(a, Tensor) for a in args):
         from ..dygraph import tracer as dytracer
-        msg_parts = [a for a in args if not isinstance(a, Tensor)]
-        message = kwargs.get("sep", " ").join(str(p) for p in msg_parts)
+        # one print op per tensor, carrying the non-tensor text that
+        # precedes it, so "a:", t1, "b:", t2 keeps its interleaving;
+        # trailing text rides the last tensor's op
+        sep = kwargs.get("sep", " ")
+        pending = []
+        ops = []
         for a in args:
             if isinstance(a, Tensor):
-                dytracer.trace_op("print", {"In": a},
-                                  {"message": message}, ["Out"])
+                ops.append([sep.join(str(p) for p in pending), a])
+                pending = []
+            else:
+                pending.append(a)
+        if pending and ops:
+            ops[-1][0] += (" | trailing: " +
+                           sep.join(str(p) for p in pending))
+        for message, t in ops:
+            dytracer.trace_op("print", {"In": t},
+                              {"message": message}, ["Out"])
         return
     print(*[np.asarray(a._value) if isinstance(a, Tensor) else a
             for a in args], **kwargs)

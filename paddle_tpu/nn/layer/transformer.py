@@ -356,11 +356,7 @@ class SwitchMoE(Layer):
         self.ep_ring_id = ep_ring_id
 
         def _sub_attr(suffix):
-            # a NAMED weight_attr must not be shared across the three
-            # differently-shaped weights (same-name params collide)
-            if isinstance(weight_attr, ParamAttr) and weight_attr.name:
-                return ParamAttr(name=weight_attr.name + suffix)
-            return weight_attr
+            return ParamAttr.derive(weight_attr, suffix)
 
         self.gate_w = self.create_parameter(
             [d_model, num_experts], attr=_sub_attr("_gate"),

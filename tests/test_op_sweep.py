@@ -1072,6 +1072,29 @@ def test_sweep_registry_coverage_accounting():
         "optimization_barrier", "coalesce_tensor",
         # rnn suite
         "gru", "lstm", "rnn", "gru_unit", "lstm_unit",
+        # detection tail suite (tests/test_detection_tail.py)
+        "matrix_nms", "locality_aware_nms", "retinanet_detection_output",
+        "rpn_target_assign", "retinanet_target_assign", "target_assign",
+        "generate_proposal_labels", "generate_mask_labels",
+        "mine_hard_examples", "collect_fpn_proposals",
+        "distribute_fpn_proposals", "box_decoder_and_assign",
+        "polygon_box_transform", "roi_perspective_transform",
+        "prroi_pool", "psroi_pool", "detection_map", "density_prior_box",
+        # sparse CTR suite (tests/test_sparse_feature.py) + PS suite
+        "cvm", "shuffle_batch", "filter_by_instag", "hash",
+        "pyramid_hash", "tdm_child", "tdm_sampler",
+        "distributed_lookup_table", "send", "recv", "fetch_barrier",
+        # straggler suite (tests/test_stragglers.py)
+        "crop", "crop_tensor", "proximal_gd", "proximal_adagrad",
+        "modified_huber_loss", "teacher_student_sigmoid_loss",
+        "positive_negative_pair", "sequence_scatter",
+        "sequence_topk_avg_pooling", "fsp", "inplace_abn", "conv_shift",
+        "attention_lstm", "match_matrix_tensor", "var_conv_2d",
+        "tree_conv", "similarity_focus",
+        # moe suite (tests/test_moe.py), sampled-loss suite, op-tail suite
+        "switch_moe", "nce", "hierarchical_sigmoid", "sample_logits",
+        "chunk_eval", "lstmp", "deformable_conv", "deformable_conv_v1",
+        "sequence_erase",
         # collective kernels under the dp-mesh suites
         "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
         "c_allreduce_prod", "c_broadcast", "c_allgather",

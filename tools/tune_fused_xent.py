@@ -1,0 +1,93 @@
+"""On-chip A/B for the Pallas fused softmax-cross-entropy kernel
+(ops/fused_xent.py) vs XLA's log_softmax+gather at the bench shape
+([batch*seq, 30522] logits) and a few block configs.
+
+Run ON TPU:  python tools/tune_fused_xent.py
+Prints a table; paste the winner into docs/perf.md and flip
+FLAGS_fused_xent in bench.py / training configs if the kernel wins.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, n=20):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    from paddle_tpu.ops.fused_xent import fused_softmax_xent
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}")
+    interpret = dev.platform != "tpu"
+    if interpret:
+        print("WARNING: not on TPU — interpreter timings are meaningless; "
+              "run this on the chip")
+
+    rng = np.random.RandomState(0)
+    results = []
+    for T, V, dtype in [(16384, 30522, jnp.bfloat16),
+                        (8192, 30522, jnp.bfloat16),
+                        (16384, 30522, jnp.float32)]:
+        logits = jnp.asarray(rng.randn(T, V), dtype)
+        labels = jnp.asarray(rng.randint(0, V, (T,)), jnp.int32)
+
+        @jax.jit
+        def xla_ce(lg):
+            lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            return -lp[jnp.arange(T), labels]
+
+        @jax.jit
+        def xla_ce_grad(lg):
+            return jax.grad(lambda l: jnp.sum(
+                -jax.nn.log_softmax(l.astype(jnp.float32))[
+                    jnp.arange(T), labels]))(lg)
+
+        base_f = timed(xla_ce, logits)
+        base_b = timed(xla_ce_grad, logits)
+        row = {"T": T, "V": V, "dtype": str(jnp.dtype(dtype)),
+               "xla_fwd_ms": round(base_f, 3),
+               "xla_fwdbwd_ms": round(base_b, 3), "pallas": {}}
+        for bt, bv in [(128, 2048), (256, 2048), (256, 4096),
+                       (512, 2048)]:
+            try:
+                @jax.jit
+                def pallas_ce(lg):
+                    return fused_softmax_xent(lg, labels, -100, bt, bv,
+                                              interpret)
+
+                @jax.jit
+                def pallas_grad(lg):
+                    return jax.grad(lambda l: jnp.sum(
+                        fused_softmax_xent(l, labels, -100, bt, bv,
+                                           interpret)))(lg)
+
+                f = timed(pallas_ce, logits)
+                b = timed(pallas_grad, logits)
+                row["pallas"][f"bt{bt}_bv{bv}"] = {
+                    "fwd_ms": round(f, 3), "fwdbwd_ms": round(b, 3),
+                    "fwd_speedup": round(base_f / f, 3),
+                    "fwdbwd_speedup": round(base_b / b, 3)}
+            except Exception as e:  # config rejected by Mosaic
+                row["pallas"][f"bt{bt}_bv{bv}"] = {"error": str(e)[:120]}
+        results.append(row)
+        print(row)
+    import json
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
