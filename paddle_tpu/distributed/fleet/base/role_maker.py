@@ -84,12 +84,28 @@ class RoleMakerBase:
         return (self.worker_index() if self.is_worker()
                 else self.server_index())
 
-    # barrier/gather: Gloo in the reference; degenerate single-process here,
-    # multi-host rides jax.distributed once initialised
+    # barrier/gather: real Gloo-analog over the rendezvous store when the
+    # launcher configured one (PADDLE_GLOO_RENDEZVOUS env contract);
+    # degenerate single-process otherwise.  Cached PER INSTANCE (each
+    # role maker has its own role; a process-wide cache would freeze the
+    # first caller's role — or a pre-env None — for everyone)
+    def _get_gloo(self):
+        if not getattr(self, "_gloo_checked", False):
+            from ...gloo import gloo_from_env
+            self._gloo = gloo_from_env(
+                "worker" if self.is_worker() else "server")
+            self._gloo_checked = True
+        return self._gloo
+
     def _barrier(self, comm_world=None):
-        pass
+        g = self._get_gloo()
+        if g is not None:
+            g.barrier(comm_world or "worker")
 
     def _all_gather(self, input, comm_world=None):
+        g = self._get_gloo()
+        if g is not None:
+            return g.all_gather(input, comm_world or "worker")
         return [input]
 
 

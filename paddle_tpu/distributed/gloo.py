@@ -1,0 +1,226 @@
+"""Gloo-analog: CPU-side barrier / all_gather / all_reduce for the fleet
+control plane.
+
+Reference: /root/reference/paddle/fluid/framework/fleet/gloo_wrapper.h:45,106
+(GlooWrapper over a gloo store; HdfsStore/HTTP rendezvous) and the python
+wrapper /root/reference/python/paddle/distributed/fleet/base/role_maker.py:31
+(class Gloo, RENDEZVOUS.HDFS/FILE/HTTP).
+
+TPU-native scope: the DENSE collective path is XLA over ICI and never
+touches this; what Gloo actually does for fleet jobs is host-side
+coordination — role-maker barriers, UtilBase all_gather of small python
+objects, PS init fences.  So this is a small store-based implementation
+with two rendezvous backends:
+
+  * FILE  — a shared directory (single host or NFS): each rank writes
+    `<prefix>/<world>/<generation>/<rank>` and polls for its peers —
+    byte-for-byte the HdfsStore pattern with local files.
+  * HTTP  — the KV server (distributed/ps/kv_server.py) as the store,
+    reusing its OP_SET/OP_PULL plane (the reference's http_server.py
+    role).
+
+Generation counters make barriers/gathers reusable (no stale-key
+aliasing between consecutive collectives).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, List, Optional
+
+__all__ = ["Gloo", "RENDEZVOUS"]
+
+
+class RENDEZVOUS:
+    HDFS = 1   # accepted for parity; maps to FILE semantics on a mount
+    FILE = 2
+    HTTP = 3
+
+
+class _FileStore:
+    def __init__(self, path: str, prefix: str = ""):
+        self.root = os.path.join(path, prefix or "gloo")
+        os.makedirs(self.root, exist_ok=True)
+
+    def set(self, key: str, blob: bytes):
+        p = os.path.join(self.root, key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, p)  # atomic publish
+
+    def get(self, key: str) -> Optional[bytes]:
+        p = os.path.join(self.root, key)
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+
+class _KVStore:
+    def __init__(self, endpoint: str, prefix: str = ""):
+        from .ps.kv_server import KVClient
+        self._c = KVClient([endpoint])
+        self._prefix = prefix or "gloo"
+
+    def set(self, key: str, blob: bytes):
+        import numpy as np
+        self._c.set_param(f"{self._prefix}/{key}",
+                          np.frombuffer(blob, dtype=np.uint8).copy())
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            arr = self._c.pull(f"{self._prefix}/{key}")
+        except KeyError:
+            return None
+        import numpy as np
+        return np.asarray(arr, dtype=np.uint8).tobytes()
+
+
+class Gloo:
+    """Barrier + object collectives over a rendezvous store."""
+
+    def __init__(self):
+        self._store = None
+        self._rank = 0
+        self._size = 1
+        self._gen = {}
+        self._timeout = float(os.environ.get(
+            "PADDLE_GLOO_RUN_TIMEOUT_SECONDS", "300"))
+        self._is_initialized = False
+
+    # -- reference Gloo.init signature (role_maker.py:65) -------------------
+    def init(self, rendezvous, role, role_id, worker_num, server_num=0,
+             need_init_all=False, kwargs=None):
+        kwargs = kwargs or {}
+        prefix = kwargs.get("store.prefix", "")
+        if rendezvous in (RENDEZVOUS.FILE, RENDEZVOUS.HDFS):
+            path = kwargs.get("dfs.path", "")
+            if not path:
+                raise ValueError("Gloo FILE rendezvous needs dfs.path")
+            self._store = _FileStore(path, prefix)
+        elif rendezvous == RENDEZVOUS.HTTP:
+            host = kwargs.get("http.host", "")
+            port = kwargs.get("http.port", "")
+            if not host or not port:
+                raise ValueError("Gloo HTTP rendezvous needs http.host/port")
+            self._store = _KVStore(f"{host}:{port}", prefix)
+        else:
+            raise ValueError(f"unknown rendezvous {rendezvous}")
+        self._rank = int(role_id)
+        # the size of THIS role's world (reference: servers rendezvous in
+        # their own comm, role_maker.py _init_fs role="SERVER")
+        self._size = int(server_num if (str(role).lower() == "server"
+                                        and server_num) else worker_num)
+        self._role = role
+        self._is_initialized = True
+
+    def rank(self):
+        return self._rank
+
+    def size(self):
+        return self._size
+
+    def is_initialized(self):
+        return self._is_initialized
+
+    # -- collectives --------------------------------------------------------
+    def _next_gen(self, world: str) -> int:
+        g = self._gen.get(world, 0)
+        self._gen[world] = g + 1
+        return g
+
+    def _gather_blobs(self, world: str, payload: bytes) -> List[bytes]:
+        gen = self._next_gen(world)
+        base = f"{world}/{gen}"
+        self._store.set(f"{base}/{self._rank}", payload)
+        out: List[Optional[bytes]] = [None] * self._size
+        deadline = time.time() + self._timeout
+        while True:
+            missing = False
+            for r in range(self._size):
+                if out[r] is None:
+                    out[r] = self._store.get(f"{base}/{r}")
+                    if out[r] is None:
+                        missing = True
+            if not missing:
+                return out  # type: ignore[return-value]
+            if time.time() > deadline:
+                absent = [r for r in range(self._size) if out[r] is None]
+                raise TimeoutError(
+                    f"gloo {world} collective gen {gen}: ranks {absent} "
+                    f"absent after {self._timeout:.0f}s")
+            time.sleep(0.02)
+
+    def barrier(self, comm_world: str = "worker"):
+        self._gather_blobs(f"barrier/{comm_world}", b"1")
+
+    def all_gather(self, obj: Any, comm_world: str = "worker") -> List[Any]:
+        blobs = self._gather_blobs(f"gather/{comm_world}",
+                                   pickle.dumps(obj))
+        return [pickle.loads(b) for b in blobs]
+
+    def all_reduce(self, x, fn="sum", comm_world: str = "worker"):
+        import numpy as np
+        vals = self.all_gather(np.asarray(x), comm_world)
+        if fn in ("sum", "SUM"):
+            return sum(vals[1:], vals[0])
+        if fn in ("max", "MAX"):
+            return np.maximum.reduce(vals)
+        if fn in ("min", "MIN"):
+            return np.minimum.reduce(vals)
+        raise ValueError(f"unknown reduce fn {fn!r}")
+
+
+def gloo_from_env(role: str = "worker") -> Optional[Gloo]:
+    """Build a Gloo from the launcher env contract
+    (PADDLE_GLOO_RENDEZVOUS / PADDLE_GLOO_FS_PATH /
+    PADDLE_GLOO_HTTP_ENDPOINT — the reference fleet launch variables);
+    returns None when no rendezvous is configured.
+
+    rank/size are ROLE-aware: workers index by PADDLE_TRAINER_ID over
+    PADDLE_TRAINERS_NUM, servers by their endpoint's position in
+    PADDLE_PSERVERS_IP_PORT_LIST (or PADDLE_PSERVER_ID), so the two
+    role worlds never alias each other's store keys."""
+    rdv = os.environ.get("PADDLE_GLOO_RENDEZVOUS", "")
+    if not rdv:
+        return None
+    g = Gloo()
+    if role == "server":
+        servers = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+        size = max(1, len(servers))
+        rank_env = os.environ.get("PADDLE_PSERVER_ID")
+        if rank_env is not None:
+            rank = int(rank_env)
+        else:
+            ep = (f"{os.environ.get('POD_IP', '127.0.0.1')}:"
+                  f"{os.environ.get('PADDLE_PORT', '0')}")
+            rank = servers.index(ep) if ep in servers else 0
+    else:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    kwargs = {
+        # run-unique namespace: a restarted/elastic incarnation (or a
+        # second job sharing the same dfs.path) must not consume a
+        # previous run's blobs — the launcher stamps a fresh id per
+        # incarnation; collectives can't survive a MID-RUN single-rank
+        # restart (peers are mid-generation), which matches the
+        # reference gloo's behavior (rendezvous is per-job)
+        "store.prefix": "gloo_" + os.environ.get(
+            "PADDLE_GLOO_RUN_ID", os.environ.get("PADDLE_JOB_ID", "run0")),
+    }
+    rdv_i = int(rdv)
+    if rdv_i in (RENDEZVOUS.FILE, RENDEZVOUS.HDFS):
+        kwargs["dfs.path"] = os.environ.get("PADDLE_GLOO_FS_PATH", "")
+    else:
+        ep = os.environ.get("PADDLE_GLOO_HTTP_ENDPOINT", "")
+        host, _, port = ep.rpartition(":")
+        kwargs["http.host"] = host
+        kwargs["http.port"] = port
+    g.init(rdv_i, role, rank, worker_num=size, server_num=size,
+           kwargs=kwargs)
+    return g

@@ -1,0 +1,136 @@
+"""Model encryption (C23 tail) — capability parity with the reference's
+crypto stack (/root/reference/paddle/fluid/framework/io/crypto/{cipher.h:24
+Cipher/CipherFactory, cipher_utils.h:24 CipherUtils GenKey/GenKeyToFile/
+ReadKeyFromFile}; pybind surface paddle/fluid/pybind/crypto.cc).
+
+The reference wraps OpenSSL AES-GCM; here the `cryptography` package's
+AESGCM does the same construction (authenticated encryption, random
+96-bit nonce prepended to the ciphertext — the reference stores its IV
+the same way).  File format: b"PTPUENC1" magic + nonce + ciphertext, so
+a load path can detect encrypted models.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["Cipher", "CipherFactory", "CipherUtils",
+           "encrypt_inference_model", "decrypt_inference_model"]
+
+_MAGIC = b"PTPUENC1"
+
+
+class CipherUtils:
+    """cipher_utils.h:24 parity."""
+
+    @staticmethod
+    def gen_key(length: int) -> bytes:
+        """length in BITS (the reference accepts 128/192/256)."""
+        if length not in (128, 192, 256):
+            raise ValueError("key length must be 128/192/256 bits")
+        return os.urandom(length // 8)
+
+    @staticmethod
+    def gen_key_to_file(length: int, filename: str) -> bytes:
+        key = CipherUtils.gen_key(length)
+        # 0600: the key must never be world-readable (it decrypts every
+        # model the pipeline produces)
+        fd = os.open(filename, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                     0o600)
+        try:
+            os.write(fd, key)
+        finally:
+            os.close(fd)
+        return key
+
+    @staticmethod
+    def read_key_from_file(filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return f.read()
+
+
+class Cipher:
+    """cipher.h:24 Cipher — AES-GCM authenticated encryption."""
+
+    def __init__(self):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        self._impl = AESGCM
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        nonce = os.urandom(12)
+        ct = self._impl(key).encrypt(nonce, bytes(plaintext), None)
+        return _MAGIC + nonce + ct
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        if not ciphertext.startswith(_MAGIC):
+            raise ValueError("not an encrypted paddle_tpu blob "
+                             "(missing magic)")
+        body = ciphertext[len(_MAGIC):]
+        nonce, ct = body[:12], body[12:]
+        return self._impl(key).decrypt(nonce, ct, None)
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes,
+                        filename: str):
+        # tmp + atomic replace: an in-place encrypt interrupted mid-write
+        # must never leave a magic-prefixed truncated file shadowing the
+        # (destroyed) plaintext
+        tmp = filename + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+        os.replace(tmp, filename)
+
+    def decrypt_from_file(self, key: bytes, filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    """cipher.h:44 — config-file selection collapses to the one AEAD."""
+
+    @staticmethod
+    def create_cipher(config_file: Optional[str] = None) -> Cipher:
+        return Cipher()
+
+
+def is_encrypted(path: str) -> bool:
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(_MAGIC)) == _MAGIC
+    except OSError:
+        return False
+
+
+def encrypt_inference_model(dirname: str, key: bytes,
+                            out_dirname: Optional[str] = None):
+    """Encrypt every file of a saved inference model directory in place
+    (or into out_dirname) — the deploy-side story the reference's
+    paddle_inference C API consumes via SetModelBuffer."""
+    out_dirname = out_dirname or dirname
+    os.makedirs(out_dirname, exist_ok=True)
+    c = Cipher()
+    for name in sorted(os.listdir(dirname)):
+        src = os.path.join(dirname, name)
+        if name.endswith(".tmp") or not os.path.isfile(src) \
+                or is_encrypted(src):
+            continue
+        with open(src, "rb") as f:
+            blob = f.read()
+        c.encrypt_to_file(blob, key, os.path.join(out_dirname, name))
+
+
+def decrypt_inference_model(dirname: str, key: bytes,
+                            out_dirname: Optional[str] = None):
+    out_dirname = out_dirname or dirname
+    os.makedirs(out_dirname, exist_ok=True)
+    c = Cipher()
+    for name in sorted(os.listdir(dirname)):
+        src = os.path.join(dirname, name)
+        if name.endswith(".tmp") or not os.path.isfile(src) \
+                or not is_encrypted(src):
+            continue
+        blob = c.decrypt_from_file(key, src)
+        dst = os.path.join(out_dirname, name)
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, dst)

@@ -1,0 +1,95 @@
+"""Gloo-analog tests (C11): real 2-process barrier + all_gather over the
+FILE rendezvous, plus the KV-server HTTP-store path in-process."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+
+def test_gloo_file_store_two_processes(tmp_path):
+    worker = textwrap.dedent("""
+        import json, os, sys
+        sys.path.insert(0, %r)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from paddle_tpu.distributed.gloo import Gloo, RENDEZVOUS
+        rank = int(sys.argv[1]); path = sys.argv[2]
+        g = Gloo()
+        g.init(RENDEZVOUS.FILE, "worker", rank, 2,
+               kwargs={"dfs.path": path})
+        g.barrier()
+        got = g.all_gather({"rank": rank, "val": rank * 10})
+        s = g.all_reduce(rank + 1, "sum")
+        g.barrier()
+        with open(os.path.join(path, f"out{rank}.json"), "w") as f:
+            json.dump({"gather": got, "sum": int(s)}, f)
+    """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    script = tmp_path / "gloo_worker.py"
+    script.write_text(worker)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), \
+        [o[-1500:].decode() for o in outs]
+    for r in range(2):
+        with open(tmp_path / f"out{r}.json") as f:
+            res = json.load(f)
+        assert res["gather"] == [{"rank": 0, "val": 0},
+                                 {"rank": 1, "val": 10}]
+        assert res["sum"] == 3
+
+
+def test_gloo_kv_store_roundtrip():
+    from paddle_tpu.distributed.gloo import Gloo, RENDEZVOUS
+    from paddle_tpu.distributed.ps.kv_server import KVServer
+    srv = KVServer("127.0.0.1:0", num_trainers=1)
+    srv.serve_in_thread()
+    try:
+        host, port = srv.endpoint.rsplit(":", 1)
+        g = Gloo()
+        g.init(RENDEZVOUS.HTTP, "worker", 0, 1,
+               kwargs={"http.host": host, "http.port": port})
+        g.barrier()
+        assert g.all_gather([1, "two"]) == [[1, "two"]]
+        np.testing.assert_allclose(g.all_reduce(np.ones(3), "sum"),
+                                   np.ones(3))
+    finally:
+        srv.stop()
+
+
+def test_role_maker_uses_gloo_env(tmp_path, monkeypatch):
+    from paddle_tpu.distributed.fleet.base import role_maker as rm
+    # instance created BEFORE the env is set must not poison later ones
+    pre = rm.PaddleCloudRoleMaker(is_collective=True)
+    assert pre._get_gloo() is None
+    monkeypatch.setenv("PADDLE_GLOO_RENDEZVOUS", "2")
+    monkeypatch.setenv("PADDLE_GLOO_FS_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    r = rm.PaddleCloudRoleMaker(is_collective=True)
+    r._barrier()
+    assert r._all_gather("x") == ["x"]
+    assert r._get_gloo() is not None
+
+
+def test_gloo_from_env_server_role(tmp_path, monkeypatch):
+    """Review r4: server-role rank/size come from the PSERVER env, not
+    the trainer vars (two servers must not both be rank 0 of world 2)."""
+    from paddle_tpu.distributed.gloo import gloo_from_env
+    monkeypatch.setenv("PADDLE_GLOO_RENDEZVOUS", "2")
+    monkeypatch.setenv("PADDLE_GLOO_FS_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.1:7000,10.0.0.2:7000")
+    monkeypatch.setenv("POD_IP", "10.0.0.2")
+    monkeypatch.setenv("PADDLE_PORT", "7000")
+    g = gloo_from_env("server")
+    assert g.rank() == 1 and g.size() == 2
+    gw = gloo_from_env("worker")
+    assert gw.rank() == 1 and gw.size() == 3
