@@ -97,15 +97,20 @@ class RoleMakerBase:
             self._gloo_checked = True
         return self._gloo
 
+    def _default_world(self):
+        # a server role maker must never land on the workers' store keys
+        # (different world sizes would alias barriers/gathers)
+        return "worker" if self.is_worker() else "server"
+
     def _barrier(self, comm_world=None):
         g = self._get_gloo()
         if g is not None:
-            g.barrier(comm_world or "worker")
+            g.barrier(comm_world or self._default_world())
 
     def _all_gather(self, input, comm_world=None):
         g = self._get_gloo()
         if g is not None:
-            return g.all_gather(input, comm_world or "worker")
+            return g.all_gather(input, comm_world or self._default_world())
         return [input]
 
 

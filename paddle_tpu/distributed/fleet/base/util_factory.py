@@ -27,12 +27,28 @@ class UtilBase:
     def _worker_num(self):
         return self.role_maker.worker_num() if self.role_maker else 1
 
+    def _gloo(self):
+        """The role maker's Gloo store when the launcher configured a
+        rendezvous — the CPU/PS-mode control plane where jax multihost
+        is never initialised (the reference's UtilBase IS the Gloo
+        consumer, fleet/base/util_factory.py)."""
+        rm = self.role_maker
+        if rm is not None and hasattr(rm, "_get_gloo"):
+            try:
+                return rm._get_gloo()
+            except Exception:
+                return None
+        return None
+
     # -- collectives (util_factory.py parity) -------------------------------
     def all_reduce(self, input, mode="sum", comm_world="worker"):
         arr = np.asarray(input)
         n = self._worker_num()
         if n <= 1:
             return arr
+        g = self._gloo()
+        if g is not None:
+            return np.asarray(g.all_reduce(arr, mode, comm_world))
         try:
             import jax
             import jax.numpy as jnp
@@ -52,6 +68,10 @@ class UtilBase:
     def barrier(self, comm_world="worker"):
         if self._worker_num() <= 1:
             return
+        g = self._gloo()
+        if g is not None:
+            g.barrier(comm_world)
+            return
         try:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("fleet_util_barrier")
@@ -63,6 +83,9 @@ class UtilBase:
         n = self._worker_num()
         if n <= 1:
             return [input]
+        g = self._gloo()
+        if g is not None:
+            return g.all_gather(input, comm_world)
         try:
             from jax.experimental import multihost_utils
             out = multihost_utils.process_allgather(np.asarray(input))

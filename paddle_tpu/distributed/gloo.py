@@ -59,6 +59,12 @@ class _FileStore:
         except FileNotFoundError:
             return None
 
+    def delete(self, key: str):
+        try:
+            os.remove(os.path.join(self.root, key))
+        except OSError:
+            pass
+
 
 class _KVStore:
     def __init__(self, endpoint: str, prefix: str = ""):
@@ -78,6 +84,17 @@ class _KVStore:
             return None
         import numpy as np
         return np.asarray(arr, dtype=np.uint8).tobytes()
+
+    def delete(self, key: str):
+        # KV server has no delete op; overwrite with an empty sentinel —
+        # get() treats a zero-length value as present, so shrink instead
+        # of delete (bounded at one byte per stale key)
+        import numpy as np
+        try:
+            self._c.set_param(f"{self._prefix}/{key}",
+                              np.zeros((0,), np.uint8))
+        except (ConnectionError, OSError):
+            pass
 
 
 class Gloo:
@@ -137,6 +154,14 @@ class Gloo:
         gen = self._next_gen(world)
         base = f"{world}/{gen}"
         self._store.set(f"{base}/{self._rank}", payload)
+        if gen > 1:
+            # safe-point GC: we completed gen-1, so every peer WROTE its
+            # gen-1 blob, and writing gen-1 proves that peer finished
+            # READING all of gen-2 — our gen-2 blob can never be needed
+            # again.  (gen-1 is NOT safe: a slow peer may still be
+            # polling it.)  Keeps a long-running job at <= 2 blobs per
+            # rank per world instead of one per collective.
+            self._store.delete(f"{world}/{gen - 2}/{self._rank}")
         out: List[Optional[bytes]] = [None] * self._size
         deadline = time.time() + self._timeout
         while True:
@@ -199,7 +224,15 @@ def gloo_from_env(role: str = "worker") -> Optional[Gloo]:
         else:
             ep = (f"{os.environ.get('POD_IP', '127.0.0.1')}:"
                   f"{os.environ.get('PADDLE_PORT', '0')}")
-            rank = servers.index(ep) if ep in servers else 0
+            if ep not in servers:
+                # a silent rank-0 fallback would let several servers
+                # claim the same rank and alias store keys — fail loud
+                raise ValueError(
+                    f"gloo server rendezvous: endpoint {ep!r} not in "
+                    f"PADDLE_PSERVERS_IP_PORT_LIST {servers}; set "
+                    "PADDLE_PSERVER_ID explicitly or fix POD_IP/"
+                    "PADDLE_PORT")
+            rank = servers.index(ep)
     else:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))

@@ -38,6 +38,9 @@ class CipherUtils:
         fd = os.open(filename, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
                      0o600)
         try:
+            # O_CREAT's mode only applies to NEW files; an existing key
+            # file keeps its old (possibly world-readable) bits — force
+            os.fchmod(fd, 0o600)
             os.write(fd, key)
         finally:
             os.close(fd)

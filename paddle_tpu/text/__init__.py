@@ -1,3 +1,5 @@
-"""paddle.text — text datasets (and, via paddle.nn, text model layers)."""
+"""paddle.text — text datasets + the text-modeling layer toolkit
+(reference python/paddle/text/: datasets + text.py)."""
 from . import datasets  # noqa: F401
 from .datasets import Imdb, UCIHousing, FakeSeq2SeqData, FakeLMData  # noqa: F401
+from .text import *  # noqa: F401,F403

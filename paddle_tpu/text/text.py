@@ -1,0 +1,554 @@
+"""paddle.text API surface — the reference's text-modeling toolkit
+(/root/reference/python/paddle/text/text.py: RNNCell :67, BasicLSTMCell
+:186, BasicGRUCell :321, RNN :476, stacked/bidirectional variants,
+DynamicDecode :1762, Conv1dPoolLayer :1980, CNNEncoder :2109, the
+Transformer family :2609-3505, LinearChainCRF :3506, CRFDecoding :3655,
+SequenceTagging :3832).
+
+TPU-native: every class here composes the shared kernel registry through
+the nn layer system (so static capture / dygraph / jit all work);
+recurrences are python-stepped in eager and unroll under trace — fused
+lax.scan recurrences live in nn.LSTM/nn.GRU for long sequences."""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+
+from ..dygraph.layers import Layer, LayerList
+from ..nn import functional as F
+from ..nn.layer.common import Linear, Dropout
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.rnn import RNN as _NNRNN, BiRNN as _NNBiRNN, RNNCellBase
+from ..nn.layer.transformer import (  # noqa: F401 (re-exported API)
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder)
+from ..static.initializer import Uniform
+
+__all__ = [
+    "RNNCell", "BasicLSTMCell", "BasicGRUCell", "RNN", "BidirectionalRNN",
+    "StackedRNNCell", "StackedLSTMCell", "LSTM", "BidirectionalLSTM",
+    "StackedGRUCell", "GRU", "BidirectionalGRU", "DynamicDecode",
+    "Conv1dPoolLayer", "CNNEncoder", "PrePostProcessLayer",
+    "MultiHeadAttention", "FFN", "TransformerEncoderLayer",
+    "TransformerEncoder", "TransformerDecoderLayer", "TransformerDecoder",
+    "LinearChainCRF", "CRFDecoding", "SequenceTagging",
+]
+
+
+class RNNCell(RNNCellBase):
+    """text.py:67 RNNCell — base with get_initial_states; subclasses
+    implement forward(inputs, states) -> (out, new_states)."""
+
+
+def _act(name_or_fn, default):
+    if name_or_fn is None:
+        return default
+    if callable(name_or_fn):
+        return name_or_fn
+    return getattr(F, name_or_fn)
+
+
+class BasicLSTMCell(RNNCell):
+    """text.py:186 — single-gate-matrix LSTM with forget_bias folded into
+    the forget gate (Jozefowicz et al. initialization trick)."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._gate_act = _act(gate_activation, F.sigmoid)
+        self._act = _act(activation, F.tanh)
+        self._forget_bias = float(forget_bias)
+        std = 1.0 / _math.sqrt(hidden_size)
+        self.weight = self.create_parameter(
+            [input_size + hidden_size, 4 * hidden_size], param_attr,
+            default_initializer=Uniform(-std, std))
+        self.bias = self.create_parameter([4 * hidden_size], bias_attr,
+                                          is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from ..tensor import math as M
+        from ..tensor.manipulation import concat, split
+        from ..tensor.linalg import matmul
+        if states is None:
+            states = [self.get_initial_states(inputs),
+                      self.get_initial_states(inputs)]
+        h, c = states
+        gates = M.add(matmul(concat([inputs, h], axis=1), self.weight),
+                      self.bias)
+        i, f, cand, o = split(gates, 4, axis=1)
+        f = M.scale(f, 1.0, bias=self._forget_bias)
+        new_c = M.add(M.multiply(c, self._gate_act(f)),
+                      M.multiply(self._gate_act(i), self._act(cand)))
+        new_h = M.multiply(self._gate_act(o), self._act(new_c))
+        return new_h, [new_h, new_c]
+
+
+class BasicGRUCell(RNNCell):
+    """text.py:321 — standard GRU with split gate/candidate weights."""
+
+    def __init__(self, input_size, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._gate_act = _act(gate_activation, F.sigmoid)
+        self._act = _act(activation, F.tanh)
+        std = 1.0 / _math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.gate_weight = self.create_parameter(
+            [input_size + hidden_size, 2 * hidden_size], param_attr,
+            default_initializer=init)
+        self.gate_bias = self.create_parameter(
+            [2 * hidden_size], bias_attr, is_bias=True)
+        self.candidate_weight = self.create_parameter(
+            [input_size + hidden_size, hidden_size], param_attr,
+            default_initializer=init)
+        self.candidate_bias = self.create_parameter(
+            [hidden_size], bias_attr, is_bias=True)
+
+    def forward(self, inputs, states=None):
+        from ..tensor import math as M
+        from ..tensor.manipulation import concat, split
+        from ..tensor.linalg import matmul
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        gates = self._gate_act(M.add(
+            matmul(concat([inputs, h], axis=1), self.gate_weight),
+            self.gate_bias))
+        u, r = split(gates, 2, axis=1)
+        cand = self._act(M.add(
+            matmul(concat([inputs, M.multiply(r, h)], axis=1),
+                   self.candidate_weight), self.candidate_bias))
+        # h' = u*h + (1-u)*c
+        new_h = M.add(M.multiply(u, h),
+                      M.multiply(M.scale(u, -1.0, bias=1.0), cand))
+        return new_h, new_h
+
+
+def _mask_merge(new, old, mask):
+    """mask*new + (1-mask)*old over a (possibly nested) state."""
+    from ..tensor import math as M
+    if isinstance(new, (list, tuple)):
+        return type(new)(_mask_merge(n, o, mask)
+                         for n, o in zip(new, old))
+    inv = M.scale(mask, -1.0, bias=1.0)
+    return M.add(M.multiply(new, mask), M.multiply(old, inv))
+
+
+class RNN(Layer):
+    """text.py:476 — run a cell over the time axis (batch-major).
+
+    With sequence_length, stepping is length-aware: states copy through
+    past each sequence's end (reverse direction starts from the last
+    VALID step, not the padding) and padded outputs are zeroed — the
+    reference RNN's masked-stepping semantics."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self._rnn = _NNRNN(cell, is_reverse=is_reverse,
+                           time_major=time_major)
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is None:
+            return self._rnn(inputs, initial_states)
+        from ..tensor import math as M
+        from ..tensor.manipulation import (unstack, stack, cast,
+                                           unsqueeze)
+        from ..tensor.creation import to_tensor
+        if self.time_major:
+            from ..tensor.manipulation import transpose
+            inputs = transpose(inputs, [1, 0, 2])
+        steps = unstack(inputs, axis=1)
+        T = len(steps)
+        seq = sequence_length
+        if not hasattr(seq, "shape"):
+            seq = to_tensor(np.asarray(seq))
+        seq_f = unsqueeze(cast(seq, "float32"), 1)        # [B, 1]
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        def _zeros_like_state(s):
+            if isinstance(s, (list, tuple)):
+                return type(s)(_zeros_like_state(v) for v in s)
+            return M.scale(s, 0.0)
+
+        for t in order:
+            out, new_states = self.cell(steps[t], states)
+            m = cast(M.scale(seq_f, 1.0, bias=float(-t)) > 0, "float32")
+            outs[t] = M.multiply(out, m)
+            if states is None:
+                # cells default-init to zeros; a padded first step must
+                # keep that zero state, not the padding's output
+                states = _zeros_like_state(new_states)
+            states = _mask_merge(new_states, states, m)
+        result = stack(outs, axis=1)
+        if self.time_major:
+            from ..tensor.manipulation import transpose
+            result = transpose(result, [1, 0, 2])
+        return result, states
+
+
+class StackedRNNCell(RNNCell):
+    """text.py:639 — run a list of cells as one deep cell; dropout (when
+    > 0) applies BETWEEN stacked layers like the reference, switched off
+    by eval()."""
+
+    def __init__(self, cells, dropout=0.0):
+        super().__init__()
+        self.cells = LayerList(cells)
+        self.dropouts = LayerList(
+            [Dropout(dropout) for _ in cells[:-1]]) if dropout else None
+
+    def forward(self, inputs, states=None):
+        new_states = []
+        out = inputs
+        if states is None:
+            states = [None] * len(self.cells)
+        for i, (cell, st) in enumerate(zip(self.cells, states)):
+            out, ns = cell(out, st)
+            if self.dropouts is not None and i < len(self.cells) - 1:
+                out = self.dropouts[i](out)
+            new_states.append(ns)
+        return out, new_states
+
+
+class StackedLSTMCell(StackedRNNCell):
+    """text.py:734."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 forget_bias=1.0, dropout=0.0, dtype="float32"):
+        cells = [BasicLSTMCell(
+            input_size if i == 0 else hidden_size, hidden_size,
+            forget_bias=forget_bias, dtype=dtype)
+            for i in range(num_layers)]
+        super().__init__(cells, dropout=dropout)
+        self.hidden_size = hidden_size
+
+
+class StackedGRUCell(StackedRNNCell):
+    """text.py:1337."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 dropout=0.0, dtype="float32"):
+        cells = [BasicGRUCell(
+            input_size if i == 0 else hidden_size, hidden_size,
+            dtype=dtype) for i in range(num_layers)]
+        super().__init__(cells, dropout=dropout)
+        self.hidden_size = hidden_size
+
+
+class LSTM(Layer):
+    """text.py:886 — stacked LSTM over the sequence."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 forget_bias=1.0, dropout=0.0, is_reverse=False,
+                 time_major=False, dtype="float32"):
+        super().__init__()
+        self.cell = StackedLSTMCell(input_size, hidden_size, num_layers,
+                                    forget_bias, dropout, dtype)
+        self._rnn = RNN(self.cell, is_reverse=is_reverse,
+                        time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self._rnn(inputs, initial_states, sequence_length)
+
+
+class GRU(Layer):
+    """text.py:1470."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 is_reverse=False, time_major=False, dtype="float32"):
+        super().__init__()
+        self.cell = StackedGRUCell(input_size, hidden_size, num_layers,
+                                   dropout, dtype)
+        self._rnn = RNN(self.cell, is_reverse=is_reverse,
+                        time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self._rnn(inputs, initial_states, sequence_length)
+
+
+class BidirectionalRNN(Layer):
+    """text.py:1006 — forward + backward passes merged by merge_mode
+    (concat / sum / ave / mul, the reference set); length-aware when
+    sequence_length is given (the backward pass starts at each
+    sequence's last VALID step)."""
+
+    def __init__(self, cell_fw, cell_bw, merge_mode="concat",
+                 time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False,
+                          time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True,
+                          time_major=time_major)
+        if merge_mode not in ("concat", "sum", "ave", "mul"):
+            raise ValueError(f"unsupported merge_mode {merge_mode!r}")
+        self._merge = merge_mode
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..tensor import math as M
+        from ..tensor.manipulation import concat
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        if self._merge == "concat":
+            out = concat([out_fw, out_bw], axis=-1)
+        elif self._merge == "sum":
+            out = M.add(out_fw, out_bw)
+        elif self._merge == "ave":
+            out = M.scale(M.add(out_fw, out_bw), 0.5)
+        else:
+            out = M.multiply(out_fw, out_bw)
+        return out, (s_fw, s_bw)
+
+
+class BidirectionalLSTM(Layer):
+    """text.py:1144."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 forget_bias=1.0, dropout=0.0, merge_mode="concat",
+                 time_major=False, dtype="float32"):
+        super().__init__()
+        self._birnn = BidirectionalRNN(
+            StackedLSTMCell(input_size, hidden_size, num_layers,
+                            forget_bias, dropout, dtype),
+            StackedLSTMCell(input_size, hidden_size, num_layers,
+                            forget_bias, dropout, dtype),
+            merge_mode=merge_mode, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self._birnn(inputs, initial_states, sequence_length)
+
+
+class BidirectionalGRU(Layer):
+    """text.py:1581."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1, dropout=0.0,
+                 merge_mode="concat", time_major=False, dtype="float32"):
+        super().__init__()
+        self._birnn = BidirectionalRNN(
+            StackedGRUCell(input_size, hidden_size, num_layers, dropout,
+                           dtype),
+            StackedGRUCell(input_size, hidden_size, num_layers, dropout,
+                           dtype),
+            merge_mode=merge_mode, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return self._birnn(inputs, initial_states, sequence_length)
+
+
+class DynamicDecode(Layer):
+    """text.py:1762 — step a decoding cell until every sequence emits the
+    end token or max_step_num is hit (greedy argmax stepping; beam search
+    rides models' generate()/the beam_search op family)."""
+
+    def __init__(self, embedding_fn, output_fn, cell, start_token,
+                 end_token, max_step_num=64):
+        super().__init__()
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.max_step_num = int(max_step_num)
+
+    def forward(self, initial_states=None, batch_ref=None):
+        import numpy as np_
+        from ..dygraph import to_variable
+        b = int(batch_ref.shape[0])
+        tok = to_variable(np_.full((b,), self.start_token, np_.int64))
+        states = initial_states
+        outs = []
+        finished = np_.zeros((b,), bool)
+        for _ in range(self.max_step_num):
+            emb = self.embedding_fn(tok)
+            out, states = self.cell(emb, states)
+            logits = self.output_fn(out)
+            nxt = np_.asarray(logits.numpy()).argmax(-1).astype(np_.int64)
+            nxt = np_.where(finished, self.end_token, nxt)
+            outs.append(nxt)
+            finished |= nxt == self.end_token
+            tok = to_variable(nxt)
+            if finished.all():
+                break
+        return np_.stack(outs, axis=1)
+
+
+class Conv1dPoolLayer(Layer):
+    """text.py:1980 — conv over the time axis + max/avg pool."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=2, pool_stride=2, pool_type="max", act=None,
+                 **kwargs):
+        super().__init__()
+        from ..nn.layer.conv import Conv2D
+        from ..nn.layer.pooling import MaxPool2D, AvgPool2D
+        # 1-d conv/pool as height-1 2-d (the reference does the same)
+        self._conv = Conv2D(num_channels, num_filters,
+                            (1, filter_size), padding=(0, filter_size // 2))
+        self._pool = (MaxPool2D((1, pool_size), (1, pool_stride))
+                      if pool_type == "max"
+                      else AvgPool2D((1, pool_size), (1, pool_stride)))
+        self._act = act
+
+    def forward(self, x):
+        from ..tensor.manipulation import unsqueeze, squeeze
+        y = self._conv(unsqueeze(x, 2))       # [B, C, 1, T]
+        if self._act is not None:
+            y = getattr(F, self._act)(y)
+        y = self._pool(y)
+        return squeeze(y, 2)
+
+
+class CNNEncoder(Layer):
+    """text.py:2109 — parallel Conv1dPool branches, concatenated."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_size=2, pool_stride=2, num_layers=1,
+                 pool_type="max", act=None):
+        super().__init__()
+        sizes = (filter_size if isinstance(filter_size, (list, tuple))
+                 else [filter_size] * num_layers)
+        chans = (num_channels if isinstance(num_channels, (list, tuple))
+                 else [num_channels] * num_layers)
+        filts = (num_filters if isinstance(num_filters, (list, tuple))
+                 else [num_filters] * num_layers)
+        self.branches = LayerList([
+            Conv1dPoolLayer(c, f, s, pool_size, pool_stride,
+                            pool_type=pool_type, act=act)
+            for c, f, s in zip(chans, filts, sizes)])
+
+    def forward(self, x):
+        from ..tensor.manipulation import concat
+        return concat([b(x) for b in self.branches], axis=1)
+
+
+class PrePostProcessLayer(Layer):
+    """text.py:2609 — the transformer 'n d a' process-cmd chain."""
+
+    def __init__(self, process_cmd, d_model, dropout_rate):
+        super().__init__()
+        self.process_cmd = process_cmd
+        self.functors = []
+        for cmd in process_cmd:
+            if cmd == "n":
+                norm = LayerNorm(d_model)
+                setattr(self, f"norm_{len(self.functors)}", norm)
+                self.functors.append(("n", norm))
+            elif cmd == "d":
+                drop = Dropout(dropout_rate)
+                # register as a sublayer (setattr) so eval() reaches it
+                # and switches off the masking
+                setattr(self, f"drop_{len(self.functors)}", drop)
+                self.functors.append(("d", drop))
+            elif cmd == "a":
+                self.functors.append(("a", None))
+
+    def forward(self, x, residual=None):
+        from ..tensor import math as M
+        for cmd, fn in self.functors:
+            if cmd == "a":
+                if residual is not None:
+                    x = M.add(x, residual)
+            else:
+                x = fn(x)
+        return x
+
+
+class FFN(Layer):
+    """text.py:2900 — position-wise feed-forward."""
+
+    def __init__(self, d_inner_hid, d_model, dropout_rate=0.0):
+        super().__init__()
+        self.fc1 = Linear(d_model, d_inner_hid)
+        self.fc2 = Linear(d_inner_hid, d_model)
+        self.drop = Dropout(dropout_rate)
+
+    def forward(self, x):
+        return self.fc2(self.drop(F.relu(self.fc1(x))))
+
+
+class LinearChainCRF(Layer):
+    """text.py:3506 — CRF log-likelihood layer over padded emissions
+    (linear_chain_crf op; Transition carries the start/end rows)."""
+
+    def __init__(self, param_attr=None, size=None, is_test=False,
+                 dtype="float32"):
+        super().__init__()
+        self.size = size
+        self.is_test = is_test
+        self.transition = self.create_parameter(
+            [size + 2, size], attr=param_attr, dtype=dtype)
+
+    def forward(self, input, label, length=None):
+        from ..tensor._dispatch import dispatch
+        ins = {"Emission": input, "Transition": self.transition,
+               "Label": label}
+        if length is not None:
+            ins["Length"] = length
+        out = dispatch("linear_chain_crf", ins, {},
+                       outs=["LogLikelihood"])
+        return out
+
+
+class CRFDecoding(Layer):
+    """text.py:3655 — viterbi decode with the CRF's transitions."""
+
+    def __init__(self, param_attr=None, size=None, is_test=False,
+                 dtype="float32"):
+        super().__init__()
+        self.size = size
+        self.transition = self.create_parameter(
+            [size + 2, size], attr=param_attr, dtype=dtype)
+
+    def forward(self, input, label=None, length=None):
+        from ..tensor._dispatch import dispatch
+        ins = {"Emission": input, "Transition": self.transition}
+        if label is not None:
+            ins["Label"] = label
+        if length is not None:
+            ins["Length"] = length
+        return dispatch("crf_decoding", ins, {}, outs=["ViterbiPath"])
+
+
+class SequenceTagging(Layer):
+    """text.py:3832 — the lexical-analysis model: embedding -> stacked
+    Bi-GRU -> emission fc -> CRF loss (+ viterbi decode at inference).
+    Shares ONE transition parameter between loss and decode like the
+    reference (crf_decoding reads the crf layer's weight)."""
+
+    def __init__(self, vocab_size, num_labels, word_emb_dim=128,
+                 grnn_hidden_dim=128, emb_learning_rate=0.1,
+                 crf_learning_rate=0.1, bigru_num=2, init_bound=0.1):
+        super().__init__()
+        from ..nn.layer.common import Embedding
+        self.word_embedding = Embedding(vocab_size, word_emb_dim)
+        self.bigrus = LayerList([
+            BidirectionalGRU(word_emb_dim if i == 0
+                             else 2 * grnn_hidden_dim, grnn_hidden_dim)
+            for i in range(bigru_num)])
+        self.fc = Linear(2 * grnn_hidden_dim, num_labels)
+        self.linear_chain_crf = LinearChainCRF(size=num_labels)
+        self.crf_decoding = CRFDecoding(size=num_labels)
+        # decode reads the TRAINED transitions: alias the parameter
+        # object (dygraph parameters don't alias by ParamAttr name; the
+        # reference's static graph shares the var by name instead)
+        self.crf_decoding.transition = self.linear_chain_crf.transition
+
+    def forward(self, word, target=None, length=None):
+        x = self.word_embedding(word)
+        for g in self.bigrus:
+            x, _ = g(x)
+        emission = self.fc(x)
+        if target is not None:
+            crf_cost = self.linear_chain_crf(emission, target, length)
+            return crf_cost, emission
+        return self.crf_decoding(emission, length=length)

@@ -1,0 +1,197 @@
+"""paddle.text toolkit tests (reference python/paddle/text/text.py) —
+cells/RNNs forward + numerics, CNN encoder, and the SequenceTagging
+CRF model training end to end."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.text as text
+from paddle_tpu.dygraph import guard, to_variable
+
+
+def test_basic_lstm_cell_matches_numpy():
+    with guard():
+        cell = text.BasicLSTMCell(4, 3, forget_bias=1.0)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        out, (h, c) = cell(to_variable(x))
+        w = np.asarray(cell.weight.numpy())
+        b = np.asarray(cell.bias.numpy())
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        xin = np.concatenate([x, np.zeros((2, 3), np.float32)], 1)
+        gates = xin @ w + b
+        i, f, cand, o = np.split(gates, 4, axis=1)
+        c_ref = sig(f + 1.0) * 0 + sig(i) * np.tanh(cand)
+        h_ref = sig(o) * np.tanh(c_ref)
+        np.testing.assert_allclose(np.asarray(h.numpy()), h_ref,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out.numpy()), h_ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_basic_gru_cell_matches_numpy():
+    with guard():
+        cell = text.BasicGRUCell(4, 3)
+        x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        out, h = cell(to_variable(x))
+        gw = np.asarray(cell.gate_weight.numpy())
+        gb = np.asarray(cell.gate_bias.numpy())
+        cw = np.asarray(cell.candidate_weight.numpy())
+        cb = np.asarray(cell.candidate_bias.numpy())
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        h0 = np.zeros((2, 3), np.float32)
+        xin = np.concatenate([x, h0], 1)
+        u, r = np.split(sig(xin @ gw + gb), 2, axis=1)
+        cand = np.tanh(np.concatenate([x, r * h0], 1) @ cw + cb)
+        h_ref = u * h0 + (1 - u) * cand
+        np.testing.assert_allclose(np.asarray(out.numpy()), h_ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stacked_and_bidirectional_shapes():
+    with guard():
+        x = to_variable(np.random.RandomState(2)
+                        .randn(2, 5, 8).astype(np.float32))
+        lstm = text.LSTM(8, 16, num_layers=2)
+        out, _ = lstm(x)
+        assert tuple(out.shape) == (2, 5, 16)
+        gru = text.GRU(8, 16)
+        out, _ = gru(x)
+        assert tuple(out.shape) == (2, 5, 16)
+        bl = text.BidirectionalLSTM(8, 6)
+        out, _ = bl(x)
+        assert tuple(out.shape) == (2, 5, 12)
+        br = text.BidirectionalRNN(text.BasicGRUCell(8, 4),
+                                   text.BasicGRUCell(8, 4))
+        out, _ = br(x)
+        assert tuple(out.shape) == (2, 5, 8)
+
+
+def test_cnn_encoder_and_ffn():
+    with guard():
+        enc = text.CNNEncoder(num_channels=8, num_filters=4,
+                              filter_size=[3, 5], num_layers=2)
+        x = to_variable(np.random.RandomState(3)
+                        .randn(2, 8, 10).astype(np.float32))
+        out = enc(x)
+        assert tuple(out.shape) == (2, 8, 5)
+        ffn = text.FFN(32, 16)
+        y = ffn(to_variable(np.random.RandomState(4)
+                            .randn(2, 3, 16).astype(np.float32)))
+        assert tuple(y.shape) == (2, 3, 16)
+        ppl = text.PrePostProcessLayer("dan", 16, 0.0)
+        z = ppl(y, residual=y)
+        assert tuple(z.shape) == (2, 3, 16)
+
+
+def test_dynamic_decode_greedy_stops_at_end():
+    with guard():
+        rng = np.random.RandomState(5)
+        emb_w = to_variable(rng.randn(10, 8).astype(np.float32))
+        cell = text.BasicGRUCell(8, 8)
+        proj = paddle_tpu.nn.Linear(8, 10)
+
+        def embedding_fn(tok):
+            from paddle_tpu.tensor.manipulation import gather
+            return gather(emb_w, tok)
+
+        dec = text.DynamicDecode(embedding_fn, proj, cell,
+                                 start_token=1, end_token=2,
+                                 max_step_num=6)
+        out = dec(batch_ref=emb_w)
+        assert out.shape[0] == 10 and 1 <= out.shape[1] <= 6
+
+
+def test_sequence_tagging_crf_trains():
+    """Book-sized convergence: the SequenceTagging model's CRF
+    log-likelihood loss falls on a fixed batch, and decode returns a
+    path of the right shape sharing the SAME transition weights."""
+    with guard():
+        V, C, T, B = 20, 4, 5, 4
+        model = text.SequenceTagging(V, C, word_emb_dim=16,
+                                     grnn_hidden_dim=8, bigru_num=1)
+        rng = np.random.RandomState(0)
+        words = to_variable(rng.randint(0, V, (B, T)).astype(np.int64))
+        target = to_variable(rng.randint(0, C, (B, T)).astype(np.int64))
+        from paddle_tpu.optimizer import Adam
+        opt = Adam(learning_rate=0.05,
+                   parameters=model.parameters())
+        losses = []
+        for _ in range(25):
+            # LogLikelihood output IS the negative log-likelihood cost
+            # (reference linear_chain_crf_op convention)
+            nll, _ = model(words, target)
+            from paddle_tpu.tensor import math as M
+            loss = M.mean(nll)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss.numpy())))
+        assert losses[-1] < losses[0], losses[::6]
+        path = model(words)
+        assert tuple(path.shape) == (B, T)
+        # decode really shares the crf weights (no divergence possible)
+        np.testing.assert_allclose(
+            np.asarray(model.crf_decoding.transition.numpy()),
+            np.asarray(model.linear_chain_crf.transition.numpy()))
+
+
+def test_rnn_sequence_length_masks_and_copies_through():
+    """Review r4: length-aware stepping — padded outputs zero, states
+    copy through, and the reverse direction starts at the last VALID
+    step (not the padding)."""
+    with guard():
+        cell = text.BasicGRUCell(3, 4)
+        rng = np.random.RandomState(7)
+        x_np = rng.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([5, 2], np.int64)
+        out, st = text.RNN(cell)(to_variable(x_np), None,
+                                 to_variable(lens))
+        o = np.asarray(out.numpy())
+        # padded steps of the short sequence emit zeros
+        assert (o[1, 2:] == 0).all() and np.abs(o[1, :2]).sum() > 0
+        # final state of the short sequence == its step-2 output state
+        ref_out, _ = text.RNN(cell)(to_variable(x_np[1:2, :2]))
+        np.testing.assert_allclose(np.asarray(st.numpy())[1],
+                                   np.asarray(ref_out.numpy())[0, -1],
+                                   rtol=1e-5, atol=1e-6)
+        # reverse: first valid output of the short sequence must equal a
+        # fresh reverse run over ONLY its valid prefix
+        r_out, _ = text.RNN(cell, is_reverse=True)(
+            to_variable(x_np), None, to_variable(lens))
+        r_ref, _ = text.RNN(cell, is_reverse=True)(
+            to_variable(x_np[1:2, :2]))
+        np.testing.assert_allclose(np.asarray(r_out.numpy())[1, :2],
+                                   np.asarray(r_ref.numpy())[0],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_merge_modes():
+    with guard():
+        x = to_variable(np.random.RandomState(8)
+                        .randn(2, 4, 3).astype(np.float32))
+        for mode, width in (("concat", 8), ("sum", 4), ("ave", 4),
+                            ("mul", 4)):
+            br = text.BidirectionalRNN(text.BasicGRUCell(3, 4),
+                                       text.BasicGRUCell(3, 4),
+                                       merge_mode=mode)
+            out, _ = br(x)
+            assert tuple(out.shape) == (2, 4, width), mode
+        with pytest.raises(ValueError, match="merge_mode"):
+            text.BidirectionalRNN(text.BasicGRUCell(3, 4),
+                                  text.BasicGRUCell(3, 4),
+                                  merge_mode="zip")
+
+
+def test_prepostprocess_dropout_respects_eval():
+    with guard():
+        ppl = text.PrePostProcessLayer("d", 4, 0.9)
+        ppl.eval()
+        x = to_variable(np.ones((2, 4), np.float32))
+        out = np.asarray(ppl(x).numpy())
+        np.testing.assert_allclose(out, np.ones((2, 4)))
