@@ -1,0 +1,400 @@
+"""Final registry-diff gap batch — the implementable remainder of
+`REGISTER_OPERATOR` sites the reference has and this registry lacked
+(systematic diff, round 4): label_smooth_op.cc, unfold_op.cc,
+segment_pool (incubate segment_{sum,mean,max,min}), partial_concat_op.cc,
+partial_sum_op.cc, pool_with_index_op.cc (3d), conv2d_transpose_op.cc
+(depthwise variant), lod_reset_op.cc, controlflow/select_output,
+get_tensor_from_selected_rows_op.cc, merge_selected_rows_op.cc,
+save_op.cc / load_op.cc / save_combine_op.cc / load_combine_op.cc,
+correlation (contrib optical-flow cost volume).
+
+Deliberately NOT here (documented descopes): mkldnn/x86 fusion_* ops and
+cudnn_lstm (XLA owns fusion), tensorrt/lite engines, quantize/dequantize
+mkldnn trio, BoxPS pull/push family + rank_attention + bilateral_slice
+(CUDA-only industrial tail, C24 descope), LoD array conversion ops
+(padded redesign replaces LoD), run_program (jit.partial_program covers
+the capability architecturally).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# label_smooth / unfold
+# ---------------------------------------------------------------------------
+
+@register_op("label_smooth", inputs=["X", "PriorDist?!"], outputs=["Out"])
+def label_smooth(ins, attrs, ctx):
+    """label_smooth_op.cc — (1-eps)*label + eps*prior (uniform 1/C when
+    no PriorDist)."""
+    x = jnp.asarray(ins["X"])
+    eps = attrs.get("epsilon", 0.0)
+    prior = ins.get("PriorDist")
+    if prior is not None:
+        p = jnp.asarray(prior).reshape(1, -1)
+    else:
+        p = 1.0 / x.shape[-1]
+    return {"Out": (1.0 - eps) * x + eps * p}
+
+
+@register_op("unfold", inputs=["X"], outputs=["Y"])
+def unfold(ins, attrs, ctx):
+    """unfold_op.cc (im2col as the 2.0 API): X [N,C,H,W] ->
+    Y [N, C*kh*kw, L] with L the number of sliding positions."""
+    x = jnp.asarray(ins["X"])
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    dh, dw = attrs.get("dilations", [1, 1])
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[2] if len(p) == 4 else p[0]),
+                    (p[1] if len(p) == 4 else p[1],
+                     p[3] if len(p) == 4 else p[1])])
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    # gather patches: [N, C, kh, kw, oh, ow]
+    rows = (jnp.arange(oh)[:, None] * sh +
+            jnp.arange(kh)[None, :] * dh)         # [oh, kh]
+    cols = (jnp.arange(ow)[:, None] * sw +
+            jnp.arange(kw)[None, :] * dw)         # [ow, kw]
+    patches = x[:, :, rows[:, :, None, None], cols[None, None]]
+    # [N, C, oh, kh, ow, kw] -> [N, C, kh, kw, oh*ow]
+    patches = jnp.transpose(patches, (0, 1, 3, 5, 2, 4))
+    return {"Y": patches.reshape(n, c * kh * kw, oh * ow)}
+
+
+# ---------------------------------------------------------------------------
+# segment_pool / partial_concat / partial_sum
+# ---------------------------------------------------------------------------
+
+@register_op("segment_pool", inputs=["X", "SegmentIds!"],
+             outputs=["Out", "SummedIds?"])
+def segment_pool(ins, attrs, ctx):
+    """segment_pool_op (incubate segment_{sum,mean,max,min}): pool rows
+    of X by SegmentIds.  Output rows = attrs['num_segments'] when given
+    (static-shape contract), else X's row count (ids < N always)."""
+    x = jnp.asarray(ins["X"])
+    ids = jnp.asarray(ins["SegmentIds"]).reshape(-1).astype(jnp.int32)
+    pool = attrs.get("pooltype", "SUM").upper()
+    n_seg = int(attrs.get("num_segments", x.shape[0]))
+    counts = jnp.zeros((n_seg,), x.dtype).at[ids].add(1.0)
+    if pool in ("SUM", "MEAN"):
+        out = jnp.zeros((n_seg,) + x.shape[1:], x.dtype).at[ids].add(x)
+        if pool == "MEAN":
+            out = out / jnp.maximum(counts, 1.0).reshape(
+                (-1,) + (1,) * (x.ndim - 1))
+    elif pool == "MAX":
+        out = jnp.full((n_seg,) + x.shape[1:], -jnp.inf, x.dtype) \
+            .at[ids].max(x)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    elif pool == "MIN":
+        out = jnp.full((n_seg,) + x.shape[1:], jnp.inf, x.dtype) \
+            .at[ids].min(x)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    else:
+        raise ValueError(f"segment_pool: unknown pooltype {pool!r}")
+    return {"Out": out, "SummedIds": counts.reshape(-1, 1)}
+
+
+@register_op("partial_concat", inputs=["X*"], outputs=["Out"])
+def partial_concat(ins, attrs, ctx):
+    """partial_concat_op.cc — concat a [start:start+length] column slice
+    of every input (CTR feature slicing)."""
+    xs = [jnp.asarray(v) for v in ins["X"]]
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    parts = []
+    for x in xs:
+        s = start + x.shape[1] if start < 0 else start
+        end = x.shape[1] if length < 0 else s + length
+        parts.append(x[:, s:end])
+    return {"Out": jnp.concatenate(parts, axis=1)}
+
+
+@register_op("partial_sum", inputs=["X*"], outputs=["Out"])
+def partial_sum(ins, attrs, ctx):
+    """partial_sum_op.cc — elementwise sum of the same column slice of
+    every input."""
+    xs = [jnp.asarray(v) for v in ins["X"]]
+    start = int(attrs.get("start_index", 0))
+    length = int(attrs.get("length", -1))
+    out = None
+    for x in xs:
+        s = start + x.shape[1] if start < 0 else start
+        end = x.shape[1] if length < 0 else s + length
+        sl = x[:, s:end]
+        out = sl if out is None else out + sl
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# max_pool3d_with_index / depthwise_conv2d_transpose
+# ---------------------------------------------------------------------------
+
+@register_op("max_pool3d_with_index", inputs=["X"], outputs=["Out", "Mask"])
+def max_pool3d_with_index(ins, attrs, ctx):
+    """pool_with_index_op.cc (3d) — max pool + flat argmax indices over
+    each [D,H,W] volume, the 3-d sibling of nn.py max_pool2d_with_index:
+    a paired (value, index) reduce_window stays O(input) memory (no
+    kd*kh*kw patch blowup) and breaks ties toward the smallest index
+    like the reference's scan order.  Padded cells carry the init
+    (-inf, sentinel) so they never win and Mask always indexes the
+    UNPADDED volume."""
+    x = jnp.asarray(ins["X"])
+    ksize = list(attrs["ksize"])
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+    strides = list(attrs.get("strides", ksize))
+    pads = list(attrs.get("paddings", [0, 0, 0]))
+    if attrs.get("adaptive", False):
+        raise NotImplementedError(
+            "max_pool3d_with_index adaptive=True: use pool3d(adaptive) "
+            "when indices are not needed")
+    n, c, d, h, w = x.shape
+    idx_map = jnp.broadcast_to(
+        jnp.arange(d * h * w, dtype=jnp.int32).reshape(1, 1, d, h, w),
+        x.shape)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        pick_b = (bv > av) | ((bv == av) & (bi < ai))
+        return (jnp.where(pick_b, bv, av), jnp.where(pick_b, bi, ai))
+
+    init_v = jnp.array(-jnp.inf, x.dtype) \
+        if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.array(jnp.iinfo(x.dtype).min, x.dtype)
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    out, mask = jax.lax.reduce_window(
+        (x, idx_map), (init_v, jnp.array(d * h * w, jnp.int32)), reducer,
+        (1, 1) + tuple(ksize), (1, 1) + tuple(strides), pad_cfg)
+    return {"Out": out, "Mask": mask.astype(jnp.int64)}
+
+
+@register_op("depthwise_conv2d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def depthwise_conv2d_transpose(ins, attrs, ctx):
+    """conv2d_transpose_op.cc depthwise variant (groups == channels).
+    Per-channel transposed conv = spatially-flipped depthwise conv with
+    lhs dilation; Filter [C, 1, kh, kw] is already OIHW for
+    feature_group_count=C, so no group reshuffle is needed."""
+    x = jnp.asarray(ins["Input"])
+    w = jnp.asarray(ins["Filter"])
+    strides = tuple(attrs.get("strides", [1, 1]))
+    dilations = tuple(attrs.get("dilations", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    c = x.shape[1]
+    padding = []
+    for i in range(2):
+        lo = pads[i] if len(pads) == 2 else pads[2 * i]
+        hi = pads[i] if len(pads) == 2 else pads[2 * i + 1]
+        k = (w.shape[2 + i] - 1) * dilations[i] + 1
+        padding.append((k - 1 - lo, k - 1 - hi))
+    out = jax.lax.conv_general_dilated(
+        x, jnp.flip(w, axis=(-1, -2)), (1, 1), padding,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=c)
+    return {"Output": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# lod_reset / select_output / SelectedRows utilities
+# ---------------------------------------------------------------------------
+
+@register_op("lod_reset", inputs=["X", "Y?!"], outputs=["Out", "Length?"])
+def lod_reset(ins, attrs, ctx):
+    """lod_reset_op.cc — in the padded redesign data never moves; the op
+    re-emits X with the NEW per-sequence lengths (from Y's lengths or
+    the target_lod attr converted from offsets)."""
+    x = jnp.asarray(ins["X"])
+    y = ins.get("Y")
+    if y is not None:
+        length = jnp.asarray(y).reshape(-1)
+    else:
+        lod = list(attrs.get("target_lod", []))
+        length = jnp.asarray(np.diff(np.asarray(lod, np.int64))
+                             if len(lod) > 1 else [x.shape[0]])
+    return {"Out": x, "Length": length.astype(jnp.int64)}
+
+
+@register_op("select_output", inputs=["X", "Mask!"], outputs=["Out*"],
+             grad=None)
+def select_output(ins, attrs, ctx):
+    """controlflow/select_output — route X to output branch Mask; the
+    other branches carry zeros (static-shape stand-in for the
+    reference's empty un-selected vars)."""
+    x = jnp.asarray(ins["X"])
+    mask = jnp.asarray(ins["Mask"]).reshape(()).astype(jnp.int32)
+    n = int(attrs.get("num_outputs", 2))
+    return {"Out": [jnp.where(mask == i, x, jnp.zeros_like(x))
+                    for i in range(n)]}
+
+
+@register_op("get_tensor_from_selected_rows", inputs=["X"],
+             outputs=["Out"], grad=None)
+def get_tensor_from_selected_rows(ins, attrs, ctx):
+    """get_tensor_from_selected_rows_op.cc — densify: scatter-add the
+    rows into a [height, ...] tensor."""
+    from ...core.selected_rows import SelectedRows
+    x = ins["X"]
+    if not isinstance(x, SelectedRows):
+        return {"Out": jnp.asarray(x)}
+    vals = jnp.asarray(x.values)
+    dense = jnp.zeros((x.height,) + vals.shape[1:], vals.dtype)
+    return {"Out": dense.at[jnp.asarray(x.rows).astype(jnp.int32)]
+            .add(vals)}
+
+
+@register_op("merge_selected_rows", inputs=["X"], outputs=["Out"],
+             grad=None)
+def merge_selected_rows(ins, attrs, ctx):
+    """merge_selected_rows_op.cc — combine duplicate row ids by adding
+    their values.  Static-shape form: densify then re-emit as arange
+    rows over the full height (duplicates merged by the scatter-add;
+    the reference's compacted unique-row output has a data-dependent
+    shape)."""
+    from ...core.selected_rows import SelectedRows
+    x = ins["X"]
+    if not isinstance(x, SelectedRows):
+        return {"Out": x}
+    vals = jnp.asarray(x.values)
+    dense = jnp.zeros((x.height,) + vals.shape[1:], vals.dtype) \
+        .at[jnp.asarray(x.rows).astype(jnp.int32)].add(vals)
+    return {"Out": SelectedRows(jnp.arange(x.height, dtype=jnp.int32),
+                                dense, x.height)}
+
+
+# ---------------------------------------------------------------------------
+# save / load ops ("save/load IS a program", reference io contract)
+# ---------------------------------------------------------------------------
+
+def _io_path(attrs):
+    p = attrs.get("file_path", "")
+    if not p:
+        raise ValueError("save/load op needs a file_path attr")
+    return p
+
+
+@register_op("save", inputs=["X"], outputs=[], grad=None,
+             side_effect=True)
+def save_op(ins, attrs, ctx):
+    """save_op.cc — persist the input tensor to file_path; ordered host
+    callback so it composes with the jitted whole-block executor."""
+    from jax.experimental import io_callback
+    path = _io_path(attrs)
+
+    def host(arr):
+        import os as _os
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        np.save(path if path.endswith(".npy") else path + ".npy",
+                np.asarray(arr))
+        return np.bool_(True)
+
+    io_callback(host, jax.ShapeDtypeStruct((), jnp.bool_),
+                jnp.asarray(ins["X"]), ordered=True)
+    return {}
+
+
+@register_op("save_combine", inputs=["X*"], outputs=[], grad=None,
+             side_effect=True)
+def save_combine_op(ins, attrs, ctx):
+    """save_combine_op.cc — persist all inputs into ONE file (npz)."""
+    from jax.experimental import io_callback
+    path = _io_path(attrs)
+    names = attrs.get("var_names") or [
+        f"v{i}" for i in range(len(ins["X"]))]
+
+    def host(*arrs):
+        import os as _os
+        _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **{n: np.asarray(a)
+                          for n, a in zip(names, arrs)})
+        return np.bool_(True)
+
+    io_callback(host, jax.ShapeDtypeStruct((), jnp.bool_),
+                *[jnp.asarray(v) for v in ins["X"]], ordered=True)
+    return {}
+
+
+@register_op("load", inputs=[], outputs=["Out"], grad=None,
+             side_effect=True)
+def load_op(ins, attrs, ctx):
+    """load_op.cc — read a tensor saved by the save op.  The file is
+    read at TRACE time (output shapes must be static; load ops run in
+    startup/restore programs that are traced per execution, matching the
+    reference's run-once usage)."""
+    path = _io_path(attrs)
+    arr = np.load(path if path.endswith(".npy") else path + ".npy")
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("load_combine", inputs=[], outputs=["Out*"], grad=None,
+             side_effect=True)
+def load_combine_op(ins, attrs, ctx):
+    """load_combine_op.cc — read the save_combine npz back, in
+    var_names order."""
+    path = _io_path(attrs)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    data = np.load(path)
+    names = attrs.get("var_names") or list(data.files)
+    return {"Out": [jnp.asarray(data[n]) for n in names]}
+
+
+# ---------------------------------------------------------------------------
+# correlation (contrib optical-flow cost volume)
+# ---------------------------------------------------------------------------
+
+@register_op("correlation", inputs=["Input1", "Input2"], outputs=["Output"])
+def correlation(ins, attrs, ctx):
+    """correlation_op.cc/.cu (FlowNet cost volume): one output channel
+    per displacement (di, dj) on the stride2 grid within
+    max_displacement, each the k x k x C patch inner product of x1 with
+    x2 shifted by the displacement, normalized by k*k*C
+    (correlation_op.cu:113 nelems).  Output spatial size follows
+    GetOutputSize (correlation_op.cc:32-45): centers start border_radius
+    = kernel_radius + max_displacement into the zero-padded inputs and
+    step by stride1.  Shifts are zero-padded slices (no wrap-around);
+    the patch sum is one reduce_window per displacement — dense batched
+    math, no gathers."""
+    x1 = jnp.asarray(ins["Input1"])
+    x2 = jnp.asarray(ins["Input2"])
+    pad = int(attrs.get("pad_size", 0))
+    k = int(attrs.get("kernel_size", 1))
+    max_d = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    n, c, h, w = x1.shape
+    rad = (k - 1) // 2
+    border = rad + max_d
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = max(1, -(-(ph - 2 * border) // s1))
+    ow = max(1, -(-(pw - 2 * border) // s1))
+    x1p = jnp.pad(x1, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    # extra max_d halo on x2 so every displacement is a plain slice of
+    # ZEROS beyond the (already padded) image — never a wrap
+    x2p = jnp.pad(x2, [(0, 0), (0, 0), (pad + max_d, pad + max_d),
+                       (pad + max_d, pad + max_d)])
+    disp = list(range(-max_d, max_d + 1, s2))
+    nelems = float(k * k * c)
+    # output centers in the padded frame; window STARTS rad earlier
+    r0 = border - rad
+    rows = r0 + jnp.arange(oh) * s1
+    cols = r0 + jnp.arange(ow) * s1
+    outs = []
+    for di in disp:
+        for dj in disp:
+            shifted = jax.lax.dynamic_slice(
+                x2p, (0, 0, max_d + di, max_d + dj), x1p.shape)
+            prod = jnp.sum(x1p * shifted, axis=1)       # [n, ph, pw]
+            win = jax.lax.reduce_window(
+                prod, 0.0, jax.lax.add, (1, k, k), (1, 1, 1),
+                [(0, 0), (0, 0), (0, 0)])               # window starts
+            outs.append(win[:, rows[:, None], cols[None, :]] / nelems)
+    return {"Output": jnp.stack(outs, axis=1)}

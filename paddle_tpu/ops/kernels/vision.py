@@ -78,23 +78,59 @@ _make_interp("nearest_interp_v2", "nearest")
 _make_interp("bicubic_interp_v2", "bicubic")
 
 
-@register_op("linear_interp", inputs=["X", "OutSize?!"], outputs=["Out"])
-def linear_interp(ins, attrs, ctx):
-    x = ins["X"]  # [n, c, w]
-    ow = attrs.get("out_w", -1)
-    n, c, w = x.shape
-    return {"Out": jax.image.resize(x, (n, c, ow), "linear").astype(x.dtype)}
+def _interp_size_nd(x, attrs, ins, keys):
+    """Resolve target spatial dims for 1-d/3-d interp: OutSize tensor >
+    scale attr > out_* attrs — the same precedence _interp_size applies
+    for the 2-d family."""
+    import numpy as np
+    spatial = x.shape[2:]
+    out = ins.get("OutSize")
+    if out is not None:
+        vals = [int(v) for v in np.asarray(out).reshape(-1)]
+        if len(vals) == len(keys):
+            return vals
+    sizes = [int(attrs.get(k, -1)) for k in keys]
+    if all(s > 0 for s in sizes):
+        return sizes
+    scale = attrs.get("scale", 0.0)
+    scales = (list(scale) if isinstance(scale, (list, tuple))
+              else [scale] * len(keys))
+    if scales and all(s and float(s) > 0 for s in scales):
+        return [int(dim * float(s)) for dim, s in zip(spatial, scales)]
+    raise ValueError(
+        "interp: no target size — give OutSize, positive scale, or "
+        f"{keys}")
 
 
-@register_op("trilinear_interp", inputs=["X", "OutSize?!"], outputs=["Out"])
-def trilinear_interp(ins, attrs, ctx):
-    x = ins["X"]  # [n, c, d, h, w]
-    od = attrs.get("out_d", -1)
-    oh = attrs.get("out_h", -1)
-    ow = attrs.get("out_w", -1)
-    n, c = x.shape[:2]
-    return {"Out": jax.image.resize(x, (n, c, od, oh, ow),
-                                    "trilinear").astype(x.dtype)}
+def _make_interp_1d(name):
+    @register_op(name, inputs=["X", "OutSize?!", "Scale?!"],
+                 outputs=["Out"])
+    def kernel(ins, attrs, ctx):
+        x = ins["X"]  # [n, c, w]
+        (ow,) = _interp_size_nd(x, attrs, ins, ["out_w"])
+        n, c, w = x.shape
+        return {"Out": jax.image.resize(x, (n, c, ow),
+                                        "linear").astype(x.dtype)}
+    return kernel
+
+
+def _make_interp_3d(name):
+    @register_op(name, inputs=["X", "OutSize?!", "Scale?!"],
+                 outputs=["Out"])
+    def kernel(ins, attrs, ctx):
+        x = ins["X"]  # [n, c, d, h, w]
+        od, oh, ow = _interp_size_nd(x, attrs, ins,
+                                     ["out_d", "out_h", "out_w"])
+        n, c = x.shape[:2]
+        return {"Out": jax.image.resize(x, (n, c, od, oh, ow),
+                                        "trilinear").astype(x.dtype)}
+    return kernel
+
+
+linear_interp = _make_interp_1d("linear_interp")
+_make_interp_1d("linear_interp_v2")
+trilinear_interp = _make_interp_3d("trilinear_interp")
+_make_interp_3d("trilinear_interp_v2")
 
 
 @register_op("affine_channel", inputs=["X", "Scale", "Bias"], outputs=["Out"])

@@ -1,0 +1,202 @@
+"""Registry-gap batch tests (round-4 systematic diff vs the reference's
+REGISTER_OPERATOR list)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.registry import run_kernel, OpContext, get_op_info
+
+
+def _run(op, ins, attrs=None):
+    import jax.numpy as jnp
+    dev = {k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else
+               jnp.asarray(v)) for k, v in ins.items()}
+    return run_kernel(op, dev, attrs or {}, OpContext(seed=5))
+
+
+GAP_OPS = ["label_smooth", "unfold", "segment_pool", "partial_concat",
+           "partial_sum", "max_pool3d_with_index",
+           "depthwise_conv2d_transpose", "lod_reset", "select_output",
+           "get_tensor_from_selected_rows", "merge_selected_rows",
+           "save", "load", "save_combine", "load_combine",
+           "correlation", "linear_interp_v2", "trilinear_interp_v2"]
+
+
+def test_registry_probe_gap_ops():
+    missing = [op for op in GAP_OPS if get_op_info(op) is None]
+    assert not missing, f"unregistered gap ops: {missing}"
+
+
+def test_label_smooth():
+    x = np.eye(4, dtype=np.float32)[:2]
+    out = np.asarray(_run("label_smooth", {"X": x},
+                          {"epsilon": 0.1})["Out"])
+    np.testing.assert_allclose(out, 0.9 * x + 0.1 / 4, rtol=1e-6)
+    prior = np.array([0.4, 0.3, 0.2, 0.1], np.float32)
+    out = np.asarray(_run("label_smooth", {"X": x, "PriorDist": prior},
+                          {"epsilon": 0.1})["Out"])
+    np.testing.assert_allclose(out, 0.9 * x + 0.1 * prior, rtol=1e-6)
+
+
+def test_unfold_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    out = np.asarray(_run("unfold", {"X": x},
+                          {"kernel_sizes": [2, 2], "strides": [1, 1],
+                           "paddings": [0, 0, 0, 0],
+                           "dilations": [1, 1]})["Y"])
+    assert out.shape == (1, 8, 9)
+    # first patch = x[:, :, 0:2, 0:2] flattened channel-major
+    exp0 = x[0, :, 0:2, 0:2].reshape(-1)
+    np.testing.assert_allclose(out[0, :, 0], exp0, rtol=1e-6)
+    # last patch
+    expl = x[0, :, 2:4, 2:4].reshape(-1)
+    np.testing.assert_allclose(out[0, :, -1], expl, rtol=1e-6)
+
+
+def test_segment_pool_modes():
+    x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    ids = np.array([0, 0, 2], np.int64)
+    s = np.asarray(_run("segment_pool", {"X": x, "SegmentIds": ids},
+                        {"pooltype": "SUM", "num_segments": 3})["Out"])
+    np.testing.assert_allclose(s, [[4, 6], [0, 0], [5, 6]])
+    m = np.asarray(_run("segment_pool", {"X": x, "SegmentIds": ids},
+                        {"pooltype": "MEAN", "num_segments": 3})["Out"])
+    np.testing.assert_allclose(m, [[2, 3], [0, 0], [5, 6]])
+    mx = np.asarray(_run("segment_pool", {"X": x, "SegmentIds": ids},
+                         {"pooltype": "MAX", "num_segments": 3})["Out"])
+    np.testing.assert_allclose(mx, [[3, 4], [0, 0], [5, 6]])
+
+
+def test_partial_concat_and_sum():
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    b = a + 10
+    out = np.asarray(_run("partial_concat", {"X": [a, b]},
+                          {"start_index": 1, "length": 2})["Out"])
+    np.testing.assert_allclose(out, np.concatenate(
+        [a[:, 1:3], b[:, 1:3]], axis=1))
+    s = np.asarray(_run("partial_sum", {"X": [a, b]},
+                        {"start_index": 1, "length": 2})["Out"])
+    np.testing.assert_allclose(s, a[:, 1:3] + b[:, 1:3])
+
+
+def test_max_pool3d_with_index():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 4, 4, 4).astype(np.float32)
+    out = _run("max_pool3d_with_index", {"X": x},
+               {"ksize": [2, 2, 2], "strides": [2, 2, 2]})
+    o = np.asarray(out["Out"])
+    mask = np.asarray(out["Mask"])
+    assert o.shape == (1, 1, 2, 2, 2)
+    # verify indices point at the max values
+    flat = x[0, 0].reshape(-1)
+    np.testing.assert_allclose(flat[mask[0, 0]], o[0, 0], rtol=1e-6)
+
+
+def test_lod_reset_and_select_output():
+    x = np.ones((3, 2), np.float32)
+    out = _run("lod_reset", {"X": x}, {"target_lod": [0, 2, 3]})
+    np.testing.assert_allclose(np.asarray(out["Out"]), x)
+    assert np.asarray(out["Length"]).tolist() == [2, 1]
+    outs = _run("select_output",
+                {"X": x, "Mask": np.array([1], np.int32)},
+                {"num_outputs": 2})["Out"]
+    assert (np.asarray(outs[0]) == 0).all()
+    np.testing.assert_allclose(np.asarray(outs[1]), x)
+
+
+def test_selected_rows_densify_and_merge():
+    import jax.numpy as jnp
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows(jnp.asarray([1, 3, 1], jnp.int32),
+                      jnp.asarray([[1.0], [2.0], [10.0]]), 5)
+    dense = np.asarray(run_kernel(
+        "get_tensor_from_selected_rows", {"X": sr}, {},
+        OpContext())["Out"])
+    np.testing.assert_allclose(dense[:, 0], [0, 11, 0, 2, 0])
+    merged = run_kernel("merge_selected_rows", {"X": sr}, {},
+                        OpContext())["Out"]
+    np.testing.assert_allclose(np.asarray(merged.values)[:, 0],
+                               [0, 11, 0, 2, 0])
+
+
+def test_save_load_ops_roundtrip(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    path = str(tmp_path / "w")
+
+    def step(v):
+        run_kernel("save", {"X": v}, {"file_path": path}, OpContext())
+        return v * 2
+
+    out = jax.jit(step)(jnp.asarray(x))
+    jax.effects_barrier()
+    np.asarray(out)
+    back = run_kernel("load", {}, {"file_path": path}, OpContext())
+    np.testing.assert_allclose(np.asarray(back["Out"]), x)
+    run_kernel("save_combine",
+               {"X": [jnp.asarray(x), jnp.asarray(x + 1)]},
+               {"file_path": str(tmp_path / "all"),
+                "var_names": ["a", "b"]}, OpContext())
+    jax.effects_barrier()
+    outs = run_kernel("load_combine", {},
+                      {"file_path": str(tmp_path / "all"),
+                       "var_names": ["a", "b"]}, OpContext())["Out"]
+    np.testing.assert_allclose(np.asarray(outs[1]), x + 1)
+
+
+def test_correlation_matches_reference_contract():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3, 4, 4).astype(np.float32)
+    y = rng.randn(1, 3, 4, 4).astype(np.float32)
+    out = np.asarray(_run("correlation",
+                          {"Input1": x, "Input2": y},
+                          {"max_displacement": 1, "stride1": 1,
+                           "stride2": 1, "pad_size": 0,
+                           "kernel_size": 1})["Output"])
+    # GetOutputSize: border=1 -> centers at rows/cols {1, 2}
+    assert out.shape == (1, 9, 2, 2)
+    # center channel (0,0 displacement): mean over C of x*y at centers
+    exp = (x[0] * y[0]).mean(0)[1:3, 1:3]
+    np.testing.assert_allclose(out[0, 4], exp, rtol=1e-5)
+    # displacement (-1,-1) channel at center (1,1): x(1,1) . y(0,0) / C
+    exp_d = (x[0, :, 1, 1] * y[0, :, 0, 0]).mean()
+    np.testing.assert_allclose(out[0, 0, 0, 0], exp_d, rtol=1e-5)
+    # border displacement reaching outside the image contributes ZEROS
+    # (no wrap): displacement (+1,+1) at the last center (2,2) reads
+    # y(3,3) which is valid; use pad-free (-1,-1) at center (1,1) -> ok;
+    # instead check wrap-freedom via a one-hot: x2 nonzero ONLY at
+    # (0,0); displacement (+1,+1) at center (2,2) would wrap to (3,3)=0
+    y2 = np.zeros_like(y)
+    y2[0, :, 0, 0] = 1.0
+    out2 = np.asarray(_run("correlation",
+                           {"Input1": np.ones_like(x), "Input2": y2},
+                           {"max_displacement": 1, "stride1": 1,
+                            "stride2": 1, "pad_size": 0,
+                            "kernel_size": 1})["Output"])
+    # only displacement (-1,-1) at center (1,1) sees the hot pixel
+    assert out2[0, 0, 0, 0] > 0
+    assert out2[0, 8, 1, 1] == 0  # (+1,+1) at (2,2) -> (3,3) is zero
+
+
+def test_interp_v2_aliases():
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    out = np.asarray(_run("linear_interp_v2", {"X": x},
+                          {"out_w": 4})["Out"])
+    assert out.shape == (1, 1, 4)
+    x3 = np.ones((1, 1, 2, 2, 2), np.float32)
+    out3 = np.asarray(_run("trilinear_interp_v2", {"X": x3},
+                           {"out_d": 4, "out_h": 4, "out_w": 4})["Out"])
+    assert out3.shape == (1, 1, 4, 4, 4)
+    np.testing.assert_allclose(out3, 1.0, atol=1e-6)
+
+
+def test_depthwise_conv2d_transpose_runs():
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 4, 5, 5).astype(np.float32)
+    w = rng.randn(4, 1, 3, 3).astype(np.float32)
+    out = np.asarray(_run("depthwise_conv2d_transpose",
+                          {"Input": x, "Filter": w},
+                          {"strides": [1, 1], "paddings": [1, 1]})
+                     ["Output"])
+    assert out.shape[1] == 4 and np.isfinite(out).all()

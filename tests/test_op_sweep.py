@@ -1095,6 +1095,13 @@ def test_sweep_registry_coverage_accounting():
         "switch_moe", "nce", "hierarchical_sigmoid", "sample_logits",
         "chunk_eval", "lstmp", "deformable_conv", "deformable_conv_v1",
         "sequence_erase",
+        # registry-gap suite (tests/test_op_gaps.py)
+        "label_smooth", "unfold", "segment_pool", "partial_concat",
+        "partial_sum", "max_pool3d_with_index",
+        "depthwise_conv2d_transpose", "lod_reset", "select_output",
+        "get_tensor_from_selected_rows", "merge_selected_rows",
+        "save", "load", "save_combine", "load_combine", "correlation",
+        "linear_interp_v2", "trilinear_interp_v2",
         # collective kernels under the dp-mesh suites
         "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
         "c_allreduce_prod", "c_broadcast", "c_allgather",
