@@ -309,6 +309,11 @@ def save_combine_op(ins, attrs, ctx):
     path = _io_path(attrs)
     names = attrs.get("var_names") or [
         f"v{i}" for i in range(len(ins["X"]))]
+    if len(names) != len(ins["X"]):
+        raise ValueError(
+            f"save_combine: {len(ins['X'])} inputs but "
+            f"{len(names)} var_names — a silent zip-truncate would "
+            "drop tensors from the checkpoint")
 
     def host(*arrs):
         import os as _os
@@ -381,7 +386,10 @@ def correlation(ins, attrs, ctx):
     # ZEROS beyond the (already padded) image — never a wrap
     x2p = jnp.pad(x2, [(0, 0), (0, 0), (pad + max_d, pad + max_d),
                        (pad + max_d, pad + max_d)])
-    disp = list(range(-max_d, max_d + 1, s2))
+    # reference grid: 2*(max_d // s2) + 1 per axis, ALWAYS including the
+    # zero displacement (correlation_op.cc:36 output_channel)
+    d_rad = max_d // s2
+    disp = [i * s2 for i in range(-d_rad, d_rad + 1)]
     nelems = float(k * k * c)
     # output centers in the padded frame; window STARTS rad earlier
     r0 = border - rad

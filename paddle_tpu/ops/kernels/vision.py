@@ -89,14 +89,14 @@ def _interp_size_nd(x, attrs, ins, keys):
         vals = [int(v) for v in np.asarray(out).reshape(-1)]
         if len(vals) == len(keys):
             return vals
-    sizes = [int(attrs.get(k, -1)) for k in keys]
-    if all(s > 0 for s in sizes):
-        return sizes
     scale = attrs.get("scale", 0.0)
     scales = (list(scale) if isinstance(scale, (list, tuple))
               else [scale] * len(keys))
     if scales and all(s and float(s) > 0 for s in scales):
         return [int(dim * float(s)) for dim, s in zip(spatial, scales)]
+    sizes = [int(attrs.get(k, -1)) for k in keys]
+    if all(s > 0 for s in sizes):
+        return sizes
     raise ValueError(
         "interp: no target size — give OutSize, positive scale, or "
         f"{keys}")

@@ -12,11 +12,13 @@ from typing import Sequence
 
 import numpy as np
 
-__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Transpose",
-           "Resize", "RandomResizedCrop", "CenterCrop", "RandomCrop",
-           "RandomHorizontalFlip", "RandomVerticalFlip", "Pad",
-           "BrightnessTransform", "ContrastTransform", "SaturationTransform",
-           "HueTransform", "ColorJitter", "Grayscale",
+__all__ = ["Compose", "BatchCompose", "BaseTransform", "ToTensor",
+           "Normalize", "Transpose", "Permute", "Resize",
+           "RandomResizedCrop", "CenterCrop", "CenterCropResize",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Pad", "GaussianNoise", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "RandomErasing", "RandomRotate", "Grayscale",
            "to_tensor", "normalize", "resize", "center_crop", "crop",
            "hflip", "vflip", "pad"]
 
@@ -399,4 +401,141 @@ class Grayscale(BaseTransform):
         out = gray[:, :, None]
         if self.num_output_channels == 3:
             out = np.repeat(out, 3, axis=2)
+        return out
+
+
+class Permute(BaseTransform):
+    """transforms.py Permute — HWC -> CHW (optionally to a tensor-like
+    float array); the 2.0 name for Transpose's default mode."""
+
+    def __init__(self, mode="CHW", to_rgb=True, keys=None):
+        super().__init__(keys)
+        assert mode == "CHW", "only CHW is supported"
+        self.mode = mode
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.to_rgb:
+            img = img[..., ::-1]  # reference Permute: BGR -> RGB
+        return img.transpose(2, 0, 1)
+
+
+class BatchCompose:
+    """transforms.py BatchCompose — apply transforms to a whole BATCH of
+    samples (used as a DataLoader collate step)."""
+
+    def __init__(self, transforms=None):
+        self.transforms = transforms or []
+
+    def __call__(self, data):
+        for f in self.transforms:
+            try:
+                data = [f(d) for d in data]
+            except Exception as e:
+                raise RuntimeError(
+                    f"BatchCompose transform {f!r} failed: {e}") from e
+        return data
+
+
+class CenterCropResize(BaseTransform):
+    """transforms.py:344 — padded center crop then resize: crop side
+    c = size/(size+crop_padding) * min(h, w) at the center, then scale
+    to `size`."""
+
+    def __init__(self, size, crop_padding=32, interpolation="bilinear",
+                 keys=None):
+        super().__init__(keys)
+        self.size = _size2d(size)
+        self.crop_padding = crop_padding
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        size = min(self.size)
+        c = int(size / (size + self.crop_padding) * min(h, w))
+        x = (h + 1 - c) // 2
+        y = (w + 1 - c) // 2
+        cropped = img[x:x + c, y:y + c, :]
+        return resize(cropped, self.size, self.interpolation)
+
+
+class GaussianNoise(BaseTransform):
+    """transforms.py:586 — add N(mean, std) noise (float32 output)."""
+
+    def __init__(self, mean=0.0, std=1.0, keys=None):
+        super().__init__(keys)
+        self.mean = float(mean)
+        self.std = float(std)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).astype(np.float32)
+        noise = np.random.normal(self.mean, self.std,
+                                 img.shape).astype(np.float32)
+        return img + noise
+
+
+class RandomErasing(BaseTransform):
+    """transforms.py:926 (Zhong et al. Random Erasing): with probability
+    `prob`, erase a random rectangle whose area/aspect are drawn from
+    `scale`/`ratio`, filling with `value`."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.4), ratio=0.3, value=0,
+                 keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).copy()
+        if np.random.random() > self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            aspect = np.random.uniform(self.ratio, 1.0 / self.ratio)
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                top = np.random.randint(0, h - eh)
+                left = np.random.randint(0, w - ew)
+                if isinstance(self.value, (list, tuple)):
+                    img[top:top + eh, left:left + ew] = np.asarray(
+                        self.value, img.dtype).reshape(1, 1, -1)
+                else:
+                    img[top:top + eh, left:left + ew] = self.value
+                return img
+        return img
+
+
+class RandomRotate(BaseTransform):
+    """transforms.py:1064 — rotate by a random angle in `degrees`
+    (scalar d means [-d, d]); nearest-sample inverse-map rotation about
+    the image center, constant-0 outside (cv2-free)."""
+
+    def __init__(self, degrees, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        angle = np.random.uniform(*self.degrees)
+        theta = np.deg2rad(angle)
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w]
+        # inverse mapping: output pixel -> source pixel
+        ys = np.cos(theta) * (yy - cy) - np.sin(theta) * (xx - cx) + cy
+        xs = np.sin(theta) * (yy - cy) + np.cos(theta) * (xx - cx) + cx
+        yi = np.rint(ys).astype(np.int64)
+        xi = np.rint(xs).astype(np.int64)
+        ok = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.zeros_like(img)
+        out[ok] = img[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)][ok]
         return out

@@ -132,3 +132,39 @@ def test_missing_dataset_raises():
     with pytest.raises(FileNotFoundError, match="no network"):
         datasets.MNIST(image_path="/nonexistent/x.gz",
                        label_path="/nonexistent/y.gz")
+
+
+# ---------------------------------------------------------------------------
+# round-4 transform parity tail (reference transforms.py: BatchCompose,
+# Permute, CenterCropResize, GaussianNoise, RandomErasing, RandomRotate)
+# ---------------------------------------------------------------------------
+
+def test_transform_parity_tail():
+    from paddle_tpu.vision import transforms as T
+    rng = np.random.RandomState(0)
+    img = rng.rand(40, 40, 3).astype(np.float32)
+
+    assert T.Permute()(img).shape == (3, 40, 40)
+
+    batch = T.BatchCompose([T.Resize(20)])([img, img])
+    assert len(batch) == 2 and batch[0].shape[:2] == (20, 20)
+
+    out = T.CenterCropResize(16, crop_padding=8)(img)
+    assert out.shape[:2] == (16, 16)
+
+    np.random.seed(0)
+    noisy = T.GaussianNoise(0.0, 0.1)(img)
+    assert noisy.shape == img.shape and not np.allclose(noisy, img)
+
+    np.random.seed(0)
+    erased = T.RandomErasing(prob=1.0, value=0.5)(img)
+    assert erased.shape == img.shape
+    assert (erased == 0.5).any()        # some rectangle was filled
+    assert not (erased == 0.5).all()
+
+    np.random.seed(0)
+    rot = T.RandomRotate(30)(img)
+    assert rot.shape == img.shape
+    # zero rotation is identity
+    same = T.RandomRotate((0, 0))(img)
+    np.testing.assert_allclose(same, img)

@@ -200,3 +200,18 @@ def test_depthwise_conv2d_transpose_runs():
                           {"strides": [1, 1], "paddings": [1, 1]})
                      ["Output"])
     assert out.shape[1] == 4 and np.isfinite(out).all()
+
+
+def test_correlation_stride2_grid_includes_zero():
+    """Review r4: stride2 grid = {i*s2 : |i*s2| <= max_d} ALWAYS
+    including 0 — 2*(max_d//s2)+1 channels per axis."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    out = np.asarray(_run("correlation", {"Input1": x, "Input2": x},
+                          {"max_displacement": 3, "stride1": 1,
+                           "stride2": 2, "pad_size": 0,
+                           "kernel_size": 1})["Output"])
+    # grid {-2, 0, 2} per axis -> 9 channels; centers start at border=3
+    assert out.shape == (1, 9, 2, 2)
+    # center channel is the zero-displacement self-correlation (>= 0)
+    assert (out[0, 4] >= 0).all()
