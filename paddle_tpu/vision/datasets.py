@@ -164,3 +164,90 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.num_samples
+
+
+class DatasetFolder(Dataset):
+    """folder.py DatasetFolder — samples arranged as
+    root/class_x/xxx.ext; classes are sorted subdirectory names.
+    loader defaults to PIL -> HWC numpy."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                      ".tif", ".tiff", ".webp")
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        extensions = tuple(extensions or self.IMG_EXTENSIONS)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(base, f)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else f.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"found no files with extensions {extensions} under "
+                f"{root!r}")
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            img = Image.open(f)
+            # BGR channel order like the reference's cv2 loader, so the
+            # canonical pipeline DatasetFolder -> Permute() (whose
+            # default to_rgb flip matches the reference) ends in RGB
+            return np.asarray(img.convert("RGB"))[..., ::-1]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """folder.py ImageFolder — an UNLABELED flat/recursive directory of
+    images (inference input listing)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        extensions = tuple(extensions or DatasetFolder.IMG_EXTENSIONS)
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(base, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"found no images under {root!r}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return (sample,)
+
+    def __len__(self):
+        return len(self.samples)

@@ -431,10 +431,15 @@ class BatchCompose:
     def __call__(self, data):
         for f in self.transforms:
             try:
-                data = [f(d) for d in data]
-            except Exception as e:
-                raise RuntimeError(
-                    f"BatchCompose transform {f!r} failed: {e}") from e
+                # batch transforms receive the WHOLE batch (the
+                # reference contract: collate-level transforms loop over
+                # samples themselves)
+                data = f(data)
+            except Exception:
+                import traceback
+                print("BatchCompose: transform", f, "failed --",
+                      traceback.format_exc())
+                raise
         return data
 
 

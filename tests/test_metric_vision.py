@@ -146,7 +146,10 @@ def test_transform_parity_tail():
 
     assert T.Permute()(img).shape == (3, 40, 40)
 
-    batch = T.BatchCompose([T.Resize(20)])([img, img])
+    def batch_resize(samples):
+        return [T.Resize(20)(s) for s in samples]
+
+    batch = T.BatchCompose([batch_resize])([img, img])
     assert len(batch) == 2 and batch[0].shape[:2] == (20, 20)
 
     out = T.CenterCropResize(16, crop_padding=8)(img)
@@ -168,3 +171,34 @@ def test_transform_parity_tail():
     # zero rotation is identity
     same = T.RandomRotate((0, 0))(img)
     np.testing.assert_allclose(same, img)
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    from PIL import Image
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    for cls, color in (("cats", (255, 0, 0)), ("dogs", (0, 255, 0))):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.new("RGB", (8, 8), color).save(d / f"{i}.png")
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cats", "dogs"] and len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and int(label) == 0
+    img, label = ds[5]
+    # loader yields BGR (reference cv2 contract): green stays channel 1
+    assert int(label) == 1 and img[0, 0, 1] == 255
+    img0, _ = ds[0]
+    assert img0[0, 0, 2] == 255  # red lands in the B..G..R slot
+
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+    (img,) = flat[0]
+    assert img.shape == (8, 8, 3)
+
+    # transforms compose
+    from paddle_tpu.vision import transforms as T
+    ds2 = DatasetFolder(str(tmp_path), transform=T.Compose(
+        [T.Resize(4), T.Permute()]))
+    img, _ = ds2[0]
+    assert img.shape == (3, 4, 4)
