@@ -158,6 +158,8 @@ class HDFSClient(FS):
     def mv(self, src, dst, overwrite=False):
         if overwrite:
             self.delete(dst)
+        elif self.is_exist(dst):
+            raise FSFileExistsError(dst)
         self._run("-mv", src, dst)
 
     def touch(self, fs_path, exist_ok=True):

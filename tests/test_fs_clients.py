@@ -1,0 +1,155 @@
+"""HDFSClient tested against a PATH-shimmed fake `hadoop` binary.
+
+The shim maps HDFS paths onto a local sandbox directory and implements the
+`hadoop fs` subcommands the client issues (-test/-ls/-mkdir/-rm/-mv/-touchz/
+-put/-get), so ls/upload/download/mv round-trip without a cluster.
+Reference behavior: /root/reference/python/paddle/distributed/fleet/utils/fs.py
+"""
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.distributed.fleet.utils.fs import (
+    FSFileExistsError, FSFileNotExistsError, HDFSClient, LocalFS)
+
+FAKE_HADOOP = r'''#!/usr/bin/env python3
+"""Fake `hadoop fs` CLI mapping hdfs paths into $FAKE_HDFS_ROOT."""
+import os, shutil, sys
+
+root = os.environ["FAKE_HDFS_ROOT"]
+
+def local(p):
+    return os.path.join(root, p.lstrip("/"))
+
+args = sys.argv[1:]
+assert args and args[0] == "fs", args
+args = args[1:]
+# strip -D k=v config pairs
+while args and args[0] == "-D":
+    args = args[2:]
+cmd, rest = args[0], args[1:]
+if cmd == "-test":
+    flag, path = rest
+    p = local(path)
+    ok = os.path.isdir(p) if flag == "-d" else os.path.exists(p)
+    sys.exit(0 if ok else 1)
+elif cmd == "-ls":
+    p = local(rest[0])
+    if not os.path.exists(p):
+        sys.exit(1)
+    for name in sorted(os.listdir(p)):
+        full = os.path.join(p, name)
+        kind = "d" if os.path.isdir(full) else "-"
+        print(f"{kind}rwxr-xr-x 1 u g 0 2026-01-01 00:00 {rest[0].rstrip('/')}/{name}")
+elif cmd == "-mkdir":
+    os.makedirs(local(rest[-1]), exist_ok=True)
+elif cmd == "-rm":
+    p = local(rest[-1])
+    if os.path.isdir(p):
+        shutil.rmtree(p, ignore_errors=True)
+    elif os.path.exists(p):
+        os.remove(p)
+elif cmd == "-mv":
+    src, dst = local(rest[0]), local(rest[1])
+    if not os.path.exists(src) or os.path.exists(dst):
+        sys.exit(1)
+    shutil.move(src, dst)
+elif cmd == "-touchz":
+    open(local(rest[0]), "a").close()
+elif cmd == "-put":
+    rest = [a for a in rest if a != "-f"]
+    dst = local(rest[1])
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.copy(rest[0], dst)
+elif cmd == "-get":
+    src = local(rest[0])
+    if not os.path.exists(src):
+        sys.exit(1)
+    shutil.copy(src, rest[1])
+else:
+    sys.exit(2)
+'''
+
+
+@pytest.fixture
+def hdfs(tmp_path, monkeypatch):
+    """An HDFSClient wired to a fake hadoop shim over a sandbox dir."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    shim = bin_dir / "hadoop"
+    shim.write_text(FAKE_HADOOP)
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    hdfs_root = tmp_path / "hdfs_root"
+    hdfs_root.mkdir()
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(hdfs_root))
+    return HDFSClient(configs={"fs.default.name": "hdfs://fake:9000"})
+
+
+def test_hdfs_mkdir_exist_isdir(hdfs):
+    assert not hdfs.is_exist("/data")
+    hdfs.mkdirs("/data/sub")
+    assert hdfs.is_exist("/data/sub")
+    assert hdfs.is_dir("/data/sub")
+    assert not hdfs.is_file("/data/sub")
+
+
+def test_hdfs_upload_download_roundtrip(hdfs, tmp_path):
+    src = tmp_path / "model.bin"
+    src.write_bytes(b"\x00weights\x01")
+    hdfs.mkdirs("/ckpt")
+    hdfs.upload(str(src), "/ckpt/model.bin")
+    assert hdfs.is_file("/ckpt/model.bin")
+    dst = tmp_path / "fetched.bin"
+    hdfs.download("/ckpt/model.bin", str(dst))
+    assert dst.read_bytes() == b"\x00weights\x01"
+
+
+def test_hdfs_ls_dir(hdfs, tmp_path):
+    hdfs.mkdirs("/job/output")
+    f = tmp_path / "log.txt"
+    f.write_text("ok")
+    hdfs.upload(str(f), "/job/log.txt")
+    dirs, files = hdfs.ls_dir("/job")
+    assert dirs == ["output"]
+    assert files == ["log.txt"]
+
+
+def test_hdfs_mv_touch_delete(hdfs, tmp_path):
+    hdfs.mkdirs("/a")
+    hdfs.touch("/a/x")
+    assert hdfs.is_file("/a/x")
+    hdfs.mv("/a/x", "/a/y")
+    assert not hdfs.is_exist("/a/x")
+    assert hdfs.is_file("/a/y")
+    # mv without overwrite refuses an existing destination
+    hdfs.touch("/a/x")
+    with pytest.raises(FSFileExistsError):
+        hdfs.mv("/a/x", "/a/y")
+    # mv with overwrite replaces the destination
+    hdfs.mv("/a/x", "/a/y", overwrite=True)
+    assert not hdfs.is_exist("/a/x")
+    assert hdfs.is_file("/a/y")
+    hdfs.delete("/a")
+    assert not hdfs.is_exist("/a")
+
+
+def test_hdfs_unavailable_raises_cleanly(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATH", str(tmp_path))  # no hadoop anywhere
+    client = HDFSClient()
+    with pytest.raises(FSFileNotExistsError):
+        client.is_exist("/whatever")
+
+
+def test_localfs_mv_no_overwrite(tmp_path):
+    fs = LocalFS()
+    a, b = tmp_path / "a", tmp_path / "b"
+    fs.touch(str(a))
+    fs.touch(str(b))
+    with pytest.raises(FSFileExistsError):
+        fs.mv(str(a), str(b))
+    fs.mv(str(a), str(b), overwrite=True)
+    assert not fs.is_exist(str(a))
