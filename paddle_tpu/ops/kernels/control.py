@@ -263,6 +263,56 @@ def static_rnn_op(ins, attrs, ctx):
     return {"Out": list(ys)}
 
 
+@register_op("dynamic_rnn", inputs=["X*"], outputs=["Out*"])
+def dynamic_rnn_op(ins, attrs, ctx):
+    """DynamicRNN (the while + lod_tensor_to_array + shrink_rnn_memory
+    pipeline of /root/reference/python/paddle/fluid/layers/
+    control_flow.py:2938) collapsed into ONE masked lax.scan.
+
+    The reference sorts sequences by length and physically shrinks the
+    batch as short sequences finish — ragged per-step shapes XLA cannot
+    compile.  TPU lowering: scan over the padded time axis with the FULL
+    batch every step; `step < lengths` masks the recurrence instead of
+    shrinking it — memories freeze at a sequence's last real step (so
+    sequence_last_step reads the same value the reference produces) and
+    step outputs are zeroed in the padding.  Row-wise step bodies (fc /
+    gru_unit / lstm_unit ...) make masked rows independent of live rows,
+    which is exactly the contract the reference's shrinking gives."""
+    tracer = _sub_tracer(ctx, attrs["sub_block"])
+    env0 = _env_map(attrs["x_names"], ins["X"], "dynamic_rnn")
+    memories = attrs["memories"]          # [boot, pre, updated]
+    scan_inputs = attrs["scan_inputs"]    # [parent_name, in_block_name]
+    step_outputs = attrs["step_outputs"]
+
+    lengths = jnp.reshape(env0[attrs["lengths_name"]], (-1,)) \
+        .astype(jnp.int32)
+    carry0 = {pre: env0[boot] for boot, pre, _ in memories}
+    # [B, T, ...] -> time-major [T, B, ...] for the scan axis
+    xs = {inb: jnp.moveaxis(env0[pn], 1, 0) for pn, inb in scan_inputs}
+    n_steps = next(iter(xs.values())).shape[0]
+
+    def _mask(active, like):
+        return active.reshape((-1,) + (1,) * (like.ndim - 1))
+
+    def f(carry, step_x):
+        t, x_slice = step_x
+        e = dict(env0)
+        e.update(carry)
+        e.update(x_slice)
+        tracer.run(e, ctx)
+        active = t < lengths
+        new_carry = {pre: jnp.where(_mask(active, e[upd]), e[upd],
+                                    carry[pre])
+                     for _, pre, upd in memories}
+        ys = tuple(jnp.where(_mask(active, e[n]), e[n],
+                             jnp.zeros_like(e[n]))
+                   for n in step_outputs)
+        return new_carry, ys
+
+    _, ys = jax.lax.scan(f, carry0, (jnp.arange(n_steps), xs))
+    return {"Out": [jnp.moveaxis(y, 0, 1) for y in ys]}
+
+
 @register_op("feed", inputs=[], outputs=["Out"], grad=None, side_effect=True)
 def feed(ins, attrs, ctx):
     raise RuntimeError("feed op is handled by the executor")

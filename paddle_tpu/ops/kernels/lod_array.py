@@ -1,0 +1,189 @@
+"""LoD rank-table / tensor-array bridge ops — the DynamicRNN substrate.
+
+Reference ops:
+  /root/reference/paddle/fluid/operators/lod_rank_table_op.cc:32
+  /root/reference/paddle/fluid/operators/max_sequence_len_op.cc:1
+  /root/reference/paddle/fluid/operators/lod_tensor_to_array_op.cc:1
+  /root/reference/paddle/fluid/operators/array_to_lod_tensor_op.cc:1
+  /root/reference/paddle/fluid/operators/shrink_rnn_memory_op.cc:1
+  /root/reference/paddle/fluid/operators/reorder_lod_tensor_by_rank_op.cc:1
+  /root/reference/paddle/fluid/operators/split_lod_tensor_op.cc:1
+  /root/reference/paddle/fluid/operators/merge_lod_tensor_op.cc:1
+  /root/reference/paddle/fluid/operators/recurrent_op.cc (rnn_memory_helper)
+
+TPU redesign (NOT a translation).  The reference walks LoD offset tables
+and *shrinks* the batch as short sequences finish, producing per-step
+tensors of shrinking row counts — ragged shapes XLA cannot compile.  Here
+variable length lives in an explicit lengths vector next to a padded
+dense tensor (io/bucketing.py doctrine), and:
+
+  * the rank table is a dense int32 [2, B] tensor — row 0 the stable
+    argsort of lengths descending (the reference's rank order), row 1 the
+    lengths in that order;
+  * lod_tensor_to_array gathers rows into rank order and flips
+    [B, T, ...] -> time-major, returning a TensorArrayVal whose buffer IS
+    the time-major tensor, so `array_read(arr, step)` yields the full
+    [B, ...] step slice — the batch never shrinks, masking replaces
+    shrinking (see `dynamic_rnn` in control.py);
+  * shrink_rnn_memory keeps every row (identity): finished sequences are
+    frozen by `where(step < len, new, old)` masking instead of dropped,
+    which preserves the reference's numerics for the surviving rows while
+    keeping one static shape for all steps;
+  * split/merge_lod_tensor keep full shape with inactive rows zeroed —
+    the masked-select trade (both sides live, `where` picks), which is
+    exactly how XLA wants data-dependent row routing phrased.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op
+from .tensor_array import TensorArrayVal
+
+
+def _lengths_1d(length):
+    return jnp.reshape(length, (-1,)).astype(jnp.int32)
+
+
+def _rank_rows(table):
+    """(indices, lengths) int32 [B] each from a [2, B] rank table."""
+    t = jnp.asarray(table)
+    return t[0].astype(jnp.int32), t[1].astype(jnp.int32)
+
+
+def _row_mask(mask, like):
+    """[B] bool row mask broadcast against a [B, ...] tensor."""
+    m = jnp.reshape(mask, (-1,)).astype(jnp.bool_)
+    return m.reshape((-1,) + (1,) * (like.ndim - 1))
+
+
+@register_op("lod_rank_table", inputs=["X?", "Length!"], outputs=["Out"],
+             grad=None)
+def lod_rank_table(ins, attrs, ctx):
+    """lod_rank_table_op.cc:32 — sort sequence indices by length
+    descending (stable, so equal lengths keep input order, matching the
+    reference's std::stable_sort).  X is accepted for API parity but the
+    lengths vector is the LoD here."""
+    lens = _lengths_1d(ins["Length"])
+    # stable descending sort: argsort ascending on negated lengths
+    order = jnp.argsort(-lens, stable=True).astype(jnp.int32)
+    return {"Out": jnp.stack([order, lens[order]])}
+
+
+@register_op("max_sequence_len", inputs=["RankTable!"], outputs=["Out"],
+             grad=None)
+def max_sequence_len(ins, attrs, ctx):
+    """max_sequence_len_op.cc — the scan length of the dynamic RNN."""
+    _, lens = _rank_rows(ins["RankTable"])
+    return {"Out": jnp.max(lens).astype(jnp.int64).reshape((1,))}
+
+
+def _to_rank_time_major(x, order):
+    """[B, T, ...] -> rank-ordered time-major array value."""
+    tm = jnp.moveaxis(jnp.take(x, order, axis=0), 1, 0)
+    return TensorArrayVal(tm, jnp.asarray(tm.shape[0], jnp.int32))
+
+
+def _from_rank_time_major(arr, order):
+    """Rank-ordered time-major -> [B, T, ...] in input order."""
+    buf = arr.buffer if isinstance(arr, TensorArrayVal) else jnp.asarray(arr)
+    inv = jnp.argsort(order)
+    return jnp.take(jnp.moveaxis(buf, 0, 1), inv, axis=0)
+
+
+def _lod_to_array_grad(ins, attrs, ctx):
+    """The two transforms are mutually inverse permutations, so each
+    grad is the other transform applied to the cotangent (explicit
+    kernels: auto-vjp cannot type float cotangents for the int32 `size`
+    leaf of TensorArrayVal)."""
+    order, _ = _rank_rows(ins["RankTable"])
+    g = ins.get("Out@GRAD")
+    if g is None:
+        return {"X@GRAD": jnp.zeros_like(ins["X"])}
+    return {"X@GRAD": _from_rank_time_major(g, order)}
+
+
+@register_op("lod_tensor_to_array", inputs=["X", "RankTable!"],
+             outputs=["Out"], grad=_lod_to_array_grad)
+def lod_tensor_to_array(ins, attrs, ctx):
+    """lod_tensor_to_array_op.cc — padded [B, T, ...] -> time-major array.
+
+    Rows are gathered into rank order, then time moves to the front; the
+    result is a TensorArrayVal of T full-batch step slices (no per-step
+    shrinking — masking downstream replaces it)."""
+    order, _ = _rank_rows(ins["RankTable"])
+    return {"Out": _to_rank_time_major(ins["X"], order)}
+
+
+def _array_to_lod_grad(ins, attrs, ctx):
+    order, _ = _rank_rows(ins["RankTable"])
+    g = ins.get("Out@GRAD")
+    x = ins["X"]
+    if g is None:
+        buf = x.buffer if isinstance(x, TensorArrayVal) else jnp.asarray(x)
+        return {"X@GRAD": TensorArrayVal(
+            jnp.zeros_like(buf), jnp.asarray(buf.shape[0], jnp.int32))}
+    return {"X@GRAD": _to_rank_time_major(g, order)}
+
+
+@register_op("array_to_lod_tensor", inputs=["X", "RankTable!"],
+             outputs=["Out"], grad=_array_to_lod_grad)
+def array_to_lod_tensor(ins, attrs, ctx):
+    """array_to_lod_tensor_op.cc — inverse of lod_tensor_to_array: stack
+    the step slices back to [B, T, ...] and undo the rank permutation so
+    rows return to input order."""
+    order, _ = _rank_rows(ins["RankTable"])
+    return {"Out": _from_rank_time_major(ins["X"], order)}
+
+
+@register_op("reorder_lod_tensor_by_rank", inputs=["X", "RankTable!"],
+             outputs=["Out"])
+def reorder_lod_tensor_by_rank(ins, attrs, ctx):
+    """reorder_lod_tensor_by_rank_op.cc — gather rows into rank order
+    (static_input's reorder; its auto-vjp is the reference's grad op,
+    which scatters back)."""
+    order, _ = _rank_rows(ins["RankTable"])
+    return {"Out": jnp.take(ins["X"], order, axis=0)}
+
+
+@register_op("shrink_rnn_memory", inputs=["X", "RankTable?!", "I?!"],
+             outputs=["Out"])
+def shrink_rnn_memory(ins, attrs, ctx):
+    """shrink_rnn_memory_op.cc — reference drops the rows of sequences
+    already finished at step I.  TPU redesign: keep every row (identity);
+    the dynamic_rnn scan freezes finished rows with where-masking, so the
+    surviving rows see identical values and the shape stays static."""
+    return {"Out": ins["X"]}
+
+
+@register_op("rnn_memory_helper", inputs=["X"], outputs=["Out"])
+def rnn_memory_helper(ins, attrs, ctx):
+    """recurrent_op.cc rnn_memory_helper — differentiable identity used to
+    give RNN memories a gradient slot."""
+    return {"Out": ins["X"]}
+
+
+@register_op("split_lod_tensor", inputs=["X", "Mask!"],
+             outputs=["OutTrue", "OutFalse"])
+def split_lod_tensor(ins, attrs, ctx):
+    """split_lod_tensor_op.cc — reference routes rows into two ragged
+    tensors by a [B] bool mask.  TPU redesign: both outputs keep the full
+    [B, ...] shape with non-selected rows zeroed, so
+    merge_lod_tensor(split(...)) round-trips exactly and both branches of
+    an IfElse stay statically shaped."""
+    x = ins["X"]
+    m = _row_mask(ins["Mask"], x)
+    zero = jnp.zeros_like(x)
+    return {"OutTrue": jnp.where(m, x, zero),
+            "OutFalse": jnp.where(m, zero, x)}
+
+
+@register_op("merge_lod_tensor", inputs=["X?", "Mask!", "InTrue", "InFalse"],
+             outputs=["Out"])
+def merge_lod_tensor(ins, attrs, ctx):
+    """merge_lod_tensor_op.cc — row-select InTrue where mask else InFalse
+    (X carried for API parity only; shapes are already aligned here)."""
+    it, if_ = ins["InTrue"], ins["InFalse"]
+    m = _row_mask(ins["Mask"], it)
+    return {"Out": jnp.where(m, it, if_)}

@@ -206,7 +206,14 @@ def _register_grad(fwd: OpInfo, depth: int = 1):
 
 
 def _is_diff(x):
-    return x is not None and jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    if x is None:
+        return False
+    # pytree values (TensorArrayVal and friends) are differentiable when
+    # any of their array leaves is — jnp.asarray would choke on them
+    if jax.tree_util.all_leaves([x]):
+        return jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    return any(jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)
+               for leaf in jax.tree_util.tree_leaves(x))
 
 
 def _make_vjp_grad_kernel(fwd: OpInfo):
@@ -275,10 +282,12 @@ def _make_vjp_grad_kernel(fwd: OpInfo):
                     i = int(k)
                     gi = (g[i] if g is not None and i < len(g)
                           and g[i] is not None else None)
-                    gs[k] = gi if gi is not None else jnp.zeros_like(r)
+                    gs[k] = gi if gi is not None else \
+                        jax.tree_util.tree_map(jnp.zeros_like, r)
                 cts[slot.name] = gs
             else:
-                cts[slot.name] = g if g is not None else jnp.zeros_like(ref)
+                cts[slot.name] = g if g is not None else \
+                    jax.tree_util.tree_map(jnp.zeros_like, ref)
 
         (din,) = vjp_fn(cts)
         result = {}

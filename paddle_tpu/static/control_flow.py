@@ -36,9 +36,13 @@ from ..core.program import (Block, OpDesc, Program, VarDesc,
                             default_main_program, unique_name)
 from .layer_helper import LayerHelper
 
-__all__ = ["While", "while_loop", "cond", "case", "switch_case", "Switch", "StaticRNN",
+__all__ = ["While", "while_loop", "cond", "case", "switch_case", "Switch",
+           "StaticRNN", "DynamicRNN",
            "increment", "less_than", "array_write", "array_read",
-           "array_length", "create_array"]
+           "array_length", "create_array",
+           "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+           "array_to_lod_tensor", "reorder_lod_tensor_by_rank",
+           "shrink_memory", "split_lod_tensor", "merge_lod_tensor"]
 
 
 # re-exported conveniences (reference keeps these in control_flow.py)
@@ -583,6 +587,305 @@ class StaticRNN:
         if len(self._out_vars) == 1:
             return self._out_vars[0]
         return list(self._out_vars)
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN -> masked lax.scan
+# ---------------------------------------------------------------------------
+class DynamicRNN:
+    """Variable-length recurrence (control_flow.py:2938 `DynamicRNN`).
+
+    The reference unfolds a LoD minibatch with a While loop over
+    lod_tensor_to_array slices, sorting sequences by length and shrinking
+    the batch as short sequences finish.  TPU redesign: sequences arrive
+    padded [B, T, ...] with an explicit lengths vector (io/bucketing.py —
+    the LoD replacement), the whole recurrence lowers to ONE `dynamic_rnn`
+    op (a masked lax.scan, see ops/kernels/control.py), and `step < len`
+    masking replaces batch shrinking: memories freeze at each sequence's
+    last real step, outputs are zero beyond it.  No sorting happens, so
+    rows keep their input order and `memory(need_reorder=True)` is
+    accepted as a no-op.
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(emb, length=seq_lens)  # emb [B,T,D]
+            enc  = drnn.static_input(encoder_proj)        # visible as-is
+            mem  = drnn.memory(shape=[H])                 # zeros [B,H]
+            h = layers.fc(layers.concat([word, mem], 1), H, act="tanh")
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        out = drnn()                                      # [B, T, H]
+        last = layers.sequence_last_step(out, length=seq_lens)
+    """
+
+    BEFORE_RNN, IN_RNN, AFTER_RNN = 0, 1, 2
+
+    def __init__(self, name=None):
+        self.program = default_main_program()
+        self.status = DynamicRNN.BEFORE_RNN
+        self._sub: Optional[Block] = None
+        self._lengths_name: Optional[str] = None
+        self._batch_ref: Optional[VarDesc] = None
+        self._seq_len: Optional[int] = None
+        self._scan_inputs: List[Tuple[str, str]] = []
+        self._memories: List[List[Optional[str]]] = []
+        self._step_outputs: List[str] = []
+        self._out_vars: List[VarDesc] = []
+
+    @contextlib.contextmanager
+    def block(self):
+        if self.status != DynamicRNN.BEFORE_RNN:
+            raise ValueError("rnn.block() can only be invoked once")
+        parent = self.program.current_block()
+        self.status = DynamicRNN.IN_RNN
+        with _sub_block(self.program) as sub:
+            self._sub = sub
+            yield
+        self.status = DynamicRNN.AFTER_RNN
+        self._finalize(parent)
+
+    def _assert_in_rnn_block_(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError(
+                f"{method}() can only be called inside `with drnn.block():`")
+
+    def step_input(self, x: VarDesc, level=0, length: VarDesc = None):
+        """Set padded sequence x [B, T, ...] as a per-step input; returns
+        the [B, ...] time slice inside the block.  The first call must
+        pass `length` (int vector [B] of true sequence lengths) — the
+        explicit replacement for the LoD the reference reads off x."""
+        self._assert_in_rnn_block_("step_input")
+        if x.shape is None or len(x.shape) < 2:
+            raise ValueError("DynamicRNN.step_input needs a padded "
+                             "[batch, time, ...] variable")
+        if self._lengths_name is None:
+            if length is None:
+                raise ValueError(
+                    "DynamicRNN.step_input: the first step input must "
+                    "pass length= (int [batch] true lengths) — padded "
+                    "tensors carry no LoD here (io/bucketing.py)")
+            self._lengths_name = length.name
+            self._batch_ref = x
+            self._seq_len = x.shape[1]
+        else:
+            if length is not None and length.name != self._lengths_name:
+                raise ValueError(
+                    "DynamicRNN.step_input: conflicting length= "
+                    f"({length.name!r} vs {self._lengths_name!r}) — all "
+                    "step inputs share the first call's lengths")
+            if (self._seq_len is not None and x.shape[1] is not None
+                    and x.shape[1] != self._seq_len):
+                raise ValueError(
+                    f"DynamicRNN.step_input: {x.name!r} has time length "
+                    f"{x.shape[1]} but the first step input has "
+                    f"{self._seq_len} — padded step inputs must share "
+                    "one time axis")
+        xt = self._sub.create_var(
+            name=unique_name(x.name + "@step"),
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=x.dtype)
+        self._scan_inputs.append((x.name, xt.name))
+        return xt
+
+    def static_input(self, x: VarDesc) -> VarDesc:
+        """Reference reorders x into rank order and shrinks it per step
+        (control_flow.py:3157).  With no sorting and no shrinking both
+        transforms are identities, so the variable is visible in the block
+        unchanged."""
+        self._assert_in_rnn_block_("static_input")
+        if self._lengths_name is None:
+            raise RuntimeError(
+                "static_input() must be called after step_input().")
+        return x
+
+    def memory(self, init: Optional[VarDesc] = None, shape=None, value=0.0,
+               need_reorder=False, dtype="float32"):
+        self._assert_in_rnn_block_("memory")
+        if self._lengths_name is None:
+            raise ValueError(
+                "memory() can only be called after step_input().")
+        from . import layers
+        if init is None:
+            if shape is None:
+                raise ValueError("DynamicRNN.memory needs init= or shape=")
+            # boot built in the PARENT block: zeros [B, *shape] batched
+            # like the step input (reference parity:
+            # fill_constant_batch_size_like against the rank table)
+            cur = self.program._current_block_idx
+            self.program._current_block_idx = self._sub.parent_idx
+            try:
+                init = layers.fill_constant_batch_size_like(
+                    self._batch_ref, [-1] + list(shape), dtype, value,
+                    input_dim_idx=0, output_dim_idx=0)
+            finally:
+                self.program._current_block_idx = cur
+        # need_reorder reorders the boot into rank order in the reference;
+        # rows are never permuted here, so it is correct as a no-op
+        pre = self._sub.create_var(name=unique_name(init.name + "@pre"),
+                                   shape=init.shape, dtype=init.dtype)
+        self._memories.append([init.name, pre.name, None])
+        return pre
+
+    def update_memory(self, ex_mem: VarDesc, new_mem: VarDesc):
+        self._assert_in_rnn_block_("update_memory")
+        for m in self._memories:
+            if m[1] == ex_mem.name:
+                m[2] = new_mem.name
+                return
+        raise ValueError(f"{ex_mem.name!r} is not a DynamicRNN memory")
+
+    def output(self, *outputs):
+        self._assert_in_rnn_block_("output")
+        for o in outputs:
+            self._step_outputs.append(o.name)
+
+    def _finalize(self, parent: Block):
+        if self._lengths_name is None:
+            raise ValueError("DynamicRNN block defined no step_input")
+        if not self._step_outputs:
+            raise ValueError("DynamicRNN produced no output()")
+        for boot, pre, upd in self._memories:
+            if upd is None:
+                raise ValueError(f"memory {pre!r} never update_memory()d")
+        free, _ = _analyze_block(self._sub)
+        local = ({inb for _, inb in self._scan_inputs}
+                 | {pre for _, pre, _ in self._memories})
+        x_names = list(dict.fromkeys(
+            [n for n in free if n not in local]
+            + [pn for pn, _ in self._scan_inputs]
+            + [boot for boot, _, _ in self._memories]
+            + [self._lengths_name]))
+        self._out_vars = []
+        batch = self._batch_ref.shape[0]
+        for n in self._step_outputs:
+            v = self._sub.var(n)
+            shape = ((batch, self._seq_len) + tuple(v.shape[1:])
+                     if v.shape is not None else None)
+            self._out_vars.append(parent.create_var(
+                name=unique_name("dynamic_rnn_out"), shape=shape,
+                dtype=v.dtype))
+        parent.append_op(
+            "dynamic_rnn",
+            inputs={"X": x_names},
+            outputs={"Out": [v.name for v in self._out_vars]},
+            attrs={"sub_block": self._sub.idx, "x_names": x_names,
+                   "scan_inputs": [list(p) for p in self._scan_inputs],
+                   "memories": [list(m) for m in self._memories],
+                   "step_outputs": list(self._step_outputs),
+                   "lengths_name": self._lengths_name})
+
+    def __call__(self, *args, **kwargs):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError("Output of the dynamic RNN can only be "
+                             "visited outside the rnn block.")
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return list(self._out_vars)
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table plumbing (ops in ops/kernels/lod_array.py)
+# ---------------------------------------------------------------------------
+def lod_rank_table(x: VarDesc = None, level=0, length: VarDesc = None):
+    """control_flow.py lod_rank_table — dense [2, B] rank table (sorted
+    indices + lengths).  `length` is required: the explicit lengths vector
+    replaces the LoD the reference reads off x."""
+    if length is None:
+        raise ValueError("lod_rank_table needs length= (int [batch]); "
+                         "padded tensors carry no LoD")
+    helper = LayerHelper("lod_rank_table")
+    out = helper.create_variable_for_type_inference("int32", True)
+    ins = {"Length": [length.name]}
+    if x is not None:
+        ins["X"] = [x.name]
+    helper.append_op("lod_rank_table", inputs=ins,
+                     outputs={"Out": [out]}, attrs={"level": level})
+    return out
+
+
+def max_sequence_len(rank_table: VarDesc):
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("max_sequence_len",
+                     inputs={"RankTable": [rank_table.name]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_tensor_to_array(x: VarDesc, table: VarDesc):
+    """Padded [B, T, ...] -> time-major tensor array in rank order."""
+    helper = LayerHelper("lod_tensor_to_array")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.attrs["is_tensor_array"] = True
+    # remember the padded source shape so array_to_lod_tensor can restore
+    # a static shape for its consumers (fc etc.)
+    if x.shape is not None:
+        out.attrs["lod_src_shape"] = list(x.shape)
+    helper.append_op("lod_tensor_to_array",
+                     inputs={"X": [x.name], "RankTable": [table.name]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_to_lod_tensor(x: VarDesc, table: VarDesc):
+    """Inverse of lod_tensor_to_array: back to [B, T, ...], input order."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    src_shape = x.attrs.get("lod_src_shape")
+    if src_shape is not None:
+        out.shape = tuple(src_shape)
+    helper.append_op("array_to_lod_tensor",
+                     inputs={"X": [x.name], "RankTable": [table.name]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x: VarDesc, rank_table: VarDesc):
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": [x.name], "RankTable": [rank_table.name]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def shrink_memory(x: VarDesc, i: VarDesc, table: VarDesc):
+    """Identity on TPU (masking replaces shrinking) — see
+    ops/kernels/lod_array.py shrink_rnn_memory."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shrink_rnn_memory",
+                     inputs={"X": [x.name], "I": [i.name],
+                             "RankTable": [table.name]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def split_lod_tensor(input: VarDesc, mask: VarDesc, level=0):
+    """Row-route input by bool mask into (true, false) full-shape tensors
+    with unselected rows zeroed (split_lod_tensor_op.cc, masked-select
+    redesign)."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_variable_for_type_inference(input.dtype)
+    out_false = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("split_lod_tensor",
+                     inputs={"X": [input.name], "Mask": [mask.name]},
+                     outputs={"OutTrue": [out_true],
+                              "OutFalse": [out_false]},
+                     attrs={"level": level})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true: VarDesc, in_false: VarDesc, x: VarDesc,
+                     mask: VarDesc, level=0):
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_variable_for_type_inference(in_true.dtype)
+    ins = {"Mask": [mask.name], "InTrue": [in_true.name],
+           "InFalse": [in_false.name]}
+    if x is not None:
+        ins["X"] = [x.name]
+    helper.append_op("merge_lod_tensor", inputs=ins,
+                     outputs={"Out": [out]}, attrs={"level": level})
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -2420,6 +2420,36 @@ def dynamic_gru(input, size, h_0=None, param_attr=None, bias_attr=None,
     return h
 
 
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """fluid.layers.gru_unit (gru_unit_op.cc): one GRU step.  `input` is
+    the pre-projected gate input [batch, size] with size = 3 * d, `hidden`
+    the previous state [batch, d].  Returns (updated_hidden,
+    reset_hidden_pre, gate) — reference order."""
+    if size % 3 != 0:
+        raise ValueError(
+            f"gru_unit: size must be 3 * hidden_dim, got {size}")
+    d = size // 3
+    helper = LayerHelper("gru_unit", name=name)
+    w = helper.create_parameter(param_attr, [d, 3 * d], input.dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * d], input.dtype,
+                                is_bias=True)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    rhp = helper.create_variable_for_type_inference(input.dtype)
+    h = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "HiddenPrev": hidden, "Weight": w}
+    if b is not None:
+        ins["Bias"] = b
+    helper.append_op("gru_unit", inputs=ins,
+                     outputs={"Gate": gate, "ResetHiddenPrev": rhp,
+                              "Hidden": h},
+                     attrs={"activation": activation,
+                            "gate_activation": gate_activation,
+                            "origin_mode": origin_mode})
+    return h, rhp, gate
+
+
 def sequence_pool(input, pool_type, length=None, name=None):
     """fluid.layers.sequence_pool (sequence_pool_op.cc): pool over the time
     axis of padded [batch, time, d] input; `length` masks the padding."""
@@ -2479,12 +2509,32 @@ def cos_sim(X, Y, name=None):
 # ---------------------------------------------------------------------------
 from .control_flow import (  # noqa: E402,F401
     While, while_loop, cond, case, switch_case, Switch, StaticRNN,
-    array_write, array_read, array_length, create_array)
+    DynamicRNN, array_write, array_read, array_length, create_array,
+    lod_rank_table, max_sequence_len, lod_tensor_to_array,
+    array_to_lod_tensor, reorder_lod_tensor_by_rank, shrink_memory,
+    split_lod_tensor, merge_lod_tensor)
+
+
+def sequence_last_step(input, length=None):
+    """fluid.layers.sequence_last_step (sequence_lod.py) — last real step
+    of each padded sequence; `length` marks where padding starts."""
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_first_step(input, length=None):
+    """fluid.layers.sequence_first_step (sequence_lod.py)."""
+    return sequence_pool(input, "first", length=length)
+
 
 __all__ += ["dynamic_lstm", "dynamic_gru", "sequence_pool", "sequence_conv",
-            "cos_sim",
-            "While", "while_loop", "cond", "case", "switch_case", "Switch", "StaticRNN",
+            "cos_sim", "gru_unit",
+            "sequence_last_step", "sequence_first_step",
+            "While", "while_loop", "cond", "case", "switch_case", "Switch",
+            "StaticRNN", "DynamicRNN",
             "array_write", "array_read", "array_length", "create_array",
+            "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+            "array_to_lod_tensor", "reorder_lod_tensor_by_rank",
+            "shrink_memory", "split_lod_tensor", "merge_lod_tensor",
             "gather_tree", "warpctc", "ctc_greedy_decoder",
             "linear_chain_crf", "crf_decoding", "multiclass_nms",
             "anchor_generator", "bipartite_match", "generate_proposals",

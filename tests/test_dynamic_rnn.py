@@ -1,0 +1,284 @@
+"""DynamicRNN + LoD rank-table machinery.
+
+Reference surface: fluid.layers.DynamicRNN
+(/root/reference/python/paddle/fluid/layers/control_flow.py:2938) and the
+lod_rank_table / lod_tensor_to_array / array_to_lod_tensor /
+shrink_rnn_memory / reorder_lod_tensor_by_rank / split_lod_tensor /
+merge_lod_tensor op family.  TPU redesign: padded [B, T, ...] + lengths,
+one masked lax.scan (ops/kernels/control.py dynamic_rnn), where-masking
+instead of batch shrinking.
+"""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        return [np.asarray(o) for o in
+                exe.run(main, feed=feed, fetch_list=fetch)]
+
+
+# ---------------------------------------------------------------------------
+# LoD-array op family
+# ---------------------------------------------------------------------------
+def test_lod_rank_table_and_max_seq_len():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        lens = layers.data("lens", [-1], dtype="int32")
+        table = layers.lod_rank_table(length=lens)
+        msl = layers.max_sequence_len(table)
+    t, m = _run(main, startup,
+                {"lens": np.array([2, 5, 3, 5], np.int32)}, [table, msl])
+    # stable descending sort: lengths [5,5,3,2], ties keep input order
+    assert t[0].tolist() == [1, 3, 2, 0]
+    assert t[1].tolist() == [5, 5, 3, 2]
+    assert int(m.ravel()[0]) == 5
+
+
+def test_lod_tensor_array_round_trip():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4, 3], dtype="float32")
+        lens = layers.data("lens", [-1], dtype="int32")
+        table = layers.lod_rank_table(length=lens)
+        arr = layers.lod_tensor_to_array(x, table)
+        i = layers.fill_constant([1], "int64", 1)
+        step1 = layers.array_read(arr, i)
+        back = layers.array_to_lod_tensor(arr, table)
+        reord = layers.reorder_lod_tensor_by_rank(x, table)
+    xv = np.arange(36, dtype=np.float32).reshape(3, 4, 3)
+    lv = np.array([2, 4, 3], np.int32)
+    s1, b, r = _run(main, startup, {"x": xv, "lens": lv},
+                    [step1, back, reord])
+    order = [1, 2, 0]                      # lengths 4, 3, 2
+    # step slice 1 = time index 1 of every sequence, in rank order
+    np.testing.assert_allclose(s1, xv[order][:, 1])
+    # round trip restores input order exactly
+    np.testing.assert_allclose(b, xv)
+    np.testing.assert_allclose(r, xv[order])
+
+
+def test_split_merge_lod_tensor_round_trip():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 3], dtype="float32")
+        mask = layers.data("mask", [-1], dtype="bool")
+        t, f = layers.split_lod_tensor(x, mask)
+        merged = layers.merge_lod_tensor(t, f, x, mask)
+        # shrink_rnn_memory is identity on TPU (masking replaces shrink)
+        i = layers.fill_constant([1], "int64", 0)
+        table = layers.lod_rank_table(
+            length=layers.cast(mask, "int32"))
+        kept = layers.shrink_memory(x, i, table)
+    xv = np.arange(12, dtype=np.float32).reshape(4, 3)
+    mv = np.array([True, False, True, False])
+    tv, fv, mg, kp = _run(main, startup, {"x": xv, "mask": mv},
+                          [t, f, merged, kept])
+    np.testing.assert_allclose(tv[mv], xv[mv])
+    np.testing.assert_allclose(tv[~mv], 0)
+    np.testing.assert_allclose(fv[~mv], xv[~mv])
+    np.testing.assert_allclose(fv[mv], 0)
+    np.testing.assert_allclose(mg, xv)
+    np.testing.assert_allclose(kp, xv)
+
+
+def test_lod_array_backward():
+    """Gradients flow through the to/from-array permutation pair (each
+    grad is the inverse transform — explicit kernels in lod_array.py)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [3, 4, 2], dtype="float32")
+        lens = layers.data("lens", [3], dtype="int32")
+        table = layers.lod_rank_table(length=lens)
+        h = layers.fc(x, size=2, num_flatten_dims=2)
+        arr = layers.lod_tensor_to_array(h, table)
+        back = layers.array_to_lod_tensor(arr, table)
+        proj = layers.fc(back, size=2, num_flatten_dims=2)  # uses shape
+        loss = layers.mean(proj)
+        static.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    scope = static.Scope()
+    xv = np.random.RandomState(0).randn(3, 4, 2).astype(np.float32)
+    lv = np.array([2, 4, 1], np.int32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        l0 = float(np.asarray(exe.run(
+            main, feed={"x": xv, "lens": lv}, fetch_list=[loss])[0]))
+        l1 = float(np.asarray(exe.run(
+            main, feed={"x": xv, "lens": lv}, fetch_list=[loss])[0]))
+    assert l1 != l0  # parameters moved: grads reached the fc weights
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN forward semantics
+# ---------------------------------------------------------------------------
+def test_dynamic_rnn_masked_accumulation():
+    """Memory freezes at each sequence's last real step; outputs zero in
+    padding; sequence_last_step reads the frozen value — the observable
+    contract of the reference's shrinking executor."""
+    B, T, D = 3, 5, 2
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        lens = layers.data("lens", [B], dtype="int32")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, length=lens)
+            mem = drnn.memory(shape=[D])
+            acc = layers.elementwise_add(mem, xt)
+            drnn.update_memory(mem, acc)
+            drnn.output(acc)
+        out = drnn()
+        last = layers.sequence_last_step(out, length=lens)
+    xv = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    lv = np.array([3, 5, 1], np.int32)
+    ov, lastv = _run(main, startup, {"x": xv, "lens": lv}, [out, last])
+    for b in range(B):
+        n = lv[b]
+        expect = np.cumsum(xv[b, :n], axis=0)
+        np.testing.assert_allclose(ov[b, :n], expect, rtol=1e-5)
+        np.testing.assert_allclose(ov[b, n:], 0, atol=0)
+        np.testing.assert_allclose(lastv[b], expect[-1], rtol=1e-5)
+
+
+def test_dynamic_rnn_static_input_and_boot_memory():
+    """static_input visibility + memory(init=..., need_reorder=True)."""
+    B, T, D, H = 2, 4, 3, 3
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        lens = layers.data("lens", [B], dtype="int32")
+        boot = layers.data("boot", [B, H], dtype="float32")
+        stat = layers.data("stat", [B, H], dtype="float32")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, length=lens)
+            sv = drnn.static_input(stat)
+            mem = drnn.memory(init=boot, need_reorder=True)
+            nxt = layers.elementwise_add(layers.elementwise_add(mem, xt),
+                                         sv)
+            drnn.update_memory(mem, nxt)
+            drnn.output(nxt)
+        out = drnn()
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    bv = rng.randn(B, H).astype(np.float32)
+    sv_ = rng.randn(B, H).astype(np.float32)
+    lv = np.array([4, 2], np.int32)
+    (ov,) = _run(main, startup,
+                 {"x": xv, "lens": lv, "boot": bv, "stat": sv_}, [out])
+    for b in range(B):
+        h = bv[b].copy()
+        for t in range(lv[b]):
+            h = h + xv[b, t] + sv_[b]
+            np.testing.assert_allclose(ov[b, t], h, rtol=1e-5)
+        np.testing.assert_allclose(ov[b, lv[b]:], 0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# training through DynamicRNN
+# ---------------------------------------------------------------------------
+def _train(main, startup, feeds_fn, loss, iters=30):
+    exe = static.Executor()
+    scope = static.Scope()
+    losses = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for i in range(iters):
+            out = exe.run(main, feed=feeds_fn(i), fetch_list=[loss])
+            losses.append(float(np.asarray(out[0])))
+    return losses
+
+
+def test_dynamic_rnn_trains():
+    """Gradients flow through the masked scan: a tanh RNN learns to
+    classify ragged sequences by their (masked) mean sign."""
+    B, T, D, H = 8, 6, 4, 8
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, T, D], dtype="float32")
+        lens = layers.data("lens", [B], dtype="int32")
+        y = layers.data("y", [B, 1], dtype="int64")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, length=lens)
+            mem = drnn.memory(shape=[H])
+            h = layers.fc(layers.concat([xt, mem], axis=1), size=H,
+                          act="tanh")
+            drnn.update_memory(mem, h)
+            drnn.output(h)
+        out = drnn()
+        last = layers.sequence_last_step(out, length=lens)
+        logits = layers.fc(last, size=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(3)
+
+    def feeds(i):
+        xv = rng.randn(B, T, D).astype(np.float32)
+        lv = rng.randint(1, T + 1, B).astype(np.int32)
+        mask = (np.arange(T)[None, :] < lv[:, None])[..., None]
+        yv = (np.sum(xv * mask, axis=(1, 2)) > 0).astype(np.int64)
+        return {"x": xv, "lens": lv, "y": yv[:, None]}
+
+    losses = _train(main, startup, feeds, loss, iters=60)
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_machine_translation_dynamic_decoder():
+    """book/test_machine_translation.py shape: GRU encoder over ragged
+    source, DynamicRNN teacher-forced decoder with the encoder summary as
+    boot memory (need_reorder=True in the reference) — learns to copy."""
+    vocab, emb_dim, hid = 20, 16, 16
+    B, seq = 16, 6
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        src = layers.data("src", [B, seq], dtype="int64")
+        src_len = layers.data("src_len", [B], dtype="int32")
+        tgt_in = layers.data("tgt_in", [B, seq], dtype="int64")
+        tgt_out = layers.data("tgt_out", [B, seq, 1], dtype="int64")
+        tgt_len = layers.data("tgt_len", [B], dtype="int32")
+        # encoder
+        semb = layers.embedding(src, size=[vocab, emb_dim])
+        egate = layers.fc(semb, size=3 * hid, num_flatten_dims=2)
+        enc = layers.dynamic_gru(egate, size=hid)
+        boot = layers.sequence_last_step(enc, length=src_len)   # [B, hid]
+        # decoder on DynamicRNN (reference uses gru_unit inside the block)
+        temb = layers.embedding(tgt_in, size=[vocab, emb_dim])
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(temb, length=tgt_len)
+            mem = drnn.memory(init=boot, need_reorder=True)
+            dec_in = layers.fc(layers.concat([word, mem], axis=1),
+                               size=3 * hid)
+            h, _, _ = layers.gru_unit(input=dec_in, hidden=mem,
+                                      size=3 * hid)
+            drnn.update_memory(mem, h)
+            out = layers.fc(h, size=vocab)
+            drnn.output(out)
+        logits = drnn()                                    # [B, seq, vocab]
+        mask = layers.cast(layers.sequence_mask(tgt_len, maxlen=seq),
+                           "float32")
+        ce = layers.softmax_with_cross_entropy(logits, tgt_out)
+        loss = layers.reduce_sum(ce * layers.unsqueeze(mask, [2])) \
+            / layers.reduce_sum(mask)
+        static.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(2)
+
+    def feeds(i):
+        s = rng.randint(2, vocab, (B, seq)).astype(np.int64)
+        lv = rng.randint(2, seq + 1, B).astype(np.int32)
+        ti = np.concatenate([np.ones((B, 1), np.int64), s[:, :-1]], axis=1)
+        return {"src": s, "src_len": lv, "tgt_in": ti,
+                "tgt_out": s[..., None], "tgt_len": lv}
+
+    losses = _train(main, startup, feeds, loss, iters=80)
+    assert losses[-1] < losses[0] * 0.8, losses
