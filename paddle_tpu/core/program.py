@@ -435,7 +435,12 @@ class Program:
 
     def fingerprint(self) -> str:
         if self._fingerprint_cache is None:
-            payload = json.dumps(self.to_dict(), sort_keys=True, default=str)
+            d = self.to_dict()
+            # the startup/main stamp routes executor dispatch but is not
+            # part of the computation (and the proto wire format does not
+            # carry it) — keep fingerprints format-independent
+            d.pop("role", None)
+            payload = json.dumps(d, sort_keys=True, default=str)
             import hashlib
             self._fingerprint_cache = hashlib.sha1(payload.encode()).hexdigest()
         return self._fingerprint_cache
@@ -443,9 +448,17 @@ class Program:
     # -- serialization (P19/C22 parity) -------------------------------------
     def to_dict(self):
         from .op_version import saved_op_versions
-        return {"version": self._version, "random_seed": self.random_seed,
-                "op_versions": saved_op_versions(),
-                "blocks": [b.to_dict() for b in self.blocks]}
+        d = {"version": self._version, "random_seed": self.random_seed,
+             "op_versions": saved_op_versions(),
+             "blocks": [b.to_dict() for b in self.blocks]}
+        # the startup/main stamp must survive serialization (both wire
+        # formats carry it): a deserialized startup containing non-init
+        # ops (e.g. a PS init_sparse `send`) would otherwise fail the
+        # executor's init-op heuristic and take the jit path, which
+        # persists nothing into an empty scope
+        if self._role is not None:
+            d["role"] = self._role
+        return d
 
     def serialize_to_string(self, format: str = "json") -> bytes:
         """`format="json"` (default, human-diffable) or `format="proto"`
@@ -466,6 +479,7 @@ class Program:
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p._version = d.get("version", 1)
+        p._role = d.get("role")
         p.blocks = []
         for bd in d["blocks"]:
             b = Block(p, bd["idx"], bd["parent_idx"])

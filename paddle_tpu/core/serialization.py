@@ -88,7 +88,8 @@ def _get_attr(msg: "pb.Attribute") -> Any:
 
 def program_to_proto(program) -> "pb.ProgramDesc":
     p = pb.ProgramDesc(version=program._version,
-                       random_seed=program.random_seed)
+                       random_seed=program.random_seed,
+                       role=program._role or "")
     for t, v in saved_op_versions().items():
         p.op_versions[t] = v
     for block in program.blocks:
@@ -132,6 +133,8 @@ def _proto_to_dict(proto: "pb.ProgramDesc") -> dict:
     actual reconstruction (single shared path with the JSON format)."""
     d = {"version": proto.version, "random_seed": proto.random_seed,
          "op_versions": dict(proto.op_versions), "blocks": []}
+    if proto.role:
+        d["role"] = proto.role
     for bd in proto.blocks:
         vars_ = []
         for vd in bd.vars:

@@ -21,3 +21,4 @@ from .dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, QueueDataset, MultiSlotDataFeed,
 )
 from . import fleet  # noqa: F401
+from .heter import HeterSection, split_heter_program  # noqa: F401

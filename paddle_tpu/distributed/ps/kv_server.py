@@ -45,6 +45,8 @@ OP_HEARTBEAT = 11  # trainer liveness ping; extra carries the trainer id
 OP_PULL_ROWS = 12  # sparse pull: arr carries int64 LOCAL row ids
 OP_PUSH_ROWS = 13  # sparse push: ids message then values message (2-part)
 OP_CONFIG_SPARSE_OPT = 14  # arr=[beta1,beta2,eps], extra: 0=sgd 1=adam
+OP_QPUSH = 16      # named-queue push (heter activation relay)
+OP_QPOP = 17       # named-queue BLOCKING pop; extra carries the timeout
 OP_PUSH_ROWS_SYNC = 15     # 2-part like PUSH_ROWS; server accumulates
 #                            until every live trainer's push arrives,
 #                            averages merged rows, then applies the
@@ -176,6 +178,33 @@ class _Handler(socketserver.BaseRequestHandler):
                         _send_msg(sock, OP_ERROR, str(e), None)
                     else:
                         _send_msg(sock, OP_PUSH_ROWS_SYNC, name, None)
+                elif op == OP_QPUSH:
+                    with srv._queue_cv:
+                        srv._queues.setdefault(name, []).append(arr)
+                        srv._queue_cv.notify_all()
+                    _send_msg(sock, OP_QPUSH, name, None)
+                elif op == OP_QPOP:
+                    # extra is the server-side wait budget; 0 means a
+                    # non-blocking try-pop (the client loops short waits
+                    # so no single wait approaches its socket timeout)
+                    deadline = time.time() + max(0.0, extra)
+                    val = None
+                    timed_out = False
+                    with srv._queue_cv:
+                        while True:
+                            q = srv._queues.get(name)
+                            if q:
+                                val = q.pop(0)
+                                break
+                            if time.time() > deadline:
+                                timed_out = True
+                                break
+                            srv._queue_cv.wait(timeout=0.5)
+                    if timed_out:
+                        _send_msg(sock, OP_ERROR,
+                                  f"queue {name!r}: pop timed out", None)
+                    else:
+                        _send_msg(sock, OP_QPOP, name, val)
                 elif op == OP_CONFIG_SPARSE_OPT:
                     with srv._lock:
                         cfg = arr.astype(np.float64).reshape(-1)
@@ -234,6 +263,11 @@ class KVServer:
         self._sparse_opt: Dict[str, dict] = {}
         self._rows_pending: Dict[str, List] = {}
         self._rows_gen: Dict[str, int] = {}
+        # named blocking queues: the heter activation relay + the
+        # enqueue/dequeue op family (reference
+        # operators/collective/c_*queue* + framework BlockingQueue)
+        self._queues: Dict[str, List[np.ndarray]] = {}
+        self._queue_cv = threading.Condition()
         self._sync_cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -512,7 +546,10 @@ class KVClient:
             f"attempts / {self.rpc_deadline:.0f}s deadline: {last}")
 
     # ops where a post-send retry could double-count on the server
-    _NON_IDEMPOTENT = (OP_PUSH_SYNC, OP_BARRIER, OP_PUSH_ROWS_SYNC)
+    # (queue ops: a retried push double-enqueues, a retried pop after a
+    # server-side success drops an element)
+    _NON_IDEMPOTENT = (OP_PUSH_SYNC, OP_BARRIER, OP_PUSH_ROWS_SYNC,
+                       OP_QPUSH, OP_QPOP)
 
     def _call(self, ep, op, name="", arr=None, extra=0.0, deadline=None,
               max_retries=None):
@@ -643,6 +680,33 @@ class KVClient:
                 ep, roundtrip, idempotent=op not in self._NON_IDEMPOTENT)
             if rop == OP_ERROR:
                 raise TimeoutError(rname)
+
+    # -- named blocking queues (heter relay / enqueue-dequeue ops) ---------
+    def q_push(self, name, value):
+        """Push onto the named server queue (queue lives on the shard
+        `name` hashes to, so all parties agree without coordination)."""
+        self._call(self._ep_for(name), OP_QPUSH, name, np.asarray(value))
+
+    def q_pop(self, name, timeout=60.0) -> np.ndarray:
+        """Blocking pop; raises TimeoutError if nothing arrives within
+        `timeout` (0 = non-blocking try-pop).
+
+        The wait is a client-side loop of SHORT server-side waits, each
+        far below sock_timeout: a single long server wait would race the
+        socket timeout, and an element popped just after the client gave
+        up would be written to a discarded socket — lost, leaving the
+        relay off by one forever."""
+        deadline = time.time() + float(timeout)
+        chunk = max(1.0, min(10.0, self.sock_timeout / 4))
+        while True:
+            wait = min(chunk, max(deadline - time.time(), 0.0))
+            try:
+                _, _, arr, _ = self._call(self._ep_for(name), OP_QPOP,
+                                          name, extra=wait)
+                return arr
+            except TimeoutError:
+                if time.time() >= deadline:
+                    raise TimeoutError(f"queue {name!r}: pop timed out")
 
     def barrier(self):
         for ep in self.endpoints:

@@ -44,6 +44,12 @@ class DistributeTranspilerConfig:
         # distribute_transpiler.py:256); False → the runtime-managed
         # PSCompiledProgram push/pull path
         self.use_graph_ops = False
+        # heter mode (heter_wrapper.h analog): ONLY the distributed
+        # sparse tables go to the PS; dense params keep their LOCAL
+        # optimizer ops (the device section trains them) — the program is
+        # then split at boundary activations by
+        # distributed/heter.split_heter_program
+        self.heter_mode = False
 
 
 def _strip_optimizer_ops(program: Program) -> Program:
@@ -53,6 +59,18 @@ def _strip_optimizer_ops(program: Program) -> Program:
     block.ops = [op for op in block.ops
                  if not (op.op_role & OpRole.Optimize
                          or op.op_role == OpRole.LRSched)]
+    program._fingerprint_cache = None
+    return program
+
+
+def _strip_table_optimizer_ops(program: Program, tables) -> Program:
+    """Heter mode: remove ONLY the optimizer ops updating distributed
+    tables (the PS applies those server-side); dense optimizer ops stay
+    with the device section."""
+    block = program.global_block()
+    block.ops = [op for op in block.ops
+                 if not ((op.op_role & OpRole.Optimize)
+                         and op.inputs.get("Param", [None])[0] in tables)]
     program._fingerprint_cache = None
     return program
 
@@ -287,7 +305,13 @@ class DistributeTranspiler:
              if (op.op_role & OpRole.Optimize) and
              op.inputs.get("LearningRate")), None)
         dist_tables = self._distributed_tables(self._program)
-        prog = _strip_optimizer_ops(self._program.clone())
+        if self.config.heter_mode:
+            # dense params train locally in the device section; only the
+            # table's optimizer moves server-side
+            prog = _strip_table_optimizer_ops(self._program.clone(),
+                                              dist_tables)
+        else:
+            prog = _strip_optimizer_ops(self._program.clone())
         block = prog.global_block()
         for op in block.ops:
             if op.type in self._LOOKUP_TYPES and \
@@ -325,7 +349,7 @@ class DistributeTranspiler:
                  # — averaging no longer trusts client-side grad_scale
                  "sync": bool(self.config.sync_mode),
                  OpRole.KEY: OpRole.RPC})
-        if param_names:
+        if param_names and not self.config.heter_mode:
             self._append_ps_graph_ops(block, block, grad_names,
                                       param_names, mode, lr_var=lr_var)
         return prog
@@ -384,7 +408,7 @@ class DistributeTranspiler:
                  "mode": "init_sparse", "trainer_id": self._trainer_id,
                  "sparse_opt": self._sparse_opt_config(n),
                  OpRole.KEY: OpRole.RPC})
-        if param_names:
+        if param_names and not self.config.heter_mode:
             self._append_ps_graph_ops(sb, mb, param_names, param_names,
                                       "init")
         self._startup._ps_startup_transpiled = True

@@ -203,6 +203,107 @@ def distributed_lookup_table(ins, attrs, ctx):
 
 
 # ---------------------------------------------------------------------------
+# named-queue ops + the heter activation relay
+# ---------------------------------------------------------------------------
+# Reference: framework/blocking_queue.h + operators/controlflow/
+# queue_generator (enqueue/dequeue used by pipeline/heter trainers), and
+# the HeterWrapper activation handoff
+# (/root/reference/paddle/fluid/framework/fleet/heter_wrapper.h:54 —
+# CPU workers own the sparse side, device workers the dense compute,
+# bridged by RPC).  TPU redesign: the queues live on the KV service;
+# graph ops reach them through ordered io_callback, so the handoff IS
+# part of the compiled step.
+
+@register_op("queue_generator", inputs=[], outputs=[], grad=None,
+             side_effect=True)
+def queue_generator(ins, attrs, ctx):
+    """Declares queue names (attrs[names]); queues materialize lazily on
+    the KV server at first push, so this is a declaration-only op kept
+    for program parity (reference queue_generator_op.cc)."""
+    return {}
+
+
+@register_op("enqueue", inputs=["X"], outputs=["Out?"], grad=None,
+             side_effect=True)
+def enqueue(ins, attrs, ctx):
+    """enqueue_op.cc analog: push X onto the named KV-server queue."""
+    endpoints = tuple(attrs["endpoints"])
+    qname = attrs["queue_name"]
+
+    def host(x):
+        _client(endpoints).q_push(qname, np.asarray(x))
+        return np.zeros((1,), np.float32)
+
+    return {"Out": io_callback(host,
+                               jax.ShapeDtypeStruct((1,), jnp.float32),
+                               ins["X"], ordered=True)}
+
+
+@register_op("dequeue", inputs=["Dummy?!"], outputs=["Out"], grad=None,
+             side_effect=True)
+def dequeue(ins, attrs, ctx):
+    """dequeue_op.cc analog: blocking pop (shape/dtype from attrs)."""
+    endpoints = tuple(attrs["endpoints"])
+    qname = attrs["queue_name"]
+    shape = tuple(attrs["shape"])
+    dtype = np.dtype(attrs.get("dtype", "float32"))
+    timeout = float(attrs.get("timeout", 60.0))
+
+    def host():
+        arr = _client(endpoints).q_pop(qname, timeout=timeout)
+        return np.ascontiguousarray(arr, dtype=dtype).reshape(shape)
+
+    return {"Out": io_callback(host, jax.ShapeDtypeStruct(shape, dtype),
+                               ordered=True)}
+
+
+@register_op("heter_send", inputs=["X*"], outputs=["Dummy?"], grad=None,
+             side_effect=True)
+def heter_send(ins, attrs, ctx):
+    """Heter handoff, sending side: ship the boundary tensors (CPU
+    worker's activations, or the device worker's activation grads) to
+    the peer section through per-variable KV queues."""
+    endpoints = tuple(attrs["endpoints"])
+    names = list(attrs["send_varnames"])
+    channel = attrs.get("channel", "heter")
+    xs = list(ins["X"] or [])
+
+    def host(*arrs):
+        c = _client(endpoints)
+        for n, a in zip(names, arrs):
+            c.q_push(f"{channel}/{n}", np.asarray(a))
+        return np.zeros((1,), np.float32)
+
+    return {"Dummy": io_callback(host,
+                                 jax.ShapeDtypeStruct((1,), jnp.float32),
+                                 *xs, ordered=True)}
+
+
+@register_op("heter_recv", inputs=["Dummy?!"], outputs=["Out*"],
+             grad=None, side_effect=True)
+def heter_recv(ins, attrs, ctx):
+    """Heter handoff, receiving side: blocking-pop the peer section's
+    boundary tensors."""
+    endpoints = tuple(attrs["endpoints"])
+    names = list(attrs["recv_varnames"])
+    channel = attrs.get("channel", "heter")
+    shapes = [tuple(s) for s in attrs["shapes"]]
+    dtypes = [np.dtype(d) for d in attrs["dtypes"]]
+    timeout = float(attrs.get("timeout", 60.0))
+
+    def host():
+        c = _client(endpoints)
+        return tuple(
+            np.ascontiguousarray(
+                c.q_pop(f"{channel}/{n}", timeout=timeout),
+                dtype=d).reshape(s)
+            for n, s, d in zip(names, shapes, dtypes))
+
+    result = [jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes)]
+    return {"Out": list(io_callback(host, tuple(result), ordered=True))}
+
+
+# ---------------------------------------------------------------------------
 # large-scale sparse-table op family (pslib analog)
 # ---------------------------------------------------------------------------
 # Reference: /root/reference/paddle/fluid/operators/distributed_ops/

@@ -1,0 +1,44 @@
+"""CPU-side heter worker for test_heter_ps — the HeterWrapper CPU-trainer
+role (heter_wrapper.h:54): owns the sparse/embedding section (pulls rows
+from the KV PS, ships boundary activations to the device worker over the
+KV queues, receives activation grads back, pushes the SelectedRows table
+grad).  Runs the serialized CPU section program in its own process."""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    spec_path = sys.argv[1]
+    with open(spec_path) as f:
+        spec = json.load(f)
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+
+    import numpy as np
+    import paddle_tpu.static as static
+    from paddle_tpu.core.program import Program
+
+    startup = Program.from_dict(spec["startup"])
+    cpu_prog = Program.from_dict(spec["cpu_program"])
+    feeds = np.asarray(spec["slots"], np.int64)
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(spec["steps"]):
+            exe.run(cpu_prog, feed={spec["feed_name"]: feeds},
+                    fetch_list=[])
+    print("CPU_WORKER_DONE")
+
+
+if __name__ == "__main__":
+    main()
