@@ -437,8 +437,9 @@ class Program:
         if self._fingerprint_cache is None:
             d = self.to_dict()
             # the startup/main stamp routes executor dispatch but is not
-            # part of the computation (and the proto wire format does not
-            # carry it) — keep fingerprints format-independent
+            # part of the computation — exclude it so fingerprints of
+            # stamped and heuristic-dispatched copies of the same graph
+            # agree
             d.pop("role", None)
             payload = json.dumps(d, sort_keys=True, default=str)
             import hashlib

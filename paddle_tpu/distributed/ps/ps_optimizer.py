@@ -220,6 +220,11 @@ class DistributeTranspiler:
             raise RuntimeError(
                 "transpile() requires a program minimized by an optimizer "
                 "(params_grads recorded)")
+        if self.config.heter_mode and not self.config.use_graph_ops:
+            raise ValueError(
+                "heter_mode requires use_graph_ops=True — the runtime "
+                "PSCompiledProgram path would silently train a non-heter "
+                "dense-PS topology")
         if self.config.use_graph_ops and not self.config.geo_sgd_mode:
             return self._transpile_with_graph_ops(pgs)
         if self._distributed_tables(self._program):

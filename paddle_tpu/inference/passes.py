@@ -130,6 +130,102 @@ def fc_fuse_pass(program: Program, ctx: PassContext) -> Program:
     return program
 
 
+@register_pass("quant_int8_pass")
+def quant_int8_pass(program: Program, ctx: PassContext) -> Program:
+    """INT8 execution rewrite (the role of the reference's
+    cpu_quantize_pass, ir/mkldnn/cpu_quantize_pass.cc): in a
+    QuantizationFreezePass-frozen program, collapse
+    fake_dequantize_max_abs(w_int8) → mul/matmul/fc into ONE int8_matmul
+    op, so the frozen program actually executes an int8 dot (int32
+    accumulation on the MXU) instead of dequantize-then-fp32-matmul.
+    Only fires when the dequant input var really is int8 — float programs
+    are untouched."""
+    block = program.global_block()
+    deq_types = ("fake_dequantize_max_abs",
+                 "fake_channel_wise_dequantize_max_abs")
+    producer: Dict[str, OpDesc] = {}
+    consumers: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_names():
+            consumers[n] = consumers.get(n, 0) + 1
+        for n in op.output_names():
+            producer[n] = op
+
+    def _int8_weight(deq: OpDesc):
+        wname = deq.inputs["X"][0]
+        try:
+            if block.var(wname).dtype != "int8":
+                return None
+        except KeyError:
+            return None
+        return wname
+
+    kept: List[OpDesc] = []
+    removed_deq: set = set()
+    for op in block.ops:
+        rewritten = False
+        wslot = {"mul": "Y", "matmul": "Y", "matmul_v2": "Y",
+                 "fc": "W"}.get(op.type)
+        # int8_matmul implements plain X[..., K] @ W[K, N] only — any
+        # transpose, alpha scaling, or non-default column flattening
+        # keeps the float path (the float kernels honor those attrs)
+        plain = (wslot is not None
+                 and not op.attrs.get("transpose_Y")
+                 and not op.attrs.get("trans_y")
+                 and not op.attrs.get("transpose_X")
+                 and not op.attrs.get("trans_x")
+                 and float(op.attrs.get("alpha", 1.0)) == 1.0
+                 and int(op.attrs.get("x_num_col_dims", 1)) == 1
+                 and int(op.attrs.get("in_num_col_dims", 1)) == 1)
+        if plain and op.type in ("mul", "fc"):
+            # mul/fc flatten at axis 1; int8_matmul contracts the LAST
+            # axis — equivalent only for 2-D activations
+            xn = op.inputs.get("Input" if op.type == "fc" else "X",
+                               [None])[0]
+            try:
+                xshape = block.var(xn).shape
+            except KeyError:
+                xshape = None
+            plain = xshape is not None and len(xshape) == 2
+        if plain:
+            wname = op.inputs.get(wslot, [None])[0]
+            deq = producer.get(wname)
+            if deq is not None and deq.type in deq_types \
+                    and _int8_weight(deq) is not None:
+                sc_slot = ("Scale" if deq.type ==
+                           "fake_dequantize_max_abs" else "Scales")
+                scales = deq.inputs[sc_slot]
+                # channel-wise supported only on the out-channel axis of
+                # [K, N] and single-level scales — anything else keeps
+                # the float path
+                if deq.type.startswith("fake_channel_wise") and (
+                        deq.attrs.get("quant_axis", 0) != 1
+                        or len(scales) != 1):
+                    kept.append(op)
+                    continue
+                xslot = "Input" if op.type == "fc" else "X"
+                ins = {"X": op.inputs[xslot],
+                       "W": [deq.inputs["X"][0]],
+                       "WScale": [scales[0]]}
+                if op.type == "fc" and op.inputs.get("Bias"):
+                    ins["Bias"] = op.inputs["Bias"]
+                kept.append(OpDesc(
+                    "int8_matmul", ins, {"Out": op.outputs["Out"]},
+                    {"max_range": float(deq.attrs.get("max_range",
+                                                      127.0)),
+                     "op_uid": program._next_uid(),
+                     OpRole.KEY: OpRole.Forward}))
+                if consumers.get(wname, 0) == 1:
+                    removed_deq.add(id(deq))
+                ctx.hit("int8_matmul_rewritten")
+                rewritten = True
+        if not rewritten:
+            kept.append(op)
+    block.ops = [op for op in kept if id(op) not in removed_deq]
+    program._fingerprint_cache = None
+    return program
+
+
 @register_pass("conv_bn_fuse_pass")
 def conv_bn_fuse_pass(program: Program, ctx: PassContext) -> Program:
     """ir/conv_bn_fuse_pass.cc: fold inference batch_norm into the
@@ -220,6 +316,9 @@ DEFAULT_INFERENCE_PASSES = [
     "is_test_pass",
     "simplify_with_basic_ops_pass",
     "fc_fuse_pass",
+    # after fc_fuse so frozen fake_dequantize→fc chains are seen fused;
+    # no-op on float programs (fires only on real int8 weight vars)
+    "quant_int8_pass",
     "conv_bn_fuse_pass",
     "prune_feed_fetch_pass",
 ]

@@ -97,6 +97,77 @@ def fake_channel_wise_dequantize_max_abs(ins, attrs, ctx):
     return {"Out": out}
 
 
+# -- REAL int8 execution path -----------------------------------------------
+# Reference: /root/reference/paddle/fluid/operators/quantize_op.cc:52,
+# dequantize_op.cc, requantize_op.cc (the mkldnn int8 inference chain) —
+# scale-MULTIPLY convention: q = round(x * scale), x = q / scale.
+
+@register_op("quantize", inputs=["Input"], outputs=["Output"], grad=None)
+def quantize_op(ins, attrs, ctx):
+    """quantize_op.cc:52 — fp32 -> int8 (or uint8 when the input is known
+    non-negative, e.g. post-relu): q = round(x * scale)."""
+    x = ins["Input"].astype(jnp.float32)
+    scale = float(attrs.get("Scale", attrs.get("scale", 1.0)))
+    neg = bool(attrs.get("is_negative_input", True))
+    q = jnp.round(x * scale)
+    if neg:
+        return {"Output": jnp.clip(q, -128, 127).astype(jnp.int8)}
+    return {"Output": jnp.clip(q, 0, 255).astype(jnp.uint8)}
+
+
+@register_op("dequantize", inputs=["Input"], outputs=["Output"],
+             grad=None)
+def dequantize_op(ins, attrs, ctx):
+    """dequantize_op.cc — int8/uint8 -> fp32: x = q / scale."""
+    scale = float(attrs.get("Scale", attrs.get("scale", 1.0)))
+    return {"Output": ins["Input"].astype(jnp.float32) / scale}
+
+
+@register_op("requantize", inputs=["Input"], outputs=["Output"],
+             grad=None)
+def requantize_op(ins, attrs, ctx):
+    """requantize_op.cc — re-scale an int8 tensor between two quantized
+    domains without a float round trip: q' = round(q * s_out / s_in)."""
+    s_in = float(attrs.get("Scale_in", attrs.get("scale_in", 1.0)))
+    s_out = float(attrs.get("Scale_out", attrs.get("scale_out", 1.0)))
+    q = jnp.round(ins["Input"].astype(jnp.float32) * (s_out / s_in))
+    return {"Output": jnp.clip(q, -128, 127).astype(jnp.int8)}
+
+
+@register_op("int8_matmul", inputs=["X", "W!", "WScale!", "Bias?"],
+             outputs=["Out"], grad=None)
+def int8_matmul(ins, attrs, ctx):
+    """The int8 execution core the quant_int8_pass rewrites frozen
+    fake_dequantize→mul/fc chains onto (replacing the reference's mkldnn
+    int8 mul/fc kernels, operators/mkldnn/mul_mkldnn_op.cc).
+
+    One fused kernel: dynamic per-tensor activation quantization, int8 x
+    int8 dot accumulated in int32 (preferred_element_type — this is the
+    dot XLA lowers onto the v5e MXU int8 path at 2x bf16 rate), then one
+    combined dequant multiply.  W is the frozen int8 weight [K, N];
+    WScale the freeze-time abs-max (per-tensor [1] or per-out-channel
+    [N]), dequant convention w_f = w_q * scale / max_range matching
+    fake_dequantize_max_abs."""
+    x, w, ws = ins["X"], ins["W"], ins["WScale"]
+    max_range = float(attrs.get("max_range", 127.0))
+    xf = x.astype(jnp.float32)
+    absmax = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8)
+    xs = 127.0 / absmax
+    xq = jnp.clip(jnp.round(xf * xs), -127, 127).astype(jnp.int8)
+    x2 = xq.reshape((-1, xq.shape[-1]))
+    acc = jax.lax.dot_general(
+        x2, w.astype(jnp.int8), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    wscale = ws.astype(jnp.float32).reshape(-1) / max_range
+    deq = wscale.reshape(()) if wscale.size == 1 else wscale[None, :]
+    out = acc.astype(jnp.float32) * deq / xs
+    out = out.reshape(tuple(x.shape[:-1]) + (w.shape[1],))
+    if ins.get("Bias") is not None:
+        out = out + ins["Bias"].astype(jnp.float32)
+    return {"Out": out.astype(ins["X"].dtype if x.dtype != jnp.int8
+                              else jnp.float32)}
+
+
 # -- quant+dequant (QAT simulated path, STE gradient) -----------------------
 @register_op("fake_quantize_dequantize_abs_max", inputs=["X"],
              outputs=["Out", "OutScale"], grad=_ste_grad)

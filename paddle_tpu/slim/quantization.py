@@ -322,6 +322,15 @@ class PostTrainingQuantization:
                 self.scope.set(op.inputs["InScale"][0],
                                np.asarray([max(maxes.get(base, 0.0), 1e-8)],
                                           np.float32))
+                # the transform ran with startup_program=None, so the
+                # moving-average state vars have no initializer anywhere;
+                # give them values so save_inference_model of the frozen
+                # program can persist them (unused at is_test)
+                self.scope.set(op.inputs["InState"][0],
+                               np.asarray([1.0], np.float32))
+                self.scope.set(op.inputs["InAccum"][0],
+                               np.asarray([max(maxes.get(base, 0.0), 1e-8)],
+                                          np.float32))
         QuantizationFreezePass(self.weight_bits).apply(quant, self.scope)
         quant._fingerprint_cache = None
         return quant
