@@ -130,6 +130,182 @@ def fc_fuse_pass(program: Program, ctx: PassContext) -> Program:
     return program
 
 
+@register_pass("multihead_matmul_fuse_pass")
+def multihead_matmul_fuse_pass(program: Program, ctx: PassContext) \
+        -> Program:
+    """ir/multihead_matmul_fuse_pass.cc analog: collapse the static-graph
+    attention idiom
+
+        q/k/v = transpose0213(reshape4d(mul(X, W) [+ bias]))
+        scores = matmul(q, k, transpose_y=True, alpha)
+        [scores = scores + mask]
+        ctx = matmul(softmax(scores), v)
+        out = reshape3d(transpose0213(ctx))
+
+    into ONE multihead_matmul op on the shared attention core.  All
+    three projections must read the same input; every fused intermediate
+    must have exactly one consumer (otherwise the pattern is left
+    alone)."""
+    block = program.global_block()
+    producer: Dict[str, OpDesc] = {}
+    consumers: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_names():
+            consumers[n] = consumers.get(n, 0) + 1
+        for n in op.output_names():
+            producer[n] = op
+
+    def _single(name):
+        return consumers.get(name, 0) == 1
+
+    def _proj(name):
+        """Trace name back through transpose([0,2,1,3]) <- reshape(4d)
+        <- mul [+ elementwise_add].  Returns (x, w, b, heads, ops)."""
+        t = producer.get(name)
+        if t is None or t.type not in ("transpose", "transpose2") or \
+                list(t.attrs.get("perm", t.attrs.get("axis", ()))) != \
+                [0, 2, 1, 3] or \
+                not _single(t.inputs["X"][0]):
+            return None
+        r = producer.get(t.inputs["X"][0])
+        if r is None or r.type not in ("reshape", "reshape2"):
+            return None
+        shape = list(r.attrs.get("shape", ()))
+        if len(shape) != 4 or not _single(r.inputs["X"][0]):
+            return None
+        heads = shape[2]
+        p = producer.get(r.inputs["X"][0])
+        matched = [t, r]
+        bias = None
+        if p is not None and p.type == "elementwise_add":
+            bias = p.inputs["Y"][0]
+            # only a real projection bias (persistable ~1-D, same check
+            # as fc_fuse_pass) — a residual/positional add is NOT one
+            try:
+                bvar = block.var(bias)
+                is_bias = bvar.persistable and bvar.shape and \
+                    len([s for s in bvar.shape if s != 1]) <= 1
+            except KeyError:
+                is_bias = False
+            if not is_bias or not _single(p.inputs["X"][0]):
+                return None
+            matched.append(p)
+            p = producer.get(p.inputs["X"][0])
+        if p is None or p.type != "mul":
+            return None
+        matched.append(p)
+        return (p.inputs["X"][0], p.inputs["Y"][0], bias, heads, matched)
+
+    kept = list(block.ops)
+    fused_any = True
+    while fused_any:
+        fused_any = False
+        for sm in kept:
+            if sm.type != "softmax" or \
+                    int(sm.attrs.get("axis", -1)) not in (-1, 3):
+                continue
+            s_in = sm.inputs["X"][0]
+            matched = [sm]
+            mask = None
+            qk = producer.get(s_in)
+            if qk is not None and qk.type == "elementwise_add":
+                add = qk
+                qk = producer.get(add.inputs["X"][0])
+                mask = add.inputs["Y"][0]
+                if qk is None or not _single(add.inputs["X"][0]):
+                    continue
+                matched.append(add)
+            if qk is None or qk.type not in ("matmul", "matmul_v2") or \
+                    not (qk.attrs.get("transpose_Y")
+                         or qk.attrs.get("trans_y")) or \
+                    not _single(s_in):
+                continue
+            matched.append(qk)
+            # the head tensors themselves must feed ONLY this attention —
+            # deleting their producers while another consumer survives
+            # would leave it reading a var nothing produces
+            if not (_single(qk.inputs["X"][0])
+                    and _single(qk.inputs["Y"][0])):
+                continue
+            pq = _proj(qk.inputs["X"][0])
+            pk = _proj(qk.inputs["Y"][0])
+            if pq is None or pk is None:
+                continue
+            # softmax output -> context matmul with v
+            ctx_mm = None
+            for op in kept:
+                if op.type in ("matmul", "matmul_v2") and \
+                        op.inputs.get("X", [None])[0] == \
+                        sm.outputs["Out"][0]:
+                    ctx_mm = op
+                    break
+            if ctx_mm is None or not _single(sm.outputs["Out"][0]) \
+                    or float(ctx_mm.attrs.get("alpha", 1.0)) != 1.0 \
+                    or not _single(ctx_mm.inputs["Y"][0]):
+                continue
+            pv = _proj(ctx_mm.inputs["Y"][0])
+            if pv is None:
+                continue
+            if not (pq[0] == pk[0] == pv[0]) or \
+                    not (pq[3] == pk[3] == pv[3]):
+                continue
+            matched.append(ctx_mm)
+            # out chain: transpose0213 -> reshape back to 3d
+            t_out = None
+            for op in kept:
+                if op.type in ("transpose", "transpose2") and \
+                        op.inputs["X"][0] == ctx_mm.outputs["Out"][0]:
+                    t_out = op
+                    break
+            if t_out is None or \
+                    list(t_out.attrs.get("perm",
+                                      t_out.attrs.get("axis", ()))) != \
+                    [0, 2, 1, 3] or \
+                    not _single(ctx_mm.outputs["Out"][0]):
+                continue
+            r_out = None
+            for op in kept:
+                if op.type in ("reshape", "reshape2") and \
+                        op.inputs["X"][0] == t_out.outputs["Out"][0]:
+                    r_out = op
+                    break
+            if r_out is None or not _single(t_out.outputs["Out"][0]) \
+                    or len(list(r_out.attrs.get("shape", ()))) != 3:
+                # the fused op emits [B, L, D]; any other merge shape
+                # (e.g. flatten-to-2D) keeps the float pattern
+                continue
+            matched += [t_out, r_out]
+            matched += pq[4] + pk[4] + pv[4]
+
+            ins = {"Input": [pq[0]], "WQ": [pq[1]], "WK": [pk[1]],
+                   "WV": [pv[1]]}
+            if pq[2]:
+                ins["BQ"] = [pq[2]]
+            if pk[2]:
+                ins["BK"] = [pk[2]]
+            if pv[2]:
+                ins["BV"] = [pv[2]]
+            if mask:
+                ins["BiasQK"] = [mask]
+            fused = OpDesc(
+                "multihead_matmul", ins,
+                {"Out": r_out.outputs["Out"]},
+                {"head_number": pq[3],
+                 "alpha": float(qk.attrs.get("alpha", 1.0)),
+                 "op_uid": program._next_uid(),
+                 OpRole.KEY: OpRole.Forward})
+            ids = set(map(id, matched))
+            pos = min(i for i, op in enumerate(kept) if id(op) in ids)
+            kept = [op for op in kept if id(op) not in ids]
+            kept.insert(pos, fused)
+            ctx.hit("multihead_matmul_fused")
+            fused_any = True
+            break
+    block.ops = kept
+    program._fingerprint_cache = None
+    return program
+
+
 @register_pass("quant_int8_pass")
 def quant_int8_pass(program: Program, ctx: PassContext) -> Program:
     """INT8 execution rewrite (the role of the reference's
@@ -315,6 +491,7 @@ def prune_pass(program: Program, ctx: PassContext) -> Program:
 DEFAULT_INFERENCE_PASSES = [
     "is_test_pass",
     "simplify_with_basic_ops_pass",
+    "multihead_matmul_fuse_pass",
     "fc_fuse_pass",
     # after fc_fuse so frozen fake_dequantize→fc chains are seen fused;
     # no-op on float programs (fires only on real int8 weight vars)

@@ -56,3 +56,56 @@ def ring_attention_op(ins, attrs, ctx):
         ax = axes if isinstance(axes, str) else axes[0]
         out = ring_attention(qh, kh, vh, ax, causal=causal)
     return {"Out": merge(out)}
+
+
+@register_op("multihead_matmul",
+             inputs=["Input", "WQ", "BQ?", "WK", "BK?", "WV", "BV?",
+                     "BiasQK?"],
+             outputs=["Out"], grad=None)
+def multihead_matmul_op(ins, attrs, ctx):
+    """Fused Q/K/V projection + scaled-dot-product attention
+    (/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cc:1
+    — the reference predictor's BERT fusion; its W packs QKV into one
+    tensor, here the three projection weights ride in separate slots so
+    the fusion pass never has to rewrite the loaded scope).
+
+    Input [B, L, D] -> Out [B, L, D] (merged heads, pre-out-projection).
+    Lowering: one einsum per projection, then the SHARED attention core —
+    Pallas flash when no additive mask and the static sequence length
+    clears the crossover, XLA softmax(QK^T)V otherwise.  attrs:
+    head_number, alpha (logit scale)."""
+    import math as _math
+
+    import jax.numpy as jnp
+
+    from ..attention import (flash_attention, reference_attention,
+                             use_flash_for)
+    x = ins["Input"]
+    h = int(attrs["head_number"])
+    b, l, d = x.shape
+
+    def proj(w, bias):
+        y = jnp.einsum("bld,dk->blk", x, w)
+        if bias is not None:
+            y = y + bias.reshape((1, 1, -1))
+        return jnp.transpose(y.reshape(b, l, h, -1), (0, 2, 1, 3))
+
+    q = proj(ins["WQ"], ins.get("BQ"))
+    k = proj(ins["WK"], ins.get("BK"))
+    v = proj(ins["WV"], ins.get("BV"))
+    scale = float(attrs.get("alpha", 1.0 / _math.sqrt(q.shape[-1])))
+    bias_qk = ins.get("BiasQK")
+    if bias_qk is None and use_flash_for(l) and \
+            abs(scale - 1.0 / _math.sqrt(q.shape[-1])) < 1e-9:
+        out = flash_attention(q, k, v)
+    else:
+        if bias_qk is not None:
+            # broadcastable to [B, H, L, L]: [L, L] masks gain leading
+            # axes, a [B, L, L] mask gains the head axis
+            if bias_qk.ndim <= 2:
+                while bias_qk.ndim < 4:
+                    bias_qk = bias_qk[None]
+            elif bias_qk.ndim == 3:
+                bias_qk = bias_qk[:, None]
+        out = reference_attention(q, k, v, bias=bias_qk, scale=scale)
+    return {"Out": jnp.transpose(out, (0, 2, 1, 3)).reshape(b, l, d)}

@@ -1,0 +1,137 @@
+"""Attention fusion pass (VERDICT r4 #6).
+
+Reference: /root/reference/paddle/fluid/operators/fused/
+multihead_matmul_op.cc:1 + ir/multihead_matmul_fuse_pass — the
+predictor's BERT win: Q/K/V projections + softmax(QK^T)V collapse into
+one fused op.  Here the fused op lowers onto the SHARED attention core
+(flash when eligible, XLA otherwise).
+"""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+B, L, D, H = 2, 8, 16, 4
+
+
+def _attention_block(x, mask=None, prefix="a"):
+    """The static-graph attention idiom the reference pass matches."""
+    def proj(name):
+        return layers.fc(x, D, num_flatten_dims=2,
+                         param_attr=static.ParamAttr(
+                             name=f"{prefix}_{name}_w"),
+                         bias_attr=static.ParamAttr(
+                             name=f"{prefix}_{name}_b"))
+
+    def heads(t):
+        t = layers.reshape(t, [0, 0, H, D // H])
+        return layers.transpose(t, [0, 2, 1, 3])
+
+    q, k, v = heads(proj("q")), heads(proj("k")), heads(proj("v"))
+    scores = layers.matmul(q, k, transpose_y=True,
+                           alpha=1.0 / np.sqrt(D // H))
+    if mask is not None:
+        scores = layers.elementwise_add(scores, mask)
+    ctx = layers.matmul(layers.softmax(scores), v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, D])
+    return layers.fc(ctx, D, num_flatten_dims=2,
+                     param_attr=static.ParamAttr(name=f"{prefix}_o_w"),
+                     bias_attr=static.ParamAttr(name=f"{prefix}_o_b"))
+
+
+def _build(with_mask):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, L, D])
+        mask = layers.data("mask", [B, 1, L, L]) if with_mask else None
+        out = _attention_block(x, mask)
+    return main, startup, out
+
+
+def _run(prog, startup, feed, fetch, scope):
+    exe = static.Executor()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        return np.asarray(exe.run(prog, feed=feed,
+                                  fetch_list=[fetch])[0])
+
+
+def test_multihead_fuse_collapses_ops_and_matches():
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, L, D).astype(np.float32)
+    mv = (rng.rand(B, 1, L, L) > 0.5).astype(np.float32) * -1e4
+
+    for with_mask in (False, True):
+        main, startup, out = _build(with_mask)
+        feed = {"x": xv, "mask": mv} if with_mask else {"x": xv}
+        scope = static.Scope()
+        ref = _run(main, startup, feed, out, scope)
+
+        n_before = len(main.global_block().ops)
+        ctx = PassContext()
+        fused = get_pass("multihead_matmul_fuse_pass")(main, ctx)
+        types = [op.type for op in fused.global_block().ops]
+        assert "multihead_matmul" in types, types
+        assert "softmax" not in types
+        # 17-op attention core + mask-add collapses to 1 fused op
+        assert len(types) <= n_before - 14, (n_before, types)
+        got = _run(fused, startup, feed, out, scope)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_multihead_fuse_leaves_cross_attention_alone():
+    """Projections reading different inputs (cross-attention between two
+    sources) must not be fused by the self-attention pattern."""
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, L, D])
+        y = layers.data("y", [B, L, D])
+
+        def heads(t):
+            t = layers.reshape(t, [0, 0, H, D // H])
+            return layers.transpose(t, [0, 2, 1, 3])
+
+        q = heads(layers.fc(x, D, num_flatten_dims=2))
+        k = heads(layers.fc(y, D, num_flatten_dims=2))
+        v = heads(layers.fc(y, D, num_flatten_dims=2))
+        scores = layers.matmul(q, k, transpose_y=True, alpha=0.5)
+        ctx_t = layers.matmul(layers.softmax(scores), v)
+        ctx_t = layers.transpose(ctx_t, [0, 2, 1, 3])
+        layers.reshape(ctx_t, [0, 0, D])
+    before = [op.type for op in main.global_block().ops]
+    prog = get_pass("multihead_matmul_fuse_pass")(main, PassContext())
+    assert [op.type for op in prog.global_block().ops] == before
+
+
+def test_bert_style_predictor_end_to_end(tmp_path):
+    """Two stacked attention layers through the saved-model predictor:
+    the default pipeline fuses BOTH and outputs match the raw program."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.io.framework_io import save_inference_model
+
+    rng = np.random.RandomState(1)
+    xv = rng.randn(B, L, D).astype(np.float32)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, L, D])
+        h = _attention_block(x, prefix="l0")
+        h = _attention_block(h, prefix="l1")
+        out = layers.reduce_mean(h, dim=[1, 2])
+
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        save_inference_model(str(tmp_path), ["x"], [out], exe, main)
+
+    predictor = create_predictor(Config(str(tmp_path)))
+    types = [op.type for op in
+             predictor._program.global_block().ops]
+    assert types.count("multihead_matmul") == 2, types
+    (got,) = predictor.run([xv])
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4,
+                               atol=2e-5)
