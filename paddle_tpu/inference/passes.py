@@ -83,6 +83,17 @@ def simplify_pass(program: Program, ctx: PassContext) -> Program:
     return program
 
 
+def _is_projection_bias(block, name):
+    """A real bias addend: persistable, effectively 1-D (shared by the
+    fc and multihead fusion passes)."""
+    try:
+        bvar = block.var(name)
+    except KeyError:
+        return False
+    return bool(bvar.persistable and bvar.shape
+                and len([s for s in bvar.shape if s != 1]) <= 1)
+
+
 @register_pass("fc_fuse_pass")
 def fc_fuse_pass(program: Program, ctx: PassContext) -> Program:
     """ir/fc_fuse_pass.cc: mul + elementwise_add(bias) → fc."""
@@ -102,13 +113,7 @@ def fc_fuse_pass(program: Program, ctx: PassContext) -> Program:
             if prev is not None and prev.type == "mul" and \
                     consumers.get(xin, 0) == 1:
                 bias = op.inputs.get("Y", [None])[0]
-                try:
-                    bvar = block.var(bias)
-                    is_bias = bvar.persistable and bvar.shape and \
-                        len([s for s in bvar.shape if s != 1]) <= 1
-                except KeyError:
-                    is_bias = False
-                if is_bias:
+                if _is_projection_bias(block, bias):
                     kept.remove(prev)
                     fc = OpDesc("fc",
                                 {"Input": prev.inputs["X"],
@@ -179,15 +184,10 @@ def multihead_matmul_fuse_pass(program: Program, ctx: PassContext) \
         bias = None
         if p is not None and p.type == "elementwise_add":
             bias = p.inputs["Y"][0]
-            # only a real projection bias (persistable ~1-D, same check
-            # as fc_fuse_pass) — a residual/positional add is NOT one
-            try:
-                bvar = block.var(bias)
-                is_bias = bvar.persistable and bvar.shape and \
-                    len([s for s in bvar.shape if s != 1]) <= 1
-            except KeyError:
-                is_bias = False
-            if not is_bias or not _single(p.inputs["X"][0]):
+            # only a real projection bias — a residual/positional add
+            # is NOT one
+            if not _is_projection_bias(block, bias) or \
+                    not _single(p.inputs["X"][0]):
                 return None
             matched.append(p)
             p = producer.get(p.inputs["X"][0])
@@ -295,9 +295,13 @@ def multihead_matmul_fuse_pass(program: Program, ctx: PassContext) \
                  "op_uid": program._next_uid(),
                  OpRole.KEY: OpRole.Forward})
             ids = set(map(id, matched))
-            pos = min(i for i, op in enumerate(kept) if id(op) in ids)
+            # insert at the LAST matched position: the fused op reads
+            # vars (e.g. the mask) that may be produced between the
+            # earliest matched op and the softmax — inserting early
+            # would resolve BiasQK to None and silently drop the mask
+            pos = max(i for i, op in enumerate(kept) if id(op) in ids)
+            kept.insert(pos + 1, fused)
             kept = [op for op in kept if id(op) not in ids]
-            kept.insert(pos, fused)
             ctx.hit("multihead_matmul_fused")
             fused_any = True
             break

@@ -1,0 +1,305 @@
+"""Numpy checks for the registry-diff mop-up ops (ops/kernels/mop_up.py)
++ the scripted diff itself staying at zero residue."""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import OpContext, run_kernel
+
+
+def _run(op, ins, attrs=None):
+    return run_kernel(op, ins, attrs or {}, OpContext())
+
+
+def test_registry_diff_residue_is_zero():
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "registry_diff.py")],
+        capture_output=True, text=True, cwd=root)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "REAL GAPS:             0" in out.stdout, out.stdout
+
+
+def test_batch_fc_matches_loop():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    w = rng.randn(3, 5, 6).astype(np.float32)
+    b = rng.randn(3, 6).astype(np.float32)
+    out = _run("batch_fc", {"Input": jnp.asarray(x), "W": jnp.asarray(w),
+                            "Bias": jnp.asarray(b)})["Out"]
+    ref = np.stack([x[s] @ w[s] + b[s] for s in range(3)])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_rank_attention_matches_loop():
+    rng = np.random.RandomState(1)
+    ins_num, fea, para_col, max_rank = 4, 3, 2, 2
+    x = rng.randn(ins_num, fea).astype(np.float32)
+    param = rng.randn(max_rank * max_rank * fea,
+                      para_col).astype(np.float32)
+    # rows: rank, (faster_1, index_1), (faster_2, index_2); 1-based
+    ro = np.array([[1, 1, 0, 2, 1],
+                   [2, 1, 2, 0, 0],      # second slot absent
+                   [0, 0, 0, 0, 0],      # invalid instance
+                   [2, 2, 3, 1, 1]], np.int32)
+    outs = _run("rank_attention",
+                {"X": jnp.asarray(x), "RankOffset": jnp.asarray(ro),
+                 "RankParam": jnp.asarray(param)}, {"MaxRank": max_rank})
+    ref = np.zeros((ins_num, para_col), np.float32)
+    p3 = param.reshape(max_rank * max_rank, fea, para_col)
+    for i in range(ins_num):
+        rank = ro[i, 0]
+        if rank <= 0:
+            continue
+        for k in range(max_rank):
+            faster, index = ro[i, 1 + 2 * k], ro[i, 2 + 2 * k]
+            if faster <= 0:
+                continue
+            blk = p3[(rank - 1) * max_rank + (faster - 1)]
+            ref[i] += x[index] @ blk
+    np.testing.assert_allclose(np.asarray(outs["Out"]), ref, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs["InsRank"]).ravel(),
+                               ro[:, 0].astype(np.float32))
+
+
+def test_bilateral_slice_constant_grid_identity():
+    """A grid whose coefficients are an identity affine map must return
+    the input unchanged regardless of the guide."""
+    rng = np.random.RandomState(2)
+    n, ci, h, w = 1, 2, 4, 4
+    co, gd, gh, gw = 2, 3, 2, 2
+    x = rng.rand(n, ci, h, w).astype(np.float32)
+    guide = rng.rand(n, h, w).astype(np.float32)
+    grid = np.zeros((n, co * (ci + 1), gd, gh, gw), np.float32)
+    for c in range(co):                   # out c = in c (identity matrix)
+        grid[:, c * (ci + 1) + c] = 1.0
+    out = _run("bilateral_slice",
+               {"X": jnp.asarray(x), "Grid": jnp.asarray(grid),
+                "Guide": jnp.asarray(guide)}, {"has_offset": True})["Out"]
+    np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5, atol=1e-5)
+
+
+def test_deformable_psroi_pooling_no_trans_matches_average():
+    """With no offsets and a single group, every bin averages its
+    bilinear samples of the (only) channel slice."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    outs = _run("deformable_psroi_pooling",
+                {"Input": jnp.asarray(x), "ROIs": jnp.asarray(rois)},
+                {"no_trans": True, "spatial_scale": 1.0, "output_dim": 2,
+                 "group_size": [1, 1], "pooled_height": 2,
+                 "pooled_width": 2, "part_size": [2, 2],
+                 "sample_per_part": 2, "trans_std": 0.0})
+    out = np.asarray(outs["Out"])
+    assert out.shape == (1, 2, 2, 2)
+    # channel mapping with group 1: output c reads input channel c
+    assert np.all(np.asarray(outs["TopCount"]) > 0)
+    # bins over the whole roi stay within data range (bilinear average)
+    assert out.min() >= x.min() - 1e-5 and out.max() <= x.max() + 1e-5
+    # spot value: bin (0,0) of channel 0 averages 4 samples around the
+    # upper-left quadrant — recompute directly
+    ref = 0.0
+    x1, y1 = -0.5, -0.5
+    bin_w = bin_h = (7.5 - (-0.5)) / 2
+    sub = bin_w / 2
+    cnt = 0
+    for ih in range(2):
+        for iw in range(2):
+            wp, hp = x1 + iw * sub, y1 + ih * sub
+            if wp < -0.5 or wp > 7.5 or hp < -0.5 or hp > 7.5:
+                continue
+            wc, hc = np.clip(wp, 0, 7), np.clip(hp, 0, 7)
+            x1i, y1i = int(np.floor(wc)), int(np.floor(hc))
+            x2i, y2i = min(x1i + 1, 7), min(y1i + 1, 7)
+            dx, dy = wc - x1i, hc - y1i
+            v = (x[0, 0, y1i, x1i] * (1 - dx) * (1 - dy)
+                 + x[0, 0, y1i, x2i] * dx * (1 - dy)
+                 + x[0, 0, y2i, x1i] * (1 - dx) * dy
+                 + x[0, 0, y2i, x2i] * dx * dy)
+            ref += v
+            cnt += 1
+    np.testing.assert_allclose(out[0, 0, 0, 0], ref / cnt, rtol=1e-5)
+
+
+def test_quant_tail_ops():
+    rng = np.random.RandomState(4)
+    q = rng.randint(-127, 128, (3, 4)).astype(np.int8)
+    s = np.float32(2.5)
+    out = _run("dequantize_abs_max",
+               {"X": jnp.asarray(q), "Scale": jnp.asarray([s])},
+               {"max_range": 127.0})["Out"]
+    np.testing.assert_allclose(np.asarray(out),
+                               q.astype(np.float32) * s / 127.0,
+                               rtol=1e-6)
+    d = np.linspace(0.0, 1.0, 128).astype(np.float32)
+    codes = np.array([[3, -5, 0, -128]], np.int8)
+    out = _run("dequantize_log",
+               {"X": jnp.asarray(codes), "Dict": jnp.asarray(d)})["Out"]
+    ref = np.array([[d[3], -d[123], d[0], -d[0]]], np.float32)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+    x = rng.randn(4, 4).astype(np.float32)
+    outs = _run("fake_quantize_range_abs_max",
+                {"X": jnp.asarray(x),
+                 "InScale": jnp.asarray([0.001], np.float32)},
+                {"bit_length": 8})
+    scale = float(np.asarray(outs["OutScale"]).ravel()[0])
+    assert scale == pytest.approx(np.abs(x).max(), rel=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(outs["Out"]),
+        np.clip(np.round(x / scale * 127), -127, 127) * scale / 127,
+        rtol=1e-5)
+
+
+def test_lookup_table_dequant():
+    # rows: [min, max, 4 packed uint8 codes in one float32]
+    emb = 4
+    codes = np.array([7, 130, 255, 0], np.uint8)
+    packed = codes.view(np.float32)[0]
+    row = np.array([[-1.0, 1.0, packed]], np.float32)
+    out = _run("lookup_table_dequant",
+               {"W": jnp.asarray(row),
+                "Ids": jnp.asarray([0], np.int64)},
+               {"quant_bits": 8})["Out"]
+    scale = 2.0 / 256.0
+    ref = scale * codes.astype(np.float32) - 1.0
+    np.testing.assert_allclose(np.asarray(out).ravel()[:emb], ref,
+                               rtol=1e-5)
+
+
+def test_dgc_momentum_switches_at_rampup():
+    p = jnp.ones((4,))
+    g = jnp.full((4,), 0.5)
+    v = jnp.full((4,), 0.2)
+    lr = jnp.asarray([0.1])
+    common = {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr}
+    pre = _run("dgc_momentum",
+               {**common, "current_step": jnp.asarray([1.0])},
+               {"mu": 0.9, "rampup_begin_step": 10.0})
+    v_new = 0.9 * 0.2 + 0.5
+    np.testing.assert_allclose(np.asarray(pre["ParamOut"]),
+                               1.0 - 0.1 * v_new, rtol=1e-6)
+    post = _run("dgc_momentum",
+                {**common, "current_step": jnp.asarray([11.0])},
+                {"mu": 0.9, "rampup_begin_step": 10.0})
+    np.testing.assert_allclose(np.asarray(post["ParamOut"]),
+                               1.0 - 0.1 * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(post["VelocityOut"]), 0.2)
+
+    x = jnp.asarray(np.full((4,), 3.0, np.float32))
+    clip = _run("dgc_clip_by_norm",
+                {"X": x, "current_step": jnp.asarray([5.0])},
+                {"max_norm": 1.0, "rampup_begin_step": 0.0})["Out"]
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(clip)), 1.0,
+                               rtol=1e-5)
+
+
+def test_fill_family():
+    out = _run("fill", {}, {"shape": [2, 2], "dtype": "float32",
+                            "value": [1.0, 2.0, 3.0, 4.0]})["Out"]
+    np.testing.assert_allclose(np.asarray(out), [[1, 2], [3, 4]])
+    z = _run("fill_zeros_like2", {"X": jnp.ones((2, 3))},
+             {"dtype": "int32"})["Out"]
+    assert np.asarray(z).dtype == np.int32 and not np.asarray(z).any()
+    g = _run("gaussian_random_batch_size_like",
+             {"Input": jnp.zeros((5, 2))},
+             {"shape": [-1, 8], "mean": 0.0, "std": 1.0,
+              "op_uid": 7})["Out"]
+    assert np.asarray(g).shape == (5, 8)
+    f = _run("fake_init", {}, {"shape": [3, 2]})["Out"]
+    assert np.asarray(f).shape == (3, 2)
+
+
+def test_tensor_array_to_tensor_and_aliases():
+    from paddle_tpu.ops.kernels.tensor_array import TensorArrayVal
+    buf = jnp.arange(24.0).reshape(3, 2, 4)
+    arr = TensorArrayVal(buf, jnp.asarray(3, jnp.int32))
+    stacked = _run("tensor_array_to_tensor", {"X": arr},
+                   {"use_stack": True, "axis": 0})["Out"]
+    np.testing.assert_allclose(np.asarray(stacked), np.asarray(buf))
+    cat = _run("tensor_array_to_tensor", {"X": arr},
+               {"use_stack": False, "axis": 0})["Out"]
+    assert np.asarray(cat).shape == (6, 4)
+    from paddle_tpu.ops.registry import get_op_info
+    for alias in ("conditional_block_infer", "merge_lod_tensor_infer",
+                  "multiclass_nms2", "recurrent", "run_program",
+                  "delete_var", "get_places", "send_barrier", "recv_save",
+                  "send_and_recv", "pull_sparse", "pull_sparse_v2",
+                  "push_sparse", "push_sparse_v2", "push_dense"):
+        assert get_op_info(alias) is not None, alias
+
+
+def test_split_selected_rows_and_merge_ids():
+    from paddle_tpu.core.selected_rows import SelectedRows
+    sr = SelectedRows(jnp.asarray([1, 5, 3], jnp.int32),
+                      jnp.asarray([[1.0], [5.0], [3.0]]), 8)
+    outs = _run("split_selected_rows", {"X": sr},
+                {"height_sections": [4, 4]})["Out"]
+    d0 = np.asarray(outs[0].to_dense()).ravel()
+    d1 = np.asarray(outs[1].to_dense()).ravel()
+    np.testing.assert_allclose(d0, [0, 1, 0, 3])
+    np.testing.assert_allclose(d1, [0, 5, 0, 0])
+
+    merged = _run(
+        "merge_ids",
+        {"Ids": [jnp.asarray([3, 1, 5, 1], jnp.int64)],
+         "Rows": [jnp.asarray([1, 3], jnp.int64),
+                  jnp.asarray([5], jnp.int64)],
+         "X": [jnp.asarray([[10.0], [30.0]]),
+               jnp.asarray([[50.0]])]})["Out"][0]
+    np.testing.assert_allclose(np.asarray(merged).ravel(),
+                               [30, 10, 50, 10])
+
+
+def test_box_sparse_pull_push():
+    """BoxPS redesign: the 'device-resident PS' is a dense HBM table."""
+    w = jnp.arange(12.0).reshape(6, 2)
+    ids = jnp.asarray([[1, 4]], jnp.int64)
+    (out,) = _run("pull_box_sparse", {"Ids": [ids], "W": w})["Out"]
+    np.testing.assert_allclose(np.asarray(out),
+                               [[[2, 3], [8, 9]]])
+    g = jnp.ones((1, 2, 2))
+    new_w = _run("push_box_sparse",
+                 {"Ids": [ids], "Grads": [g], "W": w},
+                 {"lr": 0.5})["Out"]
+    ref = np.arange(12.0).reshape(6, 2)
+    ref[1] -= 0.5
+    ref[4] -= 0.5
+    np.testing.assert_allclose(np.asarray(new_w), ref)
+
+
+def test_send_and_recv_round_trip_over_kv_queues():
+    import threading
+
+    from paddle_tpu.distributed.ps.kv_server import KVClient, KVServer
+    srv = KVServer("127.0.0.1:0")
+    srv.serve_in_thread()
+    try:
+        # a fake peer section: pops the sent tensor, replies doubled
+        def peer():
+            c = KVClient([srv.endpoint], rpc_deadline=20.0)
+            c.wait_server_ready()
+            a = c.q_pop("heter/xin", timeout=30.0)
+            c.q_push("heter/yout", a * 2.0)
+            c.close()
+
+        t = threading.Thread(target=peer)
+        t.start()
+        outs = _run("send_and_recv",
+                    {"X": [jnp.asarray([[1.0, 2.0]])]},
+                    {"send_var_name": ["xin"],
+                     "recv_var_name": ["yout"],
+                     "endpoints": [srv.endpoint],
+                     "shapes": [[1, 2]], "dtypes": ["float32"],
+                     "timeout": 30.0})["Out"]
+        t.join(timeout=30)
+        np.testing.assert_allclose(np.asarray(outs[0]), [[2.0, 4.0]])
+    finally:
+        srv.stop()

@@ -81,6 +81,46 @@ def test_multihead_fuse_collapses_ops_and_matches():
         np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
 
 
+def test_multihead_fuse_mask_produced_after_projections():
+    """The mask tensor computed AFTER the projection ops (valid
+    topological order) must still reach the fused op — the fused op is
+    inserted at the LAST matched position, not the first."""
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    rng = np.random.RandomState(4)
+    xv = rng.randn(B, L, D).astype(np.float32)
+    raw = (rng.rand(B, 1, L, L) > 0.5).astype(np.float32)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, L, D])
+        raw_mask = layers.data("raw_mask", [B, 1, L, L])
+
+        def proj():
+            return layers.fc(x, D, num_flatten_dims=2)
+
+        def heads(t):
+            return layers.transpose(
+                layers.reshape(t, [0, 0, H, D // H]), [0, 2, 1, 3])
+
+        q, k, v = heads(proj()), heads(proj()), heads(proj())
+        mask = layers.scale(raw_mask, scale=-1e4)   # produced HERE
+        scores = layers.elementwise_add(
+            layers.matmul(q, k, transpose_y=True,
+                          alpha=1.0 / np.sqrt(D // H)), mask)
+        ctx_t = layers.matmul(layers.softmax(scores), v)
+        ctx_t = layers.transpose(ctx_t, [0, 2, 1, 3])
+        out = layers.reshape(ctx_t, [0, 0, D])
+
+    feed = {"x": xv, "raw_mask": raw}
+    scope = static.Scope()
+    ref = _run(main, startup, feed, out, scope)
+    fused = get_pass("multihead_matmul_fuse_pass")(main, PassContext())
+    types = [op.type for op in fused.global_block().ops]
+    assert "multihead_matmul" in types, types
+    got = _run(fused, startup, feed, out, scope)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
 def test_multihead_fuse_leaves_cross_attention_alone():
     """Projections reading different inputs (cross-attention between two
     sources) must not be fused by the self-attention pattern."""
