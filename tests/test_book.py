@@ -235,3 +235,42 @@ def test_label_semantic_roles_crf():
     assert losses[-1] < losses[0] * 0.9, losses
     # decode returns a tag path with the right shape
     assert extras[-1][0].shape[0] == 8
+
+
+def test_image_classification_conv_static():
+    """book/test_image_classification.py analog: conv net on
+    CIFAR-shaped [3, 32, 32] images through the STATIC graph path
+    (conv -> batch_norm -> relu -> pool stack + fc head); memorizes a
+    fixed separable batch."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = layers.data("img", [-1, 3, 32, 32])
+        label = layers.data("label", [-1, 1], dtype="int64")
+        h = img
+        for nf in (8, 16):
+            h = layers.conv2d(h, num_filters=nf, filter_size=3,
+                              padding=1)
+            h = layers.batch_norm(h, act="relu")
+            h = layers.pool2d(h, pool_size=2, pool_stride=2,
+                              pool_type="max")
+        h = layers.reshape(h, [-1, 16 * 8 * 8])
+        h = layers.fc(h, 32, act="relu")
+        pred = layers.fc(h, 10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        static.Adam(learning_rate=2e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    B = 16
+    # separable synthetic "cifar": class k brightens channel k%3 in a
+    # class-specific quadrant
+    imgs = rng.rand(B, 3, 32, 32).astype(np.float32)
+    ys = rng.randint(0, 10, (B, 1)).astype(np.int64)
+    for i in range(B):
+        k = int(ys[i, 0])
+        imgs[i, k % 3, (k // 3) * 8:(k // 3) * 8 + 8] += 2.0
+
+    losses, extras, _ = _train(main, startup, lambda i: {
+        "img": imgs, "label": ys}, loss, iters=40, fetch_extra=(acc,))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+    assert float(np.asarray(extras[-1][0]).ravel()[0]) > 0.8, extras[-1]

@@ -1,0 +1,65 @@
+"""Medium-shape data-parallel dryrun (VERDICT r4 weak #5).
+
+The driver's dryrun_multichip runs toy shapes (seq 32/16) — enough for
+wiring, not for sharding-induced numerics drift.  This runs config 1
+(pure dp over the 8-device virtual mesh) at seq 512 / hidden 128 and
+checks the sharded loss MATCHES the single-device loss on identical
+params + batch, so a sharding bug that only shows at realistic dims
+fails here instead of on hardware.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+
+
+def _model(seq, hidden, vocab):
+    import __graft_entry__ as ge
+    return ge._tiny_model(seq=seq, hidden=hidden, heads=4, vocab=vocab,
+                          layers_n=2)
+
+
+@pytest.mark.slow
+def test_seq512_dp_matches_single_device():
+    import jax
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+
+    seq, hidden, vocab = 512, 128, 256
+    n_dev = len(jax.devices())
+    assert n_dev >= 8, "conftest forces an 8-device CPU mesh"
+    rng = np.random.RandomState(0)
+    batch = n_dev  # one row per device
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int64)
+    labels = rng.randint(0, vocab, (batch, seq, 1)).astype(np.int64)
+
+    def run(data_parallel):
+        main, startup, loss = _model(seq, hidden, vocab)
+        # identical init both runs: init randomness is drawn from the
+        # STARTUP program's seed (Executor._seed_for_step reads the seed
+        # of the program being run)
+        startup.random_seed = 7
+        main.random_seed = 7
+        exe = static.Executor()
+        scope = static.Scope()
+        losses = []
+        with static.scope_guard(scope):
+            exe.run(startup)
+            prog = main
+            if data_parallel:
+                prog = CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name, places=jax.devices()[:n_dev])
+            for _ in range(2):
+                (lv,) = exe.run(prog,
+                                feed={"ids": ids, "labels": labels},
+                                fetch_list=[loss])
+                losses.append(float(np.asarray(lv)))
+        return losses
+
+    single = run(False)
+    sharded = run(True)
+    assert all(np.isfinite(single)) and all(np.isfinite(sharded))
+    # same params, same global batch -> same loss trace (grad allreduce
+    # mean == full-batch grad); tolerance covers reduction order
+    np.testing.assert_allclose(sharded, single, rtol=5e-4, atol=1e-5)
+    # and training moved the loss
+    assert sharded[1] < sharded[0]
