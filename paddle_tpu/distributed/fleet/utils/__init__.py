@@ -1,1 +1,3 @@
 from .fs import LocalFS, HDFSClient, FS  # noqa: F401
+from .fleet_wrapper import (  # noqa: F401
+    BoxWrapper, FleetWrapper, HeterWrapper)

@@ -1,0 +1,177 @@
+"""FleetWrapper / BoxWrapper / HeterWrapper — the industrial-PS client
+classes (C24).
+
+Reference:
+  /root/reference/paddle/fluid/framework/fleet/fleet_wrapper.h:66 —
+    pslib client: PullSparseVarsSync / PushSparseVarsAsync /
+    PushDenseVarsAsync against pslib tables;
+  /root/reference/paddle/fluid/framework/fleet/box_wrapper.h:333 —
+    BoxPS: embeddings resident in device memory, PullSparse/PushSparse
+    without a remote hop;
+  /root/reference/paddle/fluid/framework/fleet/heter_wrapper.h:54 —
+    HeterWrapper: CPU trainer <-> device worker activation shipping.
+
+TPU redesign: all three wrap capabilities this framework already has —
+the KV tier (distributed/ps/kv_server.py) is the pslib runtime, a dense
+HBM table parameter is the BoxPS "device-resident PS" (shardable across
+chips by the TP machinery instead of a bespoke allocator), and the KV
+named queues are the heter RPC.  These classes exist so industrial-CTR
+code written against the reference wrapper API has a same-shape home.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["FleetWrapper", "BoxWrapper", "HeterWrapper"]
+
+
+class FleetWrapper:
+    """fleet_wrapper.h:66 analog over the KV-server tier.
+
+        fw = FleetWrapper()
+        fw.init_worker(endpoints, trainer_id)
+        fw.init_table("emb", np.zeros((V, D)), optimizer="adam")
+        vals = fw.pull_sparse_vars_sync("emb", keys)          # [n, D]
+        fw.push_sparse_vars_async("emb", keys, grads, lr)
+        fw.push_dense_vars_async(["w0"], [g0], lr)
+    """
+
+    def __init__(self):
+        self._client = None
+        self.scale_sparse_gradient_with_batch_size = True
+        self._request_timeout_ms = 500000
+        self._connect_timeout_ms = 10000
+        self._max_retry = 3
+
+    def set_client2client_config(self, request_timeout_ms,
+                                 connect_timeout_ms, max_retry):
+        self._request_timeout_ms = request_timeout_ms
+        self._connect_timeout_ms = connect_timeout_ms
+        self._max_retry = max_retry
+
+    def init_worker(self, endpoints: Sequence[str], trainer_id: int = 0):
+        from ...ps.kv_server import KVClient
+        self._client = KVClient(
+            list(endpoints),
+            rpc_deadline=self._request_timeout_ms / 1000.0,
+            max_retries=self._max_retry)
+        self._client.wait_server_ready(
+            timeout=self._connect_timeout_ms / 1000.0 * 6)
+        self._client.start_heartbeat(trainer_id)
+        return self._client
+
+    def _require_worker(self):
+        if self._client is None:
+            raise RuntimeError("FleetWrapper: call init_worker() first")
+        return self._client
+
+    def init_table(self, table_name: str, value, optimizer: str = "sgd",
+                   **opt_kwargs):
+        """Create the row-sharded table + install its server-resident
+        optimizer (lookup_sparse_table_fuse_* analog)."""
+        c = self._require_worker()
+        c.init_sparse_table(table_name, np.asarray(value))
+        c.config_sparse_optimizer(table_name, optimizer=optimizer,
+                                  **opt_kwargs)
+
+    def pull_sparse_vars_sync(self, table_name: str, fea_keys):
+        """PullSparseVarsSync — gather rows for fea_keys."""
+        return self._require_worker().pull_sparse(
+            table_name, np.asarray(fea_keys).reshape(-1))
+
+    def push_sparse_vars_async(self, table_name: str, fea_keys, grads,
+                               lr: float, batch_size: Optional[int] = None,
+                               sync: bool = False):
+        """PushSparseVarsAsync (+ the WithLabel batch-size scaling knob:
+        scale_sparse_gradient_with_batch_size divides by the batch)."""
+        grads = np.asarray(grads)
+        if self.scale_sparse_gradient_with_batch_size and batch_size:
+            # pre-scale the values: the sync fanin path deliberately
+            # ignores client grad_scale (server-side averaging), so
+            # batch scaling must ride in the grads themselves
+            grads = grads / float(batch_size)
+        self._require_worker().push_sparse(
+            table_name, np.asarray(fea_keys).reshape(-1), grads, lr,
+            sync=sync)
+
+    def push_dense_vars_async(self, var_names: Sequence[str], grads,
+                              lr: float):
+        c = self._require_worker()
+        for n, g in zip(var_names, grads, strict=True):
+            c.push_grad(n, np.asarray(g), lr, sync=False)
+
+    def pull_dense_vars(self, var_names: Sequence[str]):
+        c = self._require_worker()
+        return [c.pull(n) for n in var_names]
+
+    def barrier(self):
+        self._require_worker().barrier()
+
+    def stop_worker(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+class BoxWrapper:
+    """box_wrapper.h:333 analog.  BoxPS kept the embedding resident in
+    GPU memory with a custom allocator; on TPU the honest equivalent is
+    a dense HBM table array — pull is a gather, push a fused scatter-add,
+    and multi-chip scale comes from sharding the table along its vocab
+    axis with the ordinary TP machinery (dist_attr), not a separate PS
+    runtime.  Wraps the pull_box_sparse / push_box_sparse kernels so the
+    graph-op path and this imperative path share one implementation."""
+
+    def __init__(self):
+        self._tables: Dict[str, object] = {}
+
+    def create_table(self, name: str, value):
+        import jax.numpy as jnp
+        self._tables[name] = jnp.asarray(value)
+        return self._tables[name]
+
+    def pull_sparse(self, name: str, keys) -> "np.ndarray":
+        from ....ops.registry import OpContext, run_kernel
+        import jax.numpy as jnp
+        w = self._tables[name]
+        (out,) = run_kernel("pull_box_sparse",
+                            {"Ids": [jnp.asarray(keys)], "W": w},
+                            {}, OpContext())["Out"]
+        return out
+
+    def push_sparse(self, name: str, keys, grads, lr: float = 1.0):
+        from ....ops.registry import OpContext, run_kernel
+        import jax.numpy as jnp
+        self._tables[name] = run_kernel(
+            "push_box_sparse",
+            {"Ids": [jnp.asarray(keys)], "Grads": [jnp.asarray(grads)],
+             "W": self._tables[name]},
+            {"lr": lr}, OpContext())["Out"]
+        return self._tables[name]
+
+
+class HeterWrapper:
+    """heter_wrapper.h:54 analog: the activation/gradient relay between
+    a CPU section worker and the device section worker, over the KV
+    named queues (the graph-op form is heter_send/heter_recv; this is
+    the imperative client the trainer loops use)."""
+
+    def __init__(self, endpoints: Sequence[str], channel: str = "heter",
+                 timeout: float = 60.0):
+        from ...ps.kv_server import KVClient
+        self._client = KVClient(list(endpoints))
+        self._client.wait_server_ready()
+        self.channel = channel
+        self.timeout = timeout
+
+    def send(self, name: str, value):
+        self._client.q_push(f"{self.channel}/{name}", np.asarray(value))
+
+    def recv(self, name: str) -> "np.ndarray":
+        return self._client.q_pop(f"{self.channel}/{name}",
+                                  timeout=self.timeout)
+
+    def close(self):
+        self._client.close()
