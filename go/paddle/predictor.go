@@ -1,0 +1,127 @@
+// Package paddle — Go inference client (C28).
+//
+// Reference: /root/reference/go/paddle/predictor.go wraps the C
+// predictor API via cgo, which requires linking the C++ runtime into
+// the Go process.  TPU redesign: inference executes on the serving
+// host's chips behind paddle_tpu/inference/server.py; this client
+// speaks its 4-route JSON/HTTP protocol, keeping the reference's
+// Predictor API shape (NewPredictor / GetInputNames / SetInput / Run /
+// GetOutput) without any FFI.
+package paddle
+
+import (
+	"bytes"
+	"encoding/json"
+	"fmt"
+	"io"
+	"net/http"
+	"time"
+)
+
+// AnalysisConfig mirrors the reference config object; only the fields
+// meaningful for a remote predictor survive.
+type AnalysisConfig struct {
+	Endpoint string        // e.g. "http://10.0.0.2:8866"
+	Timeout  time.Duration // per-request budget
+}
+
+func NewAnalysisConfig(endpoint string) *AnalysisConfig {
+	return &AnalysisConfig{Endpoint: endpoint, Timeout: 60 * time.Second}
+}
+
+// Tensor is the wire form of one named input/output.
+type Tensor struct {
+	Data  []float32 `json:"data"`
+	Shape []int     `json:"shape"`
+	Dtype string    `json:"dtype"`
+}
+
+type Predictor struct {
+	config  *AnalysisConfig
+	client  *http.Client
+	inputs  []string
+	outputs []string
+	feeds   map[string]Tensor
+	fetched map[string]Tensor
+}
+
+type metadata struct {
+	Inputs  []string `json:"inputs"`
+	Outputs []string `json:"outputs"`
+}
+
+// NewPredictor connects and caches the model's input/output names.
+func NewPredictor(config *AnalysisConfig) (*Predictor, error) {
+	p := &Predictor{
+		config:  config,
+		client:  &http.Client{Timeout: config.Timeout},
+		feeds:   map[string]Tensor{},
+		fetched: map[string]Tensor{},
+	}
+	resp, err := p.client.Get(config.Endpoint + "/metadata")
+	if err != nil {
+		return nil, err
+	}
+	defer resp.Body.Close()
+	if resp.StatusCode != http.StatusOK {
+		raw, _ := io.ReadAll(resp.Body)
+		return nil, fmt.Errorf("metadata failed (%d): %s",
+			resp.StatusCode, raw)
+	}
+	var md metadata
+	if err := json.NewDecoder(resp.Body).Decode(&md); err != nil {
+		return nil, err
+	}
+	p.inputs, p.outputs = md.Inputs, md.Outputs
+	return p, nil
+}
+
+func (p *Predictor) GetInputNum() int        { return len(p.inputs) }
+func (p *Predictor) GetOutputNum() int       { return len(p.outputs) }
+func (p *Predictor) GetInputNames() []string { return p.inputs }
+func (p *Predictor) GetOutputNames() []string { return p.outputs }
+func (p *Predictor) GetInputName(n int) string  { return p.inputs[n] }
+func (p *Predictor) GetOutputName(n int) string { return p.outputs[n] }
+
+// SetInput stages one named input (ZeroCopyTensor.SetValue analog).
+func (p *Predictor) SetInput(name string, data []float32, shape []int) {
+	p.feeds[name] = Tensor{Data: data, Shape: shape, Dtype: "float32"}
+}
+
+// Run posts the staged inputs and caches the outputs (ZeroCopyRun).
+func (p *Predictor) Run() error {
+	body, err := json.Marshal(map[string]interface{}{"inputs": p.feeds})
+	if err != nil {
+		return err
+	}
+	resp, err := p.client.Post(p.config.Endpoint+"/predict",
+		"application/json", bytes.NewReader(body))
+	if err != nil {
+		return err
+	}
+	defer resp.Body.Close()
+	raw, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return err
+	}
+	if resp.StatusCode != http.StatusOK {
+		return fmt.Errorf("predict failed (%d): %s", resp.StatusCode, raw)
+	}
+	var reply struct {
+		Outputs map[string]Tensor `json:"outputs"`
+	}
+	if err := json.Unmarshal(raw, &reply); err != nil {
+		return err
+	}
+	p.fetched = reply.Outputs
+	return nil
+}
+
+// GetOutput returns a named output tensor after Run.
+func (p *Predictor) GetOutput(name string) (Tensor, error) {
+	t, ok := p.fetched[name]
+	if !ok {
+		return Tensor{}, fmt.Errorf("no output %q (did Run succeed?)", name)
+	}
+	return t, nil
+}

@@ -1,0 +1,121 @@
+"""HTTP inference server — the remote-client serving surface (C28).
+
+Reference: /root/reference/go/paddle/predictor.go + r/ wrap the C
+predictor API in-process, which only works where the C++ runtime can be
+linked.  TPU redesign: inference runs where the chips are, so non-Python
+clients (Go/R/anything) talk to the predictor over a 4-route JSON/HTTP
+protocol instead of FFI:
+
+    GET  /metadata           -> {"inputs": [name...], "outputs": [...]}
+    POST /predict            <- {"inputs": {name: nested-list|
+                                            {"data": [...], "shape": [...],
+                                             "dtype": "float32"}}}
+                             -> {"outputs": {name: {"data": flat list,
+                                             "shape": [...],
+                                             "dtype": "..."}}}
+    GET  /health             -> {"status": "ok"}
+
+`go/paddle/predictor.go` and `r/paddle.R` in the repo root are the
+reference-shaped clients for this protocol.  Threaded accept loop, ONE
+shared predictor under a lock for execution: the device serializes
+compute anyway and the shared executor's jit cache makes repeat
+requests instant (per-connection clones would recompile every time).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+__all__ = ["InferenceServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv: "InferenceServer" = self.server.inference  # type: ignore
+        if self.path == "/health":
+            self._reply(200, {"status": "ok"})
+        elif self.path == "/metadata":
+            p = srv._base
+            self._reply(200, {"inputs": p.get_input_names(),
+                              "outputs": p.get_output_names()})
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        srv: "InferenceServer" = self.server.inference  # type: ignore
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n))
+            feeds = []
+            for name in srv._base.get_input_names():
+                v = req["inputs"][name]
+                if isinstance(v, dict):
+                    arr = np.asarray(v["data"],
+                                     dtype=np.dtype(v.get("dtype",
+                                                          "float32")))
+                    arr = arr.reshape(v["shape"])
+                else:
+                    arr = np.asarray(v)
+                feeds.append(arr)
+            # one shared predictor under a lock: ThreadingHTTPServer
+            # spawns a thread PER CONNECTION, so per-thread clones would
+            # recompile on every request; the device serializes execution
+            # anyway, and the shared executor's jit cache makes repeat
+            # requests instant
+            with srv._run_lock:
+                outs = srv._base.run(feeds)
+            payload = {"outputs": {
+                name: {"data": np.asarray(o).ravel().tolist(),
+                       "shape": list(np.asarray(o).shape),
+                       "dtype": str(np.asarray(o).dtype)}
+                for name, o in zip(srv._base.get_output_names(), outs)}}
+            self._reply(200, payload)
+        except Exception as e:  # surface the real error to the client
+            self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+
+class InferenceServer:
+    """serve a saved inference model over HTTP.
+
+        srv = InferenceServer(model_dir, port=0)
+        srv.start()          # background thread; srv.port is bound
+        ...
+        srv.stop()
+    """
+
+    def __init__(self, model_dir: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        from . import Config, create_predictor
+        self._base = create_predictor(Config(model_dir))
+        self._run_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.inference = self  # type: ignore
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             kwargs={"poll_interval": 0.1}, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,62 @@
+# R inference client (C28).
+#
+# Reference: /root/reference/r/ wraps the C predictor API; TPU redesign:
+# inference runs behind paddle_tpu/inference/server.py and this client
+# speaks its JSON/HTTP protocol with base R only (no Rcpp/FFI).
+#
+#   p <- paddle_predictor("http://127.0.0.1:8866")
+#   p$set_input("x", array(runif(32), dim = c(4, 8)))
+#   p$run()
+#   out <- p$get_output("fc_0.tmp_1")   # list(data=..., shape=...)
+
+paddle_predictor <- function(endpoint, timeout = 60) {
+  if (!requireNamespace("jsonlite", quietly = TRUE))
+    stop("paddle_predictor needs the jsonlite package")
+
+  meta <- jsonlite::fromJSON(url(paste0(endpoint, "/metadata")))
+  feeds <- list()
+  fetched <- NULL
+
+  set_input <- function(name, value) {
+    # the wire protocol is C-order (row-major): transpose R's
+    # column-major layout before flattening, keep dims unreversed
+    if (is.null(dim(value))) {
+      data <- as.numeric(value)
+      shape <- length(value)
+    } else {
+      data <- as.numeric(aperm(value, rev(seq_along(dim(value)))))
+      shape <- dim(value)
+    }
+    feeds[[name]] <<- list(
+      data = data,
+      shape = shape,
+      dtype = jsonlite::unbox("float32"))  # scalar string on the wire
+    invisible(NULL)
+  }
+
+  run <- function() {
+    body <- jsonlite::toJSON(list(inputs = feeds), auto_unbox = FALSE)
+    if (requireNamespace("curl", quietly = TRUE)) {
+      h <- curl::new_handle(postfields = body, timeout = timeout)
+      curl::handle_setheaders(h, "Content-Type" = "application/json")
+      resp <- curl::curl_fetch_memory(paste0(endpoint, "/predict"), h)
+      if (resp$status_code != 200)
+        stop(sprintf("predict failed (%d): %s", resp$status_code,
+                     rawToChar(resp$content)))
+      fetched <<- jsonlite::fromJSON(rawToChar(resp$content))$outputs
+    } else {
+      stop("paddle_predictor$run needs the curl package")
+    }
+    invisible(NULL)
+  }
+
+  get_output <- function(name) {
+    if (is.null(fetched)) stop("call run() first")
+    out <- fetched[[name]]
+    if (is.null(out)) stop(sprintf("no output '%s'", name))
+    out
+  }
+
+  list(input_names = meta$inputs, output_names = meta$outputs,
+       set_input = set_input, run = run, get_output = get_output)
+}

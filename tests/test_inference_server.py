@@ -1,0 +1,79 @@
+"""HTTP inference server + remote-client protocol (C28).
+
+The Go (go/paddle/predictor.go) and R (r/paddle.R) clients speak this
+protocol; Python's stdlib client exercises it end-to-end here, byte-for
+-byte the same routes/payloads the Go client sends."""
+import json
+import urllib.request
+
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _save_model(tmp_path):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        out = layers.fc(x, 3, act="softmax",
+                        param_attr=static.ParamAttr(name="srv_w"),
+                        bias_attr=static.ParamAttr(name="srv_b"))
+    exe = static.Executor()
+    scope = static.Scope()
+    xb = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        from paddle_tpu.io.framework_io import save_inference_model
+        save_inference_model(str(tmp_path), ["x"], [out], exe, main)
+        (ref,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    return xb, np.asarray(ref), out.name
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_server_metadata_predict_and_error(tmp_path):
+    from paddle_tpu.inference.server import InferenceServer
+    xb, ref, out_name = _save_model(tmp_path)
+    srv = InferenceServer(str(tmp_path))
+    srv.start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        with urllib.request.urlopen(base + "/health", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(base + "/metadata", timeout=10) as r:
+            md = json.loads(r.read())
+        assert md["inputs"] == ["x"]
+        assert md["outputs"] == [out_name]
+
+        # nested-list form
+        reply = _post(base + "/predict", {"inputs": {"x": xb.tolist()}})
+        got = np.asarray(reply["outputs"][out_name]["data"]).reshape(
+            reply["outputs"][out_name]["shape"])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+        # explicit tensor form (what the Go client sends)
+        reply2 = _post(base + "/predict", {"inputs": {"x": {
+            "data": xb.ravel().tolist(), "shape": list(xb.shape),
+            "dtype": "float32"}}})
+        got2 = np.asarray(reply2["outputs"][out_name]["data"]).reshape(
+            reply2["outputs"][out_name]["shape"])
+        np.testing.assert_allclose(got2, ref, rtol=1e-4, atol=1e-6)
+
+        # malformed request -> structured 400, server stays alive
+        try:
+            _post(base + "/predict", {"inputs": {}})
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "error" in json.loads(e.read())
+        reply3 = _post(base + "/predict", {"inputs": {"x": xb.tolist()}})
+        assert reply3["outputs"][out_name]["shape"] == list(ref.shape)
+    finally:
+        srv.stop()
