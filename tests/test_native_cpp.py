@@ -1,0 +1,28 @@
+"""C27 — C++ test tier: build + run the native assert runner
+(paddle_tpu/native/src/native_test.cc), exercising blocking_queue.cc and
+tensor_io.cc through their C ABI from C++, below the Python bindings."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "native", "src")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_cpp_suite(tmp_path):
+    exe = str(tmp_path / "native_test")
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         os.path.join(_SRC, "native_test.cc"),
+         os.path.join(_SRC, "blocking_queue.cc"),
+         os.path.join(_SRC, "tensor_io.cc"),
+         "-pthread", "-o", exe],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([exe, str(tmp_path / "nt.bin")],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "ALL NATIVE TESTS PASSED" in run.stdout
