@@ -310,6 +310,93 @@ def multihead_matmul_fuse_pass(program: Program, ctx: PassContext) \
     return program
 
 
+@register_pass("embedding_eltwise_layernorm_fuse_pass")
+def embedding_eltwise_layernorm_fuse_pass(program: Program,
+                                          ctx: PassContext) -> Program:
+    """ir/embedding_eltwise_layernorm_fuse_pass.cc analog: collapse
+    BERT's input block — N embedding lookups summed by elementwise_add
+    then layer_norm — into ONE fused_embedding_eltwise_layernorm op
+    (one HBM pass over the [B, L, D] activations)."""
+    block = program.global_block()
+    producer: Dict[str, OpDesc] = {}
+    consumers: Dict[str, int] = {}
+    for op in block.ops:
+        for n in op.input_names():
+            consumers[n] = consumers.get(n, 0) + 1
+        for n in op.output_names():
+            producer[n] = op
+
+    lookup_types = ("lookup_table", "lookup_table_v2", "embedding")
+
+    def _collect_lookups(name, matched):
+        """Resolve `name` into a list of (ids, emb) lookup leaves through
+        single-consumer elementwise_add chains; None if any leaf is not
+        a lookup."""
+        p = producer.get(name)
+        if p is None or consumers.get(name, 0) != 1:
+            return None
+        if p.type in lookup_types:
+            matched.append(p)
+            pad = p.attrs.get("padding_idx", -1)
+            return [(p.inputs["Ids"][0], p.inputs["W"][0], p.type,
+                     -1 if pad is None else int(pad))]
+        if p.type == "elementwise_add":
+            left = _collect_lookups(p.inputs["X"][0], matched)
+            right = _collect_lookups(p.inputs["Y"][0], matched)
+            if left is None or right is None:
+                return None
+            matched.append(p)
+            return left + right
+        return None
+
+    kept = list(block.ops)
+    for ln in list(kept):
+        if ln.type != "layer_norm":
+            continue
+        x = ln.inputs["X"][0]
+        bna = int(ln.attrs.get("begin_norm_axis", 1))
+        try:
+            xv = block.var(x)
+        except KeyError:
+            continue
+        # normalize over the LAST axis only (the fused kernel's contract)
+        if xv.shape is None or bna != len(xv.shape) - 1:
+            continue
+        # the fused op emits only Out: a consumed Mean/Variance output
+        # keeps the float pattern
+        if any(consumers.get(ln.outputs.get(s, [None])[0] or "", 0)
+               for s in ("Mean", "Variance")):
+            continue
+        matched: List[OpDesc] = []
+        leaves = _collect_lookups(x, matched)
+        if leaves is None or len(leaves) < 2:
+            continue
+        ins = {"Ids": [i for i, _, _, _ in leaves],
+               "Embs": [w for _, w, _, _ in leaves]}
+        if ln.inputs.get("Scale"):
+            ins["Scale"] = ln.inputs["Scale"]
+        if ln.inputs.get("Bias"):
+            ins["Bias"] = ln.inputs["Bias"]
+        fused = OpDesc(
+            "fused_embedding_eltwise_layernorm", ins,
+            {"Out": ln.outputs["Y"]},
+            {"epsilon": float(ln.attrs.get("epsilon", 1e-5)),
+             # per-leaf semantics the kernel must reproduce exactly
+             "leaf_types": [t for _, _, t, _ in leaves],
+             "padding_idxs": [pi for _, _, _, pi in leaves],
+             "op_uid": program._next_uid(),
+             OpRole.KEY: OpRole.Forward})
+        matched.append(ln)
+        ids = set(map(id, matched))
+        pos = max(i for i, op in enumerate(kept) if id(op) in ids)
+        kept.insert(pos + 1, fused)
+        kept = [op for op in kept if id(op) not in ids]
+        ctx.hit("embedding_eltwise_layernorm_fused")
+    block.ops = kept
+    program._fingerprint_cache = None
+    return program
+
+
 @register_pass("quant_int8_pass")
 def quant_int8_pass(program: Program, ctx: PassContext) -> Program:
     """INT8 execution rewrite (the role of the reference's
@@ -495,6 +582,7 @@ def prune_pass(program: Program, ctx: PassContext) -> Program:
 DEFAULT_INFERENCE_PASSES = [
     "is_test_pass",
     "simplify_with_basic_ops_pass",
+    "embedding_eltwise_layernorm_fuse_pass",
     "multihead_matmul_fuse_pass",
     "fc_fuse_pass",
     # after fc_fuse so frozen fake_dequantize→fc chains are seen fused;

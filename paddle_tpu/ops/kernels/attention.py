@@ -109,3 +109,43 @@ def multihead_matmul_op(ins, attrs, ctx):
                 bias_qk = bias_qk[:, None]
         out = reference_attention(q, k, v, bias=bias_qk, scale=scale)
     return {"Out": jnp.transpose(out, (0, 2, 1, 3)).reshape(b, l, d)}
+
+
+@register_op("fused_embedding_eltwise_layernorm",
+             inputs=["Ids*!", "Embs*", "Scale?", "Bias?"],
+             outputs=["Out"], grad=None)
+def fused_embedding_eltwise_layernorm_op(ins, attrs, ctx):
+    """operators/fused/fused_embedding_eltwise_layernorm_op.cc — BERT's
+    input block as one op: sum of N embedding lookups, then layer_norm.
+    Lowering: gathers + one fused normalization; XLA fuses the adds into
+    the gather consumers, so this is one HBM pass over the [B, L, D]
+    activations instead of N+2.
+
+    Per-leaf semantics ride in attrs (captured by the fuse pass):
+    leaf_types (lookup_table squeezes a trailing 1-dim, v2/embedding do
+    not) and padding_idxs (padded rows read as zero) — the lookups run
+    through the SAME _embedding helper the standalone kernels use."""
+    import jax
+    import jax.numpy as jnp
+
+    from .nn import _embedding
+    embs = ins["Embs"]
+    leaf_types = list(attrs.get("leaf_types",
+                                ["lookup_table_v2"] * len(embs)))
+    pads = list(attrs.get("padding_idxs", [-1] * len(embs)))
+    total = None
+    for i, (ids, emb) in enumerate(zip(ins["Ids"], embs)):
+        if leaf_types[i] == "lookup_table" and ids.shape[-1] == 1:
+            ids = jnp.squeeze(ids, -1)
+        g = _embedding(emb, ids, {"padding_idx": pads[i]})
+        total = g if total is None else total + g
+    eps = float(attrs.get("epsilon", 1e-5))
+    xf = total.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    if ins.get("Scale") is not None:
+        y = y * ins["Scale"].astype(jnp.float32)
+    if ins.get("Bias") is not None:
+        y = y + ins["Bias"].astype(jnp.float32)
+    return {"Out": y.astype(total.dtype)}

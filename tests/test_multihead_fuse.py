@@ -146,6 +146,97 @@ def test_multihead_fuse_leaves_cross_attention_alone():
     assert [op.type for op in prog.global_block().ops] == before
 
 
+def test_embedding_eltwise_layernorm_fuse():
+    """BERT input block: 3 lookups + 2 adds + layer_norm -> 1 fused op,
+    identical outputs."""
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    V, Lp = 32, 6
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        w_ids = layers.data("w_ids", [B, Lp], dtype="int64")
+        p_ids = layers.data("p_ids", [B, Lp], dtype="int64")
+        t_ids = layers.data("t_ids", [B, Lp], dtype="int64")
+        we = layers.embedding(w_ids, size=[V, D],
+                              param_attr=static.ParamAttr(name="we"))
+        pe = layers.embedding(p_ids, size=[Lp, D],
+                              param_attr=static.ParamAttr(name="pe"))
+        te = layers.embedding(t_ids, size=[2, D],
+                              param_attr=static.ParamAttr(name="te"))
+        s = layers.elementwise_add(layers.elementwise_add(we, pe), te)
+        out = layers.layer_norm(s, begin_norm_axis=2)
+    rng = np.random.RandomState(5)
+    feed = {"w_ids": rng.randint(0, V, (B, Lp)).astype(np.int64),
+            "p_ids": np.tile(np.arange(Lp), (B, 1)).astype(np.int64),
+            "t_ids": rng.randint(0, 2, (B, Lp)).astype(np.int64)}
+    scope = static.Scope()
+    ref = _run(main, startup, feed, out, scope)
+    prog = get_pass("embedding_eltwise_layernorm_fuse_pass")(
+        main, PassContext())
+    types = [op.type for op in prog.global_block().ops]
+    assert "fused_embedding_eltwise_layernorm" in types, types
+    assert "layer_norm" not in types and \
+        "elementwise_add" not in types, types
+    got = _run(prog, startup, feed, out, scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_fuse_preserves_padding_and_v1_squeeze():
+    """padding_idx rows must stay zero and lookup_table (v1) trailing-1
+    squeeze must survive fusion — per-leaf semantics ride in attrs."""
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    V, Lp = 16, 5
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        w_ids = layers.data("w_ids", [B, Lp], dtype="int64")
+        p_ids = layers.data("p_ids", [B, Lp], dtype="int64")
+        we = layers.embedding(w_ids, size=[V, D], padding_idx=0,
+                              param_attr=static.ParamAttr(name="pwe"))
+        pe = layers.embedding(p_ids, size=[Lp, D],
+                              param_attr=static.ParamAttr(name="ppe"))
+        s = layers.elementwise_add(we, pe)
+        out = layers.layer_norm(s, begin_norm_axis=2)
+    rng = np.random.RandomState(6)
+    wv = rng.randint(0, V, (B, Lp)).astype(np.int64)
+    wv[:, 0] = 0                                  # padded positions
+    feed = {"w_ids": wv,
+            "p_ids": np.tile(np.arange(Lp), (B, 1)).astype(np.int64)}
+    scope = static.Scope()
+    ref = _run(main, startup, feed, out, scope)
+    prog = get_pass("embedding_eltwise_layernorm_fuse_pass")(
+        main, PassContext())
+    types = [op.type for op in prog.global_block().ops]
+    assert "fused_embedding_eltwise_layernorm" in types, types
+    got = _run(prog, startup, feed, out, scope)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_fuse_skips_consumed_mean():
+    """A consumed layer_norm Mean output keeps the float pattern."""
+    from paddle_tpu.inference.passes import PassContext, get_pass
+    V, Lp = 16, 5
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        w_ids = layers.data("w_ids", [B, Lp], dtype="int64")
+        p_ids = layers.data("p_ids", [B, Lp], dtype="int64")
+        we = layers.embedding(w_ids, size=[V, D])
+        pe = layers.embedding(p_ids, size=[Lp, D])
+        s = layers.elementwise_add(we, pe)
+        blk = main.global_block()
+        y = blk.create_var(name="ln_y", shape=[B, Lp, D],
+                           dtype="float32")
+        mean = blk.create_var(name="ln_mean", dtype="float32")
+        var = blk.create_var(name="ln_var", dtype="float32")
+        blk.append_op("layer_norm", {"X": [s.name]},
+                      {"Y": ["ln_y"], "Mean": ["ln_mean"],
+                       "Variance": ["ln_var"]},
+                      {"begin_norm_axis": 2, "epsilon": 1e-5})
+        layers.scale(blk.var("ln_mean"), scale=2.0)   # Mean consumed
+    before = [op.type for op in main.global_block().ops]
+    prog = get_pass("embedding_eltwise_layernorm_fuse_pass")(
+        main, PassContext())
+    assert [op.type for op in prog.global_block().ops] == before
+
+
 def test_bert_style_predictor_end_to_end(tmp_path):
     """Two stacked attention layers through the saved-model predictor:
     the default pipeline fuses BOTH and outputs match the raw program."""
