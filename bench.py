@@ -111,12 +111,15 @@ def main():
             os.execv(sys.executable, [sys.executable, __file__])
     _FALLBACK_NOTE = os.environ.get("BENCH_FALLBACK_NOTE", "")
     import jax
+    import jax.numpy as jnp
     import paddle_tpu.static as static
     from paddle_tpu.ops.attention import enable_flash_attention
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    seq, batch = (512, 32) if on_tpu else (128, 2)
+    # batch 64 is the measured single-chip sweet spot (r5 sweep: b32
+    # 35.9k tok/s, b64 85k, b96/b128 OOM 15.75G HBM)
+    seq, batch = (512, 64) if on_tpu else (128, 2)
     layers_n = 12 if on_tpu else 2
     hidden = 768 if on_tpu else 256
     heads = 12 if on_tpu else 4
@@ -153,23 +156,59 @@ def main():
                                   (batch, seq, 1)).astype(np.int64),
         }
 
+    # Megastep: scan K training steps inside ONE jitted dispatch
+    # (Executor.run_steps).  Per-dispatch host/tunnel latency measured r5
+    # at ~300 ms/step vs 155 ms/step device compute (batch 32) — the
+    # device-resident loop is how the chip's real rate becomes the wall
+    # rate.  BENCH_MEGASTEP=0 falls back to one-dispatch-per-step.
+    n_steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 10))
+    megastep = int(os.environ.get("BENCH_MEGASTEP",
+                                  n_steps if on_tpu else 0))
+    device_feed = os.environ.get("BENCH_DEVICE_FEED", "") not in ("", "0")
     with static.scope_guard(scope):
         exe.run(startup_p)
         feed = batch_feed()
-        # warmup/compile BOTH step signatures (fetch + no-fetch differ in
-        # cache key; compiling inside the timed loop would poison dt)
-        exe.run(main_p, feed=feed, fetch_list=[loss])
-        exe.run(main_p, feed=feed, fetch_list=[])
-        n_steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 10))
-        t0 = time.time()
-        # steps WITHOUT per-step fetches: state buffers are donated and
-        # stay on device, dispatch runs ahead of the chip; only the last
-        # step fetches the loss (forces completion of the whole chain)
-        for _ in range(n_steps - 1):
+        if device_feed and megastep <= 0:
+            # pre-stage the feed on device ONCE: isolates per-step
+            # host->device transfer cost (high-latency axon tunnel) from
+            # compute
+            feed = {k: jax.device_put(jnp.asarray(v), dev)
+                    for k, v in feed.items()}
+        prof_dir = os.environ.get("BENCH_PROFILE", "")
+        if megastep > 0:
+            n_steps = megastep
+            sfeed = {k: np.broadcast_to(np.asarray(v),
+                                        (megastep,) + np.shape(v)).copy()
+                     for k, v in feed.items()}
+            if device_feed:
+                sfeed = {k: jax.device_put(jnp.asarray(v), dev)
+                         for k, v in sfeed.items()}
+            # warmup compiles the scan; timed run is ONE dispatch
+            exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+            if prof_dir:
+                jax.profiler.start_trace(prof_dir)
+            t0 = time.time()
+            out = exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+            np.asarray(out[0])
+            dt = time.time() - t0
+        else:
+            # warmup/compile BOTH step signatures (fetch + no-fetch differ
+            # in cache key; compiling inside the timed loop poisons dt)
+            exe.run(main_p, feed=feed, fetch_list=[loss])
             exe.run(main_p, feed=feed, fetch_list=[])
-        out = exe.run(main_p, feed=feed, fetch_list=[loss])
-        np.asarray(out[0])
-        dt = time.time() - t0
+            if prof_dir:
+                jax.profiler.start_trace(prof_dir)
+            t0 = time.time()
+            # steps WITHOUT per-step fetches: state buffers are donated
+            # and stay on device, dispatch runs ahead of the chip; only
+            # the last step fetches the loss (forces completion)
+            for _ in range(n_steps - 1):
+                exe.run(main_p, feed=feed, fetch_list=[])
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(out[0])
+            dt = time.time() - t0
+        if prof_dir:
+            jax.profiler.stop_trace()
 
     tokens_per_sec = n_steps * batch * seq / dt
 

@@ -130,6 +130,13 @@ def fused_embedding_eltwise_layernorm_op(ins, attrs, ctx):
 
     from .nn import _embedding
     embs = ins["Embs"]
+    if len(ins["Ids"]) != len(embs):
+        # fail fast like the unfused lookups would on a missing feed:
+        # a silent zip() truncation here would also misalign the per-leaf
+        # attrs below
+        raise ValueError(
+            f"fused_embedding_eltwise_layernorm: {len(ins['Ids'])} Ids "
+            f"inputs for {len(embs)} embedding tables")
     leaf_types = list(attrs.get("leaf_types",
                                 ["lookup_table_v2"] * len(embs)))
     pads = list(attrs.get("padding_idxs", [-1] * len(embs)))

@@ -390,6 +390,87 @@ class Executor:
 
         return jax.jit(step, donate_argnums=(0,))
 
+    # -- multi-step dispatch (device-resident training loop) ----------------
+    def run_steps(self, program, feed=None, fetch_list=None, scope=None,
+                  return_numpy=True):
+        """Run K consecutive training steps in ONE device dispatch.
+
+        Every array in `feed` carries a leading steps axis K; the jitted
+        computation `lax.scan`s the whole-block step over it, carrying the
+        persistable state on device, and returns each fetch stacked to
+        [K, ...].  One dispatch + one feed transfer amortize per-step host
+        latency K-fold — the difference between wall throughput and device
+        throughput when dispatch crosses a high-latency link (measured
+        r5 on the axon TPU tunnel: ~300 ms/step of dispatch overhead vs
+        155 ms/step of device compute at BERT-base batch 32).
+
+        TPU-first redesign of the reference's in-runtime trainer loops
+        (train_from_dataset / multi-batch C++ trainer,
+        paddle/fluid/framework/trainer.h:1): instead of a host loop calling
+        the device once per batch, the loop itself is compiled onto the
+        device.
+        """
+        from ..core.program import default_main_program
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [v.name if hasattr(v, "name") else str(v)
+                       for v in (fetch_list or [])]
+        block = program.global_block()
+        feed_vals = {n: self._coerce_feed(block, n, v)
+                     for n, v in feed.items()}
+        if not feed_vals:
+            raise ValueError("run_steps needs at least one stacked feed "
+                             "to define the number of steps")
+        k = next(iter(feed_vals.values())).shape[0]
+        for n, v in feed_vals.items():
+            if v.shape[0] != k:
+                raise ValueError(
+                    f"feed {n!r} leading (steps) dim {v.shape[0]} != {k}")
+        state_names = [n for n in _persistable_names(program)
+                       if scope.get(n) is not None]
+        feed_sig = tuple(sorted(
+            (n, tuple(getattr(v, "shape", np.shape(v))),
+             str(getattr(v, "dtype", None)))
+            for n, v in feed_vals.items()))
+        key = ("run_steps", program.fingerprint(), feed_sig,
+               tuple(fetch_names), tuple(state_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._compile_steps(program, state_names, fetch_names)
+            self._cache[key] = fn
+        state = {n: scope.get(n) for n in state_names}
+        seeds = jnp.asarray(
+            [self._seed_for_step(program) + i for i in range(k)],
+            jnp.uint32)
+        self._step += k
+        fetches, new_state = fn(state, feed_vals, seeds)
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _compile_steps(self, program: Program, state_names, fetch_names):
+        block = program.global_block()
+        tracer = BlockTracer(block)
+
+        def body(state, xs):
+            feed, seed = xs
+            env = dict(state)
+            env.update(feed)
+            ctx = OpContext(seed=seed)
+            tracer.run(env, ctx)
+            new_state = {n: env[n] for n in state_names}
+            fetches = tuple(env[n] for n in fetch_names)
+            return new_state, fetches
+
+        def multi(state, feeds, seeds):
+            new_state, fetches = jax.lax.scan(body, state, (feeds, seeds))
+            return fetches, new_state
+
+        return jax.jit(multi, donate_argnums=(0,))
+
     # -- helpers ------------------------------------------------------------
     def _coerce_feed(self, block, name, val):
         arr = jnp.asarray(val)

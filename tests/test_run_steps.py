@@ -1,0 +1,88 @@
+"""Executor.run_steps: K training steps scanned inside one jitted
+dispatch (device-resident training loop).
+
+TPU-first redesign of the reference's in-runtime trainer loop
+(paddle/fluid/framework/trainer.h:1 MultiTrainer::Run — the C++ side
+loops batches without returning to Python); here the loop is compiled
+onto the device with lax.scan so one dispatch covers K optimizer steps.
+Measured motivation (r5, axon tunnel): ~300 ms/step dispatch overhead vs
+155 ms/step device compute at BERT-base batch 32.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def _build(lr=0.1, seed=0):
+    main, startup = static.Program(), static.Program()
+    main.random_seed = startup.random_seed = seed
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _data(k, batch=4):
+    rng = np.random.RandomState(7)
+    xs = rng.rand(k, batch, 8).astype(np.float32)
+    ys = xs.sum(2, keepdims=True).astype(np.float32)
+    return xs, ys
+
+
+def test_run_steps_matches_sequential():
+    K = 6
+    xs, ys = _data(K)
+
+    main, startup, loss = _build()
+    exe, sc = static.Executor(), static.Scope()
+    seq_losses = []
+    with static.scope_guard(sc):
+        exe.run(startup)
+        for i in range(K):
+            (lv,) = exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                            fetch_list=[loss])
+            seq_losses.append(float(lv))
+
+    main2, startup2, loss2 = _build()
+    exe2, sc2 = static.Executor(), static.Scope()
+    with static.scope_guard(sc2):
+        exe2.run(startup2)
+        (stacked,) = exe2.run_steps(main2, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss2])
+    assert stacked.shape == (K,)
+    np.testing.assert_allclose(stacked, seq_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_run_steps_state_carries_between_calls():
+    """Two successive run_steps calls continue training (scope state
+    advances on device), and the loss keeps falling."""
+    K = 8
+    xs, ys = _data(2 * K)
+    main, startup, loss = _build()
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        (l1,) = exe.run_steps(main, feed={"x": xs[:K], "y": ys[:K]},
+                              fetch_list=[loss])
+        (l2,) = exe.run_steps(main, feed={"x": xs[K:], "y": ys[K:]},
+                              fetch_list=[loss])
+    assert float(l2[-1]) < float(l1[0])
+
+
+def test_run_steps_validates_feed():
+    main, startup, loss = _build()
+    exe, sc = static.Executor(), static.Scope()
+    xs, ys = _data(3)
+    with static.scope_guard(sc):
+        exe.run(startup)
+        with pytest.raises(ValueError):
+            exe.run_steps(main, feed={}, fetch_list=[loss])
+        with pytest.raises(ValueError):
+            exe.run_steps(main, feed={"x": xs, "y": ys[:2]},
+                          fetch_list=[loss])
