@@ -129,9 +129,9 @@ def main():
     use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
 
     # Flash dispatch is seq-length AUTO by default (crossover flag
-    # flash_min_seq_len, tools/tune_flash.py pins it on hardware):
-    # at seq 512 XLA's fused attention wins on v5e (measured r2: 61.5k vs
-    # 43.5k tok/s), flash takes over at long sequence.  BENCH_FLASH=1/0
+    # flash_min_seq_len).  r5 on-chip A/Bs: XLA attention wins at every
+    # length where both fit (512/2048/4096), so auto selects flash only
+    # from 8192 up, where materialized scores OOM.  BENCH_FLASH=1/0
     # forces it for A/B runs.
     if os.environ.get("BENCH_FLASH", "") != "":
         enable_flash_attention(

@@ -38,12 +38,17 @@ __all__ = ["flash_attention", "ring_attention", "reference_attention",
 # paddle.distributed.new_group start at 1 and must not collide)
 SP_RING_ID = 101
 
-# mode: "auto" dispatches per call on sequence length — XLA's fused
-# attention wins at short sequence on v5e (measured r2: 61.5k vs 43.5k
-# tok/s at seq 512), flash wins once the O(S^2) scores matrix stops
-# fitting; the crossover threshold is a flag so TPU sweeps
-# (tools/tune_flash.py) can pin it empirically.
-_FLASH_STATE = {"mode": "auto", "min_seq_len": 2048}
+# mode: "auto" dispatches per call on sequence length.  Measured on the
+# real v5e chip (r5, BERT-base bench): XLA's fused attention beats this
+# Pallas kernel at EVERY length where both fit — 0.66x at seq 512,
+# 0.73x at 2048, 0.75x at 4096 — so auto mode keeps the XLA path
+# through the measured range and selects flash only from 8192 up, where
+# the materialized [B,H,S,S] scores stop fitting HBM and the
+# memory-frugal kernel is the difference between running and OOM.
+# Explicit control: enable_flash_attention / FLAGS_use_flash_attention
+# / the flash_min_seq_len flag; tools/tune_flash.py re-evaluates the
+# crossover from block-size sweeps on hardware.
+_FLASH_STATE = {"mode": "auto", "min_seq_len": 8192}
 
 
 def enable_flash_attention(on: bool = True):

@@ -352,10 +352,7 @@ class Executor:
                        if scope.get(n) is not None]
         # signature from metadata only — np.asarray here would force a
         # blocking device->host copy of every feed on every step
-        feed_sig = tuple(sorted(
-            (n, tuple(getattr(v, "shape", np.shape(v))),
-             str(getattr(v, "dtype", None) or np.asarray(v).dtype))
-            for n, v in feed_vals.items()))
+        feed_sig = self._feed_signature(feed_vals)
         key = (program.fingerprint(), feed_sig, tuple(fetch_names),
                tuple(state_names))
         fn = self._cache.get(key)
@@ -374,10 +371,11 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
-    def _compile(self, program: Program, state_names, feed_names,
-                 fetch_names):
-        block = program.global_block()
-        tracer = BlockTracer(block)
+    def _make_step(self, program: Program, state_names, fetch_names):
+        """(state, feed, seed) -> (fetches, state') over the whole block —
+        the single traced step both the per-dispatch and scanned paths
+        compile."""
+        tracer = BlockTracer(program.global_block())
 
         def step(state, feed, seed):
             env = dict(state)
@@ -388,6 +386,18 @@ class Executor:
             fetches = tuple(env[n] for n in fetch_names)
             return fetches, new_state
 
+        return step
+
+    @staticmethod
+    def _feed_signature(feed_vals):
+        return tuple(sorted(
+            (n, tuple(getattr(v, "shape", np.shape(v))),
+             str(getattr(v, "dtype", None) or np.asarray(v).dtype))
+            for n, v in feed_vals.items()))
+
+    def _compile(self, program: Program, state_names, feed_names,
+                 fetch_names):
+        step = self._make_step(program, state_names, fetch_names)
         return jax.jit(step, donate_argnums=(0,))
 
     # -- multi-step dispatch (device-resident training loop) ----------------
@@ -422,47 +432,56 @@ class Executor:
         if not feed_vals:
             raise ValueError("run_steps needs at least one stacked feed "
                              "to define the number of steps")
-        k = next(iter(feed_vals.values())).shape[0]
+        k = None
         for n, v in feed_vals.items():
-            if v.shape[0] != k:
+            shape = getattr(v, "shape", ())
+            if len(shape) == 0:
                 raise ValueError(
-                    f"feed {n!r} leading (steps) dim {v.shape[0]} != {k}")
+                    f"run_steps feed {n!r} is a scalar; every feed needs "
+                    f"a leading steps axis (stack K per-step values)")
+            k = shape[0] if k is None else k
+            if shape[0] != k:
+                raise ValueError(
+                    f"feed {n!r} leading (steps) dim {shape[0]} != {k}")
         state_names = [n for n in _persistable_names(program)
                        if scope.get(n) is not None]
-        feed_sig = tuple(sorted(
-            (n, tuple(getattr(v, "shape", np.shape(v))),
-             str(getattr(v, "dtype", None)))
-            for n, v in feed_vals.items()))
-        key = ("run_steps", program.fingerprint(), feed_sig,
-               tuple(fetch_names), tuple(state_names))
+        key = ("run_steps", program.fingerprint(),
+               self._feed_signature(feed_vals), tuple(fetch_names),
+               tuple(state_names))
         fn = self._cache.get(key)
         if fn is None:
             fn = self._compile_steps(program, state_names, fetch_names)
             self._cache[key] = fn
+
+        # same side contracts as run(): elastic auto-checkpoint hook,
+        # run counters, profiler span, FLAGS_check_nan_inf post-scan
+        from ..incubate.checkpoint.auto_checkpoint import _auto_checkpoint
+        _auto_checkpoint(self, program)
+        from ..core.flags import flag
+        from ..core.monitor import stat_add
+        from ..profiler import RecordEvent
+        stat_add("executor_run_times")
         state = {n: scope.get(n) for n in state_names}
         seeds = jnp.asarray(
             [self._seed_for_step(program) + i for i in range(k)],
             jnp.uint32)
         self._step += k
-        fetches, new_state = fn(state, feed_vals, seeds)
+        with RecordEvent("Executor::RunSteps"):
+            fetches, new_state = fn(state, feed_vals, seeds)
         for n, v in new_state.items():
             scope.set(n, v)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        results = [np.asarray(f) for f in fetches] if return_numpy \
+            else list(fetches)
+        if flag("check_nan_inf", False):
+            self._check_nan_inf(fetch_names, results, scope)
+        return results
 
     def _compile_steps(self, program: Program, state_names, fetch_names):
-        block = program.global_block()
-        tracer = BlockTracer(block)
+        step = self._make_step(program, state_names, fetch_names)
 
         def body(state, xs):
             feed, seed = xs
-            env = dict(state)
-            env.update(feed)
-            ctx = OpContext(seed=seed)
-            tracer.run(env, ctx)
-            new_state = {n: env[n] for n in state_names}
-            fetches = tuple(env[n] for n in fetch_names)
+            fetches, new_state = step(state, feed, seed)
             return new_state, fetches
 
         def multi(state, feeds, seeds):

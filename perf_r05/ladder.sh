@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-5 bench ladder (VERDICT r4 item 1): A/B every unmeasured perf
+# feature on the real chip, serialized (one chip).  Each run emits one
+# JSON line; stderr goes to .err.  Keep going even if one variant fails.
+cd /root/repo
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  (env "$@" timeout 900 python bench.py > perf_r05/bench_$name.json \
+      2> perf_r05/bench_$name.err; echo "exit=$?" >> perf_r05/bench_$name.err)
+  cat perf_r05/bench_$name.json 2>/dev/null
+}
+run flash1        BENCH_FLASH=1
+run fusedce       BENCH_FUSED_CE=1
+run batch64       BENCH_BATCH=64
+run batch64_flash BENCH_BATCH=64 BENCH_FLASH=1
+run seq4096_flash BENCH_SEQ=4096 BENCH_FLASH=1 BENCH_BATCH=4
+run seq4096_xla   BENCH_SEQ=4096 BENCH_FLASH=0 BENCH_BATCH=4
+run seq2048_flash BENCH_SEQ=2048 BENCH_FLASH=1 BENCH_BATCH=8
+run seq2048_xla   BENCH_SEQ=2048 BENCH_FLASH=0 BENCH_BATCH=8
+run b64_fusedce   BENCH_BATCH=64 BENCH_FUSED_CE=1
+echo "=== ladder done ==="

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Follow-up diagnostics: wait for ladder.sh to finish, then
+#  1. device-resident feed A/B (isolates per-step tunnel transfer cost)
+#  2. profiled default run (where does the 1.7x vs r2 go?)
+#  3. longer run (BENCH_STEPS=60) to amortize any fixed overhead
+cd /root/repo
+while pgrep -f "perf_r05/ladder.sh" > /dev/null; do sleep 20; done
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  (env "$@" timeout 900 python bench.py > perf_r05/bench_$name.json \
+      2> perf_r05/bench_$name.err; echo "exit=$?" >> perf_r05/bench_$name.err)
+  cat perf_r05/bench_$name.json 2>/dev/null
+}
+run devfeed       BENCH_DEVICE_FEED=1
+run devfeed_b64   BENCH_DEVICE_FEED=1 BENCH_BATCH=64
+run steps60       BENCH_STEPS=60
+run profile       BENCH_PROFILE=perf_r05/trace
+echo "=== ladder2 done ==="

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Batch sweep around the b64 peak (84.9k tok/s, 36.7% MFU) + stability.
+cd /root/repo
+while pgrep -f "perf_r05/ladder2.sh" > /dev/null; do sleep 20; done
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  (env "$@" timeout 1200 python bench.py > perf_r05/bench_$name.json \
+      2> perf_r05/bench_$name.err; echo "exit=$?" >> perf_r05/bench_$name.err)
+  cat perf_r05/bench_$name.json 2>/dev/null
+}
+run batch96        BENCH_BATCH=96
+run batch128       BENCH_BATCH=128
+run batch64_s60    BENCH_BATCH=64 BENCH_STEPS=60
+run batch64_noamp  BENCH_BATCH=64 BENCH_NO_AMP=1
+echo "=== ladder3 done ==="

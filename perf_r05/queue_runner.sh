@@ -1,0 +1,23 @@
+#!/bin/bash
+# Chip-job queue: whenever the axon tunnel answers, run the next job.
+# Jobs are lines in perf_r05/queue.txt:  <name>|<shell command>
+# Output goes to perf_r05/<name>.out/.err; completions append to
+# queue_done.txt with the exit code.  The tunnel probe runs in a
+# subprocess with a hard timeout (hang-mode safe).  One job at a time.
+cd /root/repo
+while true; do
+  job=$(head -1 perf_r05/queue.txt 2>/dev/null)
+  if [ -z "$job" ]; then sleep 60; continue; fi
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    name=${job%%|*}; cmd=${job#*|}
+    echo "$(date -u +%H:%M:%S) RUN $name: $cmd" >> perf_r05/queue_runner.log
+    sed -i 1d perf_r05/queue.txt
+    timeout 2400 bash -c "$cmd" > "perf_r05/${name}.out" \
+        2> "perf_r05/${name}.err"
+    echo "$name rc=$? out=$(head -c 400 perf_r05/${name}.out | tr '\n' ' ')" \
+        >> perf_r05/queue_done.txt
+  else
+    echo "$(date -u +%H:%M:%S) tunnel down" >> perf_r05/queue_runner.log
+    sleep 120
+  fi
+done

@@ -86,3 +86,23 @@ def test_run_steps_validates_feed():
         with pytest.raises(ValueError):
             exe.run_steps(main, feed={"x": xs, "y": ys[:2]},
                           fetch_list=[loss])
+        with pytest.raises(ValueError, match="scalar"):
+            exe.run_steps(main, feed={"x": xs, "y": np.float32(0.5)},
+                          fetch_list=[loss])
+
+
+def test_run_steps_honors_check_nan_inf():
+    """FLAGS_check_nan_inf raises on the scanned path like run() does."""
+    from paddle_tpu.core.flags import set_flags
+    main, startup, loss = _build(lr=1e6)  # divergent lr -> inf/nan fast
+    exe, sc = static.Executor(), static.Scope()
+    xs, ys = _data(6)
+    set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with static.scope_guard(sc):
+            exe.run(startup)
+            with pytest.raises(RuntimeError, match="check_nan_inf"):
+                exe.run_steps(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
