@@ -96,6 +96,9 @@ define_flag("fused_xent", False,
             "fused kernel (softmax never materialized; Softmax output "
             "slot becomes a placeholder)")
 define_flag("benchmark", False, "sync + time every executor run")
+define_flag("dataset_chunk_steps", 1,
+            "train_from_dataset: batch this many consecutive same-shape "
+            "steps into one scanned device dispatch (Executor.run_steps)")
 define_flag("sort_sum_gradient", False,
             "deterministic gradient accumulation order (flags.cc:521)")
 define_flag("check_unused_vars", False,

@@ -327,10 +327,17 @@ def _batch_queue(batches: Iterable[Dict[str, np.ndarray]], capacity: int):
 
 def run_from_dataset(executor, program, dataset, scope=None,
                      fetch_list=None, fetch_info=None, print_period=100,
-                     debug=False):
+                     debug=False, chunk_steps=None):
     """One pass over the dataset through the jitted executor step — the
     train_from_dataset/infer_from_dataset hot loop (executor.py:1345,
-    multi_trainer.cc RunFromDataset)."""
+    multi_trainer.cc RunFromDataset).
+
+    chunk_steps > 1 (or FLAGS_dataset_chunk_steps) batches consecutive
+    same-shape steps into ONE device dispatch via Executor.run_steps
+    (lax.scan) — the reference's C++ trainer keeps the batch loop out of
+    Python for the same reason; on a high-latency dispatch link this is
+    the difference between wall and device throughput.  Ragged batches
+    (e.g. the last partial one) fall back to per-step run()."""
     if isinstance(dataset, InMemoryDataset):
         if not dataset._loaded:
             raise RuntimeError(
@@ -342,6 +349,14 @@ def run_from_dataset(executor, program, dataset, scope=None,
     else:
         raise TypeError(f"not a dataset: {dataset!r}")
 
+    from ..core.flags import flag
+    if chunk_steps is None:
+        chunk_steps = int(flag("dataset_chunk_steps", 1))
+    if flag("eager_run", False):
+        # debug modes want the per-op path (op naming in NaN scans);
+        # never route them through the scanned dispatch
+        chunk_steps = 1
+
     # drop feed names the program does not declare (.lod helpers)
     block = program.global_block()
     pop, join = _batch_queue(dataset._batches(records),
@@ -351,19 +366,61 @@ def run_from_dataset(executor, program, dataset, scope=None,
                    for f in fetch_list]
     step = 0
     last = []
+
+    def _report(vals):
+        if debug or (fetch_names and step % print_period == 0):
+            info = fetch_info or fetch_names
+            msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
+                            for n, v in zip(info, vals))
+            print(f"[dataset step {step}] {msg}")
+
+    def _sig(feed):
+        return tuple(sorted((k, np.shape(v)) for k, v in feed.items()))
+
+    pending = []  # same-shape feeds awaiting one scanned dispatch
+
+    def _flush():
+        nonlocal step, last
+        if not pending:
+            return
+        if len(pending) == 1:
+            last = executor.run(program, feed=pending[0],
+                                fetch_list=fetch_list, scope=scope)
+            step += 1
+            _report(last)
+        else:
+            stacked = {k: np.stack([f[k] for f in pending])
+                       for k in pending[0]}
+            outs = executor.run_steps(program, feed=stacked,
+                                      fetch_list=fetch_list, scope=scope)
+            # per-step reporting parity with the unchunked path: the
+            # scan returns every step's fetches, not just the last
+            for i in range(len(pending)):
+                step += 1
+                _report([o[i] for o in outs])
+            last = [o[-1] for o in outs]
+        pending.clear()
+
     while True:
         batch = pop()
         if batch is None:
             break
         feed = {k: v for k, v in batch.items()
                 if block.has_var(k)}
-        last = executor.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-        step += 1
-        if debug or (fetch_names and step % print_period == 0):
-            info = fetch_info or fetch_names
-            msg = ", ".join(f"{n}={np.asarray(v).ravel()[:4]}"
-                            for n, v in zip(info, last))
-            print(f"[dataset step {step}] {msg}")
+        if chunk_steps <= 1 or not feed:
+            # feed-less programs (no declared dataset slots) cannot be
+            # stacked — run them per step like the unchunked path
+            _flush()
+            last = executor.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope)
+            step += 1
+            _report(last)
+            continue
+        if pending and _sig(feed) != _sig(pending[0]):
+            _flush()
+        pending.append(feed)
+        if len(pending) >= chunk_steps:
+            _flush()
+    _flush()
     join()
     return last

@@ -357,8 +357,7 @@ class Executor:
                tuple(state_names))
         fn = self._cache.get(key)
         if fn is None:
-            fn = self._compile(program, state_names, sorted(feed_vals),
-                               fetch_names)
+            fn = self._compile(program, state_names, fetch_names)
             self._cache[key] = fn
 
         state = {n: scope.get(n) for n in state_names}
@@ -395,8 +394,7 @@ class Executor:
              str(getattr(v, "dtype", None) or np.asarray(v).dtype))
             for n, v in feed_vals.items()))
 
-    def _compile(self, program: Program, state_names, feed_names,
-                 fetch_names):
+    def _compile(self, program: Program, state_names, fetch_names):
         step = self._make_step(program, state_names, fetch_names)
         return jax.jit(step, donate_argnums=(0,))
 

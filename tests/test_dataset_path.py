@@ -150,3 +150,38 @@ def test_pipe_command_refused(tmp_path):
         ds.load_into_memory()
     with pytest.raises(ValueError):
         DatasetFactory().create_dataset("NoSuchDataset")
+
+
+def test_chunked_dataset_train_matches_per_step(tmp_path):
+    """FLAGS_dataset_chunk_steps batches same-shape steps into one
+    scanned dispatch (Executor.run_steps); the training trajectory must
+    match the per-step path exactly (same data order, no shuffle)."""
+    from paddle_tpu.core.flags import set_flags
+    f1 = str(tmp_path / "c.txt")
+    _write_multislot(f1, 64, seed=5)
+
+    def run(chunk):
+        main, startup, loss = _ctr_program()
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(16)  # 64 rows -> 4 uniform batches
+        ds.set_filelist([f1])
+        with static.program_guard(main, startup):
+            ds.set_use_var([main.global_block().var(n)
+                            for n in ("ids", "dense", "label")])
+        exe = static.Executor()
+        scope = static.Scope()
+        set_flags({"FLAGS_dataset_chunk_steps": chunk})
+        try:
+            with static.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(3):
+                    last = exe.train_from_dataset(main, ds,
+                                                  fetch_list=[loss])
+        finally:
+            set_flags({"FLAGS_dataset_chunk_steps": 1})
+        return float(np.asarray(last[0]))
+
+    l_per_step = run(1)
+    l_chunked = run(4)
+    assert np.isfinite(l_chunked)
+    np.testing.assert_allclose(l_chunked, l_per_step, rtol=1e-5)

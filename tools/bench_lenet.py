@@ -1,0 +1,72 @@
+"""MNIST LeNet-5 training bench (BASELINE.md config 1 — the reference's
+CPU-grade config; on TPU it is dispatch-bound, which run_steps absorbs).
+
+LeNet-5 through the static API: conv-pool x2, fc x3, softmax CE, SGD.
+Prints one bench.py-style JSON line (images/s)."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_lenet(use_amp=False):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        im = layers.data("image", [-1, 1, 28, 28])
+        lbl = layers.data("label", [-1, 1], dtype="int64")
+        h = layers.conv2d(im, 6, 5, padding=2, act="relu")
+        h = layers.pool2d(h, 2, pool_type="max", pool_stride=2)
+        h = layers.conv2d(h, 16, 5, act="relu")
+        h = layers.pool2d(h, 2, pool_type="max", pool_stride=2)
+        h = layers.fc(h, 120, act="relu")
+        h = layers.fc(h, 84, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, lbl))
+        static.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    k = int(os.environ.get("BENCH_MEGASTEP", 50 if on_tpu else 5))
+
+    main_p, startup_p, loss = build_lenet()
+    exe, scope = static.Executor(), static.Scope()
+    rng = np.random.RandomState(0)
+    sfeed = {
+        "image": rng.rand(k, batch, 1, 28, 28).astype(np.float32),
+        "label": rng.randint(0, 10, (k, batch, 1)).astype(np.int64),
+    }
+    with static.scope_guard(scope):
+        exe.run(startup_p)
+        exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])  # compile
+        t0 = time.time()
+        out = exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+        np.asarray(out[0])
+        dt = time.time() - t0
+
+    print(json.dumps({
+        "metric": "lenet_mnist_images_per_sec_per_chip" if on_tpu
+                  else "lenet_mnist_cpu_images_per_sec",
+        "value": round(k * batch / dt, 2),
+        "unit": "images/s/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

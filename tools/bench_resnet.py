@@ -1,0 +1,109 @@
+"""ResNet-50 ImageNet training bench (BASELINE.md config 2: images/sec/
+chip, MFU tracked).  Builds ResNet-50 with the static-graph API
+(bottleneck v1.5: stride-2 on the 3x3, like the reference's
+vision/models/resnet.py lineage), runs momentum-SGD steps under bf16 AMP
+as one scanned device dispatch (Executor.run_steps), and prints one JSON
+line in the bench.py format.
+
+MFU accounting: ~4.1 GFLOPs/image forward at 224^2 (standard count for
+ResNet-50 v1.5), x3 for fwd+bwd.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def conv_bn(layers, x, filters, ksize, stride=1, act=None):
+    y = layers.conv2d(x, filters, ksize, stride=stride,
+                      padding=(ksize - 1) // 2, bias_attr=False)
+    return layers.batch_norm(y, act=act)
+
+
+def bottleneck(layers, x, filters, stride, downsample):
+    out = conv_bn(layers, x, filters, 1, act="relu")
+    out = conv_bn(layers, out, filters, 3, stride=stride, act="relu")
+    out = conv_bn(layers, out, filters * 4, 1)
+    if downsample:
+        x = conv_bn(layers, x, filters * 4, 1, stride=stride)
+    return layers.relu(layers.elementwise_add(out, x))
+
+
+def build_resnet50(batch, img=224, classes=1000, use_amp=True):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu import amp
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        im = layers.data("image", [-1, 3, img, img])
+        label = layers.data("label", [-1, 1], dtype="int64")
+        h = conv_bn(layers, im, 64, 7, stride=2, act="relu")
+        h = layers.pool2d(h, 3, pool_type="max", pool_stride=2,
+                          pool_padding=1)
+        for stage, (filters, blocks) in enumerate(
+                [(64, 3), (128, 4), (256, 6), (512, 3)]):
+            for b in range(blocks):
+                stride = 2 if (b == 0 and stage > 0) else 1
+                h = bottleneck(layers, h, filters, stride, b == 0)
+        h = layers.pool2d(h, pool_type="avg", global_pooling=True)
+        logits = layers.fc(h, classes)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        opt = static.Momentum(learning_rate=0.1, momentum=0.9)
+        if use_amp:
+            opt = amp.decorate(opt, init_loss_scaling=1.0,
+                               use_dynamic_loss_scaling=False,
+                               dest_dtype="bfloat16")
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
+    img = int(os.environ.get("BENCH_IMG", 224 if on_tpu else 32))
+    classes = 1000 if on_tpu else 16
+    k = int(os.environ.get("BENCH_MEGASTEP", 10 if on_tpu else 2))
+
+    main_p, startup_p, loss = build_resnet50(batch, img, classes)
+    exe, scope = static.Executor(), static.Scope()
+    rng = np.random.RandomState(0)
+    sfeed = {
+        "image": rng.rand(k, batch, 3, img, img).astype(np.float32),
+        "label": rng.randint(0, classes, (k, batch, 1)).astype(np.int64),
+    }
+    with static.scope_guard(scope):
+        exe.run(startup_p)
+        exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])  # compile
+        t0 = time.time()
+        out = exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+        np.asarray(out[0])
+        dt = time.time() - t0
+
+    images_per_sec = k * batch / dt
+    flops_per_image = 3 * 4.1e9 * (img / 224.0) ** 2
+    peak = 197e12 if on_tpu else 0
+    mfu = images_per_sec * flops_per_image / peak if peak else 0.0
+    print(json.dumps({
+        "metric": "resnet50_imagenet_images_per_sec_per_chip"
+                  if on_tpu else "resnet50_tiny_cpu_images_per_sec",
+        "value": round(images_per_sec, 2),
+        "unit": "images/s/chip",
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

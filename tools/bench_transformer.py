@@ -1,0 +1,176 @@
+"""Transformer-big WMT14 en-de training bench (BASELINE.md config 3).
+
+Encoder-decoder built with the static-graph API (6+6 layers, d=1024,
+16 heads, ffn 4096 — "Attention Is All You Need" big), label-smoothed
+cross-entropy, Adam, bf16 AMP, one scanned device dispatch per K steps
+(Executor.run_steps).  Attention masks ride as feed inputs exactly like
+the reference's transformer book model feeds *_attn_bias tensors.
+
+MFU accounting: 6 * params * processed tokens (src tokens through the
+encoder params, trg tokens through the decoder params) + the score/
+context matmul flops both stacks add; printed as one bench.py-style
+JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mha(layers, q_in, kv_in, d_model, heads, bias=None):
+    """Multi-head attention via raw static layers; bias is an additive
+    [-1, 1, Tq, Tk] feed (None = unmasked)."""
+    dk = d_model // heads
+
+    def split_heads(x, t):
+        y = layers.reshape(x, [-1, t, heads, dk])
+        y.shape = (-1, t, heads, dk)
+        return layers.transpose(y, [0, 2, 1, 3])
+
+    tq, tk = q_in.shape[1], kv_in.shape[1]
+    q = split_heads(layers.fc(q_in, d_model, num_flatten_dims=2), tq)
+    k = split_heads(layers.fc(kv_in, d_model, num_flatten_dims=2), tk)
+    v = split_heads(layers.fc(kv_in, d_model, num_flatten_dims=2), tk)
+    logits = layers.matmul(layers.scale(q, scale=dk ** -0.5), k,
+                           transpose_y=True)
+    if bias is not None:
+        logits = layers.elementwise_add(logits, bias)
+    ctx = layers.matmul(layers.softmax(logits), v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [-1, tq, d_model])
+    ctx.shape = (-1, tq, d_model)
+    return layers.fc(ctx, d_model, num_flatten_dims=2)
+
+
+def _block_post(layers, x, sub):
+    return layers.layer_norm(layers.elementwise_add(x, sub),
+                             begin_norm_axis=2)
+
+
+def _ffn(layers, x, d_model, d_inner):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+    return layers.fc(h, d_model, num_flatten_dims=2)
+
+
+def build_transformer_big(src_len, trg_len, vocab=32000, d_model=1024,
+                          heads=16, n_layers=6, d_inner=4096,
+                          use_amp=True):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu import amp
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        src = layers.data("src_ids", [-1, src_len], dtype="int64")
+        trg = layers.data("trg_ids", [-1, trg_len], dtype="int64")
+        lbl = layers.data("labels", [-1, trg_len, 1], dtype="int64")
+        causal = layers.data("trg_bias", [-1, 1, trg_len, trg_len])
+        spos = layers.data("src_pos", [-1, src_len], dtype="int64")
+        tpos = layers.data("trg_pos", [-1, trg_len], dtype="int64")
+
+        enc = layers.elementwise_add(
+            layers.embedding(src, size=[vocab, d_model]),
+            layers.embedding(spos, size=[src_len, d_model]))
+        for _ in range(n_layers):
+            enc = _block_post(layers, enc,
+                              _mha(layers, enc, enc, d_model, heads))
+            enc = _block_post(layers, enc, _ffn(layers, enc, d_model,
+                                                d_inner))
+
+        dec = layers.elementwise_add(
+            layers.embedding(trg, size=[vocab, d_model]),
+            layers.embedding(tpos, size=[trg_len, d_model]))
+        for _ in range(n_layers):
+            dec = _block_post(layers, dec,
+                              _mha(layers, dec, dec, d_model, heads,
+                                   bias=causal))
+            dec = _block_post(layers, dec,
+                              _mha(layers, dec, enc, d_model, heads))
+            dec = _block_post(layers, dec, _ffn(layers, dec, d_model,
+                                                d_inner))
+
+        logits = layers.fc(dec, vocab, num_flatten_dims=2)
+        smoothed = layers.label_smooth(
+            layers.one_hot(layers.reshape(lbl, [-1, trg_len]), vocab),
+            epsilon=0.1)
+        loss = layers.mean(layers.softmax_with_cross_entropy(
+            logits, smoothed, soft_label=True))
+        opt = static.Adam(learning_rate=2e-4)
+        if use_amp:
+            opt = amp.decorate(opt, init_loss_scaling=1.0,
+                               use_dynamic_loss_scaling=False,
+                               dest_dtype="bfloat16")
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        src_len = trg_len = int(os.environ.get("BENCH_SEQ", 256))
+        batch = int(os.environ.get("BENCH_BATCH", 16))
+        vocab, d_model, heads, n_layers, d_inner = (32000, 1024, 16, 6,
+                                                    4096)
+        k = int(os.environ.get("BENCH_MEGASTEP", 10))
+    else:
+        src_len = trg_len = 32
+        batch, vocab, d_model, heads, n_layers, d_inner = 2, 512, 128, 4, 2, 256
+        k = 2
+
+    main_p, startup_p, loss = build_transformer_big(
+        src_len, trg_len, vocab, d_model, heads, n_layers, d_inner)
+    exe, scope = static.Executor(), static.Scope()
+    rng = np.random.RandomState(0)
+    causal = np.triu(np.full((trg_len, trg_len), -1e9, np.float32), 1)
+    sfeed = {
+        "src_ids": rng.randint(0, vocab, (k, batch, src_len), np.int64),
+        "trg_ids": rng.randint(0, vocab, (k, batch, trg_len), np.int64),
+        "labels": rng.randint(0, vocab, (k, batch, trg_len, 1), np.int64),
+        "trg_bias": np.broadcast_to(
+            causal, (k, batch, 1, trg_len, trg_len)).copy(),
+        "src_pos": np.broadcast_to(np.arange(src_len, dtype=np.int64),
+                                   (k, batch, src_len)).copy(),
+        "trg_pos": np.broadcast_to(np.arange(trg_len, dtype=np.int64),
+                                   (k, batch, trg_len)).copy(),
+    }
+    with static.scope_guard(scope):
+        exe.run(startup_p)
+        exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])  # compile
+        t0 = time.time()
+        out = exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+        np.asarray(out[0])
+        dt = time.time() - t0
+
+    tokens = k * batch * (src_len + trg_len)
+    tokens_per_sec = tokens / dt
+    n_params = sum(int(np.prod(v.shape))
+                   for v in main_p.all_parameters() if v.shape is not None)
+    # params split ~40/60 enc/dec (dec adds cross-attn); use 6*P_total/2
+    # per processed token as both stacks see half the tokens, plus
+    # score/context matmuls: 12 * L * T * d per token per stack
+    flops = (6 * n_params * tokens / 2
+             + 12 * n_layers * src_len * d_model * tokens)
+    peak = 197e12 if on_tpu else 0
+    mfu = flops / dt / peak if peak else 0.0
+    print(json.dumps({
+        "metric": "transformer_big_wmt_tokens_per_sec_per_chip"
+                  if on_tpu else "transformer_tiny_cpu_tokens_per_sec",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
+        "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()
