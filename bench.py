@@ -176,15 +176,32 @@ def main():
                     for k, v in feed.items()}
         prof_dir = os.environ.get("BENCH_PROFILE", "")
         if megastep > 0:
-            n_steps = megastep
             sfeed = {k: np.broadcast_to(np.asarray(v),
                                         (megastep,) + np.shape(v)).copy()
                      for k, v in feed.items()}
             if device_feed:
                 sfeed = {k: jax.device_put(jnp.asarray(v), dev)
                          for k, v in sfeed.items()}
-            # warmup compiles the scan; timed run is ONE dispatch
-            exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+            try:
+                # warmup compiles the scan; timed run is ONE dispatch
+                exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
+            except Exception as e:  # pragma: no cover - chip-side safety
+                # the scanned path must never cost the round its number:
+                # fall back to one-dispatch-per-step and say so.  A
+                # runtime failure happens AFTER the state buffers were
+                # donated to the scan, so re-init them before the
+                # fallback reads the scope; device_feed staging was also
+                # skipped when megastep was on — do it now.
+                sys.stderr.write(
+                    f"bench: megastep path failed ({e!r}); falling back "
+                    f"to per-step dispatch\n")
+                megastep = 0
+                exe.run(startup_p)
+                if device_feed:
+                    feed = {k: jax.device_put(jnp.asarray(v), dev)
+                            for k, v in feed.items()}
+        if megastep > 0:
+            n_steps = megastep
             if prof_dir:
                 jax.profiler.start_trace(prof_dir)
             t0 = time.time()

@@ -55,10 +55,7 @@ def _conv(x, w, attrs, ndims, feature_group_count=None, transpose=False):
     # f32-accumulates low-precision convs, and the explicit round-trip
     # forces the conv's vjp into f32 (see math._matmul)
     if transpose:
-        out = jax.lax.conv_transpose(
-            x, jnp.swapaxes(w, 0, 1), strides, padding,
-            rhs_dilation=dilations, dimension_numbers=dn,
-            transpose_kernel=True)
+        out = _conv_transpose_nd(x, w, attrs, ndims)
     else:
         out = jax.lax.conv_general_dilated(
             x, w, strides, padding, rhs_dilation=dilations,
@@ -66,6 +63,40 @@ def _conv(x, w, attrs, ndims, feature_group_count=None, transpose=False):
     if fmt in ("NHWC", "NDHWC"):
         out = jnp.moveaxis(out, 1, -1)
     return out
+
+
+def _conv_transpose_nd(x, w, attrs, ndims):
+    """Transpose conv as gradient-of-conv (lhs dilation), any spatial
+    rank.  paddle filter layout [Cin, Cout/groups, k...]; paddle pads
+    CROP the output: out = (D-1)*s - 2p + (k-1)*d + 1, so explicit pads
+    become (k-1)*d - p on the dilated input."""
+    strides = tuple(attrs.get("strides", [1] * ndims))
+    dilations = tuple(attrs.get("dilations", [1] * ndims))
+    pads = _conv_padding(attrs.get("paddings", [0] * ndims),
+                         attrs.get("padding_algorithm", "EXPLICIT"), ndims)
+    groups = attrs.get("groups", 1)
+    spatial = "DHW"[3 - ndims:]
+    cin, cog = w.shape[0], w.shape[1]
+    # [Cin, Cout/g, k...] -> [Cout, Cin/g, k...]: split Cin into
+    # (g, Cin/g), swap the per-group channel axes, merge (g, Cout/g)
+    wk = w.reshape((groups, cin // groups, cog) + w.shape[2:])
+    wk = jnp.swapaxes(wk, 1, 2).reshape(
+        (groups * cog, cin // groups) + w.shape[2:])
+    wk = jnp.flip(wk, axis=tuple(range(2, 2 + ndims)))
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, wk.shape,
+        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    if isinstance(pads, str):
+        padding = pads
+    else:
+        padding = [((wk.shape[2 + i] - 1) * dilations[i] - lo,
+                    (wk.shape[2 + i] - 1) * dilations[i] - hi)
+                   for i, (lo, hi) in enumerate(pads)]
+    out = jax.lax.conv_general_dilated(
+        x, wk, (1,) * ndims, padding, lhs_dilation=strides,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+    return out.astype(x.dtype)
 
 
 @register_op("conv2d", inputs=["Input", "Filter", "Bias?"], outputs=["Output"])
@@ -95,29 +126,8 @@ def depthwise_conv2d(ins, attrs, ctx):
 @register_op("conv2d_transpose", inputs=["Input", "Filter"],
              outputs=["Output"])
 def conv2d_transpose(ins, attrs, ctx):
-    x, w = ins["Input"], ins["Filter"]
-    ndims = 2
-    strides = tuple(attrs.get("strides", [1] * ndims))
-    dilations = tuple(attrs.get("dilations", [1] * ndims))
-    pads = _conv_padding(attrs.get("paddings", [0] * ndims),
-                         attrs.get("padding_algorithm", "EXPLICIT"), ndims)
-    # conv_transpose as gradient-of-conv: lhs dilation
-    dn = jax.lax.conv_dimension_numbers(x.shape,
-                                        jnp.swapaxes(w, 0, 1).shape,
-                                        ("NCHW", "OIHW", "NCHW"))
-    if isinstance(pads, str):
-        padding = pads
-    else:
-        padding = []
-        for i, (lo, hi) in enumerate(pads):
-            k = (w.shape[2 + i] - 1) * dilations[i] + 1
-            padding.append((k - 1 - lo, k - 1 - hi))
-    w_flip = jnp.flip(jnp.swapaxes(w, 0, 1), axis=(-1, -2))
-    out = jax.lax.conv_general_dilated(
-        x, w_flip, (1, 1), padding, lhs_dilation=strides,
-        rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=attrs.get("groups", 1))
-    return {"Output": out.astype(x.dtype)}
+    return {"Output": _conv_transpose_nd(ins["Input"], ins["Filter"],
+                                         attrs, 2)}
 
 
 @register_op("conv3d_transpose", inputs=["Input", "Filter"],
