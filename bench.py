@@ -126,6 +126,11 @@ def main():
     vocab = 30522 if on_tpu else 1024
     batch = int(os.environ.get("BENCH_BATCH", batch))
     seq = int(os.environ.get("BENCH_SEQ", seq))
+    # model-shape overrides (e.g. ERNIE-large: LAYERS=24 HIDDEN=1024
+    # HEADS=16 BATCH=16 — BASELINE.md config 5's model on one chip)
+    layers_n = int(os.environ.get("BENCH_LAYERS", layers_n))
+    hidden = int(os.environ.get("BENCH_HIDDEN", hidden))
+    heads = int(os.environ.get("BENCH_HEADS", heads))
     use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
 
     # Flash dispatch is seq-length AUTO by default (crossover flag

@@ -1,5 +1,7 @@
 """paddle.text — text datasets + the text-modeling layer toolkit
 (reference python/paddle/text/: datasets + text.py)."""
 from . import datasets  # noqa: F401
-from .datasets import Imdb, UCIHousing, FakeSeq2SeqData, FakeLMData  # noqa: F401
+from .datasets import (Imdb, Imikolov, Movielens, MovieInfo,  # noqa: F401
+                       UserInfo, UCIHousing, WMT14, WMT16, Conll05st,
+                       FakeSeq2SeqData, FakeLMData)
 from .text import *  # noqa: F401,F403

@@ -17,7 +17,9 @@ import numpy as np
 from ..io.dataset import Dataset
 from ..vision.datasets import DATA_HOME, _require
 
-__all__ = ["Imdb", "UCIHousing", "FakeSeq2SeqData", "FakeLMData"]
+__all__ = ["Imdb", "Imikolov", "Movielens", "MovieInfo", "UserInfo",
+           "UCIHousing", "WMT14", "WMT16", "Conll05st",
+           "FakeSeq2SeqData", "FakeLMData"]
 
 
 class Imdb(Dataset):
@@ -92,6 +94,429 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus (reference text/datasets/imikolov.py:31).
+
+    Parses the simple-examples tarball: word dict over train+test with a
+    frequency cutoff plus <s>/<e> per line and a trailing <unk>;
+    data_type NGRAM yields window_size-grams, SEQ yields
+    (<s>+sentence, sentence+<e>) pairs."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50):
+        data_type = data_type.upper()
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type must be NGRAM or SEQ: {data_type}")
+        if data_type == "NGRAM" and window_size <= 0:
+            raise ValueError("NGRAM needs window_size > 0")
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        data_file = data_file or os.path.join(DATA_HOME, "imikolov",
+                                              "simple-examples.tgz")
+        _require(data_file, "Imikolov archive")
+        self.data_type, self.window_size, self.mode = (data_type,
+                                                       window_size, mode)
+        from collections import Counter
+        # vocab counts over train+valid (reference _build_work_dict);
+        # <unk> is forced to the LAST index
+        freq = Counter()
+        lines = []
+        with tarfile.open(data_file, "r:*") as tf:
+            for split in ("train", "valid"):
+                member = f"./simple-examples/data/ptb.{split}.txt"
+                for raw in tf.extractfile(member):
+                    freq.update(raw.decode("utf-8").strip().split())
+                    freq.update(("<s>", "<e>"))
+            member = f"./simple-examples/data/ptb.{mode}.txt"
+            for raw in tf.extractfile(member):
+                lines.append(raw.decode("utf-8").strip().split())
+        freq.pop("<unk>", None)
+        items = sorted(((w, c) for w, c in freq.items()
+                        if c > min_word_freq), key=lambda t: (-t[1], t[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(items)}
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        self.data = []
+        for toks in lines:
+            if data_type == "NGRAM":
+                # sentences are framed BEFORE n-gram extraction, so the
+                # boundary grams (<s>, w0) / (w_last, <e>) are included
+                toks = ["<s>"] + toks + ["<e>"]
+                if len(toks) < window_size:
+                    continue
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(tuple(ids[i - window_size:i]))
+            else:
+                ids = [self.word_idx.get(w, unk) for w in toks]
+                src = [self.word_idx["<s>"]] + ids
+                trg = ids + [self.word_idx["<e>"]]
+                if 0 < window_size < len(src):
+                    continue
+                self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie id/title/categories (reference movielens.py:37)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    """User id/gender/age/job (reference movielens.py:62)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ML-1M rating prediction (reference movielens.py:89): parses the
+    ml-1m zip ('::'-separated latin-1 .dat files); samples are
+    user.value() + movie.value() + [[rating*2-5]] with a seeded random
+    train/test split."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        import zipfile
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        data_file = data_file or os.path.join(DATA_HOME, "movielens",
+                                              "ml-1m.zip")
+        _require(data_file, "Movielens ml-1m.zip")
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode("latin1") \
+                        .strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pattern.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _zip = line.decode("latin1") \
+                        .strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c in
+                                    enumerate(sorted(categories))}
+            rng = np.random.RandomState(rand_seed)
+            is_test = mode == "test"
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ts = line.decode("latin1") \
+                        .strip().split("::")
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+_WMT_START, _WMT_END, _WMT_UNK, _WMT_UNK_IDX = "<s>", "<e>", "<unk>", 2
+
+
+class WMT14(Dataset):
+    """WMT14 en-de (reference wmt14.py:41): tarball carrying src.dict /
+    trg.dict members and {mode}/{mode} tab-separated parallel text;
+    samples are (src_ids, trg_ids, trg_ids_next) with <s>/<e> framing
+    and sequences longer than 80 tokens dropped."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        mode = mode.lower()
+        if mode not in ("train", "test", "gen"):
+            raise ValueError("mode must be train/test/gen")
+        if dict_size <= 0:
+            raise ValueError("dict_size must be positive")
+        data_file = data_file or os.path.join(DATA_HOME, "wmt14",
+                                              "wmt14.tgz")
+        _require(data_file, "WMT14 archive")
+        self.mode = mode
+        with tarfile.open(data_file, "r:*") as tf:
+            def to_dict(suffix):
+                names = [m.name for m in tf.getmembers()
+                         if m.name.endswith(suffix)]
+                assert len(names) == 1, (suffix, names)
+                d = {}
+                for i, line in enumerate(tf.extractfile(names[0])):
+                    if i >= dict_size:
+                        break
+                    d[line.decode("utf-8").strip()] = i
+                return d
+
+            self.src_dict = to_dict("src.dict")
+            self.trg_dict = to_dict("trg.dict")
+            self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+            wanted = f"{mode}/{mode}"
+            for m in tf.getmembers():
+                if not m.name.endswith(wanted):
+                    continue
+                for line in tf.extractfile(m):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _WMT_UNK_IDX)
+                           for w in ([_WMT_START] + parts[0].split()
+                                     + [_WMT_END])]
+                    trg = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids_next.append(trg
+                                             + [self.trg_dict[_WMT_END]])
+                    self.trg_ids.append([self.trg_dict[_WMT_START]] + trg)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """WMT16 en-de (reference wmt16.py): tarball with wmt16/{train,val,
+    test} tab-separated parallel text; dictionaries are built from the
+    train split in memory ([<s>, <e>, <unk>] + top words by frequency)
+    instead of cached dict files on disk."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        mode = mode.lower()
+        if mode not in ("train", "test", "val"):
+            raise ValueError("mode must be train/test/val")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes must be positive")
+        data_file = data_file or os.path.join(DATA_HOME, "wmt16",
+                                              "wmt16.tar.gz")
+        _require(data_file, "WMT16 archive")
+        self.mode, self.lang = mode, lang
+        src_col = 0 if lang == "en" else 1
+        with tarfile.open(data_file, "r:*") as tf:
+            # one pass over wmt16/train feeds BOTH language dicts
+            from collections import Counter
+            freqs = (Counter(), Counter())
+            for line in tf.extractfile("wmt16/train"):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                freqs[0].update(parts[0].split())
+                freqs[1].update(parts[1].split())
+
+            def to_dict(freq, size):
+                words = [_WMT_START, _WMT_END, _WMT_UNK] + \
+                    [w for w, _ in sorted(freq.items(),
+                                          key=lambda t: (-t[1], t[0]))]
+                return {w: i for i, w in enumerate(words[:size])}
+
+            self.src_dict = to_dict(freqs[src_col], src_dict_size)
+            self.trg_dict = to_dict(freqs[1 - src_col], trg_dict_size)
+            start_id = self.src_dict[_WMT_START]
+            end_id = self.src_dict[_WMT_END]
+            unk_id = self.src_dict[_WMT_UNK]
+            self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+            for line in tf.extractfile(f"wmt16/{mode}"):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [start_id] + [self.src_dict.get(w, unk_id)
+                                    for w in parts[src_col].split()] \
+                    + [end_id]
+                trg = [self.trg_dict.get(w, unk_id)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids_next.append(trg + [end_id])
+                self.trg_ids.append([start_id] + trg)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test set (reference conll05.py:43): words.gz +
+    props.gz column files inside the release tarball; one sample per
+    (sentence, predicate) with the standard bracket->BIO conversion and
+    the 5-word predicate context window replicated across the
+    sentence."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None):
+        import gzip
+        base = os.path.join(DATA_HOME, "conll05st")
+        data_file = data_file or os.path.join(base, "conll05st-tests.tar.gz")
+        word_dict_file = word_dict_file or os.path.join(base, "wordDict.txt")
+        verb_dict_file = verb_dict_file or os.path.join(base, "verbDict.txt")
+        target_dict_file = target_dict_file or os.path.join(base,
+                                                            "targetDict.txt")
+        for f, what in ((data_file, "Conll05st archive"),
+                        (word_dict_file, "word dict"),
+                        (verb_dict_file, "verb dict"),
+                        (target_dict_file, "target dict")):
+            _require(f, what)
+        self.word_dict = self._plain_dict(word_dict_file)
+        self.predicate_dict = self._plain_dict(verb_dict_file)
+        self.label_dict = self._label_dict(target_dict_file)
+        self._unk = self.word_dict.get("<unk>", 0)
+
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sentence, columns = [], []
+                for wline, pline in zip(words, props):
+                    word = wline.decode("utf-8").strip()
+                    fields = pline.decode("utf-8").strip().split()
+                    if not fields:  # sentence boundary
+                        self._emit(sentence, columns)
+                        sentence, columns = [], []
+                        continue
+                    sentence.append(word)
+                    columns.append(fields)
+                self._emit(sentence, columns)
+
+    @staticmethod
+    def _plain_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d = {}
+        for tag in tags:
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _emit(self, sentence, columns):
+        """One SRL sample per predicate column: column 0 is the predicate
+        lemma rows, columns 1.. are bracketed role tags per predicate."""
+        if not columns:
+            return
+        verbs = [w for w in (row[0] for row in columns) if w != "-"]
+        n_pred = len(columns[0]) - 1
+        for p in range(n_pred):
+            tags = []
+            current = None
+            for row in columns:
+                tok = row[1 + p]
+                label = "O"
+                if "(" in tok:
+                    current = tok[tok.index("(") + 1:].split("*")[0] \
+                        .rstrip(")")
+                    label = "B-" + current
+                elif current is not None:
+                    label = "I-" + current
+                if ")" in tok:
+                    current = None
+                tags.append(label)
+            if "B-V" not in tags or p >= len(verbs):
+                continue
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[p])
+            self.labels.append(tags)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        vi = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, key, fb in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                             (0, "0", None), (1, "p1", "eos"),
+                             (2, "p2", "eos")):
+            j = vi + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[key] = sentence[j]
+            else:
+                ctx[key] = fb
+        word_idx = [self.word_dict.get(w, self._unk) for w in sentence]
+        reps = {k: [self.word_dict.get(v, self._unk)] * n
+                for k, v in ctx.items()}
+        pred_idx = [self.predicate_dict.get(self.predicates[idx])] * n
+        label_idx = [self.label_dict.get(t) for t in labels]
+        return (np.array(word_idx), np.array(reps["n2"]),
+                np.array(reps["n1"]), np.array(reps["0"]),
+                np.array(reps["p1"]), np.array(reps["p2"]),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
 
 
 class FakeSeq2SeqData(Dataset):

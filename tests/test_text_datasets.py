@@ -1,0 +1,157 @@
+"""paddle.text datasets parity (reference python/paddle/text/datasets/):
+each loader parses the OFFICIAL archive format — tests build tiny
+synthetic archives in those formats and check ids/shapes/splits."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imikolov, Movielens, WMT14, WMT16)
+
+
+def _tar_with(path, members):
+    """members: {name: bytes} -> tar.gz at path."""
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    corpus = b"the cat sat\nthe dog sat\nthe cat ran\n"
+    path = str(tmp_path / "simple-examples.tgz")
+    _tar_with(path, {
+        "./simple-examples/data/ptb.train.txt": corpus,
+        "./simple-examples/data/ptb.valid.txt": b"the cat sat\n",
+        "./simple-examples/data/ptb.test.txt": b"the cat sat\n",
+    })
+    ds = Imikolov(path, data_type="NGRAM", window_size=2, mode="train",
+                  min_word_freq=0)
+    # lines framed <s> w w w <e> -> 4 bigrams per 3-token line, incl.
+    # the boundary grams
+    assert len(ds) == 12
+    first = ds[0]
+    assert len(first) == 2
+    assert int(first[0]) == ds.word_idx["<s>"]
+    assert "<unk>" in ds.word_idx
+    assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1  # forced last
+
+    seq = Imikolov(path, data_type="SEQ", mode="test", min_word_freq=0)
+    src, trg = seq[0]
+    assert src[0] == seq.word_idx["<s>"]
+    assert trg[-1] == seq.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    with pytest.raises(ValueError):
+        Imikolov(path, data_type="NGRAM", window_size=0)
+
+
+def test_movielens_sample_layout(tmp_path):
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action\n").encode("latin1")
+    users = ("1::M::25::12::90210\n"
+             "2::F::35::7::10001\n").encode("latin1")
+    ratings = ("1::1::5::978300760\n"
+               "1::2::3::978302109\n"
+               "2::1::4::978301968\n").encode("latin1")
+    path = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    tr = Movielens(path, mode="train", test_ratio=0.0)
+    te = Movielens(path, mode="test", test_ratio=0.0)
+    assert len(tr) == 3 and len(te) == 0
+    s = tr[0]
+    # uid, gender, age, job, mid, categories, title words, rating
+    assert len(s) == 8
+    uid, gender, age, job, mid, cats, title, rating = s
+    assert uid == [1] and gender == [0] and job == [12]
+    assert float(rating[0]) == 5.0 * 2 - 5.0
+    assert all(c in range(3) for c in cats)
+
+
+def test_wmt14_ids_and_framing(tmp_path):
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nhallo\nwelt\n"
+    train = b"hello world\thallo welt\nhello novel\thallo neu\n"
+    path = str(tmp_path / "wmt14.tgz")
+    _tar_with(path, {"wmt14/src.dict": src_dict,
+                     "wmt14/trg.dict": trg_dict,
+                     "wmt14/train/train": train})
+    ds = WMT14(path, mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, nxt = ds[0]
+    sd, td = ds.get_dict()
+    assert src[0] == sd["<s>"] and src[-1] == sd["<e>"]
+    assert trg[0] == td["<s>"] and nxt[-1] == td["<e>"]
+    np.testing.assert_array_equal(trg[1:], nxt[:-1])
+    # unknown words map to UNK_IDX=2
+    src2, _, _ = ds[1]
+    assert src2[2] == 2  # 'novel' not in dict
+
+
+def test_wmt16_builds_dict_from_train(tmp_path):
+    train = b"a b\tx y\na c\tx z\n"
+    val = b"a b\tx y\n"
+    path = str(tmp_path / "wmt16.tar.gz")
+    _tar_with(path, {"wmt16/train": train, "wmt16/val": val,
+                     "wmt16/test": val})
+    ds = WMT16(path, mode="val", src_dict_size=6, trg_dict_size=6)
+    # dict: <s> <e> <unk> + by freq: a(2) then b/c alphabetical
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["a"] == 3
+    src, trg, nxt = ds[0]
+    assert src[0] == 0 and src[-1] == 1
+    np.testing.assert_array_equal(trg[1:], nxt[:-1])
+    # reversed lang swaps the columns
+    de = WMT16(path, mode="val", src_dict_size=6, trg_dict_size=6,
+               lang="de")
+    assert de.src_dict["x"] == 3
+
+
+def test_conll05st_srl_samples(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    # props: column 0 = predicate lemma rows, column 1 = role brackets
+    props = (b"-\t(A0*\n"
+             b"-\t*)\n"
+             b"sit\t(V*)\n"
+             b"\n")
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="wb") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="wb") as g:
+        g.write(props)
+    base = tmp_path
+    path = str(base / "conll05st-tests.tar.gz")
+    _tar_with(path, {
+        "conll05st-release/test.wsj/words/test.wsj.words.gz":
+            wbuf.getvalue(),
+        "conll05st-release/test.wsj/props/test.wsj.props.gz":
+            pbuf.getvalue(),
+    })
+    (base / "wordDict.txt").write_text("<unk>\nThe\ncat\nsat\n")
+    (base / "verbDict.txt").write_text("sit\n")
+    (base / "targetDict.txt").write_text("B-A0\nB-V\nO\n")
+    ds = Conll05st(path, str(base / "wordDict.txt"),
+                   str(base / "verbDict.txt"),
+                   str(base / "targetDict.txt"))
+    assert len(ds) == 1
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx, n2, n1, c0, p1, p2, pred, mark, label = sample
+    assert list(word_idx) == [1, 2, 3]          # The cat sat
+    assert list(pred) == [0] * 3                # 'sit'
+    assert mark[2] == 1                         # predicate marked
+    wd, pd, ld = ds.get_dict()
+    assert label[2] == ld["B-V"]
+    assert label[0] == ld["B-A0"] and label[1] == ld["I-A0"]
+
+
+def test_missing_archive_raises(tmp_path):
+    with pytest.raises(Exception):
+        WMT14(str(tmp_path / "nope.tgz"), dict_size=5)
