@@ -19,8 +19,8 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "DATA_HOME"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "FakeData", "DATA_HOME"]
 
 DATA_HOME = os.path.expanduser(os.environ.get(
     "PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
@@ -140,6 +140,154 @@ class Cifar100(Cifar10):
 
     def _member_names(self):
         return [f"{self._prefix}/{'train' if self.mode == 'train' else 'test'}"]
+
+
+class _TarReader:
+    """Picklable, thread-safe member reader over one tar archive.
+
+    DataLoader workers get a fresh handle after unpickling (a TarFile
+    cannot cross a process boundary), and the thread-pool fallback's
+    concurrent reads serialize on a lock (interleaved seeks on one
+    shared file handle would hand back bytes of the wrong member)."""
+
+    def __init__(self, path):
+        self._path = path
+        self._tar = None
+        import threading
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._tar is None:
+            self._tar = tarfile.open(self._path, "r:*")
+            self._members = {m.name: m for m in self._tar.getmembers()}
+
+    def names(self):
+        with self._lock:
+            self._ensure()
+            return list(self._members)
+
+    def read(self, name):
+        with self._lock:
+            self._ensure()
+            return self._tar.extractfile(self._members[name]).read()
+
+    def __getstate__(self):
+        return {"_path": self._path}
+
+    def __setstate__(self, state):
+        self.__init__(state["_path"])
+
+    def close(self):
+        if self._tar is not None:
+            self._tar.close()
+            self._tar = None
+
+
+def _decode_image(raw, backend, transform):
+    from PIL import Image
+    import io as _io
+    img = Image.open(_io.BytesIO(raw))
+    if backend == "pil":
+        if transform is not None:
+            img = transform(img)
+        return img
+    img = np.array(img)
+    if transform is not None:
+        img = transform(img)
+    return img.astype(np.float32)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py:43):
+    102flowers.tgz jpgs + imagelabels.mat + setid.mat.  Mirrors the
+    reference's mode->setid mapping (train takes 'tstid', the LARGEST
+    split — a long-standing paddle quirk kept for parity).
+    backend='cv2' (default) yields float32 HWC ndarrays, 'pil' yields
+    PIL.Image objects."""
+
+    _FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend="cv2"):
+        mode = mode.lower()
+        if mode not in self._FLAG:
+            raise ValueError("mode must be train/valid/test")
+        if backend not in ("cv2", "pil"):
+            raise ValueError("backend must be 'cv2' or 'pil'")
+        base = os.path.join(DATA_HOME, "flowers")
+        data_file = data_file or os.path.join(base, "102flowers.tgz")
+        label_file = label_file or os.path.join(base, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(base, "setid.mat")
+        _require(data_file, "Flowers images archive")
+        _require(label_file, "Flowers imagelabels.mat")
+        _require(setid_file, "Flowers setid.mat")
+        self.transform = transform
+        self.backend = backend
+        import scipy.io as scio
+        self._reader = _TarReader(data_file)
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._FLAG[mode]][0]
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]], np.int64)
+        raw = self._reader.read("jpg/image_%05d.jpg" % index)
+        return _decode_image(raw, self.backend, self.transform), label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference vision/datasets/
+    voc2012.py:41): JPEGImages + SegmentationClass pairs selected by the
+    ImageSets/Segmentation/{trainval,train,val}.txt lists (reference
+    mode mapping: train->trainval, test->train, valid->val).
+    backend='cv2' (default) yields float32 ndarrays, 'pil' yields
+    PIL.Image objects for both image and mask."""
+
+    _FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _IMG = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LBL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2"):
+        mode = mode.lower()
+        if mode not in self._FLAG:
+            raise ValueError("mode must be train/valid/test")
+        if backend not in ("cv2", "pil"):
+            raise ValueError("backend must be 'cv2' or 'pil'")
+        data_file = data_file or os.path.join(
+            DATA_HOME, "voc2012", "VOCtrainval_11-May-2012.tar")
+        _require(data_file, "VOC2012 archive")
+        self.transform = transform
+        self.backend = backend
+        self._reader = _TarReader(data_file)
+        listing = self._reader.read(self._SET.format(self._FLAG[mode]))
+        self.data, self.labels = [], []
+        for name in listing.decode("utf-8").splitlines():
+            name = name.strip()
+            if not name:
+                continue
+            self.data.append(self._IMG.format(name))
+            self.labels.append(self._LBL.format(name))
+
+    def __getitem__(self, idx):
+        img = _decode_image(self._reader.read(self.data[idx]),
+                            self.backend, self.transform)
+        raw_lbl = self._reader.read(self.labels[idx])
+        if self.backend == "pil":
+            from PIL import Image
+            import io as _io
+            return img, Image.open(_io.BytesIO(raw_lbl))
+        import io as _io
+        from PIL import Image
+        lbl = np.array(Image.open(_io.BytesIO(raw_lbl)))
+        return img, lbl.astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
 
 
 class FakeData(Dataset):
