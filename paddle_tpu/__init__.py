@@ -30,7 +30,8 @@ def _setup_api():
     import importlib
     for mod in ("dygraph", "tensor", "nn", "optimizer", "static",
                 "distributed", "amp", "metric", "io", "vision", "text",
-                "hapi", "jit", "incubate", "profiler", "utils", "slim"):
+                "hapi", "jit", "incubate", "profiler", "utils", "slim",
+                "reader", "dataset"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:
@@ -60,3 +61,4 @@ try:
     from .io.framework_io import save, load  # noqa: F401
 except ImportError:
     pass
+from .batch import batch  # noqa: F401

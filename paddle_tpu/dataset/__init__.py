@@ -1,0 +1,12 @@
+"""paddle.dataset — fluid-era reader-creator dataset API (reference
+python/paddle/dataset/): `paddle.batch(paddle.reader.shuffle(
+paddle.dataset.mnist.train(), 500), 64)`-style pipelines.  Parsing
+delegates to the 2.0 Dataset classes (paddle_tpu.vision/text.datasets);
+zero-egress: archives are read from DATA_HOME, never downloaded."""
+from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
+               imikolov, mnist, movielens, mq2007, uci_housing, voc2012,
+               wmt14, wmt16)
+
+__all__ = ["cifar", "common", "conll05", "flowers", "image", "imdb",
+           "imikolov", "mnist", "movielens", "mq2007", "uci_housing",
+           "voc2012", "wmt14", "wmt16"]

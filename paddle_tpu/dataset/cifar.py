@@ -1,0 +1,35 @@
+"""paddle.dataset.cifar readers (reference python/paddle/dataset/
+cifar.py): samples are (3072 float32 pixels in [0, 1], int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.datasets import Cifar10, Cifar100
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader_creator(cls, mode):
+    def reader():
+        ds = cls(mode=mode)
+        flat = ds.data.transpose(0, 3, 1, 2).reshape(len(ds), -1)
+        for img, label in zip(flat, ds.labels):
+            yield (img / 255.0).astype(np.float32), int(label)
+
+    return reader
+
+
+def train10():
+    return _reader_creator(Cifar10, "train")
+
+
+def test10():
+    return _reader_creator(Cifar10, "test")
+
+
+def train100():
+    return _reader_creator(Cifar100, "train")
+
+
+def test100():
+    return _reader_creator(Cifar100, "test")

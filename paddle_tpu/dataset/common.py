@@ -1,0 +1,77 @@
+"""paddle.dataset.common (reference python/paddle/dataset/common.py):
+DATA_HOME, md5, download (local-only here), reader file splitting."""
+from __future__ import annotations
+
+import glob
+import hashlib
+import os
+import pickle
+
+from ..vision.datasets import DATA_HOME  # same cache layout
+
+__all__ = ["DATA_HOME", "md5file", "download", "split",
+           "cluster_files_reader"]
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Zero-egress environment: resolves to the cached local path and
+    verifies the checksum; raises with instructions when absent instead
+    of fetching (reference common.py:62 downloads)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if not os.path.exists(filename):
+        raise FileNotFoundError(
+            f"{filename} not present and this environment has no network "
+            f"access — place the archive from {url} there manually")
+    if md5sum and md5file(filename) != md5sum:
+        raise IOError(f"{filename} md5 mismatch (expected {md5sum})")
+    return filename
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into pickled chunk files of line_count
+    samples each (reference common.py:126)."""
+    if not callable(reader):
+        raise TypeError("reader must be callable")
+    if "%" not in suffix:
+        raise ValueError("suffix must contain %d-style placeholder")
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    lines = []
+    index = 0
+    for i, d in enumerate(reader()):
+        lines.append(d)
+        if i >= line_count and i % line_count == 0:
+            with open(suffix % index, "wb") as f:
+                dumper(lines, f)
+            lines = []
+            index += 1
+    if lines:
+        with open(suffix % index, "wb") as f:
+            dumper(lines, f)
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over this trainer's shard of pickled chunk files
+    (reference common.py:157)."""
+    loader = loader or (lambda f: pickle.load(f))
+
+    def reader():
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [f for i, f in enumerate(file_list)
+                    if i % trainer_count == trainer_id]
+        for fn in my_files:
+            with open(fn, "rb") as f:
+                for line in loader(f):
+                    yield line
+
+    return reader

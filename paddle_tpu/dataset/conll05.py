@@ -1,0 +1,46 @@
+"""paddle.dataset.conll05 readers (reference python/paddle/dataset/
+conll05.py): SRL test reader + dicts + pretrained embedding loader."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+from ..text.datasets import Conll05st as _Conll05st
+
+__all__ = ["test", "get_dict", "get_embedding"]
+
+
+def _dataset(data_file=None, word_dict_file=None, verb_dict_file=None,
+             target_dict_file=None):
+    return _Conll05st(data_file, word_dict_file, verb_dict_file,
+                      target_dict_file)
+
+
+def test(data_file=None, word_dict_file=None, verb_dict_file=None,
+         target_dict_file=None):
+    def reader():
+        ds = _dataset(data_file, word_dict_file, verb_dict_file,
+                      target_dict_file)
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader
+
+
+def get_dict(data_file=None, word_dict_file=None, verb_dict_file=None,
+             target_dict_file=None):
+    ds = _dataset(data_file, word_dict_file, verb_dict_file,
+                  target_dict_file)
+    return ds.get_dict()
+
+
+def get_embedding(emb_file=None):
+    """Load the pretrained word-embedding table (one vector per line)."""
+    emb_file = emb_file or os.path.join(DATA_HOME, "conll05st",
+                                        "emb.txt")
+    if not os.path.exists(emb_file):
+        raise FileNotFoundError(
+            f"{emb_file} not found (zero-egress environment)")
+    return np.loadtxt(emb_file, dtype=np.float32)

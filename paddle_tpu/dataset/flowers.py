@@ -1,0 +1,53 @@
+"""paddle.dataset.flowers readers (reference python/paddle/dataset/
+flowers.py)."""
+from __future__ import annotations
+
+import os
+
+from .common import DATA_HOME
+from ..vision.datasets import Flowers as _Flowers
+
+__all__ = ["train", "test", "valid"]
+
+
+def _reader_creator(mode, data_file=None, label_file=None,
+                    setid_file=None, mapper=None, cycle=False):
+    def reader():
+        base = os.path.join(DATA_HOME, "flowers")
+        ds = _Flowers(
+            data_file or os.path.join(base, "102flowers.tgz"),
+            label_file or os.path.join(base, "imagelabels.mat"),
+            setid_file or os.path.join(base, "setid.mat"), mode=mode)
+        while True:
+            for i in range(len(ds)):
+                img, label = ds[i]
+                if mapper is not None:
+                    img = mapper(img)
+                # labels stay 1-based like the reference reader
+                yield img, int(label[0])
+            if not cycle:
+                break
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          data_file=None, label_file=None, setid_file=None):
+    """buffered_size/use_xmap are accepted for signature parity; the
+    PIL decode here is cheap enough that the thread tiers are not
+    wired (wrap with paddle_tpu.reader.xmap_readers for parallel
+    mappers)."""
+    return _reader_creator("train", data_file, label_file, setid_file,
+                           mapper, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+         data_file=None, label_file=None, setid_file=None):
+    return _reader_creator("test", data_file, label_file, setid_file,
+                           mapper, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True,
+          data_file=None, label_file=None, setid_file=None):
+    return _reader_creator("valid", data_file, label_file, setid_file,
+                           mapper)

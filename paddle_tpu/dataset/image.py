@@ -1,0 +1,83 @@
+"""paddle.dataset.image helpers (reference python/paddle/dataset/
+image.py — cv2-based; here PIL/numpy): resize/crop/flip/transform for
+reader pipelines.  Images are HWC uint8/float ndarrays; output of
+simple_transform is CHW float32 like the reference."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image", "load_image_bytes", "resize_short",
+           "center_crop", "random_crop", "left_right_flip",
+           "to_chw", "simple_transform"]
+
+
+def load_image(path, is_color=True):
+    from PIL import Image
+    img = Image.open(path)
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.array(img)
+    return arr if is_color else arr[:, :, None]
+
+
+def load_image_bytes(data, is_color=True):
+    import io
+    from PIL import Image
+    img = Image.open(io.BytesIO(data))
+    img = img.convert("RGB" if is_color else "L")
+    arr = np.array(img)
+    return arr if is_color else arr[:, :, None]
+
+
+def resize_short(im, size):
+    """Scale so the SHORT side equals size (aspect preserved)."""
+    from PIL import Image
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    squeeze = im.ndim == 3 and im.shape[2] == 1
+    pil = Image.fromarray(im[:, :, 0] if squeeze else im)
+    out = np.array(pil.resize((nw, nh), Image.BILINEAR))
+    return out[:, :, None] if squeeze else out
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return im[top:top + size, left:left + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    top = rng.randint(0, h - size + 1)
+    left = rng.randint(0, w - size + 1)
+    return im[top:top + size, left:left + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def simple_transform(im, resize_size, crop_size, is_train,
+                     is_color=True, mean=None):
+    """resize_short -> (random crop + flip | center crop) -> CHW float32
+    -> optional mean subtraction (reference image.py simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2):
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        im -= mean if mean.ndim != 1 else mean[:, None, None]
+    return im

@@ -1,0 +1,79 @@
+"""paddle.dataset.imikolov readers (reference python/paddle/dataset/
+imikolov.py): PTB n-gram / seq samples under a caller-provided word
+dict."""
+from __future__ import annotations
+
+import os
+import tarfile
+
+from .common import DATA_HOME
+
+__all__ = ["build_dict", "train", "test", "DataType"]
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def _archive(data_file=None):
+    path = data_file or os.path.join(DATA_HOME, "imikolov",
+                                     "simple-examples.tgz")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} not found (zero-egress environment — place the "
+            f"standard simple-examples tarball there)")
+    return path
+
+
+def build_dict(min_word_freq=50, data_file=None):
+    """Counts over train+valid with <s>/<e> per line, <unk> forced last
+    (reference imikolov.py:54)."""
+    from collections import Counter
+    freq = Counter()
+    with tarfile.open(_archive(data_file), "r:*") as tf:
+        for split in ("train", "valid"):
+            member = f"./simple-examples/data/ptb.{split}.txt"
+            for raw in tf.extractfile(member):
+                freq.update(raw.decode("utf-8").strip().split())
+                freq.update(("<s>", "<e>"))
+    freq.pop("<unk>", None)
+    items = sorted(((w, c) for w, c in freq.items()
+                    if c > min_word_freq), key=lambda t: (-t[1], t[0]))
+    word_idx = {w: i for i, (w, _) in enumerate(items)}
+    word_idx["<unk>"] = len(word_idx)
+    return word_idx
+
+
+def _reader_creator(split, word_idx, n, data_type, data_file=None):
+    def reader():
+        unk = word_idx["<unk>"]
+        with tarfile.open(_archive(data_file), "r:*") as tf:
+            member = f"./simple-examples/data/ptb.{split}.txt"
+            for raw in tf.extractfile(member):
+                toks = raw.decode("utf-8").strip().split()
+                if data_type == DataType.NGRAM:
+                    assert n > 0, "Invalid gram length"
+                    framed = ["<s>"] + toks + ["<e>"]
+                    if len(framed) < n:
+                        continue
+                    ids = [word_idx.get(w, unk) for w in framed]
+                    for i in range(n, len(ids) + 1):
+                        yield tuple(ids[i - n:i])
+                elif data_type == DataType.SEQ:
+                    ids = [word_idx.get(w, unk) for w in toks]
+                    src = [word_idx["<s>"]] + ids
+                    trg = ids + [word_idx["<e>"]]
+                    yield src, trg
+                else:
+                    raise ValueError(f"unknown data type {data_type}")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM, data_file=None):
+    return _reader_creator("train", word_idx, n, data_type, data_file)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM, data_file=None):
+    return _reader_creator("test", word_idx, n, data_type, data_file)

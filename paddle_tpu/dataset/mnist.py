@@ -1,0 +1,29 @@
+"""paddle.dataset.mnist readers (reference python/paddle/dataset/
+mnist.py): samples are (784 float32 pixels scaled to [-1, 1], int
+label)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.datasets import MNIST
+
+__all__ = ["train", "test"]
+
+
+def _reader_creator(mode):
+    def reader():
+        ds = MNIST(mode=mode)
+        images = ds.images.reshape(len(ds), -1).astype(np.float32)
+        images = images / 255.0 * 2.0 - 1.0
+        for img, label in zip(images, ds.labels):
+            yield img, int(label)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")

@@ -1,0 +1,82 @@
+"""paddle.dataset.movielens readers + meta helpers (reference
+python/paddle/dataset/movielens.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+from ..text.datasets import Movielens as _Movielens, _AGE_TABLE
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "movie_categories", "age_table",
+           "user_info", "movie_info"]
+
+age_table = list(_AGE_TABLE)
+
+_meta = {}
+
+
+def _dataset(mode="train", data_file=None):
+    data_file = data_file or os.path.join(DATA_HOME, "movielens",
+                                          "ml-1m.zip")
+    return _Movielens(data_file, mode=mode)
+
+
+def _get_meta(data_file=None):
+    # cache keyed by the resolved archive path: a second call with a
+    # DIFFERENT data_file must not silently reuse the first archive
+    key = data_file or os.path.join(DATA_HOME, "movielens", "ml-1m.zip")
+    if key not in _meta:
+        _meta[key] = _dataset("train", data_file)
+    return _meta[key]
+
+
+def train(data_file=None):
+    def reader():
+        for i in range(len(_get_meta(data_file).data)):
+            yield tuple(np.array(d)
+                        for d in _get_meta(data_file).data[i])
+
+    return reader
+
+
+def test(data_file=None):
+    ds = [None]
+
+    def reader():
+        if ds[0] is None:
+            ds[0] = _dataset("test", data_file)
+        for i in range(len(ds[0])):
+            yield ds[0][i]
+
+    return reader
+
+
+def get_movie_title_dict(data_file=None):
+    return _get_meta(data_file).movie_title_dict
+
+
+def movie_categories(data_file=None):
+    return _get_meta(data_file).categories_dict
+
+
+def max_movie_id(data_file=None):
+    return max(_get_meta(data_file).movie_info)
+
+
+def max_user_id(data_file=None):
+    return max(_get_meta(data_file).user_info)
+
+
+def max_job_id(data_file=None):
+    return max(u.job_id for u in _get_meta(data_file).user_info.values())
+
+
+def movie_info(data_file=None):
+    return _get_meta(data_file).movie_info
+
+
+def user_info(data_file=None):
+    return _get_meta(data_file).user_info

@@ -1,0 +1,68 @@
+"""paddle.dataset.mq2007 readers (reference python/paddle/dataset/
+mq2007.py): LETOR 4.0 learning-to-rank lines
+`<rel> qid:<q> 1:<f1> 2:<f2> ... #docid = ...` grouped per query;
+pointwise / pairwise / listwise sample formats."""
+from __future__ import annotations
+
+import itertools
+import os
+
+import numpy as np
+
+from .common import DATA_HOME
+
+__all__ = ["train", "test"]
+
+
+def _parse(path):
+    """-> {qid: [(rel, feature_vector), ...]} preserving file order."""
+    queries = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = int(parts[0])
+            assert parts[1].startswith("qid:"), parts[1]
+            qid = parts[1][4:]
+            feats = [float(p.split(":")[1]) for p in parts[2:]]
+            queries.setdefault(qid, []).append(
+                (rel, np.asarray(feats, np.float32)))
+    return queries
+
+
+def _reader_creator(path, fmt):
+    def reader():
+        queries = _parse(path)
+        for qid, docs in queries.items():
+            if fmt == "pointwise":
+                for rel, vec in docs:
+                    yield vec, rel
+            elif fmt == "pairwise":
+                for (r1, v1), (r2, v2) in itertools.combinations(docs, 2):
+                    if r1 == r2:
+                        continue
+                    if r1 > r2:
+                        yield 1, v1, v2
+                    else:
+                        yield 1, v2, v1
+            elif fmt == "listwise":
+                yield [r for r, _ in docs], [v for _, v in docs]
+            else:
+                raise ValueError(f"unknown format {fmt!r}")
+
+    return reader
+
+
+def _path(split, data_file):
+    return data_file or os.path.join(DATA_HOME, "MQ2007", "MQ2007",
+                                     "Fold1", f"{split}.txt")
+
+
+def train(format="pairwise", data_file=None):
+    return _reader_creator(_path("train", data_file), format)
+
+
+def test(format="pairwise", data_file=None):
+    return _reader_creator(_path("test", data_file), format)

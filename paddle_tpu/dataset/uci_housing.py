@@ -1,0 +1,24 @@
+"""paddle.dataset.uci_housing readers (reference python/paddle/dataset/
+uci_housing.py): (13 normalized float features, 1 float target)."""
+from __future__ import annotations
+
+from ..text.datasets import UCIHousing
+
+__all__ = ["train", "test"]
+
+
+def _reader_creator(mode):
+    def reader():
+        ds = UCIHousing(mode=mode)
+        for x, y in zip(ds.x, ds.y):
+            yield x, y
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")

@@ -1,0 +1,28 @@
+"""paddle.dataset.voc2012 readers (reference python/paddle/dataset/
+voc2012.py)."""
+from __future__ import annotations
+
+from ..vision.datasets import VOC2012 as _VOC2012
+
+__all__ = ["train", "test", "val"]
+
+
+def _reader_creator(mode, data_file=None):
+    def reader():
+        ds = _VOC2012(data_file, mode=mode)
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader
+
+
+def train(data_file=None):
+    return _reader_creator("train", data_file)
+
+
+def test(data_file=None):
+    return _reader_creator("test", data_file)
+
+
+def val(data_file=None):
+    return _reader_creator("valid", data_file)

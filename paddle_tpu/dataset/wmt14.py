@@ -1,0 +1,42 @@
+"""paddle.dataset.wmt14 readers (reference python/paddle/dataset/
+wmt14.py)."""
+from __future__ import annotations
+
+import os
+
+from .common import DATA_HOME
+from ..text.datasets import WMT14 as _WMT14
+
+__all__ = ["train", "test", "gen", "get_dict"]
+
+
+def _path(data_file):
+    return data_file or os.path.join(DATA_HOME, "wmt14", "wmt14.tgz")
+
+
+def _reader_creator(mode, dict_size, data_file=None):
+    def reader():
+        ds = _WMT14(_path(data_file), mode=mode, dict_size=dict_size)
+        for i in range(len(ds)):
+            src, trg, nxt = ds.src_ids[i], ds.trg_ids[i], \
+                ds.trg_ids_next[i]
+            yield src, trg, nxt
+
+    return reader
+
+
+def train(dict_size, data_file=None):
+    return _reader_creator("train", dict_size, data_file)
+
+
+def test(dict_size, data_file=None):
+    return _reader_creator("test", dict_size, data_file)
+
+
+def gen(dict_size, data_file=None):
+    return _reader_creator("gen", dict_size, data_file)
+
+
+def get_dict(dict_size, reverse=True, data_file=None):
+    ds = _WMT14(_path(data_file), mode="train", dict_size=dict_size)
+    return ds.get_dict(reverse=reverse)

@@ -1,0 +1,48 @@
+"""paddle.dataset.wmt16 readers (reference python/paddle/dataset/
+wmt16.py)."""
+from __future__ import annotations
+
+import os
+
+from .common import DATA_HOME
+from ..text.datasets import WMT16 as _WMT16
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def _path(data_file):
+    return data_file or os.path.join(DATA_HOME, "wmt16", "wmt16.tar.gz")
+
+
+def _reader_creator(mode, src_dict_size, trg_dict_size, src_lang,
+                    data_file=None):
+    def reader():
+        ds = _WMT16(_path(data_file), mode=mode,
+                    src_dict_size=src_dict_size,
+                    trg_dict_size=trg_dict_size, lang=src_lang)
+        for i in range(len(ds)):
+            yield ds.src_ids[i], ds.trg_ids[i], ds.trg_ids_next[i]
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en", data_file=None):
+    return _reader_creator("train", src_dict_size, trg_dict_size,
+                           src_lang, data_file)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en", data_file=None):
+    return _reader_creator("test", src_dict_size, trg_dict_size,
+                           src_lang, data_file)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en",
+               data_file=None):
+    return _reader_creator("val", src_dict_size, trg_dict_size, src_lang,
+                           data_file)
+
+
+def get_dict(lang, dict_size, reverse=False, data_file=None):
+    ds = _WMT16(_path(data_file), mode="train", src_dict_size=dict_size,
+                trg_dict_size=dict_size, lang="en")
+    return ds.get_dict(lang, reverse=reverse)

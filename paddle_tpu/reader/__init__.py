@@ -1,0 +1,219 @@
+"""paddle.reader — reader-creator decorators (reference
+python/paddle/reader/decorator.py).
+
+A *reader* is a zero-arg callable returning an iterable of samples; a
+*reader creator* builds readers.  These combinators are the fluid-era
+input pipeline (`paddle.batch(paddle.reader.shuffle(mnist.train(),
+500), 64)`); the 2.0 path is paddle_tpu.io.DataLoader.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random
+import threading
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "xmap_readers", "cache", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """Reader whose samples are func applied across the given readers'
+    samples (decorator.py:91)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a buf_size window, yield it shuffled
+    (decorator.py:133)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back (decorator.py:182)."""
+
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples: (a, (b, c)) -> (a, b, c)
+    (decorator.py:247).  check_alignment=True raises ComposeNotAligned
+    when the readers run out at different lengths."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned")
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Producer-thread read-ahead of up to `size` samples
+    (decorator.py:307)."""
+
+    end = object()
+
+    def data_reader():
+        q = _queue.Queue(maxsize=size)
+        err = []
+
+        def produce():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+        if err:
+            # a swallowed producer error would look like a short-but-
+            # successful epoch — propagate it instead
+            raise err[0]
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples only (decorator.py:366)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads
+    (decorator.py:411).  order=True preserves input order."""
+
+    end = object()
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        errs = []
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        break
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errs.append(e)
+            finally:
+                # the sentinel must go out even when the mapper raised,
+                # or the consumer loop below waits forever
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+        else:
+            pending = {}
+            next_i = 0
+            while finished < process_num or pending:
+                if next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+                    continue
+                if finished == process_num:
+                    break  # remaining pending have a gap: error upstream
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                pending[item[0]] = item[1]
+        if errs:
+            raise errs[0]
+
+    return data_reader
+
+
+def cache(reader):
+    """Materialize the reader's samples once; replay from memory
+    (decorator.py:55)."""
+    all_data = None
+
+    def cache_reader():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        for d in all_data:
+            yield d
+
+    return cache_reader

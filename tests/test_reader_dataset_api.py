@@ -1,0 +1,181 @@
+"""Fluid-era data pipeline parity: paddle.reader decorators,
+paddle.batch, and the paddle.dataset reader-creator modules (reference
+python/paddle/reader/decorator.py, batch.py, dataset/)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import reader as rdr
+from paddle_tpu import batch
+
+
+def _range_reader(n):
+    def reader():
+        for i in range(n):
+            yield i
+
+    return reader
+
+
+def test_map_shuffle_chain_compose():
+    doubled = rdr.map_readers(lambda x: 2 * x, _range_reader(5))
+    assert list(doubled()) == [0, 2, 4, 6, 8]
+
+    import random
+    random.seed(0)
+    shuffled = list(rdr.shuffle(_range_reader(10), 4)())
+    assert sorted(shuffled) == list(range(10)) and shuffled != list(
+        range(10))
+
+    chained = rdr.chain(_range_reader(2), _range_reader(3))
+    assert list(chained()) == [0, 1, 0, 1, 2]
+
+    composed = rdr.compose(_range_reader(3),
+                           rdr.map_readers(lambda x: (x, x * x),
+                                           _range_reader(3)))
+    assert list(composed()) == [(0, 0, 0), (1, 1, 1), (2, 2, 4)]
+    with pytest.raises(rdr.ComposeNotAligned):
+        list(rdr.compose(_range_reader(2), _range_reader(3))())
+
+
+def test_buffered_firstn_cache_xmap():
+    assert list(rdr.buffered(_range_reader(7), 3)()) == list(range(7))
+    assert list(rdr.firstn(_range_reader(100), 4)()) == [0, 1, 2, 3]
+
+    calls = []
+
+    def counting_reader():
+        calls.append(1)
+        return iter(range(3))
+
+    cached = rdr.cache(counting_reader)
+    assert list(cached()) == [0, 1, 2]
+    assert list(cached()) == [0, 1, 2]
+    assert calls == [1]  # source consumed exactly once
+
+    mapped = sorted(rdr.xmap_readers(lambda x: x + 10, _range_reader(20),
+                                     process_num=3, buffer_size=4)())
+    assert mapped == [x + 10 for x in range(20)]
+    ordered = list(rdr.xmap_readers(lambda x: x * 3, _range_reader(20),
+                                    process_num=3, buffer_size=4,
+                                    order=True)())
+    assert ordered == [x * 3 for x in range(20)]
+
+
+def test_reader_errors_propagate():
+    def bad_reader():
+        yield 1
+        raise RuntimeError("corrupt sample")
+
+    with pytest.raises(RuntimeError, match="corrupt sample"):
+        list(rdr.buffered(bad_reader, 2)())
+
+    def bad_mapper(x):
+        if x == 5:
+            raise ValueError("mapper blew up")
+        return x
+
+    with pytest.raises(ValueError, match="mapper blew up"):
+        list(rdr.xmap_readers(bad_mapper, _range_reader(10), 2, 4)())
+    with pytest.raises(ValueError, match="mapper blew up"):
+        list(rdr.xmap_readers(bad_mapper, _range_reader(10), 2, 4,
+                              order=True)())
+
+
+def test_batch():
+    b = batch(_range_reader(7), 3)
+    assert list(b()) == [[0, 1, 2], [3, 4, 5], [6]]
+    b2 = batch(_range_reader(7), 3, drop_last=True)
+    assert list(b2()) == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        batch(_range_reader(3), 0)
+
+
+def _write_idx_mnist(tmp_path, n=8):
+    imgs = np.arange(n * 28 * 28, dtype=np.uint8).reshape(n, 28, 28)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    ip = os.path.join(tmp_path, "train-images-idx3-ubyte.gz")
+    lp = os.path.join(tmp_path, "train-labels-idx1-ubyte.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp
+
+
+def test_dataset_mnist_reader(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import mnist
+    ip, lp = _write_idx_mnist(str(tmp_path))
+    # point DATA_HOME's mnist dir at the fixture
+    import paddle_tpu.vision.datasets as vd
+    monkeypatch.setattr(vd, "DATA_HOME", str(tmp_path))
+    os.makedirs(os.path.join(str(tmp_path), "mnist"), exist_ok=True)
+    os.rename(ip, os.path.join(str(tmp_path), "mnist",
+                               "train-images-idx3-ubyte.gz"))
+    os.rename(lp, os.path.join(str(tmp_path), "mnist",
+                               "train-labels-idx1-ubyte.gz"))
+    samples = list(mnist.train()())
+    assert len(samples) == 8
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert img.min() >= -1.0 and img.max() <= 1.0
+    assert isinstance(label, int)
+
+    # the canonical composed pipeline
+    pipeline = paddle_tpu.batch(rdr.shuffle(mnist.train(), 4), 3)
+    batches = list(pipeline())
+    assert sum(len(b) for b in batches) == 8
+
+
+def test_dataset_common_split_and_cluster(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+    monkeypatch.chdir(tmp_path)
+    common.split(_range_reader(10), 4,
+                 suffix=str(tmp_path / "chunk-%05d.pickle"))
+    import glob
+    files = sorted(glob.glob(str(tmp_path / "chunk-*.pickle")))
+    assert len(files) >= 2
+    r0 = common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), trainer_count=2, trainer_id=0)
+    r1 = common.cluster_files_reader(
+        str(tmp_path / "chunk-*.pickle"), trainer_count=2, trainer_id=1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
+
+
+def test_dataset_mq2007(tmp_path):
+    letor = (
+        "2 qid:1 1:0.1 2:0.2 #docid=a\n"
+        "0 qid:1 1:0.3 2:0.1 #docid=b\n"
+        "1 qid:2 1:0.5 2:0.5 #docid=c\n"
+    )
+    p = tmp_path / "train.txt"
+    p.write_text(letor)
+    from paddle_tpu.dataset import mq2007
+    points = list(mq2007.train(format="pointwise",
+                               data_file=str(p))())
+    assert len(points) == 3 and points[0][1] == 2
+    pairs = list(mq2007.train(format="pairwise", data_file=str(p))())
+    # only qid:1 has a comparable pair (rel 2 vs 0)
+    assert len(pairs) == 1
+    one, hi, lo = pairs[0]
+    np.testing.assert_allclose(hi, [0.1, 0.2])
+    lists = list(mq2007.train(format="listwise", data_file=str(p))())
+    assert len(lists) == 2 and lists[0][0] == [2, 0]
+
+
+def test_dataset_image_transform():
+    from paddle_tpu.dataset import image as img
+    im = np.random.RandomState(0).randint(
+        0, 255, (32, 48, 3), np.uint8)
+    r = img.resize_short(im, 16)
+    assert min(r.shape[:2]) == 16 and r.shape[1] > r.shape[0]
+    c = img.center_crop(r, 16)
+    assert c.shape[:2] == (16, 16)
+    out = img.simple_transform(im, 24, 16, is_train=False)
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
