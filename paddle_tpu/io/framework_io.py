@@ -274,6 +274,26 @@ def set_program_state(program, state):
             scope.set(name, jnp.asarray(val))
 
 
+def load_program_state(model_path, var_list=None):
+    """Read saved program state back as a {name: ndarray} dict
+    (reference fluid/io.py load_program_state); pair with
+    set_program_state."""
+    state = {}
+    for suffix in (".pdparams", ".pdopt"):
+        p = model_path + suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                state.update(pickle.load(f))
+    if not state:
+        raise FileNotFoundError(
+            f"no saved state at {model_path}(.pdparams/.pdopt)")
+    if var_list is not None:
+        wanted = {v.name if hasattr(v, "name") else str(v)
+                  for v in var_list}
+        state = {k: v for k, v in state.items() if k in wanted}
+    return state
+
+
 def static_load(program, path_prefix, executor=None):
     for suffix in (".pdparams", ".pdopt"):
         p = path_prefix + suffix

@@ -6,3 +6,28 @@ def accuracy(input, label, k=1):
     """Functional top-k accuracy over a batch (metric_op.py accuracy)."""
     m = Accuracy(topk=(k,))
     return m.update(m.compute(input, label))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095):
+    """Functional AUC (reference paddle.metric.auc -> fluid
+    layers.auc); static-graph layer when called under a program guard."""
+    from ..static import layers
+    return layers.auc(input, label, curve=curve,
+                      num_thresholds=num_thresholds)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Functional chunk evaluation (reference paddle.metric.chunk_eval
+    -> fluid layers.chunk_eval)."""
+    from ..static import layers
+    return layers.chunk_eval(input, label, chunk_scheme, num_chunk_types,
+                             excluded_chunk_types=excluded_chunk_types,
+                             seq_length=seq_length)
+
+
+def mean_iou(input, label, num_classes):
+    """Functional mean-IoU (reference paddle.metric.mean_iou -> fluid
+    layers.mean_iou)."""
+    from ..static import layers
+    return layers.mean_iou(input, label, num_classes)

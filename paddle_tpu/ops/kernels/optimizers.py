@@ -333,7 +333,14 @@ def dpsgd(ins, attrs, ctx):
                       "out_num_updates"],
              grad=None, side_effect=True)
 def average_accumulates(ins, attrs, ctx):
-    # ModelAverage support op (reference optimizers/average_accumulates_op)
+    """ModelAverage support op — EXACT reference semantics
+    (operators/average_accumulates_op.h:84): each step sum_1 += param;
+    every kMaxNumAccumulates updates sum_1 spills into sum_2
+    (precision shuffle); when the window completes, sum_3 is REPLACED
+    by sum_1+sum_2, both are cleared, and the window count moves to
+    old_num_accumulates.  apply-time average is
+    (s1+s2+s3)/(num_accumulates+old_num_accumulates)."""
+    k_max_num_accumulates = 16384
     p = ins["param"]
     s1, s2, s3 = ins["in_sum_1"], ins["in_sum_2"], ins["in_sum_3"]
     na = ins["in_num_accumulates"].reshape(())
@@ -345,15 +352,19 @@ def average_accumulates(ins, attrs, ctx):
     na = na + 1
     nu = nu + 1
     s1 = s1 + p
+    spill = (nu % k_max_num_accumulates) == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
     window_full = (na >= min_avg) & (na >= jnp.minimum(
         max_avg, nu * avg_window))
-    s2_new = jnp.where(window_full, s2 + s1, s2)
+    s3_new = jnp.where(window_full, s1 + s2, s3)
+    s2_new = jnp.where(window_full, jnp.zeros_like(s2), s2)
     s1_new = jnp.where(window_full, jnp.zeros_like(s1), s1)
     ona_new = jnp.where(window_full, na, ona)
     na_new = jnp.where(window_full, jnp.zeros_like(na), na)
-    # roll s2->s3 when it grows too old
-    return {"out_sum_1": s1_new, "out_sum_2": s2_new, "out_sum_3": s3,
-            "out_num_accumulates": na_new.reshape(ins["in_num_accumulates"].shape),
+    return {"out_sum_1": s1_new, "out_sum_2": s2_new, "out_sum_3": s3_new,
+            "out_num_accumulates": na_new.reshape(
+                ins["in_num_accumulates"].shape),
             "out_old_num_accumulates": ona_new.reshape(
                 ins["in_old_num_accumulates"].shape),
             "out_num_updates": nu.reshape(ins["in_num_updates"].shape)}

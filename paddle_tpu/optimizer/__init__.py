@@ -13,3 +13,21 @@ from ..static.optimizer import (  # noqa: F401
     GradientClipByNorm as ClipGradByNorm,
     GradientClipByGlobalNorm as ClipGradByGlobalNorm,
 )
+
+# fluid-style names re-exported for the reference optimizer namespace
+from ..static.optimizer import (  # noqa: F401
+    SGDOptimizer, MomentumOptimizer, AdamOptimizer, AdamaxOptimizer,
+    AdagradOptimizer, AdadeltaOptimizer, RMSPropOptimizer, FtrlOptimizer,
+    DecayedAdagradOptimizer, DpsgdOptimizer, LambOptimizer,
+    ExponentialMovingAverage, ModelAverage, LookaheadOptimizer,
+)
+from ..static.optimizer import FtrlOptimizer as Ftrl  # noqa: F401
+from ..static.optimizer import DpsgdOptimizer as Dpsgd  # noqa: F401
+from ..static.optimizer import (  # noqa: F401
+    DecayedAdagradOptimizer as DecayedAdagrad,
+)
+from .lr_scheduler import (  # noqa: F401
+    NoamLR, PiecewiseLR, NaturalExpLR, InverseTimeLR, PolynomialLR,
+    LinearLrWarmup, ExponentialLR, MultiStepLR, StepLR, LambdaLR,
+    ReduceLROnPlateau, CosineAnnealingLR,
+)

@@ -254,3 +254,24 @@ class ReduceOnPlateau(LRScheduler):
         if self.threshold_mode == "rel":
             return abs(self.best) * self.threshold
         return self.threshold
+
+
+# 2.0-alpha "LR"-suffix aliases (reference python/paddle/optimizer/
+# __init__.py exports both spellings; the Decay names are canonical)
+NoamLR = NoamDecay
+PiecewiseLR = PiecewiseDecay
+NaturalExpLR = NaturalExpDecay
+InverseTimeLR = InverseTimeDecay
+PolynomialLR = PolynomialDecay
+LinearLrWarmup = LinearWarmup
+ExponentialLR = ExponentialDecay
+MultiStepLR = MultiStepDecay
+StepLR = StepDecay
+LambdaLR = LambdaDecay
+ReduceLROnPlateau = ReduceOnPlateau
+CosineAnnealingLR = CosineAnnealingDecay
+
+__all__ += ["NoamLR", "PiecewiseLR", "NaturalExpLR", "InverseTimeLR",
+            "PolynomialLR", "LinearLrWarmup", "ExponentialLR",
+            "MultiStepLR", "StepLR", "LambdaLR", "ReduceLROnPlateau",
+            "CosineAnnealingLR"]

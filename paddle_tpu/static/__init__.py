@@ -31,15 +31,27 @@ from .optimizer import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Ftrl,
     Lamb, ExponentialMovingAverage, L1Decay, L2Decay, GradientClipByValue,
     GradientClipByNorm, GradientClipByGlobalNorm,
+    SGDOptimizer, MomentumOptimizer, AdamOptimizer, AdamaxOptimizer,
+    AdagradOptimizer, AdadeltaOptimizer, RMSPropOptimizer, FtrlOptimizer,
+    DecayedAdagradOptimizer, DpsgdOptimizer, LambOptimizer,
+    LarsMomentumOptimizer, ModelAverage, LookaheadOptimizer,
+    RecomputeOptimizer,
 )
+from .optimizer import DecayedAdagradOptimizer as DecayedAdagrad  # noqa: F401
+from .optimizer import DpsgdOptimizer as Dpsgd  # noqa: F401
+from .layers import Print, py_func  # noqa: F401
+from ..jit import InputSpec  # noqa: F401
 
 from ..io.framework_io import (  # noqa: F401
     save_vars, save_params, save_persistables, load_vars, load_params,
     load_persistables, save_inference_model, load_inference_model,
-    set_program_state,
+    set_program_state, load_program_state,
 )
 from ..io.framework_io import static_save as save  # noqa: F401
 from ..io.framework_io import static_load as load  # noqa: F401
 from ..distributed.compiled_program import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,
 )
+# fluid alias: ParallelExecutor's role (multi-device execution of one
+# program) is CompiledProgram.with_data_parallel here
+ParallelExecutor = CompiledProgram

@@ -1153,6 +1153,40 @@ def argsort(input, axis=-1, descending=False, name=None):
     return out, ids
 
 
+def mean_iou(input, label, num_classes):
+    """layers/nn.py mean_iou: mean intersection-over-union over classes;
+    returns (mean_iou, out_wrong, out_correct)."""
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    miou.shape = (1,)
+    wrong.shape = (num_classes,)
+    correct.shape = (num_classes,)
+    helper.append_op("mean_iou",
+                     inputs={"Predictions": input, "Labels": label},
+                     outputs={"OutMeanIou": miou, "OutWrong": wrong,
+                              "OutCorrect": correct},
+                     attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """layers/control_flow.py Print: runtime tensor print that survives
+    jit (lowers to the print op / jax.debug.print)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = tuple(input.shape) if input.shape is not None else None
+    helper.append_op("print", inputs={"In": input},
+                     outputs={"Out": out},
+                     attrs={"message": message or "",
+                            "first_n": first_n, "summarize": summarize})
+    return out
+
+
 def accuracy(input, label, k=1, correct=None, total=None):
     """layers/metric_op.py accuracy: top-k accuracy of predictions."""
     helper = LayerHelper("accuracy")
@@ -2549,6 +2583,7 @@ from .layer_generator import generate_layer_fns as _generate_layer_fns  # noqa: 
 
 _GENERATED_LAYERS = _generate_layer_fns(globals(), dir())
 __all__ += _GENERATED_LAYERS
+__all__ += ["mean_iou", "Print"]
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,

@@ -573,6 +573,164 @@ class ExponentialMovingAverage:
             scope.set(name, v)
 
 
+class ModelAverage:
+    """Accumulated parameter averaging (fluid optimizer.py ModelAverage,
+    backed by the average_accumulates op): train-time ops maintain
+    windowed parameter sums; apply()/restore() swap the averaged
+    parameters in for evaluation."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._window_rate = float(average_window_rate)
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        self._name = name or "model_average"
+        self._accs: List[Tuple[VarDesc, Dict[str, VarDesc]]] = []
+        program = default_main_program()
+        helper = LayerHelper(self._name)
+        block = program.global_block()
+        with program._op_role_guard(OpRole.Optimize):
+            for p in program.all_parameters():
+                if not p.trainable:
+                    continue
+                acc = {}
+                for key, shape, dtype in (
+                        ("sum_1", p.shape, p.dtype),
+                        ("sum_2", p.shape, p.dtype),
+                        ("sum_3", p.shape, p.dtype),
+                        ("num_accumulates", (1,), "int64"),
+                        ("old_num_accumulates", (1,), "int64"),
+                        ("num_updates", (1,), "int64")):
+                    v = block.create_var(
+                        name=unique_name(f"{p.name}_avg_{key}"),
+                        shape=shape, dtype=dtype, persistable=True,
+                        stop_gradient=True)
+                    Constant(0.0)(v, helper.startup_program.global_block())
+                    acc[key] = v
+                helper.append_op(
+                    "average_accumulates",
+                    inputs={"param": p, "in_sum_1": acc["sum_1"],
+                            "in_sum_2": acc["sum_2"],
+                            "in_sum_3": acc["sum_3"],
+                            "in_num_accumulates": acc["num_accumulates"],
+                            "in_old_num_accumulates":
+                                acc["old_num_accumulates"],
+                            "in_num_updates": acc["num_updates"]},
+                    outputs={"out_sum_1": acc["sum_1"],
+                             "out_sum_2": acc["sum_2"],
+                             "out_sum_3": acc["sum_3"],
+                             "out_num_accumulates":
+                                 acc["num_accumulates"],
+                             "out_old_num_accumulates":
+                                 acc["old_num_accumulates"],
+                             "out_num_updates": acc["num_updates"]},
+                    attrs={"average_window": self._window_rate,
+                           "min_average_window": self._min_window,
+                           "max_average_window": self._max_window})
+                self._accs.append((p, acc))
+
+    def apply(self, executor=None, need_restore=True):
+        import numpy as np
+        from .executor import global_scope
+        scope = global_scope()
+        self._backup = {}
+        for p, acc in self._accs:
+            vals = {k: np.asarray(scope.get(v.name))
+                    for k, v in acc.items() if scope.get(v.name) is not None}
+            if "sum_1" not in vals:
+                continue
+            total = (vals["sum_1"] + vals.get("sum_2", 0)
+                     + vals.get("sum_3", 0))
+            count = float(vals.get("num_accumulates", np.ones(1))[0]
+                          + vals.get("old_num_accumulates",
+                                     np.zeros(1))[0])
+            if count <= 0:
+                continue
+            self._backup[p.name] = scope.get(p.name)
+            scope.set(p.name, (total / count).astype(total.dtype))
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+        scope = global_scope()
+        for name, v in getattr(self, "_backup", {}).items():
+            scope.set(name, v)
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (fluid optimizer.py LookaheadOptimizer,
+    arXiv:1907.08610): the inner optimizer advances fast weights every
+    step; every k steps the slow copies move alpha toward the fast
+    weights and the fast weights reset to them.  The k-periodic sync is
+    expressed with mask arithmetic (cond-free, XLA-friendly):
+    slow' = slow + m*alpha*(fast-slow); fast' = m*slow' + (1-m)*fast."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from . import layers
+        result = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        program = default_main_program()
+        helper = LayerHelper("lookahead")
+        block = program.global_block()
+        startup = helper.startup_program.global_block()
+        with program._op_role_guard(OpRole.Optimize):
+            # int64 counter: a float32 step would stop counting at 2^24
+            # (16.8M steps) and freeze the periodic sync forever
+            step = block.create_var(name=unique_name("lookahead_step"),
+                                    shape=(1,), dtype="int64",
+                                    persistable=True, stop_gradient=True)
+            Constant(0.0)(step, startup)
+            helper.append_op("increment", inputs={"X": step},
+                             outputs={"Out": step},
+                             attrs={"step": 1.0})
+            ki = layers.fill_constant((1,), "int64", self.k)
+            mod = layers.elementwise_mod(step, ki)
+            mask = layers.cast(
+                layers.equal(mod, layers.fill_constant((1,), "int64", 0)),
+                "float32")
+            for p in program.all_parameters():
+                if not p.trainable:
+                    continue
+                slow = block.create_var(
+                    name=unique_name(f"{p.name}_slow"), shape=p.shape,
+                    dtype=p.dtype, persistable=True, stop_gradient=True)
+                # slow weights start AT the initial fast weights: declare
+                # the var in the startup block too (the startup run only
+                # persists vars the startup program itself declares)
+                startup.create_var(name=slow.name, shape=p.shape,
+                                   dtype=p.dtype, persistable=True,
+                                   stop_gradient=True)
+                # scale(1.0) rather than assign: assign would ALIAS the
+                # param's buffer in the scope and the jitted step donates
+                # state buffers — the same buffer donated twice is an
+                # XLA execution error
+                startup.append_op("scale", inputs={"X": [p.name]},
+                                  outputs={"Out": [slow.name]},
+                                  attrs={"scale": 1.0, "bias": 0.0})
+                diff = layers.elementwise_sub(p, slow)
+                new_slow = layers.elementwise_add(
+                    slow, layers.elementwise_mul(
+                        layers.scale(diff, scale=self.alpha), mask))
+                new_fast = layers.elementwise_add(
+                    layers.elementwise_mul(new_slow, mask),
+                    layers.elementwise_mul(
+                        p, layers.scale(mask, scale=-1.0, bias=1.0)))
+                helper.append_op("assign", inputs={"X": new_slow},
+                                 outputs={"Out": slow})
+                helper.append_op("assign", inputs={"X": new_fast},
+                                 outputs={"Out": p})
+        return result
+
+
 class RecomputeOptimizer(Optimizer):
     """Activation-checkpointing wrapper (fluid optimizer.py:4458): backward
     replays forward segments from user checkpoints (see recompute_rewrite)."""

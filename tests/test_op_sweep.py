@@ -834,13 +834,17 @@ def _avg_acc_ref(ins, a):
     na = float(ins["in_num_accumulates"]) + 1
     nu = float(ins["in_num_updates"]) + 1
     s1 = s1 + p
-    # window_full = na>=min_avg and na>=min(max_avg, nu*avg_win)
+    # window_full = na>=min_avg and na>=min(max_avg, nu*avg_win); on
+    # completion s3 is REPLACED by s1+s2 and both clear (reference
+    # average_accumulates_op.h:98)
     full = (na >= a["min_average_window"]) and \
         (na >= min(a["max_average_window"], nu * a["average_window"]))
     i64 = np.int64
     if full:
-        return {"out_sum_1": np.zeros_like(s1), "out_sum_2": s2 + s1,
-                "out_sum_3": s3, "out_num_accumulates": np.array([0], i64),
+        return {"out_sum_1": np.zeros_like(s1),
+                "out_sum_2": np.zeros_like(s2),
+                "out_sum_3": s1 + s2,
+                "out_num_accumulates": np.array([0], i64),
                 "out_old_num_accumulates": np.array([int(na)], i64),
                 "out_num_updates": np.array([int(nu)], i64)}
     return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,

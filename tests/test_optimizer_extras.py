@@ -1,0 +1,118 @@
+"""ModelAverage, LookaheadOptimizer, namespace aliases, mean_iou/Print
+layers (reference fluid/optimizer.py ModelAverage/LookaheadOptimizer,
+layers/nn.py mean_iou, layers/control_flow.py Print)."""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+from paddle_tpu.static.optimizer import ModelAverage, LookaheadOptimizer
+
+rng = np.random.RandomState(0)
+XB = rng.rand(8, 4).astype(np.float32)
+YB = (XB @ rng.rand(4, 1)).astype(np.float32)
+
+
+def _linreg():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 4])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+    return main, startup, loss
+
+
+def test_lookahead_trains_and_syncs():
+    main, startup, loss = _linreg()
+    with static.program_guard(main, startup):
+        LookaheadOptimizer(static.SGD(learning_rate=0.1), alpha=0.5,
+                           k=4).minimize(loss)
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": XB, "y": YB},
+                                fetch_list=[loss])[0])
+                  for _ in range(40)]
+        # slow copies exist and track the fast weights after sync steps
+        slows = [n for n in sc.keys() if "_slow" in n]
+        assert slows
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_model_average_apply_restore():
+    main, startup, loss = _linreg()
+    with static.program_guard(main, startup):
+        static.SGD(learning_rate=0.1).minimize(loss)
+        ma = ModelAverage(0.15, min_average_window=2,
+                          max_average_window=10)
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        for _ in range(12):
+            exe.run(main, feed={"x": XB, "y": YB}, fetch_list=[loss])
+        pname = main.all_parameters()[0].name
+        final = np.asarray(sc.get(pname)).copy()
+        ma.apply(exe)
+        averaged = np.asarray(sc.get(pname)).copy()
+        # averaged weights differ from the final step's weights...
+        assert not np.allclose(final, averaged)
+        ma.restore(exe)
+        # ...and restore brings the exact final weights back
+        np.testing.assert_array_equal(
+            np.asarray(sc.get(pname)), final)
+
+
+def test_model_average_constant_params_multi_window():
+    """lr=0 keeps params constant, so after ANY number of completed
+    averaging windows the average must equal the param exactly (guards
+    the window-rollover semantics of average_accumulates: s3 is
+    replaced by s1+s2, not accumulated into)."""
+    main, startup, loss = _linreg()
+    with static.program_guard(main, startup):
+        static.SGD(learning_rate=0.0).minimize(loss)
+        ma = ModelAverage(0.15, min_average_window=2,
+                          max_average_window=2)
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        for _ in range(8):  # 4 completed windows
+            exe.run(main, feed={"x": XB, "y": YB}, fetch_list=[loss])
+        pname = main.all_parameters()[0].name
+        const = np.asarray(sc.get(pname)).copy()
+        ma.apply(exe)
+        averaged = np.asarray(sc.get(pname)).copy()
+        ma.restore(exe)
+    np.testing.assert_allclose(averaged, const, rtol=1e-6)
+
+
+def test_mean_iou_and_print_layers():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        p = layers.data("p", [-1, 4], dtype="int64")
+        l = layers.data("l", [-1, 4], dtype="int64")
+        miou, wrong, correct = layers.mean_iou(p, l, num_classes=3)
+        printed = layers.Print(layers.cast(p, "float32"),
+                               message="dbg")
+        s = layers.mean(printed)
+    exe, sc = static.Executor(), static.Scope()
+    pred = np.array([[0, 1, 2, 2]], np.int64)
+    lab = np.array([[0, 1, 1, 2]], np.int64)
+    with static.scope_guard(sc):
+        exe.run(startup)
+        out = exe.run(main, feed={"p": pred, "l": lab},
+                      fetch_list=[miou, s])
+    # classes: 0 -> iou 1, 1 -> 1/2, 2 -> 1/2  => mean 2/3
+    np.testing.assert_allclose(float(out[0]), 2.0 / 3.0, rtol=1e-5)
+
+
+def test_namespace_aliases():
+    import paddle_tpu.optimizer as opt
+    assert opt.ExponentialLR is opt.lr_scheduler.ExponentialDecay
+    assert opt.ReduceLROnPlateau is opt.lr_scheduler.ReduceOnPlateau
+    assert opt.SGDOptimizer is static.SGDOptimizer
+    import paddle_tpu.metric as metric
+    assert callable(metric.auc) and callable(metric.chunk_eval)
+    assert static.ParallelExecutor is static.CompiledProgram
+    assert static.InputSpec is not None
+    from paddle_tpu.io.framework_io import load_program_state
+    assert static.load_program_state is load_program_state
