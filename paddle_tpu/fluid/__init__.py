@@ -1,0 +1,67 @@
+"""paddle.fluid — compatibility namespace for Fluid-era user code
+(`import paddle.fluid as fluid`).  Every symbol is a re-export of this
+framework's own modules; nothing lives here.  Reference surface:
+python/paddle/fluid/__init__.py."""
+from ..core.program import (  # noqa: F401
+    Program, program_guard, default_main_program,
+    default_startup_program, name_scope, unique_name, device_guard,
+)
+from ..core.program import VarDesc as Variable  # noqa: F401
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XLAPlace, TPUPlace,
+    is_compiled_with_cuda, is_compiled_with_xpu, is_compiled_with_tpu,
+)
+from ..core import flags as core  # noqa: F401
+from ..core.flags import get_flags, set_flags  # noqa: F401
+from ..static.executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard,
+)
+from ..static import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy, ParallelExecutor,
+    ExponentialMovingAverage,
+    save_inference_model, load_inference_model, load_program_state,
+    set_program_state,
+)
+from ..static.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from ..static.backward import append_backward, gradients  # noqa: F401
+from ..io.data_feeder import DataFeeder  # noqa: F401
+from ..core.generator import seed as _seed  # noqa: F401
+
+from ..static import layers as _static_layers  # noqa: F401
+from . import layers  # noqa: F401
+from ..static import optimizer  # noqa: F401
+from ..static import initializer  # noqa: F401
+from ..static import nets  # noqa: F401
+from . import io  # noqa: F401
+from .. import dygraph  # noqa: F401
+from ..static.optimizer import (  # noqa: F401
+    L1Decay, L2Decay, GradientClipByValue, GradientClipByNorm,
+    GradientClipByGlobalNorm,
+)
+from . import regularizer, clip  # noqa: F401
+from ..metric import metrics  # noqa: F401
+from ..io.dataloader import DataLoader as _DataLoader  # noqa: F401
+
+
+def embedding(*args, **kwargs):
+    """fluid.embedding == fluid.layers.embedding (v2 semantics)."""
+    return layers.embedding(*args, **kwargs)
+
+
+def one_hot(*args, **kwargs):
+    return layers.one_hot(*args, **kwargs)
+
+
+def in_dygraph_mode():
+    from ..dygraph.base import in_dygraph_mode as _f
+    return _f()
+
+
+def enable_dygraph(place=None):
+    from ..dygraph.base import enable_dygraph as _f
+    return _f(place)
+
+
+def disable_dygraph():
+    from ..dygraph.base import disable_dygraph as _f
+    return _f()

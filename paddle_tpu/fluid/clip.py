@@ -1,0 +1,7 @@
+"""fluid.clip (reference python/paddle/fluid/clip.py)."""
+from ..static.optimizer import (  # noqa: F401
+    GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+)
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm"]

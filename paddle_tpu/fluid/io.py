@@ -1,0 +1,10 @@
+"""fluid.io — save/load surface (reference python/paddle/fluid/io.py)."""
+from ..io.framework_io import (  # noqa: F401
+    save_vars, save_params, save_persistables, load_vars, load_params,
+    load_persistables, save_inference_model, load_inference_model,
+    set_program_state, load_program_state,
+)
+from ..io.framework_io import static_save as save  # noqa: F401
+from ..io.framework_io import static_load as load  # noqa: F401
+from ..io.dataloader import DataLoader  # noqa: F401
+from ..io.generator_loader import GeneratorLoader as PyReader  # noqa: F401

@@ -1,0 +1,21 @@
+"""fluid.layers — the merged layer namespace Fluid code expects: nn +
+tensor + control_flow + metric ops in ONE module (reference
+python/paddle/fluid/layers/__init__.py merges its submodules the same
+way)."""
+from ..static.layers import *  # noqa: F401,F403
+from ..static.layers import __all__ as _layers_all
+from ..static.control_flow import *  # noqa: F401,F403
+from ..static.control_flow import __all__ as _cf_all
+from ..static import layers as _static_layers
+
+__all__ = list(_layers_all) + list(_cf_all)
+
+
+def data(name, shape, dtype="float32", lod_level=0,
+         append_batch_size=True):
+    """fluid.layers.data keeps the REFERENCE default
+    append_batch_size=True (shape=[13] means [-1, 13]); the 2.0-style
+    paddle_tpu.static.layers.data takes the full shape."""
+    return _static_layers.data(name, shape, dtype=dtype,
+                               lod_level=lod_level,
+                               append_batch_size=append_batch_size)

@@ -1,0 +1,7 @@
+"""fluid.regularizer (reference python/paddle/fluid/regularizer.py)."""
+from ..static.optimizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer",
+           "L2DecayRegularizer"]

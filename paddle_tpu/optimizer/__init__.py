@@ -21,11 +21,7 @@ from ..static.optimizer import (  # noqa: F401
     DecayedAdagradOptimizer, DpsgdOptimizer, LambOptimizer,
     ExponentialMovingAverage, ModelAverage, LookaheadOptimizer,
 )
-from ..static.optimizer import FtrlOptimizer as Ftrl  # noqa: F401
-from ..static.optimizer import DpsgdOptimizer as Dpsgd  # noqa: F401
-from ..static.optimizer import (  # noqa: F401
-    DecayedAdagradOptimizer as DecayedAdagrad,
-)
+from ..static.optimizer import Ftrl, Dpsgd, DecayedAdagrad  # noqa: F401
 from .lr_scheduler import (  # noqa: F401
     NoamLR, PiecewiseLR, NaturalExpLR, InverseTimeLR, PolynomialLR,
     LinearLrWarmup, ExponentialLR, MultiStepLR, StepLR, LambdaLR,

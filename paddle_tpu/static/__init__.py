@@ -37,8 +37,7 @@ from .optimizer import (  # noqa: F401
     LarsMomentumOptimizer, ModelAverage, LookaheadOptimizer,
     RecomputeOptimizer,
 )
-from .optimizer import DecayedAdagradOptimizer as DecayedAdagrad  # noqa: F401
-from .optimizer import DpsgdOptimizer as Dpsgd  # noqa: F401
+from .optimizer import DecayedAdagrad, Dpsgd  # noqa: F401
 from .layers import Print, py_func  # noqa: F401
 from ..jit import InputSpec  # noqa: F401
 
@@ -52,6 +51,32 @@ from ..io.framework_io import static_load as load  # noqa: F401
 from ..distributed.compiled_program import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,
 )
-# fluid alias: ParallelExecutor's role (multi-device execution of one
-# program) is CompiledProgram.with_data_parallel here
-ParallelExecutor = CompiledProgram
+class ParallelExecutor:
+    """Fluid ParallelExecutor constructor compatibility
+    (framework.ParallelExecutor(use_cuda, loss_name=..., ...)): wraps
+    CompiledProgram.with_data_parallel over all local devices; run via
+    Executor.run(pe, ...) or pe.run(fetch_list, feed)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..core.program import default_main_program
+        program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            program, build_strategy=build_strategy).with_data_parallel(
+            loss_name=loss_name, exec_strategy=exec_strategy,
+            share_vars_from=getattr(share_vars_from, "_compiled",
+                                    share_vars_from))
+        self._scope = scope
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        return self._compiled._run(executor, feed, fetch_list,
+                                   scope or self._scope, return_numpy)
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        from .executor import Executor
+        return Executor().run(self, feed=feed or feed_dict,
+                              fetch_list=fetch_list,
+                              return_numpy=return_numpy)

@@ -1153,6 +1153,11 @@ def argsort(input, axis=-1, descending=False, name=None):
     return out, ids
 
 
+def square_error_cost(input, label):
+    """layers/loss.py square_error_cost: (input - label)^2 elementwise."""
+    return square(elementwise_sub(input, label))
+
+
 def mean_iou(input, label, num_classes):
     """layers/nn.py mean_iou: mean intersection-over-union over classes;
     returns (mean_iou, out_wrong, out_correct)."""
@@ -2583,7 +2588,7 @@ from .layer_generator import generate_layer_fns as _generate_layer_fns  # noqa: 
 
 _GENERATED_LAYERS = _generate_layer_fns(globals(), dir())
 __all__ += _GENERATED_LAYERS
-__all__ += ["mean_iou", "Print"]
+__all__ += ["mean_iou", "Print", "square_error_cost"]
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,

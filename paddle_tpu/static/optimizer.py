@@ -630,6 +630,11 @@ class ModelAverage:
                 self._accs.append((p, acc))
 
     def apply(self, executor=None, need_restore=True):
+        """Swap averaged parameters in IMMEDIATELY and return a context
+        handle, so both fluid idioms work:
+        `with ma.apply(exe): evaluate()` (restores on exit when
+        need_restore) and the imperative `ma.apply(exe) ...
+        ma.restore(exe)`."""
         import numpy as np
         from .executor import global_scope
         scope = global_scope()
@@ -648,12 +653,26 @@ class ModelAverage:
                 continue
             self._backup[p.name] = scope.get(p.name)
             scope.set(p.name, (total / count).astype(total.dtype))
+        return _ModelAverageApplied(self, need_restore)
 
     def restore(self, executor=None):
         from .executor import global_scope
         scope = global_scope()
         for name, v in getattr(self, "_backup", {}).items():
             scope.set(name, v)
+
+
+class _ModelAverageApplied:
+    def __init__(self, ma, need_restore):
+        self._ma, self._need_restore = ma, need_restore
+
+    def __enter__(self):
+        return self._ma
+
+    def __exit__(self, *exc):
+        if self._need_restore:
+            self._ma.restore()
+        return False
 
 
 class LookaheadOptimizer:
@@ -682,6 +701,15 @@ class LookaheadOptimizer:
         helper = LayerHelper("lookahead")
         block = program.global_block()
         startup = helper.startup_program.global_block()
+        # only the parameters the inner optimizer actually trains get
+        # slow copies — untouched params would just burn memory and
+        # per-step ops computing fast==fast
+        trained = None
+        if isinstance(result, tuple) and len(result) == 2:
+            trained = {p.name for p, _ in result[1]}
+        elif parameter_list is not None:
+            trained = {p.name if hasattr(p, "name") else str(p)
+                       for p in parameter_list}
         with program._op_role_guard(OpRole.Optimize):
             # int64 counter: a float32 step would stop counting at 2^24
             # (16.8M steps) and freeze the periodic sync forever
@@ -699,6 +727,8 @@ class LookaheadOptimizer:
                 "float32")
             for p in program.all_parameters():
                 if not p.trainable:
+                    continue
+                if trained is not None and p.name not in trained:
                     continue
                 slow = block.create_var(
                     name=unique_name(f"{p.name}_slow"), shape=p.shape,
@@ -772,5 +802,6 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
+Dpsgd = DpsgdOptimizer
 ProximalGD = ProximalGDOptimizer
 ProximalAdagrad = ProximalAdagradOptimizer

@@ -1,0 +1,80 @@
+"""`import paddle.fluid as fluid` compatibility surface: classic
+fluid-era book code must run unchanged against this namespace
+(reference python/paddle/fluid/__init__.py)."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def test_fluid_book_style_training():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        hidden = fluid.layers.fc(input=x, size=32, act="relu",
+                                 param_attr=fluid.ParamAttr(
+                                     regularizer=fluid.regularizer.L2Decay(
+                                         1e-4)))
+        pred = fluid.layers.fc(input=hidden, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 13).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32) / 13.0
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": xb, "y": yb},
+                                fetch_list=[loss])[0])
+                  for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_fluid_surface():
+    assert fluid.default_main_program() is not None
+    assert fluid.layers.While is not None          # control flow merged
+    assert fluid.layers.fill_constant is not None
+    assert fluid.io.load_inference_model is not None
+    assert fluid.io.PyReader is not None
+    assert fluid.clip.GradientClipByGlobalNorm is not None
+    assert fluid.metrics is not None
+    assert fluid.ParallelExecutor is not None
+    assert fluid.Variable is not None
+    assert callable(fluid.in_dygraph_mode)
+    import paddle_tpu
+    assert paddle_tpu.fluid is fluid               # auto-loaded subpackage
+
+
+def test_fluid_parallel_executor():
+    """The fluid ParallelExecutor constructor idiom runs a DP step."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="px", shape=[4])
+        y = fluid.layers.data(name="py", shape=[1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with fluid.program_guard(main, startup):
+            pe = fluid.ParallelExecutor(use_cuda=False,
+                                        loss_name=loss.name)
+        xb = rng.rand(16, 4).astype(np.float32)
+        yb = xb.sum(1, keepdims=True).astype(np.float32)
+        (lv,) = exe.run(pe, feed={"px": xb, "py": yb},
+                        fetch_list=[loss])
+        assert np.isfinite(float(np.asarray(lv)))
+
+
+def test_fluid_dygraph_guard():
+    with fluid.dygraph.guard():
+        t = fluid.dygraph.to_variable(np.ones((2, 2), np.float32))
+        out = t * 3.0
+        assert float(np.asarray(out.numpy()).sum()) == 12.0
