@@ -31,7 +31,7 @@ def _setup_api():
     for mod in ("dygraph", "tensor", "nn", "optimizer", "static",
                 "distributed", "amp", "metric", "io", "vision", "text",
                 "hapi", "jit", "incubate", "profiler", "utils", "slim",
-                "reader", "dataset", "fluid"):
+                "reader", "dataset", "fluid", "regularizer"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:

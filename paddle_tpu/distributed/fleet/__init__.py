@@ -18,6 +18,9 @@ from .base.role_maker import (  # noqa: F401
 from .base.fleet_base import Fleet, fleet as _fleet_singleton  # noqa: F401
 from .base.util_factory import UtilBase  # noqa: F401
 from . import meta_optimizers  # noqa: F401
+from .data_generator import (  # noqa: F401
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
 
 # module-level passthroughs so `fleet.init(...)` works after
 # `import paddle_tpu.distributed.fleet as fleet` (reference __init__.py

@@ -169,16 +169,45 @@ class Predictor:
         return Executor()
 
     def _load_and_optimize(self):
+        import os
         from ..static.executor import Executor, Scope, scope_guard
         from ..io.framework_io import load_inference_model
         self._scope = Scope()
         self._exe = Executor()
+        model_dir = self._config._model_dir
+        prog_file = self._config._prog_file
+        params_file = self._config._params_file
+        # accept all three reference spellings:
+        #   Config(model_dir)                     -> dir with __model__
+        #   Config(prog_file, params_file)        -> explicit file paths
+        #   Config(prefix)  [jit.save output]     -> prefix.pdmodel/.pdiparams
+        if model_dir and os.path.isfile(model_dir):
+            # first positional is actually a program FILE (any name)
+            prog_file, params_file = model_dir, prog_file
+            model_dir = None
+        if model_dir and prog_file is None and \
+                not os.path.exists(os.path.join(model_dir, "__model__")) \
+                and os.path.exists(model_dir + ".pdmodel"):
+            prog_file = model_dir + ".pdmodel"
+            params_file = model_dir + ".pdiparams"
+            model_dir = None
+        if model_dir is None and prog_file:
+            model_dir = os.path.dirname(prog_file) or "."
+            prog_file = os.path.basename(prog_file)
+            if params_file:
+                pdir = os.path.dirname(params_file)
+                # keep params outside the model dir addressable: an
+                # absolute path survives os.path.join(dirname, ...)
+                params_file = os.path.basename(params_file) \
+                    if (not pdir or os.path.abspath(pdir)
+                        == os.path.abspath(model_dir)) \
+                    else os.path.abspath(params_file)
         with scope_guard(self._scope):
             prog, feed_names, fetch_targets = load_inference_model(
-                self._config._model_dir,
+                model_dir,
                 self._exe,
-                model_filename=self._config._prog_file,
-                params_filename=self._config._params_file)
+                model_filename=prog_file,
+                params_filename=params_file)
         self._feed_names = feed_names
         self._fetch_names = [t.name for t in fetch_targets]
         if self._config._ir_optim:

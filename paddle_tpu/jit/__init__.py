@@ -515,3 +515,86 @@ def load(path, **configs):
     tl = TranslatedLayer(program, feed_names, fetch_names, params)
     tl._cp.out_struct = "list" if len(fetch_names) > 1 else "single"
     return tl
+
+
+# ---------------------------------------------------------------------------
+# TracedLayer (dygraph/jit.py:1218) + dy2static logging knobs
+# ---------------------------------------------------------------------------
+_VERBOSITY = {"code_level": 0, "verbosity": 0}
+
+
+def set_code_level(level=100):
+    """jit.set_code_level: how much transformed code dy2static logs
+    (stored knob; transforms consult it when printing)."""
+    _VERBOSITY["code_level"] = int(level)
+
+
+def set_verbosity(level=0):
+    """jit.set_verbosity: dy2static logging verbosity."""
+    _VERBOSITY["verbosity"] = int(level)
+
+
+class TracedLayer:
+    """Convert a data-independent dygraph Layer into a static-graph
+    callable by tracing one forward (reference dygraph/jit.py
+    TracedLayer).  Create via TracedLayer.trace(layer, inputs); call it
+    with tensors to run the traced program; save_inference_model()
+    persists it for the Predictor."""
+
+    def __init__(self, static_function, layer, example_inputs):
+        self._sf = static_function
+        self._layer = layer
+        self._inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        if not isinstance(layer, Layer):
+            raise TypeError("TracedLayer.trace needs a dygraph Layer")
+        inputs = [i if isinstance(i, Tensor) else Tensor(i)
+                  for i in inputs]
+        sf = StaticFunction(layer.forward, layer=layer)
+        out = sf(*inputs)
+        return out, TracedLayer(sf, layer, inputs)
+
+    def __call__(self, inputs):
+        inputs = [i if isinstance(i, Tensor) else Tensor(i)
+                  for i in inputs]
+        return self._sf(*inputs)
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """Accepted for parity; the traced program already runs as one
+        jitted XLA computation."""
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        """feed/fetch are INDEX lists selecting which traced inputs/
+        outputs the saved model exposes (reference dygraph/jit.py
+        TracedLayer.save_inference_model)."""
+        import os
+        import numpy as np
+        from ..static import Executor, Scope, scope_guard
+        from ..io.framework_io import save_inference_model
+
+        cp = self._sf.concrete_program(*self._inputs)
+        feed_names = list(cp.feed_names)
+        fetch_names = list(cp.fetch_names)
+        if feed is not None:
+            feed_names = [feed_names[i] for i in feed]
+        if fetch is not None:
+            fetch_names = [fetch_names[i] for i in fetch]
+        dirname = os.path.dirname(path) or "."
+        basename = os.path.basename(path)
+        os.makedirs(dirname, exist_ok=True)
+        scope = Scope()
+        for name, t in cp.params.items():
+            scope.set(name, t._value)
+        exe = Executor()
+        with scope_guard(scope):
+            save_inference_model(
+                dirname, feed_names,
+                [cp.program.global_block().var(n) for n in fetch_names],
+                exe, main_program=cp.program,
+                model_filename=basename + ".pdmodel",
+                params_filename=basename + ".pdiparams")
+
+
+__all__ += ["TracedLayer", "set_code_level", "set_verbosity"]

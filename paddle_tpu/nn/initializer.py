@@ -1,6 +1,7 @@
 """paddle.nn.initializer — 2.0 names over the shared initializer classes
 (analog of python/paddle/nn/initializer/)."""
 from ..static.initializer import (  # noqa: F401
+    Bilinear,
     Constant, Uniform, Normal, TruncatedNormal, Xavier,
     XavierInitializer, MSRA, MSRAInitializer, NumpyArrayInitializer,
     Assign, set_global_initializer,

@@ -1,0 +1,5 @@
+"""paddle.regularizer (reference python/paddle/regularizer.py): the 2.0
+top-level regularizer names."""
+from .static.optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -20,6 +20,7 @@ __all__ = [
     "UniformInitializer", "Normal", "NormalInitializer", "TruncatedNormal",
     "TruncatedNormalInitializer", "Xavier", "XavierInitializer", "MSRA",
     "MSRAInitializer", "NumpyArrayInitializer", "Assign",
+    "Bilinear", "BilinearInitializer",
     "_global_weight_initializer", "_global_bias_initializer",
     "set_global_initializer",
 ]
@@ -163,6 +164,36 @@ class MSRAInitializer(Initializer):
                        "mean": 0.0, "std": std, "seed": self.seed})
 
 
+class BilinearInitializer(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    fluid/initializer.py BilinearInitializer): a [C, c, k, k] filter
+    whose spatial kernel is the separable triangle
+    (1-|x/f - c|)(1-|y/f - c|), so conv_transpose with stride=factor
+    performs bilinear interpolation."""
+
+    def __call__(self, var, block=None):
+        block = self._startup_block(block)
+        self._declare(block, var)
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        if shape[2] != shape[3]:
+            raise ValueError("kernel must be square (shape[2]==shape[3])")
+        size = shape[3]
+        f = np.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        n = int(np.prod(shape))
+        idx = np.arange(n)
+        x = idx % size
+        y = (idx // size) % size
+        weight = ((1 - np.abs(x / f - c))
+                  * (1 - np.abs(y / f - c))).astype(np.float32)
+        block.append_op(
+            "assign_value", outputs={"Out": var.name},
+            attrs={"shape": list(shape), "dtype": var.dtype,
+                   "values": weight.tolist()})
+
+
 class NumpyArrayInitializer(Initializer):
     def __init__(self, value):
         self.value = np.asarray(value)
@@ -177,6 +208,7 @@ class NumpyArrayInitializer(Initializer):
 
 
 # fluid-style aliases
+Bilinear = BilinearInitializer
 Constant = ConstantInitializer
 Uniform = UniformInitializer
 Normal = NormalInitializer

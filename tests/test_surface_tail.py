@@ -1,0 +1,142 @@
+"""Surface-audit tail: fleet data generators (emit the MultiSlot text
+format the dataset tier parses), jit.TracedLayer, Bilinear initializer,
+paddle.regularizer (reference incubate/data_generator, dygraph/jit.py
+TracedLayer, fluid/initializer.py BilinearInitializer)."""
+import numpy as np
+
+import paddle_tpu
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def test_multislot_data_generator_roundtrip(tmp_path):
+    from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+    from paddle_tpu.distributed import DatasetFactory
+
+    class CTRGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                ids = [int(x) for x in line.split()[:4]]
+                dense = [float(x) / 50.0 for x in line.split()[:2]]
+                label = [float(line.split()[0]) / 50.0]
+                yield [("ids", ids), ("dense", dense), ("label", label)]
+
+            return it
+
+    gen = CTRGen()
+    lines = [" ".join(str((7 * i + j) % 50) for j in range(4))
+             for i in range(32)]
+    text = gen.run_from_memory(lines)
+    # 4-slot lines: "4 a b c d 2 f f 1 f"
+    first = text.splitlines()[0].split()
+    assert first[0] == "4" and first[5] == "2" and first[8] == "1"
+    assert gen._proto_info[0] == ("ids", "uint64")
+    assert gen._proto_info[1] == ("dense", "float")
+
+    # the emitted file trains through the industrial dataset path
+    p = tmp_path / "gen.txt"
+    p.write_text(text)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, 4], dtype="int64")
+        dense = layers.data("dense", [-1, 2])
+        label = layers.data("label", [-1, 1])
+        emb = layers.embedding(ids, size=[50, 8])
+        feat = layers.concat([layers.reduce_sum(emb, dim=1), dense],
+                             axis=1)
+        pred = layers.fc(feat, 1, act="sigmoid")
+        loss = layers.mean(layers.square(
+            layers.elementwise_sub(pred, label)))
+        static.SGD(learning_rate=0.1).minimize(loss)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(8)
+    ds.set_filelist([str(p)])
+    with static.program_guard(main, startup):
+        ds.set_use_var([main.global_block().var(n)
+                        for n in ("ids", "dense", "label")])
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        for _ in range(5):
+            last = exe.train_from_dataset(main, ds, fetch_list=[loss])
+    assert np.isfinite(float(np.asarray(last[0])))
+
+
+def test_multislot_string_generator():
+    from paddle_tpu.distributed.fleet import MultiSlotStringDataGenerator
+
+    class G(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", line.split()), ("label", ["1"])]
+
+            return it
+
+    out = G().run_from_memory(["a b c"])
+    assert out == "3 a b c 1 1\n"
+
+
+def test_traced_layer_and_predictor(tmp_path):
+    from paddle_tpu import nn
+    import paddle_tpu.jit as jit
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return paddle_tpu.tensor.tanh(self.fc(x))
+
+    m = M()
+    x = paddle_tpu.to_tensor(np.random.RandomState(0).rand(3, 4)
+                             .astype(np.float32))
+    out, traced = jit.TracedLayer.trace(m, [x])
+    out2 = traced([x])
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(out2.numpy()), rtol=1e-6)
+    path = str(tmp_path / "m")
+    traced.save_inference_model(path)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    (got,) = pred.run([np.asarray(x.numpy())])
+    np.testing.assert_allclose(got, np.asarray(out.numpy()), atol=1e-5)
+    jit.set_verbosity(3)
+    jit.set_code_level(50)
+
+
+def test_bilinear_initializer_upsamples():
+    from paddle_tpu.nn.initializer import Bilinear
+    from paddle_tpu.static import ParamAttr
+    main, startup = static.Program(), static.Program()
+    factor = 2
+    C = 3
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, C, 4, 4])
+        up = layers.conv2d_transpose(
+            x, C, filter_size=2 * factor - factor % 2, stride=factor,
+            padding=int(np.ceil((factor - 1) / 2.0)), groups=C,
+            param_attr=ParamAttr(initializer=Bilinear()),
+            bias_attr=False)
+    exe, sc = static.Executor(), static.Scope()
+    im = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    im = np.tile(im, (1, C, 1, 1))
+    with static.scope_guard(sc):
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": im}, fetch_list=[up])
+    out = np.asarray(out)
+    assert out.shape == (1, C, 8, 8)
+    # bilinear upsampling: the interior is a linear ramp at half the
+    # input's slope per axis (input slope 1/col -> 0.5/col; 4/row ->
+    # 2.0/row), and every channel gets the identical separable kernel
+    np.testing.assert_allclose(np.diff(out[0, 0, 3, 2:7]), 0.5,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.diff(out[0, 0, 2:7, 3]), 2.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1], out[0, 0], rtol=1e-6)
+
+
+def test_regularizer_namespace():
+    import paddle_tpu.regularizer as reg
+    from paddle_tpu.static.optimizer import L2Decay
+    assert reg.L2Decay is L2Decay
