@@ -16,7 +16,6 @@ class DataGenerator:
     def __init__(self):
         self.batch_size_ = 32
         self._proto_info = None
-        self._line_limit = None
 
     def set_batch(self, batch_size):
         self.batch_size_ = batch_size
@@ -73,10 +72,15 @@ class DataGenerator:
             return buf.getvalue()
 
     def run_from_files(self, filelist, out=None):
-        outs = out or sys.stdout
-        for fn in filelist:
-            with open(fn) as f:
-                self._drive(f, outs)
+        def all_lines():
+            # ONE stream across the filelist so generate_batch sees full
+            # batch_size_ batches spanning file boundaries (reference
+            # DataGenerator accumulates across files)
+            for fn in filelist:
+                with open(fn) as f:
+                    yield from f
+
+        self._drive(all_lines(), out or sys.stdout)
 
     def _gen_str(self, line):
         raise NotImplementedError(

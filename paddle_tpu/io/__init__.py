@@ -7,7 +7,8 @@ from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
     BatchSampler, DistributedBatchSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         get_worker_info, WorkerInfo)
 from .generator_loader import GeneratorLoader  # noqa: F401
 from .bucketing import (  # noqa: F401
     pad_sequences, mask_from_lengths, bucket_for_length,

@@ -35,7 +35,8 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn", "ParentWatchDog"]
+__all__ = ["DataLoader", "default_collate_fn", "ParentWatchDog",
+           "WorkerInfo", "get_worker_info"]
 
 
 def default_collate_fn(batch):
@@ -86,12 +87,37 @@ class ParentWatchDog:
 _WORKER_POLL_S = 1.0
 
 
+class WorkerInfo:
+    """Worker-process metadata for IterableDataset sharding
+    (reference dataloader_iter.py:122 get_worker_info)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: WorkerInfo(id, num_workers,
+    dataset); in the main process: None."""
+    return _worker_info
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
-                 worker_id):
+                 worker_id, num_workers=1):
     """Worker-process main (dataloader_iter.py _worker_loop analog):
     receive (batch_idx, indices), emit (batch_idx, batch, error)."""
+    global _worker_info
     if isinstance(dataset, _CloudpickleEnvelope):
         dataset, collate_fn, init_fn = dataset.load()
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     watchdog = ParentWatchDog()
     try:
         if init_fn is not None:
@@ -112,6 +138,124 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn,
                 data_queue.put((bidx, None, traceback.format_exc()))
     except KeyboardInterrupt:
         pass
+
+
+_ITER_DONE = "__iterable_worker_done__"
+
+
+def _iterable_worker_loop(dataset, data_queue, collate_fn, init_fn,
+                          worker_id, num_workers, batch_size, drop_last):
+    """Iterable-mode worker main: each worker owns iter(dataset) with
+    get_worker_info() populated, so the dataset can shard its stream;
+    collated batches stream back as they are produced."""
+    global _worker_info
+    if isinstance(dataset, _CloudpickleEnvelope):
+        dataset, collate_fn, init_fn = dataset.load()
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        import itertools as _it
+        it = iter(dataset)
+        while True:
+            samples = list(_it.islice(it, batch_size))
+            if not samples:
+                break
+            if len(samples) < batch_size and drop_last:
+                break
+            data_queue.put((worker_id, collate_fn(samples), None))
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        import traceback
+        data_queue.put((worker_id, None, traceback.format_exc()))
+    finally:
+        data_queue.put((worker_id, None, _ITER_DONE))
+
+
+class _IterableMultiprocessIter:
+    """Fan-out for IterableDataset: num_workers processes each run the
+    dataset's iterator (sharded via get_worker_info) and stream batches;
+    cross-worker batch order is arrival order, like the reference."""
+
+    def __init__(self, loader, use_cloudpickle=False):
+        import multiprocessing as mp
+        ctx = mp.get_context("spawn")
+        self._nw = loader.num_workers
+        self._data_q = ctx.Queue()
+        self._workers = []
+        self._closed = False
+        if use_cloudpickle:
+            try:
+                payload = _CloudpickleEnvelope(
+                    (loader.dataset, loader.collate_fn,
+                     loader.worker_init_fn))
+                args0 = (payload, None, None)
+            except Exception as e:
+                raise _UnspawnableError(f"cloudpickle: {e}") from e
+        else:
+            args0 = (loader.dataset, loader.collate_fn,
+                     loader.worker_init_fn)
+        for wid in range(self._nw):
+            p = ctx.Process(
+                target=_iterable_worker_loop,
+                args=(args0[0], self._data_q, args0[1], args0[2], wid,
+                      self._nw, loader.batch_size, loader.drop_last),
+                daemon=True)
+            try:
+                p.start()
+            except (pickle.PicklingError, TypeError, AttributeError) as e:
+                self.close()
+                raise _UnspawnableError(str(e)) from e
+            self._workers.append(p)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        done = getattr(self, "_done", 0)
+        while True:
+            if done >= self._nw:
+                self._done = done
+                self.close()
+                raise StopIteration
+            alive = any(w.is_alive() for w in self._workers)
+            try:
+                wid, batch, err = self._data_q.get(
+                    timeout=_WORKER_POLL_S if not alive else 30.0)
+            except queue.Empty:
+                if not alive:
+                    self.close()
+                    raise RuntimeError(
+                        "DataLoader iterable worker(s) exited "
+                        "unexpectedly")
+                continue
+            if err == _ITER_DONE:
+                done += 1
+                self._done = done
+                continue
+            if err is not None:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader iterable worker {wid} failed:\n{err}")
+            self._done = done
+            return batch
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for w in self._workers:
+            if w.is_alive():
+                w.terminate()
+        for w in self._workers:
+            w.join(timeout=2.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _UnspawnableError(RuntimeError):
@@ -161,7 +305,8 @@ class _MultiprocessIter:
             p = ctx.Process(
                 target=_worker_loop,
                 args=(worker_payload[0], self._index_qs[wid], self._data_q,
-                      worker_payload[1], worker_payload[2], wid),
+                      worker_payload[1], worker_payload[2], wid,
+                      self._nw),
                 daemon=True)
             try:
                 p.start()
@@ -434,7 +579,26 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            it = self._iter_iterable()
+            it = None
+            if self.num_workers > 0 and self._spawn_ok is not False:
+                try:
+                    it = _IterableMultiprocessIter(
+                        self, use_cloudpickle=self._spawn_ok == "cp")
+                    if self._spawn_ok is None:
+                        self._spawn_ok = True
+                except _UnspawnableError:
+                    try:
+                        it = _IterableMultiprocessIter(
+                            self, use_cloudpickle=True)
+                        self._spawn_ok = "cp"
+                    except _UnspawnableError as e2:
+                        warnings.warn(
+                            "DataLoader(IterableDataset, num_workers>0): "
+                            f"not serialisable ({e2}); iterating in the "
+                            "main process", RuntimeWarning)
+                        self._spawn_ok = False
+            if it is None:
+                it = self._iter_iterable()
         elif self.num_workers > 0:
             it = None
             if self._spawn_ok is not False:

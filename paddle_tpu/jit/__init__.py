@@ -426,23 +426,32 @@ def save(layer, path, input_spec=None, **configs):
                                dtype=np.dtype(_np_dtype(spec.dtype))))
                for spec in input_spec]
     cp = sf.concrete_program(*example)
+    _save_concrete_program(cp, path)
+    return cp
+
+
+def _save_concrete_program(cp, path, feed_names=None, fetch_names=None):
+    """ONE writer for the jit on-disk layout (<path>.pdmodel JSON program
+    + <path>.pdiparams), shared by jit.save and
+    TracedLayer.save_inference_model so the format cannot drift."""
+    from ..static import Executor, Scope, scope_guard
+    from ..io.framework_io import save_inference_model
 
     dirname = os.path.dirname(path) or "."
     basename = os.path.basename(path)
     os.makedirs(dirname, exist_ok=True)
-
     scope = Scope()
     for name, t in cp.params.items():
         scope.set(name, t._value)
     exe = Executor()
     with scope_guard(scope):
         save_inference_model(
-            dirname, cp.feed_names,
-            [cp.program.global_block().var(n) for n in cp.fetch_names],
+            dirname, list(feed_names or cp.feed_names),
+            [cp.program.global_block().var(n)
+             for n in (fetch_names or cp.fetch_names)],
             exe, main_program=cp.program,
             model_filename=basename + ".pdmodel",
             params_filename=basename + ".pdiparams")
-    return cp
 
 
 def _np_dtype(dtype):
@@ -569,11 +578,6 @@ class TracedLayer:
         """feed/fetch are INDEX lists selecting which traced inputs/
         outputs the saved model exposes (reference dygraph/jit.py
         TracedLayer.save_inference_model)."""
-        import os
-        import numpy as np
-        from ..static import Executor, Scope, scope_guard
-        from ..io.framework_io import save_inference_model
-
         cp = self._sf.concrete_program(*self._inputs)
         feed_names = list(cp.feed_names)
         fetch_names = list(cp.fetch_names)
@@ -581,20 +585,7 @@ class TracedLayer:
             feed_names = [feed_names[i] for i in feed]
         if fetch is not None:
             fetch_names = [fetch_names[i] for i in fetch]
-        dirname = os.path.dirname(path) or "."
-        basename = os.path.basename(path)
-        os.makedirs(dirname, exist_ok=True)
-        scope = Scope()
-        for name, t in cp.params.items():
-            scope.set(name, t._value)
-        exe = Executor()
-        with scope_guard(scope):
-            save_inference_model(
-                dirname, feed_names,
-                [cp.program.global_block().var(n) for n in fetch_names],
-                exe, main_program=cp.program,
-                model_filename=basename + ".pdmodel",
-                params_filename=basename + ".pdiparams")
+        _save_concrete_program(cp, path, feed_names, fetch_names)
 
 
 __all__ += ["TracedLayer", "set_code_level", "set_verbosity"]

@@ -4,3 +4,7 @@ from .debugger import (  # noqa: F401
     prepare_fast_nan_inf_debug,
 )
 from .average import WeightedAverage  # noqa: F401
+from .lazy_utils import (  # noqa: F401
+    deprecated, require_version, download, load_op_library, dump_config,
+)
+from ..core.program import unique_name  # noqa: F401

@@ -1,0 +1,88 @@
+"""paddle.utils odds and ends (reference python/paddle/utils/):
+deprecated decorator, require_version, download (local-cache only in a
+zero-egress build), load_op_library, dump_config."""
+from __future__ import annotations
+
+import functools
+import os
+import warnings
+
+__all__ = ["deprecated", "require_version", "download",
+           "load_op_library", "dump_config"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated (reference utils/deprecated.py): warns on
+    call (level<=1) or raises (level==2), and prepends a note to the
+    docstring."""
+
+    def decorator(func):
+        note = (f"Deprecated since {since}. " if since else "Deprecated. ")
+        if update_to:
+            note += f"Use {update_to} instead. "
+        if reason:
+            note += reason
+        func.__doc__ = f"{note}\n\n{func.__doc__ or ''}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(f"{func.__name__}: {note}")
+            warnings.warn(note, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against [min, max]
+    (reference utils/install_check-style contract)."""
+    from .. import __version__
+
+    def as_tuple(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = as_tuple(__version__)
+    if as_tuple(min_version) > cur:
+        raise RuntimeError(
+            f"requires version >= {min_version}, installed {__version__}")
+    if max_version is not None and as_tuple(max_version) < cur:
+        raise RuntimeError(
+            f"requires version <= {max_version}, installed {__version__}")
+
+
+def download(url, path=None, md5sum=None):
+    """Resolve a dataset/weight URL against the local cache — this
+    build runs with zero egress, so a missing file raises with
+    placement instructions instead of fetching."""
+    from ..dataset.common import DATA_HOME, md5file
+    fname = os.path.join(path or DATA_HOME, url.split("/")[-1])
+    if not os.path.exists(fname):
+        raise FileNotFoundError(
+            f"{fname} not cached and this environment has no network "
+            f"access — place the file from {url} there manually")
+    if md5sum and md5file(fname) != md5sum:
+        raise IOError(f"{fname} md5 mismatch")
+    return fname
+
+
+def load_op_library(lib_path):
+    """Load a custom-op shared library (reference fluid
+    load_op_library): delegates to the cpp_extension loader, which
+    registers the ops it exports."""
+    from .cpp_extension import load_op_library as _load
+    return _load(lib_path)
+
+
+def dump_config(program, path=None):
+    """Serialize a Program's JSON form for inspection (reference
+    utils/dump_config behavior: write the config/program text)."""
+    text = program.serialize_to_string() if hasattr(
+        program, "serialize_to_string") else str(program)
+    if path:
+        with open(path, "w") as f:
+            f.write(text if isinstance(text, str)
+                    else text.decode("utf-8", "replace"))
+    return text

@@ -140,3 +140,61 @@ def test_regularizer_namespace():
     import paddle_tpu.regularizer as reg
     from paddle_tpu.static.optimizer import L2Decay
     assert reg.L2Decay is L2Decay
+
+
+def test_get_worker_info_shards_iterable_dataset():
+    """get_worker_info() inside worker processes lets an
+    IterableDataset shard its stream (reference dataloader_iter.py:122);
+    in the main process it returns None."""
+    from paddle_tpu.io import (DataLoader, IterableDataset,
+                               get_worker_info)
+    assert get_worker_info() is None
+
+    class Sharded(IterableDataset):
+        def __iter__(self):
+            info = get_worker_info()
+            n = 8
+            if info is None:
+                lo, hi, wid = 0, n, -1
+            else:
+                per = n // info.num_workers
+                lo = info.id * per
+                hi = n if info.id == info.num_workers - 1 else lo + per
+                wid = info.id
+            for i in range(lo, hi):
+                yield np.array([i, wid], np.int64)
+
+    loader = DataLoader(Sharded(), batch_size=2, num_workers=2)
+    rows = [r for batch in loader
+            for r in np.asarray(batch).reshape(-1, 2)]
+    seen = sorted(int(r[0]) for r in rows)
+    wids = {int(r[1]) for r in rows}
+    assert seen == list(range(8)), seen
+    # REAL worker processes produced the stream (info was populated
+    # with both ids), not a single-process fallback (wid would be -1)
+    assert wids == {0, 1}, wids
+
+
+def test_utils_functions(tmp_path):
+    import paddle_tpu.utils as U
+    U.require_version("0.0.1")
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        U.require_version("999.0")
+
+    @U.deprecated(update_to="paddle_tpu.fresh", since="0.1")
+    def old_fn():
+        return 41
+
+    import warnings
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert old_fn() == 41
+    assert any("Deprecated" in str(r.message) for r in rec)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        layers.data("x", [-1, 2])
+    p = tmp_path / "prog.json"
+    U.dump_config(main, str(p))
+    assert p.read_text()
