@@ -62,3 +62,48 @@ try:
 except ImportError:
     pass
 from .batch import batch  # noqa: F401
+
+# -- 2.0-alpha top-level surface (reference python/paddle/__init__.py) ------
+from .tensor.compat import *  # noqa: F401,F403
+from .core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+from .core.generator import seed as manual_seed  # noqa: F401
+from .core.program import VarDesc as Variable  # noqa: F401
+from .static.param_attr import ParamAttr  # noqa: F401
+from .optimizer.lr_scheduler import (  # noqa: F401
+    NoamDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay,
+)
+from .distributed.parallel import DataParallel  # noqa: F401
+from .core.place import XLAPlace as XPUPlace  # noqa: F401
+
+# LoD containers: ragged sequences are padded+lengths here (io/bucketing
+# is the documented redesign); the NAMES alias the eager tensor / a list
+# so isinstance checks in ported code keep working.
+LoDTensor = Tensor
+LoDTensorArray = list
+
+
+class SaveLoadConfig:
+    """jit save/load options bag (reference fluid/dygraph/jit.py
+    SaveLoadConfig): carried fields are honored by jit.save/load where
+    they exist; the rest are accepted for parity."""
+
+    def __init__(self):
+        self.output_spec = None
+        self.model_filename = None
+        self.params_filename = None
+        self.separate_params = False
+        self.keep_name_table = False
+
+
+def get_cuda_rng_state():
+    """Parity shim: the RNG is the stateless fold_in generator
+    (core/generator.py); returns its seed state."""
+    from .core.generator import global_seed
+    return [global_seed()]
+
+
+def set_cuda_rng_state(state):
+    from .core.generator import seed as _set_seed
+    if state:
+        _set_seed(int(state[0]))

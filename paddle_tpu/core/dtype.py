@@ -90,3 +90,19 @@ def is_floating(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")
+
+
+# -- default dtype (paddle.get/set_default_dtype) ---------------------------
+_DEFAULT_DTYPE = "float32"
+
+
+def set_default_dtype(d):
+    global _DEFAULT_DTYPE
+    name = convert_dtype(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise ValueError(f"unsupported default dtype {d!r}")
+    _DEFAULT_DTYPE = name
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE

@@ -40,6 +40,15 @@ class Tensor:
             want = np_dtype(convert_dtype(dtype))
             if arr.dtype != want:
                 arr = arr.astype(want)
+        elif not isinstance(value, jnp.ndarray) and \
+                not hasattr(value, "dtype") and \
+                arr.dtype == jnp.float32:
+            # python floats/lists follow paddle.set_default_dtype; typed
+            # inputs (numpy/jax arrays) keep their own dtype
+            from ..core.dtype import get_default_dtype
+            want = np_dtype(get_default_dtype())
+            if arr.dtype != want:
+                arr = arr.astype(want)
         if place is not None:
             dev = place.jax_device() if hasattr(place, "jax_device") else place
             arr = jax.device_put(arr, dev)

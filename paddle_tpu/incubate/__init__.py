@@ -1,3 +1,4 @@
 """paddle.incubate — pre-stable capability tier (reference
 fluid/incubate/): auto-checkpoint elastic recovery."""
 from . import checkpoint  # noqa: F401
+from . import reader  # noqa: F401

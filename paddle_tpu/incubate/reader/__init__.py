@@ -1,0 +1,23 @@
+"""paddle.incubate.reader (reference fluid/contrib/reader/
+distributed_reader.py): shard a batch reader across PADDLE_TRAINERS_NUM
+processes by round-robin on batch index."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer keeps every trainers_num-th batch (offset by its
+    PADDLE_TRAINER_ID), so the global stream partitions exactly."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num, (trainer_id, trainers_num)
+
+    def reader():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers_num == trainer_id:
+                yield batch
+
+    return reader

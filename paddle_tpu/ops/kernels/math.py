@@ -359,3 +359,10 @@ def isinf_v2(ins, attrs, ctx):
 @register_op("isnan_v2", inputs=["X!"], outputs=["Out"], grad=None)
 def isnan_v2(ins, attrs, ctx):
     return {"Out": jnp.isnan(ins["X"])}
+
+
+@register_op("einsum", inputs=["Operands*"], outputs=["Out"])
+def einsum_op(ins, attrs, ctx):
+    """paddle.einsum lowering: one jnp.einsum per equation (XLA emits
+    the optimal contraction on the MXU)."""
+    return {"Out": jnp.einsum(attrs["equation"], *ins["Operands"])}

@@ -275,3 +275,22 @@ __all__ += ["NoamLR", "PiecewiseLR", "NaturalExpLR", "InverseTimeLR",
             "PolynomialLR", "LinearLrWarmup", "ExponentialLR",
             "MultiStepLR", "StepLR", "LambdaLR", "ReduceLROnPlateau",
             "CosineAnnealingLR"]
+
+
+class CosineDecay(LRScheduler):
+    """fluid.dygraph CosineDecay: lr * 0.5 * (cos(epoch*pi/epochs)+1)
+    with epoch = step // step_each_epoch."""
+
+    def __init__(self, learning_rate, step_each_epoch, epochs,
+                 last_epoch=-1, verbose=False):
+        self.step_each_epoch = int(step_each_epoch)
+        self.epochs = int(epochs)
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        epoch = self.last_epoch // self.step_each_epoch
+        return self.base_lr * 0.5 * (
+            math.cos(epoch * math.pi / self.epochs) + 1)
+
+
+__all__ += ["CosineDecay"]

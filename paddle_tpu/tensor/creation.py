@@ -27,18 +27,19 @@ def _shape_list(shape):
 
 
 def full(shape, fill_value, dtype=None, name=None):
-    dtype = convert_dtype(dtype or "float32")
+    from ..core.dtype import get_default_dtype
+    dtype = convert_dtype(dtype or get_default_dtype())
     return dispatch("fill_constant", {},
                     {"shape": _shape_list(shape), "dtype": dtype,
                      "value": float(fill_value)}, name=name)
 
 
 def zeros(shape, dtype=None, name=None):
-    return full(shape, 0.0, dtype or "float32", name)
+    return full(shape, 0.0, dtype, name)
 
 
 def ones(shape, dtype=None, name=None):
-    return full(shape, 1.0, dtype or "float32", name)
+    return full(shape, 1.0, dtype, name)
 
 
 def full_like(x, fill_value, dtype=None, name=None):

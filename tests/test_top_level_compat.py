@@ -1,0 +1,86 @@
+"""2.0-alpha top-level surface (reference python/paddle/__init__.py):
+fluid-spelled functionals, einsum, addcmul, default dtype, rng state,
+LoD aliases — all importable from the package root and dual-mode where
+meaningful."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+
+
+def test_eager_compat_functions():
+    a = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = paddle.to_tensor(np.ones((3, 2), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.einsum("ij,jk->ik", a, b).numpy()),
+        np.asarray(a.numpy()) @ np.asarray(b.numpy()))
+    np.testing.assert_allclose(
+        np.asarray(paddle.addcmul(a, a, a, value=2.0).numpy()),
+        np.asarray(a.numpy()) + 2.0 * np.asarray(a.numpy()) ** 2)
+    assert not bool(np.asarray(paddle.has_inf(a).numpy()))
+    bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+    assert bool(np.asarray(paddle.has_nan(bad).numpy()))
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.reduce_mean(a).numpy())), 2.5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.elementwise_sub(a, a).numpy()), 0.0)
+    s = paddle.elementwise_sum([a, a, a])
+    np.testing.assert_allclose(np.asarray(s.numpy()),
+                               3 * np.asarray(a.numpy()))
+
+
+def test_static_einsum_records_op():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2, 3])
+        y = layers.data("y", [-1, 3, 4])
+        out = paddle.einsum("bij,bjk->bik", x, y)
+        assert any(op.type == "einsum"
+                   for op in main.global_block().ops)
+    exe, sc = static.Executor(), static.Scope()
+    xa = np.random.RandomState(0).rand(2, 2, 3).astype(np.float32)
+    ya = np.random.RandomState(1).rand(2, 3, 4).astype(np.float32)
+    with static.scope_guard(sc):
+        exe.run(startup)
+        (got,) = exe.run(main, feed={"x": xa, "y": ya},
+                         fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got),
+                               np.einsum("bij,bjk->bik", xa, ya),
+                               rtol=1e-5)
+
+
+def test_default_dtype_and_rng_state():
+    assert paddle.get_default_dtype() == "float32"
+    paddle.set_default_dtype("float64")
+    try:
+        assert paddle.get_default_dtype() == "float64"
+        # creation paths honor the default for UNTYPED (python) inputs
+        assert str(paddle.to_tensor([1.0, 2.0]).dtype) == "float64"
+        assert str(paddle.full([2], 3.0).dtype) == "float64"
+        # ...while typed inputs keep their own dtype
+        assert str(paddle.to_tensor(
+            np.ones(2, np.float32)).dtype) == "float32"
+        with pytest.raises(ValueError):
+            paddle.set_default_dtype("int8")
+        # numpy dtype CLASS form accepted like the reference
+        paddle.set_default_dtype(np.float32)
+        assert paddle.get_default_dtype() == "float32"
+    finally:
+        paddle.set_default_dtype("float32")
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    paddle.manual_seed(7)
+
+
+def test_aliases_exist():
+    assert paddle.LoDTensor is paddle.Tensor
+    assert paddle.LoDTensorArray is list
+    assert paddle.Variable is not None
+    assert paddle.ParamAttr is not None
+    assert paddle.DataParallel is not None
+    assert paddle.XPUPlace is not None
+    assert paddle.SaveLoadConfig() is not None
+    assert paddle.CosineDecay(0.1, step_each_epoch=10, epochs=4) \
+        .get_lr() == pytest.approx(0.1)
