@@ -101,6 +101,12 @@ def set_default_dtype(d):
     name = convert_dtype(d)
     if name not in ("float16", "bfloat16", "float32", "float64"):
         raise ValueError(f"unsupported default dtype {d!r}")
+    if name == "float64":
+        # jax truncates f64 to f32 unless x64 is on — enabling it here
+        # makes the contract real outside the test harness (left on when
+        # switching back: disabling would invalidate live f64 arrays)
+        import jax
+        jax.config.update("jax_enable_x64", True)
     _DEFAULT_DTYPE = name
 
 

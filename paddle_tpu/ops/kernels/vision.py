@@ -280,8 +280,22 @@ def prior_box(ins, attrs, ctx):
                 ars.append(1.0 / ar)
     sw = step_w if step_w > 0 else iw / w
     sh = step_h if step_h > 0 else ih / h
+    min_max_order = attrs.get("min_max_aspect_ratios_order", False)
     boxes = []
     for ms in min_sizes:
+        if min_max_order:
+            # reference flag: [min(ar=1), max, remaining ratios] so
+            # pretrained loc/conf channel order matches
+            boxes.append((ms / 2, ms / 2))
+            if max_sizes:
+                for mx in max_sizes:
+                    s = (ms * mx) ** 0.5 / 2
+                    boxes.append((s, s))
+            for ar in ars[1:]:
+                bw = ms * (ar ** 0.5) / 2
+                bh = ms / (ar ** 0.5) / 2
+                boxes.append((bw, bh))
+            continue
         for ar in ars:
             bw = ms * (ar ** 0.5) / 2
             bh = ms / (ar ** 0.5) / 2

@@ -250,6 +250,171 @@ def _triple(v):
     return list(v) if isinstance(v, (list, tuple)) else [int(v)] * 3
 
 
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    """layers/nn.py conv3d_transpose: NCDHW transpose conv; filter
+    layout [Cin, Cout/groups, kd, kh, kw] like the reference."""
+    helper = LayerHelper("conv3d_transpose", name=name)
+    c_in = input.shape[1]
+    if filter_size is None:
+        # derive the kernel from the requested output size, like
+        # conv2d_transpose (reference layers/nn.py)
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose: one of output_size / filter_size "
+                "required")
+        import builtins
+        osize = _triple(output_size)
+        strides_, pads_ = _triple(stride), _triple(padding)
+        dils_ = _triple(dilation)
+        ks = []
+        for i in builtins.range(3):
+            in_i = input.shape[2 + i]
+            k = ((osize[i] - (in_i - 1) * strides_[i] + 2 * pads_[i] - 1)
+                 // dils_[i] + 1)
+            ks.append(int(k))
+        filter_size = ks
+    ks = _triple(filter_size)
+    w = helper.create_parameter(
+        param_attr, [c_in, num_filters // groups] + ks, input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": input, "Filter": w},
+                     outputs={"Output": out},
+                     attrs={"strides": _triple(stride),
+                            "paddings": _triple(padding),
+                            "dilations": _triple(dilation),
+                            "groups": groups})
+    b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                is_bias=True)
+    if b is not None:
+        pre = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": out, "Y": b},
+                         outputs={"Out": pre}, attrs={"axis": 1})
+        out = pre
+    return helper.append_activation(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """layers/nn.py data_norm: normalization by accumulated batch
+    statistics (persistable BatchSize/BatchSum/BatchSquareSum), the CTR
+    models' input normalizer."""
+    helper = LayerHelper("data_norm", name=name)
+    c = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
+    from .initializer import Constant
+    stats = {}
+    for key, init in (("BatchSize", 1e4), ("BatchSum", 0.0),
+                      ("BatchSquareSum", 1e4)):
+        v = helper.main_program.global_block().create_var(
+            name=unique_name(f"{helper.name}_{key}"), shape=(c,),
+            dtype=input.dtype, persistable=True, stop_gradient=True)
+        Constant(init)(v, helper.startup_program.global_block())
+        stats[key] = v
+    y = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype)
+    scales = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("data_norm",
+                     inputs={"X": input, "BatchSize": stats["BatchSize"],
+                             "BatchSum": stats["BatchSum"],
+                             "BatchSquareSum": stats["BatchSquareSum"]},
+                     outputs={"Y": y, "Means": means, "Scales": scales},
+                     attrs={"epsilon": epsilon,
+                            "data_layout": data_layout})
+    return helper.append_activation(y, act)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """layers/detection.py multi_box_head — the SSD prediction head:
+    per feature map, conv loc/conf predictions + prior boxes; outputs
+    (mbox_locs, mbox_confs, boxes, variances) concatenated across maps."""
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max_ratio
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes, max_sizes = [], []
+        import builtins
+        ratio_step = int((max_ratio - min_ratio) / builtins.max(n_maps - 2, 1))
+        for ratio in builtins.range(min_ratio, max_ratio + 1, ratio_step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + ratio_step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    helper = LayerHelper("multi_box_head", name=name)
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        ms = min_sizes[i]
+        mxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        ar = list(ar) if isinstance(ar, (list, tuple)) else [ar]
+        boxes = helper.create_variable_for_type_inference("float32")
+        variances = helper.create_variable_for_type_inference("float32")
+        attrs = {"min_sizes": [float(ms)],
+                 "aspect_ratios": [float(a) for a in ar],
+                 "variances": list(variance), "flip": flip, "clip": clip,
+                 "offset": offset}
+        if mxs:
+            attrs["max_sizes"] = [float(mxs)]
+        if steps:
+            attrs["step_w"] = float(steps[i])
+            attrs["step_h"] = float(steps[i])
+        if step_w:
+            attrs["step_w"] = float(step_w[i]
+                                    if isinstance(step_w, (list, tuple))
+                                    else step_w)
+        if step_h:
+            attrs["step_h"] = float(step_h[i]
+                                    if isinstance(step_h, (list, tuple))
+                                    else step_h)
+        if min_max_aspect_ratios_order:
+            attrs["min_max_aspect_ratios_order"] = True
+        helper.append_op("prior_box", inputs={"Input": x, "Image": image},
+                         outputs={"Boxes": boxes, "Variances": variances},
+                         attrs=attrs)
+        # priors per cell must mirror the prior_box kernel's expansion:
+        # dedup([1.0] + ratios (+ flipped)) per min_size, +1 per max_size
+        import builtins
+        ars_full = [1.0]
+        for a in ar:
+            if builtins.all(builtins.abs(a - b) > 1e-6
+                            for b in ars_full):
+                ars_full.append(a)
+                if flip and builtins.abs(a - 1.0) > 1e-6:
+                    ars_full.append(1.0 / a)
+        num_priors = len(ars_full) + (1 if mxs else 0)
+        loc = conv2d(x, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(x, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        # [N, P*4, H, W] -> [N, H*W*P, 4]
+        loc = transpose(loc, [0, 2, 3, 1])
+        loc = reshape(loc, [0, -1, 4])
+        conf = transpose(conf, [0, 2, 3, 1])
+        conf = reshape(conf, [0, -1, num_classes])
+        boxes = reshape(boxes, [-1, 4])
+        variances = reshape(variances, [-1, 4])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(boxes)
+        vars_all.append(variances)
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    box = concat(boxes_all, axis=0)
+    var = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, box, var
+
+
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, ceil_mode=False,
            exclusive=True, name=None, data_format="NCHW"):
@@ -2588,7 +2753,7 @@ from .layer_generator import generate_layer_fns as _generate_layer_fns  # noqa: 
 
 _GENERATED_LAYERS = _generate_layer_fns(globals(), dir())
 __all__ += _GENERATED_LAYERS
-__all__ += ["mean_iou", "Print", "square_error_cost"]
+__all__ += ["mean_iou", "Print", "square_error_cost", "conv3d_transpose", "data_norm", "multi_box_head"]
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,

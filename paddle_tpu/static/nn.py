@@ -1,0 +1,18 @@
+"""paddle.static.nn — the 2.0 static layer namespace (reference
+python/paddle/static/nn/__init__.py): re-exports of the fluid layer
+functions that stay static-graph-only in 2.0."""
+from .layers import (  # noqa: F401
+    fc, batch_norm, embedding, bilinear_tensor_product, conv2d,
+    conv2d_transpose, conv3d, conv3d_transpose, crf_decoding, data_norm,
+    deformable_conv, group_norm, hsigmoid, instance_norm, layer_norm,
+    multi_box_head, nce, prelu, row_conv, spectral_norm,
+)
+from .control_flow import case, switch_case, cond  # noqa: F401
+from ..tensor.compat import create_parameter  # noqa: F401
+
+__all__ = ["fc", "batch_norm", "embedding", "bilinear_tensor_product",
+           "case", "conv2d", "conv2d_transpose", "conv3d",
+           "conv3d_transpose", "create_parameter", "crf_decoding",
+           "data_norm", "deformable_conv", "group_norm", "hsigmoid",
+           "instance_norm", "layer_norm", "multi_box_head", "nce",
+           "prelu", "row_conv", "spectral_norm", "switch_case", "cond"]

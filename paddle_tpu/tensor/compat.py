@@ -44,67 +44,28 @@ def has_nan(x):
 
 
 # -- fluid spellings over the 2.0 functional surface ------------------------
-def elementwise_add(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_add", {"X": x, "Y": y}, {"axis": axis})
+def _ew_compat(op_type):
+    def f(x, y, axis=-1, name=None):
+        from ._dispatch import wrap_data
+        y = wrap_data(y, like=x)
+        x = wrap_data(x, like=y)
+        return dispatch(op_type, {"X": x, "Y": y}, {"axis": axis})
+
+    f.__name__ = op_type
+    f.__doc__ = (f"fluid {op_type}(x, y, axis=-1): broadcasts y against "
+                 f"x starting at `axis` like the reference layer.")
+    return f
 
 
-def elementwise_sub(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_sub", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_mul(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_mul", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_div(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_div", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_mod(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_mod", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_pow(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_pow", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_floordiv(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_floordiv", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_max(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_max", {"X": x, "Y": y}, {"axis": axis})
-
-
-def elementwise_min(x, y, axis=-1, name=None):
-    from ._dispatch import wrap_data
-    y = wrap_data(y, like=x)
-    x = wrap_data(x, like=y)
-    return dispatch("elementwise_min", {"X": x, "Y": y}, {"axis": axis})
+elementwise_add = _ew_compat("elementwise_add")
+elementwise_sub = _ew_compat("elementwise_sub")
+elementwise_mul = _ew_compat("elementwise_mul")
+elementwise_div = _ew_compat("elementwise_div")
+elementwise_mod = _ew_compat("elementwise_mod")
+elementwise_pow = _ew_compat("elementwise_pow")
+elementwise_floordiv = _ew_compat("elementwise_floordiv")
+elementwise_max = _ew_compat("elementwise_max")
+elementwise_min = _ew_compat("elementwise_min")
 
 
 def elementwise_sum(inputs, name=None):
@@ -145,10 +106,15 @@ def reduce_any(input, dim=None, keep_dim=False, name=None):
 def fill_constant(shape, dtype, value, force_cpu=False, out=None,
                   name=None):
     """Dual-mode fill: eager -> full; static -> the fill_constant
-    layer."""
+    layer.  `out` is honored in BOTH modes (eager writes the result
+    into the given tensor, the fluid in-place idiom)."""
     from ..dygraph.base import in_dygraph_mode
     if in_dygraph_mode():
-        return _creation.full(shape, value, dtype=dtype)
+        result = _creation.full(shape, value, dtype=dtype)
+        if out is not None:
+            out._value = result._value
+            return out
+        return result
     from ..static import layers
     return layers.fill_constant(shape, dtype, value, force_cpu=force_cpu,
                                 out=out, name=name)

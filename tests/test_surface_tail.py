@@ -198,3 +198,44 @@ def test_utils_functions(tmp_path):
     p = tmp_path / "prog.json"
     U.dump_config(main, str(p))
     assert p.read_text()
+
+
+def test_static_nn_namespace_and_new_layers():
+    """paddle.static.nn (reference python/paddle/static/nn): the 2.0
+    static layer namespace + conv3d_transpose/data_norm/multi_box_head
+    layers (reference layers/nn.py, layers/detection.py)."""
+    import paddle_tpu.static.nn as sn
+    assert sn.fc is not None and sn.case is not None
+    main, startup = static.Program(), static.Program()
+    rng = np.random.RandomState(0)
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 2, 3, 8, 8])
+        up = sn.conv3d_transpose(x, 4, filter_size=2, stride=2)
+        img = layers.data("img", [-1, 3, 64, 64])
+        f1 = layers.data("f1", [-1, 8, 8, 8])
+        f2 = layers.data("f2", [-1, 8, 4, 4])
+        locs, confs, box, var = sn.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0, 3.0]], min_ratio=20,
+            max_ratio=90)
+        d = layers.data("d", [-1, 6])
+        dn = sn.data_norm(d)
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        out = exe.run(main, feed={
+            "x": rng.rand(1, 2, 3, 8, 8).astype(np.float32),
+            "img": rng.rand(1, 3, 64, 64).astype(np.float32),
+            "f1": rng.rand(1, 8, 8, 8).astype(np.float32),
+            "f2": rng.rand(1, 8, 4, 4).astype(np.float32),
+            "d": rng.rand(4, 6).astype(np.float32),
+        }, fetch_list=[up, locs, confs, box, var, dn])
+    assert np.asarray(out[0]).shape == (1, 4, 6, 16, 16)
+    locs_a, confs_a, box_a, var_a = (np.asarray(out[1]),
+                                     np.asarray(out[2]),
+                                     np.asarray(out[3]),
+                                     np.asarray(out[4]))
+    # SSD contract: one (loc, conf) per prior, aligned across maps
+    assert locs_a.shape[1] == box_a.shape[0] == var_a.shape[0]
+    assert locs_a.shape[2] == 4 and confs_a.shape[2] == 3
+    assert np.asarray(out[5]).shape == (4, 6)
