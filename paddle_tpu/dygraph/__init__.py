@@ -15,3 +15,4 @@ from .engine import grad  # noqa: F401
 from .layers import (  # noqa: F401
     Layer, Sequential, LayerList, ParameterList, ParamBase,
 )
+from ..jit import ProgramTranslator  # noqa: F401

@@ -96,6 +96,21 @@ def _conv_transpose_nd(x, w, attrs, ndims):
         x, wk, (1,) * ndims, padding, lhs_dilation=strides,
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups)
+    osize = attrs.get("output_size") or []
+    if osize:
+        # transpose-conv output is ambiguous up to stride-1: the
+        # reference's output_size attr selects the exact size; sizes
+        # beyond the natural one are end-padded zeros (the extra input
+        # positions a larger forward conv would have consumed)
+        pads = [(0, 0), (0, 0)]
+        for i, want in enumerate(osize):
+            have = out.shape[2 + i]
+            if not have <= want < have + strides[i]:
+                raise ValueError(
+                    f"conv_transpose output_size[{i}]={want} invalid: "
+                    f"must be in [{have}, {have + strides[i] - 1}]")
+            pads.append((0, want - have))
+        out = jnp.pad(out, pads)
     return out.astype(x.dtype)
 
 
@@ -409,14 +424,27 @@ def lrn(ins, attrs, ctx):
 
 
 @register_op("data_norm", inputs=["X", "BatchSize", "BatchSum",
-                                  "BatchSquareSum"],
+                                  "BatchSquareSum", "scale_w?", "bias?"],
              outputs=["Y", "Means", "Scales"])
 def data_norm(ins, attrs, ctx):
     x = ins["X"]
     bsize, bsum, bsq = ins["BatchSize"], ins["BatchSum"], ins["BatchSquareSum"]
     means = bsum / bsize
     scales = jnp.sqrt(bsize / bsq)
-    return {"Y": (x - means) * scales, "Means": means, "Scales": scales}
+    # stats are per-CHANNEL; reshape so they broadcast along the layout's
+    # channel axis, not blindly along the last axis
+    layout = attrs.get("data_layout", "NCHW")
+    caxis = x.ndim - 1 if (layout == "NHWC" or x.ndim <= 2) else 1
+    bshape = [1] * x.ndim
+    bshape[caxis] = means.shape[0]
+    m = means.reshape(bshape)
+    s = scales.reshape(bshape)
+    y = (x - m) * s
+    if ins.get("scale_w") is not None:
+        y = y * ins["scale_w"].reshape(bshape)
+    if ins.get("bias") is not None:
+        y = y + ins["bias"].reshape(bshape)
+    return {"Y": y, "Means": means, "Scales": scales}
 
 
 @register_op("spectral_norm", inputs=["Weight", "U", "V"], outputs=["Out"])

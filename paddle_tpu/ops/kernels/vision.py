@@ -257,6 +257,20 @@ def roi_pool(ins, attrs, ctx):
     return {"Out": out, "Argmax": jnp.zeros_like(out, dtype=jnp.int64)}
 
 
+def expand_aspect_ratios(ars_in, flip):
+    """The reference's ExpandAspectRatios: dedup([1.0] + ratios
+    (+ flipped)).  ONE definition shared by the prior_box kernel and
+    layers.multi_box_head's prior-count mirror — the two must stay
+    identical or loc/conf channels desync from the emitted priors."""
+    ars = [1.0]
+    for ar in ars_in:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    return ars
+
+
 @register_op("prior_box", inputs=["Input!", "Image!"],
              outputs=["Boxes", "Variances"], grad=None)
 def prior_box(ins, attrs, ctx):
@@ -272,25 +286,22 @@ def prior_box(ins, attrs, ctx):
     clip = attrs.get("clip", False)
     h, w = feat.shape[2], feat.shape[3]
     ih, iw = img.shape[2], img.shape[3]
-    ars = [1.0]
-    for ar in ars_in:
-        if all(abs(ar - a) > 1e-6 for a in ars):
-            ars.append(ar)
-            if flip:
-                ars.append(1.0 / ar)
+    ars = expand_aspect_ratios(ars_in, flip)
     sw = step_w if step_w > 0 else iw / w
     sh = step_h if step_h > 0 else ih / h
     min_max_order = attrs.get("min_max_aspect_ratios_order", False)
     boxes = []
-    for ms in min_sizes:
+    for si, ms in enumerate(min_sizes):
+        # reference prior_box_op.h:116 PAIRS max_sizes[s] with
+        # min_sizes[s] — never a cross-product
+        mx = max_sizes[si] if si < len(max_sizes) else None
         if min_max_order:
             # reference flag: [min(ar=1), max, remaining ratios] so
             # pretrained loc/conf channel order matches
             boxes.append((ms / 2, ms / 2))
-            if max_sizes:
-                for mx in max_sizes:
-                    s = (ms * mx) ** 0.5 / 2
-                    boxes.append((s, s))
+            if mx is not None:
+                s = (ms * mx) ** 0.5 / 2
+                boxes.append((s, s))
             for ar in ars[1:]:
                 bw = ms * (ar ** 0.5) / 2
                 bh = ms / (ar ** 0.5) / 2
@@ -300,10 +311,9 @@ def prior_box(ins, attrs, ctx):
             bw = ms * (ar ** 0.5) / 2
             bh = ms / (ar ** 0.5) / 2
             boxes.append((bw, bh))
-        if max_sizes:
-            for mx in max_sizes:
-                s = (ms * mx) ** 0.5 / 2
-                boxes.append((s, s))
+        if mx is not None:
+            s = (ms * mx) ** 0.5 / 2
+            boxes.append((s, s))
     cx = (jnp.arange(w) + offset) * sw
     cy = (jnp.arange(h) + offset) * sh
     gx, gy = jnp.meshgrid(cx, cy, indexing="xy")

@@ -227,11 +227,13 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     w = helper.create_parameter(param_attr, [c_in, num_filters // groups] + ks,
                                 input.dtype)
     out = helper.create_variable_for_type_inference(input.dtype)
+    attrs2 = {"strides": _pair(stride), "paddings": _pair(padding),
+              "dilations": _pair(dilation), "groups": groups}
+    if output_size is not None:
+        attrs2["output_size"] = _pair(output_size)
     helper.append_op("conv2d_transpose",
                      inputs={"Input": input, "Filter": w},
-                     outputs={"Output": out},
-                     attrs={"strides": _pair(stride), "paddings": _pair(padding),
-                            "dilations": _pair(dilation), "groups": groups})
+                     outputs={"Output": out}, attrs=attrs2)
     b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
                                 is_bias=True)
     if b is not None:
@@ -280,13 +282,13 @@ def conv3d_transpose(input, num_filters, output_size=None,
     w = helper.create_parameter(
         param_attr, [c_in, num_filters // groups] + ks, input.dtype)
     out = helper.create_variable_for_type_inference(input.dtype)
+    attrs3 = {"strides": _triple(stride), "paddings": _triple(padding),
+              "dilations": _triple(dilation), "groups": groups}
+    if output_size is not None:
+        attrs3["output_size"] = _triple(output_size)
     helper.append_op("conv3d_transpose",
                      inputs={"Input": input, "Filter": w},
-                     outputs={"Output": out},
-                     attrs={"strides": _triple(stride),
-                            "paddings": _triple(padding),
-                            "dilations": _triple(dilation),
-                            "groups": groups})
+                     outputs={"Output": out}, attrs=attrs3)
     b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
                                 is_bias=True)
     if b is not None:
@@ -307,7 +309,9 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     statistics (persistable BatchSize/BatchSum/BatchSquareSum), the CTR
     models' input normalizer."""
     helper = LayerHelper("data_norm", name=name)
-    c = input.shape[-1] if data_layout == "NHWC" else input.shape[1]
+    ndim = len(input.shape)
+    c = input.shape[-1] if (data_layout == "NHWC" or ndim <= 2) \
+        else input.shape[1]
     from .initializer import Constant
     stats = {}
     for key, init in (("BatchSize", 1e4), ("BatchSum", 0.0),
@@ -320,10 +324,18 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     y = helper.create_variable_for_type_inference(input.dtype)
     means = helper.create_variable_for_type_inference(input.dtype)
     scales = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op("data_norm",
-                     inputs={"X": input, "BatchSize": stats["BatchSize"],
-                             "BatchSum": stats["BatchSum"],
-                             "BatchSquareSum": stats["BatchSquareSum"]},
+    dn_inputs = {"X": input, "BatchSize": stats["BatchSize"],
+                 "BatchSum": stats["BatchSum"],
+                 "BatchSquareSum": stats["BatchSquareSum"]}
+    if enable_scale_and_shift:
+        from .initializer import Constant as _Const
+        dn_inputs["scale_w"] = helper.create_parameter(
+            param_attr, [c], input.dtype,
+            default_initializer=_Const(1.0))
+        dn_inputs["bias"] = helper.create_parameter(
+            param_attr, [c], input.dtype, is_bias=True,
+            default_initializer=_Const(0.0))
+    helper.append_op("data_norm", inputs=dn_inputs,
                      outputs={"Y": y, "Means": means, "Scales": scales},
                      attrs={"epsilon": epsilon,
                             "data_layout": data_layout})
@@ -382,17 +394,10 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         helper.append_op("prior_box", inputs={"Input": x, "Image": image},
                          outputs={"Boxes": boxes, "Variances": variances},
                          attrs=attrs)
-        # priors per cell must mirror the prior_box kernel's expansion:
-        # dedup([1.0] + ratios (+ flipped)) per min_size, +1 per max_size
-        import builtins
-        ars_full = [1.0]
-        for a in ar:
-            if builtins.all(builtins.abs(a - b) > 1e-6
-                            for b in ars_full):
-                ars_full.append(a)
-                if flip and builtins.abs(a - 1.0) > 1e-6:
-                    ars_full.append(1.0 / a)
-        num_priors = len(ars_full) + (1 if mxs else 0)
+        # priors per cell come from the SAME expansion the kernel uses
+        from ..ops.kernels.vision import expand_aspect_ratios
+        num_priors = len(expand_aspect_ratios(ar, flip)) \
+            + (1 if mxs else 0)
         loc = conv2d(x, num_priors * 4, kernel_size, stride=stride,
                      padding=pad)
         conf = conv2d(x, num_priors * num_classes, kernel_size,

@@ -1,0 +1,88 @@
+"""Systematic API-surface parity against the reference's public
+__init__ files: every quoted public name in a reference namespace's
+__init__ must resolve on the corresponding paddle_tpu module.
+
+Skipped when the reference checkout is not mounted (the suite must be
+self-contained elsewhere); under the build/judge environment this locks
+the audited namespaces at zero missing names.
+"""
+import os
+import re
+
+import pytest
+
+REF = "/root/reference/python/paddle/"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted")
+
+# (reference __init__ relative path, paddle_tpu module path)
+NAMESPACES = [
+    ("__init__.py", "paddle_tpu"),
+    ("nn/__init__.py", "paddle_tpu.nn"),
+    ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
+    ("nn/layer/__init__.py", "paddle_tpu.nn.layer"),
+    ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
+    ("tensor/__init__.py", "paddle_tpu.tensor"),
+    ("optimizer/__init__.py", "paddle_tpu.optimizer"),
+    ("metric/__init__.py", "paddle_tpu.metric"),
+    ("distributed/__init__.py", "paddle_tpu.distributed"),
+    ("distributed/fleet/__init__.py", "paddle_tpu.distributed.fleet"),
+    ("distributed/fleet/utils/__init__.py",
+     "paddle_tpu.distributed.fleet.utils"),
+    ("static/__init__.py", "paddle_tpu.static"),
+    ("static/nn/__init__.py", "paddle_tpu.static.nn"),
+    ("io/__init__.py", "paddle_tpu.io"),
+    ("vision/__init__.py", "paddle_tpu.vision"),
+    ("vision/models/__init__.py", "paddle_tpu.vision.models"),
+    ("vision/transforms/__init__.py", "paddle_tpu.vision.transforms"),
+    ("text/__init__.py", "paddle_tpu.text"),
+    ("hapi/__init__.py", "paddle_tpu.hapi"),
+    ("jit/__init__.py", "paddle_tpu.jit"),
+    ("inference/__init__.py", "paddle_tpu.inference"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("utils/__init__.py", "paddle_tpu.utils"),
+]
+
+# docstring/header tokens the quoted-string scrape inevitably picks up
+NOISE = {"License", "Apache", "AS", "print_function", "unicode_literals",
+         "division", "utf", "paddle", "fluid"}
+
+
+def _public_names(ref_file):
+    # drop comment lines first: commented-out __all__ entries (e.g.
+    # io's '#Transform') are not public surface
+    text = "\n".join(l for l in open(ref_file).read().splitlines()
+                     if not l.lstrip().startswith("#"))
+    names = set(re.findall(r"'([A-Za-z_]\w*)'", text))
+    names |= set(re.findall(r'"([A-Za-z_]\w*)"', text))
+    return {n for n in names if not n.startswith("_") and n not in NOISE}
+
+
+@pytest.mark.parametrize("ref_rel,mod_path", NAMESPACES,
+                         ids=[m for _, m in NAMESPACES])
+def test_namespace_surface(ref_rel, mod_path):
+    import importlib
+    ref_file = os.path.join(REF, ref_rel)
+    if not os.path.exists(ref_file):
+        pytest.skip(f"no reference file {ref_rel}")
+    import types
+    mod = importlib.import_module(mod_path)
+    mine = set(dir(mod))
+    missing = sorted(_public_names(ref_file) - mine)
+    # a name counts as present if a direct SUBMODULE exposes it (the
+    # reference scatters re-exports across submodules); arbitrary class
+    # attributes do NOT count — hasattr over every attr would let any
+    # class's 'name'/'shape' property vacuously satisfy the check
+    truly_missing = []
+    for n in missing:
+        found = False
+        for attr in mine:
+            sub = getattr(mod, attr, None)
+            if isinstance(sub, types.ModuleType) and hasattr(sub, n):
+                found = True
+                break
+        if not found:
+            truly_missing.append(n)
+    assert not truly_missing, (
+        f"{mod_path} lacks reference names: {truly_missing}")
