@@ -31,7 +31,8 @@ def _setup_api():
     for mod in ("dygraph", "tensor", "nn", "optimizer", "static",
                 "distributed", "amp", "metric", "io", "vision", "text",
                 "hapi", "jit", "incubate", "profiler", "utils", "slim",
-                "reader", "dataset", "fluid", "regularizer"):
+                "reader", "dataset", "fluid", "regularizer",
+                "distribution", "compat", "sysconfig", "framework"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:
@@ -83,17 +84,10 @@ LoDTensor = Tensor
 LoDTensorArray = list
 
 
-class SaveLoadConfig:
-    """jit save/load options bag (reference fluid/dygraph/jit.py
-    SaveLoadConfig): carried fields are honored by jit.save/load where
-    they exist; the rest are accepted for parity."""
-
-    def __init__(self):
-        self.output_spec = None
-        self.model_filename = None
-        self.params_filename = None
-        self.separate_params = False
-        self.keep_name_table = False
+try:
+    from .jit import SaveLoadConfig  # noqa: F401
+except ImportError:  # jit is in _setup_api's tolerant list
+    pass
 
 
 def get_cuda_rng_state():

@@ -1,0 +1,65 @@
+"""paddle.compat (reference python/paddle/compat.py): py2/3 text
+helpers kept for ported-code parity."""
+from __future__ import annotations
+
+import builtins
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round",
+           "floor_division", "get_exception_message"]
+
+long_type = int
+
+
+def _map(obj, f, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_map(o, f, inplace) for o in obj]
+            return obj
+        return [_map(o, f, inplace) for o in obj]
+    if isinstance(obj, set):
+        new = {_map(o, f, inplace) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return f(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes -> str recursively over lists/sets (compat.py:36)."""
+
+    def conv(o):
+        if isinstance(o, bytes):
+            return o.decode(encoding)
+        return o
+
+    return _map(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    def conv(o):
+        if isinstance(o, str):
+            return o.encode(encoding)
+        return o
+
+    return _map(obj, conv, inplace)
+
+
+def round(x, d=0):
+    """Python-2-style half-away-from-zero rounding (compat.py:193)."""
+    if x == 0.0:
+        return 0.0
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

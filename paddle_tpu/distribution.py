@@ -1,0 +1,143 @@
+"""paddle.distribution (reference python/paddle/distribution.py):
+Uniform and Normal with sample/log_prob/probs/entropy/kl_divergence.
+
+Built on the dual-mode tensor ops, so densities/entropies are
+TAPE-TRACED: log_prob(actions) on a Normal whose loc/scale are
+trainable tensors backpropagates (the reference builds these from fluid
+layers for the same reason), and sample() is reparameterized
+(loc + scale * eps) so pathwise gradients flow too."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .dygraph.tensor import Tensor
+from . import tensor as T
+
+__all__ = ["Distribution", "Uniform", "Normal"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2 * math.pi)
+
+
+def _as_tensor(v):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(np.asarray(v, dtype=np.float32))
+
+
+def _noise(shape, base_shape, seed, uniform=False):
+    import jax
+    from .core.generator import global_seed, next_eager_uid
+    key = jax.random.PRNGKey(seed if seed
+                             else global_seed() + next_eager_uid())
+    full = tuple(shape) + tuple(base_shape)
+    draw = jax.random.uniform if uniform else jax.random.normal
+    return Tensor(draw(key, full))
+
+
+class Distribution:
+    """Abstract base (reference distribution.py:40)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference distribution.py:167)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+
+    def _base_shape(self):
+        return np.broadcast_shapes(tuple(self.low.shape),
+                                   tuple(self.high.shape))
+
+    def sample(self, shape, seed=0):
+        u = _noise(shape, self._base_shape(), seed, uniform=True)
+        return T.add(self.low,
+                     T.multiply(u, T.subtract(self.high, self.low)))
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _as_tensor(value)
+        span = T.subtract(self.high, self.low)
+        lp = T.scale(T.log(span), scale=-1.0)
+        inside = Tensor(
+            ((v._value > self.low._value)
+             & (v._value < self.high._value)).astype(np.float32))
+        neg_inf = Tensor(jnp.asarray(-np.inf, lp._value.dtype))
+        return T.add(T.multiply(inside, lp),
+                     T.multiply(T.scale(inside, scale=-1.0, bias=1.0),
+                                neg_inf))
+
+    def probs(self, value):
+        v = _as_tensor(value)
+        inv = T.divide(Tensor(np.float32(1.0)),
+                       T.subtract(self.high, self.low))
+        inside = Tensor(
+            ((v._value > self.low._value)
+             & (v._value < self.high._value)).astype(np.float32))
+        return T.multiply(inside, inv)
+
+    def entropy(self):
+        return T.log(T.subtract(self.high, self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distribution.py:392)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def _base_shape(self):
+        return np.broadcast_shapes(tuple(self.loc.shape),
+                                   tuple(self.scale.shape))
+
+    def sample(self, shape, seed=0):
+        z = _noise(shape, self._base_shape(), seed)
+        return T.add(self.loc, T.multiply(z, self.scale))
+
+    def entropy(self):
+        return T.add(T.log(self.scale),
+                     Tensor(np.float32(0.5 + _HALF_LOG_2PI)))
+
+    def log_prob(self, value):
+        v = _as_tensor(value)
+        diff = T.subtract(v, self.loc)
+        var = T.multiply(self.scale, self.scale)
+        quad = T.divide(T.multiply(diff, diff),
+                        T.scale(var, scale=2.0))
+        return T.subtract(
+            T.scale(quad, scale=-1.0),
+            T.add(T.log(self.scale), Tensor(np.float32(_HALF_LOG_2PI))))
+
+    def probs(self, value):
+        return T.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence needs another Normal")
+        ratio = T.divide(self.scale, other.scale)
+        var_ratio = T.multiply(ratio, ratio)
+        d = T.divide(T.subtract(self.loc, other.loc), other.scale)
+        t1 = T.multiply(d, d)
+        return T.scale(
+            T.subtract(T.add(var_ratio, t1),
+                       T.add(T.log(var_ratio),
+                             Tensor(np.float32(1.0)))),
+            scale=0.5)

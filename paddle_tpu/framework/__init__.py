@@ -1,0 +1,25 @@
+"""paddle.framework (reference python/paddle/framework/__init__.py):
+re-export namespace for program/parameter/dtype/rng primitives."""
+from ..tensor.compat import (  # noqa: F401
+    create_global_var, create_parameter,
+)
+from ..static.param_attr import ParamAttr  # noqa: F401
+from ..core.program import VarDesc as Variable  # noqa: F401
+from ..core.place import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace,
+)
+from ..core.dtype import (  # noqa: F401
+    get_default_dtype, set_default_dtype,
+)
+from ..core.generator import seed as manual_seed  # noqa: F401
+from ..dygraph.engine import grad  # noqa: F401
+from ..dygraph.layers import LayerList  # noqa: F401
+from ..dygraph.base import no_grad  # noqa: F401
+from ..dygraph.tensor import to_variable  # noqa: F401
+from ..distributed.parallel import DataParallel  # noqa: F401
+from ..io.framework_io import save, load  # noqa: F401
+from ..optimizer.lr_scheduler import (  # noqa: F401
+    NoamDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay,
+)
+from ..jit import SaveLoadConfig  # noqa: F401

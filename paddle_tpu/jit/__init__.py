@@ -589,3 +589,19 @@ class TracedLayer:
 
 
 __all__ += ["TracedLayer", "set_code_level", "set_verbosity"]
+
+
+class SaveLoadConfig:
+    """jit save/load options bag (reference fluid/dygraph/jit.py
+    SaveLoadConfig): carried fields are honored by jit.save/load where
+    they exist; the rest are accepted for parity."""
+
+    def __init__(self):
+        self.output_spec = None
+        self.model_filename = None
+        self.params_filename = None
+        self.separate_params = False
+        self.keep_name_table = False
+
+
+__all__ += ["SaveLoadConfig"]

@@ -1,0 +1,16 @@
+"""paddle.sysconfig (reference python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers (the native runtime sources here)."""
+    return os.path.join(_PKG, "native", "src")
+
+
+def get_lib():
+    """Directory of the native shared library."""
+    return os.path.join(_PKG, "native")

@@ -42,6 +42,10 @@ NAMESPACES = [
     ("inference/__init__.py", "paddle_tpu.inference"),
     ("incubate/__init__.py", "paddle_tpu.incubate"),
     ("utils/__init__.py", "paddle_tpu.utils"),
+    ("framework/__init__.py", "paddle_tpu.framework"),
+    ("compat.py", "paddle_tpu.compat"),
+    ("sysconfig.py", "paddle_tpu.sysconfig"),
+    ("distribution.py", "paddle_tpu.distribution"),
 ]
 
 # docstring/header tokens the quoted-string scrape inevitably picks up
@@ -54,8 +58,21 @@ def _public_names(ref_file):
     # io's '#Transform') are not public surface
     text = "\n".join(l for l in open(ref_file).read().splitlines()
                      if not l.lstrip().startswith("#"))
-    names = set(re.findall(r"'([A-Za-z_]\w*)'", text))
-    names |= set(re.findall(r'"([A-Za-z_]\w*)"', text))
+    # prefer explicit LITERAL __all__ blocks (exact surface); any
+    # computed __all__ (concatenation, += module.__all__) falls back to
+    # the whole-file scrape — a partial literal would silently shrink
+    # the check, and `__all__ = []` would make it vacuous
+    blocks = re.findall(r"__all__\s*\+?=\s*\[([^\]]*)\]", text)
+    computed = re.search(r"__all__\s*\+?=(?!\s*\[)", text) or \
+        re.search(r"__all__\s*\+?=\s*\[[^\]]*\]\s*\+", text)
+    block_names = set()
+    for b in blocks:
+        block_names |= set(re.findall(r"['\"]([A-Za-z_]\w*)['\"]", b))
+    if blocks and not computed and block_names:
+        names = block_names
+    else:
+        names = set(re.findall(r"'([A-Za-z_]\w*)'", text))
+        names |= set(re.findall(r'"([A-Za-z_]\w*)"', text))
     return {n for n in names if not n.startswith("_") and n not in NOISE}
 
 

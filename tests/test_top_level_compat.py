@@ -84,3 +84,50 @@ def test_aliases_exist():
     assert paddle.SaveLoadConfig() is not None
     assert paddle.CosineDecay(0.1, step_each_epoch=10, epochs=4) \
         .get_lr() == pytest.approx(0.1)
+
+
+def test_distribution_module():
+    """paddle.distribution Normal/Uniform (reference distribution.py):
+    sampling statistics, log_prob/probs consistency, closed-form
+    entropy and KL."""
+    import paddle_tpu.distribution as D
+    n = D.Normal(1.0, 2.0)
+    s = np.asarray(n.sample([4000], seed=5).numpy())
+    assert abs(s.mean() - 1.0) < 0.15 and abs(s.std() - 2.0) < 0.15
+    # entropy of N(mu, sigma) = 0.5 + 0.5 ln(2 pi) + ln sigma
+    want = 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0)
+    np.testing.assert_allclose(float(np.asarray(n.entropy().numpy())),
+                               want, rtol=1e-5)
+    lp = float(np.asarray(n.log_prob(1.0).numpy()))
+    np.testing.assert_allclose(np.exp(lp),
+                               1.0 / (2.0 * np.sqrt(2 * np.pi)),
+                               rtol=1e-5)
+    # KL(N0 || N0) == 0; KL to a different Normal is positive
+    np.testing.assert_allclose(
+        float(np.asarray(n.kl_divergence(D.Normal(1.0, 2.0)).numpy())),
+        0.0, atol=1e-6)
+    assert float(np.asarray(
+        n.kl_divergence(D.Normal(0.0, 1.0)).numpy())) > 0
+
+    u = D.Uniform(0.0, 4.0)
+    su = np.asarray(u.sample([2000], seed=3).numpy())
+    assert su.min() >= 0.0 and su.max() <= 4.0
+    np.testing.assert_allclose(
+        float(np.asarray(u.probs(2.0).numpy())), 0.25, rtol=1e-6)
+    assert np.isneginf(float(np.asarray(u.log_prob(5.0).numpy())))
+    np.testing.assert_allclose(
+        float(np.asarray(u.entropy().numpy())), np.log(4.0), rtol=1e-6)
+
+
+def test_compat_framework_sysconfig():
+    import paddle_tpu.compat as compat
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.to_text([b"a", [b"b"]]) == ["a", ["b"]]
+    assert compat.round(2.5) == 3.0 and compat.round(-2.5) == -3.0
+    assert compat.floor_division(7, 2) == 3
+    import paddle_tpu.sysconfig as sysconfig
+    import os
+    assert os.path.isdir(sysconfig.get_include())
+    import paddle_tpu.framework as fw
+    assert fw.ParamAttr is not None and fw.SaveLoadConfig is not None
