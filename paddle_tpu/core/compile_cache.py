@@ -1,0 +1,170 @@
+"""Persistent XLA compilation cache + process-level trace accounting.
+
+Two related jobs, one subsystem:
+
+1. **On-disk compilation cache** — `initialize()` points JAX's persistent
+   compilation cache (the `jax.experimental.compilation_cache` machinery,
+   SNIPPETS.md [1] shows the bench-script idiom) at `PADDLE_TPU_CACHE_DIR`
+   (default `~/.cache/paddle_tpu/xla`).  A process restart then *loads*
+   the serialized XLA executable instead of re-running HLO passes — fatal
+   economics on the axon tunnel, where the TPU window is ~30 minutes and
+   a cold BERT-base compile eats several of them.  Set
+   `PADDLE_TPU_CACHE_DIR=""` (or `off`/`0`) to disable.
+
+2. **Trace/hit/miss counters** — every in-process step-cache consult in
+   `static/executor.py` / `distributed/compiled_program.py` records here
+   (through `core/monitor.py`'s StatRegistry), so tests and `bench.py`
+   can assert hard properties like "zero new traces after warmup" and
+   `Executor.cache_stats()` has one source of truth.
+
+Counter semantics:
+  * ``trace``  — a whole-block (re)trace: `jax.jit` is about to run the
+    Python step function.  The thing shape-bucketing exists to minimize.
+  * ``hit``    — a step served by an already-jitted callable; ``bucket_hit``
+    additionally marks hits that required padding feeds up to a bucket.
+  * ``miss``   — a step-cache lookup that found nothing (every miss is
+    followed by exactly one trace).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .monitor import stat_add, stat_reset, stats_with_prefix
+
+__all__ = ["initialize", "is_enabled", "cache_dir", "record_trace",
+           "record_hit", "record_miss", "cache_stats", "reset_stats",
+           "persistent_entries", "DEFAULT_CACHE_DIR", "ENV_CACHE_DIR"]
+
+ENV_CACHE_DIR = "PADDLE_TPU_CACHE_DIR"
+DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "paddle_tpu", "xla")
+_DISABLED_SENTINELS = ("", "0", "off", "none", "disabled")
+
+# monitor counter names (STAT_ADD-style registry keys)
+STAT_TRACES = "compile_cache_traces"
+STAT_HITS = "compile_cache_hits"
+STAT_MISSES = "compile_cache_misses"
+STAT_BUCKET_HITS = "compile_cache_bucket_hits"
+
+_state = {"initialized": False, "dir": None}
+
+
+def initialize(cache_dir: Optional[str] = None, *,
+               min_compile_time_s: Optional[float] = None,
+               force: bool = False) -> Optional[str]:
+    """Idempotently enable JAX's persistent on-disk compilation cache.
+
+    Resolution order for the directory: explicit arg >
+    ``$PADDLE_TPU_CACHE_DIR`` > ``~/.cache/paddle_tpu/xla``; a sentinel
+    value ("", "off", "0", "none") disables persistence (in-process
+    caching and counters keep working).  Returns the active directory or
+    None when disabled.  ``force=True`` re-points an already-initialized
+    process (tests use this to aim at a tmpdir).
+    """
+    if _state["initialized"] and not force:
+        return _state["dir"]
+    if cache_dir is None:
+        cache_dir = os.environ.get(ENV_CACHE_DIR, DEFAULT_CACHE_DIR)
+    if cache_dir is None or cache_dir.strip().lower() in _DISABLED_SENTINELS:
+        if _state["dir"] is not None:  # was enabled: actually turn it off
+            import jax
+            _config_update(jax, "jax_enable_compilation_cache", False)
+        _state["initialized"] = True
+        _state["dir"] = None
+        return None
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        # unwritable target (read-only HOME in some launchers): run with
+        # the in-process cache only rather than failing the job
+        _state["initialized"] = True
+        _state["dir"] = None
+        return None
+    if min_compile_time_s is None:
+        env_min = os.environ.get("PADDLE_TPU_CACHE_MIN_COMPILE_S")
+        # no explicit floor -> JAX's default 1s: ALWAYS set it, so a
+        # force-re-init back to defaults cannot inherit a test's 0s floor
+        # and flood the user's HOME cache with throwaway executables
+        min_compile_time_s = float(env_min) if env_min else 1.0
+    import jax
+    _config_update(jax, "jax_enable_compilation_cache", True)
+    if _state["dir"] is not None and _state["dir"] != cache_dir:
+        # JAX materializes its cache backend on first use and never
+        # re-reads the config — re-pointing an initialized process (tests)
+        # must drop that object so the new dir takes effect
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception:
+            pass
+    _config_update(jax, "jax_compilation_cache_dir", cache_dir)
+    _config_update(jax, "jax_persistent_cache_min_compile_time_secs",
+                   min_compile_time_s)
+    # small test programs compile in ms and serialize to a few KB — with
+    # a lowered time floor the size floor must drop too (0 is also the
+    # JAX default, so this is a no-op on the default path)
+    _config_update(jax, "jax_persistent_cache_min_entry_size_bytes", 0)
+    _state["initialized"] = True
+    _state["dir"] = cache_dir
+    return cache_dir
+
+
+def _config_update(jax, name, value):
+    try:
+        jax.config.update(name, value)
+    except (AttributeError, KeyError):  # older/newer jax without the knob
+        pass
+
+
+def is_enabled() -> bool:
+    return _state["dir"] is not None
+
+
+def cache_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+def persistent_entries() -> int:
+    """Number of serialized executables currently in the on-disk cache."""
+    d = _state["dir"]
+    if not d or not os.path.isdir(d):
+        return 0
+    return sum(1 for f in os.listdir(d) if f.endswith("-cache"))
+
+
+# ---------------------------------------------------------------------------
+# counters
+# ---------------------------------------------------------------------------
+def record_trace():
+    stat_add(STAT_TRACES)
+
+
+def record_hit(bucketed: bool = False):
+    stat_add(STAT_HITS)
+    if bucketed:
+        stat_add(STAT_BUCKET_HITS)
+
+
+def record_miss():
+    stat_add(STAT_MISSES)
+
+
+def cache_stats() -> Dict[str, int]:
+    """Process-level snapshot: traces / hits / misses / bucket_hits plus
+    the persistent-cache location and entry count."""
+    snap = stats_with_prefix("compile_cache_")
+    return {
+        "traces": snap.get(STAT_TRACES, 0),
+        "hits": snap.get(STAT_HITS, 0),
+        "misses": snap.get(STAT_MISSES, 0),
+        "bucket_hits": snap.get(STAT_BUCKET_HITS, 0),
+        "persistent_dir": _state["dir"],
+        "persistent_entries": persistent_entries(),
+    }
+
+
+def reset_stats():
+    for name in (STAT_TRACES, STAT_HITS, STAT_MISSES, STAT_BUCKET_HITS):
+        stat_reset(name)

@@ -12,7 +12,8 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = [
-    "DataType", "convert_dtype", "np_dtype", "jnp_dtype", "is_floating",
+    "DataType", "convert_dtype", "np_dtype", "jnp_dtype",
+    "canonical_np_dtype", "is_floating",
     "is_integer", "core_dtypes",
 ]
 
@@ -82,6 +83,21 @@ def np_dtype(dtype) -> np.dtype:
 
 def jnp_dtype(dtype):
     return jnp.dtype(np_dtype(dtype))
+
+
+_DOWNCAST_64 = {np.dtype(np.int64): np.dtype(np.int32),
+                np.dtype(np.uint64): np.dtype(np.uint32),
+                np.dtype(np.float64): np.dtype(np.float32)}
+
+
+def canonical_np_dtype(dtype, x64: bool) -> np.dtype:
+    """The dtype a feed actually holds on the backend: 64-bit types
+    narrow to their 32-bit counterparts when x64 is disabled (the TPU
+    default) — the ONE shared table for the synchronous
+    (executor._coerce_feed) and prefetched (reader.place_feed) paths, so
+    both produce identical dtypes and hit the same jit signature."""
+    dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    return dt if x64 else _DOWNCAST_64.get(dt, dt)
 
 
 def is_floating(dtype) -> bool:

@@ -99,6 +99,14 @@ define_flag("benchmark", False, "sync + time every executor run")
 define_flag("dataset_chunk_steps", 1,
             "train_from_dataset: batch this many consecutive same-shape "
             "steps into one scanned device dispatch (Executor.run_steps)")
+define_flag("dataset_prefetch_depth", 2,
+            "train_from_dataset: async device-placement read-ahead depth "
+            "(reader.Prefetcher); 0 disables the placement stage")
+define_flag("feed_bucketing", "existing",
+            "executor batch-dim bucketing on a step-cache miss: 'existing' "
+            "pads ragged batches up to an already-compiled larger batch, "
+            "'pow2' also cold-compiles at power-of-two buckets "
+            "(inference), 'off' disables")
 define_flag("sort_sum_gradient", False,
             "deterministic gradient accumulation order (flags.cc:521)")
 define_flag("check_unused_vars", False,

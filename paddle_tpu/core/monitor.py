@@ -9,7 +9,7 @@ import threading
 from typing import Dict
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "all_stats"]
+           "all_stats", "stats_with_prefix"]
 
 
 class StatRegistry:
@@ -61,3 +61,10 @@ def stat_reset(name=None):
 
 def all_stats():
     return StatRegistry.instance().snapshot()
+
+
+def stats_with_prefix(prefix: str) -> Dict[str, int]:
+    """Counter-family snapshot (e.g. ``stats_with_prefix("compile_cache_")``
+    for the hot-path trace/hit/miss surface in core/compile_cache.py)."""
+    return {k: v for k, v in StatRegistry.instance().snapshot().items()
+            if k.startswith(prefix)}

@@ -266,7 +266,10 @@ class CompiledProgram:
         n_dev = len(mesh.devices.flat)
         block = program.global_block()
 
-        feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
+        # pre-placed feeds (reader.Prefetcher via place_feed) pass through;
+        # host arrays take the synchronous conversion
+        feed_vals = {n: v if isinstance(v, jax.Array) else jnp.asarray(v)
+                     for n, v in feed.items()}
         state_names = [n for n in _persistable_names(program)
                        if scope.get(n) is not None]
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
@@ -274,11 +277,16 @@ class CompiledProgram:
         key = (program.fingerprint(), feed_sig, tuple(fetch_names),
                tuple(state_names), n_dev,
                getattr(self._build_strategy, "fetch_aggregation", "reduce"))
+        from ..core import compile_cache as _ccache
         fn = self._cache.get(key)
         if fn is None:
+            _ccache.record_miss()
+            _ccache.record_trace()
             fn = self._compile(program, state_names, sorted(feed_vals),
                                fetch_names, mesh)
             self._cache[key] = fn
+        else:
+            _ccache.record_hit()
 
         state = {n: scope.get(n) for n in state_names}
         seed = executor._seed_for_step(program)
@@ -289,6 +297,34 @@ class CompiledProgram:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def place_feed(self, feed: Dict[str, Any]) -> Dict[str, Any]:
+        """Async-friendly sharded feed placement: ship a host batch onto
+        the mesh with the same batch-dim layout `_run`'s shard_map expects
+        (dim 0 split over "dp" when it divides evenly, else replicated).
+        Designed as a `reader.Prefetcher` place_fn so the host→ICI
+        transfer of batch N+1 overlaps the sharded compute of batch N:
+
+            pf = Prefetcher(batches, place_fn=compiled.place_feed)
+            for feed in pf: exe.run(compiled, feed=feed, ...)
+        """
+        from jax.sharding import NamedSharding
+        from ..reader.prefetcher import _canonical_array, _x64_enabled
+        mesh = self._get_mesh()
+        dp = mesh.shape["dp"]
+        x64 = _x64_enabled()
+        out = {}
+        for n, v in feed.items():
+            if isinstance(v, jax.Array):
+                out[n] = v
+                continue
+            a = _canonical_array(v, x64)
+            if a.ndim >= 1 and a.shape[0] % dp == 0:
+                spec = P("dp")
+            else:
+                spec = P()
+            out[n] = jax.device_put(a, NamedSharding(mesh, spec))
+        return out
 
     def _compile(self, program, state_names, feed_names, fetch_names, mesh):
         from ..static.executor import BlockTracer

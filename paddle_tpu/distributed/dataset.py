@@ -357,10 +357,38 @@ def run_from_dataset(executor, program, dataset, scope=None,
         # never route them through the scanned dispatch
         chunk_steps = 1
 
-    # drop feed names the program does not declare (.lod helpers)
-    block = program.global_block()
+    # drop feed names the program does not declare (.lod helpers);
+    # a CompiledProgram exposes its (rewritten) Program's block
+    block = (program._get_program() if hasattr(program, "_get_program")
+             else program).global_block()
     pop, join = _batch_queue(dataset._batches(records),
                              capacity=max(2, 2 * dataset.thread_num))
+
+    def _popped():
+        while True:
+            b = pop()
+            if b is None:
+                return
+            yield {k: v for k, v in b.items() if block.has_var(k)}
+
+    # second pipeline stage: async device placement (reader.Prefetcher)
+    # so batch N+1's host->device transfer overlaps batch N's step.  A
+    # CompiledProgram brings its own mesh-aware placement
+    # (CompiledProgram.place_feed: dp-sharded NamedSharding); plain
+    # programs take the default single-device place_feed.  The chunked
+    # (run_steps) path stacks batches on the HOST before its one big
+    # transfer, so there the prefetcher only read-aheads (place=False)
+    # instead of paying a device round-trip per batch.
+    prefetch_depth = int(flag("dataset_prefetch_depth", 2))
+    if prefetch_depth > 0:
+        from ..reader.prefetcher import Prefetcher
+        place = chunk_steps <= 1 and not flag("eager_run", False)
+        place_fn = getattr(program, "place_feed", None) if place else None
+        batch_iter = Prefetcher(_popped(), depth=prefetch_depth,
+                                place_fn=place_fn, place=place)
+    else:
+        batch_iter = _popped()
+
     fetch_list = fetch_list or []
     fetch_names = [f.name if hasattr(f, "name") else str(f)
                    for f in fetch_list]
@@ -401,26 +429,26 @@ def run_from_dataset(executor, program, dataset, scope=None,
             last = [o[-1] for o in outs]
         pending.clear()
 
-    while True:
-        batch = pop()
-        if batch is None:
-            break
-        feed = {k: v for k, v in batch.items()
-                if block.has_var(k)}
-        if chunk_steps <= 1 or not feed:
-            # feed-less programs (no declared dataset slots) cannot be
-            # stacked — run them per step like the unchunked path
-            _flush()
-            last = executor.run(program, feed=feed, fetch_list=fetch_list,
-                                scope=scope)
-            step += 1
-            _report(last)
-            continue
-        if pending and _sig(feed) != _sig(pending[0]):
-            _flush()
-        pending.append(feed)
-        if len(pending) >= chunk_steps:
-            _flush()
-    _flush()
+    try:
+        for feed in batch_iter:
+            if chunk_steps <= 1 or not feed:
+                # feed-less programs (no declared dataset slots) cannot be
+                # stacked — run them per step like the unchunked path
+                _flush()
+                last = executor.run(program, feed=feed,
+                                    fetch_list=fetch_list, scope=scope)
+                step += 1
+                _report(last)
+                continue
+            if pending and _sig(feed) != _sig(pending[0]):
+                _flush()
+            pending.append(feed)
+            if len(pending) >= chunk_steps:
+                _flush()
+        _flush()
+    finally:
+        close = getattr(batch_iter, "close", None)
+        if close is not None:
+            close()
     join()
     return last

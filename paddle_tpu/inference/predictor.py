@@ -166,14 +166,21 @@ class Predictor:
 
     def _fresh_exe(self):
         from ..static.executor import Executor
-        return Executor()
+        exe = Executor()
+        # serving sees arbitrary request batch sizes: power-of-two feed
+        # bucketing bounds total jit traces at log2(max batch) — request
+        # batch 5 pads to 8 and reuses 8's executable, instead of tracing
+        # a fresh XLA program per distinct size (executor._bucket_lookup;
+        # fetch rows are sliced back to the real batch)
+        exe.bucket_policy = "pow2"
+        return exe
 
     def _load_and_optimize(self):
         import os
-        from ..static.executor import Executor, Scope, scope_guard
+        from ..static.executor import Scope, scope_guard
         from ..io.framework_io import load_inference_model
         self._scope = Scope()
-        self._exe = Executor()
+        self._exe = self._fresh_exe()
         model_dir = self._config._model_dir
         prog_file = self._config._prog_file
         params_file = self._config._params_file

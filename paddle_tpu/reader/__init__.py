@@ -13,8 +13,11 @@ import queue as _queue
 import random
 import threading
 
+from .prefetcher import Prefetcher, place_feed
+
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
-           "firstn", "xmap_readers", "cache", "ComposeNotAligned"]
+           "firstn", "xmap_readers", "cache", "ComposeNotAligned",
+           "Prefetcher", "place_feed"]
 
 
 class ComposeNotAligned(ValueError):

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..core.program import Program, Block, default_main_program, OpRole
 from ..core.place import CPUPlace, XLAPlace, Place, _current_expected_place
 from ..core.dtype import np_dtype
+from ..core import compile_cache as _ccache
 from ..ops.registry import get_op_info, OpContext
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard",
@@ -178,8 +179,24 @@ class Executor:
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place or _current_expected_place()
+        # persistent on-disk XLA cache (PADDLE_TPU_CACHE_DIR): a process
+        # restart re-loads serialized executables instead of re-compiling
+        _ccache.initialize()
         # compiled step cache: key -> (jitted fn, state names)
         self._cache: Dict[Tuple, Any] = {}
+        # miss-key -> (bucket key, padded batch) memo so a recurring ragged
+        # batch pays the bucket search once, not every step
+        self._bucket_map: Dict[Tuple, Tuple] = {}
+        # feed bucketing policy: "existing" pads a cache-missing ragged
+        # batch up to the smallest already-compiled batch (training: the
+        # epoch's last partial batch reuses the steady-state executable);
+        # "pow2" additionally cold-compiles at the next power-of-two
+        # bucket (variable-length inference: total traces bounded at
+        # log2(max batch)); "off" disables padding.
+        from ..core.flags import flag
+        self.bucket_policy = flag("feed_bucketing", "existing")
+        self._stats = {"hits": 0, "misses": 0, "traces": 0,
+                       "bucket_hits": 0}
         self._step = 0
 
     # -- public API ---------------------------------------------------------
@@ -308,7 +325,14 @@ class Executor:
                     f"non-finite values in output {n!r}")
 
     def close(self):
+        """Release the in-process jitted-step cache.  Idempotent — safe to
+        call repeatedly (reference executor.py:658 close contract).  The
+        persistent on-disk cache (PADDLE_TPU_CACHE_DIR) is deliberately
+        untouched: it is process-shared state, and the whole point is that
+        the NEXT process starts hot.  Counters survive close so post-hoc
+        `cache_stats()` still reports the session."""
         self._cache.clear()
+        self._bucket_map.clear()
 
     # -- eager interpreter (startup / debug) --------------------------------
     def _program_is_startup(self, program: Program) -> bool:
@@ -356,9 +380,19 @@ class Executor:
         key = (program.fingerprint(), feed_sig, tuple(fetch_names),
                tuple(state_names))
         fn = self._cache.get(key)
+        bucket = None  # (real batch, padded batch)
         if fn is None:
+            bucketed = self._bucket_lookup(key, feed_vals)
+            if bucketed is not None:
+                key, feed_vals, bucket = bucketed
+                fn = self._cache.get(key)
+        if fn is None:
+            self._record("miss")
+            self._record("trace")
             fn = self._compile(program, state_names, fetch_names)
             self._cache[key] = fn
+        else:
+            self._record("hit", bucketed=bucket is not None)
 
         state = {n: scope.get(n) for n in state_names}
         seed = self._seed_for_step(program)
@@ -366,9 +400,150 @@ class Executor:
         self._step += 1
         for n, v in new_state.items():
             scope.set(n, v)
+        if bucket is not None:
+            fetches = self._unpad_fetches(fetches, *bucket,
+                                          block=block,
+                                          fetch_names=fetch_names)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    # -- shape bucketing -----------------------------------------------------
+    def _record(self, kind, bucketed=False):
+        self._stats[kind + "es" if kind.endswith("s") else kind + "s"] += 1
+        if kind == "hit":
+            _ccache.record_hit(bucketed)
+            if bucketed:
+                self._stats["bucket_hits"] += 1
+        elif kind == "miss":
+            _ccache.record_miss()
+        elif kind == "trace":
+            _ccache.record_trace()
+
+    @staticmethod
+    def _common_leading_dim(feed_sig):
+        """The shared batch dim of a feed signature, or None when feeds
+        disagree / any feed is rank-0 (no well-defined batch axis)."""
+        dims = set()
+        for _, shape, _ in feed_sig:
+            if not shape:
+                return None
+            dims.add(int(shape[0]))
+        return dims.pop() if len(dims) == 1 else None
+
+    def _bucket_lookup(self, miss_key, feed_vals):
+        """On a step-cache miss, try to serve the step from a LARGER
+        already-compiled batch bucket instead of tracing a fresh shape.
+
+        Returns (bucket_key, padded_feed_vals, original_batch) or None.
+        Policy "existing": pad up to the smallest compiled batch >= b with
+        identical trailing dims/dtypes (epoch-tail ragged batch -> the
+        steady-state executable).  Policy "pow2": when nothing compiled
+        fits, target the next power-of-two >= b so variable-length
+        inference settles into at most log2(max) buckets.  Padding
+        repeats the final row — values stay in-domain (valid token ids,
+        finite floats) and real rows' per-row fetches are bit-identical
+        (row-independent programs); `_unpad_fetches` slices fetches back.
+        Batch-reduced fetches (mean loss) and state updates DO see the
+        duplicated rows — same tradeoff as pad-vs-drop-last in any
+        static-shape pipeline (docs/perf.md)."""
+        policy = self.bucket_policy
+        if policy not in ("existing", "pow2") or not feed_vals:
+            return None
+        memo = self._bucket_map.get(miss_key)
+        if memo is not None:
+            bucket_key, target = memo
+            return (bucket_key, self._pad_feeds(feed_vals, target), target)
+        fp, feed_sig, fetch_names, state_names = miss_key
+        b = self._common_leading_dim(feed_sig)
+        if b is None:
+            return None
+
+        def rebucket(sig, new_b):
+            return tuple((n, (new_b,) + tuple(s[1:]), dt)
+                         for n, s, dt in sig)
+
+        candidates = []
+        for k in self._cache:
+            if len(k) != 4 or k[0] != fp or k[2] != fetch_names \
+                    or k[3] != state_names:
+                continue
+            cand_b = self._common_leading_dim(k[1])
+            if cand_b is None or cand_b < b:
+                continue
+            if k[1] == rebucket(feed_sig, cand_b):
+                candidates.append(cand_b)
+        if policy == "pow2":
+            # the pow2 bucket competes with existing entries: serving a
+            # batch-5 stream must not ride a previously-compiled batch-64
+            # executable forever (12.8x the compute) just because 64 was
+            # seen first — one cheap 8-bucket compile amortizes at once
+            candidates.append(1 << (b - 1).bit_length())
+        if not candidates:
+            return None
+        target_b = min(candidates)
+        if target_b == b:
+            return None  # already a bucket boundary: compile exact
+        bucket_key = (fp, rebucket(feed_sig, target_b), fetch_names,
+                      state_names)
+        self._bucket_map[miss_key] = (bucket_key, (b, target_b))
+        return (bucket_key, self._pad_feeds(feed_vals, (b, target_b)),
+                (b, target_b))
+
+    @staticmethod
+    def _pad_feeds(feed_vals, target):
+        b, target_b = target
+        out = {}
+        for n, v in feed_vals.items():
+            pad = jnp.repeat(v[-1:], target_b - b, axis=0)
+            out[n] = jnp.concatenate([v, pad], axis=0)
+        return out
+
+    @staticmethod
+    def _unpad_fetches(fetches, orig_batch, padded_batch, block=None,
+                       fetch_names=()):
+        """Mask-aware fetch un-padding: slice per-row fetches back to the
+        real batch.  A fetch whose runtime leading dim equals the padded
+        bucket is sliced unless the program says its dim 0 is NOT the
+        batch: persistable vars (weights) never slice; a declared STATIC
+        dim 0 exactly equal to the bucket marks a coincidence (a [64, k]
+        temp while serving the 64-bucket) and passes through.  Declared
+        dynamic (-1/None) dims, stale concrete dims (traced programs
+        record the example batch), and undeclared temps all slice."""
+
+        def batch_dim_dynamic(name):
+            if block is None:
+                return True
+            try:
+                var = block.var(name)
+            except KeyError:
+                return True  # temp var without a declared shape
+            if getattr(var, "persistable", False):
+                return False
+            shape = getattr(var, "shape", None)
+            if not shape or shape[0] in (-1, None):
+                return True
+            return shape[0] != padded_batch
+
+        names = list(fetch_names) + [None] * (len(fetches) -
+                                              len(fetch_names))
+        return tuple(
+            f[:orig_batch]
+            if getattr(f, "ndim", 0) >= 1 and f.shape[0] == padded_batch
+            and batch_dim_dynamic(n)
+            else f
+            for f, n in zip(fetches, names))
+
+    def cache_stats(self) -> Dict[str, int]:
+        """Hot-path cache accounting for THIS executor: ``hits`` /
+        ``misses`` / ``traces`` (whole-block jit retraces — the number
+        that must stop growing after warmup) / ``bucket_hits`` (hits that
+        needed batch padding), plus the process-wide persistent-cache
+        location and entry count from core/compile_cache.py."""
+        out = dict(self._stats)
+        out["persistent_dir"] = _ccache.cache_dir()
+        out["persistent_entries"] = _ccache.persistent_entries()
+        return out
 
     def _make_step(self, program: Program, state_names, fetch_names):
         """(state, feed, seed) -> (fetches, state') over the whole block —
@@ -448,8 +623,12 @@ class Executor:
                tuple(state_names))
         fn = self._cache.get(key)
         if fn is None:
+            self._record("miss")
+            self._record("trace")
             fn = self._compile_steps(program, state_names, fetch_names)
             self._cache[key] = fn
+        else:
+            self._record("hit")
 
         # same side contracts as run(): elastic auto-checkpoint hook,
         # run counters, profiler span, FLAGS_check_nan_inf post-scan
@@ -488,15 +667,54 @@ class Executor:
 
         return jax.jit(multi, donate_argnums=(0,))
 
+    # -- prefetch-driven step loop ------------------------------------------
+    def run_prefetched(self, program, feeds, fetch_list=None, scope=None,
+                       return_numpy=True, prefetch_depth=2):
+        """Generator over `feeds` (an iterable of feed dicts) with async
+        double-buffered device placement: batch N+1's `device_put` rides a
+        worker thread while batch N computes (reader/prefetcher.py).
+        Yields each step's fetch list — iterate it to drive the loop:
+
+            for out in exe.run_prefetched(main, batches, fetch_list=[loss]):
+                ...
+
+        Feeds arriving as `jax.Array` (already placed) pass through the
+        placement stage untouched, so staged and host batches can mix."""
+        from ..reader.prefetcher import Prefetcher
+        pf = Prefetcher(feeds, depth=prefetch_depth)
+        try:
+            for feed in pf:
+                yield self.run(program, feed=feed, fetch_list=fetch_list,
+                               scope=scope, return_numpy=return_numpy)
+        finally:
+            pf.close()
+
     # -- helpers ------------------------------------------------------------
     def _coerce_feed(self, block, name, val):
+        # x64-disabled backends (the TPU default) cannot hold 64-bit
+        # values: canonicalize on the HOST side before jnp sees the array
+        # — jnp.asarray(int64) emits a per-call truncation UserWarning and
+        # an extra device-side cast otherwise.  Shared dtype table with
+        # the prefetched path (core.dtype.canonical_np_dtype) so both
+        # produce the same jit signature.
+        from ..core.dtype import canonical_np_dtype
+        import jax as _jax
+        x64 = bool(_jax.config.jax_enable_x64)
+        if not isinstance(val, _jax.Array):
+            a = np.asarray(val)
+            tgt = canonical_np_dtype(a.dtype, x64)
+            val = a if tgt == a.dtype else a.astype(tgt)
         arr = jnp.asarray(val)
         try:
             var = block.var(name)
         except KeyError:
             return arr
-        if var.dtype is not None and str(arr.dtype) != var.dtype:
-            arr = arr.astype(np_dtype(var.dtype))
+        want = var.dtype
+        if want is None or str(arr.dtype) == want:
+            return arr
+        tgt = canonical_np_dtype(np_dtype(want), x64)
+        if arr.dtype != tgt:
+            arr = arr.astype(tgt)
         return arr
 
     def _seed_for_step(self, program: Program) -> int:

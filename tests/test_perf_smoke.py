@@ -1,0 +1,40 @@
+"""Tier-1 recompile-regression gate (NOT marked slow — a retrace in the
+executor hot path must fail the suite, not wait for a perf round).
+
+Drives tools/perf_smoke.py in-process: bert-tiny, a short prefetched
+epoch with a ragged final batch, hard assertions that warmup compiles at
+most 2 signatures and the steady-state loop (including the ragged tail)
+never traces again.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_perf_smoke_gate(tmp_path):
+    import perf_smoke
+    result = perf_smoke.run_smoke(steps=8, cache_dir=str(tmp_path / "xla"))
+    assert result["traces"] <= 2, result
+    assert result["traces_after_warmup"] == 0, result
+    assert result["bucket_hits"] >= 1, result
+    assert result["value"] > 0
+    # restore the default persistent cache dir for subsequent tests
+    from paddle_tpu.core import compile_cache
+    compile_cache.initialize(force=True)
+
+
+def test_perf_smoke_cli_prints_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_smoke.py"),
+         "--steps", "6"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["traces_after_warmup"] == 0
+    assert result["value"] > 0

@@ -1,0 +1,139 @@
+"""Fast CPU perf gate: bert-tiny, ~20 steps, hard recompile assertions.
+
+The cheap canary for the executor hot path (tests/test_perf_smoke.py runs
+it as a tier-1 test): builds a bert-tiny pretraining step, runs a short
+epoch whose batches ride the async Prefetcher and whose FINAL BATCH IS
+RAGGED, then asserts the compile-once contract:
+
+  * at most ``max_traces`` whole-block traces total (fetch + no-fetch
+    signatures), and — the regression that matters — ZERO new traces
+    after warmup: the ragged tail batch must be served by shape
+    bucketing, not a fresh jit;
+  * the prefetched loop preserved batch order (checked through a
+    per-row fetch of the step's token ids).
+
+Prints one JSON line with steady-state tokens/s so perf runs can eyeball
+the number; correctness of the gate never depends on throughput (CI
+machines are noisy).
+
+Usage: python tools/perf_smoke.py [--steps 20]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_bert_tiny(vocab=512, seq=32, hidden=64, layers_n=2, heads=2):
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers, nets
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, seq], dtype="int64")
+        labels = layers.data("labels", [-1, seq, 1], dtype="int64")
+        h = layers.embedding(ids, size=[vocab, hidden])
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        for _ in range(layers_n):
+            q = layers.fc(h, hidden, num_flatten_dims=2)
+            k = layers.fc(h, hidden, num_flatten_dims=2)
+            v = layers.fc(h, hidden, num_flatten_dims=2)
+            ctx = nets.scaled_dot_product_attention(q, k, v, num_heads=heads)
+            h = layers.layer_norm(layers.elementwise_add(h, ctx),
+                                  begin_norm_axis=2)
+            ffn = layers.fc(h, hidden * 2, num_flatten_dims=2, act="gelu")
+            h = layers.layer_norm(
+                layers.elementwise_add(h, layers.fc(ffn, hidden,
+                                                    num_flatten_dims=2)),
+                begin_norm_axis=2)
+        logits = layers.fc(h, vocab, num_flatten_dims=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, labels))
+        static.Adam(learning_rate=1e-4).minimize(loss)
+    return main, startup, loss, ids
+
+
+def run_smoke(steps=20, batch=4, seq=32, max_traces=2, cache_dir=None):
+    """Run the gate; returns the result dict (raises AssertionError on a
+    recompile regression)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.core import compile_cache
+
+    if cache_dir is not None:
+        compile_cache.initialize(cache_dir, min_compile_time_s=0.0,
+                                 force=True)
+    else:
+        compile_cache.initialize()
+
+    vocab = 512
+    main, startup, loss, _ = build_bert_tiny(vocab=vocab, seq=seq)
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    idt = np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    def make_batch(b):
+        return {"ids": rng.randint(0, vocab, (b, seq)).astype(idt),
+                "labels": rng.randint(0, vocab, (b, seq, 1)).astype(idt)}
+
+    with static.scope_guard(scope):
+        exe.run(startup)
+        # warmup: compile the two steady signatures (fetch / no-fetch)
+        warm = make_batch(batch)
+        exe.run(main, feed=warm, fetch_list=[loss])
+        exe.run(main, feed=warm, fetch_list=[])
+        warm_stats = exe.cache_stats()
+
+        # epoch with a RAGGED FINAL BATCH — batch-1 tail must bucket-pad
+        # into the compiled executable, not trace a new one
+        feeds = [make_batch(batch) for _ in range(steps - 1)]
+        feeds.append(make_batch(max(1, batch - 1)))
+        t0 = time.time()
+        n_tok = 0
+        for i, _out in enumerate(exe.run_prefetched(main, feeds,
+                                                    fetch_list=[],
+                                                    return_numpy=False)):
+            n_tok += feeds[i]["ids"].shape[0] * seq
+        out = exe.run(main, feed=warm, fetch_list=[loss])
+        float(np.asarray(out[0]))
+        dt = time.time() - t0
+
+    stats = exe.cache_stats()
+    new_traces = stats["traces"] - warm_stats["traces"]
+    assert new_traces == 0, (
+        f"perf smoke FAILED: {new_traces} recompile(s) after warmup "
+        f"(stats {stats})")
+    assert stats["traces"] <= max_traces, (
+        f"perf smoke FAILED: {stats['traces']} total traces > "
+        f"{max_traces} (stats {stats})")
+    assert stats["bucket_hits"] >= 1, (
+        f"perf smoke FAILED: ragged tail batch never hit a bucket "
+        f"(stats {stats})")
+    result = {
+        "metric": "perf_smoke_tokens_per_sec",
+        "value": round(n_tok / dt, 2),
+        "steps": steps,
+        "traces": stats["traces"],
+        "traces_after_warmup": new_traces,
+        "bucket_hits": stats["bucket_hits"],
+        "persistent_dir": stats["persistent_dir"],
+    }
+    return result
+
+
+def main():
+    steps = 20
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    print(json.dumps(run_smoke(steps=steps)))
+
+
+if __name__ == "__main__":
+    main()
