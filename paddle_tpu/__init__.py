@@ -32,7 +32,8 @@ def _setup_api():
                 "distributed", "amp", "metric", "io", "vision", "text",
                 "hapi", "jit", "incubate", "profiler", "utils", "slim",
                 "reader", "dataset", "fluid", "regularizer",
-                "distribution", "compat", "sysconfig", "framework"):
+                "distribution", "compat", "sysconfig", "framework",
+                "serving"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:

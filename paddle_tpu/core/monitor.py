@@ -1,15 +1,70 @@
-"""Runtime counters — StatRegistry analog.
+"""Runtime counters/gauges/histograms — StatRegistry analog.
 
 Reference: /root/reference/paddle/fluid/platform/monitor.h (StatRegistry
 :77, STAT_ADD :130 — named int64 counters exported through pybind's `stat`
-dict)."""
+dict).  Grown past the reference for the serving tier
+(paddle_tpu/serving/metrics.py): monotonic counters stay int64, gauges
+hold a last-written value (queue depth, slot occupancy), and histograms
+keep a bounded reservoir of observations with percentile snapshots
+(request latency p50/p95/p99)."""
 from __future__ import annotations
 
+import random
 import threading
-from typing import Dict
+from typing import Dict, List
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
-           "all_stats", "stats_with_prefix"]
+           "all_stats", "stats_with_prefix", "gauge_set", "gauge_get",
+           "hist_observe", "hist_snapshot", "monitor_snapshot",
+           "HISTOGRAM_RESERVOIR"]
+
+# bounded reservoir per histogram: big enough for faithful tail
+# percentiles at serving scale, small enough to never grow unboundedly
+HISTOGRAM_RESERVOIR = 2048
+
+
+class _Reservoir:
+    """Vitter's algorithm-R reservoir: O(1) memory per histogram while the
+    observation count runs unbounded; percentiles are computed over the
+    retained sample."""
+
+    __slots__ = ("cap", "count", "total", "min", "max", "sample", "_rng")
+
+    def __init__(self, cap: int = HISTOGRAM_RESERVOIR):
+        self.cap = cap
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.sample: List[float] = []
+        # deterministic per-histogram stream, independent of global seeding
+        self._rng = random.Random(0x5EED)
+
+    def observe(self, value: float):
+        v = float(value)
+        self.count += 1
+        self.total += v
+        self.min = v if v < self.min else self.min
+        self.max = v if v > self.max else self.max
+        if len(self.sample) < self.cap:
+            self.sample.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.cap:
+                self.sample[j] = v
+
+    def snapshot(self) -> Dict[str, float]:
+        if not self.count:
+            return {"count": 0}
+        s = sorted(self.sample)
+
+        def pct(q):
+            # nearest-rank on the retained sample
+            return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+        return {"count": self.count, "min": self.min, "max": self.max,
+                "mean": self.total / self.count,
+                "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
 
 
 class StatRegistry:
@@ -18,6 +73,8 @@ class StatRegistry:
 
     def __init__(self):
         self._stats: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, _Reservoir] = {}
         self._mu = threading.Lock()
 
     @classmethod
@@ -27,6 +84,7 @@ class StatRegistry:
                 cls._instance = cls()
             return cls._instance
 
+    # -- counters (monotonic int64, the reference surface) ------------------
     def add(self, name: str, value: int = 1):
         with self._mu:
             self._stats[name] = self._stats.get(name, 0) + int(value)
@@ -39,12 +97,51 @@ class StatRegistry:
         with self._mu:
             if name is None:
                 self._stats.clear()
+                self._gauges.clear()
+                self._hists.clear()
             else:
                 self._stats.pop(name, None)
+                self._gauges.pop(name, None)
+                self._hists.pop(name, None)
+
+    # -- gauges (last-written value; may go down) ---------------------------
+    def set_gauge(self, name: str, value: float):
+        with self._mu:
+            self._gauges[name] = value
+
+    def get_gauge(self, name: str, default: float = 0) -> float:
+        with self._mu:
+            return self._gauges.get(name, default)
+
+    # -- histograms (bounded reservoir + percentile snapshot) ---------------
+    def observe(self, name: str, value: float):
+        with self._mu:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Reservoir()
+            h.observe(value)
+
+    def histogram(self, name: str) -> Dict[str, float]:
+        with self._mu:
+            h = self._hists.get(name)
+            return h.snapshot() if h is not None else {"count": 0}
 
     def snapshot(self) -> Dict[str, int]:
         with self._mu:
             return dict(self._stats)
+
+    def full_snapshot(self, prefix: str = "") -> Dict[str, object]:
+        """Counters + gauges + histogram percentiles in one dict (the
+        /stats route payload); keys optionally filtered by prefix."""
+        with self._mu:
+            out: Dict[str, object] = {
+                k: v for k, v in self._stats.items()
+                if k.startswith(prefix)}
+            out.update({k: v for k, v in self._gauges.items()
+                        if k.startswith(prefix)})
+            out.update({k: h.snapshot() for k, h in self._hists.items()
+                        if k.startswith(prefix)})
+            return out
 
 
 def stat_add(name, value=1):
@@ -61,6 +158,32 @@ def stat_reset(name=None):
 
 def all_stats():
     return StatRegistry.instance().snapshot()
+
+
+def gauge_set(name, value):
+    """Set a last-value gauge (queue depth, active slots, …)."""
+    StatRegistry.instance().set_gauge(name, value)
+
+
+def gauge_get(name, default=0):
+    return StatRegistry.instance().get_gauge(name, default)
+
+
+def hist_observe(name, value):
+    """Record one observation into the named bounded-reservoir histogram."""
+    StatRegistry.instance().observe(name, value)
+
+
+def hist_snapshot(name):
+    """{count,min,max,mean,p50,p95,p99} for the named histogram (count=0
+    when it has never been observed)."""
+    return StatRegistry.instance().histogram(name)
+
+
+def monitor_snapshot(prefix: str = ""):
+    """Executor.cache_stats()-style one-call dump of every counter, gauge
+    and histogram under ``prefix`` (e.g. ``"serving."``)."""
+    return StatRegistry.instance().full_snapshot(prefix)
 
 
 def stats_with_prefix(prefix: str) -> Dict[str, int]:

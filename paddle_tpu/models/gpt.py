@@ -52,11 +52,18 @@ class _Block(Layer):
         self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
 
-    def forward(self, x, mask):
+    def forward(self, x, mask, cache=None):
         h = self.ln1(x)
-        x = x + self.attn(h, h, h, attn_mask=mask)
+        if cache is None:
+            x = x + self.attn(h, h, h, attn_mask=mask)
+        else:
+            # incremental decode: attn consumes + extends the per-layer
+            # KV cache (MultiHeadAttention.Cache concat path)
+            out, cache = self.attn(h, h, h, attn_mask=mask, cache=cache)
+            x = x + out
         h = self.ln2(x)
-        return x + self.fc2(nn.functional.gelu(self.fc1(h)))
+        x = x + self.fc2(nn.functional.gelu(self.fc1(h)))
+        return x if cache is None else (x, cache)
 
 
 class GPTModel(Layer):
@@ -80,18 +87,54 @@ class GPTModel(Layer):
             self._mask_cache[seq] = m
         return m
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, cache=None, pos_offset=None,
+                attn_mask=None):
+        """Plain LM forward, or — when ``cache`` (list of per-block
+        ``MultiHeadAttention.Cache``) is given — one incremental decode
+        step that returns ``(logits, new_caches)``.
+
+        ``pos_offset``: per-row absolute position of ``input_ids[:, 0]``
+        (int array [batch]); continuous batching feeds sequences of
+        different lengths in one fixed-slot batch, so each row needs its
+        own position base.  ``attn_mask`` overrides the causal mask —
+        the serving engine passes an additive mask that hides each
+        slot's KV padding columns."""
         seq = input_ids.shape[1]
-        pos = paddle_tpu.to_tensor(
-            np.arange(seq, dtype=np.int64)[None].repeat(
-                input_ids.shape[0], 0))
+        if pos_offset is None:
+            pos = paddle_tpu.to_tensor(
+                np.arange(seq, dtype=np.int64)[None].repeat(
+                    input_ids.shape[0], 0))
+        else:
+            off = np.asarray(pos_offset, np.int64).reshape(-1, 1)
+            pos = paddle_tpu.to_tensor(
+                off + np.arange(seq, dtype=np.int64)[None])
         x = self.wte(input_ids) + self.wpe(pos)
-        mask = self._mask(seq)
-        for blk in self.blocks:
-            x = blk(x, mask)
+        mask = attn_mask if attn_mask is not None else self._mask(seq)
+        if cache is None:
+            for blk in self.blocks:
+                x = blk(x, mask)
+            x = self.ln_f(x)
+            # tied LM head
+            return paddle_tpu.matmul(x, self.wte.weight, transpose_y=True)
+        new_caches = []
+        for blk, c in zip(self.blocks, cache):
+            x, c = blk(x, mask, cache=c)
+            new_caches.append(c)
         x = self.ln_f(x)
-        # tied LM head
-        return paddle_tpu.matmul(x, self.wte.weight, transpose_y=True)
+        logits = paddle_tpu.matmul(x, self.wte.weight, transpose_y=True)
+        return logits, new_caches
+
+    def gen_cache(self, batch_size):
+        """Fresh empty per-block KV caches for ``batch_size`` rows (the
+        serving engine's slot-admission entry point)."""
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+        return [nn.MultiHeadAttention.Cache(
+            paddle_tpu.to_tensor(np.zeros(
+                (batch_size, c.num_heads, 0, head_dim), np.float32)),
+            paddle_tpu.to_tensor(np.zeros(
+                (batch_size, c.num_heads, 0, head_dim), np.float32)))
+            for _ in self.blocks]
 
 
 class GPTForGeneration(Layer):

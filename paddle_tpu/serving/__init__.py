@@ -1,0 +1,31 @@
+"""paddle_tpu.serving — the request-coalescing tier between the HTTP
+surface (inference/server.py) and the compiled model.
+
+Three pieces:
+
+* ``DynamicBatcher`` (batcher.py) — bounded admission queue + scheduler
+  thread that coalesces concurrent ``/predict`` requests into one padded
+  device batch per tick and slices result rows back per caller.
+* ``ContinuousBatchingEngine`` (generation.py) — fixed-slot decode batch
+  with per-slot KV cache; sequences join free slots between steps and
+  retire on EOS/max-len (``/generate``).
+* metrics (metrics.py) — the ``serving.*`` counter/gauge/histogram
+  namespace over core/monitor, dumped by ``/stats``.
+
+See docs/serving.md for the architecture and the backpressure contract.
+"""
+from .batcher import (  # noqa: F401
+    DynamicBatcher, BatcherError, QueueFullError, DeadlineExceededError,
+    BatcherStoppedError,
+)
+from .generation import (  # noqa: F401
+    ContinuousBatchingEngine, GenerationRequest,
+)
+from .metrics import serving_stats, reset_serving_stats  # noqa: F401
+
+__all__ = [
+    "DynamicBatcher", "BatcherError", "QueueFullError",
+    "DeadlineExceededError", "BatcherStoppedError",
+    "ContinuousBatchingEngine", "GenerationRequest", "serving_stats",
+    "reset_serving_stats",
+]

@@ -1,0 +1,332 @@
+"""DynamicBatcher — coalesce concurrent requests into full device batches.
+
+The serial-lock server ran batch-1 work per request while concurrent
+callers queued on a mutex; the TPU's MXU was busy exactly 1/N of the
+time.  This is the standard fix (Clipper/TF-Serving-style dynamic
+batching): requests enter a bounded admission queue, ONE scheduler
+thread drains up to ``max_batch`` row-compatible requests per tick
+(waiting at most ``max_wait_ms`` for stragglers to fill the batch),
+concatenates their rows into a single feed batch, runs the model once,
+and slices result rows back to each caller's Future.
+
+Shape discipline: the batcher never pads — it hands the coalesced batch
+to the predictor's executor, whose ``pow2`` feed bucketing pads the
+batch dim to an already-compiled bucket (inference/predictor.py).
+Coalesced batches therefore ride the SAME bounded set of executables as
+single requests: total traces stay at log2(max batch) and steady-state
+serving never retraces.
+
+Backpressure contract: a full admission queue rejects immediately
+(``QueueFullError`` → HTTP 503 + Retry-After at the server), and each
+request carries a deadline — expired requests are dropped at dequeue
+time (``DeadlineExceededError`` → HTTP 504) instead of wasting a batch
+slot on an answer nobody is waiting for.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import metrics
+
+__all__ = ["DynamicBatcher", "BatcherError", "QueueFullError",
+           "DeadlineExceededError", "BatcherStoppedError"]
+
+
+class BatcherError(RuntimeError):
+    """Base class for admission/scheduling failures; carries the HTTP
+    status the server should surface."""
+    http_status = 500
+
+
+class QueueFullError(BatcherError):
+    """Admission queue at capacity — caller should retry after backoff."""
+    http_status = 503
+
+    def __init__(self, depth, retry_after_s):
+        super().__init__(
+            f"admission queue full ({depth} waiting); retry after "
+            f"{retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(BatcherError):
+    """Request spent its whole deadline waiting in the queue."""
+    http_status = 504
+
+
+class BatcherStoppedError(BatcherError):
+    """Batcher is draining/stopped and admits no new work."""
+    http_status = 503
+    retry_after_s = 1.0
+
+
+class _Request:
+    __slots__ = ("feeds", "rows", "deadline", "future", "t_enqueue")
+
+    def __init__(self, feeds, rows, deadline):
+        self.feeds = feeds
+        self.rows = rows
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+
+    def signature(self):
+        # row-compatibility key: two requests coalesce iff every feed
+        # agrees on dtype and per-row (non-batch) shape
+        return tuple((a.dtype.str, a.shape[1:]) for a in self.feeds)
+
+
+class DynamicBatcher:
+    """Coalesce concurrent ``submit()`` calls into single device runs.
+
+    ``runner`` is the device entry point: it takes the coalesced feed
+    list (one array per model input, rows stacked along axis 0) and
+    returns the output list (each with the same leading batch dim).
+
+        batcher = DynamicBatcher(predictor.run, max_batch=8)
+        batcher.start()
+        fut = batcher.submit([x_rows])     # returns concurrent Future
+        outs = fut.result(timeout=...)     # this caller's rows only
+        batcher.stop()                     # graceful: drains the queue
+    """
+
+    def __init__(self, runner: Callable[[List[np.ndarray]],
+                                        Sequence[np.ndarray]],
+                 max_batch: int = 8, max_wait_ms: float = 2.0,
+                 max_queue: int = 64, default_timeout_s: float = 30.0,
+                 pad_to_bucket: bool = True):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._runner = runner
+        # pad ragged coalesced batches to the next pow2 HERE (cheap host
+        # numpy, repeat of the last row) so the executor always sees an
+        # exact already-compiled bucket shape: its jnp-based pad/unpad
+        # fallback costs ~2x a fast-path run, and a coalesced batch is
+        # ragged almost every tick
+        self.pad_to_bucket = bool(pad_to_bucket)
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1000.0
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = float(default_timeout_s)
+        self._queue: collections.deque = collections.deque()
+        self._mu = threading.Lock()
+        self._work = threading.Condition(self._mu)
+        self._idle = threading.Condition(self._mu)
+        self._running = False
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._mu:
+            if self._running:
+                return self
+            self._running, self._draining = True, False
+        self._thread = threading.Thread(target=self._schedule_loop,
+                                        name="paddle-tpu-batcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Stop the scheduler.  ``drain=True`` (default) keeps running
+        until every already-admitted request has a result; new submits
+        are rejected immediately either way."""
+        with self._mu:
+            if not self._running:
+                return
+            self._draining = True
+            if drain:
+                deadline = time.monotonic() + timeout
+                while self._queue:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._idle.wait(left)
+            # anything still queued (drain=False or drain timeout) fails
+            # fast rather than hanging its caller forever
+            while self._queue:
+                req = self._queue.popleft()
+                req.future.set_exception(
+                    BatcherStoppedError("batcher stopped before request "
+                                        "was scheduled"))
+            self._running = False
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        metrics.gauge("queue.depth", 0)
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, feeds: Sequence[np.ndarray],
+               timeout_s: Optional[float] = None) -> Future:
+        """Admit one request (a list of per-input arrays sharing leading
+        batch dim).  Returns a Future resolving to this request's output
+        rows.  Raises ``QueueFullError`` / ``BatcherStoppedError``
+        synchronously on backpressure."""
+        feeds = [np.asarray(a) for a in feeds]
+        if not feeds:
+            raise ValueError("submit() needs at least one feed array")
+        rows = int(feeds[0].shape[0]) if feeds[0].ndim else 1
+        if rows < 1:
+            raise ValueError("request must carry at least one row "
+                             f"(got shape {tuple(feeds[0].shape)})")
+        for a in feeds:
+            if a.ndim == 0 or int(a.shape[0]) != rows:
+                raise ValueError(
+                    "all feeds must share the leading batch dim "
+                    f"(got {[tuple(x.shape) for x in feeds]})")
+        timeout_s = self.default_timeout_s if timeout_s is None \
+            else float(timeout_s)
+        req = _Request(feeds, rows, time.monotonic() + timeout_s)
+        with self._mu:
+            if not self._running or self._draining:
+                metrics.count("requests.rejected")
+                raise BatcherStoppedError("batcher is not accepting work")
+            if len(self._queue) >= self.max_queue:
+                metrics.count("requests.rejected")
+                # honest hint: time for the backlog to clear one queue
+                # at current batch geometry, floor 50ms
+                retry = max(0.05, self.max_wait_s *
+                            (len(self._queue) / max(1, self.max_batch)))
+                raise QueueFullError(len(self._queue), retry)
+            self._queue.append(req)
+            metrics.count("requests.admitted")
+            metrics.gauge("queue.depth", len(self._queue))
+            self._work.notify()
+        return req.future
+
+    def run_sync(self, feeds: Sequence[np.ndarray],
+                 timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        """submit() + result() with the request's own deadline."""
+        timeout_s = self.default_timeout_s if timeout_s is None \
+            else float(timeout_s)
+        return self.submit(feeds, timeout_s).result(timeout=timeout_s + 5.0)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._mu:
+            return len(self._queue)
+
+    # -- scheduler ----------------------------------------------------------
+    def _take_batch(self) -> List[_Request]:
+        """Dequeue the next coalescible group: up to ``max_batch`` rows of
+        requests sharing the head-of-line request's feed signature.
+        Expired requests are failed and skipped.  Blocks until work or
+        stop."""
+        with self._mu:
+            while True:
+                now = time.monotonic()
+                # deadline sweep at the head — don't burn a tick on dead
+                # requests
+                while self._queue and self._queue[0].deadline <= now:
+                    req = self._queue.popleft()
+                    # counted, but NOT recorded into latency_ms: the
+                    # histogram tracks completed requests, and a 30s
+                    # timeout sample would swamp the p99
+                    metrics.count("requests.timeout")
+                    req.future.set_exception(DeadlineExceededError(
+                        "request expired after waiting "
+                        f"{now - req.t_enqueue:.3f}s in queue"))
+                if not self._queue:
+                    metrics.gauge("queue.depth", 0)
+                    self._idle.notify_all()
+                    if not self._running:
+                        return []
+                    self._work.wait(timeout=0.05)
+                    continue
+                head = self._queue[0]
+                # wait up to max_wait for the batch to fill — but never
+                # past the head request's deadline
+                batch_full = sum(
+                    r.rows for r in self._queue
+                    if r.signature() == head.signature()) >= self.max_batch
+                wait_until = min(head.t_enqueue + self.max_wait_s,
+                                 head.deadline)
+                if not batch_full and now < wait_until and self._running \
+                        and not self._draining:
+                    self._work.wait(timeout=min(wait_until - now, 0.05))
+                    continue
+                # harvest row-compatible requests in FIFO order
+                sig, taken, rows = head.signature(), [], 0
+                remaining = collections.deque()
+                while self._queue:
+                    req = self._queue.popleft()
+                    # the head is always taken, even when its own row
+                    # count exceeds max_batch (an oversized request runs
+                    # alone rather than starving the queue)
+                    if req.deadline > now and \
+                            req.signature() == sig and \
+                            (not taken or
+                             rows + req.rows <= self.max_batch):
+                        taken.append(req)
+                        rows += req.rows
+                    elif req.deadline <= now:
+                        metrics.count("requests.timeout")
+                        req.future.set_exception(DeadlineExceededError(
+                            "request expired after waiting "
+                            f"{now - req.t_enqueue:.3f}s in queue"))
+                    else:
+                        remaining.append(req)
+                self._queue = remaining
+                metrics.gauge("queue.depth", len(self._queue))
+                if taken:
+                    return taken
+
+    def _schedule_loop(self):
+        while True:
+            taken = self._take_batch()
+            if not taken:
+                return  # stopped and queue empty
+            self._run_batch(taken)
+            with self._mu:
+                if not self._queue:
+                    self._idle.notify_all()
+
+    def _run_batch(self, taken: List[_Request]):
+        rows = sum(r.rows for r in taken)
+        metrics.count("batch.runs")
+        metrics.gauge("batch.last_size", rows)
+        metrics.observe("batch.occupancy", rows)
+        if len(taken) > 1:
+            metrics.count("batch.coalesced")
+            metrics.count("batch.coalesced_requests", len(taken))
+        try:
+            feeds = [np.concatenate([r.feeds[i] for r in taken], axis=0)
+                     if len(taken) > 1 else taken[0].feeds[i]
+                     for i in range(len(taken[0].feeds))]
+            run_rows = rows
+            if self.pad_to_bucket and rows & (rows - 1):
+                run_rows = 1 << (rows - 1).bit_length()
+                feeds = [np.concatenate(
+                    [f, np.repeat(f[-1:], run_rows - rows, axis=0)],
+                    axis=0) for f in feeds]
+            outs = [np.asarray(o) for o in self._runner(feeds)]
+        except Exception as e:  # noqa: BLE001 — fan the failure out
+            metrics.count("requests.failed", len(taken))
+            for r in taken:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        if run_rows != rows:
+            # drop the pow2 padding rows before result slicing
+            outs = [o[:rows] if o.ndim and o.shape[0] == run_rows else o
+                    for o in outs]
+        done = time.monotonic()
+        off = 0
+        for r in taken:
+            # slice this caller's rows back out; outputs without the
+            # request batch dim (e.g. a scalar metric) are shared as-is
+            r_outs = [o[off:off + r.rows]
+                      if o.ndim and o.shape[0] == rows else o
+                      for o in outs]
+            off += r.rows
+            metrics.count("requests.completed")
+            metrics.latency_ms(done - r.t_enqueue)
+            r.future.set_result(r_outs)

@@ -1,0 +1,393 @@
+"""Continuous-batching generation engine (Orca-style iteration-level
+scheduling) for autoregressive decode.
+
+``GPTForGeneration.generate`` decodes one request at a time and
+recomputes the whole prefix every step — fine for a notebook, hopeless
+for serving: the device runs batch-1 matmuls and a long request blocks
+every short one behind it.  This engine keeps a FIXED-SLOT decode batch
+(``max_slots`` rows) stepping continuously; sequences are admitted into
+free slots BETWEEN steps and retired the moment they emit EOS or hit
+their length budget, so a finished short request never waits for the
+longest sequence in its batch (the continuous-batching lesson).
+
+Per-slot KV cache: each slot owns dense per-layer K/V host arrays
+([heads, len, head_dim]) built once at admission (a single prefill pass
+over the prompt through ``GPTModel.forward(cache=...)``) and extended by
+one column per step, so a decode step is O(1) model work per token
+instead of O(len) prefix recompute.  Slots of different lengths share a
+step by padding KV to a power-of-two length bucket and masking the pad
+columns with the same additive-mask path the model uses for causality —
+shapes seen by the compiler stay bounded at (max_slots, log2 lengths),
+the serving analog of the executor's pow2 feed buckets.
+
+Decode strategies reuse the ``generate()`` contract: ``greedy_search``
+(deterministic — token-for-token equal to per-sequence ``generate``)
+and ``sampling`` (temperature / top-k, per-request seeded RNG).  Beam
+search is whole-sequence search and cannot join a running batch; the
+engine rejects it at submit.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+from . import metrics
+from .batcher import (BatcherStoppedError, DeadlineExceededError,
+                      QueueFullError)
+
+__all__ = ["ContinuousBatchingEngine", "GenerationRequest"]
+
+_NEG_INF = -1e9
+
+
+def _next_pow2(n: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class GenerationRequest:
+    """One admitted generation request; resolves its Future with the full
+    token sequence (prompt + generated, truncated at EOS) as int64[n]."""
+
+    __slots__ = ("prompt", "max_new", "strategy", "top_k", "temperature",
+                 "rng", "future", "deadline", "t_enqueue")
+
+    def __init__(self, prompt, max_new, strategy, top_k, temperature,
+                 seed, timeout_s):
+        self.prompt = np.asarray(prompt, np.int64).reshape(-1)
+        self.max_new = int(max_new)
+        self.strategy = strategy
+        self.top_k = int(top_k)
+        self.temperature = float(temperature)
+        self.rng = np.random.RandomState(seed)
+        self.future: Future = Future()
+        self.t_enqueue = time.monotonic()
+        self.deadline = self.t_enqueue + timeout_s
+
+
+class _Slot:
+    __slots__ = ("req", "kv", "tokens", "next_id", "n_new")
+
+    def __init__(self, req, kv, tokens, next_id):
+        self.req = req
+        self.kv = kv          # per-layer (k [H, len, Dh], v [H, len, Dh])
+        self.tokens = tokens  # prompt + generated so far (python list)
+        self.next_id = next_id  # sampled, not yet fed through the model
+        self.n_new = 1
+
+    @property
+    def kv_len(self) -> int:
+        return self.kv[0][0].shape[1]
+
+
+class ContinuousBatchingEngine:
+    """Serve ``generate()`` traffic from one continuously-stepping batch.
+
+        eng = ContinuousBatchingEngine(model, max_slots=4).start()
+        fut = eng.submit([2, 17, 5], max_length=20)
+        tokens = fut.result()          # np.int64 [prompt+generated]
+        eng.stop()
+
+    ``model`` is a ``GPTForGeneration`` (or bare ``GPTModel``) — anything
+    exposing ``config``, ``gen_cache(batch)`` and the cache-aware
+    ``forward(ids, cache, pos_offset, attn_mask)``.
+    """
+
+    def __init__(self, model, max_slots: int = 4, max_queue: int = 64,
+                 default_timeout_s: float = 120.0, kv_bucket_floor: int = 16):
+        self._model = getattr(model, "gpt", model)
+        self.config = self._model.config
+        self.max_slots = int(max_slots)
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = float(default_timeout_s)
+        self._kv_floor = int(kv_bucket_floor)
+        self._queue: List[GenerationRequest] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._mu = threading.Lock()
+        self._work = threading.Condition(self._mu)
+        self._idle = threading.Condition(self._mu)
+        self._running = False
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._mu:
+            if self._running:
+                return self
+            self._running, self._draining = True, False
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        name="paddle-tpu-genloop",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 60.0):
+        with self._mu:
+            if not self._running:
+                return
+            self._draining = True
+            self._work.notify_all()
+            if drain:
+                deadline = time.monotonic() + timeout
+                while self._queue or any(self._slots):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._idle.wait(left)
+            for req in self._queue:
+                req.future.set_exception(BatcherStoppedError(
+                    "generation engine stopped before request started"))
+            self._queue.clear()
+            self._running = False
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # the decode thread is dead now: fail whatever it left in-flight
+        # (drain=False, or a drain that timed out) instead of letting
+        # callers hang on their futures
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                if not slot.req.future.done():
+                    slot.req.future.set_exception(BatcherStoppedError(
+                        "generation engine stopped mid-decode"))
+                self._slots[i] = None
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, input_ids, max_length: int = 20,
+               decode_strategy: str = "greedy_search", top_k: int = 0,
+               temperature: float = 1.0, seed: int = 0,
+               timeout_s: Optional[float] = None) -> Future:
+        if decode_strategy not in ("greedy_search", "sampling"):
+            raise ValueError(
+                f"continuous batching supports 'greedy_search' and "
+                f"'sampling', got decode_strategy={decode_strategy!r} "
+                "(beam search is whole-sequence and cannot join a "
+                "running batch)")
+        prompt = np.asarray(input_ids, np.int64).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("input_ids must hold at least one token")
+        if prompt.size + max_length > self.config.max_position:
+            raise ValueError(
+                f"prefix ({prompt.size}) + max_length ({max_length}) "
+                f"exceeds max_position ({self.config.max_position})")
+        req = GenerationRequest(
+            prompt, max_length, decode_strategy, top_k, temperature, seed,
+            self.default_timeout_s if timeout_s is None else timeout_s)
+        with self._mu:
+            if not self._running or self._draining:
+                metrics.count("gen.rejected")
+                raise BatcherStoppedError(
+                    "generation engine is not accepting work")
+            if len(self._queue) >= self.max_queue:
+                metrics.count("gen.rejected")
+                raise QueueFullError(len(self._queue), 1.0)
+            self._queue.append(req)
+            metrics.count("gen.admitted")
+            metrics.gauge("gen.queue.depth", len(self._queue))
+            self._work.notify()
+        return req.future
+
+    # -- decode loop --------------------------------------------------------
+    def _decode_loop(self):
+        while True:
+            with self._mu:
+                while self._running and not self._queue \
+                        and not any(self._slots):
+                    self._idle.notify_all()
+                    if self._draining:
+                        return
+                    self._work.wait(timeout=0.05)
+                if not self._running:
+                    return
+                pending = self._admit_locked()
+            for req in pending:
+                try:
+                    self._prefill(req)
+                except Exception as e:  # noqa: BLE001 — this request only
+                    metrics.count("gen.failed")
+                    req.future.set_exception(e)
+            try:
+                if any(self._slots):
+                    self._step()
+            except Exception as e:  # noqa: BLE001 — fail loud, stay alive
+                self._fail_all(e)
+
+    def _admit_locked(self) -> List[GenerationRequest]:
+        """Pick queued requests for the free slots (FIFO, expired dropped);
+        called with the lock held, prefill happens outside it."""
+        now = time.monotonic()
+        keep = []
+        for req in self._queue:
+            if req.future.cancelled():
+                pass  # caller gave up (e.g. /generate handler timeout)
+            elif req.deadline <= now:
+                metrics.count("gen.timeout")
+                req.future.set_exception(DeadlineExceededError(
+                    f"request expired after {now - req.t_enqueue:.2f}s "
+                    "in queue"))
+            else:
+                keep.append(req)
+        self._queue = keep
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        pending = self._queue[:len(free)]
+        self._queue = self._queue[len(pending):]
+        metrics.gauge("gen.queue.depth", len(self._queue))
+        return pending
+
+    def _fail_all(self, err):
+        with self._mu:
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    if not slot.req.future.done():
+                        slot.req.future.set_exception(err)
+                    self._slots[i] = None
+            metrics.gauge("gen.active_slots", 0)
+            self._idle.notify_all()
+
+    # -- model plumbing -----------------------------------------------------
+    def _prefill(self, req: GenerationRequest):
+        """Run the prompt through the model once: fills this sequence's KV
+        cache and samples its first token, then installs it in a free
+        slot (or retires it immediately on EOS/budget)."""
+        import paddle_tpu
+        if req.future.cancelled():
+            return
+        p = req.prompt.size
+        # pad the prompt to a pow2 length bucket so prefill compiles at
+        # most log2(max_position) shapes (same bounded-shape discipline
+        # as decode); causality makes the pad tokens invisible to rows
+        # < p, and their K/V columns are sliced away below
+        pp = min(_next_pow2(p, self._kv_floor),
+                 int(self.config.max_position))
+        ids = np.full((1, pp), self.config.eos_id, np.int64)
+        ids[0, :p] = req.prompt
+        caches = self._model.gen_cache(1)
+        logits, caches = self._model.forward(
+            paddle_tpu.to_tensor(ids), cache=caches,
+            pos_offset=np.zeros(1, np.int64),
+            attn_mask=self._model._mask(pp))
+        last = np.asarray(logits.numpy())[0, p - 1]
+        nxt = self._sample(req, last)
+        kv = [(np.asarray(c.k.numpy())[0, :, :p],
+               np.asarray(c.v.numpy())[0, :, :p])
+              for c in caches]
+        slot = _Slot(req, kv, list(req.prompt), nxt)
+        metrics.count("gen.prefill_tokens", p)
+        if nxt == self.config.eos_id or req.max_new <= 1:
+            slot.tokens.append(nxt)
+            self._retire(slot)
+            return
+        with self._mu:
+            idx = self._slots.index(None)
+            self._slots[idx] = slot
+            metrics.gauge("gen.active_slots",
+                          sum(s is not None for s in self._slots))
+
+    def _step(self):
+        """One decode step over every active slot (ONE device batch)."""
+        import paddle_tpu
+        from ..nn import MultiHeadAttention
+        with self._mu:
+            # a cancelled future means the caller stopped waiting — free
+            # the slot instead of decoding tokens nobody will read
+            for i, s in enumerate(self._slots):
+                if s is not None and s.req.future.cancelled():
+                    metrics.count("gen.cancelled")
+                    self._slots[i] = None
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+        if not active:
+            return
+        S = self.max_slots
+        cfg = self.config
+        heads = cfg.num_heads
+        head_dim = cfg.hidden_size // heads
+        lpad = _next_pow2(max(s.kv_len for _, s in active), self._kv_floor)
+
+        ids = np.full((S, 1), cfg.eos_id, np.int64)
+        pos = np.zeros(S, np.int64)
+        # additive mask over [cache columns 0..lpad-1, new-token column]:
+        # valid history + self are 0, pad columns and idle rows -inf
+        mask = np.full((S, 1, 1, lpad + 1), _NEG_INF, np.float32)
+        mask[:, :, :, lpad] = 0.0
+        n_layers = len(active[0][1].kv)
+        k_b = np.zeros((n_layers, S, heads, lpad, head_dim), np.float32)
+        v_b = np.zeros_like(k_b)
+        for i, s in active:
+            ln = s.kv_len
+            ids[i, 0] = s.next_id
+            pos[i] = ln
+            mask[i, :, :, :ln] = 0.0
+            for li, (k, v) in enumerate(s.kv):
+                k_b[li, i, :, :ln] = k
+                v_b[li, i, :, :ln] = v
+        caches = [MultiHeadAttention.Cache(paddle_tpu.to_tensor(k_b[li]),
+                                           paddle_tpu.to_tensor(v_b[li]))
+                  for li in range(n_layers)]
+        logits, new_caches = self._model.forward(
+            paddle_tpu.to_tensor(ids), cache=caches, pos_offset=pos,
+            attn_mask=paddle_tpu.to_tensor(mask))
+        step_logits = np.asarray(logits.numpy())[:, 0]
+        # the new K/V column for every slot sits at index lpad
+        new_cols = [(np.asarray(c.k.numpy())[:, :, lpad],
+                     np.asarray(c.v.numpy())[:, :, lpad])
+                    for c in new_caches]
+        metrics.count("gen.steps")
+        metrics.count("gen.tokens", len(active))
+        metrics.observe("gen.step_occupancy", len(active))
+
+        retired = []
+        for i, s in active:
+            for li, (k, v) in enumerate(s.kv):
+                s.kv[li] = (
+                    np.concatenate([k, new_cols[li][0][i][:, None]], 1),
+                    np.concatenate([v, new_cols[li][1][i][:, None]], 1))
+            s.tokens.append(s.next_id)
+            nxt = self._sample(s.req, step_logits[i])
+            s.next_id = nxt
+            s.n_new += 1
+            if nxt == self.config.eos_id or s.n_new >= s.req.max_new:
+                s.tokens.append(nxt)
+                retired.append(i)
+        with self._mu:
+            for i in retired:
+                slot, self._slots[i] = self._slots[i], None
+                self._retire(slot)
+            metrics.gauge("gen.active_slots",
+                          sum(s is not None for s in self._slots))
+
+    def _sample(self, req: GenerationRequest, logits: np.ndarray) -> int:
+        if req.strategy == "sampling":
+            logits = logits / max(req.temperature, 1e-6)
+            if req.top_k:
+                kth = np.sort(logits)[-req.top_k]
+                logits = np.where(logits < kth, _NEG_INF, logits)
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            return int(req.rng.choice(p.shape[0], p=p))
+        return int(np.argmax(logits))
+
+    def _retire(self, slot: _Slot):
+        metrics.count("gen.completed")
+        metrics.observe("gen.seq_len", len(slot.tokens))
+        metrics.latency_ms(time.monotonic() - slot.req.t_enqueue)
+        if not slot.req.future.done():
+            slot.req.future.set_result(np.asarray(slot.tokens, np.int64))
+
+    @property
+    def active_slots(self) -> int:
+        with self._mu:
+            return sum(s is not None for s in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._mu:
+            return len(self._queue)

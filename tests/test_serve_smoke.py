@@ -1,0 +1,22 @@
+"""Tier-1 serving gate (NOT marked slow — losing request coalescing or
+retracing on coalesced batches is a serving regression that must fail
+the suite, not wait for a perf round).
+
+Drives tools/serve_smoke.py in-process: tiny fc model behind the HTTP
+server with dynamic batching, pow2-bucket warmup, concurrent clients,
+hard assertions that batches coalesced and nothing retraced."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_serve_smoke_gate(tmp_path):
+    import serve_smoke
+    result = serve_smoke.run_smoke(clients=4, requests=6,
+                                   model_dir=str(tmp_path))
+    assert result["traces_after_warmup"] == 0, result
+    assert result["coalesced_batches"] > 0, result
+    assert result["value"] > 0, result
+    assert result["p99_ms"] > 0, result

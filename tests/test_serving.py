@@ -1,0 +1,328 @@
+"""paddle_tpu.serving: dynamic batching + continuous-batching generation.
+
+Covers the serving-tier contracts: K concurrent callers coalesce into
+<= ceil(K/max_batch) device runs with row-exact results, queue-full and
+deadline backpressure, monitor gauges/histograms, continuous-batching
+decode equivalence with per-sequence generate(), and a threaded
+end-to-end server pass."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (DynamicBatcher, QueueFullError,
+                                DeadlineExceededError, BatcherStoppedError)
+from paddle_tpu.serving import metrics
+
+
+def test_batcher_coalesces_rows_exact():
+    """12 callers / max_batch 4 -> exactly ceil(12/4)=3 device runs once
+    the scheduler unblocks, every caller getting its own rows back."""
+    sizes = []
+    gate = threading.Event()
+
+    def runner(feeds):
+        if not gate.is_set():  # the plug request holds the scheduler
+            gate.wait(10)
+        else:
+            sizes.append(feeds[0].shape[0])
+        return [feeds[0] * 3.0, np.float32(7.0)]
+
+    b = DynamicBatcher(runner, max_batch=4, max_wait_ms=0.0,
+                       pad_to_bucket=False).start()
+    try:
+        plug = b.submit([np.zeros((1, 2), np.float32)])
+        time.sleep(0.05)  # scheduler is now blocked inside the plug run
+        futs = [b.submit([np.full((1, 2), float(i), np.float32)])
+                for i in range(12)]
+        gate.set()
+        plug.result(timeout=10)
+        outs = [f.result(timeout=10) for f in futs]
+    finally:
+        b.stop()
+    assert sizes == [4, 4, 4], sizes
+    for i, (rows, scalar) in enumerate(outs):
+        np.testing.assert_array_equal(rows, np.full((1, 2), 3.0 * i))
+        # batch-level (non-row) outputs are shared to every caller
+        assert float(scalar) == 7.0
+    assert metrics.counter("batch.coalesced") >= 3
+
+
+def test_batcher_pow2_padding_and_mixed_shapes():
+    """Ragged coalesced batches are padded to the pow2 bucket before the
+    runner; requests with different row shapes never share a run."""
+    sizes = []
+
+    def runner(feeds):
+        sizes.append(feeds[0].shape[0])
+        return [feeds[0] + 1.0]
+
+    b = DynamicBatcher(runner, max_batch=8, max_wait_ms=40.0).start()
+    try:
+        f1 = b.submit([np.zeros((2, 3), np.float32)])
+        f2 = b.submit([np.ones((1, 3), np.float32)])
+        f3 = b.submit([np.zeros((1, 5), np.float32)])  # other signature
+        r1 = f1.result(timeout=10)[0]
+        r2 = f2.result(timeout=10)[0]
+        r3 = f3.result(timeout=10)[0]
+    finally:
+        b.stop()
+    assert r1.shape == (2, 3) and np.all(r1 == 1.0)
+    assert r2.shape == (1, 3) and np.all(r2 == 2.0)
+    assert r3.shape == (1, 5)
+    # 2+1 rows coalesced -> padded to 4; the [1,5] request ran alone
+    assert 4 in sizes and 1 in sizes, sizes
+
+
+def test_batcher_queue_full_and_deadline():
+    release = threading.Event()
+
+    def slow(feeds):
+        release.wait(10)
+        return [feeds[0]]
+
+    b = DynamicBatcher(slow, max_batch=1, max_wait_ms=0.0,
+                       max_queue=2).start()
+    try:
+        first = b.submit([np.zeros((1, 1), np.float32)])
+        time.sleep(0.05)  # scheduler now blocked in `slow`
+        expired = b.submit([np.zeros((1, 1), np.float32)], timeout_s=0.01)
+        b.submit([np.zeros((1, 1), np.float32)])
+        with pytest.raises(QueueFullError) as ei:
+            b.submit([np.zeros((1, 1), np.float32)])
+        assert ei.value.http_status == 503
+        assert ei.value.retry_after_s > 0
+        time.sleep(0.05)  # let the 10ms deadline lapse before release
+        release.set()
+        first.result(timeout=10)
+        with pytest.raises(DeadlineExceededError):
+            expired.result(timeout=10)
+    finally:
+        b.stop()
+    # stopped batcher rejects synchronously
+    with pytest.raises(BatcherStoppedError):
+        b.submit([np.zeros((1, 1), np.float32)])
+    assert metrics.counter("requests.timeout") >= 1
+
+
+def test_batcher_error_fanout():
+    def broken(feeds):
+        raise RuntimeError("kernel exploded")
+
+    b = DynamicBatcher(broken, max_batch=4, max_wait_ms=20.0).start()
+    try:
+        futs = [b.submit([np.zeros((1, 1), np.float32)])
+                for _ in range(3)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="kernel exploded"):
+                f.result(timeout=10)
+    finally:
+        b.stop()
+
+
+def test_monitor_gauges_and_histograms():
+    from paddle_tpu.core.monitor import (gauge_set, gauge_get,
+                                         hist_observe, hist_snapshot,
+                                         monitor_snapshot, stat_reset)
+    gauge_set("t.depth", 5)
+    gauge_set("t.depth", 3)
+    assert gauge_get("t.depth") == 3
+    assert hist_snapshot("t.lat")["count"] == 0
+    for v in range(1, 101):
+        hist_observe("t.lat", float(v))
+    snap = hist_snapshot("t.lat")
+    assert snap["count"] == 100 and snap["min"] == 1.0
+    assert snap["max"] == 100.0
+    assert abs(snap["p50"] - 50) <= 2
+    assert abs(snap["p99"] - 99) <= 2
+    full = monitor_snapshot("t.")
+    assert full["t.depth"] == 3 and full["t.lat"]["count"] == 100
+    stat_reset("t.depth")
+    stat_reset("t.lat")
+    assert gauge_get("t.depth") == 0
+    assert hist_snapshot("t.lat")["count"] == 0
+
+
+def _tiny_gpt(vocab=30):
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position=32, dropout=0.0)
+    return GPTForGeneration(GPTModel(cfg))
+
+
+def test_continuous_batching_matches_sequential_generate():
+    """Sequences admitted into a shared fixed-slot batch (joining and
+    leaving mid-decode) must reproduce per-sequence greedy generate()
+    token for token."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(2, 30, (n,)).astype(np.int64)
+               for n in (3, 5, 2)]
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        refs = [m.generate(p[None], max_length=4,
+                           decode_strategy="greedy_search")[0]
+                for p in prompts]
+        # 2 slots, 3 requests: the third must join when a slot frees
+        eng = ContinuousBatchingEngine(m, max_slots=2).start()
+        try:
+            futs = [eng.submit(p, max_length=4) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        finally:
+            eng.stop()
+    for ref, out in zip(refs, outs):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    assert metrics.counter("gen.completed") >= 3
+    assert metrics.counter("gen.steps") >= 1
+
+
+def test_engine_rejects_bad_requests():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    with dg.guard():
+        m = _tiny_gpt()
+        eng = ContinuousBatchingEngine(m, max_slots=2)
+        with pytest.raises(ValueError, match="beam"):
+            eng.submit([2, 3], decode_strategy="beam_search")
+        with pytest.raises(ValueError, match="max_position"):
+            eng.submit(list(range(2, 30)), max_length=30)
+        with pytest.raises(BatcherStoppedError):
+            eng.submit([2, 3])  # not started
+        eng.start()
+        eng.stop()
+        with pytest.raises(BatcherStoppedError):
+            eng.submit([2, 3])
+
+
+def test_server_stop_without_start(tmp_path):
+    """stop() on a never-started server must not hang in shutdown()."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_smoke
+    from paddle_tpu.inference.server import InferenceServer
+    serve_smoke.save_tiny_model(str(tmp_path))
+    srv = InferenceServer(str(tmp_path))
+    done = threading.Event()
+
+    def stopper():
+        srv.stop(drain_timeout_s=1.0)
+        done.set()
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    assert done.wait(10), "stop() hung on a never-started server"
+    assert srv.status == "stopped"
+
+
+def test_server_keepalive_survives_error_replies(tmp_path):
+    """Early error replies (404 route) must drain the POST body, or the
+    next request on the same keep-alive connection desyncs."""
+    import sys, os, http.client
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_smoke
+    from paddle_tpu.inference.server import InferenceServer
+    xb, ref, out_name = serve_smoke.save_tiny_model(str(tmp_path))
+    srv = InferenceServer(str(tmp_path))
+    srv.start()
+    try:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+        body = json.dumps({"inputs": {"x": xb[:1].tolist()}}).encode()
+        conn.request("POST", "/nope", body,
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().read() and True  # 404, body drained
+        # the SAME connection must still serve a real predict
+        conn.request("POST", "/predict", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        reply = json.loads(resp.read())
+        got = np.asarray(reply["outputs"][out_name]["data"]).reshape(
+            reply["outputs"][out_name]["shape"])
+        np.testing.assert_allclose(got, ref[:1], rtol=1e-4, atol=1e-6)
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_server_end_to_end_threaded(tmp_path):
+    """Concurrent /predict through the batcher (row-exact), /generate
+    through the engine (greedy-equal), /stats, readiness /health, and
+    graceful stop()."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_smoke
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.inference.server import InferenceServer
+
+    xb, ref, out_name = serve_smoke.save_tiny_model(str(tmp_path))
+    with dg.guard():
+        gen = _tiny_gpt()
+        gen.eval()
+        seq_ref = gen.generate(np.array([[4, 9]], np.int64),
+                               max_length=3)[0]
+        srv = InferenceServer(str(tmp_path), max_wait_ms=10.0,
+                              generator=gen, gen_slots=2)
+        srv.start()
+        try:
+            base = f"http://{srv.host}:{srv.port}"
+            with urllib.request.urlopen(base + "/health", timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+
+            results = [None] * 6
+            def client(i):
+                k = i % xb.shape[0]
+                reply = _post(base + "/predict",
+                              {"inputs": {"x": xb[k:k + 1].tolist()}})
+                o = reply["outputs"][out_name]
+                results[i] = (k, np.asarray(o["data"]).reshape(o["shape"]))
+            ts = [threading.Thread(target=client, args=(i,))
+                  for i in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for k, got in results:
+                np.testing.assert_allclose(got, ref[k:k + 1],
+                                           rtol=1e-4, atol=1e-6)
+
+            g = _post(base + "/generate",
+                      {"input_ids": [4, 9], "max_length": 3})
+            assert g["output_ids"][0] == list(seq_ref)
+
+            with urllib.request.urlopen(base + "/stats", timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["status"] == "ok"
+            assert st["serving"].get("serving.requests.completed", 0) >= 6
+            assert "predictor_cache" in st
+
+            # structured client error: missing input -> 400 + json body
+            try:
+                _post(base + "/predict", {"inputs": {}})
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                body = json.loads(e.read())
+                assert "error" in body and "type" in body
+        finally:
+            srv.stop()
+        assert srv.status == "stopped"
+        # post-stop: socket is closed, no handler raced server_close
+        with pytest.raises(Exception):
+            urllib.request.urlopen(base + "/health", timeout=2)

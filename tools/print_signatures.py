@@ -28,6 +28,7 @@ MODULES = [
     "paddle_tpu.distributed.fleet",
     "paddle_tpu.distributed.tensor_parallel",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.slim",
     "paddle_tpu.incubate",
 ]

@@ -1,0 +1,200 @@
+"""Serving gate: dynamic batching must coalesce and must not retrace.
+
+The serving analog of tools/perf_smoke.py (tests/test_serve_smoke.py
+runs it as a tier-1 test): saves a tiny fc model, starts the HTTP
+inference server with dynamic batching, warms every pow2 feed bucket the
+load can touch, then fires N concurrent clients and asserts the serving
+contract:
+
+  * ZERO jit retraces after warmup — coalesced batches of any size must
+    ride the predictor's pow2 buckets, never a fresh trace;
+  * ``serving.batch.coalesced`` > 0 — concurrent requests actually
+    shared device batches (the whole point of the tier);
+  * every client got byte-exact rows for ITS request back.
+
+Prints one JSON line with steady-state QPS + latency percentiles;
+correctness of the gate never depends on throughput (CI boxes are
+noisy).
+
+Usage: python tools/serve_smoke.py [--clients 6] [--requests 10]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def save_tiny_model(model_dir: str, in_dim: int = 8, classes: int = 3,
+                    hidden: int = 0, depth: int = 0):
+    """Save an fc(+relu stack)+softmax inference model; returns
+    (ref_input, ref_output) for row-exactness checks.  ``hidden``/
+    ``depth`` grow the model so per-run device time dominates HTTP
+    overhead (the serving bench's regime)."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.io.framework_io import save_inference_model
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, in_dim])
+        h = x
+        for _ in range(depth):
+            h = layers.fc(h, hidden, act="relu")
+        out = layers.fc(h, classes, act="softmax")
+    exe = static.Executor()
+    scope = static.Scope()
+    xb = np.random.RandomState(0).rand(4, in_dim).astype(np.float32)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        save_inference_model(model_dir, ["x"], [out], exe, main)
+        (ref,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+    return xb, np.asarray(ref), out.name
+
+
+def http_json(url: str, payload=None, timeout: float = 60.0):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_load(base_url: str, payloads, clients: int, requests: int,
+             check=None):
+    """Steady-state load driver: ``clients`` threads each POST ``requests``
+    times to /predict over ONE keep-alive connection (payload
+    round-robined per client); returns wall seconds.  ``check(reply,
+    payload_idx)`` validates each reply."""
+    import http.client
+    from urllib.parse import urlsplit
+    barrier = threading.Barrier(clients + 1)
+    errors = []
+    netloc = urlsplit(base_url).netloc
+
+    def client(cid):
+        conn = http.client.HTTPConnection(netloc, timeout=60)
+        bodies = [json.dumps(p).encode() for p in payloads]
+        barrier.wait()
+        try:
+            for i in range(requests):
+                k = (cid + i) % len(payloads)
+                try:
+                    conn.request("POST", "/predict", bodies[k],
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    reply = json.loads(resp.read())
+                    if resp.status != 200:
+                        raise AssertionError(f"HTTP {resp.status}: {reply}")
+                    if check is not None:
+                        check(reply, k)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(f"client {cid} req {i}: {e}")
+                    return
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.time()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    if errors:
+        raise AssertionError("serve smoke load errors:\n  " +
+                             "\n  ".join(errors[:10]))
+    return dt
+
+
+def run_smoke(clients: int = 6, requests: int = 10, max_batch: int = 8,
+              max_wait_ms: float = 10.0, model_dir: str = None):
+    """Run the gate; returns the result dict (AssertionError on a
+    coalescing or retrace regression)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+    from paddle_tpu.inference.server import InferenceServer
+    from paddle_tpu.serving.metrics import reset_serving_stats
+
+    model_dir = model_dir or tempfile.mkdtemp(prefix="serve_smoke_")
+    xb, ref, out_name = save_tiny_model(model_dir)
+    reset_serving_stats()
+    srv = InferenceServer(model_dir, max_batch=max_batch,
+                          max_wait_ms=max_wait_ms)
+    srv.start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        # warm every pow2 bucket a coalesced batch can land in: request
+        # batch b pads to the next pow2, so {1,2,4,...,max_batch} covers
+        # any coalesced size
+        b = 1
+        while b <= max_batch:
+            http_json(base + "/predict",
+                      {"inputs": {"x": np.repeat(xb[:1], b, 0).tolist()}})
+            b <<= 1
+        warm_traces = http_json(base + "/stats")[
+            "predictor_cache"]["traces"]
+
+        # steady state: each client fires batch-1 rows of xb (row j),
+        # checking it gets row j of the reference back
+        payloads = [{"inputs": {"x": xb[j:j + 1].tolist()}}
+                    for j in range(xb.shape[0])]
+
+        def check(reply, k):
+            got = np.asarray(reply["outputs"][out_name]["data"]).reshape(
+                reply["outputs"][out_name]["shape"])
+            np.testing.assert_allclose(got, ref[k:k + 1],
+                                       rtol=1e-4, atol=1e-6)
+
+        dt = run_load(base, payloads, clients, requests, check)
+        stats = http_json(base + "/stats")
+    finally:
+        srv.stop()
+
+    s = stats["serving"]
+    traces = stats["predictor_cache"]["traces"]
+    coalesced = s.get("serving.batch.coalesced", 0)
+    assert traces == warm_traces, (
+        f"serve smoke FAILED: {traces - warm_traces} retrace(s) after "
+        f"warmup (stats {stats['predictor_cache']})")
+    assert coalesced > 0, (
+        f"serve smoke FAILED: no request coalescing under {clients} "
+        f"concurrent clients (serving stats {s})")
+    lat = s.get("serving.latency_ms", {})
+    n_req = clients * requests
+    result = {
+        "metric": "serve_smoke_steady_qps",
+        "value": round(n_req / dt, 2),
+        "clients": clients,
+        "requests": n_req,
+        "coalesced_batches": coalesced,
+        "batch_runs": s.get("serving.batch.runs", 0),
+        "traces_after_warmup": traces - warm_traces,
+        "p50_ms": round(lat.get("p50", 0.0), 3),
+        "p99_ms": round(lat.get("p99", 0.0), 3),
+    }
+    return result
+
+
+def main():
+    args = sys.argv[1:]
+
+    def opt(name, default):
+        return int(args[args.index(name) + 1]) if name in args else default
+
+    print(json.dumps(run_smoke(clients=opt("--clients", 6),
+                               requests=opt("--requests", 10))))
+
+
+if __name__ == "__main__":
+    main()
