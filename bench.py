@@ -62,6 +62,145 @@ def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
 _FALLBACK_NOTE = ""
 
 
+def _last_known_tpu_metric():
+    """The last-good ON-CHIP headline from prior artifacts (BENCH_r*.json
+    driver captures and perf_r*/ builder captures).  A CPU-fallback round
+    carries this forward instead of silently overwriting the perf record
+    with a tunnel hang (VERDICT r5 weak-point 7): the official record
+    stays an under-statement of the chip, never an erasure of it."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = []
+
+    def consider(src, d):
+        if not isinstance(d, dict):
+            return
+        if d.get("metric") != "bert_base_pretrain_tokens_per_sec_per_chip":
+            return
+        entry = {"source": os.path.relpath(src, here),
+                 "value": d.get("value"),
+                 "unit": d.get("unit", "tokens/s/chip"),
+                 "vs_baseline": d.get("vs_baseline", 0.0)}
+        if "mfu" in d:
+            entry["mfu"] = d["mfu"]
+        candidates.append(entry)
+
+    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(p) as f:
+                consider(p, json.load(f).get("parsed"))
+        except (OSError, ValueError, AttributeError):
+            continue  # unreadable, non-JSON, or top level not an object
+    for p in sorted(glob.glob(os.path.join(here, "perf_r*", "*.json"))):
+        try:
+            with open(p) as f:
+                consider(p, json.load(f))
+        except (OSError, ValueError):
+            continue
+    if not candidates:
+        return None
+    # LAST known, not best-ever: an on-chip regression recorded in a
+    # newer round must not be papered over by an older, higher number
+    import re as _re
+
+    def _round(c):
+        m = _re.search(r"(?:BENCH_r|perf_r)0*(\d+)", c["source"])
+        return int(m.group(1)) if m else -1
+
+    newest = max(_round(c) for c in candidates)
+    pool = [c for c in candidates if _round(c) == newest]
+    return max(pool, key=lambda c: (c.get("vs_baseline") or 0.0,
+                                    c.get("value") or 0.0))
+
+
+def checkpoint_main():
+    """Checkpoint-overhead A/B (`python bench.py --checkpoint` or
+    BENCH_MODE=checkpoint): steady-state bert-tiny training throughput
+    with (a) no checkpointing, (b) async CheckpointManager saves every
+    step, (c) synchronous saves every step.  The async number must sit
+    within a few percent of baseline — that's the whole point of
+    decoupling snapshot from persistence — while sync pays the full
+    serialize+fsync cost on the train path.  Prints ONE JSON line;
+    numbers quoted in docs/checkpoint.md."""
+    import tempfile
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import perf_smoke
+    import paddle_tpu.static as static
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    steps = int(os.environ.get("BENCH_CKPT_STEPS", 60))
+    every = int(os.environ.get("BENCH_CKPT_EVERY", 10))
+    reps = int(os.environ.get("BENCH_CKPT_REPS", 2))
+    batch, seq, vocab = 8, 64, 2048
+    rng = np.random.RandomState(0)
+    idt = np.int64 if jax.config.jax_enable_x64 else np.int32
+
+    def measure(mode):
+        from paddle_tpu.core.program import _reset_unique_names
+        _reset_unique_names()
+        main_p, startup_p, loss, _ = perf_smoke.build_bert_tiny(
+            vocab=vocab, seq=seq, hidden=128, layers_n=2, heads=4)
+        exe = static.Executor()
+        scope = static.Scope()
+        feed = {"ids": rng.randint(0, vocab, (batch, seq)).astype(idt),
+                "labels": rng.randint(0, vocab,
+                                      (batch, seq, 1)).astype(idt)}
+        mgr = None
+        root = None
+        try:
+            with static.scope_guard(scope):
+                exe.run(startup_p)
+                exe.run(main_p, feed=feed, fetch_list=[loss])  # warm/compile
+                if mode == "async":
+                    root = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+                    mgr = CheckpointManager(root, keep_last_n=3,
+                                            max_in_flight=1)
+                    exe.enable_checkpointing(mgr, program=main_p,
+                                             every_n_steps=every,
+                                             scope=scope)
+                if mode == "sync":
+                    root = tempfile.mkdtemp(prefix=f"bench_ckpt_{mode}_")
+                    mgr = CheckpointManager(root, keep_last_n=3)
+                t0 = time.time()
+                for i in range(steps):
+                    out = exe.run(main_p, feed=feed, fetch_list=[loss])
+                    if mode == "sync" and (i + 1) % every == 0:
+                        s, state, extra = exe.checkpoint_snapshot(
+                            main_p, scope)
+                        mgr.save(s, state, extra=extra, sync=True)
+                np.asarray(out[0])
+                dt = time.time() - t0
+                if mgr is not None:
+                    mgr.wait()
+                    mgr.close()
+        finally:
+            if root is not None:
+                import shutil
+                shutil.rmtree(root, ignore_errors=True)
+        return steps * batch * seq / dt
+
+    # best-of-N per mode: CPU CI boxes swing 20%+ run-to-run, and the A/B
+    # claim is about the checkpoint path, not scheduler noise
+    base = max(measure("off") for _ in range(reps))
+    async_tps = max(measure("async") for _ in range(reps))
+    sync_tps = max(measure("sync") for _ in range(reps))
+    result = {
+        "metric": "ckpt_async_overhead_pct",
+        "value": round((base / async_tps - 1.0) * 100, 2),
+        "unit": "%",
+        "steps": steps,
+        "save_every_n_steps": every,
+        "tokens_per_sec": {"off": round(base, 1),
+                           "async": round(async_tps, 1),
+                           "sync": round(sync_tps, 1)},
+        "sync_overhead_pct": round((base / sync_tps - 1.0) * 100, 2),
+    }
+    print(json.dumps(result))
+
+
 def serving_main():
     """Serving benchmark mode (`python bench.py --serving` or
     BENCH_MODE=serving): N concurrent clients hammer the HTTP server's
@@ -187,6 +326,10 @@ def main():
     if "--serving" in sys.argv or \
             os.environ.get("BENCH_MODE") == "serving":
         serving_main()
+        return
+    if "--checkpoint" in sys.argv or \
+            os.environ.get("BENCH_MODE") == "checkpoint":
+        checkpoint_main()
         return
     # allow CPU fallback benchmarking only when explicitly requested or
     # after the full retry budget is exhausted
@@ -399,8 +542,18 @@ def main():
     }
     if on_tpu:
         result["mfu"] = round(mfu, 4)
-    if _FALLBACK_NOTE:
-        result["note"] = _FALLBACK_NOTE
+    else:
+        # ANY CPU run is a FAILED perf run for the north-star record, and
+        # says so explicitly — the driver must not read CPU tokens/s as
+        # the perf headline.  The last-good on-chip number rides along so
+        # a tunnel hang never erases what the chip already demonstrated
+        # (VERDICT r5 weak-point 7).
+        result["failed"] = True
+        result["note"] = _FALLBACK_NOTE or \
+            "CPU run (TPU not used); not comparable to the baseline"
+        last = _last_known_tpu_metric()
+        if last is not None:
+            result["last_known_tpu"] = last
     print(json.dumps(result))
 
 

@@ -1,0 +1,27 @@
+"""paddle_tpu.checkpoint — async atomic checkpointing + auto-resume.
+
+The preemption-safety tier for TPU-pod training (docs/checkpoint.md):
+
+* ``CheckpointManager`` (manager.py) — async background persistence with
+  a bounded in-flight budget, atomic temp-dir-then-rename commits, CRC
+  manifests, keep-last-N / keep-every-M retention, ``latest_step()``
+  discovery that skips truncated checkpoints, and SIGTERM/SIGINT final
+  saves.
+* atomic primitives (atomic.py) — ``atomic_write`` (write-temp-rename
+  for single files, used by hapi ``Model.save`` and ``paddle.save``),
+  ``commit_dir``, fsync helpers.
+
+Integration points: ``Executor.enable_checkpointing`` /
+``Executor.restore_from_checkpoint`` (static), ``Model.fit(...,
+resume=True)`` (hapi), and ``incubate.checkpoint.CheckpointSaver``
+(fluid-parity surface re-based on the same atomic commit protocol).
+"""
+from .atomic import atomic_write, commit_dir, crc32_file, fsync_dir  # noqa: F401
+from .manager import (  # noqa: F401
+    Checkpoint, CheckpointError, CheckpointManager, FORMAT_VERSION,
+)
+
+__all__ = [
+    "CheckpointManager", "Checkpoint", "CheckpointError", "FORMAT_VERSION",
+    "atomic_write", "commit_dir", "crc32_file", "fsync_dir",
+]

@@ -6,23 +6,42 @@ derive per-op keys as fold_in(PRNGKey(seed + step), op_uid); dygraph draws
 sequentially from a counter."""
 from __future__ import annotations
 
+import os
 import threading
 
-_state = threading.local()
+
+class _State:
+    """Process-global generator state (reference generator.h has ONE
+    default generator per device, not one per thread) — a DataLoader
+    prefetch thread drawing shuffle seeds and the main thread restoring
+    checkpointed RNG state must see the same generator.
+
+    `salt` is per-process OS entropy mixed into UNSEEDED sampler draws
+    only, so independent launches shuffle differently (as they did when
+    samplers drew raw OS entropy) without making dygraph init or seeded
+    runs nondeterministic.  paddle.seed() zeroes it (explicit seeding
+    means cross-process reproducibility), and it rides the checkpointed
+    RNG state so a resumed unseeded run still replays its sequence."""
+    seed = 0
+    counter = 0
+    salt = int.from_bytes(os.urandom(4), "little")
+
+
+_state = _State()
+_mu = threading.Lock()
 
 
 def _get():
-    if not hasattr(_state, "seed"):
-        _state.seed = 0
-        _state.counter = 0
     return _state
 
 
 def seed(s: int):
     """paddle.seed analog: seed every generator."""
     st = _get()
-    st.seed = int(s)
-    st.counter = 0
+    with _mu:
+        st.seed = int(s)
+        st.counter = 0
+        st.salt = 0
     from .program import default_main_program, default_startup_program
     default_main_program().random_seed = int(s)
     default_startup_program().random_seed = int(s)
@@ -33,12 +52,38 @@ def global_seed() -> int:
     return _get().seed
 
 
+def process_salt() -> int:
+    """OS-entropy component of unseeded sampler draws (0 once seeded)."""
+    return _get().salt
+
+
 def next_eager_uid() -> int:
     """Monotone uid for dygraph op calls (each eager random op gets a fresh
     key from fold_in(key(seed), uid))."""
     st = _get()
-    st.counter += 1
-    return st.counter
+    with _mu:
+        st.counter += 1
+        return st.counter
+
+
+def get_rng_state() -> dict:
+    """Snapshot the global generator (seed + eager draw counter + process
+    salt) for checkpointing; restore with :func:`set_rng_state`."""
+    st = _get()
+    with _mu:
+        return {"seed": st.seed, "counter": st.counter, "salt": st.salt}
+
+
+def set_rng_state(state: dict) -> None:
+    """Restore a :func:`get_rng_state` snapshot WITHOUT touching the
+    default programs' random_seed (unlike seed(), which also resets the
+    counter) — resumed training replays the exact eager key sequence
+    (including unseeded sampler draws, via the restored salt)."""
+    st = _get()
+    with _mu:
+        st.seed = int(state.get("seed", st.seed))
+        st.counter = int(state.get("counter", st.counter))
+        st.salt = int(state.get("salt", st.salt))
 
 
 class Generator:

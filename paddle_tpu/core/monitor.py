@@ -69,13 +69,16 @@ class _Reservoir:
 
 class StatRegistry:
     _instance = None
-    _lock = threading.Lock()
+    # RLocks, not Locks: a SIGTERM handler (checkpoint preemption save)
+    # records metrics from the same thread whose interrupted frame may
+    # already hold the registry lock — a plain Lock self-deadlocks there
+    _lock = threading.RLock()
 
     def __init__(self):
         self._stats: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._hists: Dict[str, _Reservoir] = {}
-        self._mu = threading.Lock()
+        self._mu = threading.RLock()
 
     @classmethod
     def instance(cls) -> "StatRegistry":

@@ -14,13 +14,92 @@ reference's op_version_registry / op_compatible_info flow.
 from __future__ import annotations
 
 import json
-from typing import Any
+from typing import Any, Tuple
+
+import numpy as np
 
 from . import framework_pb2 as pb
 from .op_version import saved_op_versions
 
 __all__ = ["program_to_proto", "program_from_proto",
-           "serialize_program", "deserialize_program"]
+           "serialize_program", "deserialize_program",
+           "encode_tensor", "decode_tensor",
+           "tensor_to_bytes", "tensor_from_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# tensor payload codec (checkpoint shards, save_vars archives)
+# ---------------------------------------------------------------------------
+# bfloat16 is the dominant TPU checkpoint dtype but is NOT a native numpy
+# dtype: np.save/np.savez cannot express its descr, and a pickle round-trip
+# ties the artifact to ml_dtypes being importable at load site.  The codec
+# stores bf16 as a bit-exact uint16 view plus a dtype tag, so shard files
+# stay plain numpy-representable buffers and the logical dtype is
+# reconstructed from the tag (paddle_tpu/checkpoint/manager.py manifests).
+
+_TENSOR_MAGIC = b"PTT1"
+
+
+def encode_tensor(arr) -> Tuple[np.ndarray, str]:
+    """Lower an array to a numpy-storable view + logical dtype tag.
+
+    bfloat16 -> (uint16 bit view, "bfloat16"); every native numpy dtype
+    passes through with its own name as the tag.  The view is contiguous
+    so ``view.tobytes()`` is the canonical payload for CRCs."""
+    a = np.asarray(arr)
+    if not a.flags["C_CONTIGUOUS"]:
+        # .reshape(a.shape) undoes ascontiguousarray's 0-d -> 1-d promotion
+        a = np.ascontiguousarray(a).reshape(a.shape)
+    name = a.dtype.name
+    if name == "bfloat16":
+        return a.view(np.uint16), "bfloat16"
+    return a, name
+
+
+def decode_tensor(view, dtype_tag: str) -> np.ndarray:
+    """Inverse of :func:`encode_tensor`: reinterpret the stored view as its
+    logical dtype (bit-exact for bf16)."""
+    a = np.asarray(view)
+    if not a.flags["C_CONTIGUOUS"]:
+        a = np.ascontiguousarray(a).reshape(a.shape)
+    if dtype_tag == "bfloat16":
+        import ml_dtypes
+        if a.dtype != np.uint16:
+            raise ValueError(
+                f"bfloat16 payload must be a uint16 view, got {a.dtype}")
+        return a.view(ml_dtypes.bfloat16)
+    if a.dtype.name != dtype_tag:
+        a = a.astype(np.dtype(dtype_tag))
+    return a
+
+
+def tensor_to_bytes(arr) -> bytes:
+    """Self-describing binary tensor record: magic + length-prefixed JSON
+    header {dtype, vdtype, shape} + raw buffer bytes."""
+    view, tag = encode_tensor(arr)
+    header = json.dumps({"dtype": tag, "vdtype": view.dtype.str,
+                         "shape": list(view.shape)}).encode()
+    return (_TENSOR_MAGIC + len(header).to_bytes(4, "little") + header
+            + view.tobytes())
+
+
+def tensor_from_bytes(data: bytes) -> np.ndarray:
+    if data[:4] != _TENSOR_MAGIC:
+        raise ValueError("not a paddle_tpu tensor record (bad magic)")
+    hlen = int.from_bytes(data[4:8], "little")
+    meta = json.loads(data[8:8 + hlen].decode())
+    buf = data[8 + hlen:]
+    # .copy(): the result must OWN its memory (and be writeable) — a
+    # bytes-backed frombuffer view is read-only and can be zero-copy
+    # aliased by jnp.asarray downstream, which donate_argnums would then
+    # free out from under the caller
+    view = np.frombuffer(buf, dtype=np.dtype(meta["vdtype"])).copy()
+    expect = int(np.prod(meta["shape"])) if meta["shape"] else 1
+    if view.size != expect:
+        raise ValueError(
+            f"tensor record truncated: {view.size} elements, header "
+            f"declares {expect}")
+    return decode_tensor(view.reshape(meta["shape"]), meta["dtype"])
 
 _VAR_TYPES = {"DENSE_TENSOR": pb.VarDesc.DENSE_TENSOR,
               "SELECTED_ROWS": pb.VarDesc.SELECTED_ROWS,

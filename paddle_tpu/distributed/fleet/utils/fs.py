@@ -87,8 +87,20 @@ class LocalFS(FS):
             os.remove(fs_path)
 
     def mv(self, src, dst, overwrite=False):
-        if not overwrite and os.path.exists(dst):
+        if not os.path.exists(dst):
+            # same-filesystem move is an atomic rename (the checkpoint
+            # tier's commit primitive); cross-device falls back to copy
+            shutil.move(src, dst)
+            return
+        if not overwrite:
             raise FSFileExistsError(dst)
+        if os.path.isfile(src) and not os.path.isdir(dst):
+            try:
+                os.replace(src, dst)  # atomic file swap, never a window
+                return
+            except OSError:
+                pass  # cross-device (EXDEV): no atomic swap exists
+        self.delete(dst)
         shutil.move(src, dst)
 
     def touch(self, fs_path, exist_ok=True):

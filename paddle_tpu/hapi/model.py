@@ -206,12 +206,43 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
-        """hapi model.py:1242 parity."""
+            callbacks=None, resume=False):
+        """hapi model.py:1242 parity, plus preemption-safe auto-resume.
+
+        With ``save_dir`` set, every ``save_freq``-th epoch commits an
+        atomic checkpoint (params + optimizer + LR state) under
+        ``<save_dir>/checkpoints`` via paddle_tpu.checkpoint.
+        ``resume=True`` restores the newest valid checkpoint and
+        continues from the epoch after it — a killed run re-launched
+        with the same arguments picks up where it stopped."""
         loader = _make_loader(train_data, batch_size, shuffle, drop_last,
                               num_workers)
         eval_loader = _make_loader(eval_data, batch_size, False, False,
                                    num_workers)
+        start_epoch = 0
+        ckpt_mgr = None
+        if resume and save_dir is None:
+            raise ValueError("fit(resume=True) needs save_dir")
+        if save_dir is not None:
+            from ..checkpoint import CheckpointManager
+            ckpt_root = os.path.join(save_dir, "checkpoints")
+            if not resume and os.path.isdir(ckpt_root):
+                # a previous run's higher-numbered checkpoints would make
+                # retention GC delete this fresh run's commits the moment
+                # they land, and would hijack a later resume=True — a
+                # non-resuming fit owns its save_dir
+                import shutil
+                import warnings
+                warnings.warn(
+                    f"fit(resume=False) discarding stale checkpoints "
+                    f"under {ckpt_root}", RuntimeWarning, stacklevel=2)
+                shutil.rmtree(ckpt_root)
+            ckpt_mgr = CheckpointManager(ckpt_root)
+            if resume:
+                ckpt = ckpt_mgr.load()
+                if ckpt is not None:
+                    self._restore_fit_state(ckpt)
+                    start_epoch = ckpt.step + 1
         steps = len(loader) if hasattr(loader, "__len__") else None
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, verbose=verbose,
@@ -221,26 +252,85 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         history = []
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            for m in self._metrics:
-                m.reset()
-            cbks.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, lbls = self._split_batch(batch)
-                res = self.train_batch(ins, lbls)
-                logs = dict(zip(["loss"] + self._metric_names(), res))
-                cbks.on_train_batch_end(step, logs)
-            cbks.on_epoch_end(epoch, logs)
-            history.append(logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, batch_size=batch_size,
-                              verbose=0, callbacks=callbacks)
+        try:
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
+                    break
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(epoch)
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, lbls = self._split_batch(batch)
+                    res = self.train_batch(ins, lbls)
+                    logs = dict(zip(["loss"] + self._metric_names(), res))
+                    cbks.on_train_batch_end(step, logs)
+                cbks.on_epoch_end(epoch, logs)
+                history.append(logs)
+                if ckpt_mgr is not None and (
+                        (epoch + 1) % save_freq == 0 or
+                        epoch + 1 == epochs):
+                    state, extra = self._fit_state()
+                    extra["epoch"] = epoch
+                    ckpt_mgr.save(epoch, state, extra=extra)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, batch_size=batch_size,
+                                  verbose=0, callbacks=callbacks)
+        finally:
+            if ckpt_mgr is not None:
+                import sys
+                # must be read BEFORE the except below, which would make
+                # exc_info reflect the close error instead
+                propagating = sys.exc_info()[0] is not None
+                try:
+                    ckpt_mgr.close()  # drains in-flight saves
+                except Exception:
+                    # a deferred background-save error must not mask an
+                    # exception already propagating out of the train loop
+                    if not propagating:
+                        raise
+                    import warnings
+                    warnings.warn(
+                        "checkpoint manager close failed while another "
+                        "exception was propagating", RuntimeWarning)
         cbks.on_train_end()
         return history
+
+    def _fit_state(self):
+        """(state, extra) for the epoch checkpoint: tensors prefixed
+        model/ and opt/; the JSON-able LR-scheduler dict rides extra."""
+        state = {"model/" + k: v for k, v in
+                 self.network.state_dict().items()}
+        from ..core.generator import get_rng_state
+        # without the generator state a resumed run would redraw dropout
+        # masks / shuffles from a fresh counter and diverge from the
+        # straight-through run
+        extra = {"rng": get_rng_state()}
+        if self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            for k, v in self._optimizer.state_dict().items():
+                if k == "LR_Scheduler":
+                    extra["lr_scheduler"] = {
+                        kk: float(vv) for kk, vv in v.items()}
+                else:
+                    state["opt/" + k] = v
+        return state, extra
+
+    def _restore_fit_state(self, ckpt):
+        params = {k[len("model/"):]: v for k, v in ckpt.state.items()
+                  if k.startswith("model/")}
+        self.network.set_state_dict(params)
+        opt_state = {k[len("opt/"):]: v for k, v in ckpt.state.items()
+                     if k.startswith("opt/")}
+        if "rng" in ckpt.extra:
+            from ..core.generator import set_rng_state
+            set_rng_state(ckpt.extra["rng"])
+        if "lr_scheduler" in ckpt.extra:
+            opt_state["LR_Scheduler"] = ckpt.extra["lr_scheduler"]
+        if opt_state and self._optimizer is not None and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(opt_state)
 
     def _split_batch(self, batch):
         batch = _to_list(batch)
@@ -291,7 +381,10 @@ class Model:
 
     # -- save / load / summary ----------------------------------------------
     def save(self, path, training=True):
-        """model.py save: <path>.pdparams (+ .pdopt when training)."""
+        """model.py save: <path>.pdparams (+ .pdopt when training).  Both
+        files go through the atomic write-temp-then-rename helper — a
+        crash mid-write leaves the previous artifact intact instead of a
+        truncated pickle."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -302,7 +395,8 @@ class Model:
             def _host(v):
                 # arrays → numpy; nested dicts (LR_Scheduler state) kept
                 return v if hasattr(v, "keys") else np.asarray(v)
-            with open(path + ".pdopt", "wb") as f:
+            from ..checkpoint.atomic import atomic_write
+            with atomic_write(path + ".pdopt") as f:
                 pickle.dump({k: _host(v) for k, v in
                              self._optimizer.state_dict().items()},
                             f, protocol=4)

@@ -138,14 +138,17 @@ class TrainEpochRange(SerializableBase):
             self._fs = self._checker.make_fs()
             self._saver = CheckpointSaver(self._fs)
             self._path = self._checker.get_job_checkpoint_path(name)
-            no = self._saver.get_last_checkpoint_no(self._path)
-            if no >= 0:
-                self._saver.load_checkpoint(self._path, [self])
+            # load_checkpoint verifies integrity and may fall back to an
+            # earlier number than the newest dir — trust ITS return value
+            no = self._saver.load_checkpoint(self._path, [self])
+            if no is not None:
                 self._start_epoch = self._epoch_no + 1
                 # statuses restore lazily at _attach (the programs don't
-                # exist yet); remember where their .npz blobs live
+                # exist yet); the saver reports the exact (absolute) local
+                # dir it verified and deserialized from — on a remote FS
+                # that's the materialized cache copy, never the remote path
                 self._restore_dir = os.path.join(
-                    self._path, f"__paddle_checkpoint__.{no}", "obj_0")
+                    self._saver.last_restore_dir, "obj_0")
 
     @property
     def name(self):

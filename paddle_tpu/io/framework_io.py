@@ -8,14 +8,18 @@ Reference:
   /root/reference/paddle/fluid/framework/save_load_util.cc (tensor format).
 
 Formats (TPU build):
-  * per-var file      : raw np.save (.npy payload under the var's name)
-  * combined file     : np.savez archive keyed by var name
+  * per-var file      : raw np.save (.npy payload under the var's name);
+                        dtypes numpy cannot express (bf16) as .npt
+                        self-describing records (core/serialization)
+  * combined file     : np.savez archive keyed by var name, non-native
+                        dtypes tagged in a __tensor_dtypes__ sidecar entry
   * program file      : Program.serialize_to_string (JSON, versioned)
   * 2.0 prefix        : <prefix>.pdmodel / .pdparams / .pdopt where the
                         param/opt files are pickled {name: ndarray} dicts.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
 from collections import OrderedDict
@@ -52,11 +56,12 @@ def _tree_to_numpy(obj):
 
 
 def save(obj, path, protocol=4):
-    """paddle.save — pickle an object tree with tensors lowered to numpy."""
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    """paddle.save — pickle an object tree with tensors lowered to numpy.
+    Atomic: written to a same-dir temp file, fsync'd, renamed into place
+    (paddle_tpu/checkpoint/atomic.py) so a crash mid-save never corrupts
+    an existing artifact."""
+    from ..checkpoint.atomic import atomic_write
+    with atomic_write(path) as f:
         pickle.dump(_tree_to_numpy(obj), f, protocol=protocol)
 
 
@@ -106,14 +111,40 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             raise RuntimeError(f"variable {v.name!r} has no value in scope "
                                "(run the startup program first)")
         values[v.name] = _to_numpy(val)
+    from ..core.serialization import encode_tensor, tensor_to_bytes
     if filename is None:
         for name, val in values.items():
-            np.save(os.path.join(dirname, name + ".npy"), val)
+            view, tag = encode_tensor(val)
+            if view.dtype == val.dtype:  # native numpy dtype
+                np.save(os.path.join(dirname, name + ".npy"), val)
+                stale = os.path.join(dirname, name + ".npt")
+            else:
+                # bf16 etc.: np.save silently degrades non-native dtypes
+                # to a void descr ('|V2') that loads back as garbage —
+                # use the self-describing tensor record instead
+                with open(os.path.join(dirname, name + ".npt"), "wb") as f:
+                    f.write(tensor_to_bytes(val))
+                stale = os.path.join(dirname, name + ".npy")
+            if os.path.exists(stale):
+                # a re-save that switched the var's dtype class must not
+                # leave the other extension behind: load prefers .npy and
+                # would silently restore the stale values
+                os.remove(stale)
     else:
         # write through a file object so np.savez can't append '.npz' and
         # break the save→load filename round-trip
+        enc, tags = {}, {}
+        for name, val in values.items():
+            enc[name], tag = encode_tensor(val)
+            if enc[name].dtype != val.dtype:  # non-native: tag the view
+                tags[name] = tag
+        if tags:
+            # sidecar entry, not a var name: old loaders only look up
+            # requested var names, so the archive stays backward-readable
+            enc["__tensor_dtypes__"] = np.frombuffer(
+                json.dumps(tags).encode(), dtype=np.uint8)
         with open(os.path.join(dirname, filename), "wb") as f:
-            np.savez(f, **values)
+            np.savez(f, **enc)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -129,12 +160,16 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     import jax.numpy as jnp
+    from ..core.serialization import decode_tensor, tensor_from_bytes
     prog, vars = _resolve(executor, main_program,
                           predicate or is_persistable, vars)
     scope = _scope_of(executor)
     if filename is not None:
         archive = np.load(os.path.join(dirname, filename))
         src = {k: archive[k] for k in archive.files}
+        tags = {}
+        if "__tensor_dtypes__" in src:
+            tags = json.loads(src.pop("__tensor_dtypes__").tobytes())
     else:
         src = None
     for v in vars:
@@ -142,11 +177,18 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             if v.name not in src:
                 raise KeyError(f"{v.name!r} missing from {filename}")
             val = src[v.name]
+            if v.name in tags:
+                val = decode_tensor(val, tags[v.name])
         else:
             p = os.path.join(dirname, v.name + ".npy")
-            if not os.path.exists(p):
-                raise FileNotFoundError(p)
-            val = np.load(p)
+            if os.path.exists(p):
+                val = np.load(p)
+            else:
+                pt = os.path.join(dirname, v.name + ".npt")
+                if not os.path.exists(pt):
+                    raise FileNotFoundError(p)
+                with open(pt, "rb") as f:
+                    val = tensor_from_bytes(f.read())
         scope.set(v.name, jnp.asarray(val))
 
 
@@ -252,14 +294,15 @@ def static_save(program, path_prefix, executor=None):
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
+    from ..checkpoint.atomic import atomic_write
     from ..static.executor import global_scope
     scope = global_scope()
     params, opts = _split_param_opt(program, scope)
-    with open(path_prefix + ".pdparams", "wb") as f:
+    with atomic_write(path_prefix + ".pdparams") as f:
         pickle.dump(params, f, protocol=4)
-    with open(path_prefix + ".pdopt", "wb") as f:
+    with atomic_write(path_prefix + ".pdopt") as f:
         pickle.dump(opts, f, protocol=4)
-    with open(path_prefix + ".pdmodel", "wb") as f:
+    with atomic_write(path_prefix + ".pdmodel") as f:
         f.write(program.serialize_to_string())
 
 

@@ -20,6 +20,19 @@ __all__ = ["Sampler", "SequenceSampler", "RandomSampler",
            "DistributedBatchSampler"]
 
 
+def _framework_epoch_seed():
+    """Per-iteration shuffle seed derived from the global generator
+    (seed + per-process salt + monotone draw counter) instead of raw OS
+    entropy: epochs still shuffle differently, and independent UNSEEDED
+    launches still differ (the salt is fresh entropy per process), but
+    the sequence is reproducible under paddle.seed() (salt pinned to 0)
+    and — because counter and salt ride checkpoint RNG state — replays
+    identically after a resume (checkpoint bitwise-equivalence covers
+    shuffle order, not just dropout)."""
+    from ..core.generator import global_seed, next_eager_uid, process_salt
+    return (global_seed(), process_salt(), next_eager_uid())
+
+
 class Sampler:
     def __init__(self, data_source=None):
         self.data_source = data_source
@@ -68,7 +81,10 @@ class RandomSampler(Sampler):
                 except StopIteration:
                     return
             return
-        rng = np.random.default_rng(self.generator)
+        if self.generator is None:
+            rng = np.random.default_rng(_framework_epoch_seed())
+        else:
+            rng = np.random.default_rng(self.generator)
         if self.replacement:
             yield from rng.integers(0, n, size=self.num_samples).tolist()
         else:
@@ -93,7 +109,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        rng = np.random.default_rng()
+        rng = np.random.default_rng(_framework_epoch_seed())
         idx = rng.choice(len(self.weights), size=self.num_samples,
                          replace=self.replacement, p=p)
         yield from idx.tolist()

@@ -91,6 +91,9 @@ class Prefetcher:
                                             sharding=sharding)
         self._place_fn = place_fn or (lambda b: b)
         self._source = iter(source)
+        self.position = 0  # batches HANDED TO the consumer (checkpointable
+        # resume cursor: staged-but-unconsumed batches are not counted, so
+        # a restart re-reads them instead of skipping them)
         self._q: "_queue.Queue" = _queue.Queue(maxsize=depth)
         self._err: Optional[BaseException] = None
         self._closed = threading.Event()
@@ -140,6 +143,7 @@ class Prefetcher:
                 err, self._err = self._err, None
                 raise err
             raise StopIteration
+        self.position += 1
         return item
 
     def __enter__(self):

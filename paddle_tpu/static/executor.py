@@ -173,6 +173,57 @@ def _persistable_names(program: Program) -> List[str]:
                   if v.persistable)
 
 
+def _unwrap_program(program):
+    """Peel executable wrappers down to the underlying Program:
+    ParallelExecutor wraps a CompiledProgram (``._compiled``) which wraps
+    the Program (``._program``) — the checkpoint hook must reach the real
+    Program through either."""
+    for _ in range(4):
+        if program is None or isinstance(program, Program):
+            break
+        inner = getattr(program, "_program", None)
+        if inner is None:
+            inner = getattr(program, "_compiled", None)
+        if inner is None:
+            break
+        program = inner
+    return program
+
+
+_OPTIMIZER_OP_TYPES = frozenset(
+    ("sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop", "lamb",
+     "lars_momentum", "dgc_momentum", "ftrl", "adamax", "adadelta"))
+
+
+def _is_training(program: Program) -> bool:
+    """A program that updates state: has backward or optimizer ops.
+    Distinguishes the real train program from startup (pure initializers)
+    and eval programs when the checkpoint hook has to bind by itself."""
+    return any(op.type.endswith("_grad") or op.type in _OPTIMIZER_OP_TYPES
+               for b in program.blocks for op in b.ops)
+
+
+class _CkptHook:
+    """Periodic-checkpoint registration (enable_checkpointing).
+
+    `program` may start as None and is latched by _maybe_checkpoint onto
+    the first training program run afterwards; `run_scope` tracks the
+    scope that program last ran in (for the preemption provider when no
+    scope was given at enable time); `last` is the executor step of the
+    most recent save (re-anchored by restore)."""
+
+    __slots__ = ("manager", "program", "every", "scope", "last",
+                 "run_scope")
+
+    def __init__(self, manager, program, every, scope, last):
+        self.manager = manager
+        self.program = program
+        self.every = every
+        self.scope = scope
+        self.last = last
+        self.run_scope = None
+
+
 class Executor:
     """exe = Executor(XLAPlace(0)); exe.run(startup); exe.run(main, feed,
     fetch_list) — the reference's two-program contract (executor.py:474)."""
@@ -198,6 +249,12 @@ class Executor:
         self._stats = {"hits": 0, "misses": 0, "traces": 0,
                        "bucket_hits": 0}
         self._step = 0
+        # periodic checkpointing (enable_checkpointing): (manager,
+        # program, every_n_steps, scope, last-saved-step)
+        self._ckpt = None
+        self._ckpt_barrier = None
+        self._active_prefetcher = None
+        self.last_restored_extra = None  # sidecar of the last resume
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -207,8 +264,18 @@ class Executor:
         if isinstance(program, CompiledProgram) or (
                 program is not None and not isinstance(program, Program)
                 and hasattr(program, "_run")):
-            # CompiledProgram / Pipeline / PS trainer program dispatch
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            # CompiledProgram / Pipeline / PS trainer program dispatch.
+            # The checkpoint hook still fires: multi-chip pretraining is
+            # the workload the checkpoint tier exists for.
+            results = program._run(self, feed, fetch_list, scope,
+                                   return_numpy)
+            # resolve the scope the wrapper actually ran in: some wrappers
+            # (ParallelExecutor) carry their own _scope — snapshotting
+            # global_scope() instead would commit an EMPTY checkpoint
+            self._maybe_checkpoint(
+                program, scope or getattr(program, "_scope", None)
+                or global_scope())
+            return results
         if getattr(program, "_ps_server_config", None):
             # pserver program: exe.run(pserver_prog) == listen_and_serv
             from ..distributed.ps.kv_server import KVServer
@@ -248,6 +315,7 @@ class Executor:
                                              fetch_names, return_numpy)
         if flag("check_nan_inf", False):
             self._check_nan_inf(fetch_names, results, scope)
+        self._maybe_checkpoint(program, scope)
         return results
 
     def _check_nan_inf(self, fetch_names, results, scope):
@@ -651,6 +719,7 @@ class Executor:
             else list(fetches)
         if flag("check_nan_inf", False):
             self._check_nan_inf(fetch_names, results, scope)
+        self._maybe_checkpoint(program, scope)
         return results
 
     def _compile_steps(self, program: Program, state_names, fetch_names):
@@ -682,12 +751,183 @@ class Executor:
         placement stage untouched, so staged and host batches can mix."""
         from ..reader.prefetcher import Prefetcher
         pf = Prefetcher(feeds, depth=prefetch_depth)
+        self._active_prefetcher = pf
         try:
             for feed in pf:
                 yield self.run(program, feed=feed, fetch_list=fetch_list,
                                scope=scope, return_numpy=return_numpy)
         finally:
+            self._active_prefetcher = None
             pf.close()
+
+    # -- checkpointing (paddle_tpu/checkpoint, docs/checkpoint.md) ----------
+    def enable_checkpointing(self, manager, program=None, every_n_steps=100,
+                             scope=None, barrier=None):
+        """Periodic async checkpoints of `program`'s persistable state.
+
+        After every run()/run_steps() that advances ``self._step`` across
+        an ``every_n_steps`` boundary, the persistables (params AND
+        optimizer accumulators — in static mode both live in the scope),
+        the executor step, and the RNG state are snapshotted and handed
+        to `manager` for background persistence.  Also registers the
+        manager's preemption state provider, so a SIGTERM final save
+        captures the live state (CheckpointManager.
+        install_preemption_handler).
+
+        With ``program=None`` the hook binds to the first TRAINING
+        program (one containing gradient/optimizer ops) run after
+        enabling; startup and eval programs running through the same
+        executor neither trigger saves nor hijack the snapshot.
+
+        With a ``world_size > 1`` manager, `barrier` (e.g.
+        ``paddle_tpu.distributed.collective.barrier``) lets the hook
+        publish each staged checkpoint during the run: save → wait →
+        barrier → rank-0 commit.  Without one, stages stay pending until
+        the next rank-0 startup recovers them."""
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+        self._ckpt = _CkptHook(manager=manager, program=program,
+                               every=int(every_n_steps), scope=scope,
+                               last=self._step)
+        self._ckpt_barrier = barrier
+        if getattr(manager, "world_size", 1) > 1 and barrier is None:
+            import warnings
+            warnings.warn(
+                "multi-host CheckpointManager without barrier=: periodic "
+                "checkpoints are only STAGED during the run and get "
+                "committed at the next rank-0 startup; pass barrier= "
+                "(e.g. paddle_tpu.distributed.collective.barrier) to "
+                "publish them as training goes", RuntimeWarning,
+                stacklevel=2)
+        def _provider():
+            # prefer the (possibly latched) registered program and the
+            # scope training actually runs in, so the final preemption
+            # save snapshots the same state the periodic hook does —
+            # the enable-time scope may be None while every run passes
+            # an explicit one
+            hook = self._ckpt
+            prog = (hook.program if hook else None) or program
+            sc = (hook.scope or hook.run_scope) if hook else scope
+            return self.checkpoint_snapshot(prog, sc)
+
+        manager.set_state_provider(_provider)
+
+    def disable_checkpointing(self):
+        if self._ckpt is not None:
+            # also detach the preemption provider: a SIGTERM after an
+            # explicit disable must not commit a snapshot of whatever
+            # default_main_program() happens to be
+            self._ckpt.manager.set_state_provider(None)
+        self._ckpt = None
+
+    def checkpoint_snapshot(self, program=None, scope=None):
+        """(step, state, extra) for CheckpointManager.save: persistable
+        scope values + executor step + RNG + dataset position (when a
+        run_prefetched loop is active)."""
+        program = program or default_main_program()
+        # CompiledProgram / ParallelExecutor wrap the real Program
+        program = _unwrap_program(program)
+        scope = scope or global_scope()
+        state = {n: scope.get(n) for n in _persistable_names(program)
+                 if scope.get(n) is not None}
+        from ..core.generator import get_rng_state
+        extra = {"executor_step": self._step, "rng": get_rng_state(),
+                 "program_fingerprint": program.fingerprint()}
+        pf = self._active_prefetcher
+        if pf is not None:
+            extra["dataset_position"] = pf.position
+        return self._step, state, extra
+
+    def _maybe_checkpoint(self, program, scope):
+        hook = self._ckpt
+        if hook is None:
+            return
+        run_p = _unwrap_program(program)
+        if hook.program is None:
+            # bind to the first TRAINING program run after enabling —
+            # runs of the startup or an eval program must neither latch
+            # (that would silently disable checkpointing of the real
+            # train loop) nor be snapshotted (their persistables lack
+            # the optimizer accumulators, and restoring such a
+            # checkpoint would silently reset Adam moments)
+            if not (isinstance(run_p, Program) and _is_training(run_p)):
+                return
+            hook.program = run_p
+        # compare the underlying Programs: registering the raw Program
+        # but running it through CompiledProgram / ParallelExecutor (the
+        # multi-chip paths) must still checkpoint
+        if run_p is not _unwrap_program(hook.program):
+            return
+        # remember where the registered program actually runs — the
+        # preemption provider snapshots this scope when none was given
+        # at enable time
+        hook.run_scope = scope
+        if self._step - hook.last < hook.every:
+            return
+        step, state, extra = self.checkpoint_snapshot(
+            hook.program, hook.scope or scope)
+        hook.manager.save(step, state, extra=extra)
+        if getattr(hook.manager, "world_size", 1) > 1 and \
+                self._ckpt_barrier is not None:
+            # multi-host publish: every rank staged+fsync'd, then rank 0
+            # renames — never publishes a stage another rank is writing
+            hook.manager.wait()
+            self._ckpt_barrier()
+            hook.manager.commit(step)
+        hook.last = self._step
+
+    def restore_from_checkpoint(self, manager, program=None, scope=None,
+                                step=None):
+        """Auto-resume: load the newest VALID checkpoint (corrupt ones are
+        skipped by the manager), write the state back into the scope, and
+        restore the executor step + RNG so per-step derived seeds replay
+        identically.  Returns the restored step, or None when the
+        checkpoint root is empty (fresh start).
+
+        The checkpoint's non-tensor sidecar survives on
+        ``self.last_restored_extra`` — in particular
+        ``extra["dataset_position"]`` (batches already consumed by the
+        interrupted run_prefetched loop), which the caller uses to
+        fast-forward its feed source::
+
+            pos = (exe.last_restored_extra or {}).get("dataset_position", 0)
+            for out in exe.run_prefetched(main, islice(feeds, pos, None)):
+                ...
+        """
+        ckpt = manager.load(step=step)
+        if ckpt is None:
+            self.last_restored_extra = None
+            return None
+        scope = scope or global_scope()
+        extra = ckpt.extra
+        saved_fp = extra.get("program_fingerprint")
+        if program is not None and saved_fp is not None:
+            target_fp = _unwrap_program(program).fingerprint()
+            if target_fp != saved_fp:
+                import warnings
+                warnings.warn(
+                    "restoring a checkpoint saved from a DIFFERENT "
+                    "program (fingerprint mismatch): vars absent from "
+                    "the checkpoint keep their fresh-init values and "
+                    "orphan checkpoint vars are still written — resumed "
+                    "training may diverge from the original run",
+                    RuntimeWarning, stacklevel=2)
+        for name, val in ckpt.state.items():
+            # jnp.array (copy), never jnp.asarray: a zero-copy alias of
+            # host memory would be donated to XLA by the next step's
+            # donate_argnums and freed/reused out from under numpy
+            scope.set(name, jnp.array(val))
+        self._step = int(extra.get("executor_step", ckpt.step))
+        if self._ckpt is not None:
+            # enable-then-restore ordering: re-anchor the last-saved
+            # marker so the next run doesn't immediately re-save the
+            # state just loaded (and shift every later boundary)
+            self._ckpt.last = self._step
+        if "rng" in extra:
+            from ..core.generator import set_rng_state
+            set_rng_state(extra["rng"])
+        self.last_restored_extra = dict(extra)
+        return ckpt.step
 
     # -- helpers ------------------------------------------------------------
     def _coerce_feed(self, block, name, val):

@@ -1,0 +1,809 @@
+"""paddle_tpu.checkpoint — async atomic checkpointing + auto-resume.
+
+The contracts docs/checkpoint.md promises:
+  * kill/resume equivalence — straight-through training and
+    train-k/crash/resume/train-rest produce BITWISE-identical params and
+    optimizer state;
+  * a truncated or checksum-corrupt checkpoint is never loaded — load()
+    warns and falls back to the previous valid step;
+  * retention keeps last-N ∪ every-M;
+  * async saves are bounded in flight and drain on wait()/close();
+  * bf16 state round-trips bit-exactly (TPU checkpoints are mostly bf16);
+  * hapi Model.fit(resume=True) continues from the saved epoch;
+  * SIGTERM/SIGINT handlers write a final synchronous checkpoint.
+"""
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+from paddle_tpu.core.program import _reset_unique_names
+from paddle_tpu.checkpoint import (
+    CheckpointManager, CheckpointError, atomic_write,
+)
+
+
+def _build():
+    """Identical program on every call (fresh name counters, as a process
+    restart would have)."""
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(n):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(4, 8).astype(np.float32),
+             "y": rng.rand(4, 1).astype(np.float32)} for _ in range(n)]
+
+
+def _persistables(program, scope):
+    from paddle_tpu.static.executor import _persistable_names
+    return {n: np.asarray(scope.get(n))
+            for n in _persistable_names(program)
+            if scope.get(n) is not None}
+
+
+def test_kill_resume_bitwise_equivalence(tmp_path):
+    """Train 6 straight vs train 3 / 'crash' / auto-resume / train 3 →
+    params AND optimizer accumulators bitwise-identical."""
+    n, k = 6, 3
+    feeds = _feeds(n)
+
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for f in feeds:
+            exe.run(main, feed=f, fetch_list=[loss])
+        ref = _persistables(main, scope)
+
+    root = str(tmp_path / "ckpts")
+    main2, startup2, loss2 = _build()
+    assert main2.fingerprint() == main.fingerprint()
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    mgr = CheckpointManager(root)
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        exe2.enable_checkpointing(mgr, program=main2, every_n_steps=k,
+                                  scope=scope2)
+        for f in feeds[:k]:
+            exe2.run(main2, feed=f, fetch_list=[loss2])
+    mgr.close()  # drains the async save
+
+    # crash: everything rebuilt from scratch, only the dir survives
+    main3, startup3, loss3 = _build()
+    exe3 = static.Executor()
+    scope3 = static.Scope()
+    mgr2 = CheckpointManager(root)
+    with static.scope_guard(scope3):
+        exe3.run(startup3)
+        resumed = exe3.restore_from_checkpoint(mgr2, program=main3,
+                                               scope=scope3)
+        assert resumed is not None
+        for f in feeds[k:]:
+            exe3.run(main3, feed=f, fetch_list=[loss3])
+        got = _persistables(main3, scope3)
+    mgr2.close()
+
+    assert set(ref) == set(got)
+    for name in sorted(ref):
+        assert ref[name].dtype == got[name].dtype, name
+        np.testing.assert_array_equal(ref[name], got[name], err_msg=name)
+
+
+def test_corrupt_checkpoint_never_loads(tmp_path):
+    """Truncation → latest_step() skips; bit-flip → CRC refusal; load()
+    falls back with a RuntimeWarning; explicit load(step=) raises."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": np.full((8, 8), s, np.float32)}, sync=True)
+    assert mgr.latest_step() == 3
+
+    shard3 = os.path.join(mgr.step_dir(3), "shard_00000.bin")
+    with open(shard3, "r+b") as f:
+        f.truncate(os.path.getsize(shard3) // 2)
+    assert mgr.latest_step() == 2  # truncated step skipped
+
+    shard2 = os.path.join(mgr.step_dir(2), "shard_00000.bin")
+    with open(shard2, "r+b") as f:
+        f.seek(3)
+        b = f.read(1)
+        f.seek(3)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ckpt = mgr.load()
+    assert ckpt.step == 1
+    assert ckpt.state["w"][0, 0] == 1
+    assert sum(isinstance(w.message, RuntimeWarning)
+               for w in caught) >= 2  # one per refused checkpoint
+
+    with pytest.raises(CheckpointError):
+        mgr.load(step=3)
+    with pytest.raises(CheckpointError):
+        mgr.load(step=2)
+    mgr.close()
+
+
+def test_retention_keep_last_n_and_every_m(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=2,
+                            keep_every_m_steps=4)
+    for s in range(1, 9):
+        mgr.save(s, {"w": np.zeros(3, np.float32)}, sync=True)
+    # last 2 = {7, 8}; every 4 = {4, 8}
+    assert mgr.all_steps() == [4, 7, 8]
+    mgr.close()
+
+
+def test_async_saves_drain_and_record_metrics(tmp_path):
+    from paddle_tpu.core.monitor import gauge_get, hist_snapshot, stat_get
+    before = stat_get("checkpoint.saves")
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10, max_in_flight=1)
+    for s in range(5):
+        mgr.save(s, {"w": np.full((16, 16), s, np.float32)})
+    mgr.wait()  # all five persisted despite a budget of 1 in flight
+    assert mgr.all_steps() == [0, 1, 2, 3, 4]
+    assert stat_get("checkpoint.saves") - before == 5
+    assert stat_get("checkpoint.bytes_written") > 0
+    assert gauge_get("checkpoint.last_saved_step") == 4
+    assert hist_snapshot("checkpoint.save_seconds")["count"] >= 5
+    mgr.close()
+
+
+def test_bf16_state_roundtrips_bit_exact(tmp_path):
+    import ml_dtypes
+    rng = np.random.RandomState(7)
+    bf = rng.randn(33, 9).astype(ml_dtypes.bfloat16)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w_bf16": bf, "w_f32": rng.randn(4).astype(np.float32)},
+             sync=True)
+    ckpt = mgr.load()
+    got = ckpt.state["w_bf16"]
+    assert got.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(got.view(np.uint16), bf.view(np.uint16))
+    assert ckpt.state["w_f32"].dtype == np.float32
+    mgr.close()
+
+
+def test_extra_sidecar_and_rng_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    extra = {"executor_step": 12, "rng": {"seed": 42, "counter": 7},
+             "dataset_position": 3}
+    mgr.save(12, {"w": np.ones(2, np.float32)}, extra=extra, sync=True)
+    ckpt = mgr.load()
+    assert ckpt.extra["rng"] == {"seed": 42, "counter": 7}
+    assert ckpt.extra["dataset_position"] == 3
+    mgr.close()
+
+
+def test_empty_state_save_warns(tmp_path):
+    """A zero-tensor save commits clean (nothing for CRC to catch) yet
+    restores nothing — almost always a wrong-scope caller bug, so save()
+    must warn."""
+    mgr = CheckpointManager(str(tmp_path))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mgr.save(1, {}, sync=True)
+    assert any(isinstance(w.message, RuntimeWarning) and
+               "EMPTY" in str(w.message) for w in caught)
+    mgr.close()
+
+
+def test_preemption_save_drains_and_writes_final(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w": np.zeros(4, np.float32)})  # async, in flight
+    mgr.set_state_provider(
+        lambda: (2, {"w": np.ones(4, np.float32)}, {"final": True}))
+    saved = mgr.preemption_save()
+    assert saved == 2
+    ckpt = mgr.load()
+    assert ckpt.step == 2 and ckpt.extra["final"] is True
+    assert mgr.all_steps() == [1, 2]  # the async one drained first
+    mgr.close()
+
+
+def test_preemption_handler_installs_and_chains(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.set_state_provider(
+        lambda: (5, {"w": np.zeros(2, np.float32)}, {}))
+    prev = signal.getsignal(signal.SIGINT)
+    mgr.install_preemption_handler(signals=(signal.SIGINT,))
+    try:
+        assert signal.getsignal(signal.SIGINT) == mgr._handle_preemption
+        with pytest.raises(KeyboardInterrupt):
+            mgr._handle_preemption(signal.SIGINT, None)
+        assert mgr.load().step == 5  # final checkpoint landed first
+    finally:
+        mgr.uninstall_preemption_handler()
+    assert signal.getsignal(signal.SIGINT) == prev
+    mgr.close()
+
+
+def test_preemption_handler_double_install_does_not_recurse(tmp_path):
+    """A second install must not record the handler as its own
+    'previous' disposition — the chain would recurse on signal instead
+    of saving and exiting."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.set_state_provider(
+        lambda: (3, {"w": np.zeros(2, np.float32)}, {}))
+    prev = signal.getsignal(signal.SIGINT)
+    mgr.install_preemption_handler(signals=(signal.SIGINT,))
+    mgr.install_preemption_handler(signals=(signal.SIGINT,))  # again
+    try:
+        assert mgr._prev_handlers[signal.SIGINT] == prev  # original kept
+        with pytest.raises(KeyboardInterrupt):  # not RecursionError
+            mgr._handle_preemption(signal.SIGINT, None)
+        assert mgr.load().step == 3
+    finally:
+        mgr.uninstall_preemption_handler()
+    assert signal.getsignal(signal.SIGINT) == prev
+    mgr.close()
+
+
+def test_unseeded_sampler_salt_differs_and_replays():
+    """Unseeded processes draw a per-process entropy salt, so
+    independent launches shuffle differently — yet the salt rides the
+    checkpointed RNG state, so a resumed unseeded run still replays its
+    exact shuffle sequence."""
+    from paddle_tpu.core.generator import (get_rng_state, process_salt,
+                                           seed, set_rng_state)
+    from paddle_tpu.io.sampler import RandomSampler
+    orig = get_rng_state()
+    try:
+        # simulate two independent unseeded processes via distinct salts
+        set_rng_state({"seed": 0, "counter": 0, "salt": 11111})
+        a = list(RandomSampler(list(range(64))))
+        set_rng_state({"seed": 0, "counter": 0, "salt": 22222})
+        b = list(RandomSampler(list(range(64))))
+        assert a != b
+        # resume replay: restoring the full state replays the draw
+        set_rng_state({"seed": 0, "counter": 0, "salt": 11111})
+        assert list(RandomSampler(list(range(64)))) == a
+        # explicit seeding pins the salt to 0 (cross-process reproducible)
+        seed(5)
+        assert process_salt() == 0
+    finally:
+        set_rng_state(orig)
+
+
+def test_multihost_stale_pending_pruned(tmp_path):
+    """No-barrier multi-host mode: superseded .pending stages are swept
+    once a newer recoverable stage exists and they have gone idle past
+    the grace window — a multi-day run must not accumulate one model
+    copy per save."""
+    import time
+    root = str(tmp_path)
+    m0 = CheckpointManager(root, rank=0, world_size=2)
+    m1 = CheckpointManager(root, rank=1, world_size=2)
+    for s in (1, 2):
+        m0.save(s, {"w": np.full(4, float(s), np.float32)}, sync=True)
+        m1.save(s, {"w": np.full(4, float(s), np.float32)}, sync=True)
+    p1 = os.path.join(root, ".pending.step_1")
+    p2 = os.path.join(root, ".pending.step_2")
+    assert os.path.isdir(p1) and os.path.isdir(p2)
+    old = time.time() - 7200
+    for dirpath, _dirs, files in os.walk(p1):
+        os.utime(dirpath, (old, old))
+        for f in files:
+            os.utime(os.path.join(dirpath, f), (old, old))
+    # next save triggers the prune on rank 0
+    m0.save(3, {"w": np.zeros(4, np.float32)}, sync=True)
+    assert not os.path.exists(p1)  # superseded by complete step 2, idle
+    assert os.path.isdir(p2)  # newest recoverable: kept
+    assert os.path.isdir(os.path.join(root, ".pending.step_3"))  # newest
+    for m in (m0, m1):
+        m.close()
+
+
+def test_stale_dir_recovered_not_deleted(tmp_path):
+    """Crash between commit_dir's two renames (re-publish of an existing
+    step) leaves the only complete copy under `.stale.*` — a fresh
+    manager must recover it back to `step_<N>`, not delete it."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(7, {"w": np.full(4, 7.0, np.float32)}, sync=True)
+    mgr.close()
+    os.rename(os.path.join(str(tmp_path), "step_7"),
+              os.path.join(str(tmp_path), ".stale.step_7.123.abcd1234"))
+    mgr2 = CheckpointManager(str(tmp_path))
+    assert mgr2.latest_step() == 7
+    assert mgr2.load().state["w"][0] == 7.0
+    mgr2.close()
+
+
+def test_preemption_save_proceeds_despite_stale_async_error(tmp_path):
+    """A stale background-save failure must not abort the final
+    synchronous preemption save; the error surfaces later at close()."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr._last_error = RuntimeError("simulated earlier async failure")
+    mgr.set_state_provider(
+        lambda: (9, {"w": np.ones(2, np.float32)}, {}))
+    assert mgr.preemption_save() == 9
+    assert mgr.load().step == 9
+    with pytest.raises(CheckpointError):
+        mgr.close()
+
+
+def test_shuffle_order_replays_after_rng_restore():
+    """RandomSampler derives epoch seeds from the global generator, so a
+    restored RNG state replays the same shuffle sequence (bitwise resume
+    covers batch order, not just dropout)."""
+    from paddle_tpu.core.generator import (get_rng_state, seed,
+                                           set_rng_state)
+    from paddle_tpu.io.sampler import RandomSampler
+    seed(123)
+    first = list(RandomSampler(list(range(32))))
+    snap = get_rng_state()
+    second = list(RandomSampler(list(range(32))))
+    assert first != second  # epochs still shuffle differently
+    set_rng_state(snap)
+    assert list(RandomSampler(list(range(32)))) == second
+
+
+def test_dataloader_shuffle_seeded_and_replayable():
+    """Shuffle seeds are drawn on the DataLoader's prefetch thread; the
+    global generator is process-wide, so paddle.seed() reaches it,
+    epochs still differ, and a restored RNG state replays the same epoch
+    order (resume covers loader-thread shuffle, not just dropout)."""
+    import paddle_tpu.io as pio
+    from paddle_tpu.core.generator import (get_rng_state, seed,
+                                           set_rng_state)
+
+    def epoch(dl):
+        return [int(v) for b in dl for v in np.asarray(b).ravel()]
+
+    ds = list(range(16))
+    seed(7)
+    dl = pio.DataLoader(ds, batch_size=4, shuffle=True)
+    e1, e2 = epoch(dl), epoch(dl)
+    assert sorted(e1) == list(range(16))
+    assert e1 != e2  # epochs reshuffle
+    snap = get_rng_state()
+    e3 = epoch(dl)
+    set_rng_state(snap)
+    assert epoch(dl) == e3  # restored RNG replays the loader-thread draw
+    seed(7)
+    assert epoch(dl) == e1  # seeding controls the prefetch-thread shuffle
+
+
+def test_multihost_pending_recovered_on_restart(tmp_path):
+    """world_size > 1 preemption saves can only STAGE (no cross-host
+    barrier inside a dying signal handler); the next rank-0 startup must
+    COMMIT a fully-staged pending checkpoint — and drop a partial one."""
+    root = str(tmp_path)
+    m0 = CheckpointManager(root, rank=0, world_size=2)
+    m1 = CheckpointManager(root, rank=1, world_size=2)
+    m0.save(3, {"w": np.full(4, 0.0, np.float32)}, sync=True)
+    m1.save(3, {"w": np.full(4, 1.0, np.float32)}, sync=True)
+    # process dies before commit(3) — stage dir survives
+    assert os.path.isdir(os.path.join(root, ".pending.step_3"))
+    assert CheckpointManager(root, rank=1, world_size=2
+                             ).latest_step() is None  # nothing published
+
+    r0 = CheckpointManager(root, rank=0, world_size=2)  # recovery runs
+    assert r0.latest_step() == 3
+    r1 = CheckpointManager(root, rank=1, world_size=2)
+    assert r0.load().state["w"][0] == 0.0  # each rank strictly own shard
+    assert r1.load().state["w"][0] == 1.0
+    for m in (m0, m1, r0, r1):
+        m.close()
+
+    # a stage missing rank 1's shard is dropped, not published
+    m0b = CheckpointManager(root, rank=0, world_size=2)
+    m0b.save(9, {"w": np.zeros(4, np.float32)}, sync=True)
+    m0b.close()
+    fresh = CheckpointManager(root, rank=0, world_size=2)
+    assert fresh.latest_step() == 3
+    assert not os.path.isdir(os.path.join(root, ".pending.step_9"))
+    fresh.close()
+
+
+def test_tmp_stage_sweep_respects_owner_liveness(tmp_path):
+    """A .tmp.* stage owned by a LIVE pid (a concurrent manager mid-save
+    on this root) must survive another manager's startup sweep — as must
+    a fresh dead-looking stage (the pid test is host-local; on a shared
+    mount it may be another host's live writer).  Only a dead owner's
+    stage idle past the grace window is removed."""
+    import subprocess
+    import sys
+    import time
+    root = str(tmp_path)
+    live = os.path.join(root, f".tmp.step_5.{os.getpid()}.deadbeef")
+    os.makedirs(live)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = os.path.join(root, f".tmp.step_6.{p.pid}.deadbeef")
+    os.makedirs(dead)
+    mgr = CheckpointManager(root)
+    assert os.path.isdir(live)  # in-progress stage left alone
+    assert os.path.isdir(dead)  # fresh: possibly a foreign live writer
+    mgr.close()
+    old = time.time() - 7200
+    os.utime(dead, (old, old))
+    mgr2 = CheckpointManager(root)
+    assert os.path.isdir(live)
+    assert not os.path.exists(dead)  # idle past grace: abandoned, swept
+    mgr2.close()
+
+
+def test_saver_stage_sweep_has_cross_host_grace(tmp_path):
+    """The saver's pid-liveness test is host-local: a dead-LOOKING stage
+    with fresh mtime may be another host's live writer on a shared mount
+    and must be kept; once idle past the grace window it is swept."""
+    import subprocess
+    import sys
+    import time
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver, SerializableBase)
+
+    class Obj(SerializableBase):
+        def serialize(self, path):
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "d.txt"), "w") as f:
+                f.write("x")
+
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    root = str(tmp_path)
+    os.makedirs(root, exist_ok=True)
+    stage = os.path.join(root,
+                         f".tmp.__paddle_checkpoint__.0.{p.pid}.abcd1234")
+    os.makedirs(stage)
+    saver = CheckpointSaver()
+    saver.save_checkpoint(root, [Obj()])
+    assert os.path.isdir(stage)  # fresh: possibly a foreign live writer
+    old = time.time() - 7200
+    os.utime(stage, (old, old))
+    saver.save_checkpoint(root, [Obj()])
+    assert not os.path.exists(stage)  # idle past grace: abandoned
+
+
+def test_atomic_write_leaves_target_intact_on_error(tmp_path):
+    p = str(tmp_path / "artifact.bin")
+    with atomic_write(p) as f:
+        f.write(b"good")
+    with pytest.raises(RuntimeError):
+        with atomic_write(p) as f:
+            f.write(b"partial garbage")
+            raise RuntimeError("crash mid-write")
+    with open(p, "rb") as f:
+        assert f.read() == b"good"
+    assert [n for n in os.listdir(str(tmp_path))
+            if n.startswith(".tmp.")] == []
+
+
+def test_hapi_fit_resume_continues_from_saved_epoch(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __init__(self, n=16):
+            rng = np.random.RandomState(0)
+            self.x = rng.rand(n, 4).astype(np.float32)
+            self.y = self.x.sum(1, keepdims=True).astype(np.float32)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def make_model():
+        _reset_unique_names()
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                            parameters=net.parameters()),
+            loss=paddle.nn.MSELoss())
+        return model
+
+    d = str(tmp_path / "run")
+    m1 = make_model()
+    h1 = m1.fit(DS(), batch_size=4, epochs=2, shuffle=False, verbose=0,
+                save_dir=d)
+    assert len(h1) == 2
+    assert os.path.isdir(os.path.join(d, "checkpoints", "step_1"))
+
+    # relaunch: runs only the remaining 2 epochs, ends bitwise-equal to
+    # a 4-epoch straight run
+    m2 = make_model()
+    h2 = m2.fit(DS(), batch_size=4, epochs=4, shuffle=False, verbose=0,
+                save_dir=d, resume=True)
+    assert len(h2) == 2
+
+    m3 = make_model()
+    m3.fit(DS(), batch_size=4, epochs=4, shuffle=False, verbose=0)
+    a = {k: np.asarray(v.numpy()) for k, v in
+         m2.network.state_dict().items()}
+    b = {k: np.asarray(v.numpy()) for k, v in
+         m3.network.state_dict().items()}
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+    with pytest.raises(ValueError):
+        make_model().fit(DS(), epochs=1, resume=True)  # needs save_dir
+
+    # a NON-resuming fit into the same save_dir must not inherit the old
+    # run's higher-numbered checkpoints: retention GC would delete the
+    # fresh run's commits the moment they land, and a later resume=True
+    # would restore the stale state
+    m5 = make_model()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        m5.fit(DS(), batch_size=4, epochs=1, shuffle=False, verbose=0,
+               save_dir=d, resume=False)
+    assert any("stale checkpoints" in str(w.message) for w in caught)
+    from paddle_tpu.checkpoint import CheckpointManager as _CM
+    fresh = _CM(os.path.join(d, "checkpoints"))
+    assert fresh.all_steps() == [0]  # only the new run's epoch-0 commit
+    fresh.close()
+
+
+def test_incubate_saver_atomic_commit_and_fallback(tmp_path):
+    from paddle_tpu.incubate.checkpoint.checkpoint_saver import (
+        CheckpointSaver, SerializableBase)
+
+    class Obj(SerializableBase):
+        def __init__(self, payload=""):
+            self.payload = payload
+
+        def serialize(self, path):
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "data.txt"), "w") as f:
+                f.write(self.payload)
+
+        def deserialize(self, path):
+            with open(os.path.join(path, "data.txt")) as f:
+                self.payload = f.read()
+
+    root = str(tmp_path / "saver")
+    saver = CheckpointSaver()
+    for i in range(3):
+        no = saver.save_checkpoint(root, [Obj(f"v{i}")], max_keep=5)
+        assert no == i
+    # no staging dirs left behind, meta present in each commit
+    assert all(n.startswith("__paddle_checkpoint__.")
+               for n in os.listdir(root))
+    # corrupt the newest checkpoint's payload in place
+    with open(os.path.join(root, "__paddle_checkpoint__.2", "obj_0",
+                           "data.txt"), "w") as f:
+        f.write("CORRUPTED")
+    obj = Obj()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        no = saver.load_checkpoint(root, [obj])
+    assert no == 1 and obj.payload == "v1"
+    assert any(isinstance(w.message, RuntimeWarning) for w in caught)
+
+
+def test_executor_hook_fires_through_compiled_program(tmp_path):
+    """Registering the raw Program but running it wrapped in
+    CompiledProgram (the multi-chip path) must still checkpoint — the
+    hook compares underlying Programs, not wrapper identity."""
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 8).astype(np.float32),
+              "y": rng.rand(8, 1).astype(np.float32)}  # 8 = dp mesh size
+             for _ in range(4)]
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=2,
+                                 scope=scope)
+        for f in feeds:
+            exe.run(cp, feed=f, fetch_list=[loss])
+    mgr.wait()
+    assert len(mgr.all_steps()) >= 2
+    mgr.close()
+
+
+def test_executor_hook_fires_through_parallel_executor(tmp_path):
+    """ParallelExecutor wraps a CompiledProgram which wraps the Program —
+    the hook must unwrap BOTH levels: with a registered Program it still
+    checkpoints, and with program=None the snapshot reaches the real
+    Program instead of crashing on the wrapper."""
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 8).astype(np.float32),
+              "y": rng.rand(8, 1).astype(np.float32)}  # 8 = dp mesh size
+             for _ in range(4)]
+    with static.scope_guard(scope):
+        exe.run(startup)
+        pe = static.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                     main_program=main)
+        mgr = CheckpointManager(str(tmp_path / "registered"),
+                                keep_last_n=10)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=2,
+                                 scope=scope)
+        for f in feeds:
+            exe.run(pe, feed=f, fetch_list=[loss])
+        mgr.wait()
+        assert len(mgr.all_steps()) >= 2
+        mgr.close()
+
+        mgr2 = CheckpointManager(str(tmp_path / "default"), keep_last_n=10)
+        exe.enable_checkpointing(mgr2, every_n_steps=2, scope=scope)
+        for f in feeds:
+            exe.run(pe, feed=f, fetch_list=[loss])
+        mgr2.wait()
+        assert len(mgr2.all_steps()) >= 2
+        mgr2.close()
+
+
+def test_default_program_latches_on_training_program(tmp_path):
+    """enable_checkpointing(program=None) must bind to the first TRAINING
+    program (grad/optimizer ops) run afterwards — startup and eval
+    programs run through the same executor, before OR after, must
+    neither latch (which would silently disable checkpointing of the
+    real train loop) nor commit a checkpoint missing the optimizer
+    accumulators."""
+    main, startup, loss = _build()
+    eval_p, eval_start = static.Program(), static.Program()
+    with static.program_guard(eval_p, eval_start):
+        x = layers.data("x", [-1, 8])
+        layers.fc(x, 1)
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+    feeds = _feeds(6)
+    with static.scope_guard(scope):
+        # enable FIRST: the startup and eval runs below must not latch
+        exe.enable_checkpointing(mgr, every_n_steps=2, scope=scope)
+        exe.run(startup)
+        exe.run(eval_start)
+        exe.run(eval_p, feed={"x": feeds[0]["x"]})
+        n_train_tensors = None
+        for f in feeds:
+            exe.run(eval_p, feed={"x": f["x"]})  # must NOT checkpoint
+            exe.run(main, feed=f, fetch_list=[loss])
+    mgr.wait()
+    steps = mgr.all_steps()
+    assert len(steps) >= 2
+    for s in steps:
+        state = mgr.load(step=s).state
+        if n_train_tensors is None:
+            n_train_tensors = len(state)
+        # every checkpoint carries the train program's full persistable
+        # set (params + Adam moments + LR), never the eval program's two
+        assert len(state) == n_train_tensors and len(state) > 4, (
+            s, sorted(state))
+    mgr.close()
+
+
+def test_preemption_provider_uses_run_scope(tmp_path):
+    """enable_checkpointing without scope= while every run passes an
+    explicit scope: the preemption save must snapshot the scope training
+    runs in, not the (empty) global scope — an empty final checkpoint
+    would become the newest step and resume would restore nothing."""
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+    exe.enable_checkpointing(mgr, program=main, every_n_steps=10**6)
+    exe.run(startup, scope=scope)
+    for f in _feeds(2):
+        exe.run(main, feed=f, fetch_list=[loss], scope=scope)
+    saved = mgr.preemption_save()
+    assert saved == exe._step
+    state = mgr.load().state
+    assert len(state) > 4, sorted(state)  # params + Adam moments + LR
+    mgr.close()
+
+
+def test_disable_checkpointing_detaches_preemption_provider(tmp_path):
+    """After disable_checkpointing() a preemption must not commit a
+    snapshot of whatever default_main_program() happens to be."""
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path))
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=10**6)
+        exe.run(main, feed=_feeds(1)[0], fetch_list=[loss])
+        exe.disable_checkpointing()
+    assert mgr.preemption_save() is None
+    assert mgr.all_steps() == []
+    mgr.close()
+
+
+def test_restore_warns_on_program_fingerprint_mismatch(tmp_path):
+    """Restoring into a program that differs from the one the checkpoint
+    was saved from must warn: absent vars keep fresh-init values — a
+    chimera state the user should know about."""
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path))
+    with static.scope_guard(scope):
+        exe.run(startup)
+        s, state, extra = exe.checkpoint_snapshot(main, scope)
+        mgr.save(s, state, extra=extra, sync=True)
+
+    _reset_unique_names()
+    other, other_start = static.Program(), static.Program()
+    with static.program_guard(other, other_start):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        pred = layers.fc(x, 1)  # different topology
+        loss2 = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss2)
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    with static.scope_guard(scope2):
+        exe2.run(other_start)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            exe2.restore_from_checkpoint(mgr, program=other, scope=scope2)
+    assert any("fingerprint mismatch" in str(w.message) for w in caught)
+    mgr.close()
+
+
+def test_async_snapshot_copies_mutable_host_arrays(tmp_path):
+    """A numpy array handed to save() must be snapshotted by value: an
+    in-place mutation racing the background writer may not tear the
+    persisted checkpoint."""
+    w = np.zeros((64, 64), np.float32)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(1, {"w": w})  # async
+    w += 1.0  # next "train step" mutates in place immediately
+    mgr.wait()
+    ckpt = mgr.load()
+    np.testing.assert_array_equal(ckpt.state["w"],
+                                  np.zeros((64, 64), np.float32))
+    mgr.close()
+
+
+def test_executor_hook_saves_on_step_boundaries(tmp_path):
+    main, startup, loss = _build()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(str(tmp_path), keep_last_n=10)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=2,
+                                 scope=scope)
+        for f in _feeds(5):
+            exe.run(main, feed=f, fetch_list=[loss])
+    mgr.wait()
+    assert len(mgr.all_steps()) == 2  # steps 2 and 4 after warm start
+    # provider registered for preemption: the final sync save captures
+    # the CURRENT (post-step-5) state
+    saved = mgr.preemption_save()
+    assert saved == exe._step
+
+    # enable-then-restore ordering re-anchors the last-saved marker, so
+    # the next run doesn't immediately re-save the state just loaded
+    main2, startup2, _ = _build()
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        exe2.enable_checkpointing(mgr, program=main2, every_n_steps=2,
+                                  scope=scope2)
+        restored = exe2.restore_from_checkpoint(mgr, main2, scope2)
+        assert restored == saved
+        assert exe2._ckpt.last == exe2._step
+    mgr.close()

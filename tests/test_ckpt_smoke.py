@@ -1,0 +1,39 @@
+"""Tier-1 checkpoint-robustness gate (NOT marked slow — a regression in
+atomic commit / CRC refusal / resume must fail the suite, not wait for a
+fault in production).
+
+Drives tools/ckpt_smoke.py: periodic async checkpoints, truncate the
+newest shard, bit-flip the next, assert latest_step() skips the
+truncated one and resume lands on the last valid step with a warning.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_ckpt_smoke_gate(tmp_path):
+    import ckpt_smoke
+    result = ckpt_smoke.run_smoke(steps=6, root=str(tmp_path / "ckpts"))
+    assert result["value"] == result["saved_steps"][-3], result
+    assert result["load_fallbacks"] >= 1, result
+    assert result["wall_s"] < 30, result
+
+
+@pytest.mark.slow  # duplicates the in-process gate via a subprocess
+def test_ckpt_smoke_cli_prints_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ckpt_smoke.py"),
+         "--steps", "5"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["truncated_step"] == result["saved_steps"][-1]
+    assert result["value"] == result["saved_steps"][-3]

@@ -133,6 +133,48 @@ def test_op_version_registry_rules():
         reg.register("myop", 3)
 
 
+def test_tensor_codec_bf16_roundtrip():
+    # TPU checkpoints are predominantly bf16, which numpy cannot express
+    # natively: the codec stores a uint16 bit view + dtype tag and must
+    # round-trip BIT-exactly (core/serialization.py encode/decode_tensor)
+    import ml_dtypes
+    from paddle_tpu.core.serialization import (
+        decode_tensor, encode_tensor, tensor_from_bytes, tensor_to_bytes)
+    rng = np.random.RandomState(3)
+    a = rng.randn(5, 7).astype(ml_dtypes.bfloat16)
+    view, tag = encode_tensor(a)
+    assert tag == "bfloat16" and view.dtype == np.uint16
+    back = decode_tensor(view, tag)
+    assert back.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(back.view(np.uint16), a.view(np.uint16))
+    # bytes container round-trip, native dtypes included
+    for arr in (a, rng.randn(3).astype(np.float32),
+                rng.randint(0, 9, (2, 2)).astype(np.int32),
+                np.float32(2.5).reshape(())):
+        out = tensor_from_bytes(tensor_to_bytes(arr))
+        assert out.dtype == arr.dtype and out.shape == np.shape(arr)
+        # must own its memory (not alias the input bytes): a read-only
+        # frombuffer view would be zero-copy aliased by jnp.asarray and
+        # freed by donate_argnums out from under the caller
+        assert out.flags.writeable
+        np.testing.assert_array_equal(
+            out.view(np.uint16) if out.dtype == ml_dtypes.bfloat16
+            else out,
+            arr.view(np.uint16) if out.dtype == ml_dtypes.bfloat16
+            else arr)
+
+
+def test_tensor_codec_rejects_truncation():
+    import pytest
+    from paddle_tpu.core.serialization import (
+        tensor_from_bytes, tensor_to_bytes)
+    blob = tensor_to_bytes(np.arange(64, dtype=np.float32))
+    with pytest.raises(ValueError):
+        tensor_from_bytes(blob[:-8])
+    with pytest.raises(ValueError):
+        tensor_from_bytes(b"XXXX" + blob[4:])
+
+
 def test_accumulator_link_survives_binary_roundtrip():
     # accum_of (optimizer accumulator -> param) feeds sharding inheritance
     # in CompiledProgram; it must survive serialization or the name-prefix

@@ -1,0 +1,154 @@
+"""Checkpoint gate: corrupt checkpoints must never load; resume must work.
+
+The fault-tolerance analog of tools/perf_smoke.py (tests/test_ckpt_smoke.py
+runs it as a tier-1 test, <30 s on CPU): trains a tiny static model with
+periodic async checkpointing, then attacks the checkpoint directory the
+two ways a preemption/bad disk does and asserts the recovery contract:
+
+  * TRUNCATION — the newest step's shard is cut short (the artifact a
+    mid-write kill leaves if atomicity is violated out-of-band):
+    ``latest_step()`` must skip it;
+  * BIT-FLIP — the next step's shard is corrupted in place without
+    changing its size: ``load()`` must refuse it on CRC and fall back,
+    with a RuntimeWarning;
+  * RESUME — a fresh Executor restores from the surviving step and
+    training continues.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/ckpt_smoke.py [--steps 6]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_tiny():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.core.program import _reset_unique_names
+    # deterministic names across "restarts" in one process
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _clip(path: str, keep_bytes: int):
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+
+
+def _flip(path: str, offset: int = 7):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def run_smoke(steps: int = 6, root: str = None):
+    """Run the gate; returns the result dict (AssertionError on a
+    robustness regression)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    t_start = time.time()
+    root = root or tempfile.mkdtemp(prefix="ckpt_smoke_")
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(4, 8).astype(np.float32),
+              "y": rng.rand(4, 1).astype(np.float32)}
+             for _ in range(steps)]
+
+    main, startup, loss = build_tiny()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(root, keep_last_n=steps + 1)
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=1,
+                                 scope=scope)
+        for f in feeds:
+            exe.run(main, feed=f, fetch_list=[loss])
+    mgr.wait()
+    saved = mgr.all_steps()
+    assert len(saved) >= 3, (
+        f"ckpt smoke FAILED: expected >=3 checkpoints, got {saved}")
+    newest, second, survivor = saved[-1], saved[-2], saved[-3]
+
+    # attack 1: truncate the newest shard → latest_step() must skip it
+    shard = os.path.join(mgr.step_dir(newest), "shard_00000.bin")
+    _clip(shard, os.path.getsize(shard) // 2)
+    got = mgr.latest_step()
+    assert got == second, (
+        f"ckpt smoke FAILED: latest_step()={got} did not skip the "
+        f"truncated step {newest}")
+
+    # attack 2: bit-flip the second-newest shard → CRC refusal + fallback
+    _flip(os.path.join(mgr.step_dir(second), "shard_00000.bin"))
+    mgr.close()
+
+    # "restart": fresh manager + executor + scope, auto-resume
+    mgr2 = CheckpointManager(root)
+    main2, startup2, loss2 = build_tiny()
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            resumed = exe2.restore_from_checkpoint(mgr2, program=main2,
+                                                   scope=scope2)
+        fallback_warned = any(isinstance(w.message, RuntimeWarning)
+                              for w in caught)
+        assert resumed == survivor, (
+            f"ckpt smoke FAILED: resumed from {resumed}, expected the "
+            f"last valid step {survivor} (truncated {newest}, "
+            f"bit-flipped {second})")
+        assert fallback_warned, (
+            "ckpt smoke FAILED: corrupt-checkpoint fallback produced no "
+            "RuntimeWarning")
+        # training continues from the restored state
+        (val,) = exe2.run(main2, feed=feeds[0], fetch_list=[loss2])
+        assert np.isfinite(np.asarray(val)).all()
+    mgr2.close()
+
+    from paddle_tpu.core.monitor import stat_get
+    result = {
+        "metric": "ckpt_smoke_resume_step",
+        "value": resumed,
+        "saved_steps": saved,
+        "truncated_step": newest,
+        "bitflipped_step": second,
+        "load_fallbacks": stat_get("checkpoint.load_fallbacks"),
+        "wall_s": round(time.time() - t_start, 2),
+    }
+    return result
+
+
+def main():
+    steps = 6
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    print(json.dumps(run_smoke(steps=steps)))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ MODULES = [
     "paddle_tpu.distributed.tensor_parallel",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.checkpoint",
     "paddle_tpu.slim",
     "paddle_tpu.incubate",
 ]
