@@ -17,7 +17,7 @@ import numpy as np
 
 
 def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
-                    batch=8, use_amp=True):
+                    batch=8, use_amp=True, use_ring=False):
     import paddle_tpu.static as static
     from paddle_tpu.static import layers, nets
     from paddle_tpu import amp
@@ -32,11 +32,14 @@ def build_bert_base(vocab=30522, seq=512, hidden=768, layers_n=12, heads=12,
         h = layers.elementwise_add(emb, pemb)
         h = layers.layer_norm(h, begin_norm_axis=2)
         for _ in range(layers_n):
-            # self-attention
+            # self-attention (use_ring: the ring_attention op — sequence
+            # shards over an "sp" mesh axis under CompiledProgram, plain
+            # attention on one device; the long-seq path's kernel)
             q = layers.fc(h, hidden, num_flatten_dims=2)
             k = layers.fc(h, hidden, num_flatten_dims=2)
             v = layers.fc(h, hidden, num_flatten_dims=2)
-            ctx = nets.scaled_dot_product_attention(q, k, v, num_heads=heads)
+            ctx = nets.scaled_dot_product_attention(
+                q, k, v, num_heads=heads, sequence_parallel=use_ring)
             attn_out = layers.fc(ctx, hidden, num_flatten_dims=2)
             h = layers.layer_norm(layers.elementwise_add(h, attn_out),
                                   begin_norm_axis=2)
@@ -292,6 +295,144 @@ def serving_main():
     print(json.dumps(result))
 
 
+def _argv_value(flag):
+    """Optional value following `flag` in argv (None when the flag is
+    absent, "" when it is last or followed by another --option)."""
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--"):
+        return sys.argv[i + 1]
+    return ""
+
+
+def _bench_knobs():
+    """Shared --remat / --grad-merge / --ring knob parsing (argv wins
+    over env).  Returns (remat_mode, grad_merge_k, use_ring) where
+    remat_mode is "" / "always" / "auto".  Both `--remat` and
+    `--remat auto` work, matching the BENCH_REMAT=1|auto spellings."""
+    remat = _argv_value("--remat")
+    if remat is None:
+        remat = os.environ.get("BENCH_REMAT", "")
+    elif remat == "":
+        remat = os.environ.get("BENCH_REMAT", "") or "1"
+    if remat in ("0", "false"):
+        remat = ""
+    remat_mode = "" if not remat else ("auto" if remat == "auto"
+                                       else "always")
+    gm_raw = _argv_value("--grad-merge")
+    if gm_raw is None or gm_raw == "":
+        if gm_raw == "":
+            raise SystemExit("bench: --grad-merge needs a step count "
+                             "(e.g. --grad-merge 2)")
+        gm_raw = os.environ.get("BENCH_GRAD_MERGE", "0")
+    gm = int(gm_raw or 0)
+    ring = os.environ.get("BENCH_RING", "") not in ("", "0", "false") \
+        or "--ring" in sys.argv
+    return remat_mode, gm, ring
+
+
+def seq_ladder_main():
+    """Sequence-length ladder (`python bench.py --seq-ladder` or
+    BENCH_MODE=seq_ladder): builds the bench model at each rung —
+    optionally with remat (BENCH_REMAT=1/auto) and/or ring attention
+    (BENCH_RING=1) — and emits the HBM estimator's PREDICTED peak
+    alongside measured tokens/s, one JSON line with the whole ladder.
+    On chip, rungs the estimator predicts to OOM are SKIPPED instead of
+    burning tunnel minutes on an allocator error; on CPU the rungs
+    shrink so the mode runs end-to-end in CI.  Token budget per rung is
+    constant (BENCH_LADDER_TOKENS) so batch = tokens/seq, matching the
+    r5 ladder protocol (perf_r05/ladder.log)."""
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        ok, reason = _probe_tpu()
+        if not ok:
+            sys.stderr.write(f"bench: seq-ladder on CPU ({reason})\n")
+            jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.program import _reset_unique_names
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    default_ladder = "512,1024,2048,4096" if on_tpu else "64,128"
+    seqs = [int(s) for s in os.environ.get(
+        "BENCH_SEQ_LADDER", default_ladder).split(",") if s]
+    tokens = int(os.environ.get("BENCH_LADDER_TOKENS",
+                                32768 if on_tpu else 512))
+    layers_n = int(os.environ.get("BENCH_LAYERS", 12 if on_tpu else 2))
+    hidden = int(os.environ.get("BENCH_HIDDEN", 768 if on_tpu else 128))
+    heads = int(os.environ.get("BENCH_HEADS", 12 if on_tpu else 4))
+    vocab = int(os.environ.get("BENCH_VOCAB", 30522 if on_tpu else 1024))
+    steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 5))
+    use_amp = os.environ.get("BENCH_NO_AMP", "") in ("", "0", "false")
+    remat_mode, _, use_ring = _bench_knobs()
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for seq in seqs:
+        batch = max(1, tokens // seq)
+        _reset_unique_names()
+        if remat_mode:
+            set_flags({"recompute": remat_mode, "hbm_assume_batch": batch})
+        try:
+            main_p, startup_p, loss = build_bert_base(
+                vocab, seq, hidden, layers_n, heads, batch,
+                use_amp=use_amp, use_ring=use_ring)
+        finally:
+            set_flags({"recompute": "", "hbm_assume_batch": 0})
+        mem = static.analyze_program(main_p, batch=batch)
+        row = {"seq": seq, "batch": batch,
+               "predicted_peak_bytes": mem["peak_bytes"],
+               "predicted_peak_gib": round(mem["peak_bytes"] / 2 ** 30, 2),
+               "predicted_fits": mem["fits"],
+               "remat": remat_mode or "off", "ring": use_ring}
+        if on_tpu and not mem["fits"]:
+            # the whole point of compile-time accounting: a predicted
+            # OOM costs zero tunnel seconds
+            row["skipped"] = "predicted OOM at " + \
+                f"{mem['budget_bytes'] / 2 ** 30:.2f} GiB budget"
+            rows.append(row)
+            continue
+        idt = np.int64 if jax.config.jax_enable_x64 else np.int32
+        feed = {
+            "ids": rng.randint(0, vocab, (batch, seq)).astype(idt),
+            "pos": np.tile(np.arange(seq), (batch, 1)).astype(idt),
+            "labels": rng.randint(0, vocab, (batch, seq, 1)).astype(idt),
+        }
+        exe, scope = static.Executor(), static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup_p)
+            exe.run(main_p, feed=feed, fetch_list=[loss])   # warm/compile
+            exe.run(main_p, feed=feed, fetch_list=[])
+            t0 = time.time()
+            for _ in range(steps - 1):
+                exe.run(main_p, feed=feed, fetch_list=[])
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+            np.asarray(out[0])
+            dt = time.time() - t0
+        exe.close()
+        row["tokens_per_sec"] = round(steps * batch * seq / dt, 2)
+        rows.append(row)
+    measured = [r for r in rows if "tokens_per_sec" in r]
+    result = {
+        "metric": "seq_ladder_tokens_per_sec",
+        "value": measured[-1]["tokens_per_sec"] if measured else 0.0,
+        "unit": "tokens/s",
+        "on_tpu": on_tpu,
+        "remat": remat_mode or "off",
+        "ring": use_ring,
+        "hbm_budget_bytes": static.hbm_budget_bytes(),
+        "ladder": rows,
+    }
+    if not on_tpu:
+        result["failed"] = True
+        result["note"] = "CPU run; predicted peaks are the deliverable"
+    print(json.dumps(result))
+
+
 def _probe_tpu():
     """Device discovery over the axon tunnel can hang inside a C call, so
     probe in SUBPROCESSES with hard timeouts.  A CPU fallback is a FAILED
@@ -330,6 +471,10 @@ def main():
     if "--checkpoint" in sys.argv or \
             os.environ.get("BENCH_MODE") == "checkpoint":
         checkpoint_main()
+        return
+    if "--seq-ladder" in sys.argv or \
+            os.environ.get("BENCH_MODE") == "seq_ladder":
+        seq_ladder_main()
         return
     # allow CPU fallback benchmarking only when explicitly requested or
     # after the full retry budget is exhausted
@@ -392,8 +537,35 @@ def main():
         from paddle_tpu.ops.fused_xent import enable_fused_xent
         enable_fused_xent(True)
 
+    # BENCH_REMAT=1/auto (--remat): activation checkpointing at
+    # transformer-layer boundaries (static/recompute_rewrite.py) — the
+    # memory-for-throughput knob the b96/b128 A/B decides.  "auto"
+    # rewrites only when the HBM estimator predicts this batch exceeds
+    # PADDLE_TPU_HBM_BYTES.  BENCH_GRAD_MERGE=K (--grad-merge K):
+    # k-step gradient accumulation (static.gradient_merge), the OTHER
+    # way to trade per-step memory for effective batch.  BENCH_RING=1
+    # (--ring): ring-attention op in every layer.  NOTE on one chip
+    # (this bench's Executor path) the op degrades to plain attention —
+    # the A/B measures the op's dispatch overhead and composes with
+    # remat; the estimator charges the degraded kernel's full S² scores
+    # (memory_analysis._op_internal_bytes), and the true sp-sharded
+    # numbers need CompiledProgram over a multi-chip mesh.
+    remat_mode, grad_merge_k, use_ring = _bench_knobs()
+    if remat_mode:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"recompute": remat_mode, "hbm_assume_batch": batch})
+
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
-                                              heads, batch, use_amp=use_amp)
+                                              heads, batch, use_amp=use_amp,
+                                              use_ring=use_ring)
+    if remat_mode:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"recompute": "", "hbm_assume_batch": 0})
+    if grad_merge_k > 1:
+        static.gradient_merge(main_p, grad_merge_k, startup_p)
+    # compile-time HBM verdict rides every bench record: the number that
+    # decides fits-or-OOMs before a tunnel window is ever spent
+    _mem = static.analyze_program(main_p, batch=batch)
     exe = static.Executor()
     scope = static.Scope()
     rng = np.random.RandomState(0)
@@ -533,6 +705,10 @@ def main():
         # steady-state vs compile split: `value` is measured AFTER warmup;
         # a cold persistent cache shows up here, not in the headline
         "compile_time_s": round(compile_time_s, 2),
+        # compile-time HBM accounting (static/memory_analysis.py)
+        "predicted_peak_bytes": _mem["peak_bytes"],
+        "predicted_fits": _mem["fits"],
+        "hbm_budget_bytes": _mem["budget_bytes"],
         "cache": {
             "persistent_dir": stats["persistent_dir"],
             "warm_start": bool(warm_entries),
@@ -540,6 +716,12 @@ def main():
             "hits": stats["hits"],
         },
     }
+    if remat_mode or grad_merge_k > 1 or use_ring:
+        # self-describing A/B records: the queue runner's JSON says what
+        # memory knobs produced the number
+        result["memory_knobs"] = {"remat": remat_mode or "off",
+                                  "grad_merge_k": grad_merge_k,
+                                  "ring": use_ring}
     if on_tpu:
         result["mfu"] = round(mfu, 4)
     else:

@@ -126,6 +126,9 @@ class OptimizerWithMixedPrecision:
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         ops = self.apply_gradients(params_grads)
+        # recorded like Optimizer.minimize does: the PS transpiler and
+        # static.gradient_merge read the pairing off the program
+        loss.block.program._ps_params_grads = params_grads
         return ops, params_grads
 
     def __getattr__(self, item):

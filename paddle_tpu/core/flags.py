@@ -107,6 +107,16 @@ define_flag("feed_bucketing", "existing",
             "pads ragged batches up to an already-compiled larger batch, "
             "'pow2' also cold-compiles at power-of-two buckets "
             "(inference), 'off' disables")
+define_flag("recompute", "",
+            "activation checkpointing in append_backward: '' = off, "
+            "'auto' = select transformer-layer checkpoints and rewrite "
+            "only when the HBM estimator predicts PADDLE_TPU_HBM_BYTES "
+            "is exceeded, 'always' = rewrite unconditionally; explicit "
+            "checkpoints= lists always win (static/memory_analysis.py)")
+define_flag("hbm_assume_batch", 0,
+            "batch size the HBM estimator binds symbolic -1 dims to "
+            "(memory_analysis; 0 binds 1, making batch-dynamic "
+            "estimates a lower bound)")
 define_flag("sort_sum_gradient", False,
             "deterministic gradient accumulation order (flags.cc:521)")
 define_flag("check_unused_vars", False,

@@ -19,7 +19,8 @@ __all__ = ["build_transformer_lm"]
 
 
 def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
-                         tensor_parallel_degree=1):
+                         tensor_parallel_degree=1,
+                         sequence_parallel=False):
     """Returns (main_program, startup_program, loss, logits); feeds are
     int64 `ids` [batch, seq_len], `pos` [batch, seq_len] (position ids,
     typically np.tile(np.arange(seq_len), (batch, 1))), and `labels`
@@ -27,7 +28,17 @@ def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
 
     Attention is BIDIRECTIONAL (BERT/ERNIE-style MLM rehearsal — the
     bench's north-star config): feed masked-token labels, not shifted
-    next-token labels.  For causal decoding use models.GPTModel."""
+    next-token labels.  For causal decoding use models.GPTModel.
+
+    ``sequence_parallel=True`` routes every layer's attention through
+    the `ring_attention` op: run the program via
+    ``CompiledProgram(BuildStrategy.sequence_parallel_degree=n)`` and
+    the sequence dim shards over the "sp" mesh axis with K/V rotating
+    around the ring (the long-context path — no S² scores tensor).  On
+    a single device the op degrades to plain attention, so the same
+    program also runs for CPU debugging.  Composes with
+    FLAGS_recompute auto-remat (checkpoints select at layer boundaries
+    around the ring op like any attention core)."""
     import paddle_tpu.static as static
     from ..distributed.tensor_parallel import (parallel_attention,
                                                col_parallel_fc,
@@ -35,6 +46,10 @@ def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
     import paddle_tpu.static.nets as nets
 
     tp = max(1, int(tensor_parallel_degree))
+    if sequence_parallel and tp > 1:
+        raise ValueError("sequence_parallel and tensor_parallel_degree>1 "
+                         "cannot combine in one program (mesh has one "
+                         "model axis; see CompiledProgram._get_mesh)")
     main, startup = static.Program(), static.Program()
     with static.program_guard(main, startup):
         ids = layers.data("ids", [-1, seq_len], dtype="int64")
@@ -52,7 +67,8 @@ def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
                 k = layers.fc(a_in, hidden, num_flatten_dims=2)
                 v = layers.fc(a_in, hidden, num_flatten_dims=2)
                 ctx = nets.scaled_dot_product_attention(
-                    q, k, v, num_heads=num_heads)
+                    q, k, v, num_heads=num_heads,
+                    sequence_parallel=sequence_parallel)
                 attn = layers.fc(ctx, hidden, num_flatten_dims=2)
             h = layers.elementwise_add(h, attn)
             m_in = layers.layer_norm(h, begin_norm_axis=2)

@@ -15,6 +15,12 @@ from .executor import (  # noqa: F401
     Executor, Scope, global_scope, scope_guard, BlockTracer,
 )
 from .backward import append_backward, gradients  # noqa: F401
+from .memory_analysis import (  # noqa: F401
+    estimate_peak_bytes, analyze_program, hbm_budget_bytes,
+    select_layer_checkpoints,
+)
+from .optimizer import gradient_merge  # noqa: F401
+from . import memory_analysis  # noqa: F401
 from .initializer import (  # noqa: F401
     Constant, Uniform, Normal, TruncatedNormal, Xavier, MSRA,
     NumpyArrayInitializer, set_global_initializer,

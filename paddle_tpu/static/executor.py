@@ -567,40 +567,83 @@ class Executor:
             out[n] = jnp.concatenate([v, pad], axis=0)
         return out
 
-    @staticmethod
-    def _unpad_fetches(fetches, orig_batch, padded_batch, block=None,
+    @classmethod
+    def _unpad_fetches(cls, fetches, orig_batch, padded_batch, block=None,
                        fetch_names=()):
         """Mask-aware fetch un-padding: slice per-row fetches back to the
         real batch.  A fetch whose runtime leading dim equals the padded
         bucket is sliced unless the program says its dim 0 is NOT the
-        batch: persistable vars (weights) never slice; a declared STATIC
-        dim 0 exactly equal to the bucket marks a coincidence (a [64, k]
-        temp while serving the 64-bucket) and passes through.  Declared
-        dynamic (-1/None) dims, stale concrete dims (traced programs
-        record the example batch), and undeclared temps all slice."""
-
-        def batch_dim_dynamic(name):
-            if block is None:
-                return True
-            try:
-                var = block.var(name)
-            except KeyError:
-                return True  # temp var without a declared shape
-            if getattr(var, "persistable", False):
-                return False
-            shape = getattr(var, "shape", None)
-            if not shape or shape[0] in (-1, None):
-                return True
-            return shape[0] != padded_batch
-
+        batch (`_fetch_batch_dim_dynamic`): persistable vars (weights)
+        never slice; a declared STATIC dim 0 exactly equal to the bucket
+        marks a coincidence (a [64, k] temp while serving the 64-bucket)
+        and passes through.  Declared dynamic (-1/None) dims, stale
+        concrete dims (traced programs record the example batch), and
+        undeclared temps all slice."""
         names = list(fetch_names) + [None] * (len(fetches) -
                                               len(fetch_names))
         return tuple(
             f[:orig_batch]
             if getattr(f, "ndim", 0) >= 1 and f.shape[0] == padded_batch
-            and batch_dim_dynamic(n)
+            and cls._fetch_batch_dim_dynamic(block, n, padded_batch)
             else f
             for f, n in zip(fetches, names))
+
+    def memory_report(self, program=None, feed=None, scope=None,
+                      batch=None):
+        """Compile-time HBM accounting for one training step of
+        `program` (static/memory_analysis.py): the op-IR liveness
+        estimate always; XLA ground truth via
+        ``jit(step).lower(...).compile().memory_analysis()`` when `feed`
+        is given and the installed backend supports it.
+
+        Returns ``{"estimate": <analyze_program dict>, "peak_bytes",
+        "budget_bytes", "fits", "xla": {...} | None}``.  `batch` binds
+        symbolic -1 dims for the estimate; when omitted it is inferred
+        from the feed's leading dim.  The estimate needs NO device —
+        fits-or-OOMs for a TPU config is answered on any host."""
+        from ..core.program import default_main_program
+        from .memory_analysis import analyze_program
+        program = _unwrap_program(program or default_main_program())
+        if batch is None and feed:
+            for v in feed.values():
+                shape = getattr(v, "shape", None) or np.shape(v)
+                if len(shape):
+                    batch = int(shape[0])
+                    break
+        est = analyze_program(program, batch=batch)
+        report = {"estimate": est, "peak_bytes": est["peak_bytes"],
+                  "budget_bytes": est["budget_bytes"],
+                  "fits": est["fits"], "xla": None}
+        if feed:
+            scope = scope or global_scope()
+            block = program.global_block()
+            feed_vals = {n: self._coerce_feed(block, n, v)
+                         for n, v in feed.items()}
+            state_names = [n for n in _persistable_names(program)
+                           if scope.get(n) is not None]
+            state = {n: scope.get(n) for n in state_names}
+            try:
+                step = self._make_step(program, state_names, [])
+                lowered = jax.jit(step, donate_argnums=(0,)).lower(
+                    state, feed_vals, jnp.uint32(0))
+                ma = lowered.compile().memory_analysis()
+                xla = {}
+                for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                          "output_size_in_bytes", "alias_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                    v = getattr(ma, k, None)
+                    if v is not None:
+                        xla[k] = int(v)
+                if xla:
+                    xla["peak_bytes"] = (
+                        xla.get("argument_size_in_bytes", 0)
+                        + xla.get("temp_size_in_bytes", 0)
+                        + xla.get("output_size_in_bytes", 0)
+                        - xla.get("alias_size_in_bytes", 0))
+                    report["xla"] = xla
+            except Exception as e:  # backend without memory_analysis()
+                report["xla_error"] = repr(e)
+        return report
 
     def cache_stats(self) -> Dict[str, int]:
         """Hot-path cache accounting for THIS executor: ``hits`` /
@@ -660,6 +703,14 @@ class Executor:
         paddle/fluid/framework/trainer.h:1): instead of a host loop calling
         the device once per batch, the loop itself is compiled onto the
         device.
+
+        Stacked feeds ride the same FLAGS_feed_bucketing policy as
+        run(): a ragged PER-STEP batch pads up to an already-compiled
+        stacked bucket (axis 1; fetches are sliced back), and a short
+        final chunk (K' < the compiled steady K) is served step-by-step
+        through run() instead of retracing the whole scan — the steps
+        axis is never padded, because scanned padding steps would replay
+        extra optimizer updates.
         """
         from ..core.program import default_main_program
         program = program or default_main_program()
@@ -690,13 +741,30 @@ class Executor:
                self._feed_signature(feed_vals), tuple(fetch_names),
                tuple(state_names))
         fn = self._cache.get(key)
+        bucket = None  # (real per-step batch, padded per-step batch)
+        if fn is None:
+            bucketed = self._bucket_lookup_steps(key, feed_vals)
+            if bucketed is not None:
+                key, feed_vals, bucket = bucketed
+                fn = self._cache.get(key)
+        if fn is None and self.bucket_policy != "off" and \
+                self._has_longer_scan(key, k):
+            # short FINAL chunk (K' < a compiled steady K): padding the
+            # steps axis would replay extra optimizer updates, so the
+            # chunk runs step-by-step through run() — which buckets the
+            # batch dim itself — instead of retracing the whole scan.
+            # State threading and per-step seeds are identical to the
+            # scanned path (same _seed_for_step walk over self._step).
+            return self._run_steps_fallback(program, feed_vals, k,
+                                            fetch_list, scope,
+                                            return_numpy)
         if fn is None:
             self._record("miss")
             self._record("trace")
             fn = self._compile_steps(program, state_names, fetch_names)
             self._cache[key] = fn
         else:
-            self._record("hit")
+            self._record("hit", bucketed=bucket is not None)
 
         # same side contracts as run(): elastic auto-checkpoint hook,
         # run counters, profiler span, FLAGS_check_nan_inf post-scan
@@ -715,6 +783,10 @@ class Executor:
             fetches, new_state = fn(state, feed_vals, seeds)
         for n, v in new_state.items():
             scope.set(n, v)
+        if bucket is not None:
+            fetches = self._unpad_steps_fetches(fetches, *bucket,
+                                                block=block,
+                                                fetch_names=fetch_names)
         results = [np.asarray(f) for f in fetches] if return_numpy \
             else list(fetches)
         if flag("check_nan_inf", False):
@@ -735,6 +807,138 @@ class Executor:
             return fetches, new_state
 
         return jax.jit(multi, donate_argnums=(0,))
+
+    # -- run_steps shape bucketing ------------------------------------------
+    def _bucket_lookup_steps(self, miss_key, feed_vals):
+        """run_steps analog of _bucket_lookup: on a scan-cache miss, pad
+        the PER-STEP batch dim (axis 1 of every stacked feed) up to the
+        smallest already-compiled stacked bucket with the SAME step
+        count K.  The steps axis is never padded — extra scanned steps
+        would replay extra optimizer updates.  Same duplicated-row
+        caveats as run()'s bucketing (docs/perf.md)."""
+        policy = self.bucket_policy
+        if policy not in ("existing", "pow2") or not feed_vals:
+            return None
+        memo = self._bucket_map.get(miss_key)
+        if memo is not None:
+            bucket_key, target = memo
+            return (bucket_key, self._pad_steps_feeds(feed_vals, target),
+                    target)
+        tag, fp, feed_sig, fetch_names, state_names = miss_key
+        dims = set()
+        for _, shape, _ in feed_sig:
+            if len(shape) < 2:
+                return None
+            dims.add(int(shape[1]))
+        if len(dims) != 1:
+            return None
+        b = dims.pop()
+
+        def rebucket(sig, new_b):
+            return tuple((n, (s[0], new_b) + tuple(s[2:]), dt)
+                         for n, s, dt in sig)
+
+        candidates = []
+        for k in self._cache:
+            if len(k) != 5 or k[0] != tag or k[1] != fp \
+                    or k[3] != fetch_names or k[4] != state_names:
+                continue
+            cdims = {int(s[1]) for _, s, _ in k[2] if len(s) >= 2}
+            if len(cdims) != 1:
+                continue
+            cand_b = cdims.pop()
+            if cand_b < b:
+                continue
+            if k[2] == rebucket(feed_sig, cand_b):
+                candidates.append(cand_b)
+        if not candidates:
+            return None
+        target_b = min(candidates)
+        if target_b == b:
+            return None
+        bucket_key = (tag, fp, rebucket(feed_sig, target_b), fetch_names,
+                      state_names)
+        self._bucket_map[miss_key] = (bucket_key, (b, target_b))
+        return (bucket_key, self._pad_steps_feeds(feed_vals, (b, target_b)),
+                (b, target_b))
+
+    @staticmethod
+    def _pad_steps_feeds(feed_vals, target):
+        b, target_b = target
+        out = {}
+        for n, v in feed_vals.items():
+            pad = jnp.repeat(v[:, -1:], target_b - b, axis=1)
+            out[n] = jnp.concatenate([v, pad], axis=1)
+        return out
+
+    @staticmethod
+    def _fetch_batch_dim_dynamic(block, name, padded_batch):
+        """Shared declared-shape heuristic for fetch un-padding: does
+        the program say this fetch's dim 0 is the (padded) batch?  Used
+        by _unpad_fetches (run) and _unpad_steps_fetches (run_steps,
+        where the per-step dim 0 is the stacked axis 1)."""
+        if block is None:
+            return True
+        try:
+            var = block.var(name)
+        except (KeyError, TypeError):
+            return True  # unnamed fetch / temp var without declared shape
+        if getattr(var, "persistable", False):
+            return False
+        shape = getattr(var, "shape", None)
+        if not shape or shape[0] in (-1, None):
+            return True
+        return shape[0] != padded_batch
+
+    def _unpad_steps_fetches(self, fetches, orig_batch, padded_batch,
+                             block=None, fetch_names=()):
+        """Slice stacked fetches [K, padded_b, ...] back to the real
+        per-step batch along axis 1 (the per-step dim 0)."""
+        names = list(fetch_names) + [None] * (len(fetches) -
+                                              len(fetch_names))
+        out = []
+        for f, n in zip(fetches, names):
+            if getattr(f, "ndim", 0) >= 2 and f.shape[1] == padded_batch \
+                    and self._fetch_batch_dim_dynamic(block, n,
+                                                      padded_batch):
+                f = f[:, :orig_batch]
+            out.append(f)
+        return tuple(out)
+
+    def _has_longer_scan(self, miss_key, k):
+        """True when a scan with the same per-step signature but MORE
+        steps is already compiled — i.e. this call is the short final
+        chunk of a steady run_steps loop."""
+        tag, fp, feed_sig, fetch_names, state_names = miss_key
+
+        def strip_k(sig):
+            return tuple((n, tuple(s[1:]), dt) for n, s, dt in sig)
+
+        want = strip_k(feed_sig)
+        for key in self._cache:
+            if len(key) != 5 or key[0] != tag or key[1] != fp \
+                    or key[3] != fetch_names or key[4] != state_names:
+                continue
+            ks = {int(s[0]) for _, s, _ in key[2] if len(s) >= 1}
+            if len(ks) == 1 and ks.pop() > k and strip_k(key[2]) == want:
+                return True
+        return False
+
+    def _run_steps_fallback(self, program, feed_vals, k, fetch_list,
+                            scope, return_numpy):
+        """Serve a K' < K final chunk as K' single-step dispatches through
+        run() (whose own cache/bucketing applies) and restack the
+        fetches to the run_steps [K', ...] contract."""
+        outs = []
+        for i in range(k):
+            outs.append(self.run(
+                program, feed={n: v[i] for n, v in feed_vals.items()},
+                fetch_list=fetch_list, scope=scope, return_numpy=True))
+        n_fetch = len(outs[0]) if outs else 0
+        stacked = [np.stack([o[j] for o in outs]) for j in range(n_fetch)]
+        if return_numpy:
+            return stacked
+        return [jnp.asarray(s) for s in stacked]
 
     # -- prefetch-driven step loop ------------------------------------------
     def run_prefetched(self, program, feeds, fetch_list=None, scope=None,

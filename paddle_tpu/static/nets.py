@@ -101,10 +101,42 @@ def attention_core(q, k, v, d_key, dropout_rate=0.0, merge_shape=None):
 
 
 def scaled_dot_product_attention(queries, keys, values, num_heads=1,
-                                 dropout_rate=0.0):
+                                 dropout_rate=0.0, sequence_parallel=False,
+                                 causal=False):
     """Multi-head attention built from primitive ops (nets.py:503).  The
     flash/ring Pallas kernel lives in paddle_tpu.ops.attention; this is
-    the graph-API form."""
+    the graph-API form.
+
+    ``sequence_parallel=True`` emits the `ring_attention` op on the
+    merged-head [b, t, h*d] tensors instead of the score-matrix graph:
+    under a CompiledProgram whose BuildStrategy sets
+    ``sequence_parallel_degree`` the sequence dim is sharded over the
+    "sp" mesh axis and K/V rotate around the ring (O(S/n) activations,
+    no S² scores); outside any mesh the op degrades to plain attention,
+    so the same program runs single-chip for debugging."""
+    if sequence_parallel:
+        if dropout_rate:
+            raise ValueError(
+                "scaled_dot_product_attention(sequence_parallel=True) "
+                "does not support attention-probability dropout — the "
+                "probs are never materialized")
+        from ..ops.attention import SP_RING_ID
+        helper = layers.LayerHelper("ring_attention")
+        out = helper.create_variable_for_type_inference(queries.dtype)
+        out.shape = tuple(queries.shape) if queries.shape else None
+        helper.append_op("ring_attention",
+                         inputs={"Q": [queries], "K": [keys],
+                                 "V": [values]},
+                         outputs={"Out": [out]},
+                         attrs={"causal": bool(causal),
+                                "ring_id": SP_RING_ID,
+                                "num_heads": int(num_heads)})
+        return out
+    if causal:
+        raise NotImplementedError(
+            "causal masking is only wired for the sequence_parallel "
+            "(ring_attention) path; the score-matrix graph here is the "
+            "bidirectional BERT/ERNIE form")
     d_key = queries.shape[-1] // num_heads
 
     def _split_heads(x):

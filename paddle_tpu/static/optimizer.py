@@ -32,6 +32,7 @@ __all__ = [
     "ProximalAdagradOptimizer",
     "ExponentialMovingAverage", "L1Decay", "L2Decay",
     "GradientClipByValue", "GradientClipByNorm", "GradientClipByGlobalNorm",
+    "gradient_merge",
 ]
 
 
@@ -759,6 +760,40 @@ class LookaheadOptimizer:
                 helper.append_op("assign", inputs={"X": new_fast},
                                  outputs={"Out": p})
         return result
+
+
+def gradient_merge(program, k_steps, startup_program=None,
+                   params_grads=None, avg=True):
+    """Standalone k-step gradient accumulation over an already-minimized
+    `program` — the GradientMergeOptimizer rewrite without the fleet
+    strategy detour: grads accumulate into PERSISTABLE buffers every
+    step and the optimizer ops commit through a step-counter mask on the
+    k-th (straight-line masked update; one XLA computation, see
+    distributed/fleet/meta_optimizers/gradient_merge_optimizer.py).
+
+    The accumulators and the step counter are persistable and
+    startup-initialized, so they thread through `Executor.run_steps`'
+    donated on-device state and ride checkpoints
+    (`Executor.checkpoint_snapshot`) like any optimizer accumulator —
+    a resumed run continues mid-accumulation-window.
+
+    `params_grads` defaults to the pairs `minimize()` recorded on the
+    program; pass them explicitly when composing with wrappers that do
+    not record them (e.g. amp.decorate's minimize)."""
+    from ..core.program import default_startup_program
+    if k_steps is None or int(k_steps) <= 1:
+        return program
+    pgs = params_grads or getattr(program, "_ps_params_grads", None)
+    if not pgs:
+        raise ValueError(
+            "gradient_merge: run optimizer.minimize(loss) on the program "
+            "first (it records the param/grad pairs), or pass "
+            "params_grads= explicitly")
+    startup = startup_program or default_startup_program()
+    from ..distributed.fleet.meta_optimizers.gradient_merge_optimizer \
+        import apply_gradient_merge
+    apply_gradient_merge(program, startup, pgs, int(k_steps), avg=avg)
+    return program
 
 
 class RecomputeOptimizer(Optimizer):

@@ -349,3 +349,60 @@ def test_fused_xent_through_op_flag():
     np.testing.assert_allclose(np.asarray(fused["Loss"]),
                                np.asarray(base["Loss"]), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_wired_sequence_parallel_transformer_lm():
+    """The PUBLIC long-seq wiring: build_transformer_lm(
+    sequence_parallel=True) emits ring_attention ops per layer, runs
+    single-device (ring degrades to plain attention), matches the
+    non-sp build numerically there, and composes with FLAGS_recompute
+    auto-remat (barriers + ring op in the same block).  The dp×sp mesh
+    execution of the ring op itself is pinned by
+    test_static_ring_attention_op_sequence_parallel above."""
+    import paddle_tpu.static as static
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.models.static_lm import build_transformer_lm
+
+    VOCAB, HID, SEQ, B = 64, 32, 16, 4
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, VOCAB, (B, SEQ)).astype(np.int32),
+            "pos": np.tile(np.arange(SEQ), (B, 1)).astype(np.int32),
+            "labels": rng.randint(0, VOCAB,
+                                  (B, SEQ, 1)).astype(np.int32)}
+
+    def build(sp, remat=False):
+        _reset_unique_names()
+        if remat:
+            set_flags({"recompute": "always"})
+        try:
+            main, startup, loss, _ = build_transformer_lm(
+                VOCAB, HID, 2, 2, SEQ, sequence_parallel=sp)
+            with static.program_guard(main, startup):
+                static.SGD(learning_rate=0.0).minimize(loss)
+        finally:
+            set_flags({"recompute": ""})
+        return main, startup, loss
+
+    def run_single(main, startup, loss):
+        exe, sc = static.Executor(), static.Scope()
+        with static.scope_guard(sc):
+            exe.run(startup)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+        return float(lv)
+
+    main_sp, startup_sp, loss_sp = build(sp=True)
+    ring_ops = [op for op in main_sp.global_block().ops
+                if op.type == "ring_attention"]
+    assert len(ring_ops) == 2  # one per layer
+    l_sp = run_single(main_sp, startup_sp, loss_sp)
+    main_plain, startup_plain, loss_plain = build(sp=False)
+    l_plain = run_single(main_plain, startup_plain, loss_plain)
+    np.testing.assert_allclose(l_sp, l_plain, rtol=1e-4, atol=1e-6)
+
+    # remat × ring compose in one block, numerics preserved
+    main_r, startup_r, loss_r = build(sp=True, remat=True)
+    ops_r = [op.type for op in main_r.global_block().ops]
+    assert "optimization_barrier" in ops_r and "ring_attention" in ops_r
+    l_r = run_single(main_r, startup_r, loss_r)
+    np.testing.assert_allclose(l_r, l_plain, rtol=1e-4, atol=1e-6)

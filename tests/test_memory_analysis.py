@@ -1,0 +1,150 @@
+"""Compile-time HBM accounting (static/memory_analysis.py +
+Executor.memory_report).
+
+The estimator's job is ordinal and threshold truth, not byte-exactness:
+remat must walk SMALLER than no-remat, bigger batches must walk bigger,
+the PADDLE_TPU_HBM_BYTES budget must flip the fits verdict, and where
+the installed backend exposes ``compile().memory_analysis()`` the walk
+must land within an order-of-magnitude band of XLA's own accounting
+(XLA fuses/rematerializes aggressively, so tight tolerances would pin
+implementation noise, not correctness).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.program import _reset_unique_names
+from paddle_tpu.static import layers, nets
+
+
+VOCAB, SEQ, HIDDEN, HEADS = 128, 16, 32, 2
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    set_flags({"recompute": "", "hbm_assume_batch": 0})
+
+
+def build_toy_transformer(layers_n=4, remat=False):
+    _reset_unique_names()
+    if remat:
+        set_flags({"recompute": "always"})
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            ids = layers.data("ids", [-1, SEQ], dtype="int64")
+            labels = layers.data("labels", [-1, SEQ, 1], dtype="int64")
+            h = layers.embedding(ids, size=[VOCAB, HIDDEN])
+            h = layers.layer_norm(h, begin_norm_axis=2)
+            for _ in range(layers_n):
+                q = layers.fc(h, HIDDEN, num_flatten_dims=2)
+                k = layers.fc(h, HIDDEN, num_flatten_dims=2)
+                v = layers.fc(h, HIDDEN, num_flatten_dims=2)
+                ctx = nets.scaled_dot_product_attention(q, k, v,
+                                                        num_heads=HEADS)
+                h = layers.layer_norm(layers.elementwise_add(h, ctx),
+                                      begin_norm_axis=2)
+                ffn = layers.fc(h, HIDDEN * 2, num_flatten_dims=2,
+                                act="gelu")
+                h = layers.layer_norm(
+                    layers.elementwise_add(
+                        h, layers.fc(ffn, HIDDEN, num_flatten_dims=2)),
+                    begin_norm_axis=2)
+            logits = layers.fc(h, VOCAB, num_flatten_dims=2)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, labels))
+            static.Adam(learning_rate=1e-3).minimize(loss)
+    finally:
+        set_flags({"recompute": ""})
+    return main, startup, loss
+
+
+def test_remat_peak_below_plain_peak_on_4_layer_transformer():
+    plain, _, _ = build_toy_transformer(layers_n=4, remat=False)
+    remat, _, _ = build_toy_transformer(layers_n=4, remat=True)
+    p = static.estimate_peak_bytes(plain, batch=32)
+    r = static.estimate_peak_bytes(remat, batch=32)
+    assert r < p, (r, p)
+    # the saving is activations, not persistables: both walk the same
+    # parameter set
+    ra = static.analyze_program(remat, batch=32)
+    pa = static.analyze_program(plain, batch=32)
+    assert ra["persistable_bytes"] == pa["persistable_bytes"]
+    assert ra["activation_peak_bytes"] < pa["activation_peak_bytes"]
+
+
+def test_peak_grows_with_batch():
+    main, _, _ = build_toy_transformer(layers_n=2)
+    peaks = [static.estimate_peak_bytes(main, batch=b)
+             for b in (4, 8, 16, 32)]
+    assert peaks == sorted(peaks) and peaks[0] < peaks[-1], peaks
+
+
+def test_oom_prediction_honors_budget_env(monkeypatch):
+    from paddle_tpu.static.memory_analysis import HBM_BUDGET_ENV
+    main, _, _ = build_toy_transformer(layers_n=2)
+    peak = static.estimate_peak_bytes(main, batch=8)
+    monkeypatch.setenv(HBM_BUDGET_ENV, str(peak * 4))
+    assert static.analyze_program(main, batch=8)["fits"] is True
+    monkeypatch.setenv(HBM_BUDGET_ENV, str(max(1, peak // 4)))
+    assert static.analyze_program(main, batch=8)["fits"] is False
+    # and the budget itself is reported
+    assert static.analyze_program(
+        main, batch=8)["budget_bytes"] == max(1, peak // 4)
+
+
+def test_phase_peaks_and_report_shape():
+    main, _, _ = build_toy_transformer(layers_n=2)
+    r = static.analyze_program(main, batch=8)
+    assert r["peak_bytes"] == max(r["phase_peaks"].values())
+    assert set(r["phase_peaks"]) == {"forward", "backward", "optimize"}
+    assert r["top_live"] and all(isinstance(c, int)
+                                 for _, c in r["top_live"])
+    assert r["n_unknown_vars"] == 0
+    # optimizer phase holds params + grads + adam moments, far below the
+    # activation peak but above the bare persistables
+    assert r["phase_peaks"]["optimize"] >= r["persistable_bytes"]
+
+
+def test_memory_report_estimate_without_device_or_feed():
+    main, _, _ = build_toy_transformer(layers_n=2)
+    exe = static.Executor()
+    rep = exe.memory_report(main, batch=16)
+    assert rep["peak_bytes"] == static.estimate_peak_bytes(main, batch=16)
+    assert rep["xla"] is None
+    assert rep["estimate"]["batch"] == 16
+
+
+def test_memory_report_vs_xla_ground_truth_on_cpu():
+    """Where the backend exposes compile().memory_analysis(), the walked
+    peak must sit within an order-of-magnitude band of XLA's number —
+    catching unit errors (bytes vs elements) and liveness blowups while
+    tolerating XLA's fusion/remat freedom."""
+    main, startup, loss = build_toy_transformer(layers_n=2)
+    exe, scope = static.Executor(), static.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, VOCAB, (8, SEQ)).astype(np.int32),
+            "labels": rng.randint(0, VOCAB, (8, SEQ, 1)).astype(np.int32)}
+    with static.scope_guard(scope):
+        exe.run(startup)
+        rep = exe.memory_report(main, feed=feed, scope=scope)
+    if rep["xla"] is None:
+        pytest.skip("backend exposes no memory_analysis(): "
+                    + rep.get("xla_error", "none returned"))
+    xla_peak = rep["xla"]["peak_bytes"]
+    est_peak = rep["peak_bytes"]
+    assert xla_peak > 0
+    assert est_peak / 10 <= xla_peak <= est_peak * 10, \
+        (est_peak, rep["xla"])
+
+
+def test_select_layer_checkpoints_picks_one_per_layer():
+    for n in (2, 4):
+        main, _, _ = build_toy_transformer(layers_n=n)
+        picks = static.select_layer_checkpoints(main)
+        assert len(picks) == n, (n, picks)
+        # each pick is a layer_norm output declared in the block
+        blk = main.global_block()
+        assert all(blk.has_var(p) for p in picks)

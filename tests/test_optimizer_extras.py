@@ -116,3 +116,54 @@ def test_namespace_aliases():
     assert static.InputSpec is not None
     from paddle_tpu.io.framework_io import load_program_state
     assert static.load_program_state is load_program_state
+
+def test_gradient_merge_standalone_api():
+    """paddle_tpu.static.gradient_merge: k-step accumulation without the
+    fleet-strategy detour — k=2 over identical batches equals half the
+    plain steps, the accumulators/counter are persistable (survive
+    checkpoint snapshots and run_steps state threading), and k<=1 is a
+    no-op."""
+    def build():
+        main, startup, loss = _linreg()
+        with static.program_guard(main, startup):
+            static.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    main, startup, loss = build()
+    with static.program_guard(main, startup):
+        static.gradient_merge(main, 2)
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        feed = {"x": np.stack([XB] * 4), "y": np.stack([YB] * 4)}
+        exe.run_steps(main, feed=feed, fetch_list=[loss])
+        w_merge = [np.asarray(sc.get(p.name))
+                   for p in main.all_parameters()]
+        _, state, _ = exe.checkpoint_snapshot(main, sc)
+        assert any("@GradientMerge" in n for n in state), sorted(state)
+        assert any("@gm_step" in n for n in state), sorted(state)
+
+    main2, startup2, loss2 = build()
+    exe2, sc2 = static.Executor(), static.Scope()
+    with static.scope_guard(sc2):
+        exe2.run(startup2)
+        for _ in range(2):
+            exe2.run(main2, feed={"x": XB, "y": YB}, fetch_list=[loss2])
+        w_plain = [np.asarray(sc2.get(p.name))
+                   for p in main2.all_parameters()]
+    for a, b in zip(w_merge, w_plain):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    # no params_grads recorded -> loud error, not a silent no-op
+    main3, _, _ = _linreg()
+    try:
+        static.gradient_merge(main3, 2)
+    except ValueError as e:
+        assert "minimize" in str(e)
+    else:
+        raise AssertionError("expected ValueError without minimize()")
+    # k=1 is a no-op
+    main4, startup4, loss4 = build()
+    n_ops = len(main4.global_block().ops)
+    static.gradient_merge(main4, 1)
+    assert len(main4.global_block().ops) == n_ops

@@ -1,0 +1,246 @@
+"""Recompute (activation checkpointing) rewrite: numerical equivalence
+and composition.
+
+The rewrite (static/recompute_rewrite.py) replays forward segments from
+checkpoint vars during backward, with segment inputs routed through an
+`optimization_barrier` op so XLA cannot CSE the replay back into the
+original forward (which would silently keep every activation alive and
+defeat the memory saving).  These tests pin the contract the
+memory-for-throughput tier rests on:
+
+  * forward loss AND parameter gradients are numerically equal with vs.
+    without the rewrite — for a MANUAL checkpoint list and for
+    FLAGS_recompute auto selection;
+  * the rewritten block actually contains optimization_barrier ops;
+  * the rewrite composes with AMP's cast-inserting program rewrite and
+    with Executor.run_steps' scanned megastep (donated state, one scan);
+  * FLAGS_recompute=auto only rewrites when the HBM estimator predicts
+    the PADDLE_TPU_HBM_BYTES budget is exceeded.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.program import _reset_unique_names
+from paddle_tpu.static import layers, nets
+
+
+VOCAB, SEQ, HIDDEN, HEADS, LAYERS = 128, 16, 32, 2, 2
+BATCH = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    set_flags({"recompute": "", "hbm_assume_batch": 0})
+
+
+def build_tiny_transformer(use_amp=False, lr=0.0):
+    """bert-tiny-style MLM step; lr=0 keeps params frozen so grads can
+    be fetched and compared across program variants."""
+    _reset_unique_names()
+    from paddle_tpu import amp
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, SEQ], dtype="int64")
+        labels = layers.data("labels", [-1, SEQ, 1], dtype="int64")
+        h = layers.embedding(ids, size=[VOCAB, HIDDEN])
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        boundaries = []
+        for _ in range(LAYERS):
+            boundaries.append(h)
+            q = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            k = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            v = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            ctx = nets.scaled_dot_product_attention(q, k, v,
+                                                    num_heads=HEADS)
+            h = layers.layer_norm(layers.elementwise_add(h, ctx),
+                                  begin_norm_axis=2)
+            ffn = layers.fc(h, HIDDEN * 2, num_flatten_dims=2, act="gelu")
+            h = layers.layer_norm(
+                layers.elementwise_add(
+                    h, layers.fc(ffn, HIDDEN, num_flatten_dims=2)),
+                begin_norm_axis=2)
+        logits = layers.fc(h, VOCAB, num_flatten_dims=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, labels))
+        opt = static.SGD(learning_rate=lr)
+        if use_amp:
+            opt = amp.decorate(opt, init_loss_scaling=1.0,
+                               use_dynamic_loss_scaling=False,
+                               dest_dtype="bfloat16")
+        _, params_grads = opt.minimize(loss)
+    return main, startup, loss, params_grads, boundaries
+
+
+def _feed():
+    rng = np.random.RandomState(0)
+    return {"ids": rng.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32),
+            "labels": rng.randint(0, VOCAB,
+                                  (BATCH, SEQ, 1)).astype(np.int32)}
+
+
+def _run_loss_and_grads(main, startup, loss, params_grads):
+    exe, scope = static.Executor(), static.Scope()
+    fetch = [loss] + [g for _, g in params_grads]
+    with static.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main, feed=_feed(), fetch_list=fetch)
+    grads = {p.name: np.asarray(g) for (p, _), g
+             in zip(params_grads, out[1:])}
+    return float(np.asarray(out[0])), grads
+
+
+def _barrier_count(program):
+    return sum(1 for op in program.global_block().ops
+               if op.type == "optimization_barrier")
+
+
+_PLAIN_REF = {}
+
+
+def _plain_reference():
+    """Loss+grads of the UNREWRITTEN program, computed once per module —
+    three tests compare against it and each whole-block jit compile is
+    the expensive part of this file."""
+    if not _PLAIN_REF:
+        main_p, start_p, loss_p, pg_p, _ = build_tiny_transformer()
+        loss0, grads0 = _run_loss_and_grads(main_p, start_p, loss_p, pg_p)
+        assert _barrier_count(main_p) == 0
+        _PLAIN_REF["ref"] = (loss0, grads0)
+    return _PLAIN_REF["ref"]
+
+
+def test_manual_checkpoints_match_plain_backward():
+    loss0, grads0 = _plain_reference()
+
+    # manual checkpoints through RecomputeOptimizer (fluid contract)
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, SEQ], dtype="int64")
+        labels = layers.data("labels", [-1, SEQ, 1], dtype="int64")
+        h = layers.embedding(ids, size=[VOCAB, HIDDEN])
+        h = layers.layer_norm(h, begin_norm_axis=2)
+        ckpts = []
+        for _ in range(LAYERS):
+            ckpts.append(h)
+            q = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            k = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            v = layers.fc(h, HIDDEN, num_flatten_dims=2)
+            ctx = nets.scaled_dot_product_attention(q, k, v,
+                                                    num_heads=HEADS)
+            h = layers.layer_norm(layers.elementwise_add(h, ctx),
+                                  begin_norm_axis=2)
+            ffn = layers.fc(h, HIDDEN * 2, num_flatten_dims=2, act="gelu")
+            h = layers.layer_norm(
+                layers.elementwise_add(
+                    h, layers.fc(ffn, HIDDEN, num_flatten_dims=2)),
+                begin_norm_axis=2)
+        logits = layers.fc(h, VOCAB, num_flatten_dims=2)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, labels))
+        opt = static.RecomputeOptimizer(static.SGD(learning_rate=0.0))
+        opt._set_checkpoints(ckpts)
+        _, pg = opt.minimize(loss)
+    assert _barrier_count(main) >= 1, \
+        "rewritten block lost its optimization_barrier"
+    loss1, grads1 = _run_loss_and_grads(main, startup, loss, pg)
+
+    np.testing.assert_allclose(loss1, loss0, rtol=1e-5, atol=1e-6)
+    assert set(grads1) == set(grads0)
+    for name in grads0:
+        np.testing.assert_allclose(grads1[name], grads0[name],
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+def test_auto_checkpoint_selection_matches_plain_backward():
+    loss0, grads0 = _plain_reference()
+
+    set_flags({"recompute": "always"})
+    main, startup, loss, pg, _ = build_tiny_transformer()
+    set_flags({"recompute": ""})
+    assert _barrier_count(main) >= 1
+    loss1, grads1 = _run_loss_and_grads(main, startup, loss, pg)
+    np.testing.assert_allclose(loss1, loss0, rtol=1e-5, atol=1e-6)
+    for name in grads0:
+        np.testing.assert_allclose(grads1[name], grads0[name],
+                                   rtol=1e-4, atol=1e-6, err_msg=name)
+
+
+def test_auto_mode_gates_on_estimated_budget(monkeypatch):
+    from paddle_tpu.static.memory_analysis import HBM_BUDGET_ENV
+    # generous budget: no rewrite
+    monkeypatch.setenv(HBM_BUDGET_ENV, str(1 << 40))
+    set_flags({"recompute": "auto", "hbm_assume_batch": BATCH})
+    main_big, *_ = build_tiny_transformer()
+    assert _barrier_count(main_big) == 0
+    # starvation budget (below the tiny model's ~450 kB walked peak):
+    # rewrite engages
+    monkeypatch.setenv(HBM_BUDGET_ENV, str(100_000))
+    main_small, *_ = build_tiny_transformer()
+    assert _barrier_count(main_small) >= 1
+
+
+def test_estimator_says_remat_is_smaller():
+    main_p, *_ = build_tiny_transformer()
+    set_flags({"recompute": "always"})
+    main_r, *_ = build_tiny_transformer()
+    set_flags({"recompute": ""})
+    plain = static.estimate_peak_bytes(main_p, batch=BATCH)
+    remat = static.estimate_peak_bytes(main_r, batch=BATCH)
+    assert remat < plain, (remat, plain)
+
+
+def test_rewrite_composes_with_amp():
+    main_p, start_p, loss_p, pg_p, _ = build_tiny_transformer(use_amp=True)
+    loss0, grads0 = _run_loss_and_grads(main_p, start_p, loss_p, pg_p)
+
+    set_flags({"recompute": "always"})
+    main, startup, loss, pg, _ = build_tiny_transformer(use_amp=True)
+    set_flags({"recompute": ""})
+    assert _barrier_count(main) >= 1
+    # AMP inserted cast ops in the forward; the replayed segments carry
+    # them too — same bf16 compute path both ways
+    assert any(op.type == "cast" for op in main.global_block().ops)
+    loss1, grads1 = _run_loss_and_grads(main, startup, loss, pg)
+    np.testing.assert_allclose(loss1, loss0, rtol=1e-3, atol=1e-4)
+    for name in grads0:
+        np.testing.assert_allclose(grads1[name], grads0[name],
+                                   rtol=2e-2, atol=2e-3, err_msg=name)
+
+
+def test_rewrite_composes_with_run_steps():
+    """Remat program under the scanned megastep: K steps in one dispatch
+    match K sequential run() dispatches of the SAME program."""
+    K = 3
+    set_flags({"recompute": "always"})
+    main, startup, loss, _, _ = build_tiny_transformer(lr=0.05)
+    set_flags({"recompute": ""})
+    assert _barrier_count(main) >= 1
+
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, VOCAB, (K, BATCH, SEQ)).astype(np.int32)
+    labels = rng.randint(0, VOCAB, (K, BATCH, SEQ, 1)).astype(np.int32)
+
+    exe, sc = static.Executor(), static.Scope()
+    seq_losses = []
+    with static.scope_guard(sc):
+        exe.run(startup)
+        for i in range(K):
+            (lv,) = exe.run(main, feed={"ids": ids[i],
+                                        "labels": labels[i]},
+                            fetch_list=[loss])
+            seq_losses.append(float(lv))
+
+    set_flags({"recompute": "always"})
+    main2, startup2, loss2, _, _ = build_tiny_transformer(lr=0.05)
+    set_flags({"recompute": ""})
+    exe2, sc2 = static.Executor(), static.Scope()
+    with static.scope_guard(sc2):
+        exe2.run(startup2)
+        (stacked,) = exe2.run_steps(main2,
+                                    feed={"ids": ids, "labels": labels},
+                                    fetch_list=[loss2])
+    np.testing.assert_allclose(stacked, seq_losses, rtol=1e-4, atol=1e-5)

@@ -106,3 +106,64 @@ def test_run_steps_honors_check_nan_inf():
                               fetch_list=[loss])
     finally:
         set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_run_steps_short_final_chunk_no_scan_retrace():
+    """A K' < K final chunk is served step-by-step through run()'s cache
+    (at most ONE single-step trace, reused forever) instead of retracing
+    the whole scan — and numerics match the all-sequential walk."""
+    K = 6
+    xs, ys = _data(K + 2)
+    main, startup, loss = _build()
+    exe, sc = static.Executor(), static.Scope()
+    with static.scope_guard(sc):
+        exe.run(startup)
+        (l1,) = exe.run_steps(main, feed={"x": xs[:K], "y": ys[:K]},
+                              fetch_list=[loss])
+        t0 = exe.cache_stats()["traces"]
+        (l2,) = exe.run_steps(main, feed={"x": xs[K:], "y": ys[K:]},
+                              fetch_list=[loss])
+        assert l2.shape == (2,)
+        assert exe.cache_stats()["traces"] - t0 <= 1  # single-step sig
+        t1 = exe.cache_stats()["traces"]
+        exe.run_steps(main, feed={"x": xs[K:], "y": ys[K:]},
+                      fetch_list=[loss])
+        assert exe.cache_stats()["traces"] == t1  # steady thereafter
+
+    main2, startup2, loss2 = _build()
+    exe2, sc2 = static.Executor(), static.Scope()
+    seq = []
+    with static.scope_guard(sc2):
+        exe2.run(startup2)
+        for i in range(K + 2):
+            (lv,) = exe2.run(main2, feed={"x": xs[i], "y": ys[i]},
+                             fetch_list=[loss2])
+            seq.append(float(lv))
+    np.testing.assert_allclose(np.concatenate([l1, l2]), seq,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_run_steps_ragged_batch_buckets_into_compiled_scan():
+    """Same K but a smaller PER-STEP batch pads up into the compiled
+    stacked bucket (zero new traces) and the stacked fetches un-pad."""
+    K = 4
+    xs, ys = _data(K)
+    main, startup, loss = _build()
+    exe, sc = static.Executor(), static.Scope()
+    per_row = next(v for v in main.global_block().vars.values()
+                   if v.shape == (-1, 1) and not v.is_data
+                   and not v.persistable)
+    with static.scope_guard(sc):
+        exe.run(startup)
+        exe.run_steps(main, feed={"x": xs, "y": ys},
+                      fetch_list=[loss, per_row])
+        t0 = exe.cache_stats()["traces"]
+        b0 = exe.cache_stats()["bucket_hits"]
+        lv, pred_rows = exe.run_steps(
+            main, feed={"x": xs[:, :3], "y": ys[:, :3]},
+            fetch_list=[loss, per_row])
+        assert exe.cache_stats()["traces"] == t0, "scan retraced"
+        assert exe.cache_stats()["bucket_hits"] == b0 + 1
+        assert lv.shape == (K,)  # scalar loss: nothing to un-pad
+        # per-row fetch un-padded from the bucket batch 4 back to 3
+        assert pred_rows.shape[:2] == (K, 3), pred_rows.shape
