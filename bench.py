@@ -332,6 +332,22 @@ def _bench_knobs():
     return remat_mode, gm, ring
 
 
+def _dp_shard_knob():
+    """--dp-shard [N] / BENCH_DP_SHARD=N: ZeRO-1 optimizer-state
+    sharding A/B (distributed/sharding.py).  A bare --dp-shard targets
+    the v5e-32 pod slice's 8-chip host world."""
+    raw = _argv_value("--dp-shard")
+    if raw is None:
+        raw = os.environ.get("BENCH_DP_SHARD", "0")
+    elif raw == "":
+        raw = os.environ.get("BENCH_DP_SHARD", "") or "8"
+    ds = int(raw or 0)
+    if ds < 0:
+        raise SystemExit("bench: --dp-shard needs a non-negative world "
+                         "size (e.g. --dp-shard 8)")
+    return ds
+
+
 def seq_ladder_main():
     """Sequence-length ladder (`python bench.py --seq-ladder` or
     BENCH_MODE=seq_ladder): builds the bench model at each rung —
@@ -551,21 +567,46 @@ def main():
     # (memory_analysis._op_internal_bytes), and the true sp-sharded
     # numbers need CompiledProgram over a multi-chip mesh.
     remat_mode, grad_merge_k, use_ring = _bench_knobs()
+    # BENCH_DP_SHARD=N (--dp-shard [N]): ZeRO-1 optimizer-state sharding
+    # A/B.  The rewrite is applied for an N-rank dp world; on this
+    # bench's single-device Executor path every collective degrades to
+    # identity, so tokens/s measures the rewrite's dispatch/fusion
+    # overhead while predicted_peak_bytes and collective_bytes_per_step
+    # report the N-chip story (the mesh numbers need CompiledProgram
+    # over real chips — queued as zero1_* in perf_r05/queue.txt).
+    dp_shard = _dp_shard_knob()
     if remat_mode:
         from paddle_tpu.core.flags import set_flags
-        set_flags({"recompute": remat_mode, "hbm_assume_batch": batch})
+        set_flags({"recompute": remat_mode, "hbm_assume_batch": batch,
+                   "hbm_dp_shard": dp_shard})
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
                                               heads, batch, use_amp=use_amp,
                                               use_ring=use_ring)
     if remat_mode:
         from paddle_tpu.core.flags import set_flags
-        set_flags({"recompute": "", "hbm_assume_batch": 0})
+        set_flags({"recompute": "", "hbm_assume_batch": 0,
+                   "hbm_dp_shard": 0})
+    _collective_bytes = None
+    if dp_shard > 1:
+        from paddle_tpu.distributed.compiled_program import \
+            insert_grad_allreduce
+        from paddle_tpu.distributed.sharding import (
+            shard_optimizer_states, collective_bytes_per_step)
+        # plain-DP wire bytes: what insert_grad_allreduce WOULD emit for
+        # this program on an N-rank mesh (per-param allreduce)
+        plain_bytes = collective_bytes_per_step(
+            insert_grad_allreduce(main_p), dp_shard)
+        shard_optimizer_states(main_p, startup_p, dp_degree=dp_shard)
+        zero_bytes = collective_bytes_per_step(
+            insert_grad_allreduce(main_p), dp_shard)
+        _collective_bytes = {"allreduce": plain_bytes, "zero1": zero_bytes}
     if grad_merge_k > 1:
         static.gradient_merge(main_p, grad_merge_k, startup_p)
     # compile-time HBM verdict rides every bench record: the number that
     # decides fits-or-OOMs before a tunnel window is ever spent
-    _mem = static.analyze_program(main_p, batch=batch)
+    _mem = static.analyze_program(main_p, batch=batch,
+                                  dp_shard=dp_shard or None)
     exe = static.Executor()
     scope = static.Scope()
     rng = np.random.RandomState(0)
@@ -716,12 +757,18 @@ def main():
             "hits": stats["hits"],
         },
     }
-    if remat_mode or grad_merge_k > 1 or use_ring:
+    if remat_mode or grad_merge_k > 1 or use_ring or dp_shard > 1:
         # self-describing A/B records: the queue runner's JSON says what
         # memory knobs produced the number
         result["memory_knobs"] = {"remat": remat_mode or "off",
                                   "grad_merge_k": grad_merge_k,
-                                  "ring": use_ring}
+                                  "ring": use_ring,
+                                  "dp_shard": dp_shard}
+    if _collective_bytes is not None:
+        # per-rank ICI bytes per step: bucketed reduce-scatter+allgather
+        # vs the per-param allreduce baseline (ring accounting)
+        result["collective_bytes_per_step"] = _collective_bytes
+        result["optimizer_slot_bytes"] = _mem["optimizer_slot_bytes"]
     if on_tpu:
         result["mfu"] = round(mfu, 4)
     else:

@@ -113,6 +113,11 @@ define_flag("recompute", "",
             "only when the HBM estimator predicts PADDLE_TPU_HBM_BYTES "
             "is exceeded, 'always' = rewrite unconditionally; explicit "
             "checkpoints= lists always win (static/memory_analysis.py)")
+define_flag("hbm_dp_shard", 0,
+            "HBM accounting: assume ZeRO-1 optimizer-state sharding over "
+            "this many dp replicas (distributed/sharding.py) — the "
+            "auto-remat verdict's optimizer-slot reservation and "
+            "analyze_program's prediction mode divide slot bytes by it")
 define_flag("hbm_assume_batch", 0,
             "batch size the HBM estimator binds symbolic -1 dims to "
             "(memory_analysis; 0 binds 1, making batch-dynamic "

@@ -165,6 +165,12 @@ class VarDesc:
         # optimizer accumulator → param link (_add_accumulator)
         if self.attrs.get("accum_of"):
             d["accum_of"] = self.attrs["accum_of"]
+        # ZeRO-1 sharded slot marking (distributed/sharding.py): the var
+        # is a global-shaped bucket sharded over the dp axis at this
+        # degree — CompiledProgram state specs and the HBM walker's
+        # per-chip accounting both read it, so it must survive the wire
+        if self.attrs.get("dp_shard"):
+            d["dp_shard"] = int(self.attrs["dp_shard"])
         return d
 
     @staticmethod
@@ -179,6 +185,8 @@ class VarDesc:
             v.attrs["dist_attr"] = list(d["dist_attr"])
         if d.get("accum_of"):
             v.attrs["accum_of"] = d["accum_of"]
+        if d.get("dp_shard"):
+            v.attrs["dp_shard"] = int(d["dp_shard"])
         return v
 
 

@@ -17,6 +17,10 @@ from .spawn import spawn  # noqa: F401
 from .compiled_program import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,
 )
+from .sharding import (  # noqa: F401
+    shard_optimizer_states, ShardingPlan, unshard_state, reshard_state,
+    collective_bytes_per_step,
+)
 from .dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, QueueDataset, MultiSlotDataFeed,
 )

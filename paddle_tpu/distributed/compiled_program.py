@@ -100,11 +100,54 @@ class ExecutionStrategy:
         self.use_thread_barrier = True
 
 
+# op types a reduced gradient legitimately flows through between the
+# reduction collective and the optimizer op's Grad slot (scaling, AMP
+# casts, gradient-merge accumulate/mask plumbing, ZeRO bucket plumbing)
+_REDUCE_TRANSPARENT_OPS = frozenset((
+    "scale_by_world_size", "scale", "cast", "elementwise_add", "where",
+    "reshape", "reshape2", "concat", "pad", "slice", "assign",
+    "check_finite_and_unscale", "update_loss_scaling",
+))
+_REDUCE_OPS = frozenset(("c_allreduce_sum", "c_reducescatter"))
+
+
+def _grad_already_reduced(producers: Dict[str, "OpDesc"], name: str,
+                          limit: int = 64) -> bool:
+    """True when `name`'s producer chain already contains a gradient
+    reduction (c_allreduce_sum / c_reducescatter), walking back only
+    through the ops a reduction pass inserts — the first op outside that
+    set (a real backward grad op) terminates the walk.  Makes
+    insert_grad_allreduce idempotent and ZeRO-aware: applying the pass
+    twice, or on a program `shard_optimizer_states` already rewrote,
+    inserts nothing."""
+    seen, frontier = set(), [name]
+    while frontier and limit > 0:
+        limit -= 1
+        n = frontier.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        op = producers.get(n)
+        if op is None:
+            continue
+        if op.type in _REDUCE_OPS:
+            return True
+        if op.type not in _REDUCE_TRANSPARENT_OPS:
+            continue
+        frontier.extend(op.input_names())
+    return False
+
+
 def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
                           scale=True, fp16_allreduce=None) -> Program:
     """Insert c_allreduce_sum (+ 1/N scale) on every Grad input of optimizer
     ops.  Mirrors CreateAllReduceOp insertion
     (multi_devices_graph_pass.cc:464,:632); returns a rewritten clone.
+
+    Idempotent: a Grad input whose producer chain already contains a
+    c_allreduce_sum / c_reducescatter (this pass applied twice via
+    CompiledProgram + a fleet meta-optimizer, or a ZeRO-1 program from
+    distributed/sharding.py) is left alone instead of double-reduced.
 
     fp16_allreduce (meta_optimizers/fp16_allreduce_optimizer.py analog):
     wrap the allreduce in bf16 casts, halving ICI bytes."""
@@ -112,6 +155,10 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
         fp16_allreduce = getattr(program, "_fp16_allreduce", False)
     p = copy.deepcopy(program)
     block = p.global_block()
+    producers: Dict[str, Any] = {}
+    for op in block.ops:
+        for n in op.output_names():
+            producers[n] = op
     new_ops = []
     done: Dict[str, str] = {}
     for op in block.ops:
@@ -121,6 +168,9 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
             for g in gnames:
                 if g in done:
                     new_gnames.append(done[g])
+                    continue
+                if _grad_already_reduced(producers, g):
+                    new_gnames.append(g)
                     continue
                 from ..core.program import OpDesc
                 src = g
@@ -232,6 +282,22 @@ class CompiledProgram:
     def _get_program(self) -> Program:
         if self._rewritten is None:
             n = len(self._devices())
+            has_zero = any(
+                v.attrs.get("dp_shard")
+                for b in self._program.blocks for v in b.vars.values())
+            if has_zero and (
+                    int(getattr(self._build_strategy,
+                                "sequence_parallel_degree", 1)) > 1 or
+                    int(getattr(self._build_strategy,
+                                "tensor_parallel_degree", 1)) > 1):
+                # under dp×sp grads are partial over BOTH axes but the
+                # ZeRO reduce-scatter rides ring 0's first axis only;
+                # under dp×tp the slot-spec interplay is untested —
+                # refuse rather than silently mis-reduce
+                raise NotImplementedError(
+                    "ZeRO-1 sharded programs (shard_optimizer_states) "
+                    "compose with a pure dp mesh only; sequence/tensor "
+                    "parallel degrees must be 1")
             if self._is_data_parallel:
                 scale = (self._build_strategy.gradient_scale_strategy ==
                          GradientScaleStrategy.CoeffNumDevice and n > 1)
@@ -419,6 +485,25 @@ class CompiledProgram:
             return tuple(fetches), new_state
 
         state_specs = {n: P() for n in state_names}
+        # ZeRO-1 sharded optimizer slots (distributed/sharding.py): the
+        # persistable is declared at the GLOBAL padded bucket shape and
+        # marked dp_shard — shard it over "dp" so each rank holds (and
+        # donates, and updates) only its slice.  Any dp degree dividing
+        # the padded length runs the same program.
+        for n in state_names:
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            if not v.attrs.get("dp_shard"):
+                continue
+            dp = mesh.shape["dp"]
+            if not v.shape or int(v.shape[0]) % dp != 0:
+                raise ValueError(
+                    f"ZeRO-1 slot {n!r} (shape {v.shape}) does not divide "
+                    f"the mesh dp degree {dp}; re-run "
+                    f"shard_optimizer_states for this mesh")
+            state_specs[n] = P("dp")
         if has_tp:
             # param sharding from dist_attr annotations
             # (tensor_parallel.py shard_param); optimizer accumulators

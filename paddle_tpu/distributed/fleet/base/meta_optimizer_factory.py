@@ -12,7 +12,7 @@ from ..meta_optimizers import (
     AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
     LocalSGDOptimizer, AdaptiveLocalSGDOptimizer, LarsOptimizer,
     LambOptimizer, DGCOptimizer, FP16AllReduceOptimizer,
-    GraphExecutionOptimizer,
+    ShardingOptimizer, GraphExecutionOptimizer,
 )
 
 __all__ = ["MetaOptimizerFactory", "meta_optimizer_names"]
@@ -25,6 +25,9 @@ _META_OPTIMIZERS = [
     RecomputeOptimizer,
     AMPOptimizer,
     FP16AllReduceOptimizer,
+    # ZeRO-1 sharding BEFORE gradient merge: the merge rewrite masks the
+    # sharded update's commit, so reduce-scatter serves K micro-steps
+    ShardingOptimizer,
     GradientMergeOptimizer,
     LocalSGDOptimizer,
     AdaptiveLocalSGDOptimizer,

@@ -11,4 +11,5 @@ from .lars_optimizer import LarsOptimizer  # noqa: F401
 from .lamb_optimizer import LambOptimizer  # noqa: F401
 from .dgc_optimizer import DGCOptimizer, DGCMomentumOptimizer  # noqa: F401
 from .fp16_allreduce_optimizer import FP16AllReduceOptimizer  # noqa: F401
+from .sharding_optimizer import ShardingOptimizer  # noqa: F401
 from .graph_execution_optimizer import GraphExecutionOptimizer  # noqa: F401

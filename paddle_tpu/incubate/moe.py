@@ -67,7 +67,8 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
     Returns (out [N, D], aux_loss scalar)."""
     N, D = x.shape
     E = gate_w.shape[1]
-    ep = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    from ..ops.kernels.collective import _axis_size
+    ep = 1 if axis_name is None else _axis_size(axis_name)
     e_local = w1.shape[0]
     if e_local * ep != E:
         raise ValueError(

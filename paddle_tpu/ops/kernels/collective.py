@@ -26,6 +26,16 @@ def _axes(ctx, attrs):
     return ctx.collective_axes(attrs.get("ring_id", 0))
 
 
+def _axis_size(ax) -> int:
+    """Static size of a mesh axis from inside shard_map.  jax.lax.axis_size
+    only exists in newer jax; psum of a python 1 is constant-folded to the
+    axis size at trace time on every version."""
+    import jax as _jax
+    if hasattr(_jax.lax, "axis_size"):
+        return _jax.lax.axis_size(ax)
+    return int(_jax.lax.psum(1, ax))
+
+
 def _c_allreduce(name, op):
     @register_op(name, inputs=["X"], outputs=["Out"], grad="auto",
                  side_effect=True)
@@ -119,7 +129,7 @@ def c_scatter(ins, attrs, ctx):
     if not axes:
         return {"Out": x}
     ax = axes if isinstance(axes, str) else axes[0]
-    n = jax.lax.axis_size(ax)
+    n = _axis_size(ax)
     idx = jax.lax.axis_index(ax)
     # only the root's buffer is meaningful — broadcast it first so non-root
     # ranks may contribute an arbitrary (e.g. zero) full-shaped buffer
@@ -177,7 +187,7 @@ def c_split(ins, attrs, ctx):
     if not axes:
         return {"Out": x}
     ax = axes if isinstance(axes, str) else axes[0]
-    n = jax.lax.axis_size(ax)
+    n = _axis_size(ax)
     idx = jax.lax.axis_index(ax)
     shard = x.shape[-1] // n
     return {"Out": jax.lax.dynamic_slice_in_dim(x, idx * shard, shard,
@@ -276,7 +286,7 @@ def alltoall(ins, attrs, ctx):
     if not axes:
         return {"Out": x}
     ax = axes if isinstance(axes, str) else axes[0]
-    n = jax.lax.axis_size(ax)
+    n = _axis_size(ax)
     xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     out = jax.lax.all_to_all(xs, ax, split_axis=0, concat_axis=0, tiled=False)
     return {"Out": out.reshape(x.shape)}
@@ -301,7 +311,7 @@ def p_recv(ins, attrs, ctx):
     if not axes:
         return {"Out": x}
     ax = axes if isinstance(axes, str) else axes[0]
-    n = jax.lax.axis_size(ax)
+    n = _axis_size(ax)
     peer = attrs.get("peer", 0)
     me = attrs.get("me", None)
     # permutation sending peer -> this rank; built statically over the ring

@@ -299,8 +299,20 @@ def lamb(ins, attrs, ctx):
     m1_hat = m1_out / (1 - b1p)
     m2_hat = m2_out / (1 - b2p)
     r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
-    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
-    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    sq_p = jnp.sum(jnp.square(p))
+    sq_r = jnp.sum(jnp.square(r))
+    # ZeRO-1 sharded update (distributed/sharding.py): p and r are this
+    # rank's 1/world shard of one flat parameter, but the trust ratio is
+    # defined on the WHOLE parameter's norms — psum the squared norms
+    # over the ring.  Zero bucket padding contributes zero to both sums.
+    ring = attrs.get("reduce_norms_ring_id")
+    if ring is not None:
+        axes = ctx.collective_axes(ring)
+        if axes:
+            sq_p = jax.lax.psum(sq_p, axes)
+            sq_r = jax.lax.psum(sq_r, axes)
+    p_norm = jnp.sqrt(sq_p)
+    r_norm = jnp.sqrt(sq_r)
     trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
     p_out = p - lr * trust * r
     return {"ParamOut": p_out.astype(ins["Param"].dtype),

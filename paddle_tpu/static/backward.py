@@ -121,7 +121,13 @@ def _auto_remat_checkpoints(loss, block: Block, no_grad: Set[str]):
                 for d in p.shape:
                     n *= 1 if d in (-1, None) else int(d)
                 reserve += n * _np.dtype(_np_dtype(p.dtype)).itemsize
-        if report["peak_bytes"] + 2 * reserve <= report["fits_budget_bytes"]:
+        # world-size-aware slot accounting: under ZeRO-1 sharding
+        # (FLAGS_hbm_dp_shard, distributed/sharding.py) the moments this
+        # reserve models are split 1/N per chip — the verdict must match
+        # the sharded post-minimize walk, not the replicated one
+        ds = int(flag("hbm_dp_shard", 0)) or 1
+        if report["peak_bytes"] + 2 * reserve // ds \
+                <= report["fits_budget_bytes"]:
             return None
     return ckpts
 

@@ -589,7 +589,7 @@ class Executor:
             for f, n in zip(fetches, names))
 
     def memory_report(self, program=None, feed=None, scope=None,
-                      batch=None):
+                      batch=None, dp_shard=None):
         """Compile-time HBM accounting for one training step of
         `program` (static/memory_analysis.py): the op-IR liveness
         estimate always; XLA ground truth via
@@ -610,7 +610,7 @@ class Executor:
                 if len(shape):
                     batch = int(shape[0])
                     break
-        est = analyze_program(program, batch=batch)
+        est = analyze_program(program, batch=batch, dp_shard=dp_shard)
         report = {"estimate": est, "peak_bytes": est["peak_bytes"],
                   "budget_bytes": est["budget_bytes"],
                   "fits": est["fits"], "xla": None}
