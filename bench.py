@@ -204,6 +204,101 @@ def checkpoint_main():
     print(json.dumps(result))
 
 
+def elastic_main():
+    """Elastic-schedule A/B (`python bench.py --elastic` or
+    BENCH_MODE=elastic): steady-state training throughput of the plain
+    data-parallel step vs the elasticized one (distributed/elastic.py) on
+    the full local mesh.  The elastic path swaps psum gradient reduction
+    for the world-size-invariant ordered fold (all_gather + explicit
+    left-fold continuation) plus the masked commit — topology-invariant
+    bitwise resume is bought with extra gradient wire volume and the fold
+    chain, and this mode prices it.  Also re-runs two global steps on a
+    half-size mesh and reports whether the committed loss matched the
+    full-mesh value bitwise (the elastic contract, continuously
+    verified).  Prints ONE JSON line."""
+    import tempfile
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU") or not os.environ.get(
+            "BENCH_ELASTIC_TPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    from paddle_tpu.distributed.elastic import elasticize, rebucket_feeds
+    from paddle_tpu.static import layers
+
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", 40))
+    world = len(jax.devices())
+    logical = 1 << (world.bit_length() - 1)  # pow2 floor
+    batch_per_rank = int(os.environ.get("BENCH_ELASTIC_BATCH", 4))
+    hidden = int(os.environ.get("BENCH_ELASTIC_HIDDEN", 256))
+    rng = np.random.RandomState(0)
+    gb = logical * batch_per_rank
+    feeds = [{"x": rng.rand(gb, hidden).astype(np.float32),
+              "y": rng.rand(gb, 1).astype(np.float32)}
+             for _ in range(steps)]
+
+    def build(elastic):
+        _reset_unique_names()
+        main_p, startup_p = static.Program(), static.Program()
+        with static.program_guard(main_p, startup_p):
+            x = layers.data("x", [-1, hidden])
+            y = layers.data("y", [-1, 1])
+            h = layers.fc(x, hidden, act="relu")
+            h = layers.fc(h, hidden, act="relu")
+            pred = layers.fc(h, 1)
+            loss = layers.mean(
+                layers.square(layers.elementwise_sub(pred, y)))
+            static.Adam(learning_rate=1e-3).minimize(loss)
+        meta = None
+        if elastic:
+            meta = elasticize(main_p, startup_p, logical_dp=logical,
+                              loss_name=loss)
+        return main_p, startup_p, loss, meta
+
+    def measure(elastic, run_world, n_steps, warm=2):
+        warm = min(warm, max(0, n_steps - 1))
+        main_p, startup_p, loss, meta = build(elastic)
+        cp = CompiledProgram(main_p).with_data_parallel(
+            loss_name=loss.name,
+            places=list(jax.devices())[:run_world])
+        fetch = meta["loss_avg"] if elastic else loss
+        exe = static.Executor()
+        scope = static.Scope()
+        losses = []
+        t0 = time.time()
+        with static.scope_guard(scope):
+            exe.run(startup_p)
+            for i, f in enumerate(feeds[:n_steps]):
+                if i == warm:
+                    t0 = time.time()
+                for mf in rebucket_feeds(f, logical, run_world):
+                    out = exe.run(cp, feed=mf, fetch_list=[fetch])
+                losses.append(np.asarray(out[0]))
+        dt = max(1e-9, time.time() - t0)
+        return (n_steps - warm) * gb / dt, losses
+
+    # A/B on `logical` devices, not `world`: a non-power-of-two device
+    # count would not divide the schedule (the pow2 floor is the mesh)
+    plain_tps, _ = measure(False, logical, steps)
+    elastic_tps, ref_losses = measure(True, logical, steps)
+    # contract check: two global steps on a half-size mesh, same math
+    _, half_losses = measure(True, max(1, logical // 2), 4)
+    bitwise = all(np.array_equal(a, b)
+                  for a, b in zip(ref_losses[:4], half_losses))
+    result = {
+        "metric": "elastic_overhead_pct",
+        "value": round((plain_tps / elastic_tps - 1.0) * 100, 2),
+        "unit": "%",
+        "steps": steps,
+        "logical_dp": logical,
+        "rows_per_sec": {"plain_dp": round(plain_tps, 1),
+                         "elastic": round(elastic_tps, 1)},
+        "half_mesh_loss_bitwise": bool(bitwise),
+    }
+    print(json.dumps(result))
+
+
 def serving_main():
     """Serving benchmark mode (`python bench.py --serving` or
     BENCH_MODE=serving): N concurrent clients hammer the HTTP server's
@@ -487,6 +582,10 @@ def main():
     if "--checkpoint" in sys.argv or \
             os.environ.get("BENCH_MODE") == "checkpoint":
         checkpoint_main()
+        return
+    if "--elastic" in sys.argv or \
+            os.environ.get("BENCH_MODE") == "elastic":
+        elastic_main()
         return
     if "--seq-ladder" in sys.argv or \
             os.environ.get("BENCH_MODE") == "seq_ladder":

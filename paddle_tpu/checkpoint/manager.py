@@ -281,6 +281,12 @@ class CheckpointManager:
                 nbytes += len(buf)
             f.flush()
             os.fsync(f.fileno())
+        # fault injection (paddle_tpu/testing/chaos.py): the window
+        # between shard bytes and manifest/commit is exactly where a
+        # preempted host tears a checkpoint — chaos makes that timing
+        # reproducible (slow_save / torn_save)
+        from ..testing import chaos as _chaos
+        _chaos.save_hook(stage, job.step)
         manifest = {
             "format": FORMAT_VERSION,
             "framework_version": _framework_version(),
@@ -438,6 +444,21 @@ class CheckpointManager:
             stacklevel=3)
 
     def _read(self, step: int, manifest: dict) -> Checkpoint:
+        saved_world = int(manifest.get("world_size", 1))
+        if saved_world != self.world_size:
+            # topology shift at the storage layer: this manager's rank
+            # layout differs from the writer's.  Rank-private shards from
+            # vanished ranks are NOT merged here (single-host state is
+            # rank-complete; multi-host rank-merged load is a ROADMAP
+            # follow-up) — surface it instead of silently reading a
+            # same-named shard with different contents.
+            warnings.warn(
+                f"checkpoint step {step} was written by a world of "
+                f"{saved_world} ranks but is being loaded by a world of "
+                f"{self.world_size}; rank-private shards of vanished "
+                "ranks are not merged — topology-shifted restore "
+                "converts replicated/global state only (docs/elastic.md)",
+                RuntimeWarning, stacklevel=3)
         state: Dict[str, np.ndarray] = {}
         by_shard: Dict[str, List[tuple]] = {}
         for name, meta in manifest["tensors"].items():
@@ -593,7 +614,12 @@ class CheckpointManager:
             time.sleep(0.05)
         if self._state_provider is None:
             return None
-        step, state, extra = self._state_provider()
+        provided = self._state_provider()
+        if provided is None:
+            # provider registered but nothing to save yet (e.g. hapi fit
+            # preempted before its first epoch completed)
+            return None
+        step, state, extra = provided
         stat_add("checkpoint.preemption_saves")
         return self.save(step, state, extra=extra, sync=True)
 

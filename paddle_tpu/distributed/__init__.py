@@ -21,6 +21,10 @@ from .sharding import (  # noqa: F401
     shard_optimizer_states, ShardingPlan, unshard_state, reshard_state,
     collective_bytes_per_step,
 )
+from .elastic import (  # noqa: F401
+    elasticize, rebucket_feeds, rederive_schedule, reanchor_topology,
+    elastic_meta, micro_steps_per_global,
+)
 from .dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, QueueDataset, MultiSlotDataFeed,
 )

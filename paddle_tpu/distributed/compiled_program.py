@@ -108,7 +108,8 @@ _REDUCE_TRANSPARENT_OPS = frozenset((
     "reshape", "reshape2", "concat", "pad", "slice", "assign",
     "check_finite_and_unscale", "update_loss_scaling",
 ))
-_REDUCE_OPS = frozenset(("c_allreduce_sum", "c_reducescatter"))
+_REDUCE_OPS = frozenset(("c_allreduce_sum", "c_reducescatter",
+                         "c_elastic_fold"))
 
 
 def _grad_already_reduced(producers: Dict[str, "OpDesc"], name: str,
@@ -285,6 +286,20 @@ class CompiledProgram:
             has_zero = any(
                 v.attrs.get("dp_shard")
                 for b in self._program.blocks for v in b.vars.values())
+            has_elastic = getattr(self._program, "_elastic_meta",
+                                  None) is not None
+            if has_elastic and (
+                    int(getattr(self._build_strategy,
+                                "sequence_parallel_degree", 1)) > 1 or
+                    int(getattr(self._build_strategy,
+                                "tensor_parallel_degree", 1)) > 1):
+                # the ordered fold reduces over ring 0's dp axis only;
+                # under dp×sp gradients are partial over both axes and
+                # the fold would silently drop the sp contributions
+                raise NotImplementedError(
+                    "elastic programs (distributed/elastic.elasticize) "
+                    "compose with a pure dp mesh only; sequence/tensor "
+                    "parallel degrees must be 1")
             if has_zero and (
                     int(getattr(self._build_strategy,
                                 "sequence_parallel_degree", 1)) > 1 or
@@ -332,6 +347,36 @@ class CompiledProgram:
         n_dev = len(mesh.devices.flat)
         block = program.global_block()
 
+        elastic = getattr(program, "_elastic_meta", None)
+        micro_k = 1
+        if elastic is not None:
+            n_logical = int(elastic["logical_dp"])
+            if n_logical % n_dev != 0:
+                raise ValueError(
+                    f"elastic logical_dp={n_logical} is not divisible by "
+                    f"the mesh world {n_dev}")
+            micro_k = n_logical // n_dev
+            # topology-shifted resume: restore_from_checkpoint left the
+            # schedule position in GLOBAL steps (it cannot know the new
+            # mesh); re-anchor the executor's micro-step counter for THIS
+            # world before deriving seeds from it
+            rebase = getattr(executor, "_elastic_rebase_global", None)
+            if rebase is not None:
+                executor._step = int(rebase) * micro_k
+                executor._elastic_steps = int(rebase) * micro_k
+                # the restore re-derived the persistable micro counter
+                # for its best-guess default world; THIS mesh is the
+                # authority — re-anchor it too, or the commit mask and
+                # per-rank RNG phase run at the wrong K (e.g. restore on
+                # an 8-device host, then places=4: counter g vs step
+                # g*2 would commit after ONE half-folded micro-step)
+                scope.set(elastic["counter"],
+                          jnp.array(np.full((1,), int(rebase) * micro_k,
+                                            np.int32)))
+                executor._elastic_rebase_global = None
+            executor._last_elastic_world = n_dev
+            executor._last_elastic_k = micro_k
+
         # pre-placed feeds (reader.Prefetcher via place_feed) pass through;
         # host arrays take the synchronous conversion
         feed_vals = {n: v if isinstance(v, jax.Array) else jnp.asarray(v)
@@ -354,10 +399,31 @@ class CompiledProgram:
         else:
             _ccache.record_hit()
 
+        from ..testing import chaos as _chaos
+        if _chaos.enabled():
+            # same step numbering as the kill hook: the n-th TRAIN step
+            # (startup/eval dispatches neither count nor fault)
+            if getattr(program, "_chaos_is_training", None) is None:
+                from ..static.executor import _is_training
+                program._chaos_is_training = _is_training(program)
+            if program._chaos_is_training:
+                _chaos.collective_hook(executor._train_runs + 1)
         state = {n: scope.get(n) for n in state_names}
-        seed = executor._seed_for_step(program)
+        if elastic is not None:
+            # one RNG stream per GLOBAL step: all K micro-steps of a
+            # window derive from the same base seed, decorrelated per
+            # LOGICAL rank inside the traced step — so dropout masks and
+            # shuffles replay identically on any mesh size.  Counted by
+            # _elastic_steps, which (unlike _step) startup/eval runs
+            # never pollute.
+            seed = (int(program.random_seed) * 1000003 +
+                    executor._elastic_steps // micro_k) % (2 ** 31)
+        else:
+            seed = executor._seed_for_step(program)
         fetches, new_state = fn(state, feed_vals, jnp.uint32(seed))
         executor._step += 1
+        if elastic is not None:
+            executor._elastic_steps += 1
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:
@@ -407,11 +473,27 @@ class CompiledProgram:
                 f"BuildStrategy.fetch_aggregation must be 'reduce' or "
                 f"'concat', got {fetch_aggregation!r}")
 
+        elastic = getattr(program, "_elastic_meta", None)
+        n_mesh_dp = mesh.shape["dp"]
+        micro_k = 1
+        if elastic is not None:
+            micro_k = int(elastic["logical_dp"]) // n_mesh_dp
+
         def step(state, feed, seed):
             # decorrelate RNG across replicas (the reference gives each
             # device worker a distinct seed).  NOT across tp: tp shards
             # see the same batch and must draw identical dropout masks.
-            local_seed = seed + jnp.uint32(jax.lax.axis_index("dp"))
+            if elastic is not None:
+                # elastic: decorrelate by LOGICAL rank jM+m (micro-step j
+                # from the persistable counter, pre-increment), so every
+                # topology draws the same per-rank streams
+                cnt = jnp.reshape(state[elastic["counter"]], (-1,))[0]
+                micro = jnp.mod(cnt.astype(jnp.uint32),
+                                jnp.uint32(micro_k))
+                local_seed = seed + micro * jnp.uint32(n_mesh_dp) + \
+                    jnp.uint32(jax.lax.axis_index("dp"))
+            else:
+                local_seed = seed + jnp.uint32(jax.lax.axis_index("dp"))
             if has_sp:
                 local_seed = local_seed * jnp.uint32(7919) + \
                     jnp.uint32(jax.lax.axis_index("sp"))
@@ -447,6 +529,15 @@ class CompiledProgram:
             fetches = []
             for n in fetch_names:
                 v = env[n]
+                if elastic is not None and (
+                        n == elastic.get("loss_avg")
+                        or n in elastic.get("accs", ())):
+                    # elastic fold outputs are already replicated AND
+                    # globally averaged; pmean-ing n identical replicas
+                    # computes nL/n, whose rounding depends on the world
+                    # size — exactly the variance elastic mode removes
+                    fetches.append(v)
+                    continue
                 if fetch_aggregation == "concat":
                     # reference ParallelExecutor semantics: per-device rows
                     # concatenated along dim 0 (scalars stack to [ndev]).

@@ -1,0 +1,370 @@
+"""Elastic data parallelism: one logical schedule, any physical world size.
+
+The spot-fleet problem (ROADMAP "elastic training"): a v5e-32 job loses
+hosts mid-run, and the 24 chips that come back must CONTINUE the same
+training run — same global batch schedule, same loss trace — not start a
+subtly different one.  Three facts make that hard:
+
+  1. the data-parallel world size is baked into the gradient reduction
+     (``psum`` over however many devices exist), so the same global batch
+     summed on 8 vs 4 devices differs in floating-point reduction order;
+  2. gradient-merge counters, RNG streams and sampler positions are all
+     denominated in *micro-steps*, whose meaning changes with the world;
+  3. ZeRO-sharded optimizer state is laid out for a specific shard count.
+
+This module solves (1) and (2) by fixing the LOGICAL topology and making
+the reduction order a property of the program, not of the mesh:
+
+``elasticize(program, startup, logical_dp=N)`` rewrites an
+already-minimized program so that
+
+  * a global step is ``K = N / M`` micro-steps on an M-device mesh
+    (``K`` is resolved at *trace* time from the mesh — the op list is
+    identical for every M, so the program fingerprint, the persistable
+    state layout, and the checkpoint format are world-size invariant);
+  * gradients are reduced by ``c_elastic_fold`` — an ``all_gather``
+    followed by an explicit, unrolled left-fold continued from a
+    persistable accumulator.  Micro-step j folds logical ranks
+    ``jM .. jM+M-1`` in order, so after K micro-steps the accumulator
+    holds exactly ``(((g0+g1)+g2)+...)+g_{N-1}`` — the same adds in the
+    same order for EVERY factorization of N, hence bitwise-identical
+    updates across topology changes (tests/test_elastic.py proves
+    8→4→8 and 8→2→4→8 bitwise-equal to an uninterrupted run);
+  * the optimizer commits through a mask derived from a persistable
+    micro-step counter (the gradient-merge masking machinery), scaled by
+    the exact power-of-two ``1/N``;
+  * the per-shard loss is folded the same way, so the committed
+    ``<loss>@ELASTIC_AVG`` value reproduces the full-mesh loss trace
+    bitwise.
+
+Wire-cost note: the fold gathers every rank's gradient instead of
+psum-ing it — (M-1)·|g| bytes vs allreduce's 2(M-1)/M·|g|.  Elastic mode
+trades up to ~M/2× gradient wire volume for topology invariance; the
+plain (non-elastic) path is untouched.
+
+(3) — ZeRO layout conversion — is handled at restore time by
+``Executor.restore_from_checkpoint`` routing state through
+``sharding.unshard_state`` → ``sharding.reshard_state`` (see
+docs/elastic.md).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.program import OpDesc, OpRole, Program, unique_name
+from .fleet.meta_optimizers.rewrite_utils import (
+    _op, new_tmp_var, retarget_op_outputs_masked)
+
+__all__ = ["elasticize", "rebucket_feeds", "rederive_schedule",
+           "elastic_meta", "micro_steps_per_global"]
+
+
+def elastic_meta(program) -> Optional[dict]:
+    """The elastic rewrite's metadata dict, or None for plain programs."""
+    return getattr(program, "_elastic_meta", None)
+
+
+def micro_steps_per_global(program, world: int) -> int:
+    """K for `program` on a `world`-device mesh (1 for plain programs)."""
+    meta = elastic_meta(program)
+    if meta is None:
+        return 1
+    n = int(meta["logical_dp"])
+    if world < 1 or n % world != 0:
+        raise ValueError(
+            f"elastic logical_dp={n} is not divisible by the physical "
+            f"world size {world}; an elastic mesh must be a power-of-two "
+            f"divisor of the logical world")
+    return n // world
+
+
+def elasticize(program: Program, startup: Program, logical_dp: int,
+               loss_name=None, params_grads=None) -> dict:
+    """Rewrite an already-minimized `program` for the elastic schedule.
+
+    Must run BEFORE the startup program executes (it appends accumulator
+    initializers, like ``static.gradient_merge``).  `logical_dp` is the
+    job's logical data-parallel degree — the reduction order and the
+    commit cadence are defined against it forever; any mesh whose size
+    divides it runs the same program.  `loss_name` (var or name)
+    additionally folds the loss so the committed ``<loss>@ELASTIC_AVG``
+    fetch is world-size invariant.  Mutates `program`/`startup` in place
+    and returns the recorded meta dict (also at
+    ``program._elastic_meta``)."""
+    n = int(logical_dp)
+    if n < 1 or (n & (n - 1)):
+        raise ValueError(f"logical_dp must be a power of two, got {n}")
+    if elastic_meta(program) is not None:
+        raise ValueError("elasticize already applied to this program")
+    plan = getattr(program, "_zero_shard_plan", None)
+    if plan is not None and getattr(plan, "buckets", None):
+        raise NotImplementedError(
+            "elasticize does not compose with shard_optimizer_states "
+            "(ZeRO-1) yet — ZeRO topology shifts are handled by "
+            "checkpoint layout conversion at restore instead "
+            "(docs/elastic.md)")
+    if getattr(program, "_gm_meta", None) is not None:
+        raise NotImplementedError(
+            "elasticize does not stack on static.gradient_merge: the "
+            "elastic schedule IS a masked accumulation window (K = "
+            "logical_dp / world); apply only one of the two")
+    pgs = params_grads or getattr(program, "_ps_params_grads", None)
+    if not pgs:
+        raise ValueError(
+            "elasticize: run optimizer.minimize(loss) on the program "
+            "first (it records the param/grad pairs), or pass "
+            "params_grads= explicitly")
+
+    block = program.global_block()
+    sblock = startup.global_block()
+    opt_start = next((i for i, op in enumerate(block.ops)
+                      if op.op_role == OpRole.Optimize), len(block.ops))
+    opt_ops = block.ops[opt_start:]
+    block.ops = block.ops[:opt_start]
+
+    def _persistable(name, shape, dtype, value):
+        for b in (block, sblock):
+            b.create_var(name=name, shape=shape, dtype=dtype,
+                         persistable=True, stop_gradient=True)
+        sblock.ops.append(OpDesc(
+            "fill_constant", {}, {"Out": [name]},
+            {"shape": list(shape), "value": value, "dtype": dtype,
+             "op_uid": startup._next_uid()}))
+
+    # micro-step counter; (counter % K == 0) AFTER the increment marks the
+    # commit micro-step.  K = logical_dp / mesh-size is resolved inside
+    # the elastic_commit_mask kernel at trace time, so this same op list
+    # serves every world size.
+    counter = unique_name("@elastic_step")
+    _persistable(counter, (1,), "int32", 0)
+    _op(program, block, "increment", {"X": [counter]}, {"Out": [counter]},
+        {"step": 1})
+    mask = new_tmp_var(block, name_hint="@elastic_mask", dtype="bool")
+    _op(program, block, "elastic_commit_mask", {"X": [counter]},
+        {"Out": [mask]}, {"ring_id": 0, "logical_dp": n})
+
+    acc_names: List[str] = []
+    resets: List[tuple] = []  # (acc, folded) pairs to reset on commit
+
+    def _fold(src_name, like_var, hint):
+        """acc += ordered cross-rank fold of `src_name`; returns the
+        folded (pre-reset) temp and registers the reset."""
+        acc = unique_name(hint + "@ELASTIC_ACC")
+        shape = list(like_var.shape or [1])
+        _persistable(acc, shape, like_var.dtype or "float32", 0.0)
+        folded = new_tmp_var(block, like=block.var(acc),
+                             name_hint=hint + "@ELASTIC_FOLD")
+        _op(program, block, "c_elastic_fold",
+            {"X": [src_name], "Acc": [acc]}, {"Out": [folded]},
+            {"ring_id": 0, "logical_dp": n})
+        acc_names.append(acc)
+        resets.append((acc, folded))
+        return folded
+
+    grad_to_committed: Dict[str, str] = {}
+    for p, g in pgs:
+        gname = g.name if hasattr(g, "name") else str(g)
+        if gname in grad_to_committed:
+            continue
+        gvar = block.var(gname)
+        folded = _fold(gname, gvar, gname)
+        committed = new_tmp_var(block, like=gvar,
+                                name_hint=gname + "@ELASTIC_AVG")
+        _op(program, block, "scale", {"X": [folded]}, {"Out": [committed]},
+            {"scale": 1.0 / n, "bias": 0.0})
+        grad_to_committed[gname] = committed
+
+    loss_avg = None
+    if loss_name is not None:
+        lname = loss_name.name if hasattr(loss_name, "name") else \
+            str(loss_name)
+        lvar = block.var(lname)
+        lfold = _fold(lname, lvar, lname)
+        loss_avg = lname + "@ELASTIC_AVG"
+        block.create_var(name=loss_avg, shape=list(lvar.shape or [1]),
+                         dtype=lvar.dtype or "float32", stop_gradient=True)
+        _op(program, block, "scale", {"X": [lfold]}, {"Out": [loss_avg]},
+            {"scale": 1.0 / n, "bias": 0.0})
+
+    # optimizer ops read the committed fold and commit through the mask;
+    # `rename` keeps intra-group dataflow on the fresh @MASKED temps
+    tail: List[OpDesc] = []
+    rename: Dict[str, str] = {}
+    for op in opt_ops:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [
+                rename.get(grad_to_committed.get(nm, nm),
+                           grad_to_committed.get(nm, nm))
+                for nm in names]
+        retarget_op_outputs_masked(program, op, mask, tail, rename)
+        block.ops.append(op)
+    block.ops.extend(tail)
+
+    # accumulators reset on commit so the next window folds from zero
+    for acc, folded in resets:
+        zeros = new_tmp_var(block, like=block.var(acc),
+                            name_hint=acc + "@ZERO")
+        _op(program, block, "fill_constant", {}, {"Out": [zeros]},
+            {"shape": list(block.var(acc).shape or [1]), "value": 0.0,
+             "dtype": block.var(acc).dtype})
+        _op(program, block, "where",
+            {"Condition": [mask], "X": [zeros], "Y": [folded]},
+            {"Out": [acc]})
+
+    program._fingerprint_cache = None
+    startup._fingerprint_cache = None
+    meta = {"logical_dp": n, "counter": counter, "loss_avg": loss_avg,
+            "accs": acc_names, "version": 1}
+    program._elastic_meta = meta
+    return meta
+
+
+def rebucket_feeds(feed: dict, logical_dp: int, world: int,
+                   batch_rows: Optional[int] = None) -> List[dict]:
+    """Split one GLOBAL-batch feed dict (N·b rows) into the K = N/M
+    micro-step feeds an M-device mesh consumes: micro-step j carries the
+    rows of logical ranks jM .. jM+M-1, which is simply the next M·b-row
+    slice — the same row order every topology sees.
+
+    Feeds carrying the batch axis are split; everything else (lr
+    scalars, lookup tables, replicated vectors) rides every micro-step
+    whole.  The batch axis is `batch_rows` when given; otherwise the
+    leading dim SHARED BY MOST feeds (a lone big table must not hijack
+    row detection), and an ambiguous tie raises — pass batch_rows=
+    explicitly.  A non-divisible batch FAILS rather than being silently
+    replicated K times (duplicated data, wrong loss scale)."""
+    k = int(logical_dp) // int(world)
+    if int(logical_dp) % int(world) != 0 or k < 1:
+        raise ValueError(
+            f"world {world} does not divide logical_dp {logical_dp}")
+    if k == 1:
+        return [dict(feed)]
+    arrays = {name: np.asarray(arr) for name, arr in feed.items()}
+    if batch_rows is not None:
+        rows = int(batch_rows)
+    else:
+        counts: Dict[int, int] = {}
+        for a in arrays.values():
+            if a.ndim >= 1:
+                counts[a.shape[0]] = counts.get(a.shape[0], 0) + 1
+        if not counts:
+            rows = 0
+        else:
+            best = max(counts.values())
+            modes = sorted(d for d, c in counts.items() if c == best)
+            if len(modes) > 1:
+                raise ValueError(
+                    f"ambiguous batch axis: leading dims {modes} are "
+                    f"equally common across feeds — pass batch_rows= "
+                    "to rebucket_feeds")
+            rows = modes[0]
+    micro = [dict() for _ in range(k)]
+    for name, a in arrays.items():
+        if a.ndim >= 1 and rows > 0 and a.shape[0] == rows:
+            if rows % k != 0:
+                raise ValueError(
+                    f"feed {name!r} carries {rows} global-batch rows, "
+                    f"not divisible into K={k} micro-steps — elastic "
+                    f"global batches must be logical_dp·b rows "
+                    f"(logical_dp={logical_dp})")
+            for j, part in enumerate(np.split(a, k, axis=0)):
+                micro[j][name] = part
+        else:
+            for j in range(k):
+                micro[j][name] = a
+    return micro
+
+
+def reanchor_topology(executor, program, scope, world: int) -> int:
+    """In-process topology shift: re-anchor an elastic program's schedule
+    for a new mesh world WITHOUT a checkpoint round-trip (the live-shrink
+    path tools/elastic_smoke.py exercises; a relaunched process gets the
+    same treatment from ``Executor.restore_from_checkpoint``).
+
+    Re-derives the executor micro-step and the persistable micro counter
+    for the new K, zeroes partially-folded accumulators when the position
+    was mid-window (that window replays), and re-homes every persistable
+    through the host so the next CompiledProgram can place it on a
+    different device set.  Returns the global step."""
+    import jax.numpy as jnp
+    meta = elastic_meta(program)
+    if meta is None:
+        raise ValueError("reanchor_topology needs an elasticized program")
+    k_old = max(1, int(getattr(executor, "_last_elastic_k", 1)))
+    g, j = divmod(int(getattr(executor, "_elastic_steps",
+                              executor._step)), k_old)
+    if j:
+        warnings.warn(
+            f"elastic topology shift mid-window (micro {j}/{k_old}): "
+            f"rounding down to global step {g}; the partial window "
+            "replays", RuntimeWarning, stacklevel=2)
+    k_new = micro_steps_per_global(program, world)
+    executor._step = g * k_new
+    executor._elastic_steps = g * k_new
+    executor._last_elastic_k = k_new
+    executor._last_elastic_world = int(world)
+    from ..static.executor import _persistable_names
+    for name in _persistable_names(program):
+        v = scope.get(name)
+        if v is not None:
+            # host round-trip: drop the old mesh's committed sharding
+            scope.set(name, jnp.array(np.asarray(v)))
+    scope.set(meta["counter"],
+              jnp.array(np.full((1,), g * k_new, np.int32)))
+    if j:
+        for acc in meta["accs"]:
+            v = scope.get(acc)
+            if v is not None:
+                scope.set(acc, jnp.zeros_like(jnp.asarray(v)))
+    if executor._ckpt is not None:
+        # periodic-checkpoint cadence is denominated in micro-steps too
+        executor._ckpt.last = executor._step
+    return g
+
+
+def rederive_schedule(extra: dict, new_world: int) -> Optional[dict]:
+    """Map a checkpoint's elastic schedule position onto `new_world`.
+
+    The sidecar's ``extra["elastic"]`` records the logical world N and
+    the micro-step denominator K_old the checkpoint was written under.
+    Returns the re-derived positions (all denominated for K_new):
+
+      * ``executor_step`` — micro-step count to restore into the
+        executor so per-global-step derived RNG seeds replay;
+      * ``counter_value`` — value for the persistable micro counter;
+      * ``global_batches_consumed`` — how many GLOBAL batches the data
+        pipeline should skip (feed re-bucketing happens on top with
+        `rebucket_feeds`);
+      * ``replayed_micro`` — nonzero when the checkpoint was taken
+        mid-accumulation-window: the position is rounded DOWN to the
+        window start and the partially-folded accumulators must be
+        zeroed (the window replays; the committed trace is unaffected).
+
+    Returns None when the checkpoint has no elastic sidecar."""
+    el = (extra or {}).get("elastic")
+    if not el:
+        return None
+    n = int(el["logical_dp"])
+    k_old = max(1, int(el.get("k", 1)))
+    if int(new_world) < 1 or n % int(new_world) != 0:
+        raise ValueError(
+            f"cannot resume an elastic logical_dp={n} job on "
+            f"{new_world} devices (must divide the logical world)")
+    k_new = n // int(new_world)
+    # the program's own micro counter is authoritative (the executor step
+    # also counts startup/eval runs); fall back for older sidecars
+    step_old = int(el.get("counter_value",
+                          extra.get("executor_step", 0)))
+    g, j = divmod(step_old, k_old)
+    if j:
+        warnings.warn(
+            f"elastic resume from a mid-window checkpoint (micro "
+            f"{j}/{k_old}): rounding down to global step {g}; the "
+            "partial window replays and its accumulators are reset",
+            RuntimeWarning, stacklevel=3)
+    return {"logical_dp": n, "k_new": k_new, "global_step": g,
+            "executor_step": g * k_new, "counter_value": g * k_new,
+            "global_batches_consumed": g, "replayed_micro": j}

@@ -73,6 +73,14 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
         block.ops.append(op)
     block.ops.extend(tail)
 
+    # record what a topology-shifted resume must re-derive: the counter
+    # (re-denominated to the new k), and the accumulators (zeroed when a
+    # partial window is rounded down) — static/executor.py
+    # restore_from_checkpoint reads this meta from both sides
+    program._gm_meta = {"counter": program._last_masked_counter,
+                        "k": int(k_steps),
+                        "accs": sorted(grad_to_acc.values())}
+
     # reset accumulators on masked steps: acc = where(mask, 0, acc)
     for gname, acc in grad_to_acc.items():
         zeros = new_tmp_var(block, like=block.var(acc),

@@ -59,6 +59,10 @@ def append_masked_step_counter(program: Program, startup: Program,
                 "op_uid": startup._next_uid()})
     sb.ops.append(d)
 
+    # topology-shifted resume (static/executor.py restore_from_checkpoint)
+    # needs to find and re-derive this counter; the return value is the
+    # mask, so the counter name rides a program attr
+    program._last_masked_counter = step
     _op(program, block, "increment", {"X": [step]}, {"Out": [step]},
         {"step": 1})
     kconst = new_tmp_var(block, name_hint=f"@{prefix}_k", dtype="int32")

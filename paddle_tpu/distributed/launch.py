@@ -8,6 +8,20 @@ pserver+trainer processes for parameter-server mode.
 TPU mapping: one worker process per host of the slice (`--nproc_per_node`
 defaults to 1 — a single jax client drives all local chips); `--ips` lists
 slice hosts; rank-0 endpoint doubles as the jax.distributed coordinator.
+
+Supervision (docs/elastic.md): the launcher is a SUPERVISOR, not a
+passive poller.  A rank that dies leaves its peers wedged inside the
+next collective, so on any non-zero exit the pod is torn down fail-fast
+(SIGTERM → grace → SIGKILL, giving every survivor's preemption handler a
+chance to checkpoint).  With ``--elastic``, the launcher then re-forms
+the job from the surviving capacity — the new world is the largest
+power-of-two divisor of the ORIGINAL (logical) world that the survivors
+can fill — and relaunches with the elastic env contract
+(``PADDLE_TPU_ELASTIC=1``, ``PADDLE_TPU_ELASTIC_LOGICAL_WORLD=<N>``,
+``PADDLE_TPU_ELASTIC_RESTART=<n>``); workers resume from the last
+committed checkpoint via ``Executor.restore_from_checkpoint``, whose
+topology-shifted restore re-buckets state and schedule for the new
+world.
 """
 from __future__ import annotations
 
@@ -17,10 +31,10 @@ import sys
 import time
 
 from .launch_utils import (Cluster, Pod, get_cluster, start_local_trainers,
-                           watch_local_trainers, terminate_procs,
-                           find_free_ports)
+                           watch_local_trainers, poll_local_trainers,
+                           terminate_procs, find_free_ports)
 
-__all__ = ["launch_collective", "launch_ps", "main"]
+__all__ = ["launch_collective", "launch_ps", "main", "elastic_world_size"]
 
 
 def _parse_args(argv=None):
@@ -33,6 +47,14 @@ def _parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--run_mode", type=str, default="collective",
                    choices=["collective", "ps"])
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise: on a lost rank, re-form the job from "
+                        "survivors and relaunch resuming from the last "
+                        "checkpoint (docs/elastic.md)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic relaunch budget before giving up")
+    p.add_argument("--term_grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL at teardown")
     p.add_argument("--server_num", type=int, default=None)
     p.add_argument("--worker_num", type=int, default=None)
     p.add_argument("--servers", type=str, default="")
@@ -42,11 +64,21 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def launch_collective(args):
-    """launch.py:198 parity."""
+def elastic_world_size(survivors: int, logical_world: int) -> int:
+    """Largest power-of-two divisor of `logical_world` that `survivors`
+    ranks can fill — the world the re-formed mesh runs at (the elastic
+    schedule requires the physical world to divide the logical one)."""
+    if survivors < 1:
+        return 0
+    w = 1
+    while w * 2 <= survivors and logical_world % (w * 2) == 0:
+        w *= 2
+    return w
+
+
+def _spawn_pod(args, nproc, envs):
     node_ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
     this_ip = os.environ.get("POD_IP", node_ips[0])
-    nproc = args.nproc_per_node
     if args.started_port is not None:
         ports = list(range(args.started_port, args.started_port + nproc))
     else:
@@ -57,16 +89,73 @@ def launch_collective(args):
                                devices_per_proc)
     procs = start_local_trainers(cluster, pod, args.training_script,
                                  args.training_script_args,
-                                 log_dir=args.log_dir)
-    try:
-        while True:
-            procs = watch_local_trainers(procs, cluster.trainers_nranks())
-            if not procs:
-                return 0
-            time.sleep(1)
-    except KeyboardInterrupt:
-        terminate_procs(procs)
-        return 1
+                                 log_dir=args.log_dir, envs=envs)
+    return cluster, procs
+
+
+def launch_collective(args):
+    """launch.py:198 parity, upgraded to a supervision loop.
+
+    Non-elastic: any rank dying tears the pod down (fail-fast) and exits
+    non-zero — survivors blocked in a dead collective must not hang the
+    job forever.  ``--elastic``: the teardown is followed by re-forming
+    the mesh from surviving capacity and relaunching with the elastic
+    env contract; workers resume from the last committed checkpoint."""
+    nproc = args.nproc_per_node
+    n_ips = len([ip for ip in args.ips.split(",") if ip.strip()])
+    if args.elastic and n_ips > 1:
+        # this launcher supervises LOCAL trainers only; shrinking a
+        # multi-node job needs cross-host re-form coordination (every
+        # launcher must agree on the survivor set) — refuse rather than
+        # re-size the local pod against a global world it cannot see
+        sys.stderr.write(
+            "--elastic currently supervises a single node "
+            "(--ips with one host); multi-node elastic re-form needs "
+            "a cross-host coordinator (docs/elastic.md)\n")
+        return 2
+    logical_world = nproc * n_ips
+    restarts = 0
+    while True:
+        envs = {}
+        if args.elastic:
+            envs = {"PADDLE_TPU_ELASTIC": "1",
+                    "PADDLE_TPU_ELASTIC_LOGICAL_WORLD": str(logical_world),
+                    "PADDLE_TPU_ELASTIC_RESTART": str(restarts)}
+        cluster, procs = _spawn_pod(args, nproc, envs)
+        failed = []
+        try:
+            while True:
+                procs, _done, failed = poll_local_trainers(procs)
+                if failed or not procs:
+                    break
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            terminate_procs(procs, sigterm_grace=args.term_grace)
+            return 1
+        if not failed:
+            return 0
+        codes = {tp.rank: tp.proc.poll() for tp in failed}
+        # fail fast: peers of a dead rank are wedged in the next
+        # collective — tear the pod down (SIGTERM lets their preemption
+        # handlers checkpoint) instead of letting them hang
+        terminate_procs(procs + failed, sigterm_grace=args.term_grace)
+        survivors = nproc - len(failed)
+        if not args.elastic or restarts >= args.max_restarts:
+            sys.stderr.write(
+                f"trainer rank(s) {sorted(codes)} exited non-zero "
+                f"{codes}; pod terminated (elastic="
+                f"{bool(args.elastic)}, restarts={restarts})\n")
+            return 1
+        new_world = elastic_world_size(survivors, logical_world)
+        if new_world < 1:
+            sys.stderr.write("no surviving capacity to re-form the mesh\n")
+            return 1
+        sys.stderr.write(
+            f"elastic: rank(s) {sorted(codes)} lost ({codes}); re-forming "
+            f"mesh {nproc} -> {new_world} of logical {logical_world}, "
+            f"restart {restarts + 1}/{args.max_restarts}\n")
+        nproc = new_world
+        restarts += 1
 
 
 def launch_ps(args):

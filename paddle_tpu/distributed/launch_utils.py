@@ -17,7 +17,7 @@ from typing import List, Optional
 
 __all__ = ["Cluster", "Pod", "Trainer", "get_cluster",
            "start_local_trainers", "watch_local_trainers", "terminate_procs",
-           "find_free_ports"]
+           "poll_local_trainers", "find_free_ports"]
 
 
 class Trainer:
@@ -130,11 +130,20 @@ def start_local_trainers(cluster: Cluster, pod: Pod, training_script,
     return procs
 
 
-def terminate_procs(procs: List[TrainerProc]):
+def terminate_procs(procs: List[TrainerProc], sigterm_grace: float = 10.0):
+    """Tear a pod down with SIGTERM → grace → SIGKILL escalation.
+
+    SIGTERM first so every trainer's preemption handler gets to drain and
+    write its final checkpoint (CheckpointManager.install_preemption_
+    handler); any process still alive `sigterm_grace` seconds later is
+    SIGKILLed — a trainer wedged inside a dead collective never responds
+    to SIGTERM, and leaving it would hang the launcher forever.  Killed
+    children are always reaped (no zombies for a long-lived supervisor
+    that relaunches in a loop)."""
     for tp in procs:
         if tp.proc is not None and tp.proc.poll() is None:
             tp.proc.terminate()
-    deadline = time.time() + 10
+    deadline = time.time() + max(0.0, float(sigterm_grace))
     for tp in procs:
         if tp.proc is None:
             continue
@@ -142,21 +151,46 @@ def terminate_procs(procs: List[TrainerProc]):
             tp.proc.wait(max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:
             tp.proc.kill()
+            try:
+                tp.proc.wait(10)  # reap the SIGKILLed child
+            except subprocess.TimeoutExpired:  # pragma: no cover - kernel
+                pass
         if tp.log_fn:
             tp.log_fn.close()
+            tp.log_fn = None
 
 
-def watch_local_trainers(procs: List[TrainerProc], nranks) -> List[TrainerProc]:
-    """Poll children; on any failure kill the rest and raise (the watchdog,
-    launch_utils.py watch_local_trainers)."""
-    alive = []
+def poll_local_trainers(procs: List[TrainerProc]):
+    """One supervision tick: (alive, done, failed).  Exited trainers get
+    their workerlog handle closed here — a long-lived elastic supervisor
+    drops cleanly-finished ranks from its poll list every tick, and
+    nothing else would ever flush/close those fds."""
+    alive, done, failed = [], [], []
     for tp in procs:
         ret = tp.proc.poll()
         if ret is None:
             alive.append(tp)
-        elif ret != 0:
-            terminate_procs(procs)
-            raise RuntimeError(
-                f"trainer rank {tp.rank} exited with code {ret}; "
-                f"job aborted ({nranks} ranks)")
+        elif ret == 0:
+            done.append(tp)
+        else:
+            failed.append(tp)
+        if ret is not None and tp.log_fn:
+            tp.log_fn.close()
+            tp.log_fn = None
+    return alive, done, failed
+
+
+def watch_local_trainers(procs: List[TrainerProc], nranks) -> List[TrainerProc]:
+    """Poll children; on any non-zero exit FAIL FAST — kill the whole pod
+    (SIGTERM→grace→SIGKILL) and raise.  A dead rank's peers are blocked
+    inside the next collective and will never make progress; silently
+    dropping the dead rank and waiting on the survivors hangs the job
+    forever (the watchdog, launch_utils.py watch_local_trainers)."""
+    alive, _done, failed = poll_local_trainers(procs)
+    if failed:
+        terminate_procs(procs)
+        codes = {tp.rank: tp.proc.poll() for tp in failed}
+        raise RuntimeError(
+            f"trainer rank(s) {sorted(codes)} exited with code(s) "
+            f"{codes}; job aborted ({nranks} ranks)")
     return alive

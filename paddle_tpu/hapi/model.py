@@ -214,7 +214,16 @@ class Model:
         ``<save_dir>/checkpoints`` via paddle_tpu.checkpoint.
         ``resume=True`` restores the newest valid checkpoint and
         continues from the epoch after it — a killed run re-launched
-        with the same arguments picks up where it stopped."""
+        with the same arguments picks up where it stopped.
+
+        Preemption (docs/elastic.md): with ``save_dir`` set, a SIGTERM
+        mid-training commits the LAST COMPLETED epoch's state as a
+        final synchronous checkpoint before the process dies — even for
+        epochs ``save_freq`` skipped — so a preempted fit resumes at
+        that epoch boundary and the partial epoch replays (the same
+        round-down semantics as the static elastic tier).  The chaos
+        harness (``PADDLE_TPU_CHAOS`` kill directives, counted in
+        train batches here) covers this loop too."""
         loader = _make_loader(train_data, batch_size, shuffle, drop_last,
                               num_workers)
         eval_loader = _make_loader(eval_data, batch_size, False, False,
@@ -252,6 +261,16 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         history = []
+        # preemption: SIGTERM commits the newest EPOCH-BOUNDARY state
+        # (cached below after every epoch, not just save_freq ones) —
+        # a mid-epoch snapshot would resume at epoch+1 with half an
+        # epoch of extra updates baked in
+        epoch_cache = [None]
+        if ckpt_mgr is not None:
+            ckpt_mgr.set_state_provider(lambda: epoch_cache[0])
+            ckpt_mgr.install_preemption_handler()
+        from ..testing import chaos as _chaos
+        batches_done = 0
         try:
             for epoch in range(start_epoch, epochs):
                 if self.stop_training:
@@ -266,14 +285,25 @@ class Model:
                     res = self.train_batch(ins, lbls)
                     logs = dict(zip(["loss"] + self._metric_names(), res))
                     cbks.on_train_batch_end(step, logs)
+                    batches_done += 1
+                    _chaos.step_hook(batches_done)
                 cbks.on_epoch_end(epoch, logs)
                 history.append(logs)
-                if ckpt_mgr is not None and (
-                        (epoch + 1) % save_freq == 0 or
-                        epoch + 1 == epochs):
+                if ckpt_mgr is not None:
                     state, extra = self._fit_state()
                     extra["epoch"] = epoch
-                    ckpt_mgr.save(epoch, state, extra=extra)
+                    # cache BY VALUE: the state dict holds the live
+                    # parameter tensors, and the preemption save happens
+                    # batches later — an aliased cache would commit a
+                    # mid-epoch chimera labeled as this epoch
+                    epoch_cache[0] = (
+                        epoch,
+                        {k: np.array(v.numpy()) if hasattr(v, "numpy")
+                         else np.array(v) for k, v in state.items()},
+                        extra)
+                    if (epoch + 1) % save_freq == 0 or \
+                            epoch + 1 == epochs:
+                        ckpt_mgr.save(epoch, state, extra=extra)
                 if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                     self.evaluate(eval_loader, batch_size=batch_size,
                                   verbose=0, callbacks=callbacks)

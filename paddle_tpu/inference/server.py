@@ -76,8 +76,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _reply_error(self, code, err, headers=None):
-        self._reply(code, {"error": f"{type(err).__name__}: {err}",
-                           "type": type(err).__name__}, headers)
+        body = {"error": f"{type(err).__name__}: {err}",
+                "type": type(err).__name__}
+        retry = getattr(err, "retry_after_s", None)
+        if retry is not None:
+            # the header is RFC 7231 delta-seconds (integer, ceiling);
+            # the body carries the precise jittered hint so in-process
+            # clients keep sub-second decorrelation
+            body["retry_after_s"] = round(float(retry), 3)
+            headers = dict(headers or {})
+            headers.setdefault("Retry-After",
+                               str(max(1, int(-(-float(retry) // 1)))))
+        self._reply(code, body, headers)
 
     # -- routes -------------------------------------------------------------
     def do_GET(self):
@@ -142,8 +152,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply_error(400, e)
             return
         except QueueFullError as e:
-            self._reply_error(e.http_status, e, {
-                "Retry-After": f"{max(1, int(round(e.retry_after_s)))}"})
+            self._reply_error(e.http_status, e)
             return
         except BatcherError as e:
             self._reply_error(e.http_status, e)
@@ -188,9 +197,7 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(e, FuturesTimeout):
                 self._reply_error(504, e)
             elif isinstance(e, QueueFullError):
-                self._reply_error(e.http_status, e, {
-                    "Retry-After":
-                        f"{max(1, int(round(e.retry_after_s)))}"})
+                self._reply_error(e.http_status, e)
             elif isinstance(e, BatcherError):
                 self._reply_error(e.http_status, e)
             elif isinstance(e, ValueError):

@@ -320,6 +320,55 @@ def p_recv(ins, attrs, ctx):
                                             for s, d in perm])}
 
 
+@register_op("elastic_commit_mask", inputs=["X"], outputs=["Out"],
+             grad=None, side_effect=True)
+def elastic_commit_mask(ins, attrs, ctx):
+    """Commit mask for the elastic schedule (distributed/elastic.py):
+    True when the post-increment micro-step counter completes a window of
+    K = logical_dp / mesh-world micro-steps.  K is resolved HERE at trace
+    time, so the same program serves every world size; off-mesh the world
+    is 1 and a single process walks all N logical micro-steps."""
+    cnt = ins["X"]
+    n = int(attrs["logical_dp"])
+    axes = _axes(ctx, attrs)
+    m = 1
+    if axes:
+        ax = axes if isinstance(axes, str) else axes[0]
+        m = _axis_size(ax)
+    if m < 1 or n % m != 0:
+        raise ValueError(
+            f"elastic logical_dp={n} is not divisible by the mesh dp "
+            f"degree {m}; an elastic mesh must be a divisor of the "
+            "logical world")
+    k = n // m
+    return {"Out": jnp.mod(cnt, k) == 0}
+
+
+@register_op("c_elastic_fold", inputs=["X", "Acc"], outputs=["Out"],
+             grad=None, side_effect=True)
+def c_elastic_fold(ins, attrs, ctx):
+    """World-size-invariant ordered reduction (distributed/elastic.py):
+    all_gather the per-rank values, then continue an EXPLICIT unrolled
+    left-fold from the accumulator — micro-step j of an M-device mesh
+    adds logical ranks jM..jM+M-1 in rank order, so after a full window
+    the result is (((v0+v1)+v2)+...)+v_{N-1} for every factorization of
+    the logical world.  psum must not be used here: its reduction order
+    is implementation-defined and XLA may reassociate psum(a+b) into
+    psum(a)+psum(b), both of which break bitwise topology invariance.
+    Off-mesh this degrades to acc + x (a world of one logical rank per
+    micro-step)."""
+    x, acc = ins["X"], ins["Acc"]
+    axes = _axes(ctx, attrs)
+    if not axes:
+        return {"Out": acc + x}
+    ax = axes if isinstance(axes, str) else axes[0]
+    gathered = jax.lax.all_gather(x, ax, axis=0, tiled=False)
+    out = acc
+    for i in range(gathered.shape[0]):
+        out = out + gathered[i]
+    return {"Out": out}
+
+
 @register_op("scale_by_world_size", inputs=["X"], outputs=["Out"], grad=None,
              side_effect=True)
 def scale_by_world_size(ins, attrs, ctx):

@@ -38,6 +38,21 @@ __all__ = ["DynamicBatcher", "BatcherError", "QueueFullError",
            "DeadlineExceededError", "BatcherStoppedError"]
 
 
+def _jittered(seconds: float, spread: float = 0.5) -> float:
+    """`seconds` scaled by a uniform factor in [1-spread, 1+spread).
+
+    Backpressure hints MUST be decorrelated: when a load spike 503s a
+    thousand clients in the same scheduler tick, a deterministic
+    Retry-After synchronizes their retries into a thundering herd that
+    re-creates the exact spike that rejected them (and meets it with an
+    admission queue that drained in between — oscillation, not
+    convergence).  Full jitter is the standard fix (AWS architecture
+    blog, "Exponential Backoff and Jitter")."""
+    import random
+    return max(0.01, float(seconds) * (1.0 - spread + 2.0 * spread *
+                                       random.random()))
+
+
 class BatcherError(RuntimeError):
     """Base class for admission/scheduling failures; carries the HTTP
     status the server should surface."""
@@ -63,7 +78,13 @@ class DeadlineExceededError(BatcherError):
 class BatcherStoppedError(BatcherError):
     """Batcher is draining/stopped and admits no new work."""
     http_status = 503
-    retry_after_s = 1.0
+
+    def __init__(self, msg="batcher is not accepting work"):
+        super().__init__(msg)
+        # jittered, not a constant: a drain rejects every concurrent
+        # client at the same instant, and a fixed Retry-After marches
+        # them all back in lockstep against whichever replica takes over
+        self.retry_after_s = _jittered(1.0)
 
 
 class _Request:
@@ -192,9 +213,12 @@ class DynamicBatcher:
             if len(self._queue) >= self.max_queue:
                 metrics.count("requests.rejected")
                 # honest hint: time for the backlog to clear one queue
-                # at current batch geometry, floor 50ms
-                retry = max(0.05, self.max_wait_s *
-                            (len(self._queue) / max(1, self.max_batch)))
+                # at current batch geometry (load-scaled, floor 50ms),
+                # jittered so concurrently-rejected clients don't return
+                # as one synchronized wave
+                retry = _jittered(max(0.05, self.max_wait_s *
+                                      (len(self._queue) /
+                                       max(1, self.max_batch))))
                 raise QueueFullError(len(self._queue), retry)
             self._queue.append(req)
             metrics.count("requests.admitted")

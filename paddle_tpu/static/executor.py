@@ -29,6 +29,7 @@ from ..core.place import CPUPlace, XLAPlace, Place, _current_expected_place
 from ..core.dtype import np_dtype
 from ..core import compile_cache as _ccache
 from ..ops.registry import get_op_info, OpContext
+from ..testing import chaos as _chaos
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard",
            "as_numpy", "BlockTracer"]
@@ -249,6 +250,16 @@ class Executor:
         self._stats = {"hits": 0, "misses": 0, "traces": 0,
                        "bucket_hits": 0}
         self._step = 0
+        # chaos fault-injection step index (testing/chaos.py): counts
+        # TRAINING run()/run_steps() calls only (_chaos_step gates on
+        # _is_training, so startup/eval runs never shift the spec) —
+        # kill@<n> means "after the n-th train step"
+        self._train_runs = 0
+        # elastic micro-step count (distributed/elastic.py): unlike
+        # _step, this counts ONLY elastic CompiledProgram runs (startup/
+        # eval runs pollute _step), so global step = _elastic_steps // K
+        # is exact and survives topology-shifted restores
+        self._elastic_steps = 0
         # periodic checkpointing (enable_checkpointing): (manager,
         # program, every_n_steps, scope, last-saved-step)
         self._ckpt = None
@@ -275,6 +286,7 @@ class Executor:
             self._maybe_checkpoint(
                 program, scope or getattr(program, "_scope", None)
                 or global_scope())
+            self._chaos_step(program)
             return results
         if getattr(program, "_ps_server_config", None):
             # pserver program: exe.run(pserver_prog) == listen_and_serv
@@ -316,7 +328,29 @@ class Executor:
         if flag("check_nan_inf", False):
             self._check_nan_inf(fetch_names, results, scope)
         self._maybe_checkpoint(program, scope)
+        self._chaos_step(program)
         return results
+
+    def _chaos_step(self, program):
+        """Count this run toward the chaos step index ONLY when it was a
+        TRAINING run: the PADDLE_TPU_CHAOS contract is 'after the n-th
+        train step', and an interleaved eval/test-program run must not
+        shift the injected-fault positions.  Training-ness is cached on
+        the (unwrapped) program; everything here is skipped when chaos
+        is off."""
+        if not _chaos.enabled():
+            return
+        p = _unwrap_program(program)
+        cached = getattr(p, "_chaos_is_training", None)
+        if cached is None:
+            cached = isinstance(p, Program) and _is_training(p)
+            try:
+                p._chaos_is_training = cached
+            except (AttributeError, TypeError):  # exotic wrapper
+                pass
+        if cached:
+            self._train_runs += 1
+            _chaos.step_hook(self._train_runs)
 
     def _check_nan_inf(self, fetch_names, results, scope):
         """FLAGS_check_nan_inf (reference details/nan_inf_utils_detail —
@@ -716,6 +750,13 @@ class Executor:
         program = program or default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
+        if getattr(program, "_elastic_meta", None) is not None:
+            raise NotImplementedError(
+                "run_steps does not support elastic programs yet: the "
+                "scanned steps axis would fix the micro-step count at "
+                "trace time, defeating the world-size-resolved schedule "
+                "— drive elastic programs through run() "
+                "(distributed/elastic.py)")
         fetch_names = [v.name if hasattr(v, "name") else str(v)
                        for v in (fetch_list or [])]
         block = program.global_block()
@@ -792,6 +833,7 @@ class Executor:
         if flag("check_nan_inf", False):
             self._check_nan_inf(fetch_names, results, scope)
         self._maybe_checkpoint(program, scope)
+        self._chaos_step(program)
         return results
 
     def _compile_steps(self, program: Program, state_names, fetch_names):
@@ -1037,6 +1079,29 @@ class Executor:
         from ..core.generator import get_rng_state
         extra = {"executor_step": self._step, "rng": get_rng_state(),
                  "program_fingerprint": program.fingerprint()}
+        # topology-shift sidecars: enough for restore_from_checkpoint to
+        # convert layouts and re-derive schedule counters when the next
+        # incarnation of this job runs at a different world size
+        plan = getattr(program, "_zero_shard_plan", None)
+        if plan is not None and getattr(plan, "buckets", None):
+            extra["zero_shard_plan"] = plan.to_dict()
+            extra["dp_degree"] = int(plan.dp_degree)
+        el = getattr(program, "_elastic_meta", None)
+        if el is not None:
+            cnt = scope.get(el["counter"])
+            extra["elastic"] = {
+                "logical_dp": int(el["logical_dp"]),
+                "k": int(getattr(self, "_last_elastic_k", 1)),
+                "world": int(getattr(self, "_last_elastic_world", 1)),
+                "counter": el["counter"], "accs": list(el["accs"]),
+                # the program's own persistable micro counter is the
+                # authoritative schedule position (executor _step also
+                # counts startup/eval runs)
+                "counter_value": int(np.asarray(cnt).reshape(-1)[0])
+                if cnt is not None else self._elastic_steps}
+        gm = getattr(program, "_gm_meta", None)
+        if gm is not None:
+            extra["gradient_merge"] = dict(gm)
         pf = self._active_prefetcher
         if pf is not None:
             extra["dataset_position"] = pf.position
@@ -1081,47 +1146,78 @@ class Executor:
         hook.last = self._step
 
     def restore_from_checkpoint(self, manager, program=None, scope=None,
-                                step=None):
+                                step=None, world=None,
+                                on_mismatch="convert"):
         """Auto-resume: load the newest VALID checkpoint (corrupt ones are
         skipped by the manager), write the state back into the scope, and
         restore the executor step + RNG so per-step derived seeds replay
         identically.  Returns the restored step, or None when the
         checkpoint root is empty (fresh start).
 
+        Topology-shifted resume (docs/elastic.md): when the checkpoint's
+        program fingerprint differs from `program`'s because the
+        data-parallel world changed, the state is CONVERTED instead of
+        loaded as a chimera:
+
+          * ZeRO-1 shard-count mismatch — the checkpoint's recorded
+            ``ShardingPlan`` routes the bucket slots through
+            ``sharding.unshard_state`` → ``sharding.reshard_state`` for
+            the target program's plan (either side may also be plain);
+          * elastic programs (``distributed.elastic``) fingerprint
+            identically across worlds; their micro-step counter and
+            executor step are re-derived for the new K = N/world
+            (``world`` defaults to every local device, the same default
+            mesh CompiledProgram builds);
+          * ``gradient_merge`` counters are re-denominated when the
+            resumed program uses a different k; a mid-window position
+            rounds down to the last commit and replays the window.
+
+        ``on_mismatch``: "convert" (default) converts when it can and
+        warns otherwise; "error" raises ``CheckpointError`` on any
+        unconvertible fingerprint mismatch; "warn" restores the old
+        chimera behaviour with a warning only.
+
         The checkpoint's non-tensor sidecar survives on
         ``self.last_restored_extra`` — in particular
         ``extra["dataset_position"]`` (batches already consumed by the
-        interrupted run_prefetched loop), which the caller uses to
-        fast-forward its feed source::
+        interrupted run_prefetched loop; on an elastic shift it is
+        re-derived to GLOBAL batches, the unit `rebucket_feeds`
+        consumes), which the caller uses to fast-forward its feed
+        source::
 
             pos = (exe.last_restored_extra or {}).get("dataset_position", 0)
             for out in exe.run_prefetched(main, islice(feeds, pos, None)):
                 ...
         """
+        import warnings
+        if on_mismatch not in ("convert", "error", "warn"):
+            raise ValueError(
+                f"on_mismatch must be 'convert', 'error' or 'warn', "
+                f"got {on_mismatch!r}")
         ckpt = manager.load(step=step)
         if ckpt is None:
             self.last_restored_extra = None
             return None
         scope = scope or global_scope()
-        extra = ckpt.extra
+        extra = dict(ckpt.extra)
+        state = dict(ckpt.state)
+        target = _unwrap_program(program) if program is not None else None
         saved_fp = extra.get("program_fingerprint")
-        if program is not None and saved_fp is not None:
-            target_fp = _unwrap_program(program).fingerprint()
-            if target_fp != saved_fp:
-                import warnings
-                warnings.warn(
-                    "restoring a checkpoint saved from a DIFFERENT "
-                    "program (fingerprint mismatch): vars absent from "
-                    "the checkpoint keep their fresh-init values and "
-                    "orphan checkpoint vars are still written — resumed "
-                    "training may diverge from the original run",
-                    RuntimeWarning, stacklevel=2)
-        for name, val in ckpt.state.items():
+        if target is not None and saved_fp is not None and \
+                target.fingerprint() != saved_fp:
+            state = self._convert_topology_shift(
+                state, extra, target, on_mismatch)
+        for name, val in state.items():
             # jnp.array (copy), never jnp.asarray: a zero-copy alias of
             # host memory would be donated to XLA by the next step's
             # donate_argnums and freed/reused out from under numpy
             scope.set(name, jnp.array(val))
         self._step = int(extra.get("executor_step", ckpt.step))
+        # schedule re-derivation: elastic K and gradient-merge k counters
+        # are denominated in micro-steps whose meaning changes with the
+        # world / the rebuilt program
+        self._rederive_elastic(target, scope, extra, world)
+        self._rederive_gradient_merge(target, scope, extra, warnings)
         if self._ckpt is not None:
             # enable-then-restore ordering: re-anchor the last-saved
             # marker so the next run doesn't immediately re-save the
@@ -1132,6 +1228,168 @@ class Executor:
             set_rng_state(extra["rng"])
         self.last_restored_extra = dict(extra)
         return ckpt.step
+
+    def _convert_topology_shift(self, state, extra, target, on_mismatch):
+        """Fingerprint mismatch triage: convert ZeRO-1 layouts when the
+        plans are recorded, otherwise warn (or raise under 'error')."""
+        import warnings
+        saved_plan = extra.get("zero_shard_plan")
+        tgt_plan = getattr(target, "_zero_shard_plan", None)
+        if tgt_plan is not None and not getattr(tgt_plan, "buckets", None):
+            tgt_plan = None
+        if saved_plan or tgt_plan is not None:
+            from ..distributed.sharding import (reshard_state,
+                                                unshard_state)
+            src_dp = (saved_plan or {}).get("dp_degree", 1)
+            tgt_dp = tgt_plan.dp_degree if tgt_plan is not None else 1
+            try:
+                converted = state
+                if saved_plan:
+                    converted = unshard_state(converted, saved_plan)
+                if tgt_plan is not None:
+                    converted = reshard_state(converted, tgt_plan)
+            except (ValueError, KeyError) as e:
+                # colliding names with different shapes etc. — the two
+                # programs differ beyond their shard layout and the
+                # relayout itself is impossible
+                if on_mismatch == "error":
+                    from ..checkpoint import CheckpointError
+                    raise CheckpointError(
+                        "fingerprint mismatch is not a pure ZeRO-1 "
+                        f"shard-count change (layout conversion failed: "
+                        f"{e}) — refusing the chimera restore "
+                        "(on_mismatch='error')") from e
+                warnings.warn(
+                    "restoring a checkpoint saved from a DIFFERENT "
+                    f"program (fingerprint mismatch): ZeRO-1 layout "
+                    f"conversion dp={src_dp} -> dp={tgt_dp} FAILED "
+                    f"({e}); loading the unconverted state — resumed "
+                    "training may diverge (pass on_mismatch='error' "
+                    "to refuse)", RuntimeWarning, stacklevel=3)
+                return state
+            state = converted
+            # a PURE shard-count shift converts completely: every target
+            # persistable is in the converted state.  Leftover holes mean
+            # the programs differ beyond sharding — that is still a
+            # chimera, and 'error' must refuse it even though a plan
+            # existed
+            missing = [n for n in _persistable_names(target)
+                       if n not in state]
+            if missing:
+                if on_mismatch == "error":
+                    from ..checkpoint import CheckpointError
+                    raise CheckpointError(
+                        "fingerprint mismatch is not a pure ZeRO-1 "
+                        "shard-count change: after layout conversion "
+                        f"the checkpoint still lacks {missing[:8]}"
+                        f"{'...' if len(missing) > 8 else ''} — "
+                        "refusing the chimera restore "
+                        "(on_mismatch='error')")
+                warnings.warn(
+                    "restoring a checkpoint saved from a DIFFERENT "
+                    "program (fingerprint mismatch): converted the "
+                    f"ZeRO-1 layout dp={src_dp} -> dp={tgt_dp}, but "
+                    f"{len(missing)} target vars are still absent and "
+                    "keep their fresh-init values — resumed training "
+                    "may diverge (pass on_mismatch='error' to refuse)",
+                    RuntimeWarning, stacklevel=3)
+                return state
+            warnings.warn(
+                "restoring a checkpoint saved from a DIFFERENT program "
+                "(fingerprint mismatch): automatically converted the "
+                f"ZeRO-1 optimizer-state layout dp={src_dp} -> "
+                f"dp={tgt_dp} (unshard_state -> reshard_state); "
+                "training resumes on the re-bucketed state",
+                RuntimeWarning, stacklevel=3)
+            return state
+        if on_mismatch == "error":
+            from ..checkpoint import CheckpointError
+            raise CheckpointError(
+                "checkpoint program fingerprint does not match the "
+                "target program and no recorded sharding plan makes the "
+                "difference convertible; pass on_mismatch='warn' to "
+                "force the (diverging) chimera restore")
+        warnings.warn(
+            "restoring a checkpoint saved from a DIFFERENT "
+            "program (fingerprint mismatch): vars absent from "
+            "the checkpoint keep their fresh-init values and "
+            "orphan checkpoint vars are still written — resumed "
+            "training may diverge from the original run "
+            "(pass on_mismatch='error' to refuse chimera loads)",
+            RuntimeWarning, stacklevel=3)
+        return state
+
+    def _rederive_elastic(self, target, scope, extra, world):
+        """Elastic schedule position -> the new world's denomination."""
+        el_meta = getattr(target, "_elastic_meta", None) \
+            if target is not None else None
+        if el_meta is None or "elastic" not in extra:
+            return
+        import jax as _jax
+        from ..distributed.elastic import rederive_schedule
+        new_world = int(world) if world else len(_jax.devices())
+        red = rederive_schedule(extra, new_world)
+        if red is None:
+            return
+        self._step = red["executor_step"]
+        self._elastic_steps = red["executor_step"]
+        self._last_elastic_k = red["k_new"]
+        self._last_elastic_world = new_world
+        # CompiledProgram re-anchors for its ACTUAL mesh on first run —
+        # `world` here is only the best-effort default (all devices)
+        self._elastic_rebase_global = red["global_step"]
+        scope.set(el_meta["counter"],
+                  jnp.array(np.full((1,), red["counter_value"], np.int32)))
+        if red["replayed_micro"]:
+            for acc in el_meta["accs"]:
+                v = scope.get(acc)
+                if v is not None:
+                    scope.set(acc, jnp.zeros_like(jnp.asarray(v)))
+        if "dataset_position" in extra:
+            # GLOBAL batches, not micro-feeds: the elastic feeding
+            # pattern is rebucket_feeds over global batches, and the
+            # actual mesh (hence K) may differ from the `world` default
+            # used here — a K-denominated position would go stale the
+            # moment CompiledProgram re-anchors for its real mesh
+            extra["dataset_position"] = red["global_batches_consumed"]
+        extra["global_step"] = red["global_step"]
+
+    def _rederive_gradient_merge(self, target, scope, extra, warnings):
+        """gradient_merge counter k_old -> k_new re-denomination (global
+        batch preserved across a world change by scaling k)."""
+        tgt_gm = getattr(target, "_gm_meta", None) \
+            if target is not None else None
+        saved_gm = extra.get("gradient_merge")
+        if tgt_gm is None or not saved_gm:
+            return
+        k_old = max(1, int(saved_gm.get("k", 1)))
+        k_new = max(1, int(tgt_gm.get("k", 1)))
+        same_names = saved_gm.get("counter") == tgt_gm.get("counter")
+        if k_old == k_new and same_names:
+            return  # identical schedule: restored state is already right
+        cnt = scope.get(saved_gm.get("counter")) \
+            if saved_gm.get("counter") else None
+        old_count = int(np.asarray(cnt).reshape(-1)[0]) \
+            if cnt is not None else 0
+        commits, j = divmod(old_count, k_old)
+        if j:
+            warnings.warn(
+                f"gradient_merge resume mid-window (micro {j}/{k_old}): "
+                f"rounding down to commit {commits}; the partial window "
+                "replays and its accumulators are reset", RuntimeWarning,
+                stacklevel=3)
+        scope.set(tgt_gm["counter"],
+                  jnp.array(np.full((1,), commits * k_new, np.int32)))
+        for acc in tgt_gm.get("accs", []):
+            v = scope.get(acc)
+            if v is not None and (j or not same_names):
+                scope.set(acc, jnp.zeros_like(jnp.asarray(v)))
+        if "dataset_position" in extra:
+            # the discarded j mid-window micro-batches must REPLAY, not
+            # be skipped: re-derive the feed position to the commit
+            # boundary in the NEW k's denomination (one batch per
+            # micro-step), like the elastic path does
+            extra["dataset_position"] = commits * k_new
 
     # -- helpers ------------------------------------------------------------
     def _coerce_feed(self, block, name, val):

@@ -1,0 +1,4 @@
+"""paddle_tpu.testing — fault-injection and test harness utilities."""
+from . import chaos  # noqa: F401
+
+__all__ = ["chaos"]

@@ -1,0 +1,131 @@
+"""Elastic training worker for the launch/chaos e2e tests.
+
+One "host" of the simulated fleet: builds the elasticized toy model
+(logical_dp=8), auto-resumes from its checkpoint root via the
+topology-shifted restore, trains the remaining global steps on a mesh of
+``world`` devices feeding re-bucketed micro-batches, and writes a JSON
+report.  ``PADDLE_TPU_CHAOS`` may kill it at any micro-step — that is
+the point.
+
+Usage:
+  python elastic_worker.py <ckpt_root> <out_json> <world> <total_steps>
+
+With no argv (launcher mode) everything comes from the launcher env
+contract: rank from PADDLE_TRAINER_ID, world = 4 * PADDLE_TRAINERS_NUM
+(each "host" owns 4 of the logical 8 chips), restart counter from
+PADDLE_TPU_ELASTIC_RESTART, paths from PADDLE_TPU_ELASTIC_TEST_DIR.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOGICAL = 8
+
+# standalone invocations need the virtual 8-device CPU mesh too (under
+# pytest the conftest already exported this); must happen before jax
+# initializes its backends
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={LOGICAL}").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_elastic():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.distributed.elastic import elasticize
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    meta = elasticize(main, startup, logical_dp=LOGICAL, loss_name=loss)
+    return main, startup, loss, meta
+
+
+def feeds_for(total_steps):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(LOGICAL, 8).astype(np.float32),
+             "y": rng.rand(LOGICAL, 1).astype(np.float32)}
+            for _ in range(total_steps)]
+
+
+def run(ckpt_root, out_json, world, total_steps):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    from paddle_tpu.distributed.elastic import rebucket_feeds
+
+    world = int(world)
+    total_steps = int(total_steps)
+    k = LOGICAL // world
+    main, startup, loss, meta = build_elastic()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(ckpt_root)
+    mgr.install_preemption_handler()  # SIGTERM -> final sync checkpoint
+    g = 0
+    with static.scope_guard(scope):
+        exe.run(startup)
+        # commit cadence = one checkpoint per GLOBAL step (K micro-steps)
+        exe.enable_checkpointing(mgr, program=main, every_n_steps=k,
+                                 scope=scope)
+        resumed = exe.restore_from_checkpoint(mgr, program=main,
+                                              scope=scope, world=world)
+        if resumed is not None:
+            g = int(exe.last_restored_extra.get("global_step", 0))
+        cp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, places=list(jax.devices())[:world])
+        losses = {}
+        for gi, f in enumerate(feeds_for(total_steps)[g:], start=g):
+            for mf in rebucket_feeds(f, LOGICAL, world):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            losses[gi] = float(np.asarray(out[0]).reshape(-1)[0])
+        params = {p.name: np.asarray(scope.get(p.name)).tolist()
+                  for p in main.all_parameters()}
+    mgr.close()
+    report = {
+        "rank": int(os.environ.get("PADDLE_TRAINER_ID", 0)),
+        "world": world,
+        "restart": int(os.environ.get("PADDLE_TPU_ELASTIC_RESTART", 0)),
+        "elastic_env": os.environ.get("PADDLE_TPU_ELASTIC"),
+        "logical_env": os.environ.get("PADDLE_TPU_ELASTIC_LOGICAL_WORLD"),
+        "resumed_global": g,
+        "losses": losses,
+        "params": params,
+    }
+    tmp = out_json + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f)
+    os.replace(tmp, out_json)
+    return 0
+
+
+def main():
+    if len(sys.argv) >= 5:
+        return run(sys.argv[1], sys.argv[2], sys.argv[3], sys.argv[4])
+    # launcher mode: everything from the env contract
+    base = os.environ["PADDLE_TPU_ELASTIC_TEST_DIR"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    world = min(LOGICAL, 4 * nranks)  # each "host" owns 4 logical chips
+    restart = int(os.environ.get("PADDLE_TPU_ELASTIC_RESTART", 0))
+    return run(os.path.join(base, f"ckpt_rank{rank}"),
+               os.path.join(base, f"out_rank{rank}_r{restart}.json"),
+               world, int(os.environ.get("ELASTIC_TOTAL_STEPS", 4)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

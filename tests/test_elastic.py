@@ -1,0 +1,439 @@
+"""Elastic training (distributed/elastic.py + testing/chaos.py + launch).
+
+The ROADMAP "Done =" condition: kill/resume 8→4→8 devices on the CPU
+mesh with a loss trace BITWISE-equal to an uninterrupted run after the
+schedule re-converges.  Tier-1 keeps the cheap schedule/harness units
+(the single-shrink integration gate lives in tests/test_elastic_smoke.py
+via tools/elastic_smoke.py); the full chaos-driven kill/shrink/regrow
+matrix and the launcher supervision loop are marked ``slow``.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.core.program import _reset_unique_names
+from paddle_tpu.distributed.elastic import (
+    elasticize, elastic_meta, micro_steps_per_global, rebucket_feeds,
+    rederive_schedule, reanchor_topology)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _build_plain():
+    from paddle_tpu.static import layers
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# schedule units (tier-1)
+# ---------------------------------------------------------------------------
+def test_rebucket_feeds_preserves_row_order():
+    feed = {"x": np.arange(16).reshape(8, 2), "lr": np.float32(0.1)}
+    micro = rebucket_feeds(feed, 8, 2)  # K = 4 micro-feeds of 2 rows
+    assert len(micro) == 4
+    got = np.concatenate([m["x"] for m in micro], axis=0)
+    np.testing.assert_array_equal(got, feed["x"])  # same global row order
+    assert all(m["lr"] == np.float32(0.1) for m in micro)  # replicated
+    assert [m["x"].shape[0] for m in micro] == [2, 2, 2, 2]
+    # K = 1 passthrough
+    assert rebucket_feeds(feed, 8, 8)[0]["x"].shape == (8, 2)
+    with pytest.raises(ValueError):
+        rebucket_feeds(feed, 8, 3)  # 3 does not divide 8
+    # a lone big non-batch feed (lookup table) must not hijack the batch
+    # axis: the MOST COMMON leading dim wins and the table replicates
+    mixed = {"x": np.zeros((8, 2)), "y": np.zeros((8, 1)),
+             "table": np.zeros((1024, 4))}
+    out = rebucket_feeds(mixed, 8, 2)
+    assert out[0]["x"].shape == (2, 2) and out[0]["table"].shape == \
+        (1024, 4)
+    # ambiguous tie demands an explicit batch_rows
+    amb = {"x": np.zeros((8, 2)), "t": np.zeros((6, 2))}
+    with pytest.raises(ValueError, match="ambiguous"):
+        rebucket_feeds(amb, 8, 2)
+    out = rebucket_feeds(amb, 8, 2, batch_rows=8)
+    assert out[0]["x"].shape == (2, 2) and out[0]["t"].shape == (6, 2)
+    # a non-divisible batch fails loudly instead of replicating rows
+    with pytest.raises(ValueError, match="not divisible"):
+        rebucket_feeds({"x": np.zeros((10, 2))}, 8, 2)
+
+
+def test_rederive_schedule_boundary_and_midwindow():
+    extra = {"executor_step": 99,  # polluted; counter_value wins
+             "elastic": {"logical_dp": 8, "k": 2, "counter_value": 6}}
+    red = rederive_schedule(extra, new_world=8)  # 4 -> 8 regrow
+    assert red["global_step"] == 3 and red["k_new"] == 1
+    assert red["executor_step"] == 3 and red["counter_value"] == 3
+    assert red["replayed_micro"] == 0
+    # mid-window: micro 7 under k=2 rounds down to global 3 and replays
+    extra["elastic"]["counter_value"] = 7
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        red = rederive_schedule(extra, new_world=4)
+    assert red["global_step"] == 3 and red["replayed_micro"] == 1
+    assert red["executor_step"] == 6  # 3 windows * k_new=2
+    assert any("mid-window" in str(w.message) for w in caught)
+    # world must divide the logical world
+    with pytest.raises(ValueError):
+        rederive_schedule(extra, new_world=3)
+    assert rederive_schedule({}, 4) is None  # no elastic sidecar
+
+
+def test_micro_steps_per_global_and_meta():
+    main, startup, loss = _build_plain()
+    assert elastic_meta(main) is None
+    meta = elasticize(main, startup, logical_dp=8, loss_name=loss)
+    assert elastic_meta(main) is meta
+    assert micro_steps_per_global(main, 8) == 1
+    assert micro_steps_per_global(main, 2) == 4
+    with pytest.raises(ValueError):
+        micro_steps_per_global(main, 3)
+    assert meta["loss_avg"].endswith("@ELASTIC_AVG")
+    assert len(meta["accs"]) == 5  # 4 param grads + the loss fold
+
+
+def test_elasticize_guards():
+    main, startup, loss = _build_plain()
+    with pytest.raises(ValueError):
+        elasticize(main, startup, logical_dp=6, loss_name=loss)  # not pow2
+    elasticize(main, startup, logical_dp=8, loss_name=loss)
+    with pytest.raises(ValueError):
+        elasticize(main, startup, logical_dp=8)  # double apply
+    # programs without recorded param/grad pairs are rejected loudly
+    main2, startup2 = static.Program(), static.Program()
+    with pytest.raises(ValueError):
+        elasticize(main2, startup2, logical_dp=8)
+
+
+def test_run_steps_refuses_elastic_programs():
+    main, startup, loss = _build_plain()
+    elasticize(main, startup, logical_dp=8, loss_name=loss)
+    exe = static.Executor()
+    with pytest.raises(NotImplementedError, match="elastic"):
+        exe.run_steps(main, feed={"x": np.zeros((2, 4, 8), np.float32)})
+
+
+def test_elasticize_rejects_zero1_composition():
+    import jax
+    from paddle_tpu.distributed.sharding import shard_optimizer_states
+    main, startup, loss = _build_plain()
+    plan = shard_optimizer_states(main, startup, dp_degree=8)
+    assert plan.buckets
+    with pytest.raises(NotImplementedError, match="ZeRO"):
+        elasticize(main, startup, logical_dp=8, loss_name=loss)
+
+
+def test_elastic_world_size_rounds_to_pow2_divisor():
+    from paddle_tpu.distributed.launch import elastic_world_size
+    assert elastic_world_size(8, 8) == 8
+    assert elastic_world_size(7, 8) == 4  # odd survivor count -> 4
+    assert elastic_world_size(3, 8) == 2
+    assert elastic_world_size(1, 8) == 1
+    assert elastic_world_size(0, 8) == 0
+    assert elastic_world_size(6, 4) == 4  # capped by the logical world
+
+
+def test_elastic_fold_and_mask_kernels_off_mesh():
+    """Kernel degradation contract: off-mesh (no collective axes) the
+    c_elastic_fold op is acc + x (one logical rank per micro-step) and
+    elastic_commit_mask resolves K = logical_dp — a single process walks
+    all N micro-steps of a window."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op_info, OpContext
+    ctx = OpContext(dist_info={0: None})
+    fold = get_op_info("c_elastic_fold").kernel
+    acc = jnp.zeros(3, jnp.float32)
+    for i in range(3):
+        acc = fold({"X": jnp.full(3, float(i + 1), jnp.float32),
+                    "Acc": acc}, {"ring_id": 0, "logical_dp": 8}, ctx)["Out"]
+    np.testing.assert_array_equal(np.asarray(acc), np.full(3, 6.0))
+    mask = get_op_info("elastic_commit_mask").kernel
+    got = [bool(np.asarray(mask({"X": jnp.array([c], jnp.int32)},
+                                {"ring_id": 0, "logical_dp": 4},
+                                ctx)["Out"])[0]) for c in range(1, 9)]
+    # off-mesh K = 4: commits after micro-steps 4 and 8
+    assert got == [False, False, False, True, False, False, False, True]
+
+
+# ---------------------------------------------------------------------------
+# chaos harness units (tier-1)
+# ---------------------------------------------------------------------------
+def test_chaos_spec_parsing(monkeypatch):
+    from paddle_tpu.testing import chaos
+    monkeypatch.setenv(chaos.CHAOS_ENV,
+                       "kill@5:rank=1:signal=term; slow_save=0.25; "
+                       "torn_save@3; collective_fail@2:times=3")
+    chaos.reload()
+    assert chaos.enabled()
+    kinds = {d.kind: d for d in chaos._directives()}
+    assert kinds["kill"].step == 5 and kinds["kill"].rank == 1
+    assert kinds["kill"].sig == signal.SIGTERM
+    assert kinds["slow_save"].seconds == 0.25
+    assert kinds["torn_save"].step == 3
+    assert kinds["collective_fail"].times == 3
+    monkeypatch.setenv(chaos.CHAOS_ENV, "explode@7")
+    with pytest.raises(ValueError, match="unknown"):
+        chaos.reload()
+    monkeypatch.setenv(chaos.CHAOS_ENV, "")
+    chaos.reload()
+    assert not chaos.enabled()
+
+
+def test_chaos_kill_respects_rank_filter(monkeypatch):
+    from paddle_tpu.testing import chaos
+    # a directive for rank 1 must be inert on rank 0 (this process)
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv(chaos.CHAOS_ENV, "kill@1:rank=0")
+    chaos.reload()
+    chaos.step_hook(1)  # rank mismatch: no kill — we are still alive
+    monkeypatch.setenv(chaos.CHAOS_ENV, "kill@2:rank=1")
+    chaos.reload()
+    chaos.step_hook(1)  # step mismatch: alive
+
+
+def test_chaos_collective_fail_injects_then_recovers(monkeypatch):
+    """A transient collective failure surfaces as ChaosCollectiveError
+    from the dispatch; the retry (same step) proceeds and training
+    continues unaffected."""
+    import jax
+    from paddle_tpu.testing import chaos
+    from paddle_tpu.testing.chaos import ChaosCollectiveError
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    main, startup, loss = _build_plain()
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 8).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+    monkeypatch.setenv(chaos.CHAOS_ENV, "collective_fail@1:times=1")
+    chaos.reload()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ChaosCollectiveError):
+            exe.run(cp, feed=feed, fetch_list=[loss])
+        # transient: the retry of the SAME step goes through
+        out = exe.run(cp, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+    monkeypatch.setenv(chaos.CHAOS_ENV, "")
+    chaos.reload()
+
+
+# ---------------------------------------------------------------------------
+# supervision units (tier-1)
+# ---------------------------------------------------------------------------
+def _mk_proc(code, rank):
+    from paddle_tpu.distributed.launch_utils import TrainerProc
+    tp = TrainerProc()
+    tp.proc = subprocess.Popen([sys.executable, "-c", code])
+    tp.rank = rank
+    return tp
+
+
+def test_watchdog_fails_fast_and_kills_peers():
+    """A non-zero rank exit must terminate the pod and raise — the peers
+    are wedged in the next collective, not 'still healthy'."""
+    from paddle_tpu.distributed.launch_utils import (poll_local_trainers,
+                                                     watch_local_trainers)
+    dead = _mk_proc("raise SystemExit(3)", rank=0)
+    sleeper = _mk_proc("import time; time.sleep(60)", rank=1)
+    dead.proc.wait()
+    procs = [dead, sleeper]
+    alive, done, failed = poll_local_trainers(procs)
+    assert [tp.rank for tp in failed] == [0]
+    assert [tp.rank for tp in alive] == [1]
+    with pytest.raises(RuntimeError, match="rank"):
+        watch_local_trainers(procs, 2)
+    assert sleeper.proc.poll() is not None  # peer was torn down
+
+
+def test_terminate_escalates_sigterm_to_sigkill():
+    """A proc ignoring SIGTERM (wedged in a dead collective) must be
+    SIGKILLed after the grace window — and reaped."""
+    from paddle_tpu.distributed.launch_utils import terminate_procs
+    tp = _mk_proc(
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "print('armed', flush=True)\n"
+        "time.sleep(60)\n", rank=0)
+    time.sleep(1.0)  # let the child install SIG_IGN
+    t0 = time.time()
+    terminate_procs([tp], sigterm_grace=0.5)
+    took = time.time() - t0
+    assert tp.proc.poll() == -signal.SIGKILL
+    assert took < 30
+
+
+# ---------------------------------------------------------------------------
+# kill / shrink / regrow (slow)
+# ---------------------------------------------------------------------------
+def _worker_env(**chaos):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_CHAOS", None)
+    env.update(chaos)
+    return env
+
+
+def _run_worker(root, out, world, steps, env=None, timeout=300):
+    return subprocess.run(
+        [sys.executable, WORKER, root, out, str(world), str(steps)],
+        env=env or _worker_env(), capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.mark.slow
+def test_chaos_kill_shrink_regrow_bitwise(tmp_path):
+    """THE acceptance scenario: 8 -> (SIGKILL) -> 4 -> (SIGTERM mid-
+    window) -> 8, driven end-to-end by the chaos harness across real
+    process restarts, with the loss trace and final params BITWISE equal
+    to an uninterrupted 8-device run."""
+    steps = 5
+    root = str(tmp_path / "ckpts")
+    # uninterrupted reference (its own root; no checkpoints consulted)
+    ref_out = str(tmp_path / "ref.json")
+    p = _run_worker(str(tmp_path / "ref_ckpts"), ref_out, 8, steps)
+    assert p.returncode == 0, p.stderr[-3000:]
+    ref = json.load(open(ref_out))
+    assert sorted(map(int, ref["losses"])) == list(range(steps))
+
+    # phase A: full world, hard-killed (preempted host: no goodbye)
+    # after 2 global steps (train-run counter, startup not counted)
+    outA = str(tmp_path / "a.json")
+    p = _run_worker(root, outA, 8, steps,
+                    env=_worker_env(PADDLE_TPU_CHAOS="kill@2"))
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr[-2000:])
+    assert not os.path.exists(outA)  # died mid-run
+
+    # phase B: resume on 4 devices (K=2); graceful SIGTERM mid-window —
+    # train-run 3 of this process is the FIRST micro-step of global 3
+    outB = str(tmp_path / "b.json")
+    p = _run_worker(root, outB, 4, steps,
+                    env=_worker_env(PADDLE_TPU_CHAOS="kill@3:signal=term"))
+    assert p.returncode == 143, (p.returncode, p.stderr[-2000:])
+    assert not os.path.exists(outB)
+
+    # phase C: the fleet is back — regrow to 8, run to completion.  The
+    # exact resume point depends on which async save the SIGKILL raced
+    # (that is the point of the chaos harness); what is CONTRACTUAL is
+    # that some committed step survived, a mid-window SIGTERM save
+    # rounds down and replays, and the final math is bitwise-identical.
+    outC = str(tmp_path / "c.json")
+    p = _run_worker(root, outC, 8, steps)
+    assert p.returncode == 0, p.stderr[-3000:]
+    c = json.load(open(outC))
+    assert 1 <= c["resumed_global"] < steps, c["resumed_global"]
+
+    # bitwise: every global step phase C recomputed matches the
+    # uninterrupted trace, and the final params are identical
+    for gi, lv in c["losses"].items():
+        assert np.float32(lv) == np.float32(ref["losses"][gi]), gi
+    for name, want in ref["params"].items():
+        np.testing.assert_array_equal(
+            np.asarray(want, np.float32), np.asarray(c["params"][name],
+                                                     np.float32),
+            err_msg=name)
+
+
+@pytest.mark.slow
+def test_inprocess_shrink_regrow_matrix_bitwise():
+    """8 -> 2 -> 4 -> 8 live re-anchoring (no checkpoint round-trip):
+    reanchor_topology re-derives the schedule between phases and every
+    factorization folds in the same order."""
+    import jax
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    steps_phase = [(8, 1), (2, 1), (4, 1), (8, 1)]
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(8, 8).astype(np.float32),
+              "y": rng.rand(8, 1).astype(np.float32)} for _ in range(4)]
+
+    def run(phases):
+        main, startup, loss = _build_plain()
+        meta = elasticize(main, startup, logical_dp=8, loss_name=loss)
+        exe = static.Executor()
+        scope = static.Scope()
+        trace, g, first = [], 0, True
+        with static.scope_guard(scope):
+            exe.run(startup)
+            for world, ngs in phases:
+                if not first:
+                    reanchor_topology(exe, main, scope, world)
+                first = False
+                cp = CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name,
+                    places=list(jax.devices())[:world])
+                for _ in range(ngs):
+                    for mf in rebucket_feeds(feeds[g], 8, world):
+                        out = exe.run(cp, feed=mf,
+                                      fetch_list=[meta["loss_avg"]])
+                    trace.append(np.asarray(out[0]))
+                    g += 1
+            params = {p.name: np.asarray(scope.get(p.name))
+                      for p in main.all_parameters()}
+        return trace, params
+
+    ref_trace, ref_params = run([(8, 4)])
+    got_trace, got_params = run(steps_phase)
+    for i, (a, b) in enumerate(zip(ref_trace, got_trace)):
+        assert np.array_equal(a, b), f"loss diverged at global step {i}"
+    for k in ref_params:
+        np.testing.assert_array_equal(ref_params[k], got_params[k],
+                                      err_msg=k)
+
+
+@pytest.mark.slow
+def test_launcher_elastic_supervision_end_to_end(tmp_path, monkeypatch):
+    """Lost-host story through the real launcher: rank 1 chaos-dies, the
+    supervisor tears the pod down fail-fast (rank 0's SIGTERM preemption
+    handler checkpoints), re-forms the mesh from the survivor and
+    relaunches with the elastic env contract; the relaunched worker
+    resumes on the shrunk world and finishes the schedule bitwise."""
+    from paddle_tpu.distributed import launch
+    steps = 4
+    base = str(tmp_path)
+    monkeypatch.setenv("PADDLE_TPU_ELASTIC_TEST_DIR", base)
+    monkeypatch.setenv("ELASTIC_TOTAL_STEPS", str(steps))
+    # rank 1 dies after 2 train steps; the relaunched pod has no rank 1
+    monkeypatch.setenv("PADDLE_TPU_CHAOS", "kill@2:rank=1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    rc = launch.main(["--elastic", "--max_restarts", "2",
+                      "--nproc_per_node", "2", "--term_grace", "30",
+                      "--log_dir", os.path.join(base, "logs"), WORKER])
+    assert rc == 0
+
+    # restart 1 = the re-formed pod: one surviving "host" = world 4
+    out = os.path.join(base, "out_rank0_r1.json")
+    assert os.path.exists(out), os.listdir(base)
+    rep = json.load(open(out))
+    assert rep["restart"] == 1 and rep["world"] == 4
+    assert rep["elastic_env"] == "1" and rep["logical_env"] == "2"
+    assert rep["resumed_global"] >= 1  # resumed from rank 0's preemption
+    #  or periodic checkpoint, not from scratch
+
+    # and the finished schedule matches an uninterrupted reference
+    ref_out = os.path.join(base, "ref.json")
+    p = _run_worker(os.path.join(base, "ref_ckpts"), ref_out, 8, steps)
+    assert p.returncode == 0, p.stderr[-3000:]
+    ref = json.load(open(ref_out))
+    for gi, lv in rep["losses"].items():
+        assert np.float32(lv) == np.float32(ref["losses"][gi]), gi
+    for name, want in ref["params"].items():
+        np.testing.assert_array_equal(
+            np.asarray(want, np.float32),
+            np.asarray(rep["params"][name], np.float32), err_msg=name)

@@ -1,0 +1,47 @@
+"""Tier-1 elastic-resume gate (NOT marked slow — losing the ability to
+resume a preempted job on a shrunk mesh must fail the suite, not wait
+for the next real preemption).
+
+Drives tools/elastic_smoke.py: elasticized training on the full
+8-device mesh with per-step checkpoints, "kill", topology-shifted
+restore onto 4 devices, continue on re-bucketed micro-feeds — loss
+trace and params must be BITWISE equal to the uninterrupted run.  The
+full chaos-driven 8→4→8 kill/shrink/regrow matrix is in
+tests/test_elastic.py (slow).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_elastic_smoke_gate(tmp_path):
+    import elastic_smoke
+    result = elastic_smoke.run_smoke(steps=4, kill_at=2,
+                                     root=str(tmp_path / "ckpts"))
+    assert result["bitwise_loss_trace"] is True, result
+    assert result["bitwise_params"] is True, result
+    assert result["value"] == 4 and result["logical_dp"] == 8, result
+    # the 25 s tier-1 budget is dominated by mesh COMPILES, which are
+    # host-load dependent (the shard_smoke precedent: report, don't
+    # hard-assert) — wall_s is reported in the JSON; the assertion here
+    # is a generous hang guard only (typical: ~5 s)
+    assert result["wall_s"] < 120, result
+
+
+@pytest.mark.slow  # duplicates the in-process gate via a subprocess
+def test_elastic_smoke_cli_prints_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "elastic_smoke.py"),
+         "--steps", "4", "--kill-at", "2"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["bitwise_loss_trace"] is True
+    assert result["resumed_checkpoint_step"] is not None

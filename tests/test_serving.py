@@ -109,6 +109,50 @@ def test_batcher_queue_full_and_deadline():
     assert metrics.counter("requests.timeout") >= 1
 
 
+def test_backpressure_retry_after_is_jittered_and_load_scaled():
+    """A fixed Retry-After marches every rejected client back in one
+    synchronized wave (thundering herd); the hint must be load-scaled
+    AND jittered so concurrent rejects decorrelate."""
+    release = threading.Event()
+
+    def slow(feeds):
+        release.wait(10)
+        return [feeds[0]]
+
+    hints = []
+    shallow_hints = []
+    for depth in (2, 32):
+        b = DynamicBatcher(slow, max_batch=1, max_wait_ms=50.0,
+                           max_queue=depth).start()
+        try:
+            b.submit([np.zeros((1, 1), np.float32)])
+            time.sleep(0.05)  # scheduler blocked inside `slow`
+            for _ in range(depth):
+                b.submit([np.zeros((1, 1), np.float32)])
+            got = []
+            for _ in range(24):
+                with pytest.raises(QueueFullError) as ei:
+                    b.submit([np.zeros((1, 1), np.float32)])
+                got.append(ei.value.retry_after_s)
+            (shallow_hints if depth == 2 else hints).extend(got)
+        finally:
+            release.set()
+            b.stop(drain=False)
+            release.clear()
+    # jitter: repeated rejects at identical load must NOT repeat the hint
+    assert len(set(hints)) > 1
+    assert len(set(shallow_hints)) > 1
+    # load scaling: a 16x deeper backlog earns a larger hint even at the
+    # jitter extremes (bounds: base*[0.5, 1.5))
+    assert min(hints) > max(shallow_hints)
+    for h in hints + shallow_hints:
+        assert h > 0
+    # a draining batcher's rejection hint is jittered too, not 1.0 flat
+    stopped = [BatcherStoppedError().retry_after_s for _ in range(16)]
+    assert len(set(stopped)) > 1
+    assert all(0.5 <= s <= 1.5 for s in stopped)
+
+
 def test_batcher_error_fanout():
     def broken(feeds):
         raise RuntimeError("kernel exploded")

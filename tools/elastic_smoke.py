@@ -1,0 +1,181 @@
+"""Elastic gate: kill at full world, resume on a SHRUNK mesh, same math.
+
+The tier-1 slice of the elastic tier (tests/test_elastic_smoke.py runs
+it, budgeted <25 s wall on the 8-device CPU mesh; the full chaos-driven
+8→4→8 kill/shrink/regrow matrix lives in tests/test_elastic.py marked
+``slow``).  Single process, one shrink:
+
+  1. elasticize a tiny model for logical_dp=8 and train ``kill_at``
+     global steps on the full 8-device mesh with per-step async
+     checkpointing;
+  2. "lose half the fleet": fresh executor/scope/manager (process-
+     restart semantics), ``restore_from_checkpoint(world=4)`` — the
+     topology-shifted restore re-derives the micro-step counter and
+     schedule position for K=2;
+  3. train the remaining global steps on the 4-device mesh, feeding the
+     SAME global batches re-bucketed into K=2 micro-feeds;
+  4. assert the loss trace and final params are BITWISE equal to an
+     uninterrupted 8-device run — the world-size-invariant ordered fold
+     (c_elastic_fold) makes the reduction order a property of the
+     program, not the mesh.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/elastic_smoke.py [--steps 4] [--kill-at 2]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOGICAL = 8
+SHRUNK = 4
+
+
+def build_elastic():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.distributed.elastic import elasticize
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    meta = elasticize(main, startup, logical_dp=LOGICAL, loss_name=loss)
+    return main, startup, loss, meta
+
+
+def _train(exe, scope, main, loss, meta, world, feeds):
+    import jax
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    from paddle_tpu.distributed.elastic import rebucket_feeds
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:world])
+    trace = []
+    with static.scope_guard(scope):
+        for f in feeds:
+            for mf in rebucket_feeds(f, LOGICAL, world):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            trace.append(np.asarray(out[0]))
+    return trace
+
+
+def run_smoke(steps: int = 4, kill_at: int = 2, root: str = None):
+    """Run the gate; returns the result dict (AssertionError on an
+    elastic-resume regression)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    t_start = time.time()
+    assert 0 < kill_at < steps
+    ndev = len(jax.devices())
+    assert ndev >= LOGICAL, (
+        f"elastic smoke needs {LOGICAL} devices "
+        f"(XLA_FLAGS=--xla_force_host_platform_device_count={LOGICAL}), "
+        f"got {ndev}")
+    root = root or tempfile.mkdtemp(prefix="elastic_smoke_")
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(LOGICAL, 8).astype(np.float32),
+              "y": rng.rand(LOGICAL, 1).astype(np.float32)}
+             for _ in range(steps)]
+
+    # uninterrupted full-world reference
+    main, startup, loss, meta = build_elastic()
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+    t0 = time.time()
+    ref = _train(exe, scope, main, loss, meta, LOGICAL, feeds)
+    full_compile_s = time.time() - t0
+    with static.scope_guard(scope):
+        ref_params = {p.name: np.asarray(scope.get(p.name))
+                      for p in main.all_parameters()}
+
+    # phase 1: full world with per-global-step checkpoints, "killed"
+    main1, startup1, loss1, meta1 = build_elastic()
+    exe1 = static.Executor()
+    scope1 = static.Scope()
+    mgr = CheckpointManager(root)
+    with static.scope_guard(scope1):
+        exe1.run(startup1)
+        exe1.enable_checkpointing(mgr, program=main1, every_n_steps=1,
+                                  scope=scope1)
+    _train(exe1, scope1, main1, loss1, meta1, LOGICAL, feeds[:kill_at])
+    mgr.close()
+
+    # phase 2: half the fleet is gone — resume on a 4-device mesh.
+    # Deliberately NO world= hint: the restore re-derives for its
+    # default (all local devices) and the first CompiledProgram run
+    # re-anchors counter/step for the ACTUAL 4-device mesh — the
+    # world-mismatch path a real resume takes when the job script
+    # learns its mesh after restoring.
+    main2, startup2, loss2, meta2 = build_elastic()
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    mgr2 = CheckpointManager(root)
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        resumed = exe2.restore_from_checkpoint(
+            mgr2, program=main2, scope=scope2)
+    assert resumed is not None, "elastic smoke FAILED: nothing to resume"
+    g = exe2.last_restored_extra.get("global_step")
+    assert g == kill_at, (
+        f"elastic smoke FAILED: re-derived global step {g}, "
+        f"expected {kill_at}")
+    trace2 = _train(exe2, scope2, main2, loss2, meta2, SHRUNK, feeds[g:])
+    mgr2.close()
+
+    # the shrunk continuation must be BITWISE the uninterrupted trace
+    for i, (a, b) in enumerate(zip(ref[g:], trace2)):
+        assert np.array_equal(a, b), (
+            f"elastic smoke FAILED: loss trace diverged at global step "
+            f"{g + i}: {a!r} != {b!r} (8-dev reference vs 4-dev resume)")
+    with static.scope_guard(scope2):
+        for name, want in ref_params.items():
+            got = np.asarray(scope2.get(name))
+            assert np.array_equal(want, got), (
+                f"elastic smoke FAILED: param {name} diverged after "
+                "topology-shifted resume")
+
+    result = {
+        "metric": "elastic_smoke_resume_world",
+        "value": SHRUNK,
+        "logical_dp": LOGICAL,
+        "kill_at_global_step": kill_at,
+        "resumed_checkpoint_step": resumed,
+        "global_steps": steps,
+        "bitwise_loss_trace": True,
+        "bitwise_params": True,
+        "full_world_phase_s": round(full_compile_s, 2),
+        "wall_s": round(time.time() - t_start, 2),
+    }
+    return result
+
+
+def main():
+    steps, kill_at = 4, 2
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    if "--kill-at" in sys.argv:
+        kill_at = int(sys.argv[sys.argv.index("--kill-at") + 1])
+    print(json.dumps(run_smoke(steps=steps, kill_at=kill_at)))
+
+
+if __name__ == "__main__":
+    main()
