@@ -132,6 +132,8 @@ def rewrite_program(main_program: Program, amp_lists=None,
         new_ops.append(op)
     block.ops = new_ops
     main_program._fingerprint_cache = None
+    from ..core.pass_framework import finish_pass
+    finish_pass(main_program, "amp", dest_dtype=dest_dtype)
     return main_program
 
 

@@ -19,9 +19,59 @@ from typing import Callable, Dict, List, Optional
 from .program import Program, OpDesc, OpRole
 
 __all__ = ["register_pass", "get_pass", "apply_passes", "PassContext",
-           "all_passes"]
+           "all_passes", "record_applied", "applied_passes", "has_applied",
+           "finish_pass"]
 
 _PASSES: Dict[str, Callable] = {}
+
+
+# ---------------------------------------------------------------------------
+# applied-passes registry
+# ---------------------------------------------------------------------------
+# One place that answers "which rewrites ran on this Program, in what
+# order" — replacing the ad-hoc idempotency stamps each pass grew on its
+# own (`zero_sharded` op attrs, `_gm_meta`, `_elastic_meta`, ...).  The
+# per-pass metadata attrs stay (they carry rewrite-specific payloads the
+# checkpoint/restore machinery needs), but ORDER lives here, and the
+# verifier's pass-composition checks (static/verifier.py V501-V503) read
+# it.  Deliberately NOT serialized into to_dict(): like _gm_meta and
+# _zero_shard_plan it is build-session state; it does ride clone()'s
+# deepcopy, so a cloned rewritten program keeps its history.
+APPLIED_PASSES_ATTR = "_applied_passes"
+
+
+def record_applied(program: Program, name: str, **meta) -> dict:
+    """Append `name` (+ free-form metadata) to `program`'s applied-pass
+    history and return the recorded entry."""
+    entry = {"pass": str(name)}
+    entry.update(meta)
+    hist = getattr(program, APPLIED_PASSES_ATTR, None)
+    if hist is None:
+        hist = []
+        setattr(program, APPLIED_PASSES_ATTR, hist)
+    hist.append(entry)
+    return entry
+
+
+def applied_passes(program: Program) -> List[dict]:
+    """The ordered rewrite history: a list of ``{"pass": name, ...meta}``
+    dicts (earliest first).  Empty for a virgin program."""
+    return list(getattr(program, APPLIED_PASSES_ATTR, None) or [])
+
+
+def has_applied(program: Program, name: str) -> bool:
+    return any(e.get("pass") == name for e in applied_passes(program))
+
+
+def finish_pass(program: Program, name: str, startup=None, **meta):
+    """The rewrite-pass epilogue every pass shares: record the
+    application in the registry, then run the env-gated post-rewrite
+    verification (static/verifier.py self_check — a no-op unless
+    PADDLE_TPU_VERIFY is set; strict mode raises AT THE REWRITE SITE
+    with `name` in the message)."""
+    record_applied(program, name, **meta)
+    from ..static.verifier import self_check
+    return self_check(program, name, startup=startup)
 
 
 class PassContext:
@@ -57,6 +107,7 @@ def apply_passes(program: Program, names: List[str],
     ctx = ctx or PassContext()
     for n in names:
         program = _PASSES[n](program, ctx)
+        record_applied(program, n)
         program._fingerprint_cache = None
     return program
 

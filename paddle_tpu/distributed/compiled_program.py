@@ -161,6 +161,7 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
         for n in op.output_names():
             producers[n] = op
     new_ops = []
+    inserted = 0
     done: Dict[str, str] = {}
     for op in block.ops:
         if op.attrs.get(OpRole.KEY) == OpRole.Optimize and "Grad" in op.inputs:
@@ -191,6 +192,7 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
                             {"ring_id": 0, OpRole.KEY: OpRole.Dist,
                              "op_uid": p._next_uid()})
                 new_ops.append(ar)
+                inserted += 1
                 if fp16_allreduce:
                     back = unique_name(g + "@FP32")
                     block.create_var(name=back, stop_gradient=True,
@@ -215,6 +217,13 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
             op.inputs["Grad"] = new_gnames
         new_ops.append(op)
     block.ops = new_ops
+    if inserted:
+        # record only EFFECTIVE applications: the idempotent re-apply
+        # path (with_data_parallel over an already-reduced program)
+        # inserts nothing and must not misreport history
+        from ..core.pass_framework import record_applied
+        record_applied(p, "grad_allreduce", scale=bool(scale),
+                       fp16=bool(fp16_allreduce), reductions=inserted)
     return p
 
 
@@ -391,6 +400,10 @@ class CompiledProgram:
         from ..core import compile_cache as _ccache
         fn = self._cache.get(key)
         if fn is None:
+            # env-gated IR verification rides the (already slow) first
+            # compile of each program (PADDLE_TPU_VERIFY, verifier.py)
+            from ..static.verifier import verify_first_compile
+            verify_first_compile(program, fetch_list=fetch_names)
             _ccache.record_miss()
             _ccache.record_trace()
             fn = self._compile(program, state_names, sorted(feed_vals),

@@ -97,16 +97,19 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     n = int(logical_dp)
     if n < 1 or (n & (n - 1)):
         raise ValueError(f"logical_dp must be a power of two, got {n}")
-    if elastic_meta(program) is not None:
+    from ..core.pass_framework import has_applied
+    if elastic_meta(program) is not None or has_applied(program, "elastic"):
         raise ValueError("elasticize already applied to this program")
     plan = getattr(program, "_zero_shard_plan", None)
-    if plan is not None and getattr(plan, "buckets", None):
+    if (plan is not None and getattr(plan, "buckets", None)) or \
+            has_applied(program, "zero1_sharding"):
         raise NotImplementedError(
             "elasticize does not compose with shard_optimizer_states "
             "(ZeRO-1) yet — ZeRO topology shifts are handled by "
             "checkpoint layout conversion at restore instead "
             "(docs/elastic.md)")
-    if getattr(program, "_gm_meta", None) is not None:
+    if getattr(program, "_gm_meta", None) is not None or \
+            has_applied(program, "gradient_merge"):
         raise NotImplementedError(
             "elasticize does not stack on static.gradient_merge: the "
             "elastic schedule IS a masked accumulation window (K = "
@@ -219,6 +222,8 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     meta = {"logical_dp": n, "counter": counter, "loss_avg": loss_avg,
             "accs": acc_names, "version": 1}
     program._elastic_meta = meta
+    from ..core.pass_framework import finish_pass
+    finish_pass(program, "elastic", startup=startup, logical_dp=n)
     return meta
 
 

@@ -25,6 +25,15 @@ __all__ = ["GradientMergeOptimizer", "apply_gradient_merge"]
 
 def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
     """Rewrite the already-minimized `program` for k-step accumulation."""
+    from ....core.pass_framework import finish_pass, has_applied
+    if getattr(program, "_gm_meta", None) is not None or \
+            has_applied(program, "gradient_merge"):
+        # a second application would stack a second counter/mask over the
+        # first's @MASKED temps: accumulators of accumulators, committing
+        # every k² steps — refuse instead of silently double-masking
+        raise ValueError(
+            "gradient_merge already applied to this program (see the "
+            "applied-passes registry, core/pass_framework.py)")
     block = program.global_block()
     opt_start = next((i for i, op in enumerate(block.ops)
                       if op.op_role == OpRole.Optimize), len(block.ops))
@@ -91,6 +100,8 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
         _op(program, block, "where", {"Condition": [mask], "X": [zeros],
                                       "Y": [acc]}, {"Out": [acc]})
     program._fingerprint_cache = None
+    finish_pass(program, "gradient_merge", startup=startup,
+                k=int(k_steps))
     return program, mask
 
 

@@ -533,6 +533,12 @@ def shard_optimizer_states(program: Program, startup: Program,
 
     plan = ShardingPlan(world, plan_buckets)
     program._zero_shard_plan = plan
+    # applied-passes registry + env-gated post-rewrite self-check
+    # (static/verifier.py: ZeRO-1 is the pass the rs↔ag pairing and
+    # dp_shard-consistency diagnostics were built for)
+    from ..core.pass_framework import finish_pass
+    finish_pass(program, "zero1_sharding", startup=startup,
+                dp_degree=world, buckets=len(plan_buckets))
     return plan
 
 

@@ -228,7 +228,15 @@ class Predictor:
                                              self._fetch_names))
         if self._config._precision == PrecisionType.Bfloat16:
             from ..amp import rewrite_program
-            rewrite_program(prog)
+            rewrite_program(prog)  # self-checks as pass "amp"
+        else:
+            # env-gated post-pipeline verification (PADDLE_TPU_VERIFY):
+            # the inference folds rewrite weights AND graph together, so
+            # a broken fold should fail at load, not at the first
+            # /predict.  (On the bf16 branch rewrite_program just ran
+            # the same full check — don't walk the IR twice.)
+            from ..static.verifier import self_check
+            self_check(prog, "inference_pipeline")
         self._program = prog
 
     # -- 2.x API ------------------------------------------------------------

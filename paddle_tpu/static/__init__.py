@@ -21,6 +21,11 @@ from .memory_analysis import (  # noqa: F401
 )
 from .optimizer import gradient_merge  # noqa: F401
 from . import memory_analysis  # noqa: F401
+from .verifier import (  # noqa: F401
+    check_program, collective_sequence, collective_wire_bytes,
+    VerifyReport, Diagnostic, ProgramVerificationError,
+)
+from . import verifier  # noqa: F401
 from .initializer import (  # noqa: F401
     Constant, Uniform, Normal, TruncatedNormal, Xavier, MSRA,
     NumpyArrayInitializer, set_global_initializer,

@@ -326,7 +326,8 @@ class Executor:
                 results = self._run_compiled(program, scope, feed,
                                              fetch_names, return_numpy)
         if flag("check_nan_inf", False):
-            self._check_nan_inf(fetch_names, results, scope)
+            self._check_nan_inf(fetch_names, results, scope,
+                                program=program)
         self._maybe_checkpoint(program, scope)
         self._chaos_step(program)
         return results
@@ -352,26 +353,66 @@ class Executor:
             self._train_runs += 1
             _chaos.step_hook(self._train_runs)
 
-    def _check_nan_inf(self, fetch_names, results, scope):
+    def _check_nan_inf(self, fetch_names, results, scope, program=None,
+                       steps=1):
         """FLAGS_check_nan_inf (reference details/nan_inf_utils_detail —
         per-op output scan; here: fetches + persistable state after the
-        jitted step, which bounds the same failure)."""
-        bad = []
+        jitted step, which bounds the same failure).
+
+        Each finding names the PRODUCING op (type, op_uid, op index) and
+        the value's dtype, resolved from `program`'s IR — not just the
+        fetch name — so a NaN points at the kernel that minted it, like
+        the reference's CheckOpHasNanOrInf.  Under ``run_steps`` (where
+        fetches are stacked ``[K, ...]``) the report also names the
+        first micro-step whose slice went non-finite.  Works identically
+        for run() and run_steps(); the eager path has the sharper
+        `_per_op_nan_scan`.  (docs/static_analysis.md "NaN/Inf
+        debugging".)"""
+        bad = []  # (kind, name, array, step_idx or None)
         for n, v in zip(fetch_names, results or []):
             arr = np.asarray(v)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                bad.append(f"fetch {n!r}")
-        for n in scope.keys():
+                step_idx = None
+                if steps > 1 and arr.ndim >= 1 and arr.shape[0] == steps:
+                    per_step = np.isfinite(
+                        arr.reshape(steps, -1)).all(axis=1)
+                    step_idx = int(np.argmin(per_step))
+                bad.append(("fetch", n, arr, step_idx))
+        scan_names = _persistable_names(program) if program is not None \
+            else list(scope.keys())
+        for n in scan_names:
             v = scope.get(n)
             if v is None:
                 continue
             arr = np.asarray(v)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                bad.append(f"var {n!r}")
-        if bad:
-            raise RuntimeError(
-                "FLAGS_check_nan_inf: non-finite values in "
-                + ", ".join(bad))
+                bad.append(("var", n, arr, None))
+        if not bad:
+            return
+        producers = {}
+        if program is not None:
+            for b in program.blocks:
+                for i, op in enumerate(b.ops):
+                    for out_name in op.output_names():
+                        if out_name:
+                            # keep the LAST writer: that is the value the
+                            # step actually committed
+                            producers[out_name] = (b.idx, i, op)
+        msgs = []
+        for kind, n, arr, step_idx in bad:
+            msg = f"{kind} {n!r} (dtype {arr.dtype})"
+            if step_idx is not None:
+                msg += f", first non-finite at micro-step {step_idx}"
+            hit = producers.get(n)
+            if hit is not None:
+                bi, oi, op = hit
+                msg += (f", produced by op {op.type!r} "
+                        f"(uid {op.attrs.get('op_uid')}, "
+                        f"block {bi} op {oi})")
+            msgs.append(msg)
+        raise RuntimeError(
+            "FLAGS_check_nan_inf: non-finite values in "
+            + "; ".join(msgs))
 
     # -- dataset-driven training (MultiTrainer path, executor.py:1345) ------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -489,6 +530,11 @@ class Executor:
                 key, feed_vals, bucket = bucketed
                 fn = self._cache.get(key)
         if fn is None:
+            # env-gated IR verification on the first compile of each
+            # program (PADDLE_TPU_VERIFY — static/verifier.py): the IR
+            # walk rides the already-slow trace path only
+            from .verifier import verify_first_compile
+            verify_first_compile(program, fetch_list=fetch_names)
             self._record("miss")
             self._record("trace")
             fn = self._compile(program, state_names, fetch_names)
@@ -800,6 +846,8 @@ class Executor:
                                             fetch_list, scope,
                                             return_numpy)
         if fn is None:
+            from .verifier import verify_first_compile
+            verify_first_compile(program, fetch_list=fetch_names)
             self._record("miss")
             self._record("trace")
             fn = self._compile_steps(program, state_names, fetch_names)
@@ -831,7 +879,8 @@ class Executor:
         results = [np.asarray(f) for f in fetches] if return_numpy \
             else list(fetches)
         if flag("check_nan_inf", False):
-            self._check_nan_inf(fetch_names, results, scope)
+            self._check_nan_inf(fetch_names, results, scope,
+                                program=program, steps=int(k))
         self._maybe_checkpoint(program, scope)
         self._chaos_step(program)
         return results

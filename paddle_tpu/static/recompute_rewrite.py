@@ -220,4 +220,6 @@ def append_backward_with_checkpoints(block: Block, loss, parameter_list,
         gv.shape = p.shape
         gv.dtype = gv.dtype or p.dtype
         result.append((p, gv))
+    from ..core.pass_framework import finish_pass
+    finish_pass(program, "recompute", checkpoints=len(ckpt_names))
     return result

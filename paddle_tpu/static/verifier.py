@@ -1,0 +1,1155 @@
+"""Program IR verifier & distributed-correctness analyzer.
+
+Analog of the reference's pre-execution validation
+(/root/reference/paddle/fluid/framework/op_desc.cc OpDesc::Check +
+per-op InferShape run by the C++ executor before launch) — but widened
+to the invariants that actually break THIS framework: paddle_tpu stacks
+five interacting program-rewrite passes (AMP, recompute, gradient_merge,
+ZeRO-1 sharding, elastic fold) whose composition contracts were, until
+now, enforced only by convention and caught only when an 8-device run
+deadlocked or diverged.  This module moves those failures from tunnel
+time to compile time, the same way `static/memory_analysis.py` moved
+OOMs to estimator time.
+
+`check_program(program, level=...)` walks the op IR and reports
+structured `Diagnostic`s (never raises on a defect unless asked) at four
+cumulative levels:
+
+  1. ``graph``       — def-before-use, dangling vars, dtype/shape
+                       consistency (via the same abstract evaluation as
+                       `core/infer_shape.py`), feed/fetch/persistable
+                       integrity, duplicate-write (SSA violation)
+                       detection outside known accumulator patterns.
+  2. ``collective``  — the SPMD/distributed checker: extracts the
+                       ordered collective sequence, verifies
+                       ring_id/dp_degree/shape/dtype agreement,
+                       reduce-scatter↔allgather pairing, `dp_shard`
+                       metadata consistency, control-flow-divergent
+                       collectives (a collective under a data-dependent
+                       sub-block = a guaranteed cross-rank deadlock
+                       under shard_map), psum-reassociation hazards in
+                       bitwise-order fold paths, double reductions, and
+                       pass-composition order (the applied-passes
+                       registry, `core/pass_framework.py`).
+  3. ``donation``    — buffers donated to XLA (ZeRO slot shards,
+                       elastic accumulators, the jitted step's donated
+                       persistable state): alias-creating startup
+                       assigns (double donation), reads-after-donation
+                       (a forward/backward-role op reading state an
+                       optimizer-role op already committed), fetches of
+                       per-rank shards.
+  4. ``retrace``     — lint for feeds whose shapes escape the batch-dim
+                       bucketing policy and Python-captured array
+                       constants baked into op attrs (each build
+                       fingerprints differently → retrace every step).
+
+Diagnostic codes are STABLE (docs/static_analysis.md): tests and
+allowlists key on them.  Every diagnostic carries provenance (block/op
+index, op type, op_uid, var name) so a report names the defect site,
+not just the defect class.
+
+`collective_sequence(program)` / `collective_wire_bytes(program, world)`
+expose the ordered collective schedule and its ring-algorithm ICI cost —
+the shared substrate the ROADMAP auto-parallel planner needs for
+wire-byte costing.
+
+Gating: ``PADDLE_TPU_VERIFY`` env ("" = off, "warn", "strict") arms
+(a) a first-compile hook in `static/executor.py` /
+`distributed/compiled_program.py` and (b) post-rewrite self-checks in
+every rewrite pass; "strict" raises `ProgramVerificationError` on any
+error-severity diagnostic.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.program import Block, OpDesc, OpRole, Program
+
+__all__ = [
+    "Diagnostic", "VerifyReport", "ProgramVerificationError",
+    "check_program", "collective_sequence", "collective_wire_bytes",
+    "verify_mode", "self_check", "verify_first_compile", "VERIFY_ENV",
+]
+
+VERIFY_ENV = "PADDLE_TPU_VERIFY"
+
+# level name -> highest suite number it runs (levels are cumulative)
+_LEVELS = {"graph": 1, "collective": 2, "donation": 3, "retrace": 4,
+           "all": 4, "strict": 4}
+
+ERROR = "error"
+WARNING = "warning"
+
+
+class ProgramVerificationError(RuntimeError):
+    """Raised by strict-mode verification when error diagnostics exist."""
+
+    def __init__(self, report: "VerifyReport", context: str = ""):
+        self.report = report
+        head = f"program verification failed ({context})" if context \
+            else "program verification failed"
+        super().__init__(f"{head}:\n{report.render(errors_only=True)}")
+
+
+class Diagnostic:
+    """One structured finding with provenance."""
+
+    __slots__ = ("code", "severity", "message", "block_idx", "op_idx",
+                 "op_type", "op_uid", "var")
+
+    def __init__(self, code: str, severity: str, message: str,
+                 block_idx: Optional[int] = None,
+                 op_idx: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 op_uid: Optional[int] = None,
+                 var: Optional[str] = None):
+        self.code = code
+        self.severity = severity
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.op_uid = op_uid
+        self.var = var
+
+    def where(self) -> str:
+        parts = []
+        if self.block_idx is not None:
+            parts.append(f"block {self.block_idx}")
+        if self.op_idx is not None:
+            parts.append(f"op {self.op_idx}")
+        if self.op_type:
+            uid = f" uid={self.op_uid}" if self.op_uid is not None else ""
+            parts.append(f"{self.op_type!r}{uid}")
+        if self.var:
+            parts.append(f"var {self.var!r}")
+        return ", ".join(parts)
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        w = self.where()
+        return f"[{self.code}/{self.severity}] {self.message}" + \
+            (f"  ({w})" if w else "")
+
+
+class VerifyReport:
+    """All diagnostics from one `check_program` run."""
+
+    def __init__(self, diagnostics: List[Diagnostic], level: str,
+                 applied_passes: Optional[List[dict]] = None):
+        self.diagnostics = list(diagnostics)
+        self.level = level
+        self.applied_passes = list(applied_passes or [])
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return sorted({d.code for d in self.diagnostics})
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def render(self, errors_only: bool = False) -> str:
+        ds = self.errors if errors_only else self.diagnostics
+        if not ds:
+            return "clean (0 diagnostics)"
+        return "\n".join(f"  {d!r}" for d in ds)
+
+    def raise_on_error(self, context: str = ""):
+        if self.errors:
+            raise ProgramVerificationError(self, context)
+        return self
+
+    def __repr__(self):
+        return (f"VerifyReport(level={self.level!r}, "
+                f"errors={len(self.errors)}, "
+                f"warnings={len(self.warnings)})")
+
+
+# ---------------------------------------------------------------------------
+# op-type classification tables
+# ---------------------------------------------------------------------------
+# cross-rank communication ops: must execute in the same order with the
+# same operands on every rank or the mesh deadlocks / diverges
+_COLLECTIVE_OPS = frozenset((
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_reducescatter", "c_allgather", "c_broadcast",
+    "broadcast", "c_scatter", "c_concat", "c_split", "alltoall",
+    "barrier", "mp_allreduce_sum", "c_elastic_fold", "partial_allgather",
+    "p_send", "p_recv", "ring_attention", "sync_batch_norm",
+    "sync_batch_norm_grad",
+))
+
+# collectives whose summation order XLA may legally reassociate — fatal
+# inside a path that requires a bitwise-stable reduction order (the
+# elastic fold's whole contract, distributed/elastic.py)
+_PSUM_ORDER_SENSITIVE = frozenset((
+    "c_allreduce_sum", "c_reducescatter", "mp_allreduce_sum",
+))
+
+# output shapes depend on the mesh (off-mesh the kernels degrade to
+# identity), so the abstract-evaluation shape check must skip them
+_MESH_DEPENDENT_OPS = frozenset((
+    "c_reducescatter", "c_allgather", "c_split", "c_concat", "c_scatter",
+    "alltoall", "partial_allgather", "c_elastic_fold",
+    "elastic_commit_mask", "scale_by_world_size", "ring_attention",
+    "p_send", "p_recv",
+))
+
+# control-flow container ops: their sub-block carries run under traced
+# lax control flow, where per-rank divergence is possible
+_CONTROL_FLOW_OPS = frozenset((
+    "while", "conditional_block", "cond", "static_rnn", "recurrent",
+))
+
+# in-place container writers: a tensor array var IS rebound by every
+# write (write_to_array at index i), so multi-write is its contract,
+# not an SSA violation
+_INPLACE_CONTAINER_OPS = frozenset((
+    "write_to_array", "array_write", "lod_tensor_to_array",
+    "create_tensor_array",
+))
+
+# ops a reduction pass inserts between a collective and its consumer —
+# shared vocabulary with distributed/compiled_program._grad_already_reduced
+_REDUCE_TRANSPARENT = frozenset((
+    "scale_by_world_size", "scale", "cast", "elementwise_add", "where",
+    "reshape", "reshape2", "concat", "pad", "slice", "assign",
+    "check_finite_and_unscale", "update_loss_scaling",
+))
+_REDUCE_OPS = frozenset(("c_allreduce_sum", "c_reducescatter",
+                         "c_elastic_fold"))
+
+_STARTUP_INIT_OPS = frozenset((
+    "fill_constant", "uniform_random", "gaussian_random",
+    "truncated_gaussian_random", "assign_value", "eye", "c_broadcast",
+    "broadcast", "seed", "range", "linspace", "scale", "assign",
+))
+
+
+def _role(op: OpDesc) -> int:
+    return int(op.attrs.get(OpRole.KEY, OpRole.Forward))
+
+
+def _is_optimize_write(op: OpDesc) -> bool:
+    return bool(_role(op) & OpRole.Optimize)
+
+
+def _is_fwd_bwd_read(op: OpDesc) -> bool:
+    # strip the Loss marker bit; Forward(0) and Backward(1) remain
+    return (_role(op) & ~OpRole.Loss) in (OpRole.Forward, OpRole.Backward)
+
+
+def _var_of(block: Block, name: str):
+    try:
+        return block.var(name)
+    except KeyError:
+        return None
+
+
+def _numel(shape) -> Optional[int]:
+    if shape is None:
+        return None
+    n = 1
+    for d in shape:
+        d = int(d)
+        if d < 0:
+            return None
+        n *= d
+    return n
+
+
+def _dtype_bytes(dtype: Optional[str]) -> int:
+    if not dtype:
+        return 0
+    from ..core.dtype import np_dtype
+    try:
+        return int(np.dtype(np_dtype(dtype)).itemsize)
+    except Exception:
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# collective-sequence extraction (the planner's wire-cost substrate)
+# ---------------------------------------------------------------------------
+def collective_sequence(program: Program) -> List[dict]:
+    """The ordered cross-rank communication schedule of `program`.
+
+    One entry per collective op, in execution order, with the operand
+    metadata every rank must agree on (this IS the deadlock surface:
+    under shard_map each rank traces the same op list, so any divergence
+    in order/ring/shape means a rank waits on a collective its peers
+    never post).  Entry keys: ``block``/``index`` (provenance),
+    ``type``, ``ring_id``, ``dp_degree`` (None unless stamped),
+    ``var``/``shape``/``dtype``/``nbytes`` (the X operand), ``op_uid``.
+
+    This is also the substrate the ROADMAP auto-parallel planner costs
+    ICI wire bytes over — see `collective_wire_bytes`.
+    """
+    seq = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type not in _COLLECTIVE_OPS:
+                continue
+            xnames = op.inputs.get("X", []) or op.input_names()
+            xname = xnames[0] if xnames else None
+            v = _var_of(block, xname) if xname else None
+            shape = tuple(v.shape) if v is not None and v.shape is not None \
+                else None
+            dtype = v.dtype if v is not None else None
+            numel = _numel(shape)
+            seq.append({
+                "block": block.idx, "index": i, "type": op.type,
+                "ring_id": int(op.attrs.get("ring_id", 0)),
+                "dp_degree": (int(op.attrs["dp_degree"])
+                              if op.attrs.get("dp_degree") else None),
+                "var": xname, "shape": shape, "dtype": dtype,
+                "nbytes": (numel * _dtype_bytes(dtype)
+                           if numel is not None else None),
+                "op_uid": op.attrs.get("op_uid"),
+            })
+    return seq
+
+
+def collective_wire_bytes(program: Program, world: int,
+                          ring_id: Optional[int] = None) -> int:
+    """ICI bytes ONE rank moves per step under ring-algorithm accounting:
+    allreduce 2(N-1)/N of the buffer, reduce-scatter (N-1)/N, allgather
+    and the elastic all-gather fold (N-1)× the local shard, broadcast/
+    scatter (N-1)/N, alltoall (N-1)/N.  Entries with unknown sizes
+    contribute 0 (count them via `collective_sequence` if that matters).
+    `ring_id=None` sums every ring.  An entry stamped with its own
+    ``dp_degree`` (the sharding pass records the group size it padded
+    for) is priced at THAT group size; `world` covers the rest."""
+    if world <= 1:
+        return 0
+    total = 0.0
+    for e in collective_sequence(program):
+        if ring_id is not None and e["ring_id"] != ring_id:
+            continue
+        n = e["nbytes"]
+        if not n:
+            continue
+        g = e["dp_degree"] or world  # per-entry group size wins
+        if g <= 1:
+            continue
+        t = e["type"]
+        if t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+                 "c_allreduce_prod", "mp_allreduce_sum", "sync_batch_norm",
+                 "sync_batch_norm_grad"):
+            total += 2.0 * (g - 1) / g * n
+        elif t in ("c_reducescatter", "c_scatter", "c_broadcast",
+                   "broadcast", "alltoall", "c_split", "c_concat"):
+            total += (g - 1) / g * n
+        elif t in ("c_allgather", "c_elastic_fold", "partial_allgather"):
+            total += (g - 1) * n
+        elif t in ("p_send", "p_recv"):
+            total += n
+        # barrier / elastic_commit_mask: control traffic only
+    return int(total)
+
+
+# ---------------------------------------------------------------------------
+# suite 1: graph verifier
+# ---------------------------------------------------------------------------
+def _check_graph(program: Program, fetch_roots: Set[str],
+                 out: List[Diagnostic]):
+    from ..ops.registry import get_op_info
+    block = program.global_block()
+
+    # V109 unknown ops (all blocks): the executor would hit the same
+    # NotImplementedError mid-trace; catching it here names the op site
+    for b in program.blocks:
+        for i, op in enumerate(b.ops):
+            if op.type in ("feed", "fetch"):
+                continue
+            if get_op_info(op.type) is None:
+                out.append(Diagnostic(
+                    "V109", ERROR,
+                    f"op type {op.type!r} has no registered kernel",
+                    block_idx=b.idx, op_idx=i, op_type=op.type,
+                    op_uid=op.attrs.get("op_uid")))
+
+    # availability walk over the global block (sub-blocks close over the
+    # whole parent env at trace time, so def-before-use is only
+    # well-defined at the top level)
+    available: Set[str] = set()
+    for b in program.blocks:
+        for v in b.vars.values():
+            if v.persistable or v.is_data:
+                available.add(v.name)
+    def _required_inputs(op: OpDesc) -> List[str]:
+        """Input names excluding OPTIONAL slots: the tracer hands a
+        kernel None for a missing optional operand by contract (e.g.
+        heter_recv's Dummy dependency token), so only required slots
+        constitute a real read."""
+        info = get_op_info(op.type)
+        if info is None:
+            return op.input_names()
+        names = []
+        for slot in info.inputs:
+            if slot.optional:
+                continue
+            names.extend(op.inputs.get(slot.name, []))
+        # names in slots the registry doesn't declare still count
+        declared = {s.name for s in info.inputs}
+        for slot_name, vs in op.inputs.items():
+            if slot_name not in declared:
+                names.extend(vs)
+        return names
+
+    writers: Dict[str, List[Tuple[int, OpDesc]]] = {}
+    for i, op in enumerate(block.ops):
+        if op.type == "feed":
+            available.update(op.output_names())
+            continue
+        if op.type != "fetch":
+            for n in _required_inputs(op):
+                if n and n not in available and not block.has_var(n):
+                    # read of a name that is neither produced, declared,
+                    # persistable, nor a feed — the trace would KeyError
+                    out.append(Diagnostic(
+                        "V101", ERROR,
+                        f"op reads {n!r} before any definition (not a "
+                        f"feed, not persistable, no producing op)",
+                        block_idx=0, op_idx=i, op_type=op.type,
+                        op_uid=op.attrs.get("op_uid"), var=n))
+                elif n and n not in available:
+                    # declared but never produced: only an error when it
+                    # cannot be fed (a declared non-data temp with no
+                    # producer is a broken rewrite)
+                    v = _var_of(block, n)
+                    if v is not None and not v.is_data and \
+                            not v.persistable:
+                        out.append(Diagnostic(
+                            "V101", ERROR,
+                            f"op reads {n!r} before its definition — "
+                            f"declared but no earlier op produces it",
+                            block_idx=0, op_idx=i, op_type=op.type,
+                            op_uid=op.attrs.get("op_uid"), var=n))
+        for n in op.output_names():
+            if not n:
+                continue
+            available.add(n)
+            writers.setdefault(n, []).append((i, op))
+
+    # V106 duplicate write (SSA violation) outside accumulator patterns:
+    # persistables are the sanctioned in-place state (counters, params,
+    # masked commits); control-flow carries are rewritten in place by
+    # design; everything else must be single-assignment
+    for n, ws in writers.items():
+        if len(ws) < 2:
+            continue
+        v = _var_of(block, n)
+        if v is not None and (v.persistable or v.is_data):
+            continue
+        if any(op.type in _CONTROL_FLOW_OPS or
+               op.type in _INPLACE_CONTAINER_OPS for _, op in ws):
+            continue
+        i, op = ws[1]
+        out.append(Diagnostic(
+            "V106", WARNING,
+            f"non-persistable var {n!r} is written by {len(ws)} ops "
+            f"(SSA violation outside the known accumulator patterns); "
+            f"later reads silently see the last write",
+            block_idx=0, op_idx=i, op_type=op.type,
+            op_uid=op.attrs.get("op_uid"), var=n))
+
+    # V102 dangling @GRAD vars.  Scoped to gradients in a TRAINING
+    # program (one with optimizer ops): there every produced gradient
+    # must reach an optimizer/reduction consumer, so a dead one means a
+    # rewrite dropped the consumer.  Deliberately NOT a general
+    # dead-code lint — unfetched forward metrics and `gradients()` API
+    # leaves are user intent (and DCE's job), not defects.
+    consumed: Set[str] = set()
+    for b in program.blocks:
+        for op in b.ops:
+            consumed.update(n for n in op.input_names() if n)
+    has_optimizer = any(_is_optimize_write(op) and "Grad" in op.inputs
+                        for op in block.ops)
+    if has_optimizer:
+        for i, op in enumerate(block.ops):
+            if op.type in ("feed", "fetch"):
+                continue
+            info = get_op_info(op.type)
+            if info is not None and info.side_effect:
+                continue
+            outs = [n for n in op.output_names() if n]
+            if not outs or not all(n.endswith("@GRAD") for n in outs):
+                continue
+            live = any(
+                n in consumed or n in fetch_roots or (
+                    (v := _var_of(block, n)) is not None
+                    and (v.persistable or v.is_data))
+                for n in outs)
+            if not live:
+                out.append(Diagnostic(
+                    "V102", WARNING,
+                    f"gradient var(s) {outs} dangle: produced but "
+                    f"consumed by no optimizer/reduction op in a "
+                    f"training program (a rewrite dropped the consumer)",
+                    block_idx=0, op_idx=i, op_type=op.type,
+                    op_uid=op.attrs.get("op_uid"), var=outs[0]))
+
+    # V107 feed/fetch integrity
+    for b in program.blocks:
+        for v in b.vars.values():
+            if v.is_data and v.persistable:
+                out.append(Diagnostic(
+                    "V107", ERROR,
+                    f"var {v.name!r} is both feed data and persistable: "
+                    f"it would be fed AND donated as jitted state in the "
+                    f"same step", block_idx=b.idx, var=v.name))
+    for i, op in enumerate(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        for n in op.output_names():
+            v = _var_of(block, n) if n else None
+            if v is not None and v.is_data:
+                out.append(Diagnostic(
+                    "V107", ERROR,
+                    f"op overwrites feed var {n!r}; the next step's feed "
+                    f"would silently clobber (or be clobbered by) it",
+                    block_idx=0, op_idx=i, op_type=op.type,
+                    op_uid=op.attrs.get("op_uid"), var=n))
+    for n in fetch_roots:
+        if not block.has_var(n) and n not in available:
+            out.append(Diagnostic(
+                "V107", ERROR,
+                f"fetch target {n!r} exists nowhere in the program",
+                var=n))
+
+    _check_shapes(program, out)
+
+
+def _check_shapes(program: Program, out: List[Diagnostic]):
+    """V103/V104: re-derive each op's output shape/dtype by the same
+    abstract evaluation `core/infer_shape.py` uses at build time and
+    compare against the DECLARED VarDescs.  Catches pass-emitted ops
+    whose hand-declared temps disagree with the kernel (a dtype clash
+    the trace would only surface as a deep XLA error, or a shape clash
+    that silently broadcasts).  Mesh-dependent ops are skipped (their
+    off-mesh degraded shapes differ by design), as are grad ops (their
+    cotangent slot convention makes abstract evaluation ambiguous here —
+    build-time infer_shape already covered them)."""
+    import jax
+    from ..core.infer_shape import _struct_for, _SENTINEL
+    from ..ops.registry import get_op_info, OpContext
+
+    block = program.global_block()
+    for i, op in enumerate(block.ops):
+        if op.type in ("feed", "fetch") or op.type in _MESH_DEPENDENT_OPS \
+                or op.type in _CONTROL_FLOW_OPS \
+                or op.type.endswith("_grad"):
+            continue
+        if op.attrs.get("zero_sharded") or any(
+                (v := _var_of(block, n)) is not None
+                and v.attrs.get("dp_shard")
+                for n in op.input_names() + op.output_names() if n):
+            # sharded bucket update: slot operands are declared at the
+            # GLOBAL padded shape but each rank traces its 1/N slice
+            # under shard_map — off-mesh abstract shapes differ by design
+            continue
+        info = get_op_info(op.type)
+        if info is None:
+            continue  # V109 already reported
+        ins = {}
+        complete = True
+        for slot in info.inputs:
+            names = op.inputs.get(slot.name, [])
+            if not names:
+                if not slot.optional:
+                    complete = False
+                    break
+                ins[slot.name] = [] if slot.duplicable else None
+                continue
+            try:
+                structs = [_struct_for(block.var(n)) for n in names if n]
+            except (KeyError, NotImplementedError):
+                complete = False
+                break
+            ins[slot.name] = structs if slot.duplicable else structs[0]
+        if not complete:
+            continue
+        try:
+            if info.infer_shape is not None:
+                outs = info.infer_shape(ins, op.attrs)
+            else:
+                ctx = OpContext(seed=0)
+                outs = jax.eval_shape(
+                    lambda i_: info.kernel(i_, op.attrs, ctx), ins)
+        except Exception:
+            continue  # kernel refused the abstract operands; not a verdict
+        if not isinstance(outs, dict):
+            continue
+        for slot in info.outputs:
+            names = op.outputs.get(slot.name, [])
+            res = outs.get(slot.name)
+            if not names or res is None:
+                continue
+            res_list = res if isinstance(res, (list, tuple)) else [res]
+            for name, st in zip(names, res_list):
+                if not name or st is None or not hasattr(st, "shape"):
+                    continue
+                v = _var_of(block, name)
+                if v is None:
+                    continue
+                inferred_shape = tuple(-1 if s == _SENTINEL else int(s)
+                                       for s in st.shape)
+                inferred_dtype = str(np.dtype(st.dtype).name) \
+                    if hasattr(st, "dtype") else None
+                if v.dtype is not None and inferred_dtype is not None \
+                        and v.dtype != inferred_dtype:
+                    out.append(Diagnostic(
+                        "V103", ERROR,
+                        f"declared dtype {v.dtype} of {name!r} clashes "
+                        f"with the kernel's inferred {inferred_dtype}",
+                        block_idx=0, op_idx=i, op_type=op.type,
+                        op_uid=op.attrs.get("op_uid"), var=name))
+                if v.shape is not None:
+                    declared = tuple(int(s) for s in v.shape)
+                    if len(declared) != len(inferred_shape) or any(
+                            d >= 0 and s >= 0 and d != s
+                            for d, s in zip(declared, inferred_shape)):
+                        out.append(Diagnostic(
+                            "V104", ERROR,
+                            f"declared shape {list(declared)} of {name!r} "
+                            f"clashes with the kernel's inferred "
+                            f"{list(inferred_shape)}",
+                            block_idx=0, op_idx=i, op_type=op.type,
+                            op_uid=op.attrs.get("op_uid"), var=name))
+
+
+# ---------------------------------------------------------------------------
+# suite 2: SPMD / collective checker
+# ---------------------------------------------------------------------------
+def _check_collectives(program: Program, out: List[Diagnostic]):
+    seq = collective_sequence(program)
+    block = program.global_block()
+
+    # V205: a collective inside a control-flow sub-block.  Under
+    # shard_map every rank traces the same op list, but a sub-block runs
+    # under lax.while_loop/cond whose predicate is DATA — per-rank data
+    # diverges, so one rank can take an iteration (and post a collective)
+    # its peers never reach: a guaranteed deadlock on a real mesh.
+    for e in seq:
+        if e["block"] != 0:
+            out.append(Diagnostic(
+                "V205", ERROR,
+                f"collective {e['type']!r} inside control-flow sub-block "
+                f"{e['block']}: a rank-divergent trip count deadlocks "
+                f"the mesh (hoist the collective out of the loop/branch)",
+                block_idx=e["block"], op_idx=e["index"],
+                op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+
+    # V202a: dp_degree consensus on each ring (the sharding pass stamps
+    # the world it padded buckets for — two degrees on one ring means
+    # two passes rewrote for different worlds)
+    ring_degrees: Dict[int, Set[int]] = {}
+    for e in seq:
+        if e["dp_degree"] is not None:
+            ring_degrees.setdefault(e["ring_id"], set()).add(e["dp_degree"])
+    for ring, degs in ring_degrees.items():
+        if len(degs) > 1:
+            out.append(Diagnostic(
+                "V202", ERROR,
+                f"collectives on ring {ring} disagree on dp_degree "
+                f"{sorted(degs)}: the program was rewritten for two "
+                f"different worlds", var=None))
+
+    # V203: per-op operand consistency for degree-stamped shard ops
+    for e in seq:
+        if e["type"] not in ("c_reducescatter", "c_allgather") or \
+                e["dp_degree"] is None:
+            continue
+        d = e["dp_degree"]
+        op = program.blocks[e["block"]].ops[e["index"]]
+        in_v = _var_of(block, e["var"]) if e["var"] else None
+        out_names = op.outputs.get("Out", [])
+        out_v = _var_of(block, out_names[0]) if out_names else None
+        in_n = _numel(in_v.shape) if in_v is not None else None
+        out_n = _numel(out_v.shape) if out_v is not None else None
+        if in_v is not None and out_v is not None and \
+                in_v.dtype and out_v.dtype and in_v.dtype != out_v.dtype:
+            out.append(Diagnostic(
+                "V203", ERROR,
+                f"{e['type']} input dtype {in_v.dtype} != output dtype "
+                f"{out_v.dtype} (collectives preserve dtype; cast "
+                f"separately)", block_idx=e["block"], op_idx=e["index"],
+                op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+        if e["type"] == "c_reducescatter" and in_n is not None:
+            if in_n % d != 0:
+                out.append(Diagnostic(
+                    "V203", ERROR,
+                    f"c_reducescatter input numel {in_n} is not divisible "
+                    f"by dp_degree {d}: the shard split is ill-formed",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+            elif out_n is not None and out_n != in_n // d:
+                out.append(Diagnostic(
+                    "V203", ERROR,
+                    f"c_reducescatter output numel {out_n} != input "
+                    f"{in_n} / dp_degree {d}",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+        if e["type"] == "c_allgather" and in_n is not None and \
+                out_n is not None and out_n != in_n * d:
+            out.append(Diagnostic(
+                "V203", ERROR,
+                f"c_allgather output numel {out_n} != input {in_n} × "
+                f"dp_degree {d}",
+                block_idx=e["block"], op_idx=e["index"],
+                op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+
+    # V201/V202b: reduce-scatter ↔ allgather pairing with matching
+    # bucket plans.  The ZeRO-1 recipe is rs(bucket) → sharded update →
+    # ag(shard): every degree-stamped rs must be followed by an ag whose
+    # local operand is the same shard length, on the same ring.  Pair
+    # greedily in program order by shard numel; ring mismatches on an
+    # otherwise-matching pair get the sharper V202.
+    rs_open: List[dict] = []
+    for e in seq:
+        if e["dp_degree"] is None:
+            continue
+        if e["type"] == "c_reducescatter":
+            d = e["dp_degree"]
+            n = _numel(e["shape"])
+            e["_shard"] = (n // d) if (n is not None and d and
+                                       n % d == 0) else None
+            rs_open.append(e)
+        elif e["type"] == "c_allgather":
+            n = _numel(e["shape"])  # ag input IS the local shard
+            match = next((r for r in rs_open if r["_shard"] is not None
+                          and r["_shard"] == n), None)
+            if match is None:
+                out.append(Diagnostic(
+                    "V201", ERROR,
+                    f"c_allgather (shard numel {n}) has no preceding "
+                    f"unpaired c_reducescatter with a matching bucket "
+                    f"plan — swapped collective order or an orphaned "
+                    f"publish (every rank would gather stale shards)",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+            else:
+                rs_open.remove(match)
+                if match["ring_id"] != e["ring_id"]:
+                    out.append(Diagnostic(
+                        "V202", ERROR,
+                        f"paired c_reducescatter (ring {match['ring_id']}) "
+                        f"and c_allgather (ring {e['ring_id']}) ride "
+                        f"different rings: the publish gathers over a "
+                        f"different device group than the reduction",
+                        block_idx=e["block"], op_idx=e["index"],
+                        op_type=e["type"], op_uid=e["op_uid"],
+                        var=e["var"]))
+    for r in rs_open:
+        out.append(Diagnostic(
+            "V201", ERROR,
+            f"c_reducescatter (bucket {r['var']!r}) is never published "
+            f"back by a matching c_allgather: params stay stale on "
+            f"{max((r['dp_degree'] or 2) - 1, 1)} of "
+            f"{r['dp_degree']} ranks",
+            block_idx=r["block"], op_idx=r["index"], op_type=r["type"],
+            op_uid=r["op_uid"], var=r["var"]))
+
+    # V204: dp_shard metadata consistency
+    plan = getattr(program, "_zero_shard_plan", None)
+    plan_degree = int(plan.dp_degree) if plan is not None and \
+        getattr(plan, "buckets", None) else None
+    stamped = {d for degs in ring_degrees.values() for d in degs}
+    for b in program.blocks:
+        for v in b.vars.values():
+            ds = v.attrs.get("dp_shard")
+            if not ds:
+                continue
+            ds = int(ds)
+            if v.shape and int(v.shape[0]) % ds != 0:
+                out.append(Diagnostic(
+                    "V204", ERROR,
+                    f"dp_shard({ds}) var {v.name!r} has leading dim "
+                    f"{v.shape[0]} not divisible by the shard degree",
+                    block_idx=b.idx, var=v.name))
+            if plan_degree is not None and ds != plan_degree:
+                out.append(Diagnostic(
+                    "V204", ERROR,
+                    f"dp_shard({ds}) var {v.name!r} disagrees with the "
+                    f"program's ShardingPlan dp_degree={plan_degree}",
+                    block_idx=b.idx, var=v.name))
+            elif plan_degree is None and stamped and ds not in stamped:
+                out.append(Diagnostic(
+                    "V204", ERROR,
+                    f"dp_shard({ds}) var {v.name!r} disagrees with the "
+                    f"collectives' stamped dp_degree {sorted(stamped)}",
+                    block_idx=b.idx, var=v.name))
+
+    # V206: psum-reassociation hazard inside a bitwise-order fold path.
+    # The elastic fold exists BECAUSE psum's reduction order is
+    # implementation-defined; any order-sensitive psum collective on the
+    # fold's ring silently re-introduces the world-size dependence.
+    if getattr(program, "_elastic_meta", None) is not None:
+        for e in seq:
+            if e["type"] in _PSUM_ORDER_SENSITIVE and e["ring_id"] == 0:
+                out.append(Diagnostic(
+                    "V206", ERROR,
+                    f"{e['type']} on ring 0 inside an elastic program: "
+                    f"psum order is implementation-defined, breaking the "
+                    f"fold's bitwise topology invariance (reduce through "
+                    f"c_elastic_fold instead)",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+
+    # V207: double reduction — a reduction collective whose operand's
+    # producer chain (through pass-inserted plumbing only) already
+    # contains a reduction.  The idempotency contract
+    # insert_grad_allreduce/shard_optimizer_states maintain by hand.
+    producers: Dict[str, OpDesc] = {}
+    for op in block.ops:
+        for n in op.output_names():
+            if n:
+                producers[n] = op
+    for i, op in enumerate(block.ops):
+        if op.type not in _REDUCE_OPS:
+            continue
+        frontier = [n for n in op.inputs.get("X", []) if n]
+        seen: Set[str] = set()
+        hops = 64
+        while frontier and hops > 0:
+            hops -= 1
+            n = frontier.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            p = producers.get(n)
+            if p is None or p is op:
+                continue
+            if p.type in _REDUCE_OPS:
+                out.append(Diagnostic(
+                    "V207", ERROR,
+                    f"{op.type} re-reduces {n!r}, already reduced by "
+                    f"{p.type} upstream: gradients would be scaled/"
+                    f"summed twice (a reduction pass was applied twice)",
+                    block_idx=0, op_idx=i, op_type=op.type,
+                    op_uid=op.attrs.get("op_uid"), var=n))
+                break
+            if p.type in _REDUCE_TRANSPARENT:
+                frontier.extend(p.input_names())
+
+    _check_pass_order(program, out)
+
+
+def _check_pass_order(program: Program, out: List[Diagnostic]):
+    """V501-V503: composition contracts between the rewrite passes, read
+    from the applied-passes registry (core/pass_framework.py)."""
+    from ..core.pass_framework import applied_passes
+    order = [e["pass"] for e in applied_passes(program)]
+    if "elastic" in order and "gradient_merge" in order:
+        out.append(Diagnostic(
+            "V501", ERROR,
+            "elastic and gradient_merge both applied: the elastic "
+            "schedule IS a masked accumulation window — stacking a "
+            "second counter double-masks the optimizer commit"))
+    if "elastic" in order and "zero1_sharding" in order:
+        out.append(Diagnostic(
+            "V503", ERROR,
+            "elastic and zero1_sharding both applied: the ordered fold "
+            "reduces into REPLICATED accumulators while ZeRO-1 updates "
+            "1/N shards — the combination is refused by elasticize()"))
+    if "gradient_merge" in order and "zero1_sharding" in order and \
+            order.index("gradient_merge") < order.index("zero1_sharding"):
+        out.append(Diagnostic(
+            "V502", ERROR,
+            "zero1_sharding applied AFTER gradient_merge: sharding must "
+            "run first so the masked commit wraps the bucketed sharded "
+            "update (the reverse buckets the @MASKED temps and "
+            "reduce-scatters every micro-step's partial sums)"))
+
+
+# ---------------------------------------------------------------------------
+# suite 3: donation / alias analyzer
+# ---------------------------------------------------------------------------
+def _donated_names(program: Program) -> Set[str]:
+    """Persistables the jitted step donates (donate_argnums=(0,) over the
+    whole state dict): all of them — with the ZeRO shards and elastic/gm
+    accumulators called out by the sharper checks."""
+    return {v.name for b in program.blocks for v in b.vars.values()
+            if v.persistable}
+
+
+def _check_donation(program: Program, startup: Optional[Program],
+                    fetch_roots: Set[str], out: List[Diagnostic]):
+    block = program.global_block()
+
+    # V301: alias-creating assigns between persistables in the STARTUP
+    # (eager) program.  `assign` binds the same device buffer under two
+    # scope names; the next jitted step donates the state dict, so XLA
+    # receives one buffer twice — an execution error at best, silent
+    # reuse at worst.  (The Lookahead optimizer routes this through
+    # scale(1.0) for exactly this reason.)
+    for prog in ([startup] if startup is not None else []):
+        sb = prog.global_block()
+        for i, op in enumerate(sb.ops):
+            if op.type != "assign":
+                continue
+            src = (op.inputs.get("X") or [None])[0]
+            dst = (op.outputs.get("Out") or [None])[0]
+            sv = _var_of(sb, src) if src else None
+            dv = _var_of(sb, dst) if dst else None
+            # the MAIN program's var table decides donation: startup
+            # often declares mirrors of main persistables
+            mv_src = _var_of(block, src) if src else None
+            mv_dst = _var_of(block, dst) if dst else None
+            src_p = (sv is not None and sv.persistable) or \
+                (mv_src is not None and mv_src.persistable)
+            dst_p = (dv is not None and dv.persistable) or \
+                (mv_dst is not None and mv_dst.persistable)
+            if src_p and dst_p and src != dst:
+                out.append(Diagnostic(
+                    "V301", ERROR,
+                    f"startup assigns persistable {src!r} into "
+                    f"persistable {dst!r}: both scope names alias ONE "
+                    f"device buffer, which the jitted step then donates "
+                    f"twice (use scale(x, 1.0) to copy instead)",
+                    block_idx=0, op_idx=i, op_type=op.type,
+                    op_uid=op.attrs.get("op_uid"), var=dst))
+
+    # V302: read-after-donation.  The optimizer commit is the donation
+    # point of a persistable's old buffer: once an Optimize-role op has
+    # written param/slot P, a LATER forward/backward-role op reading P
+    # sees the UPDATED value — gradients computed against half-updated
+    # state, the classic swapped-pass-order bug.  (Optimize-role readers
+    # are the masked-commit machinery reading its own temps: fine.)
+    donated_at: Dict[str, Tuple[int, OpDesc]] = {}
+    donated = _donated_names(program)
+    for i, op in enumerate(block.ops):
+        if _is_fwd_bwd_read(op) and op.type not in ("feed", "fetch"):
+            for n in op.input_names():
+                hit = donated_at.get(n)
+                if hit is not None:
+                    j, wop = hit
+                    out.append(Diagnostic(
+                        "V302", ERROR,
+                        f"{op.type!r} (role fwd/bwd) reads persistable "
+                        f"{n!r} AFTER its optimizer commit by "
+                        f"{wop.type!r} at op {j}: the old buffer is "
+                        f"donated — this read sees the post-update "
+                        f"value (pass ordering bug)",
+                        block_idx=0, op_idx=i, op_type=op.type,
+                        op_uid=op.attrs.get("op_uid"), var=n))
+        if _is_optimize_write(op):
+            for n in op.output_names():
+                if n in donated:
+                    donated_at.setdefault(n, (i, op))
+
+    # V303: fetching a per-rank shard.  dp_shard persistables live
+    # sharded over the mesh (CompiledProgram feeds them P("dp")); a
+    # fetch replicates/aggregates, returning one rank's slice (or a
+    # meaningless pmean of disjoint shards) — and snapshotting it
+    # through a fetch races the donation.  Checkpoints read the GLOBAL
+    # persistable through the scope instead.
+    if fetch_roots:
+        for b in program.blocks:
+            for v in b.vars.values():
+                if v.attrs.get("dp_shard") and v.name in fetch_roots:
+                    out.append(Diagnostic(
+                        "V303", ERROR,
+                        f"fetch of ZeRO-sharded slot {v.name!r}: each "
+                        f"rank holds 1/{v.attrs['dp_shard']} of it — a "
+                        f"fetch returns garbage; snapshot it via "
+                        f"Executor.checkpoint_snapshot instead",
+                        block_idx=b.idx, var=v.name))
+
+
+# ---------------------------------------------------------------------------
+# suite 4: retrace lint
+# ---------------------------------------------------------------------------
+def _check_retrace(program: Program, out: List[Diagnostic]):
+    block = program.global_block()
+    for v in block.vars.values():
+        if not v.is_data:
+            continue
+        if v.shape is None or len(v.shape) == 0:
+            out.append(Diagnostic(
+                "V403", WARNING,
+                f"feed {v.name!r} is declared rank-0: with any scalar "
+                f"feed in the signature the batch-dim bucketing policy "
+                f"disables itself and every ragged batch retraces "
+                f"(declare it shape [1] and reshape instead)",
+                block_idx=0, var=v.name))
+            continue
+        dyn_tail = [i for i, d in enumerate(v.shape) if int(d) == -1
+                    and i > 0]
+        if dyn_tail:
+            out.append(Diagnostic(
+                "V401", WARNING,
+                f"feed {v.name!r} shape {list(v.shape)} is dynamic in "
+                f"dim(s) {dyn_tail}: FLAGS_feed_bucketing pads only the "
+                f"leading batch dim, so every distinct length in those "
+                f"dims compiles a fresh executable (pad/bucket them "
+                f"host-side — io/bucketing.py)",
+                block_idx=0, var=v.name))
+    for b in program.blocks:
+        for i, op in enumerate(b.ops):
+            for k, val in op.attrs.items():
+                leaves = val if isinstance(val, (list, tuple)) else (val,)
+                if any(isinstance(leaf, np.ndarray) or
+                       type(leaf).__module__.startswith("jax")
+                       for leaf in leaves):
+                    out.append(Diagnostic(
+                        "V402", WARNING,
+                        f"op attr {k!r} holds a Python-captured array "
+                        f"constant: it is baked "
+                        f"into the trace and breaks fingerprint "
+                        f"stability — a per-step value here retraces "
+                        f"every step (feed it instead)",
+                        block_idx=b.idx, op_idx=i, op_type=op.type,
+                        op_uid=op.attrs.get("op_uid"), var=None))
+                    break
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def check_program(program: Program, level: str = "all",
+                  startup: Optional[Program] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  suppress: Iterable[str] = (),
+                  raise_on_error: bool = False) -> VerifyReport:
+    """Statically verify `program`'s op IR; returns a `VerifyReport`.
+
+    ``level``: "graph" | "collective" | "donation" | "retrace" | "all"
+    (cumulative: "donation" runs graph+collective+donation), or an int
+    1-4.  ``startup`` additionally checks init-time alias hazards
+    (V301).  ``fetch_list`` (vars or names) sharpens the dangling-var
+    and shard-fetch checks.  ``suppress`` drops diagnostic codes an
+    allowlist has accepted.  ``raise_on_error=True`` raises
+    `ProgramVerificationError` when any error-severity diagnostic
+    remains.
+
+    Wired as ``paddle.static.check_program``; the same walk is run
+    automatically at first compile and after every rewrite pass when
+    ``PADDLE_TPU_VERIFY`` is set (docs/static_analysis.md).
+    """
+    if isinstance(level, int):
+        depth = max(1, min(4, level))
+    else:
+        try:
+            depth = _LEVELS[str(level)]
+        except KeyError:
+            raise ValueError(
+                f"unknown verify level {level!r}: expected one of "
+                f"{sorted(_LEVELS)} or an int 1-4")
+    fetch_roots: Set[str] = set()
+    for f in (fetch_list or []):
+        fetch_roots.add(f.name if hasattr(f, "name") else str(f))
+    fetch_roots.update(getattr(program, "_fetch_names", ()) or ())
+
+    diags: List[Diagnostic] = []
+    _check_graph(program, fetch_roots, diags)
+    if depth >= 2:
+        _check_collectives(program, diags)
+    if depth >= 3:
+        _check_donation(program, startup, fetch_roots, diags)
+    if depth >= 4:
+        _check_retrace(program, diags)
+
+    suppress = set(suppress)
+    if suppress:
+        diags = [d for d in diags if d.code not in suppress]
+    from ..core.pass_framework import applied_passes
+    report = VerifyReport(diags, level=str(level),
+                          applied_passes=applied_passes(program))
+    if raise_on_error:
+        report.raise_on_error()
+    return report
+
+
+def verify_mode() -> str:
+    """The PADDLE_TPU_VERIFY env contract: "" (off), "warn" (report
+    defects as RuntimeWarnings), "strict" (raise on error diagnostics).
+    Any other truthy value (e.g. "1") means "warn"."""
+    raw = os.environ.get(VERIFY_ENV, "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return ""
+    if raw == "strict":
+        return "strict"
+    return "warn"
+
+
+def self_check(program: Program, pass_name: str,
+               startup: Optional[Program] = None):
+    """Post-rewrite self-verification hook for the rewrite passes
+    (sharding, elastic, gradient_merge, recompute, AMP): a no-op unless
+    PADDLE_TPU_VERIFY is set; in "strict" mode a pass that emitted
+    broken IR raises at the rewrite site (with the pass named), in
+    "warn" mode it warns and continues."""
+    mode = verify_mode()
+    if not mode:
+        return None
+    report = check_program(program, level="all", startup=startup)
+    if report.errors and mode == "strict":
+        raise ProgramVerificationError(report,
+                                       context=f"after pass {pass_name!r}")
+    if report.diagnostics:
+        import warnings
+        warnings.warn(
+            f"PADDLE_TPU_VERIFY: pass {pass_name!r} left "
+            f"{len(report.errors)} error(s) / {len(report.warnings)} "
+            f"warning(s):\n{report.render()}", RuntimeWarning,
+            stacklevel=3)
+    return report
+
+
+_verified_fingerprints: Set[Tuple] = set()
+
+
+def verify_first_compile(program: Program,
+                         fetch_list: Optional[Sequence] = None):
+    """First-compile hook (Executor/_run_compiled, run_steps, and
+    CompiledProgram on a trace-cache miss): verifies each distinct
+    (program, fetch set) once per process when PADDLE_TPU_VERIFY is
+    set.  Memoized by fingerprint + fetch names — the fetch set is part
+    of what gets checked (V107 missing fetch, V303 shard fetch), so a
+    later compile of the same program with new fetches re-verifies.
+    The check costs an IR walk + abstract evaluation, so it rides the
+    (already slow) compile path only."""
+    mode = verify_mode()
+    if not mode:
+        return None
+    fetch_key = tuple(sorted(
+        f.name if hasattr(f, "name") else str(f)
+        for f in (fetch_list or [])))
+    try:
+        fp = (program.fingerprint(), fetch_key)
+    except Exception:
+        fp = None
+    if fp is not None and fp in _verified_fingerprints:
+        return None
+    report = check_program(program, level="all", fetch_list=fetch_list)
+    if report.errors and mode == "strict":
+        # memoize only CLEAN outcomes: a retried run of the same broken
+        # program must hit the gate again, not the memo
+        raise ProgramVerificationError(report, context="first compile")
+    if fp is not None:
+        _verified_fingerprints.add(fp)
+    if report.diagnostics:
+        import warnings
+        warnings.warn(
+            f"PADDLE_TPU_VERIFY (first compile): {len(report.errors)} "
+            f"error(s) / {len(report.warnings)} warning(s):\n"
+            f"{report.render()}", RuntimeWarning, stacklevel=3)
+    return report

@@ -1,0 +1,610 @@
+"""IR verifier & distributed-correctness analyzer tests
+(static/verifier.py).
+
+Two halves, mirroring the acceptance contract:
+
+  * ZERO FALSE POSITIVES: every program the rewrite passes legitimately
+    produce — plain, AMP, gradient_merge, ZeRO-1, elastic, recompute,
+    and their sanctioned compositions — verifies clean in strict mode.
+  * MUTATION DETECTION: ≥10 seeded defect classes (swapped collective
+    order, mismatched ring_id, read-after-donate, rank-conditional
+    collective, dangling @GRAD, dtype clash, ...) are each caught with
+    their STABLE diagnostic code (docs/static_analysis.md) and carry
+    op/var provenance.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu.static import layers
+from paddle_tpu.static.verifier import (
+    ProgramVerificationError, check_program, collective_sequence,
+    collective_wire_bytes, self_check, verify_mode)
+from paddle_tpu.core.pass_framework import (applied_passes, has_applied,
+                                            record_applied)
+from paddle_tpu.core.program import OpDesc, OpRole, _reset_unique_names
+from paddle_tpu.distributed.sharding import shard_optimizer_states
+
+
+def build_train(opt_cls=None, lr=1e-3):
+    """Small minimized training program: (main, startup, loss)."""
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = (opt_cls or static.Adam)(learning_rate=lr)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def build_sharded(dp=8, **kw):
+    main, startup, loss = build_train(**kw)
+    plan = shard_optimizer_states(main, startup, dp_degree=dp)
+    return main, startup, loss, plan
+
+
+def assert_code(report, code):
+    hits = report.by_code(code)
+    assert hits, f"expected {code}, got {report.codes()}:\n{report.render()}"
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# zero false positives on sanctioned programs
+# ---------------------------------------------------------------------------
+class TestCleanPrograms:
+    def test_plain_training_program_is_clean(self):
+        main, startup, loss = build_train()
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss])
+        assert rep.ok and not rep.diagnostics, rep.render()
+
+    def test_every_optimizer_is_clean(self):
+        for cls in (static.SGD, static.Momentum, static.Adam,
+                    static.AdamW, static.Lamb):
+            main, startup, loss = build_train(opt_cls=cls)
+            rep = check_program(main, level="all", startup=startup,
+                                fetch_list=[loss])
+            assert not rep.diagnostics, \
+                f"{cls.__name__}:\n{rep.render()}"
+
+    def test_zero1_sharded_is_clean_and_strict_passes(self):
+        main, startup, loss, plan = build_sharded()
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss], raise_on_error=True)
+        assert not rep.diagnostics, rep.render()
+
+    def test_zero1_plus_gradient_merge_is_clean(self):
+        main, startup, loss, plan = build_sharded()
+        static.gradient_merge(main, 4, startup_program=startup)
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss])
+        assert not rep.diagnostics, rep.render()
+
+    def test_elastic_is_clean(self):
+        from paddle_tpu.distributed.elastic import elasticize
+        main, startup, loss = build_train()
+        elasticize(main, startup, logical_dp=8, loss_name=loss)
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss.name + "@ELASTIC_AVG"])
+        assert not rep.diagnostics, rep.render()
+
+    def test_amp_is_clean(self):
+        from paddle_tpu import amp
+        _reset_unique_names()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 8])
+            y = layers.data("y", [-1, 1])
+            h = layers.fc(x, 16, act="relu")
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt = amp.decorate(static.Adam(learning_rate=1e-3),
+                               use_dynamic_loss_scaling=True)
+            opt.minimize(loss, startup)
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss])
+        assert not rep.diagnostics, rep.render()
+
+    def test_recompute_is_clean(self):
+        _reset_unique_names()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 8])
+            y = layers.data("y", [-1, 1])
+            h1 = layers.fc(x, 16, act="relu")
+            h2 = layers.fc(h1, 16, act="relu")
+            pred = layers.fc(h2, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt = static.RecomputeOptimizer(
+                static.Adam(learning_rate=1e-3))
+            opt._set_checkpoints([h1])
+            opt.minimize(loss)
+        rep = check_program(main, level="all", startup=startup,
+                            fetch_list=[loss])
+        assert not rep.diagnostics, rep.render()
+        assert has_applied(main, "recompute")
+
+    def test_grad_allreduce_rewrite_is_clean(self):
+        from paddle_tpu.distributed.compiled_program import \
+            insert_grad_allreduce
+        main, startup, loss = build_train()
+        rewritten = insert_grad_allreduce(main)
+        rep = check_program(rewritten, level="all", startup=startup,
+                            fetch_list=[loss])
+        assert not rep.diagnostics, rep.render()
+        # idempotent re-apply stays clean (no V207 double reduction)
+        again = insert_grad_allreduce(rewritten)
+        rep2 = check_program(again, level="all", fetch_list=[loss])
+        assert not rep2.by_code("V207"), rep2.render()
+
+    def test_clean_program_executes_after_verification(self):
+        # verification is read-only: the verified program still runs
+        main, startup, loss = build_train()
+        check_program(main, level="all", startup=startup,
+                      fetch_list=[loss])
+        exe = static.Executor()
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            out = exe.run(main, feed={
+                "x": np.random.rand(4, 8).astype(np.float32),
+                "y": np.random.rand(4, 1).astype(np.float32)},
+                fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# mutation detection: seeded defect classes -> stable codes
+# ---------------------------------------------------------------------------
+class TestMutations:
+    def test_def_before_use_V101(self):
+        main, _, loss = build_train()
+        main.global_block().ops.insert(0, OpDesc(
+            "scale", {"X": ["never_defined"]}, {"Out": ["q"]},
+            {"scale": 1.0, "op_uid": main._next_uid()}))
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V101")
+        assert hits[0].var == "never_defined"       # provenance
+        assert hits[0].op_type == "scale"
+
+    def test_dangling_grad_var_V102(self):
+        main, _, loss = build_train()
+        blk = main.global_block()
+        blk.create_var(name="phantom@GRAD", shape=[8], dtype="float32")
+        blk.ops.append(OpDesc(
+            "fill_constant", {}, {"Out": ["phantom@GRAD"]},
+            {"shape": [8], "value": 0.0, "dtype": "float32",
+             "op_uid": main._next_uid()}))
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V102")
+        assert hits[0].var == "phantom@GRAD"
+
+    def test_dtype_clash_V103(self):
+        main, _, loss = build_train()
+        main.global_block().var(loss.name).dtype = "int32"
+        assert_code(check_program(main, fetch_list=[loss]), "V103")
+
+    def test_shape_clash_V104(self):
+        main, _, loss = build_train()
+        # corrupt a declared activation shape behind the kernel's back
+        blk = main.global_block()
+        fc_out = next(op for op in blk.ops if op.type == "mul")
+        v = blk.var(fc_out.outputs["Out"][0])
+        v.shape = tuple(d + 3 for d in v.shape)
+        assert_code(check_program(main, fetch_list=[loss]), "V104")
+
+    def test_duplicate_write_V106(self):
+        main, _, loss = build_train()
+        blk = main.global_block()
+        tmp = next(n for op in blk.ops for n in op.output_names()
+                   if not blk.var(n).persistable)
+        blk.ops.append(OpDesc(
+            "fill_constant", {}, {"Out": [tmp]},
+            {"shape": [1], "value": 0.0, "dtype": "float32",
+             "op_uid": main._next_uid()}))
+        assert_code(check_program(main, fetch_list=[loss]), "V106")
+
+    def test_feed_var_overwritten_V107(self):
+        main, _, loss = build_train()
+        main.global_block().ops.append(OpDesc(
+            "scale", {"X": ["x"]}, {"Out": ["x"]},
+            {"scale": 1.0, "op_uid": main._next_uid()}))
+        assert_code(check_program(main, fetch_list=[loss]), "V107")
+
+    def test_missing_fetch_target_V107(self):
+        main, _, _ = build_train()
+        assert_code(check_program(main, fetch_list=["no_such_var"]),
+                    "V107")
+
+    def test_unknown_op_V109(self):
+        main, _, loss = build_train()
+        main.global_block().ops.append(
+            OpDesc("totally_fake_op", {}, {}, {}))
+        assert_code(check_program(main, fetch_list=[loss]), "V109")
+
+    def test_swapped_collective_order_V201(self):
+        main, startup, loss, _ = build_sharded()
+        blk = main.global_block()
+        rs = next(i for i, op in enumerate(blk.ops)
+                  if op.type == "c_reducescatter")
+        ag = next(i for i, op in enumerate(blk.ops)
+                  if op.type == "c_allgather")
+        blk.ops[rs], blk.ops[ag] = blk.ops[ag], blk.ops[rs]
+        assert_code(check_program(main, fetch_list=[loss]), "V201")
+
+    def test_orphan_reducescatter_V201(self):
+        main, startup, loss, _ = build_sharded()
+        blk = main.global_block()
+        blk.ops = [op for op in blk.ops if op.type != "c_allgather"]
+        assert_code(check_program(main, fetch_list=[loss]), "V201")
+
+    def test_mismatched_ring_id_V202(self):
+        main, startup, loss, _ = build_sharded()
+        next(op for op in main.global_block().ops
+             if op.type == "c_allgather").attrs["ring_id"] = 1
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V202")
+        assert hits[0].op_type == "c_allgather"
+
+    def test_mismatched_dp_degree_V202(self):
+        main, startup, loss, _ = build_sharded()
+        next(op for op in main.global_block().ops
+             if op.type == "c_reducescatter").attrs["dp_degree"] = 4
+        assert_code(check_program(main, fetch_list=[loss]), "V202")
+
+    def test_indivisible_shard_V203(self):
+        main, startup, loss, _ = build_sharded()
+        rs = next(op for op in main.global_block().ops
+                  if op.type == "c_reducescatter")
+        xv = main.global_block().var(rs.inputs["X"][0])
+        xv.shape = (int(xv.shape[0]) + 1,)
+        assert_code(check_program(main, fetch_list=[loss]), "V203")
+
+    def test_dp_shard_metadata_clash_V204(self):
+        main, startup, loss, plan = build_sharded()
+        v = main.global_block().var(plan.slot_var_names()[0])
+        v.attrs["dp_shard"] = 4
+        assert_code(check_program(main, fetch_list=[loss]), "V204")
+
+    def test_rank_conditional_collective_V205(self):
+        main, _, loss = build_train()
+        sub = main.create_block()
+        main.rollback()
+        sub.ops.append(OpDesc(
+            "c_allreduce_sum", {"X": ["x"]}, {"Out": ["x"]},
+            {"ring_id": 0, "op_uid": main._next_uid()}))
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V205")
+        assert hits[0].block_idx == 1                # provenance
+
+    def test_psum_in_elastic_fold_path_V206(self):
+        from paddle_tpu.distributed.elastic import elasticize
+        main, startup, loss = build_train()
+        elasticize(main, startup, logical_dp=8, loss_name=loss)
+        blk = main.global_block()
+        blk.create_var(name="hazard_out", shape=[1], dtype="float32")
+        blk.ops.append(OpDesc(
+            "c_allreduce_sum", {"X": [loss.name]},
+            {"Out": ["hazard_out"]},
+            {"ring_id": 0, "op_uid": main._next_uid()}))
+        assert_code(check_program(main,
+                                  fetch_list=[loss.name + "@ELASTIC_AVG"]),
+                    "V206")
+
+    def test_double_reduction_V207(self):
+        from paddle_tpu.distributed.compiled_program import \
+            insert_grad_allreduce
+        main, _, loss = build_train()
+        p = insert_grad_allreduce(main)
+        blk = p.global_block()
+        ar_i, ar = next((i, op) for i, op in enumerate(blk.ops)
+                        if op.type == "c_allreduce_sum")
+        blk.create_var(name="re_reduced", shape=None, dtype="float32")
+        blk.ops.insert(ar_i + 1, OpDesc(
+            "c_allreduce_sum", {"X": [ar.outputs["Out"][0]]},
+            {"Out": ["re_reduced"]},
+            {"ring_id": 0, "op_uid": p._next_uid()}))
+        assert_code(check_program(p, fetch_list=[loss]), "V207")
+
+    def test_startup_alias_assign_V301(self):
+        main, startup, loss = build_train()
+        ps = main.all_parameters()
+        startup.global_block().ops.append(OpDesc(
+            "assign", {"X": [ps[0].name]}, {"Out": [ps[1].name]},
+            {"op_uid": startup._next_uid()}))
+        assert_code(check_program(main, startup=startup,
+                                  fetch_list=[loss]), "V301")
+
+    def test_read_after_donate_V302(self):
+        main, _, loss = build_train()
+        blk = main.global_block()
+        param = main.all_parameters()[0]
+        blk.create_var(name="post_read", shape=param.shape,
+                       dtype=param.dtype, stop_gradient=True)
+        blk.ops.append(OpDesc(
+            "scale", {"X": [param.name]}, {"Out": ["post_read"]},
+            {"scale": 2.0, OpRole.KEY: OpRole.Forward,
+             "op_uid": main._next_uid()}))
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V302")
+        assert hits[0].var == param.name
+
+    def test_fetch_of_sharded_slot_V303(self):
+        main, startup, loss, plan = build_sharded()
+        slot = plan.slot_var_names()[0]
+        assert_code(check_program(main, fetch_list=[slot]), "V303")
+
+    def test_retrace_lints_V401_V402_V403(self):
+        main, _, loss = build_train()
+        blk = main.global_block()
+        blk.create_var(name="ragged", shape=[-1, -1], dtype="float32",
+                       is_data=True)
+        blk.create_var(name="scalar_feed", shape=[], dtype="float32",
+                       is_data=True)
+        blk.ops[3].attrs["captured"] = np.zeros(3)
+        rep = check_program(main, fetch_list=[loss])
+        for code in ("V401", "V402", "V403"):
+            assert_code(rep, code)
+
+    def test_pass_order_violation_V502(self):
+        main, startup, loss, _ = build_sharded()
+        main._applied_passes = [{"pass": "gradient_merge", "k": 2},
+                                {"pass": "zero1_sharding"}]
+        assert_code(check_program(main, fetch_list=[loss]), "V502")
+
+    def test_elastic_plus_gm_V501(self):
+        main, _, loss = build_train()
+        record_applied(main, "elastic", logical_dp=8)
+        record_applied(main, "gradient_merge", k=2)
+        assert_code(check_program(main, fetch_list=[loss]), "V501")
+
+    def test_elastic_plus_zero1_V503(self):
+        main, _, loss = build_train()
+        record_applied(main, "zero1_sharding", dp_degree=8)
+        record_applied(main, "elastic", logical_dp=8)
+        assert_code(check_program(main, fetch_list=[loss]), "V503")
+
+
+# ---------------------------------------------------------------------------
+# API surface: levels, suppression, strict mode, env gating
+# ---------------------------------------------------------------------------
+class TestApi:
+    def test_levels_are_cumulative(self):
+        main, _, loss = build_train()
+        sub = main.create_block()
+        main.rollback()
+        sub.ops.append(OpDesc(
+            "c_allreduce_sum", {"X": ["x"]}, {"Out": ["x"]},
+            {"ring_id": 0, "op_uid": main._next_uid()}))
+        graph_only = check_program(main, level="graph",
+                                   fetch_list=[loss])
+        assert not graph_only.by_code("V205")
+        for level in ("collective", "donation", "retrace", "all", 2, 4):
+            assert check_program(main, level=level,
+                                 fetch_list=[loss]).by_code("V205")
+
+    def test_unknown_level_raises(self):
+        main, _, _ = build_train()
+        with pytest.raises(ValueError):
+            check_program(main, level="bogus")
+
+    def test_suppress_allowlists_codes(self):
+        main, _, loss = build_train()
+        main.global_block().ops.append(
+            OpDesc("totally_fake_op", {}, {}, {}))
+        rep = check_program(main, fetch_list=[loss], suppress=("V109",))
+        assert not rep.by_code("V109")
+
+    def test_raise_on_error(self):
+        main, _, loss = build_train()
+        main.global_block().ops.append(
+            OpDesc("totally_fake_op", {}, {}, {}))
+        with pytest.raises(ProgramVerificationError) as ei:
+            check_program(main, fetch_list=[loss], raise_on_error=True)
+        assert "V109" in str(ei.value)
+
+    def test_env_gated_self_check(self, monkeypatch):
+        main, _, loss = build_train()
+        main.global_block().ops.append(
+            OpDesc("totally_fake_op", {}, {}, {}))
+        monkeypatch.delenv("PADDLE_TPU_VERIFY", raising=False)
+        assert verify_mode() == ""
+        assert self_check(main, "unit") is None      # off: free
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "warn")
+        with pytest.warns(RuntimeWarning, match="V109"):
+            self_check(main, "unit")
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+        with pytest.raises(ProgramVerificationError, match="unit"):
+            self_check(main, "unit")
+
+    def test_strict_first_compile_catches_broken_program(self, monkeypatch):
+        from paddle_tpu.static import verifier as V
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+        main, startup, loss = build_train()
+        blk = main.global_block()
+        blk.ops.insert(0, OpDesc(
+            "scale", {"X": ["never_defined"]}, {"Out": ["q"]},
+            {"scale": 1.0, "op_uid": main._next_uid()}))
+        main._fingerprint_cache = None
+        exe = static.Executor()
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            with pytest.raises(ProgramVerificationError):
+                exe.run(main, feed={
+                    "x": np.zeros((2, 8), np.float32),
+                    "y": np.zeros((2, 1), np.float32)},
+                    fetch_list=[loss])
+
+    def test_strict_gate_holds_on_retry(self, monkeypatch):
+        # the memo records only CLEAN outcomes: re-running the same
+        # broken program must hit the gate again, not the memo
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+        main, startup, loss = build_train()
+        main.global_block().ops.insert(0, OpDesc(
+            "scale", {"X": ["never_defined"]}, {"Out": ["q"]},
+            {"scale": 1.0, "op_uid": main._next_uid()}))
+        main._fingerprint_cache = None
+        exe = static.Executor()
+        scope = static.Scope()
+        feed = {"x": np.zeros((2, 8), np.float32),
+                "y": np.zeros((2, 1), np.float32)}
+        with static.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(2):
+                with pytest.raises(ProgramVerificationError):
+                    exe.run(main, feed=feed, fetch_list=[loss])
+
+    def test_first_compile_reverifies_new_fetch_set(self, monkeypatch):
+        # the memo keys on (fingerprint, fetch set): a later compile of
+        # the SAME program fetching a ZeRO shard must still raise V303
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+        main, startup, loss, plan = build_sharded()
+        slot = plan.slot_var_names()[0]
+        exe = static.Executor()
+        scope = static.Scope()
+        feed = {"x": np.zeros((8, 8), np.float32),
+                "y": np.zeros((8, 1), np.float32)}
+        from paddle_tpu.distributed.compiled_program import CompiledProgram
+        compiled = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        with static.scope_guard(scope):
+            exe.run(startup)
+            exe.run(compiled, feed=feed, fetch_list=[loss])  # clean
+            with pytest.raises(ProgramVerificationError, match="V303"):
+                exe.run(compiled, feed=feed, fetch_list=[slot])
+
+    def test_strict_mode_clean_program_runs(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+        main, startup, loss = build_train()
+        exe = static.Executor()
+        scope = static.Scope()
+        with static.scope_guard(scope):
+            exe.run(startup)
+            out = exe.run(main, feed={
+                "x": np.random.rand(4, 8).astype(np.float32),
+                "y": np.random.rand(4, 1).astype(np.float32)},
+                fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# applied-passes registry (core/pass_framework.py)
+# ---------------------------------------------------------------------------
+class TestAppliedPassRegistry:
+    def test_rewrites_record_in_order(self):
+        main, startup, loss, plan = build_sharded()
+        static.gradient_merge(main, 2, startup_program=startup)
+        names = [e["pass"] for e in applied_passes(main)]
+        assert names == ["zero1_sharding", "gradient_merge"]
+        assert applied_passes(main)[0]["dp_degree"] == 8
+        assert applied_passes(main)[1]["k"] == 2
+
+    def test_registry_survives_clone(self):
+        main, startup, loss, _ = build_sharded()
+        assert has_applied(main.clone(), "zero1_sharding")
+
+    def test_gradient_merge_refuses_double_apply(self):
+        main, startup, loss = build_train()
+        static.gradient_merge(main, 2, startup_program=startup)
+        with pytest.raises(ValueError, match="already applied"):
+            static.gradient_merge(main, 2, startup_program=startup)
+
+    def test_elastic_refuses_on_registry_alone(self):
+        from paddle_tpu.distributed.elastic import elasticize
+        main, startup, loss = build_train()
+        record_applied(main, "gradient_merge", k=2)
+        with pytest.raises(NotImplementedError):
+            elasticize(main, startup, logical_dp=8, loss_name=loss)
+
+    def test_apply_passes_records(self):
+        from paddle_tpu.core.pass_framework import apply_passes
+        main, _, _ = build_train()
+        out = apply_passes(main, ["dead_code_elimination_pass"])
+        assert has_applied(out, "dead_code_elimination_pass")
+
+
+# ---------------------------------------------------------------------------
+# collective-sequence extraction (the planner substrate)
+# ---------------------------------------------------------------------------
+class TestCollectiveSequence:
+    def test_zero1_sequence_order_and_metadata(self):
+        main, startup, loss, plan = build_sharded()
+        seq = collective_sequence(main)
+        types = [e["type"] for e in seq]
+        assert types.index("c_reducescatter") < types.index("c_allgather")
+        for e in seq:
+            if e["type"] in ("c_reducescatter", "c_allgather"):
+                assert e["dp_degree"] == 8
+                assert e["ring_id"] == 0
+                assert e["nbytes"] and e["nbytes"] > 0
+
+    def test_wire_bytes_matches_sharding_accounting(self):
+        # the verifier's ring-cost model agrees with the bench's
+        # (sharding.collective_bytes_per_step) on the ops both model
+        from paddle_tpu.distributed.sharding import \
+            collective_bytes_per_step
+        main, startup, loss, _ = build_sharded()
+        ours = collective_wire_bytes(main, 8, ring_id=0)
+        theirs = collective_bytes_per_step(main, 8)
+        # ours also counts the c_split rank-slice; theirs is rs+ag only
+        assert ours >= theirs > 0
+
+    def test_world_of_one_costs_zero(self):
+        main, startup, loss, _ = build_sharded()
+        assert collective_wire_bytes(main, 1) == 0
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_check_nan_inf: producing-op provenance (satellite)
+# ---------------------------------------------------------------------------
+class TestNanInfProvenance:
+    def _poisoned(self):
+        _reset_unique_names()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [-1, 4])
+            h = layers.fc(x, 4)
+            bad = layers.log(layers.scale(h, scale=0.0))  # log(0) = -inf
+            loss = layers.mean(bad)
+        return main, startup, loss
+
+    def test_reports_producing_op_and_dtype(self):
+        from paddle_tpu.core.flags import set_flags
+        main, startup, loss = self._poisoned()
+        exe = static.Executor()
+        scope = static.Scope()
+        set_flags({"check_nan_inf": True})
+        try:
+            with static.scope_guard(scope):
+                exe.run(startup)
+                with pytest.raises(RuntimeError) as ei:
+                    exe.run(main, feed={
+                        "x": np.ones((2, 4), np.float32)},
+                        fetch_list=[loss])
+        finally:
+            set_flags({"check_nan_inf": False})
+        msg = str(ei.value)
+        assert "float32" in msg                      # dtype
+        assert "produced by op" in msg and "uid" in msg
+
+    def test_run_steps_reports_micro_step(self):
+        from paddle_tpu.core.flags import set_flags
+        _reset_unique_names()
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = layers.data("x", [2, 4])
+            out = layers.log(layers.reduce_sum(x))   # log(<=0) poisons
+        exe = static.Executor()
+        scope = static.Scope()
+        # step 0 finite, step 1 non-finite
+        feed = {"x": np.stack([np.ones((2, 4), np.float32),
+                               np.zeros((2, 4), np.float32)])}
+        set_flags({"check_nan_inf": True})
+        try:
+            with static.scope_guard(scope):
+                with pytest.raises(RuntimeError) as ei:
+                    exe.run_steps(main, feed=feed, fetch_list=[out])
+        finally:
+            set_flags({"check_nan_inf": False})
+        assert "micro-step 1" in str(ei.value)
