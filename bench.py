@@ -781,7 +781,9 @@ def main():
         if megastep > 0:
             n_steps = megastep
             if prof_dir:
+                from paddle_tpu.profiler import set_device_trace_active
                 jax.profiler.start_trace(prof_dir)
+                set_device_trace_active(True)
             t0 = time.time()
             out = exe.run_steps(main_p, feed=sfeed, fetch_list=[loss])
             np.asarray(out[0])
@@ -797,7 +799,9 @@ def main():
             compile_time_s = time.time() - tc
             warm_traces = exe.cache_stats()["traces"]
             if prof_dir:
+                from paddle_tpu.profiler import set_device_trace_active
                 jax.profiler.start_trace(prof_dir)
+                set_device_trace_active(True)
             t0 = time.time()
             # steps WITHOUT per-step fetches: state buffers are donated
             # and stay on device, dispatch runs ahead of the chip; only
@@ -825,21 +829,41 @@ def main():
             assert exe.cache_stats()["traces"] == warm_traces, \
                 "recompile inside the timed loop"
         if prof_dir:
+            from paddle_tpu.profiler import set_device_trace_active
             jax.profiler.stop_trace()
+            set_device_trace_active(False)
 
     tokens_per_sec = n_steps * batch * seq / dt
 
-    # MFU accounting: 6 * params * tokens (fwd+bwd matmul flops) PLUS the
-    # attention score/context matmuls the params-only count misses —
-    # QK^T and PV are each 2*s*hidden flops per token per layer forward,
-    # 3x that with backward: 12 * L * s * hidden per token
+    # MFU accounting, twice over and cross-checked:
+    #   analytic — 6 * params * tokens (fwd+bwd matmul flops) PLUS the
+    #   attention score/context matmuls the params-only count misses —
+    #   QK^T and PV are each 2*s*hidden flops per token per layer
+    #   forward, 3x that with backward: 12 * L * s * hidden per token;
+    #   exact — static.analyze_flops walks the ACTUAL op list (so remat
+    #   replays, ring degradation, AMP rewrites are all priced).  Both
+    #   ride the JSON; >10% drift on a plain build means either the
+    #   walker regressed or the analytic constants went stale, and the
+    #   bench says so instead of silently reporting two truths.
     n_params = sum(
         int(np.prod(v.shape)) for v in main_p.all_parameters()
         if v.shape is not None)
     flops_per_token = 6 * n_params + 12 * layers_n * seq * hidden
+    analytic_step_flops = flops_per_token * batch * seq
+    walker_step_flops = static.analyze_flops(
+        main_p, batch=batch)["total_flops"]
+    flops_drift = walker_step_flops / analytic_step_flops - 1.0
+    if abs(flops_drift) > 0.10 and not remat_mode:
+        sys.stderr.write(
+            f"bench: WARNING analyze_flops ({walker_step_flops:.3e}) "
+            f"drifts {flops_drift * 100:+.1f}% from the analytic "
+            f"estimate ({analytic_step_flops:.3e}) — walker regression "
+            f"or stale analytic constants?\n")
     achieved = tokens_per_sec * flops_per_token
-    peak = 197e12 if on_tpu else 0  # v5e bf16 peak
+    peak = static.peak_flops_per_chip("tpu" if on_tpu else "cpu")
     mfu = achieved / peak if peak else 0.0
+    mfu_exact = (tokens_per_sec / (batch * seq)) * walker_step_flops \
+        / peak if peak else 0.0
 
     stats = exe.cache_stats()
     result = {
@@ -855,6 +879,11 @@ def main():
         "predicted_peak_bytes": _mem["peak_bytes"],
         "predicted_fits": _mem["fits"],
         "hbm_budget_bytes": _mem["budget_bytes"],
+        # per-op FLOPs accounting (static/flops_analysis.py): the exact
+        # walked step cost next to the analytic formula, + their drift
+        "flops_per_step_walked": walker_step_flops,
+        "flops_per_step_analytic": analytic_step_flops,
+        "flops_drift_pct": round(flops_drift * 100, 2),
         "cache": {
             "persistent_dir": stats["persistent_dir"],
             "warm_start": bool(warm_entries),
@@ -876,6 +905,7 @@ def main():
         result["optimizer_slot_bytes"] = _mem["optimizer_slot_bytes"]
     if on_tpu:
         result["mfu"] = round(mfu, 4)
+        result["mfu_exact"] = round(mfu_exact, 4)
     else:
         # ANY CPU run is a FAILED perf run for the north-star record, and
         # says so explicitly — the driver must not read CPU tokens/s as

@@ -33,7 +33,7 @@ def _setup_api():
                 "hapi", "jit", "incubate", "profiler", "utils", "slim",
                 "reader", "dataset", "fluid", "regularizer",
                 "distribution", "compat", "sysconfig", "framework",
-                "serving", "checkpoint"):
+                "serving", "checkpoint", "observability"):
         try:
             importlib.import_module(f".{mod}", __name__)
         except ImportError:

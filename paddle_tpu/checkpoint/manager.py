@@ -302,11 +302,14 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         stat_add("checkpoint.bytes_written", nbytes)
+        from ..observability.journal import emit as _jemit
+        _jemit("checkpoint_save", step=int(job.step), bytes=int(nbytes))
         if self.world_size == 1:
             # manifest.json is the commit marker; the rename publishes it
             commit_dir(stage, final, fsync=False)  # files fsync'd above
             fsync_path(self.root)
             self._gc()
+            _jemit("checkpoint_commit", step=int(job.step), path=final)
         # world_size > 1: every rank only STAGES here.  Publishing is a
         # separate step — the caller barriers across hosts, then rank 0
         # calls commit(step).  Committing inside save() would let rank 0
@@ -340,6 +343,9 @@ class CheckpointManager:
         commit_dir(stage, self.step_dir(step))
         fsync_path(self.root)
         self._gc()
+        from ..observability.journal import emit as _jemit
+        _jemit("checkpoint_commit", step=int(step),
+               path=self.step_dir(step))
 
     def _note_saved(self, step: int, seconds: float) -> None:
         stat_add("checkpoint.saves")

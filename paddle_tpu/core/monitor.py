@@ -10,13 +10,14 @@ keep a bounded reservoir of observations with percentile snapshots
 from __future__ import annotations
 
 import random
+import re
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 __all__ = ["StatRegistry", "stat_add", "stat_get", "stat_reset",
            "all_stats", "stats_with_prefix", "gauge_set", "gauge_get",
            "hist_observe", "hist_snapshot", "monitor_snapshot",
-           "HISTOGRAM_RESERVOIR"]
+           "prometheus_text", "HISTOGRAM_RESERVOIR"]
 
 # bounded reservoir per histogram: big enough for faithful tail
 # percentiles at serving scale, small enough to never grow unboundedly
@@ -63,7 +64,7 @@ class _Reservoir:
             return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
 
         return {"count": self.count, "min": self.min, "max": self.max,
-                "mean": self.total / self.count,
+                "mean": self.total / self.count, "sum": self.total,
                 "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
 
 
@@ -87,9 +88,27 @@ class StatRegistry:
                 cls._instance = cls()
             return cls._instance
 
+    def _guard_kind(self, name: str, kind: str):
+        """Refuse a cross-kind name collision at registration time.  A
+        counter, gauge and histogram sharing one name used to silently
+        overwrite each other in full_snapshot (last dict.update wins),
+        so /stats lied about two of the three.  Registration is where
+        the collision is cheap to name; the merged /stats payload stays
+        exactly as before for every legal (collision-free) name."""
+        others = (("counter", self._stats), ("gauge", self._gauges),
+                  ("histogram", self._hists))
+        for other_kind, store in others:
+            if other_kind != kind and name in store:
+                raise ValueError(
+                    f"metric {name!r} is already registered as a "
+                    f"{other_kind}; refusing to shadow it with a {kind} "
+                    f"(the merged /stats snapshot would silently drop "
+                    f"one of them — pick a distinct name)")
+
     # -- counters (monotonic int64, the reference surface) ------------------
     def add(self, name: str, value: int = 1):
         with self._mu:
+            self._guard_kind(name, "counter")
             self._stats[name] = self._stats.get(name, 0) + int(value)
 
     def get(self, name: str) -> int:
@@ -110,6 +129,7 @@ class StatRegistry:
     # -- gauges (last-written value; may go down) ---------------------------
     def set_gauge(self, name: str, value: float):
         with self._mu:
+            self._guard_kind(name, "gauge")
             self._gauges[name] = value
 
     def get_gauge(self, name: str, default: float = 0) -> float:
@@ -121,6 +141,7 @@ class StatRegistry:
         with self._mu:
             h = self._hists.get(name)
             if h is None:
+                self._guard_kind(name, "histogram")
                 h = self._hists[name] = _Reservoir()
             h.observe(value)
 
@@ -145,6 +166,106 @@ class StatRegistry:
             out.update({k: h.snapshot() for k, h in self._hists.items()
                         if k.startswith(prefix)})
             return out
+
+    # -- Prometheus text exposition -----------------------------------------
+    def prometheus_text(self, prefix: str = "",
+                        labels: Optional[Dict[str, str]] = None) -> str:
+        """Render every counter/gauge/histogram under ``prefix`` in the
+        Prometheus text exposition format (version 0.0.4) — the /metrics
+        payload any scraper understands, unlike /stats' ad-hoc JSON.
+
+        Counters render as ``<name>_total`` (TYPE counter), gauges as-is
+        (TYPE gauge), histograms as TYPE summary: one series per
+        retained quantile (p50/p95/p99 from the bounded reservoir) plus
+        ``_sum``/``_count``.  Dotted registry names sanitize to the
+        metric charset (``serving.latency_ms`` ->
+        ``serving_latency_ms``); the original name rides in the HELP
+        line.  `labels` (e.g. ``{"rank": "0"}``) attach to every series,
+        values escaped per the spec."""
+        with self._mu:
+            counters = {k: v for k, v in self._stats.items()
+                        if k.startswith(prefix)}
+            gauges = {k: v for k, v in self._gauges.items()
+                      if k.startswith(prefix)}
+            hists = {k: h.snapshot() for k, h in self._hists.items()
+                     if k.startswith(prefix)}
+        lines: List[str] = []
+
+        def series(name, value, extra_labels=None):
+            lab = dict(labels or {})
+            lab.update(extra_labels or {})
+            if lab:
+                body = ",".join(
+                    f'{_sanitize_metric(k)}="{_escape_label_value(v)}"'
+                    for k, v in sorted(lab.items()))
+                return f"{name}{{{body}}} {_fmt_value(value)}"
+            return f"{name} {_fmt_value(value)}"
+
+        for k in sorted(counters):
+            n = _sanitize_metric(k)
+            if not n.endswith("_total"):
+                n += "_total"
+            lines.append(f"# HELP {n} {_escape_help(k)} (counter)")
+            lines.append(f"# TYPE {n} counter")
+            lines.append(series(n, int(counters[k])))
+        for k in sorted(gauges):
+            n = _sanitize_metric(k)
+            lines.append(f"# HELP {n} {_escape_help(k)} (gauge)")
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(series(n, gauges[k]))
+        for k in sorted(hists):
+            snap = hists[k]
+            n = _sanitize_metric(k)
+            lines.append(f"# HELP {n} {_escape_help(k)} "
+                         "(reservoir percentiles)")
+            lines.append(f"# TYPE {n} summary")
+            if snap.get("count", 0):
+                for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                               ("0.99", "p99")):
+                    lines.append(series(n, snap[key], {"quantile": q}))
+            lines.append(series(n + "_sum", snap.get("sum", 0.0)))
+            lines.append(series(n + "_count", snap.get("count", 0)))
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+# metric names: [a-zA-Z_:][a-zA-Z0-9_:]*; label names: no colon.  The
+# registry's dotted names map '.' (and anything else illegal) to '_'.
+_METRIC_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize_metric(name: str) -> str:
+    out = _METRIC_BAD.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape_label_value(v) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return format(f, ".10g")
+
+
+def prometheus_text(prefix: str = "", labels=None) -> str:
+    """Prometheus text-exposition dump of the process registry (the
+    /metrics payload; `StatRegistry.prometheus_text`)."""
+    return StatRegistry.instance().prometheus_text(prefix, labels)
 
 
 def stat_add(name, value=1):

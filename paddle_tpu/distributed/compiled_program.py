@@ -371,6 +371,9 @@ class CompiledProgram:
             # world before deriving seeds from it
             rebase = getattr(executor, "_elastic_rebase_global", None)
             if rebase is not None:
+                from ..observability.journal import emit as _jemit
+                _jemit("reanchor", world=int(n_dev), k=int(micro_k),
+                       global_step=int(rebase))
                 executor._step = int(rebase) * micro_k
                 executor._elastic_steps = int(rebase) * micro_k
                 # the restore re-derived the persistable micro counter
@@ -406,6 +409,9 @@ class CompiledProgram:
             verify_first_compile(program, fetch_list=fetch_names)
             _ccache.record_miss()
             _ccache.record_trace()
+            from ..observability.journal import emit as _jemit
+            _jemit("compile", mode="compiled", world=int(n_dev),
+                   fingerprint=str(key[0])[:16])
             fn = self._compile(program, state_names, sorted(feed_vals),
                                fetch_names, mesh)
             self._cache[key] = fn

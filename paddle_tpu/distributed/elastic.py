@@ -327,6 +327,9 @@ def reanchor_topology(executor, program, scope, world: int) -> int:
     if executor._ckpt is not None:
         # periodic-checkpoint cadence is denominated in micro-steps too
         executor._ckpt.last = executor._step
+    from ..observability.journal import emit as _jemit
+    _jemit("reanchor", world=int(world), k=int(k_new), global_step=int(g),
+           replayed_micro=int(j))
     return g
 
 

@@ -55,6 +55,17 @@ def _parse_args(argv=None):
                    help="elastic relaunch budget before giving up")
     p.add_argument("--term_grace", type=float, default=10.0,
                    help="seconds between SIGTERM and SIGKILL at teardown")
+    p.add_argument("--heartbeat_dir", type=str, default=None,
+                   help="arm progress-based supervision: workers write "
+                        "per-rank heartbeat files here each train step "
+                        "(PADDLE_TPU_HEARTBEAT_DIR is exported to them); "
+                        "a live rank whose heartbeat goes stale past "
+                        "--stall_timeout is torn down like a dead one "
+                        "(wedged-in-a-dead-collective detection)")
+    p.add_argument("--stall_timeout", type=float, default=300.0,
+                   help="seconds without a heartbeat before a rank "
+                        "counts as stalled (must out-wait the longest "
+                        "legitimate step, first-step compile included)")
     p.add_argument("--server_num", type=int, default=None)
     p.add_argument("--worker_num", type=int, default=None)
     p.add_argument("--servers", type=str, default="")
@@ -114,6 +125,7 @@ def launch_collective(args):
             "a cross-host coordinator (docs/elastic.md)\n")
         return 2
     logical_world = nproc * n_ips
+    hb_dir = args.heartbeat_dir
     restarts = 0
     while True:
         envs = {}
@@ -121,25 +133,61 @@ def launch_collective(args):
             envs = {"PADDLE_TPU_ELASTIC": "1",
                     "PADDLE_TPU_ELASTIC_LOGICAL_WORLD": str(logical_world),
                     "PADDLE_TPU_ELASTIC_RESTART": str(restarts)}
+        if hb_dir:
+            # progress-based supervision (docs/observability.md): the
+            # workers beat per train step; stale heartbeats from a
+            # previous incarnation must not trip the NEW pod before its
+            # first step, so the dir is swept at every (re)spawn
+            envs["PADDLE_TPU_HEARTBEAT_DIR"] = hb_dir
+            os.makedirs(hb_dir, exist_ok=True)
+            for name in os.listdir(hb_dir):
+                if name.startswith("heartbeat.rank"):
+                    try:
+                        os.unlink(os.path.join(hb_dir, name))
+                    except OSError:
+                        pass
         cluster, procs = _spawn_pod(args, nproc, envs)
-        failed = []
+        failed, stalled = [], []
         try:
             while True:
                 procs, _done, failed = poll_local_trainers(procs)
                 if failed or not procs:
                     break
+                if hb_dir:
+                    from ..observability.heartbeat import stalled_ranks
+                    stalled = stalled_ranks(
+                        hb_dir, args.stall_timeout,
+                        ranks=[tp.rank for tp in procs])
+                    if stalled:
+                        break
                 time.sleep(0.5)
         except KeyboardInterrupt:
             terminate_procs(procs, sigterm_grace=args.term_grace)
             return 1
-        if not failed:
+        if not failed and not stalled:
             return 0
-        codes = {tp.rank: tp.proc.poll() for tp in failed}
+        if failed:
+            codes = {tp.rank: tp.proc.poll() for tp in failed}
+        else:
+            # a wedged rank never exits on its own: a stale heartbeat IS
+            # the failure signal, and the teardown below is what turns
+            # "hangs forever" into "re-forms and finishes"
+            codes = {r: "stalled" for r in stalled}
+            sys.stderr.write(
+                f"trainer rank(s) {stalled} stalled: no heartbeat for "
+                f"{args.stall_timeout}s — treating as lost\n")
         # fail fast: peers of a dead rank are wedged in the next
         # collective — tear the pod down (SIGTERM lets their preemption
         # handlers checkpoint) instead of letting them hang
         terminate_procs(procs + failed, sigterm_grace=args.term_grace)
-        survivors = nproc - len(failed)
+        survivors = nproc - len(failed) - len(stalled)
+        if survivors < 1 and stalled and not failed:
+            # stall-only teardown: every process was ALIVE and the host
+            # answered — the capacity exists even though progress froze
+            # (on a real mesh one wedged collective stalls every peer's
+            # heartbeat at once).  Re-form minimally instead of declaring
+            # the fleet gone; --max_restarts still bounds the loop.
+            survivors = 1
         if not args.elastic or restarts >= args.max_restarts:
             sys.stderr.write(
                 f"trainer rank(s) {sorted(codes)} exited non-zero "

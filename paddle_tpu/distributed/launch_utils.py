@@ -180,17 +180,36 @@ def poll_local_trainers(procs: List[TrainerProc]):
     return alive, done, failed
 
 
-def watch_local_trainers(procs: List[TrainerProc], nranks) -> List[TrainerProc]:
+def watch_local_trainers(procs: List[TrainerProc], nranks,
+                         heartbeat_dir=None,
+                         stall_timeout_s=None) -> List[TrainerProc]:
     """Poll children; on any non-zero exit FAIL FAST — kill the whole pod
     (SIGTERM→grace→SIGKILL) and raise.  A dead rank's peers are blocked
     inside the next collective and will never make progress; silently
     dropping the dead rank and waiting on the survivors hangs the job
-    forever (the watchdog, launch_utils.py watch_local_trainers)."""
+    forever (the watchdog, launch_utils.py watch_local_trainers).
+
+    With `heartbeat_dir` + `stall_timeout_s`, a LIVE rank whose last
+    heartbeat is older than the deadline gets the same treatment as a
+    dead one: a rank wedged inside a dead collective never exits, so
+    process liveness alone would watch the job hang forever
+    (docs/observability.md "rank heartbeats")."""
     alive, _done, failed = poll_local_trainers(procs)
-    if failed:
+    stalled: List[int] = []
+    if not failed and heartbeat_dir and stall_timeout_s:
+        from ..observability.heartbeat import stalled_ranks
+        live = [tp.rank for tp in alive]
+        stalled = stalled_ranks(heartbeat_dir, float(stall_timeout_s),
+                                ranks=live)
+    if failed or stalled:
         terminate_procs(procs)
-        codes = {tp.rank: tp.proc.poll() for tp in failed}
+        if failed:
+            codes = {tp.rank: tp.proc.poll() for tp in failed}
+            raise RuntimeError(
+                f"trainer rank(s) {sorted(codes)} exited with code(s) "
+                f"{codes}; job aborted ({nranks} ranks)")
         raise RuntimeError(
-            f"trainer rank(s) {sorted(codes)} exited with code(s) "
-            f"{codes}; job aborted ({nranks} ranks)")
+            f"trainer rank(s) {stalled} stalled (no heartbeat for "
+            f"{stall_timeout_s}s — wedged in a dead collective?); pod "
+            f"torn down ({nranks} ranks)")
     return alive

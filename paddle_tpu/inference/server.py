@@ -19,7 +19,11 @@ instead of FFI:
     GET  /health             -> {"status": "loading|ok|draining"}
                                 (non-"ok" replies are 503: readiness)
     GET  /stats              -> serving.* monitor snapshot + predictor
-                                cache stats
+                                cache stats (ad-hoc JSON, kept for
+                                in-process clients and the bench)
+    GET  /metrics            -> the same registry in Prometheus text
+                                exposition format (core.monitor.
+                                prometheus_text) — the scrape target
 
 `go/paddle/predictor.go` and `r/paddle.R` in the repo root are the
 reference-shaped clients for this protocol.
@@ -102,6 +106,15 @@ class _Handler(BaseHTTPRequestHandler):
                               "outputs": p.get_output_names()})
         elif self.path == "/stats":
             self._reply(200, srv.stats())
+        elif self.path == "/metrics":
+            from ..core.monitor import prometheus_text
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 

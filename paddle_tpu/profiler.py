@@ -22,7 +22,14 @@ from typing import Dict, List, Optional
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "RecordEvent", "record_event", "cuda_profiler",
-           "npu_profiler", "export_chrome_tracing"]
+           "npu_profiler", "export_chrome_tracing",
+           "set_device_trace_active"]
+
+# sentinel jax_trace_dir value: a device trace started OUTSIDE
+# start_profiler (e.g. bench.py calling jax.profiler.start_trace
+# directly) — RecordEvent annotates into it, but stop_profiler must not
+# stop a trace it does not own
+_EXTERNAL_TRACE = "<external>"
 
 
 class _Event:
@@ -68,12 +75,13 @@ def stop_profiler(sorted_key="total", profile_path="/tmp/profile"):
     chrome trace next to profile_path."""
     with _state.lock:
         _state.enabled = False
-        if _state.jax_trace_dir:
+        if _state.jax_trace_dir and _state.jax_trace_dir != _EXTERNAL_TRACE:
             try:
                 import jax
                 jax.profiler.stop_trace()
             except (ImportError, RuntimeError):
                 pass
+        if _state.jax_trace_dir != _EXTERNAL_TRACE:
             _state.jax_trace_dir = None
         events = list(_state.events)
     _print_summary(events, sorted_key)
@@ -85,6 +93,18 @@ def reset_profiler():
     with _state.lock:
         _state.events = []
         _state.t0 = time.perf_counter()
+
+
+def set_device_trace_active(active: bool = True):
+    """Tell RecordEvent a device trace started OUTSIDE start_profiler
+    (jax.profiler.start_trace called directly — bench's BENCH_PROFILE
+    path) is live, so host annotations keep nesting into it; pass False
+    after stopping it.  start_profiler-owned traces need no call."""
+    with _state.lock:
+        if active:
+            _state.jax_trace_dir = _EXTERNAL_TRACE
+        elif _state.jax_trace_dir == _EXTERNAL_TRACE:
+            _state.jax_trace_dir = None
 
 
 def _print_summary(events: List[_Event], sorted_key):
@@ -122,10 +142,33 @@ def export_chrome_tracing(path: str, events: Optional[List[_Event]] = None):
     return path
 
 
+# jax.profiler cached ONCE (None = not yet resolved, False = absent):
+# RecordEvent.__enter__ sits inside Executor.run, and re-running the
+# import machinery + constructing a TraceAnnotation on every step cost
+# real hot-path time even with the profiler disabled
+_jax_profiler = None
+
+
+def _resolve_jax_profiler():
+    global _jax_profiler
+    if _jax_profiler is None:
+        try:
+            import jax
+            _jax_profiler = jax.profiler
+        except (ImportError, AttributeError):
+            _jax_profiler = False
+    return _jax_profiler
+
+
 class RecordEvent:
     """RAII host annotation (platform/profiler.h:127).  Also usable as a
-    decorator/context; nests with jax's TraceAnnotation so host events
-    appear in the device trace."""
+    decorator/context; while a device trace is active (start_profiler
+    with trace_dir) it nests a jax TraceAnnotation so host events appear
+    in the device trace.  With no device trace the annotation is skipped
+    entirely — the disabled-profiler cost is two attribute reads, not an
+    import plus a TraceAnnotation per call."""
+
+    __slots__ = ("name", "_t", "_jax_ctx")
 
     def __init__(self, name: str):
         self.name = name
@@ -135,12 +178,11 @@ class RecordEvent:
     def __enter__(self):
         if _state.enabled:
             self._t = time.perf_counter() - _state.t0
-        try:
-            import jax
-            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
-            self._jax_ctx.__enter__()
-        except (ImportError, AttributeError):
-            self._jax_ctx = None
+        if _state.jax_trace_dir is not None:
+            prof = _resolve_jax_profiler()
+            if prof:
+                self._jax_ctx = prof.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
         return self
 
     def __exit__(self, *a):

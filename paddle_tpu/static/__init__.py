@@ -21,6 +21,10 @@ from .memory_analysis import (  # noqa: F401
 )
 from .optimizer import gradient_merge  # noqa: F401
 from . import memory_analysis  # noqa: F401
+from .flops_analysis import (  # noqa: F401
+    analyze_flops, estimate_step_flops, peak_flops_per_chip,
+)
+from . import flops_analysis  # noqa: F401
 from .verifier import (  # noqa: F401
     check_program, collective_sequence, collective_wire_bytes,
     VerifyReport, Diagnostic, ProgramVerificationError,

@@ -191,6 +191,20 @@ def _unwrap_program(program):
     return program
 
 
+def _wrapper_chips(program) -> int:
+    """Device count of an executable wrapper's (already-built) mesh —
+    the MFU denominator must scale with the chips that shared the step.
+    Falls back to 1 when no mesh is discoverable."""
+    for obj in (program, getattr(program, "_compiled", None)):
+        mesh = getattr(obj, "_mesh", None) if obj is not None else None
+        if mesh is not None:
+            try:
+                return max(1, int(len(mesh.devices.flat)))
+            except Exception:
+                pass
+    return 1
+
+
 _OPTIMIZER_OP_TYPES = frozenset(
     ("sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop", "lamb",
      "lars_momentum", "dgc_momentum", "ftrl", "adamax", "adadelta"))
@@ -266,6 +280,10 @@ class Executor:
         self._ckpt_barrier = None
         self._active_prefetcher = None
         self.last_restored_extra = None  # sidecar of the last resume
+        # telemetry (docs/observability.md): chip peak FLOPs/s resolved
+        # once per executor (None = not yet; 0.0 = unknown -> no MFU)
+        self._peak_flops = None
+        self._observed_steps = 0
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -278,8 +296,12 @@ class Executor:
             # CompiledProgram / Pipeline / PS trainer program dispatch.
             # The checkpoint hook still fires: multi-chip pretraining is
             # the workload the checkpoint tier exists for.
+            import time as _time
+            _t0 = _time.perf_counter()
             results = program._run(self, feed, fetch_list, scope,
                                    return_numpy)
+            self._observe_step(program, _time.perf_counter() - _t0,
+                               feed or {}, chips=_wrapper_chips(program))
             # resolve the scope the wrapper actually ran in: some wrappers
             # (ParallelExecutor) carry their own _scope — snapshotting
             # global_scope() instead would commit an EMPTY checkpoint
@@ -315,7 +337,9 @@ class Executor:
         from ..core.flags import flag
         from ..core.monitor import stat_add
         from ..profiler import RecordEvent
+        import time as _time
         stat_add("executor_run_times")
+        _t0 = _time.perf_counter()
         with RecordEvent("Executor::Run"):
             if flag("eager_run", False):
                 self._run_eager(program, scope, feed, fetch_names)
@@ -325,6 +349,7 @@ class Executor:
             else:
                 results = self._run_compiled(program, scope, feed,
                                              fetch_names, return_numpy)
+        self._observe_step(program, _time.perf_counter() - _t0, feed)
         if flag("check_nan_inf", False):
             self._check_nan_inf(fetch_names, results, scope,
                                 program=program)
@@ -352,6 +377,166 @@ class Executor:
         if cached:
             self._train_runs += 1
             _chaos.step_hook(self._train_runs)
+
+    # -- step telemetry (docs/observability.md) -----------------------------
+    @staticmethod
+    def _is_training_cached(p) -> bool:
+        cached = getattr(p, "_telemetry_is_training", None)
+        if cached is None:
+            cached = isinstance(p, Program) and _is_training(p)
+            try:
+                p._telemetry_is_training = cached
+            except (AttributeError, TypeError):
+                pass
+        return cached
+
+    @staticmethod
+    def _feed_tokens(feed_vals, stacked: bool) -> int:
+        """Tokens processed by one dispatch, inferred from the feed: the
+        largest >=2-D integer feed's numel (ids-style models — the
+        labels feed ties, max() is stable); else batch rows (x-style
+        models).  `stacked` marks run_steps feeds ([K, B, ...]: rows
+        are the two leading dims)."""
+        best_int = 0
+        rows = 0
+        for v in feed_vals.values():
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if not shape:
+                continue
+            dt = getattr(v, "dtype", None)
+            try:
+                kind = np.dtype(str(dt)).kind if dt is not None else "?"
+            except TypeError:  # framework dtype numpy can't parse
+                kind = "?"
+            if kind in ("i", "u") and len(shape) >= 2:
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                best_int = max(best_int, n)
+            lead = int(shape[0])
+            if stacked and len(shape) >= 2:
+                lead *= int(shape[1])
+            rows = max(rows, lead)
+        return best_int or rows
+
+    @staticmethod
+    def _feed_batch(feed_vals, stacked: bool) -> int:
+        """Per-step batch from the feed's leading dims (the -1 binding
+        for the cached FLOPs/HBM walks); `stacked` = run_steps feeds
+        whose per-step batch is axis 1.  The MOST COMMON candidate wins
+        (ties -> largest) so a lone non-batch feed — a fed lr of shape
+        [1], a lookup table — cannot poison the per-program cache."""
+        counts: Dict[int, int] = {}
+        for v in feed_vals.values():
+            shape = tuple(getattr(v, "shape", ()) or ())
+            if len(shape) >= (2 if stacked else 1):
+                b = int(shape[1] if stacked else shape[0])
+                counts[b] = counts.get(b, 0) + 1
+        if not counts:
+            return 0
+        return max(counts, key=lambda b: (counts[b], b))
+
+    def _flops_per_step(self, p, batch) -> Optional[int]:
+        """analyze_flops total for this program at `batch`, cached on
+        the program (one IR walk per distinct batch, then a dict hit)."""
+        try:
+            cache = p.__dict__.setdefault("_flops_by_batch", {})
+        except (AttributeError, TypeError):
+            return None
+        if batch not in cache:
+            try:
+                from .flops_analysis import analyze_flops
+                cache[batch] = analyze_flops(p, batch=batch)[
+                    "total_flops"]
+            except Exception:
+                cache[batch] = None  # telemetry never kills training
+        return cache[batch]
+
+    def _observe_step(self, program, dt, feed_vals, steps=1, chips=1,
+                      stacked=None):
+        """Per-train-step telemetry: wall time, tokens/s, achieved-vs-
+        peak MFU, retrace count into core/monitor; one journal event;
+        one heartbeat.  Costs a handful of registry writes when nothing
+        is armed; skipped entirely for non-training programs (startup /
+        eval).  Fully fenced: telemetry must never kill a training run,
+        so ANY failure here (unparseable feed dtype, a user-registered
+        metric-name collision, a sick disk under the journal) degrades
+        to a silently skipped observation."""
+        p = _unwrap_program(program)
+        if not self._is_training_cached(p):
+            return
+        try:
+            self._observe_step_inner(
+                p, dt, feed_vals, steps, chips,
+                steps > 1 if stacked is None else stacked)
+        except Exception:
+            pass
+
+    def _observe_step_inner(self, p, dt, feed_vals, steps, chips,
+                            stacked):
+        from ..core.monitor import gauge_set, hist_observe, stat_add
+        from ..observability import heartbeat as _hb
+        from ..observability import journal as _journal
+        from ..observability.sidecar import maybe_start_from_env
+        maybe_start_from_env()
+        self._observed_steps += steps
+        stat_add("train.steps", steps)
+        step_ms = dt * 1e3 / max(1, steps)
+        hist_observe("train.step_ms", step_ms)
+        gauge_set("executor.retraces", self._stats["traces"])
+        tokens = self._feed_tokens(feed_vals, stacked=stacked)
+        tps = None
+        if tokens and dt > 0:
+            tps = tokens / dt
+            gauge_set("train.tokens_per_sec", tps)
+        mfu = None
+        if self._peak_flops is None:
+            from .flops_analysis import peak_flops_per_chip
+            try:
+                self._peak_flops = float(peak_flops_per_chip())
+            except Exception:
+                self._peak_flops = 0.0
+        if self._peak_flops and dt > 0:
+            batch = self._feed_batch(feed_vals, stacked=stacked)
+            flops = self._flops_per_step(p, batch) if batch else None
+            if flops:
+                mfu = (flops * steps) / dt / (self._peak_flops
+                                              * max(1, chips))
+                gauge_set("train.mfu", mfu)
+        # predicted-vs-ground-truth HBM: the estimate once per program,
+        # the allocator's answer every 64 steps (a C call, not free)
+        if self._observed_steps == steps or \
+                self._observed_steps % 64 < steps:
+            self._observe_hbm(p, feed_vals, stacked)
+        _hb.maybe_beat(self._step, wall_ms=round(step_ms, 3))
+        if _journal.journal_enabled():
+            ev = {"step": self._step, "wall_ms": round(step_ms, 3)}
+            if steps > 1:
+                ev["micro_steps"] = steps
+            if tps is not None:
+                ev["tokens_per_sec"] = round(tps, 1)
+            if mfu is not None:
+                ev["mfu"] = round(mfu, 5)
+            _journal.emit("step", **ev)
+
+    def _observe_hbm(self, p, feed_vals, stacked):
+        from ..core.monitor import gauge_set
+        try:
+            batch = self._feed_batch(feed_vals, stacked=stacked)
+            cache = p.__dict__.setdefault("_hbm_by_batch", {})
+            if batch and batch not in cache:
+                from .memory_analysis import analyze_program
+                cache[batch] = analyze_program(p, batch=batch)[
+                    "peak_bytes"]
+            if batch and cache.get(batch):
+                gauge_set("hbm.predicted_peak_bytes", cache[batch])
+            import jax as _jax
+            stats = _jax.local_devices()[0].memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use")
+            if peak:
+                gauge_set("hbm.device_peak_bytes", int(peak))
+        except Exception:
+            pass  # backends without memory_stats / exotic programs
 
     def _check_nan_inf(self, fetch_names, results, scope, program=None,
                        steps=1):
@@ -537,6 +722,8 @@ class Executor:
             verify_first_compile(program, fetch_list=fetch_names)
             self._record("miss")
             self._record("trace")
+            from ..observability.journal import emit as _jemit
+            _jemit("compile", mode="run", fingerprint=str(key[0])[:16])
             fn = self._compile(program, state_names, fetch_names)
             self._cache[key] = fn
         else:
@@ -850,6 +1037,9 @@ class Executor:
             verify_first_compile(program, fetch_list=fetch_names)
             self._record("miss")
             self._record("trace")
+            from ..observability.journal import emit as _jemit
+            _jemit("compile", mode="run_steps",
+                   fingerprint=str(key[1])[:16])
             fn = self._compile_steps(program, state_names, fetch_names)
             self._cache[key] = fn
         else:
@@ -868,10 +1058,17 @@ class Executor:
             [self._seed_for_step(program) + i for i in range(k)],
             jnp.uint32)
         self._step += k
+        import time as _time
+        _t0 = _time.perf_counter()
         with RecordEvent("Executor::RunSteps"):
             fetches, new_state = fn(state, feed_vals, seeds)
+        _dt = _time.perf_counter() - _t0
         for n, v in new_state.items():
             scope.set(n, v)
+        # stacked=True explicitly: a K=1 run_steps feed still has its
+        # per-step batch on axis 1, not axis 0
+        self._observe_step(program, _dt, feed_vals, steps=int(k),
+                           stacked=True)
         if bucket is not None:
             fetches = self._unpad_steps_fetches(fetches, *bucket,
                                                 block=block,
@@ -1276,6 +1473,10 @@ class Executor:
             from ..core.generator import set_rng_state
             set_rng_state(extra["rng"])
         self.last_restored_extra = dict(extra)
+        from ..observability.journal import emit as _jemit
+        _jemit("restore", step=int(ckpt.step),
+               executor_step=int(self._step),
+               global_step=extra.get("global_step"))
         return ckpt.step
 
     def _convert_topology_shift(self, state, extra, target, on_mismatch):

@@ -25,11 +25,19 @@ elastic_smoke.py) and on a real preemptible fleet when needed.
       the crash-consistency contract: the orphaned stage is swept on the
       next startup and load() falls back to the last CRC-valid commit.
 
-  ``collective_fail@<step>[:times=<n>]``
+  ``collective_fail@<step>[:times=<n>][:rank=<r>]``
       Raise ``ChaosCollectiveError`` from the next ``<n>`` (default 1)
       compiled-program dispatches at executor step ``<step>`` — the
       transient collective failure a flaky ICI link produces; callers
-      retry or surface it to the supervisor.
+      retry or surface it to the supervisor.  ``rank=<r>`` restricts the
+      fault to one trainer rank (default: every rank); ``times`` large
+      enough to outlast any retry budget turns the fault PERMANENT — the
+      wedged-rank scenario the heartbeat stall deadline exists for
+      (docs/observability.md).
+
+Every fired directive is also recorded in the run journal
+(``paddle_tpu.observability.journal``) when journaling is armed, so a
+chaos run's post-mortem shows which faults actually fired where.
 
 Hooks are wired into ``Executor.run`` (step_hook), ``CheckpointManager.
 _persist`` (save_hook) and ``CompiledProgram._run`` (collective_hook);
@@ -70,10 +78,10 @@ _spec_raw: Optional[str] = None
 
 
 def _rank() -> int:
-    try:
-        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    except ValueError:
-        return 0
+    # the observability tier's shared resolver, so the chaos rank filter,
+    # heartbeat filenames and journal rank field can never disagree
+    from ..observability.journal import trainer_rank
+    return trainer_rank()
 
 
 def _parse(raw: str) -> List[_Directive]:
@@ -112,7 +120,9 @@ def _parse(raw: str) -> List[_Directive]:
                                   rank=int(opts.get("rank", 0))))
         elif name == "collective_fail":
             out.append(_Directive("collective_fail", step=step,
-                                  times=int(opts.get("times", 1))))
+                                  times=int(opts.get("times", 1)),
+                                  rank=(int(opts["rank"])
+                                        if "rank" in opts else None)))
         else:
             raise ValueError(
                 f"unknown {CHAOS_ENV} directive {part!r} (see "
@@ -153,6 +163,17 @@ def _die(sig) -> None:  # pragma: no cover - ends the process
         os._exit(143)
 
 
+def _journal_fire(directive: str, step) -> None:
+    """Record a fired directive in the run journal (no-op when
+    journaling is unarmed; flushed per line, so even a SIGKILL directive
+    leaves its own record behind)."""
+    try:
+        from ..observability.journal import emit
+        emit("chaos", directive=directive, step=step)
+    except Exception:
+        pass
+
+
 def step_hook(step: int) -> None:
     """Called by the executor after finishing micro-step `step`."""
     if not enabled():
@@ -160,6 +181,7 @@ def step_hook(step: int) -> None:
     for d in _directives():
         if d.kind == "kill" and d.step == step and d.rank == _rank():
             d.step = None  # never double-fire in one process
+            _journal_fire("kill", step)
             _die(d.sig)
 
 
@@ -170,10 +192,12 @@ def save_hook(stage_dir: str, step: int) -> None:
         return
     for d in _directives():
         if d.kind == "slow_save" and d.seconds > 0:
+            _journal_fire("slow_save", step)
             time.sleep(d.seconds)
         elif d.kind == "torn_save" and d.step == step and \
                 d.rank == _rank():
             d.step = None
+            _journal_fire("torn_save", step)
             _die(signal.SIGKILL)
 
 
@@ -183,8 +207,10 @@ def collective_hook(step: int) -> None:
     if not enabled():
         return
     for d in _directives():
-        if d.kind == "collective_fail" and d.step == step and d.times > 0:
+        if d.kind == "collective_fail" and d.step == step and \
+                d.times > 0 and d.rank in (None, _rank()):
             d.times -= 1
+            _journal_fire("collective_fail", step)
             raise ChaosCollectiveError(
                 f"injected transient collective failure at step {step} "
                 f"({d.times} more)")

@@ -61,12 +61,15 @@ def feeds_for(total_steps):
 
 
 def run(ckpt_root, out_json, world, total_steps):
+    import time
     import jax
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu.static as static
     from paddle_tpu.checkpoint import CheckpointManager
     from paddle_tpu.distributed.compiled_program import CompiledProgram
     from paddle_tpu.distributed.elastic import rebucket_feeds
+    from paddle_tpu.observability import journal as _journal
+    from paddle_tpu.testing.chaos import ChaosCollectiveError
 
     world = int(world)
     total_steps = int(total_steps)
@@ -91,7 +94,23 @@ def run(ckpt_root, out_json, world, total_steps):
         losses = {}
         for gi, f in enumerate(feeds_for(total_steps)[g:], start=g):
             for mf in rebucket_feeds(f, LOGICAL, world):
-                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+                # transient collective failures (flaky ICI / chaos
+                # collective_fail) RETRY the same micro-step — an
+                # injection that never recovers leaves this rank wedged
+                # mid-step, alive but making no progress: exactly the
+                # state the launcher's heartbeat stall deadline exists
+                # to detect (each retry is journaled for the post-mortem)
+                attempt = 0
+                while True:
+                    try:
+                        out = exe.run(cp, feed=mf,
+                                      fetch_list=[meta["loss_avg"]])
+                        break
+                    except ChaosCollectiveError:
+                        attempt += 1
+                        _journal.emit("collective_retry", step=exe._step,
+                                      attempt=attempt)
+                        time.sleep(0.2)
             losses[gi] = float(np.asarray(out[0]).reshape(-1)[0])
         params = {p.name: np.asarray(scope.get(p.name)).tolist()
                   for p in main.all_parameters()}

@@ -6,6 +6,7 @@ deadline backpressure, monitor gauges/histograms, continuous-batching
 decode equivalence with per-sequence generate(), and a threaded
 end-to-end server pass."""
 import json
+import re
 import threading
 import time
 import urllib.error
@@ -189,6 +190,114 @@ def test_monitor_gauges_and_histograms():
     stat_reset("t.lat")
     assert gauge_get("t.depth") == 0
     assert hist_snapshot("t.lat")["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (core/monitor.prometheus_text + /metrics)
+# ---------------------------------------------------------------------------
+_PROM_LINE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})? (?P<value>\S+)$')
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: {(name, labels): value},
+    {name: type}.  Raises on any line that violates the line grammar —
+    the round-trip IS the conformance check."""
+    series, types = {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            types[name] = typ
+            continue
+        if line.startswith("#"):
+            assert line.startswith("# HELP "), line
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = ",".join(f'{k}="{v}"'
+                                for k, v in _PROM_LABEL.findall(raw))
+            assert consumed == raw, f"malformed labels: {raw!r}"
+            for k, v in _PROM_LABEL.findall(raw):
+                labels[k] = re.sub(
+                    r'\\(["\\n])',
+                    lambda mm: {'"': '"', '\\': '\\', 'n': '\n'}[
+                        mm.group(1)], v)
+        series[(m.group("name"),
+                tuple(sorted(labels.items())))] = float(m.group("value"))
+    return series, types
+
+
+def test_prometheus_text_spec_conformance_roundtrip():
+    """HELP/TYPE lines, counter _total suffix, summary quantile series,
+    and label escaping all survive a round-trip through a strict line
+    parser."""
+    from paddle_tpu.core.monitor import (prometheus_text, stat_add,
+                                         gauge_set, hist_observe,
+                                         stat_reset)
+    stat_add("promtest.requests", 7)
+    gauge_set("promtest.depth", 2.5)
+    for v in range(1, 101):
+        hist_observe("promtest.lat_ms", float(v))
+    try:
+        nasty = 'a"b\\c\nd'
+        text = prometheus_text(prefix="promtest.",
+                               labels={"rank": "0", "job": nasty})
+        series, types = _parse_prometheus(text)
+        assert types["promtest_requests_total"] == "counter"
+        assert types["promtest_depth"] == "gauge"
+        assert types["promtest_lat_ms"] == "summary"
+        base = (("job", nasty), ("rank", "0"))
+        assert series[("promtest_requests_total", base)] == 7
+        assert series[("promtest_depth", base)] == 2.5
+        q50 = series[("promtest_lat_ms",
+                      tuple(sorted(base + (("quantile", "0.5"),))))]
+        assert abs(q50 - 50) <= 2
+        assert series[("promtest_lat_ms_count", base)] == 100
+        assert series[("promtest_lat_ms_sum", base)] == 5050
+        # every TYPE-declared metric has at least one sample line
+        for name in types:
+            assert any(k[0].startswith(name) for k in series), name
+    finally:
+        for n in ("promtest.requests", "promtest.depth",
+                  "promtest.lat_ms"):
+            stat_reset(n)
+
+
+def test_server_metrics_scrape_live(tmp_path):
+    """GET /metrics on the live inference server: text/plain exposition
+    a scraper can parse, carrying the serving metrics the request
+    traffic just minted."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_smoke
+    from paddle_tpu.inference.server import InferenceServer
+    xb, ref, out_name = serve_smoke.save_tiny_model(str(tmp_path))
+    srv = InferenceServer(str(tmp_path), max_wait_ms=5.0)
+    srv.start()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        _post(base + "/predict", {"inputs": {"x": xb[:1].tolist()}})
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            ctype = r.headers["Content-Type"]
+            body = r.read().decode()
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        series, types = _parse_prometheus(body)
+        assert types["serving_requests_completed_total"] == "counter"
+        completed = series[("serving_requests_completed_total", ())]
+        assert completed >= 1
+        assert types["serving_latency_ms"] == "summary"
+    finally:
+        srv.stop()
 
 
 def _tiny_gpt(vocab=30):

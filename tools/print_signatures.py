@@ -30,6 +30,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.serving",
     "paddle_tpu.checkpoint",
+    "paddle_tpu.observability",
     "paddle_tpu.slim",
     "paddle_tpu.incubate",
 ]
