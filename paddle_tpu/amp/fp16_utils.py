@@ -35,6 +35,21 @@ _FP32_OUT_SLOTS = {
 # low-precision decision and are left as fp32 master weights.
 _PARAM_TOLERANT = {"layer_norm"}
 
+# Gray ops whose kernels FOLLOW one MAIN operand's dtype under mixed
+# operands instead of promoting (softmax_with_cross_entropy returns
+# softmax/loss in the Logits dtype and upcasts the label internally —
+# ops/kernels/loss.py): a black fp32 SECONDARY operand (a label-smooth
+# target) doesn't force the whole op — and its giant output — back to
+# fp32.  When the MAIN operand (the value of this map) is already
+# low-precision, the op is decided low, black operands stay protected
+# (uncast), and the output declarations match what the kernel actually
+# emits; when the main operand itself is black/fp32, black-wins
+# applies as usual (the kernel follows it to fp32).  Promoting binaries
+# (elementwise_add etc.) are deliberately NOT here: their kernel output
+# under mixed operands IS fp32, so black-wins keeps declarations
+# truthful for them.
+_MIXED_FOLLOW = {"softmax_with_cross_entropy": "Logits"}
+
 
 def _is_float_var(block, name):
     try:
@@ -85,15 +100,29 @@ def rewrite_program(main_program: Program, amp_lists=None,
             # producer wins (its fp32 output is protected — don't cast it
             # back down); otherwise follow any low-precision producer,
             # casting the remaining float inputs (e.g. the fp32 bias param
-            # of an fc's bias-add); with neither, stay fp32
+            # of an fc's bias-add); with neither, stay fp32.  Exception:
+            # a _MIXED_FOLLOW kernel fed by BOTH (bf16 logits + a black
+            # fp32 label) runs mixed and follows the low operand, so it
+            # is decided low with the black operand left uncast — the
+            # verifier's V103 catches the stale-fp32 alternative.
             ins = [n for n in op.input_names() if _is_float_var(block, n)]
-            if any(n in black_out for n in ins):
+            low = any(var_dtype.get(n, block.var(n).dtype) == dest_dtype
+                      for n in ins)
+            # follower exception keys on the MAIN operand specifically:
+            # a bf16 label with black fp32 logits must NOT flip the op
+            # low (the kernel would follow the fp32 logits)
+            follow_low = False
+            if t in _MIXED_FOLLOW:
+                follow_low = any(
+                    var_dtype.get(n, block.var(n).dtype) == dest_dtype
+                    for n in op.inputs.get(_MIXED_FOLLOW[t], [])
+                    if n and _is_float_var(block, n))
+            if any(n in black_out for n in ins) and not follow_low:
                 want = None
                 black_out.update(
                     n for n in op.output_names()
                     if _is_float_var(block, n))
-            elif any(var_dtype.get(n, block.var(n).dtype) == dest_dtype
-                     for n in ins):
+            elif low:
                 want = dest_dtype
             else:
                 want = None
@@ -108,7 +137,12 @@ def rewrite_program(main_program: Program, amp_lists=None,
                 for n in names:
                     if not _is_float_var(block, n) or (
                             t in _PARAM_TOLERANT and
-                            block.var(n).persistable):
+                            block.var(n).persistable) or (
+                            t in amp_lists.gray_list and n in black_out):
+                        # on a low-decided GRAY op a black-produced fp32
+                        # operand stays protected (the kernel upcasts it
+                        # internally); white ops still cast everything
+                        # down — running the matmul in bf16 is their job
                         out_names.append(n)
                         continue
                     cur = var_dtype.get(n, block.var(n).dtype)

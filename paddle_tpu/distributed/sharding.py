@@ -538,7 +538,8 @@ def shard_optimizer_states(program: Program, startup: Program,
     # dp_shard-consistency diagnostics were built for)
     from ..core.pass_framework import finish_pass
     finish_pass(program, "zero1_sharding", startup=startup,
-                dp_degree=world, buckets=len(plan_buckets))
+                dp_degree=world, buckets=len(plan_buckets),
+                bucket_bytes=int(bucket_bytes))
     return plan
 
 
@@ -615,36 +616,25 @@ def reshard_state(state: Dict[str, object], plan: ShardingPlan) \
 
 
 # ---------------------------------------------------------------------------
-# collective traffic accounting (bench --dp-shard A/B)
+# collective traffic accounting — superseded by the verifier's extractor
 # ---------------------------------------------------------------------------
+_collective_bytes_deprecation_warned = False
+
+
 def collective_bytes_per_step(program: Program, world: int) -> int:
-    """ICI bytes one rank moves per step for the gradient/param
-    collectives in `program` (ring-algorithm accounting): allreduce
-    costs 2(N-1)/N of the buffer, reduce-scatter and allgather each
-    (N-1)/N.  Only the dist-pass collectives are counted (ring 0);
-    forward model-parallel collectives are out of scope."""
-    if world <= 1:
-        return 0
-    from ..core.dtype import np_dtype
-    block = program.global_block()
-
-    def nbytes(name):
-        v = block.vars.get(name)
-        if v is None or v.shape is None or v.dtype is None:
-            return 0
-        return _numel(v.shape) * int(np.dtype(np_dtype(v.dtype)).itemsize)
-
-    total = 0.0
-    for op in block.ops:
-        if op.attrs.get("ring_id", 0) != 0:
-            continue
-        if op.type == "c_allreduce_sum":
-            total += 2.0 * (world - 1) / world * nbytes(
-                op.inputs["X"][0])
-        elif op.type == "c_reducescatter":
-            total += (world - 1) / world * nbytes(op.inputs["X"][0])
-        elif op.type == "c_allgather":
-            # input is the local shard; the ring moves the OUTPUT minus
-            # the local slice
-            total += (world - 1) * nbytes(op.inputs["X"][0])
-    return int(total)
+    """DEPRECATED: superseded by ``static.collective_wire_bytes`` (the
+    verifier's ordered-collective-sequence extractor with ring-algorithm
+    accounting over every collective type and every ring — the planner's
+    wire-cost substrate).  This shim delegates to it restricted to ring
+    0 (this helper's historical scope: the dist-pass gradient/param
+    collectives) and warns once per process."""
+    global _collective_bytes_deprecation_warned
+    if not _collective_bytes_deprecation_warned:
+        _collective_bytes_deprecation_warned = True
+        warnings.warn(
+            "sharding.collective_bytes_per_step is deprecated; use "
+            "paddle_tpu.static.collective_wire_bytes(program, world) "
+            "(ring-accounted, all collective types/rings) instead",
+            DeprecationWarning, stacklevel=2)
+    from ..static.verifier import collective_wire_bytes
+    return collective_wire_bytes(program, world, ring_id=0)

@@ -30,6 +30,11 @@ from .verifier import (  # noqa: F401
     VerifyReport, Diagnostic, ProgramVerificationError,
 )
 from . import verifier  # noqa: F401
+from .planner import (  # noqa: F401
+    plan_program, apply_plan, Plan, ici_bytes_per_chip,
+)
+from . import planner  # noqa: F401
+from .recompute_rewrite import apply_recompute  # noqa: F401
 from .initializer import (  # noqa: F401
     Constant, Uniform, Normal, TruncatedNormal, Xavier, MSRA,
     NumpyArrayInitializer, set_global_initializer,

@@ -26,6 +26,147 @@ from ..ops.registry import get_op_info
 GRAD_SUFFIX = "@GRAD"
 
 
+def apply_recompute(program, checkpoints=None):
+    """POST-HOC activation-checkpointing rewrite of an already-minimized
+    training program (forward + backward + optimizer tail in one block).
+
+    `append_backward_with_checkpoints` below rewrites at backward-BUILD
+    time, which is too early for the auto-parallel planner
+    (static/planner.py): the planner receives a finished program and
+    must apply every candidate knob as a rewrite on a clone.  This
+    function performs the same transformation on the finished op list:
+
+      * forward ops are segmented at `checkpoints` (default: the same
+        `select_layer_checkpoints` picks FLAGS_recompute uses);
+      * the first backward op that reads a non-stored activation of
+        segment S triggers S's replay: an `optimization_barrier` over
+        the segment's non-stored external inputs (so XLA cannot CSE the
+        replay with the original forward) followed by the segment's ops
+        re-emitted with ``@RC``-renamed outputs;
+      * every later backward read of a segment-S activation is renamed
+        to its ``@RC`` alias, so the ORIGINAL activation's live range
+        ends in the forward sweep — exactly the liveness cut the memory
+        walker (static/memory_analysis.py) prices.
+
+    Replayed ops keep their original ``op_uid`` (PRNG-keyed kernels like
+    dropout replay the same mask — the build-time rewrite's contract).
+    Numerics are unchanged: the replay computes the same values the
+    backward would have read.  Idempotent via the applied-passes
+    registry: a program that already carries the "recompute" pass (from
+    either rewrite path) is returned untouched.  Returns `program`.
+    """
+    from ..core.pass_framework import has_applied, finish_pass
+    from .memory_analysis import _phase_of, select_layer_checkpoints
+    if has_applied(program, "recompute"):
+        return program
+    if checkpoints is None:
+        checkpoints = select_layer_checkpoints(program)
+    ckpt_names = {c.name if hasattr(c, "name") else str(c)
+                  for c in checkpoints}
+    block = program.global_block()
+    if not ckpt_names:
+        return program
+
+    ops = block.ops
+    n_fwd = 0
+    for op in ops:
+        if op.type != "feed" and _phase_of(op) != "forward":
+            break
+        n_fwd += 1
+    fwd_ops = ops[:n_fwd]
+    seg_of, fresh_seg = _segment_ids(fwd_ops, ckpt_names)
+    if fresh_seg == 0:
+        return program  # no checkpoint var is actually produced here
+
+    prod_seg: Dict[str, int] = {}
+    for op, s in zip(fwd_ops, seg_of):
+        if s == fresh_seg:
+            continue
+        for n in op.output_names():
+            if n:
+                prod_seg[n] = s
+
+    def _stored(name: str) -> bool:
+        """Safe to read in backward WITHOUT triggering a replay."""
+        if name in ckpt_names:
+            return True
+        v = block.vars.get(name)
+        return v is not None and (v.persistable or v.is_data)
+
+    def _barrier_free(name: str) -> bool:
+        """Params/data feed both passes identically; everything else a
+        replay reads — INCLUDING the checkpoints — must route through
+        the barrier, or XLA CSEs the replay with the original forward
+        and the memory saving evaporates (build-time rewrite's
+        `_is_barrier_free` contract)."""
+        v = block.vars.get(name)
+        return v is not None and (v.persistable or v.is_data)
+
+    new_tail: List[OpDesc] = []
+    replay_maps: Dict[int, Dict[str, str]] = {}
+
+    def _emit_replay(seg_id: int):
+        ops_in_seg = [op for op, s in zip(fwd_ops, seg_of)
+                      if s == seg_id and op.type not in ("feed", "fetch")]
+        produced = {n for op in ops_in_seg for n in op.output_names()}
+        ext_inputs = sorted({
+            n for op in ops_in_seg for n in op.input_names()
+            if n and n not in produced})
+        rmap: Dict[str, str] = {}
+
+        def _alias(name: str, suffix: str) -> str:
+            # replay aliases inherit the ORIGINAL var's shape/dtype (the
+            # replayed op computes the same value; create_var's float32
+            # default would trip the verifier's V103 on bf16/AMP casts)
+            orig = block.vars.get(name)
+            alias = unique_name(name + suffix)
+            block.create_var(
+                name=alias,
+                shape=orig.shape if orig is not None else None,
+                dtype=orig.dtype if orig is not None else None,
+                stop_gradient=True)
+            rmap[name] = alias
+            return alias
+
+        barrier_ins = [n for n in ext_inputs if not _barrier_free(n)]
+        if barrier_ins:
+            bar_outs = [_alias(n, "@RCB") for n in barrier_ins]
+            new_tail.append(OpDesc(
+                "optimization_barrier", {"X": barrier_ins},
+                {"Out": bar_outs},
+                {OpRole.KEY: OpRole.Backward,
+                 "op_uid": program._next_uid()}))
+        for op in ops_in_seg:
+            new_ins = {k: [rmap.get(n, n) for n in v]
+                       for k, v in op.inputs.items()}
+            new_outs = {k: [_alias(n, "@RC") for n in v]
+                        for k, v in op.outputs.items()}
+            attrs = dict(op.attrs)  # same op_uid: replayed PRNG matches
+            attrs[OpRole.KEY] = OpRole.Backward
+            new_tail.append(OpDesc(op.type, new_ins, new_outs, attrs))
+        replay_maps[seg_id] = rmap
+
+    for op in ops[n_fwd:]:
+        if _phase_of(op) == "backward":
+            needed = sorted({
+                prod_seg[n] for n in op.input_names()
+                if n and n in prod_seg and not _stored(n)})
+            for s in needed:
+                if s not in replay_maps:
+                    _emit_replay(s)
+            if needed:
+                for k, v in op.inputs.items():
+                    op.inputs[k] = [
+                        replay_maps.get(prod_seg.get(n, -1), {}).get(n, n)
+                        for n in v]
+        new_tail.append(op)
+    block.ops = fwd_ops + new_tail
+    program._fingerprint_cache = None
+    finish_pass(program, "recompute", checkpoints=len(ckpt_names),
+                post_hoc=True)
+    return program
+
+
 def _segment_ids(fwd_ops: List[OpDesc], checkpoints: Set[str]):
     """Assign each forward op a segment id; segment boundary AFTER an op that
     produces a checkpoint var.  Ops after the last checkpoint form the final

@@ -71,6 +71,7 @@ from ..core.program import Block, OpDesc, OpRole, Program
 __all__ = [
     "Diagnostic", "VerifyReport", "ProgramVerificationError",
     "check_program", "collective_sequence", "collective_wire_bytes",
+    "entry_wire_bytes",
     "verify_mode", "self_check", "verify_first_compile", "VERIFY_ENV",
 ]
 
@@ -326,41 +327,58 @@ def collective_sequence(program: Program) -> List[dict]:
     return seq
 
 
+def entry_wire_bytes(entry: dict, world: int) -> float:
+    """Ring-algorithm ICI bytes ONE rank moves for a single
+    `collective_sequence` entry: allreduce 2(N-1)/N of the buffer,
+    reduce-scatter (N-1)/N, allgather and the elastic all-gather fold
+    (N-1)× the local shard, broadcast/scatter (N-1)/N, alltoall
+    (N-1)/N.  An entry stamped with its own ``dp_degree`` (the sharding
+    pass records the group size it padded for) is priced at THAT group
+    size; `world` covers the rest.  Unknown sizes price 0.  Shared by
+    `collective_wire_bytes` and the auto-parallel planner's
+    overlap-aware roofline (static/planner.py)."""
+    n = entry["nbytes"]
+    if not n:
+        return 0.0
+    g = entry["dp_degree"] or world  # per-entry group size wins
+    if g <= 1:
+        return 0.0
+    t = entry["type"]
+    if t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+             "c_allreduce_prod", "mp_allreduce_sum", "sync_batch_norm",
+             "sync_batch_norm_grad"):
+        return 2.0 * (g - 1) / g * n
+    if t in ("c_reducescatter", "c_scatter", "c_broadcast",
+             "broadcast", "alltoall"):
+        return (g - 1) / g * n
+    if t in ("c_allgather", "c_concat", "c_elastic_fold",
+             "partial_allgather"):
+        # input is the local shard; the ring moves (g-1) remote shards
+        # (c_concat's kernel IS a tiled all_gather, ops/kernels/
+        # collective.py)
+        return float((g - 1) * n)
+    if t in ("p_send", "p_recv"):
+        return float(n)
+    # c_split is a LOCAL dynamic slice of a replicated operand (each
+    # rank keeps its own piece — ops/kernels/collective.py): zero wire.
+    # barrier / elastic_commit_mask / ring_attention: control traffic
+    # only (ring_attention's K/V rotation is its own op-internal story).
+    return 0.0
+
+
 def collective_wire_bytes(program: Program, world: int,
                           ring_id: Optional[int] = None) -> int:
-    """ICI bytes ONE rank moves per step under ring-algorithm accounting:
-    allreduce 2(N-1)/N of the buffer, reduce-scatter (N-1)/N, allgather
-    and the elastic all-gather fold (N-1)× the local shard, broadcast/
-    scatter (N-1)/N, alltoall (N-1)/N.  Entries with unknown sizes
-    contribute 0 (count them via `collective_sequence` if that matters).
-    `ring_id=None` sums every ring.  An entry stamped with its own
-    ``dp_degree`` (the sharding pass records the group size it padded
-    for) is priced at THAT group size; `world` covers the rest."""
+    """ICI bytes ONE rank moves per step under ring-algorithm accounting
+    (per-entry formulas: `entry_wire_bytes`).  Entries with unknown
+    sizes contribute 0 (count them via `collective_sequence` if that
+    matters).  `ring_id=None` sums every ring."""
     if world <= 1:
         return 0
     total = 0.0
     for e in collective_sequence(program):
         if ring_id is not None and e["ring_id"] != ring_id:
             continue
-        n = e["nbytes"]
-        if not n:
-            continue
-        g = e["dp_degree"] or world  # per-entry group size wins
-        if g <= 1:
-            continue
-        t = e["type"]
-        if t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
-                 "c_allreduce_prod", "mp_allreduce_sum", "sync_batch_norm",
-                 "sync_batch_norm_grad"):
-            total += 2.0 * (g - 1) / g * n
-        elif t in ("c_reducescatter", "c_scatter", "c_broadcast",
-                   "broadcast", "alltoall", "c_split", "c_concat"):
-            total += (g - 1) / g * n
-        elif t in ("c_allgather", "c_elastic_fold", "partial_allgather"):
-            total += (g - 1) * n
-        elif t in ("p_send", "p_recv"):
-            total += n
-        # barrier / elastic_commit_mask: control traffic only
+        total += entry_wire_bytes(e, world)
     return int(total)
 
 
@@ -853,10 +871,13 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
 
 
 def _check_pass_order(program: Program, out: List[Diagnostic]):
-    """V501-V503: composition contracts between the rewrite passes, read
-    from the applied-passes registry (core/pass_framework.py)."""
+    """V501-V503: composition contracts between the rewrite passes, and
+    V504: plan drift — the program's actually-applied passes disagree
+    with the auto-parallel plan recorded on it.  Both read the
+    applied-passes registry (core/pass_framework.py)."""
     from ..core.pass_framework import applied_passes
-    order = [e["pass"] for e in applied_passes(program)]
+    entries = applied_passes(program)
+    order = [e["pass"] for e in entries]
     if "elastic" in order and "gradient_merge" in order:
         out.append(Diagnostic(
             "V501", ERROR,
@@ -877,6 +898,51 @@ def _check_pass_order(program: Program, out: List[Diagnostic]):
             "run first so the masked commit wraps the bucketed sharded "
             "update (the reverse buckets the @MASKED temps and "
             "reduce-scatters every micro-step's partial sums)"))
+
+    # V504: plan drift.  `static.plan_program`/`apply_plan` record the
+    # chosen knobs as an "auto_parallel_plan" registry entry; the
+    # rewrites the plan names record their own entries when applied.
+    # A program whose ACTUAL rewrite state (remat / dp_shard degree /
+    # gradient_merge K / ring op presence / shard bucket size) disagrees
+    # with the recorded plan was hand-edited after planning — its bench
+    # records and docs would attribute the numbers to knobs that never
+    # ran.
+    plans = [e for e in entries if e.get("pass") == "auto_parallel_plan"]
+    if plans:
+        plan = plans[-1]  # latest plan is the authority
+
+        def _drift(knob, planned, applied):
+            out.append(Diagnostic(
+                "V504", ERROR,
+                f"plan drift: recorded auto-parallel plan says "
+                f"{knob}={planned!r} but the program's applied passes "
+                f"say {applied!r} — the program was modified after "
+                f"planning (re-plan, or apply the recorded plan)"))
+
+        remat_applied = "recompute" in order
+        if "remat" in plan and bool(plan["remat"]) != remat_applied:
+            _drift("remat", bool(plan["remat"]), remat_applied)
+        zs = next((e for e in reversed(entries)
+                   if e["pass"] == "zero1_sharding"), None)
+        dp_applied = int(zs.get("dp_degree", 0)) if zs else 0
+        if "dp_shard" in plan and int(plan["dp_shard"] or 0) != dp_applied:
+            _drift("dp_shard", int(plan["dp_shard"] or 0), dp_applied)
+        if zs is not None and plan.get("bucket_mb") and \
+                zs.get("bucket_bytes") and \
+                int(plan["bucket_mb"]) * 2 ** 20 != int(zs["bucket_bytes"]):
+            _drift("bucket_mb", int(plan["bucket_mb"]),
+                   int(zs["bucket_bytes"]) // 2 ** 20)
+        gm = next((e for e in reversed(entries)
+                   if e["pass"] == "gradient_merge"), None)
+        gm_applied = int(gm.get("k", 0)) if gm else 1
+        if "grad_merge" in plan and \
+                int(plan["grad_merge"] or 1) != gm_applied:
+            _drift("grad_merge", int(plan["grad_merge"] or 1), gm_applied)
+        if "ring" in plan:
+            has_ring = any(op.type == "ring_attention"
+                           for b in program.blocks for op in b.ops)
+            if bool(plan["ring"]) != has_ring:
+                _drift("ring", bool(plan["ring"]), has_ring)
 
 
 # ---------------------------------------------------------------------------

@@ -318,14 +318,36 @@ def test_prediction_skips_unshardable_optimizer_slots():
 
 def test_collective_bytes_zero1_matches_allreduce_volume():
     # ZeRO-1's whole point: SAME wire volume (rs + ag == allreduce),
-    # 1/N the optimizer memory
+    # 1/N the optimizer memory.  Priced by the verifier's ring-accounted
+    # extractor (static.collective_wire_bytes — the planner's wire
+    # substrate, which superseded sharding.collective_bytes_per_step).
     main, startup, loss = _build()
-    plain = collective_bytes_per_step(insert_grad_allreduce(main), WORLD)
+    plain = static.collective_wire_bytes(insert_grad_allreduce(main), WORLD)
     shard_optimizer_states(main, startup, dp_degree=WORLD)
-    zero = collective_bytes_per_step(insert_grad_allreduce(main), WORLD)
+    zero = static.collective_wire_bytes(insert_grad_allreduce(main), WORLD)
     assert plain > 0
     # padding can only add a sliver
     assert plain <= zero <= int(plain * 1.25)
+
+
+def test_collective_bytes_per_step_shim_delegates_and_warns_once():
+    """The superseded helper survives as a deprecation shim: one
+    DeprecationWarning per process, then plain delegation to the
+    ring-0 slice of static.collective_wire_bytes."""
+    import warnings
+    from paddle_tpu.distributed import sharding as sharding_mod
+    main, startup, loss = _build()
+    shard_optimizer_states(main, startup, dp_degree=WORLD)
+    reduced = insert_grad_allreduce(main)
+    sharding_mod._collective_bytes_deprecation_warned = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = collective_bytes_per_step(reduced, WORLD)
+        again = collective_bytes_per_step(reduced, WORLD)
+    assert got == again == static.collective_wire_bytes(reduced, WORLD,
+                                                        ring_id=0)
+    deps = [x for x in w if issubclass(x.category, DeprecationWarning)]
+    assert len(deps) == 1  # warns ONCE
 
 
 def test_plan_and_state_conversion_roundtrip():
@@ -403,14 +425,14 @@ def test_fp16_allreduce_wraps_bucket_reduce_scatter():
     bucket reduce-scatter's wire leg is bf16 (half the ICI bytes) and
     the accounting sees it."""
     main, startup, loss = _build()
-    full = collective_bytes_per_step(insert_grad_allreduce(main), WORLD)
+    full = static.collective_wire_bytes(insert_grad_allreduce(main), WORLD)
     main._fp16_allreduce = True
     shard_optimizer_states(main, startup, dp_degree=WORLD)
     block = main.global_block()
     rs = next(op for op in block.ops if op.type == "c_reducescatter")
     assert block.var(rs.inputs["X"][0]).dtype == "bfloat16"
     # wire accounting: bf16 reduce-scatter + fp32 allgather < fp32 both
-    zero = collective_bytes_per_step(main, WORLD)
+    zero = static.collective_wire_bytes(main, WORLD)
     assert zero < full
 
 

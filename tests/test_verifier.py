@@ -540,15 +540,15 @@ class TestCollectiveSequence:
                 assert e["nbytes"] and e["nbytes"] > 0
 
     def test_wire_bytes_matches_sharding_accounting(self):
-        # the verifier's ring-cost model agrees with the bench's
-        # (sharding.collective_bytes_per_step) on the ops both model
+        # sharding.collective_bytes_per_step is now a deprecation shim
+        # delegating to THIS extractor's ring-0 slice, so the two must
+        # agree exactly (c_split prices 0 — it's a local slice)
         from paddle_tpu.distributed.sharding import \
             collective_bytes_per_step
         main, startup, loss, _ = build_sharded()
         ours = collective_wire_bytes(main, 8, ring_id=0)
         theirs = collective_bytes_per_step(main, 8)
-        # ours also counts the c_split rank-slice; theirs is rs+ag only
-        assert ours >= theirs > 0
+        assert ours == theirs > 0
 
     def test_world_of_one_costs_zero(self):
         main, startup, loss, _ = build_sharded()

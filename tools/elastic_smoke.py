@@ -76,6 +76,10 @@ def _train(exe, scope, main, loss, meta, world, feeds):
 def run_smoke(steps: int = 4, kill_at: int = 2, root: str = None):
     """Run the gate; returns the result dict (AssertionError on an
     elastic-resume regression)."""
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     import jax
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu.static as static

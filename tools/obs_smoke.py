@@ -57,6 +57,10 @@ def hand_counted_matmul_flops(batch: int) -> int:
 
 
 def run_smoke():
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np

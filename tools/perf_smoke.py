@@ -61,6 +61,10 @@ def build_bert_tiny(vocab=512, seq=32, hidden=64, layers_n=2, heads=2):
 def run_smoke(steps=20, batch=4, seq=32, max_traces=2, cache_dir=None):
     """Run the gate; returns the result dict (raises AssertionError on a
     recompile regression)."""
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     import jax
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu.static as static

@@ -120,6 +120,10 @@ def run_smoke(clients: int = 6, requests: int = 10, max_batch: int = 8,
               max_wait_ms: float = 10.0, model_dir: str = None):
     """Run the gate; returns the result dict (AssertionError on a
     coalescing or retrace regression)."""
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     import jax
     jax.config.update("jax_platforms", "cpu")
     import tempfile

@@ -38,6 +38,10 @@ WORLD = 8
 def run_smoke(steps: int = 4, batch: int = 16):
     """Run the gate; returns the result dict (AssertionError on a
     sharding or retrace regression)."""
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (

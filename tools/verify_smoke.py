@@ -58,6 +58,10 @@ def build_sharded_program(dp_degree: int = 8):
 def run_smoke():
     """Run the gate; returns the result dict (AssertionError on any
     verifier regression)."""
+    # every tier-1 smoke doubles as a verifier sweep (ISSUE 10):
+    # armed here, the first-compile hook and the rewrite-pass
+    # self-checks verify every program this gate builds, for free
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
     import jax
     jax.config.update("jax_platforms", "cpu")
     import paddle_tpu.static as static
