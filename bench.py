@@ -428,9 +428,9 @@ def _bench_knobs():
 
 
 def _dp_shard_knob():
-    """--dp-shard [N] / BENCH_DP_SHARD=N: ZeRO-1 optimizer-state
-    sharding A/B (distributed/sharding.py).  A bare --dp-shard targets
-    the v5e-32 pod slice's 8-chip host world."""
+    """--dp-shard [N] / BENCH_DP_SHARD=N: ZeRO optimizer-state sharding
+    A/B (distributed/sharding.py).  A bare --dp-shard targets the
+    v5e-32 pod slice's 8-chip host world."""
     raw = _argv_value("--dp-shard")
     if raw is None:
         raw = os.environ.get("BENCH_DP_SHARD", "0")
@@ -441,6 +441,24 @@ def _dp_shard_knob():
         raise SystemExit("bench: --dp-shard needs a non-negative world "
                          "size (e.g. --dp-shard 8)")
     return ds
+
+
+def _zero_stage_knob():
+    """--zero-stage S / BENCH_ZERO_STAGE=S: which ZeRO stage the
+    --dp-shard rewrite applies (1 = optimizer slots, 2 = + sharded
+    gradient accumulation under --grad-merge, 3 = full parameter
+    sharding with JIT gathers).  Default 1; ignored without a dp_shard
+    world."""
+    raw = _argv_value("--zero-stage")
+    if raw is None or raw == "":
+        raw = os.environ.get("BENCH_ZERO_STAGE", "1")
+    zs = int(raw or 1)
+    if zs == 0:
+        return 1  # 0 = "unset", mirroring BENCH_DP_SHARD=0 (ignored
+        # anyway without a dp_shard world)
+    if zs not in (1, 2, 3):
+        raise SystemExit("bench: --zero-stage must be 1, 2 or 3")
+    return zs
 
 
 def seq_ladder_main():
@@ -827,18 +845,21 @@ def main():
     # (memory_analysis._op_internal_bytes), and the true sp-sharded
     # numbers need CompiledProgram over a multi-chip mesh.
     remat_mode, grad_merge_k, use_ring = _bench_knobs()
-    # BENCH_DP_SHARD=N (--dp-shard [N]): ZeRO-1 optimizer-state sharding
-    # A/B.  The rewrite is applied for an N-rank dp world; on this
-    # bench's single-device Executor path every collective degrades to
-    # identity, so tokens/s measures the rewrite's dispatch/fusion
-    # overhead while predicted_peak_bytes and collective_bytes_per_step
-    # report the N-chip story (the mesh numbers need CompiledProgram
-    # over real chips — queued as zero1_* in perf_r05/queue.txt).
+    # BENCH_DP_SHARD=N (--dp-shard [N]) + BENCH_ZERO_STAGE=S
+    # (--zero-stage S): ZeRO sharding A/B at stages 1-3.  The rewrite is
+    # applied for an N-rank dp world; on this bench's single-device
+    # Executor path every collective degrades to identity, so tokens/s
+    # measures the rewrite's dispatch/fusion overhead while
+    # predicted_peak_bytes and collective_bytes report the N-chip story
+    # (the mesh numbers need CompiledProgram over real chips — queued as
+    # zero1_*/zero2_*/zero3_* in perf_r05/queue.txt).
     dp_shard = _dp_shard_knob()
+    zero_stage = _zero_stage_knob()
     if remat_mode:
         from paddle_tpu.core.flags import set_flags
         set_flags({"recompute": remat_mode, "hbm_assume_batch": batch,
-                   "hbm_dp_shard": dp_shard})
+                   "hbm_dp_shard": dp_shard,
+                   "hbm_zero_stage": zero_stage if dp_shard > 1 else 0})
 
     main_p, startup_p, loss = build_bert_base(vocab, seq, hidden, layers_n,
                                               heads, batch, use_amp=use_amp,
@@ -846,7 +867,7 @@ def main():
     if remat_mode:
         from paddle_tpu.core.flags import set_flags
         set_flags({"recompute": "", "hbm_assume_batch": 0,
-                   "hbm_dp_shard": 0})
+                   "hbm_dp_shard": 0, "hbm_zero_stage": 0})
     _collective_bytes = None
     if dp_shard > 1:
         from paddle_tpu.distributed.compiled_program import \
@@ -855,14 +876,14 @@ def main():
         # wire accounting rides the verifier's ring-accounted extractor
         # (static.collective_wire_bytes — the planner's wire substrate;
         # ring 0 = the dist-pass gradient/param collectives, matching
-        # the A/B's historical scope; the superseded per-bucket
-        # sharding.collective_bytes_per_step survives as a deprecation
-        # shim delegating to the same accounting).
+        # the A/B's historical scope; the per-bucket
+        # sharding.collective_bytes_per_step shim is retired).
         # plain-DP wire bytes: what insert_grad_allreduce WOULD emit for
         # this program on an N-rank mesh (per-param allreduce)
         plain_bytes = static.collective_wire_bytes(
             insert_grad_allreduce(main_p), dp_shard, ring_id=0)
-        shard_optimizer_states(main_p, startup_p, dp_degree=dp_shard)
+        shard_optimizer_states(main_p, startup_p, dp_degree=dp_shard,
+                               stage=zero_stage)
         reduced = insert_grad_allreduce(main_p)
         zero_bytes = static.collective_wire_bytes(reduced, dp_shard,
                                                  ring_id=0)
@@ -870,14 +891,17 @@ def main():
         # collectives) — reported alongside the ring-0 A/B numbers so
         # the full wire story stays visible
         wire_all = static.collective_wire_bytes(reduced, dp_shard)
-        _collective_bytes = {"allreduce": plain_bytes, "zero1": zero_bytes,
-                             "zero1_all_rings": wire_all}
+        _collective_bytes = {"allreduce": plain_bytes,
+                             f"zero{zero_stage}": zero_bytes,
+                             f"zero{zero_stage}_all_rings": wire_all}
     if grad_merge_k > 1:
         static.gradient_merge(main_p, grad_merge_k, startup_p)
     # compile-time HBM verdict rides every bench record: the number that
     # decides fits-or-OOMs before a tunnel window is ever spent
     _mem = static.analyze_program(main_p, batch=batch,
-                                  dp_shard=dp_shard or None)
+                                  dp_shard=dp_shard or None,
+                                  zero_stage=(zero_stage
+                                              if dp_shard > 1 else None))
     exe = static.Executor()
     scope = static.Scope()
     rng = np.random.RandomState(0)
@@ -1063,12 +1087,15 @@ def main():
         result["memory_knobs"] = {"remat": remat_mode or "off",
                                   "grad_merge_k": grad_merge_k,
                                   "ring": use_ring,
-                                  "dp_shard": dp_shard}
+                                  "dp_shard": dp_shard,
+                                  "zero_stage": (zero_stage
+                                                 if dp_shard > 1 else 0)}
     if _collective_bytes is not None:
         # per-rank ICI bytes per step: bucketed reduce-scatter+allgather
         # vs the per-param allreduce baseline (ring accounting)
         result["collective_bytes_per_step"] = _collective_bytes
         result["optimizer_slot_bytes"] = _mem["optimizer_slot_bytes"]
+        result["parameter_bytes"] = _mem["parameter_bytes"]
     if on_tpu:
         result["mfu"] = round(mfu, 4)
         result["mfu_exact"] = round(mfu_exact, 4)

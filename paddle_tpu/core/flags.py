@@ -118,6 +118,10 @@ define_flag("hbm_dp_shard", 0,
             "this many dp replicas (distributed/sharding.py) — the "
             "auto-remat verdict's optimizer-slot reservation and "
             "analyze_program's prediction mode divide slot bytes by it")
+define_flag("hbm_zero_stage", 0,
+            "HBM accounting: ZeRO stage the FLAGS_hbm_dp_shard "
+            "prediction assumes (1 = slots only, 3 also divides the "
+            "parameters the pass would pack; 0 defaults to 1)")
 define_flag("hbm_assume_batch", 0,
             "batch size the HBM estimator binds symbolic -1 dims to "
             "(memory_analysis; 0 binds 1, making batch-dynamic "

@@ -19,7 +19,10 @@ from .compiled_program import (  # noqa: F401
 )
 from .sharding import (  # noqa: F401
     shard_optimizer_states, ShardingPlan, unshard_state, reshard_state,
-    collective_bytes_per_step,
+)
+from .partition_spec import (  # noqa: F401
+    match_partition_rules, zero_stage_rules, build_sharding_specs,
+    PartitionRule, REPLICATED, DP_SHARD,
 )
 from .elastic import (  # noqa: F401
     elasticize, rebucket_feeds, rederive_schedule, reanchor_topology,

@@ -112,15 +112,21 @@ _REDUCE_OPS = frozenset(("c_allreduce_sum", "c_reducescatter",
                          "c_elastic_fold"))
 
 
-def _grad_already_reduced(producers: Dict[str, "OpDesc"], name: str,
-                          limit: int = 64) -> bool:
+def _grad_already_reduced(producers: Dict[str, List["OpDesc"]], name: str,
+                          limit: int = 96) -> bool:
     """True when `name`'s producer chain already contains a gradient
     reduction (c_allreduce_sum / c_reducescatter), walking back only
     through the ops a reduction pass inserts — the first op outside that
     set (a real backward grad op) terminates the walk.  Makes
     insert_grad_allreduce idempotent and ZeRO-aware: applying the pass
     twice, or on a program `shard_optimizer_states` already rewrote,
-    inserts nothing."""
+    inserts nothing.
+
+    `producers` maps each var to ALL its writers, not just the last: a
+    ZeRO-2 shard accumulator is written by its `elementwise_add`
+    accumulate AND its masked `where` reset — the reduction sits behind
+    the accumulate, and a last-writer-only walk through the reset would
+    miss it and re-reduce per-rank shards (summing unrelated slices)."""
     seen, frontier = set(), [name]
     while frontier and limit > 0:
         limit -= 1
@@ -128,14 +134,12 @@ def _grad_already_reduced(producers: Dict[str, "OpDesc"], name: str,
         if n in seen:
             continue
         seen.add(n)
-        op = producers.get(n)
-        if op is None:
-            continue
-        if op.type in _REDUCE_OPS:
-            return True
-        if op.type not in _REDUCE_TRANSPARENT_OPS:
-            continue
-        frontier.extend(op.input_names())
+        for op in producers.get(n, ()):
+            if op.type in _REDUCE_OPS:
+                return True
+            if op.type not in _REDUCE_TRANSPARENT_OPS:
+                continue
+            frontier.extend(op.input_names())
     return False
 
 
@@ -159,11 +163,19 @@ def insert_grad_allreduce(program: Program, num_replicas_axis="dp",
     producers: Dict[str, Any] = {}
     for op in block.ops:
         for n in op.output_names():
-            producers[n] = op
+            producers.setdefault(n, []).append(op)
     new_ops = []
     inserted = 0
     done: Dict[str, str] = {}
     for op in block.ops:
+        if op.attrs.get("zero_sharded"):
+            # a ZeRO bucket update: its Grad is the reduce-scattered
+            # shard (possibly behind a gradient-merge accumulator) —
+            # per-rank DIFFERENT slices an allreduce would sum into
+            # garbage.  The producer walk below also catches this, but
+            # the stamp is the contract.
+            new_ops.append(op)
+            continue
         if op.attrs.get(OpRole.KEY) == OpRole.Optimize and "Grad" in op.inputs:
             gnames = op.inputs["Grad"]
             new_gnames = []
@@ -594,26 +606,17 @@ class CompiledProgram:
                 fetches.append(v)
             return tuple(fetches), new_state
 
-        state_specs = {n: P() for n in state_names}
-        # ZeRO-1 sharded optimizer slots (distributed/sharding.py): the
-        # persistable is declared at the GLOBAL padded bucket shape and
-        # marked dp_shard — shard it over "dp" so each rank holds (and
-        # donates, and updates) only its slice.  Any dp degree dividing
-        # the padded length runs the same program.
-        for n in state_names:
-            try:
-                v = block.var(n)
-            except KeyError:
-                continue
-            if not v.attrs.get("dp_shard"):
-                continue
-            dp = mesh.shape["dp"]
-            if not v.shape or int(v.shape[0]) % dp != 0:
-                raise ValueError(
-                    f"ZeRO-1 slot {n!r} (shape {v.shape}) does not divide "
-                    f"the mesh dp degree {dp}; re-run "
-                    f"shard_optimizer_states for this mesh")
-            state_specs[n] = P("dp")
+        # ZeRO sharded buckets (distributed/sharding.py stages 1-3:
+        # optimizer slots, gradient-merge shard accumulators, stage-3
+        # param buckets): persistables declared at the GLOBAL padded
+        # shape and marked dp_shard shard over "dp", so each rank holds
+        # (and donates, and updates) only its slice.  Any dp degree
+        # dividing the padded length runs the same program.  The specs
+        # come from the partition-spec engine — the single consumption
+        # point, so the engine's plan and the mesh's placement can never
+        # drift apart.
+        from .partition_spec import state_partition_specs
+        state_specs = state_partition_specs(program, mesh, state_names)
         if has_tp:
             # param sharding from dist_attr annotations
             # (tensor_parallel.py shard_param); optimizer accumulators

@@ -11,8 +11,21 @@ step stays one XLA computation.  Note the merged-grad allreduce (inserted
 later by CompiledProgram on the optimizer's Grad input) is then also
 executed every step; XLA overlaps it with compute and psum is linear, so
 numerics match the reference's communicate-on-apply schedule.
+
+ZeRO-2/3 composition (distributed/sharding.py stage>=2): instead of
+full-size per-param accumulators, the bucket gradient is accumulated
+AFTER its reduce-scatter — the accumulator is a ``dp_shard`` persistable
+at 1/N per chip, so k-step accumulation costs params/N instead of
+params.  The sharding pass stamps its ops with ``zero_role`` so this
+pass can keep the per-step plumbing (flatten → concat → reduce-scatter
+→ scale) raw and unmasked, splice the shard accumulation at the
+``grad_shard`` boundary the recorded plan names, and mask only the
+update/publish tail.  The merged gradient is never re-gathered — the
+V201 "deferred counterpart" story.
 """
 from __future__ import annotations
+
+import warnings as _warnings
 
 from ....core.program import OpDesc, OpRole, default_startup_program, \
     unique_name
@@ -40,11 +53,40 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
     opt_ops = block.ops[opt_start:]
     block.ops = block.ops[:opt_start]
 
+    # ZeRO-2/3: accumulate the reduce-scattered bucket shard at 1/N
+    # instead of full-size per-param grads.  Only sound when the bucket
+    # consumes the RAW backward gradients — an interposed rewrite (grad
+    # clip, AMP unscale) between backward and the bucket means every
+    # micro-step's value is a function of the partial average, and
+    # accumulating downstream of it would change the math; fall back to
+    # the classic full-size path there (stage 2 degrades to stage 1's
+    # accumulation with a warning, numerics first).
+    plan = getattr(program, "_zero_shard_plan", None)
+    shard_acc = bool(plan is not None and getattr(plan, "stage", 1) >= 2
+                     and getattr(plan, "buckets", None))
+    bucket_grads = set()
+    if shard_acc:
+        bucket_grads = {p["grad"] for b in plan.buckets
+                        for p in b["params"]}
+        raw_grads = {g.name for _p, g in params_grads}
+        if not bucket_grads <= raw_grads:
+            _warnings.warn(
+                "gradient_merge: ZeRO stage>=2 sharded accumulation "
+                "needs the gradient buckets to consume raw backward "
+                "gradients, but an interposed rewrite (grad clip / AMP "
+                "unscale) renamed them — falling back to full-size "
+                "per-param accumulators (stage-1 memory behaviour, "
+                "identical numerics)", RuntimeWarning, stacklevel=3)
+            shard_acc = False
+            bucket_grads = set()
+
     mask = append_masked_step_counter(program, startup, k_steps, prefix="gm")
 
     grad_to_avg = {}   # grad name -> merged (avg) grad fed to optimizer ops
     grad_to_acc = {}   # grad name -> persistable accumulator
     for p, g in params_grads:
+        if g.name in bucket_grads:
+            continue  # accumulated post-reduce-scatter at 1/N instead
         acc = unique_name(g.name + "@GradientMerge")
         block.create_var(name=acc, shape=g.shape, dtype=g.dtype,
                          persistable=True, stop_gradient=True)
@@ -68,11 +110,52 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
         grad_to_avg[g.name] = avg_name
         grad_to_acc[g.name] = acc
 
+    def _append_shard_accumulate(gshard, bucket):
+        """sacc += grad_shard every step; the update reads sacc/k.  The
+        accumulator is declared at the GLOBAL padded bucket shape and
+        marked dp_shard — each rank holds (and donates) 1/N of it."""
+        sacc = unique_name(bucket["name"] + "@GSHARD_ACC")
+        sb = startup.global_block()
+        for blk in (block, sb):
+            v = blk.create_var(name=sacc, shape=[bucket["padded_len"]],
+                               dtype=bucket["grad_dtype"],
+                               persistable=True, stop_gradient=True)
+            v.attrs["dp_shard"] = int(plan.dp_degree)
+        sb.ops.append(OpDesc(
+            "fill_constant", {}, {"Out": [sacc]},
+            {"shape": [bucket["padded_len"]], "value": 0.0,
+             "dtype": bucket["grad_dtype"],
+             "op_uid": startup._next_uid()}))
+        _op(program, block, "elementwise_add",
+            {"X": [sacc], "Y": [gshard]}, {"Out": [sacc]})
+        if avg:
+            avg_name = new_tmp_var(block, like=block.var(sacc),
+                                   name_hint=bucket["name"] + "@GM_AVG")
+            _op(program, block, "scale", {"X": [sacc]},
+                {"Out": [avg_name]}, {"scale": 1.0 / k_steps, "bias": 0.0})
+        else:
+            avg_name = sacc
+        return sacc, avg_name
+
     # optimizer ops: read merged grads, commit only on masked steps.
     # `rename` keeps intra-group dataflow intact: later ops read the fresh
     # @MASKED temps of earlier ops in the group, not the stale vars.
     tail = []
     rename = {}
+    shard_accs = []
+    if shard_acc:
+        # stage>=2: the bucket reduce-scatters are interleaved in
+        # BACKWARD (before the optimizer split), so the per-step shard
+        # is already live here — accumulate it at the head of the
+        # optimizer region and point the bucket update at the merged
+        # shard instead of this step's
+        for bucket in plan.buckets:
+            gs = bucket.get("grad_shard")
+            if not gs:
+                continue
+            sacc, avg_name = _append_shard_accumulate(gs, bucket)
+            shard_accs.append(sacc)
+            rename[gs] = avg_name
     for op in opt_ops:
         for slot, names in op.inputs.items():
             op.inputs[slot] = [rename.get(grad_to_avg.get(n, n),
@@ -88,20 +171,25 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
     # restore_from_checkpoint reads this meta from both sides
     program._gm_meta = {"counter": program._last_masked_counter,
                         "k": int(k_steps),
-                        "accs": sorted(grad_to_acc.values())}
+                        "accs": sorted(list(grad_to_acc.values()) +
+                                       shard_accs)}
 
-    # reset accumulators on masked steps: acc = where(mask, 0, acc)
-    for gname, acc in grad_to_acc.items():
+    # reset accumulators on masked steps: acc = where(mask, 0, acc).
+    # fill_zeros_like, not fill_constant with the declared shape: a
+    # dp_shard accumulator is declared at the GLOBAL padded shape but
+    # each rank traces its 1/N slice under shard_map — the zeros must
+    # follow the runtime shape
+    for acc in list(grad_to_acc.values()) + shard_accs:
         zeros = new_tmp_var(block, like=block.var(acc),
                             name_hint=acc + "@ZERO")
-        gshape = list(block.var(acc).shape or [1])
-        _op(program, block, "fill_constant", {}, {"Out": [zeros]},
-            {"shape": gshape, "value": 0.0, "dtype": block.var(acc).dtype})
+        _op(program, block, "fill_zeros_like", {"X": [acc]},
+            {"Out": [zeros]}, {"dtype": block.var(acc).dtype})
         _op(program, block, "where", {"Condition": [mask], "X": [zeros],
                                       "Y": [acc]}, {"Out": [acc]})
     program._fingerprint_cache = None
     finish_pass(program, "gradient_merge", startup=startup,
-                k=int(k_steps))
+                k=int(k_steps), zero_stage=(getattr(plan, "stage", 0)
+                                            if shard_acc else 0))
     return program, mask
 
 

@@ -18,7 +18,12 @@ sharding_configs:
   * ``dp_degree`` — the DP world the bucket padding targets (default:
     local device count, the mesh CompiledProgram will build);
   * ``bucket_mb`` — flat-bucket coalescing granularity in MB (falls back
-    to the reference's ``fuse_broadcast_MB`` key, default 32).
+    to the reference's ``fuse_broadcast_MB`` key, default 32);
+  * ``stage`` — ZeRO stage 1/2/3 (default 1; the reference key
+    ``sharding_degree`` semantics stay with ``dp_degree``): 2 keeps the
+    reduce-scattered grad buckets sharded through gradient_merge
+    accumulation, 3 shards the parameters themselves with just-in-time
+    per-bucket allgather (distributed/sharding.py).
 """
 from __future__ import annotations
 
@@ -56,5 +61,6 @@ class ShardingOptimizer(MetaOptimizerBase):
             program, startup,
             dp_degree=c.get("dp_degree") or None,
             bucket_bytes=int(float(bucket_mb) * 2 ** 20) if bucket_mb
-            else None)
+            else None,
+            stage=int(c.get("stage", 1)))
         return ops, params_grads

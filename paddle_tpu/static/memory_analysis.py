@@ -308,7 +308,8 @@ def _phase_of(op) -> str:
 
 def analyze_program(program: Program, batch: Optional[int] = None,
                     budget_bytes: Optional[int] = None,
-                    dp_shard: Optional[int] = None) -> Dict:
+                    dp_shard: Optional[int] = None,
+                    zero_stage: Optional[int] = None) -> Dict:
     """Full liveness report for `program`'s global block.
 
     Returns a dict with ``peak_bytes`` (persistables + peak live
@@ -322,21 +323,37 @@ def analyze_program(program: Program, batch: Optional[int] = None,
     when set, else 1 (which makes batch-dynamic programs a lower bound —
     pass the real batch for a fits/OOM verdict that means anything).
 
-    World-size-aware slot accounting (ZeRO-1, distributed/sharding.py):
-    a persistable marked ``dp_shard`` (a sharded bucket slot declared at
-    the GLOBAL padded shape) is charged 1/degree per chip — the walker
-    reports per-chip footprints.  `dp_shard` (argument; defaults to
-    ``FLAGS_hbm_dp_shard``) additionally PREDICTS sharding an unsharded
-    program: per-param optimizer accumulators (``accum_of``-linked vars)
-    are charged 1/N, answering "would ERNIE-large-b24 fit under ZeRO-1?"
-    before the rewrite is ever applied.
+    World-size-aware accounting (ZeRO stages 1-3,
+    distributed/sharding.py): a persistable marked ``dp_shard`` (a
+    sharded bucket — optimizer slots, stage-2 gradient accumulators, or
+    a stage-3 param bucket — declared at the GLOBAL padded shape) is
+    charged 1/degree per chip — the walker reports per-chip footprints.
+    An APPLIED program therefore needs no stage argument: the stamps on
+    its vars carry the whole story (stage-3 params additionally show up
+    as gathered ACTIVATIONS with forward/backward-bounded liveness,
+    which the live-set sweep prices for free).
+
+    `dp_shard` (argument; defaults to ``FLAGS_hbm_dp_shard``)
+    additionally PREDICTS sharding an unsharded program: per-param
+    optimizer accumulators (``accum_of``-linked vars) are charged 1/N,
+    answering "would ERNIE-large-b24 fit under ZeRO-1?" before the
+    rewrite is ever applied.  `zero_stage` (defaults to
+    ``FLAGS_hbm_zero_stage``) extends the prediction up the ladder:
+    stage >= 3 also divides the parameters the pass would pack
+    (`predicted_shardable_params`).  Stage-3 prediction is a LOWER
+    bound — it does not model the transient gathered copies — so the
+    applied program's walk is the authority (the planner prices applied
+    clones, never predictions).
     """
     from ..core.flags import flag
     if batch is None:
         batch = int(flag("hbm_assume_batch", 0)) or 1
     if dp_shard is None:
         dp_shard = int(flag("hbm_dp_shard", 0)) or None
+    if zero_stage is None:
+        zero_stage = int(flag("hbm_zero_stage", 0)) or 1
     pred_shard = int(dp_shard) if dp_shard and int(dp_shard) > 1 else 0
+    pred_stage = max(1, int(zero_stage)) if pred_shard else 0
     budget = hbm_budget_bytes() if budget_bytes is None else int(budget_bytes)
     block = program.global_block()
     sizer = _Sizer(block, batch)
@@ -348,30 +365,43 @@ def analyze_program(program: Program, batch: Optional[int] = None,
             if v.persistable:
                 persistable.add(v.name)
                 var_desc.setdefault(v.name, v)
-    # prediction mode only divides slots the sharding pass would ACTUALLY
+    # prediction mode only divides state the sharding pass would ACTUALLY
     # partition — an Adamax moment or a MasterParam-carrying op's slots
     # stay replicated, so the verdict never claims memory the rewrite
     # cannot deliver
     shardable: Set[str] = set()
+    shardable_params: Set[str] = set()
     if pred_shard:
         from ..distributed.sharding import predicted_shardable_slots
         shardable = predicted_shardable_slots(program)
+        if pred_stage >= 3:
+            from ..distributed.sharding import predicted_shardable_params
+            shardable_params = predicted_shardable_params(program)
     persistable_bytes = 0
     slot_bytes = 0
+    param_bytes = 0
     for n in sorted(persistable):
         raw = sizer(n)
         v = var_desc.get(n)
         marked = int((v.attrs.get("dp_shard") or 0) if v is not None else 0)
-        is_slot = v is not None and bool(marked or v.attrs.get("accum_of"))
+        is_slot = v is not None and bool(
+            (marked and not v.attrs.get("zero_param_bucket"))
+            or v.attrs.get("accum_of"))
+        is_param = v is not None and bool(
+            v.is_parameter or v.attrs.get("zero_param_bucket"))
         if marked > 1:
             cost = -(-raw // marked)          # per-chip slice of the bucket
         elif pred_shard and n in shardable:
-            cost = -(-raw // pred_shard)      # predicted ZeRO-1 slot share
+            cost = -(-raw // pred_shard)      # predicted ZeRO slot share
+        elif pred_shard and n in shardable_params:
+            cost = -(-raw // pred_shard)      # predicted ZeRO-3 param share
         else:
             cost = raw
         persistable_bytes += cost
         if is_slot:
             slot_bytes += cost
+        if is_param:
+            param_bytes += cost
 
     ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
 
@@ -450,8 +480,17 @@ def analyze_program(program: Program, batch: Optional[int] = None,
         if cur > peak:
             peak, peak_idx, peak_type = cur, i, op.type
             peak_live = set(live)
-        # inputs AND outputs whose last use is behind us die here
-        for n in set(op.input_names()) | set(op.output_names()):
+        # inputs AND outputs whose last use is behind us die here — and
+        # so do the ROOT buffers of any alias among them: a buffer that
+        # is only ever read through alias views (ZeRO-3's slice → seg →
+        # reshape-to-param gather chains) never reappears by name in a
+        # later op, so sweeping only the op's own names would leak it
+        # forever.  (Backward ops formally mention every forward input,
+        # which is why ordinary residual roots never hit this path.)
+        mentioned = set(op.input_names()) | set(op.output_names())
+        for n in list(mentioned):
+            mentioned |= reps.get(n, frozenset())
+        for n in mentioned:
             if n in live and last_use.get(n, -1) <= i:
                 cur -= cost_of.get(n, 0)
                 live.discard(n)
@@ -463,6 +502,10 @@ def analyze_program(program: Program, batch: Optional[int] = None,
         "dp_shard": int(pred_shard) if pred_shard else None,
         "persistable_bytes": int(persistable_bytes),
         "optimizer_slot_bytes": int(slot_bytes),
+        # per-chip PARAMETER state (replicated params, or the 1/degree
+        # slice of ZeRO-3 dp_shard param buckets) — the stage-3 claim
+        # the shard smoke and docs tables report
+        "parameter_bytes": int(param_bytes),
         "activation_peak_bytes": int(peak),
         "peak_bytes": int(persistable_bytes + peak),
         "phase_peaks": {k: int(v + persistable_bytes)

@@ -14,8 +14,9 @@ Closes the ROADMAP loop the previous tiers opened one leg at a time:
 Until now these estimators answered questions a HUMAN asked — the
 docs/perf.md decision table was hand-tuned by a reviewer reading them.
 `plan_program` asks all the questions itself: it enumerates the knob
-lattice (batch bucket × remat × ZeRO-1 dp_shard degree × gradient-merge
-K × shard bucket-MB × ring-attention variant), applies each candidate
+lattice (batch bucket × remat × ZeRO dp_shard degree × ZeRO stage 1/2/3
+× gradient-merge K × shard bucket-MB × ring-attention variant), applies
+each candidate
 as a REAL program rewrite on a clone (every knob already is one:
 `recompute_rewrite.apply_recompute`, `sharding.shard_optimizer_states`,
 `static.gradient_merge`, `insert_grad_allreduce`; ring rides as a
@@ -79,6 +80,15 @@ DEFAULT_ICI_BYTES_PER_S = 200e9
 DEFAULT_BATCH_BUCKETS = (8, 16, 32, 64, 96, 128)
 DEFAULT_GRAD_MERGE = (1, 2)
 DEFAULT_BUCKET_MB = (32,)
+# ZeRO stages searched when a dp_shard degree is on the lattice: 1 =
+# optimizer slots, 2 = + sharded gradient accumulation (only distinct
+# from 1 under gradient_merge), 3 = + full parameter sharding with JIT
+# gathers (distributed/sharding.py)
+DEFAULT_ZERO_STAGES = (1, 2, 3)
+
+# the full knob tuple one lattice point carries, in table order
+KNOB_KEYS = ("batch", "remat", "dp_shard", "zero_stage", "grad_merge",
+             "bucket_mb", "ring")
 
 # gradient reduction collectives XLA overlaps with backward compute;
 # everything else (the allgather publish, forward collectives) is
@@ -105,11 +115,12 @@ def ici_bytes_per_chip() -> float:
 class Plan:
     """The argmax of one `plan_program` search.
 
-    ``knobs``: {"batch", "remat", "dp_shard", "grad_merge", "bucket_mb",
-    "ring"} — the applied spelling of the lattice point.  ``predicted``
-    fields are the roofline numbers for the chosen candidate; ``trace``
-    is the full per-candidate table (one dict per lattice point, priced
-    and gated — the docs/perf.md decision-table source)."""
+    ``knobs``: {"batch", "remat", "dp_shard", "zero_stage", "grad_merge",
+    "bucket_mb", "ring"} — the applied spelling of the lattice point.
+    ``predicted`` fields are the roofline numbers for the chosen
+    candidate; ``trace`` is the full per-candidate table (one dict per
+    lattice point, priced and gated — the docs/perf.md decision-table
+    source)."""
 
     def __init__(self, knobs: Dict, world: int, hbm_budget_bytes: int,
                  chosen: Dict, trace: List[Dict]):
@@ -150,20 +161,21 @@ class Plan:
     def render_table(self) -> str:
         """The per-candidate trace as a markdown table (the docs/perf.md
         decision-table source)."""
-        head = ("| batch | remat | dp_shard | gm K | bucket MB | ring | "
-                "peak GiB | fits | step ms | verdict |")
-        sep = "|---|---|---|---|---|---|---|---|---|---|"
+        head = ("| batch | remat | dp_shard | stage | gm K | bucket MB | "
+                "ring | peak GiB | fits | step ms | verdict |")
+        sep = "|---|---|---|---|---|---|---|---|---|---|---|"
         rows = [head, sep]
         for c in self.trace:
             rows.append(
-                "| {batch} | {remat} | {dp_shard} | {grad_merge} | "
-                "{bucket_mb} | {ring} | {gib:.2f} | {fits} | "
-                "{step_ms:.2f} | {verdict} |".format(
+                "| {batch} | {remat} | {dp_shard} | {zero_stage} | "
+                "{grad_merge} | {bucket_mb} | {ring} | {gib:.2f} | "
+                "{fits} | {step_ms:.2f} | {verdict} |".format(
                     gib=c["peak_bytes"] / 2 ** 30,
                     fits="yes" if c["fits"] else "no",
                     **{k: c[k] for k in ("batch", "remat", "dp_shard",
-                                         "grad_merge", "bucket_mb",
-                                         "ring", "step_ms", "verdict")}))
+                                         "zero_stage", "grad_merge",
+                                         "bucket_mb", "ring", "step_ms",
+                                         "verdict")}))
         return "\n".join(rows)
 
     def __repr__(self):
@@ -208,6 +220,7 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
                    ((False, True) if can_remat else (False,)))
     dps = tuple(knobs.get("dp_shard") or
                 ((0, int(world)) if world > 1 else (0,)))
+    stages = tuple(knobs.get("zero_stage") or DEFAULT_ZERO_STAGES)
     gms = tuple(knobs.get("grad_merge") or
                 (DEFAULT_GRAD_MERGE if can_gm else (1,)))
     buckets = tuple(knobs.get("bucket_mb") or DEFAULT_BUCKET_MB)
@@ -216,8 +229,8 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
 
     seen = set()
     out = []
-    for b, r, dp, gm, mb, ring in itertools.product(
-            batches, remats, dps, gms, buckets, rings):
+    for b, r, dp, z, gm, mb, ring in itertools.product(
+            batches, remats, dps, stages, gms, buckets, rings):
         if ring and not have_ring_variant:
             continue
         if not can_remat and r:
@@ -225,13 +238,20 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
         if not can_gm and gm > 1:
             continue
         mb_eff = int(mb) if dp > 1 else 0   # bucket size is a ZeRO knob
-        key = (int(b), bool(r), int(dp), int(gm), mb_eff, bool(ring))
+        # the stage axis only exists once a dp degree does; stage 2
+        # without gradient_merge IS stage 1 (the sharded accumulator
+        # only materializes under a merge window), so it collapses
+        z_eff = int(z) if dp > 1 else 0
+        if z_eff == 2 and gm <= 1:
+            z_eff = 1
+        key = (int(b), bool(r), int(dp), z_eff, int(gm), mb_eff,
+               bool(ring))
         if key in seen:
             continue
         seen.add(key)
         out.append({"batch": int(b), "remat": bool(r), "dp_shard": int(dp),
-                    "grad_merge": int(gm), "bucket_mb": mb_eff,
-                    "ring": bool(ring)})
+                    "zero_stage": z_eff, "grad_merge": int(gm),
+                    "bucket_mb": mb_eff, "ring": bool(ring)})
     return out
 
 
@@ -256,7 +276,8 @@ def _apply_knobs(main: Program, startup: Optional[Program],
         shard_optimizer_states(
             m, s, dp_degree=cand["dp_shard"],
             bucket_bytes=(cand["bucket_mb"] * 2 ** 20
-                          if cand["bucket_mb"] else None))
+                          if cand["bucket_mb"] else None),
+            stage=int(cand.get("zero_stage") or 1))
     if cand["grad_merge"] > 1 and not has_applied(m, "gradient_merge"):
         from .optimizer import gradient_merge
         gradient_merge(m, cand["grad_merge"], s)
@@ -409,12 +430,15 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     # must describe clones that can actually exist, and the recorded
     # plan must match the applied state (V504)
     pre_remat = has_applied(program, "recompute")
-    pre_dp = pre_bucket_mb = 0
+    pre_dp = pre_bucket_mb = pre_stage = 0
     if has_applied(program, "zero1_sharding"):
         zs = next((e for e in reversed(applied_passes(program))
                    if e["pass"] == "zero1_sharding"), {})
         zplan = getattr(program, "_zero_shard_plan", None)
         pre_dp = int(zplan.dp_degree) if zplan is not None else world
+        pre_stage = int(getattr(zplan, "stage", 0) or
+                        zs.get("stage", 1)) if zplan is not None else \
+            int(zs.get("stage", 1))
         if zs.get("bucket_bytes"):
             pre_bucket_mb = max(1, int(zs["bucket_bytes"]) // 2 ** 20)
     pre_gm = 0
@@ -437,6 +461,7 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         # outside the default (0, world) axis would otherwise empty the
         # lattice and silently discard the batch search)
         eff_knobs["dp_shard"] = (pre_dp,)
+        eff_knobs["zero_stage"] = (pre_stage or 1,)
         if pre_bucket_mb:
             eff_knobs["bucket_mb"] = (pre_bucket_mb,)
     lattice = _knob_lattice(world, batch, eff_knobs,
@@ -447,7 +472,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         # no checkpointable layers): fall back to pricing the program
         # as-is so the caller still gets a verdict
         lattice = [{"batch": int(batch or 1), "remat": pre_remat,
-                    "dp_shard": pre_dp, "grad_merge": pre_gm or 1,
+                    "dp_shard": pre_dp, "zero_stage": pre_stage,
+                    "grad_merge": pre_gm or 1,
                     "bucket_mb": pre_bucket_mb, "ring": pre_ring}]
 
     trace: List[Dict] = []
@@ -457,8 +483,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
             base_main, base_startup = (program, startup)
             if cand["ring"] and not pre_ring:
                 base_main, base_startup = variants["ring"]
-            rkey = (cand["remat"], cand["dp_shard"], cand["grad_merge"],
-                    cand["bucket_mb"], cand["ring"])
+            rkey = (cand["remat"], cand["dp_shard"], cand["zero_stage"],
+                    cand["grad_merge"], cand["bucket_mb"], cand["ring"])
             point = points.get(rkey)
             if point is None:
                 point = points[rkey] = _RewritePoint(
@@ -487,7 +513,10 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     feasible = [r for r in trace if r["fits"]]
 
     def _n_knobs(r):
+        # higher ZeRO stages count as extra knobs so ties prefer the
+        # least-invasive rewrite (plain < zero1 < zero2 < zero3)
         return (int(r["remat"]) + int(r["dp_shard"] > 1) +
+                max(0, int(r.get("zero_stage") or 0) - 1) +
                 int(r["grad_merge"] > 1) + int(r["ring"]))
 
     if feasible:
@@ -504,12 +533,9 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         chosen["verdict"] = (chosen["verdict"] +
                              "; chosen (nothing fits)").lstrip("; ")
     for r in trace:
-        if all(r[k] == chosen[k] for k in ("batch", "remat", "dp_shard",
-                                           "grad_merge", "bucket_mb",
-                                           "ring")):
+        if all(r[k] == chosen[k] for k in KNOB_KEYS):
             r["verdict"] = chosen["verdict"]
-    knob_dict = {k: chosen[k] for k in ("batch", "remat", "dp_shard",
-                                        "grad_merge", "bucket_mb", "ring")}
+    knob_dict = {k: chosen[k] for k in KNOB_KEYS}
     plan = Plan(knob_dict, world, budget, chosen, trace)
     # non-registry attachment for inspection/telemetry; the REGISTRY
     # entry is written by apply_plan, at application time, so the V504
@@ -540,8 +566,7 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
             f"program was built with ring_attention={has_ring} — apply the "
             f"plan to the matching build variant "
             f"(nets.scaled_dot_product_attention(sequence_parallel=...))")
-    meta = {k: knobs.get(k) for k in ("batch", "remat", "dp_shard",
-                                      "grad_merge", "bucket_mb", "ring")}
+    meta = {k: knobs.get(k) for k in KNOB_KEYS}
     if isinstance(plan, Plan):
         meta["predicted_step_ms"] = round(plan.predicted_step_ms, 4)
         meta["predicted_peak_bytes"] = plan.predicted_peak_bytes
@@ -555,7 +580,8 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
         shard_optimizer_states(
             program, startup, dp_degree=int(knobs["dp_shard"]),
             bucket_bytes=(int(knobs["bucket_mb"]) * 2 ** 20
-                          if knobs.get("bucket_mb") else None))
+                          if knobs.get("bucket_mb") else None),
+            stage=int(knobs.get("zero_stage") or 1))
     if int(knobs.get("grad_merge") or 1) > 1 and \
             not has_applied(program, "gradient_merge"):
         from .optimizer import gradient_merge

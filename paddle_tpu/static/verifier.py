@@ -323,6 +323,17 @@ def collective_sequence(program: Program) -> List[dict]:
                 "nbytes": (numel * _dtype_bytes(dtype)
                            if numel is not None else None),
                 "op_uid": op.attrs.get("op_uid"),
+                # ZeRO stage stamps (distributed/sharding.py): stage the
+                # pass emitted this op for and its role in the bucket
+                # chain — the stage-aware pairing checks and the wire
+                # pricer both read them
+                "zero_stage": op.attrs.get("zero_stage"),
+                "zero_role": op.attrs.get("zero_role"),
+                # the X operand is a dp_shard persistable declared at the
+                # GLOBAL padded shape: each rank's LOCAL operand is
+                # 1/degree of the declared bytes (ZeRO-3 param gathers)
+                "x_dp_shard": (int(v.attrs.get("dp_shard") or 0)
+                               if v is not None else 0),
             })
     return seq
 
@@ -355,7 +366,11 @@ def entry_wire_bytes(entry: dict, world: int) -> float:
              "partial_allgather"):
         # input is the local shard; the ring moves (g-1) remote shards
         # (c_concat's kernel IS a tiled all_gather, ops/kernels/
-        # collective.py)
+        # collective.py).  When the operand is a dp_shard persistable
+        # declared at the GLOBAL padded shape (a ZeRO-3 param-bucket
+        # gather), the local shard is 1/g of the declared bytes.
+        if entry.get("x_dp_shard"):
+            return (g - 1) / g * n
         return float((g - 1) * n)
     if t in ("p_send", "p_recv"):
         return float(n)
@@ -726,31 +741,119 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
                     block_idx=e["block"], op_idx=e["index"],
                     op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
         if e["type"] == "c_allgather" and in_n is not None and \
-                out_n is not None and out_n != in_n * d:
-            out.append(Diagnostic(
-                "V203", ERROR,
-                f"c_allgather output numel {out_n} != input {in_n} × "
-                f"dp_degree {d}",
-                block_idx=e["block"], op_idx=e["index"],
-                op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+                out_n is not None:
+            if e.get("x_dp_shard"):
+                # ZeRO-3 JIT gather: the operand is DECLARED at the
+                # global padded shape (each rank's traced slice is 1/d),
+                # so the gathered output must equal the declared input
+                if out_n != in_n:
+                    out.append(Diagnostic(
+                        "V203", ERROR,
+                        f"c_allgather of dp_shard var: output numel "
+                        f"{out_n} != the bucket's declared global numel "
+                        f"{in_n}",
+                        block_idx=e["block"], op_idx=e["index"],
+                        op_type=e["type"], op_uid=e["op_uid"],
+                        var=e["var"]))
+            elif out_n != in_n * d:
+                out.append(Diagnostic(
+                    "V203", ERROR,
+                    f"c_allgather output numel {out_n} != input {in_n} × "
+                    f"dp_degree {d}",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
 
     # V201/V202b: reduce-scatter ↔ allgather pairing with matching
-    # bucket plans.  The ZeRO-1 recipe is rs(bucket) → sharded update →
-    # ag(shard): every degree-stamped rs must be followed by an ag whose
-    # local operand is the same shard length, on the same ring.  Pair
-    # greedily in program order by shard numel; ring mismatches on an
-    # otherwise-matching pair get the sharper V202.
+    # bucket plans, validated AGAINST THE RECORDED STAGE.  The ZeRO-1/2
+    # recipe is rs(bucket) → sharded update → ag(shard): every
+    # degree-stamped rs must be followed by an ag whose local operand is
+    # the same shard length, on the same ring.  ZeRO-3 changes both
+    # halves: a JIT param gather (``zero_role`` gather_fwd/gather_bwd)
+    # is not a publish — it must read a dp_shard param bucket — and the
+    # grad reduce-scatter's "gathered counterpart" is the NEXT step's
+    # forward gather, so instead of an ag pairing the rs must reach (via
+    # pass-inserted plumbing — the gradient-merge shard accumulator
+    # included) a ``zero_sharded`` update writing a dp_shard bucket in
+    # place.  Pair the rest greedily in program order by shard numel;
+    # ring mismatches on an otherwise-matching pair get the sharper
+    # V202.
+    block0 = program.global_block()
+    consumers: Dict[str, List[OpDesc]] = {}
+    for op in block0.ops:
+        for n in op.input_names():
+            if n:
+                consumers.setdefault(n, []).append(op)
+
+    def _reaches_inplace_sharded_update(rs_entry) -> bool:
+        """rs output → (transparent plumbing)* → op with `zero_sharded`
+        whose ParamOut is a dp_shard var (the ZeRO-3 in-place bucket
+        update — the structural witness that the publish is deferred to
+        the next step's gather)."""
+        op0 = program.blocks[rs_entry["block"]].ops[rs_entry["index"]]
+        frontier = [n for n in op0.outputs.get("Out", []) if n]
+        seen: Set[str] = set()
+        hops = 64
+        while frontier and hops > 0:
+            hops -= 1
+            n = frontier.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            for c in consumers.get(n, ()):
+                if c.attrs.get("zero_sharded"):
+                    pouts = c.outputs.get("ParamOut", [])
+                    pv = _var_of(block0, pouts[0]) if pouts else None
+                    if pv is not None and pv.attrs.get("dp_shard"):
+                        return True
+                    # under gradient_merge the update's ParamOut is a
+                    # @MASKED temp and the bucket write is the deferred
+                    # where(mask, temp, bucket) commit — follow it
+                    for w in consumers.get(pouts[0] if pouts else "", ()):
+                        if w.type != "where":
+                            continue
+                        wouts = w.outputs.get("Out", [])
+                        wv = _var_of(block0, wouts[0]) if wouts else None
+                        if wv is not None and wv.attrs.get("dp_shard"):
+                            return True
+                    continue
+                if c.type in _REDUCE_TRANSPARENT or \
+                        c.type in ("elementwise_add", "scale", "where"):
+                    frontier.extend(m for m in c.output_names() if m)
+        return False
+
     rs_open: List[dict] = []
     for e in seq:
         if e["dp_degree"] is None:
             continue
         if e["type"] == "c_reducescatter":
+            if e.get("zero_stage") == 3 and \
+                    _reaches_inplace_sharded_update(e):
+                # deferred publish: the sharded update writes the param
+                # bucket in place; the next step's JIT gather is the ag
+                continue
             d = e["dp_degree"]
             n = _numel(e["shape"])
             e["_shard"] = (n // d) if (n is not None and d and
                                        n % d == 0) else None
             rs_open.append(e)
         elif e["type"] == "c_allgather":
+            if e.get("zero_role") in ("gather_fwd", "gather_bwd"):
+                # ZeRO-3 JIT param gather: never part of the publish
+                # pairing, but it must actually read sharded state — a
+                # gather of a replicated var would move (g-1)× the full
+                # params over ICI for nothing
+                if not e.get("x_dp_shard"):
+                    out.append(Diagnostic(
+                        "V201", ERROR,
+                        f"ZeRO-3 JIT param gather reads {e['var']!r}, "
+                        f"which is not a dp_shard-marked bucket: the "
+                        f"gather would replicate an already-replicated "
+                        f"buffer (stage stamp disagrees with the "
+                        f"program's sharded state)",
+                        block_idx=e["block"], op_idx=e["index"],
+                        op_type=e["type"], op_uid=e["op_uid"],
+                        var=e["var"]))
+                continue
             n = _numel(e["shape"])  # ag input IS the local shard
             match = next((r for r in rs_open if r["_shard"] is not None
                           and r["_shard"] == n), None)
@@ -776,6 +879,16 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
                         op_type=e["type"], op_uid=e["op_uid"],
                         var=e["var"]))
     for r in rs_open:
+        if r.get("zero_stage") == 3:
+            out.append(Diagnostic(
+                "V201", ERROR,
+                f"ZeRO-3 c_reducescatter (bucket {r['var']!r}) reaches "
+                f"no in-place sharded update of a dp_shard param bucket "
+                f"and no publish allgather: the reduced gradients go "
+                f"nowhere (the deferred-publish contract is broken)",
+                block_idx=r["block"], op_idx=r["index"], op_type=r["type"],
+                op_uid=r["op_uid"], var=r["var"]))
+            continue
         out.append(Diagnostic(
             "V201", ERROR,
             f"c_reducescatter (bucket {r['var']!r}) is never published "
@@ -785,10 +898,36 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
             block_idx=r["block"], op_idx=r["index"], op_type=r["type"],
             op_uid=r["op_uid"], var=r["var"]))
 
-    # V204: dp_shard metadata consistency
+    # V204: dp_shard metadata consistency — degree AND stage.  Every op
+    # the sharding pass emitted is stamped with the stage it was emitted
+    # for; the recorded plan is the authority, and a disagreement means
+    # the program was rewritten twice for different stages (or a stamp
+    # was hand-edited) — the stage-aware V201/V203 rules above would
+    # then be validating against the wrong contract.
     plan = getattr(program, "_zero_shard_plan", None)
     plan_degree = int(plan.dp_degree) if plan is not None and \
         getattr(plan, "buckets", None) else None
+    plan_stage = int(getattr(plan, "stage", 1)) if plan is not None and \
+        getattr(plan, "buckets", None) else None
+    if plan_stage is not None:
+        stamped_stages = {int(op.attrs["zero_stage"])
+                          for b in program.blocks for op in b.ops
+                          if op.attrs.get("zero_stage") is not None}
+        for s in sorted(stamped_stages - {plan_stage}):
+            out.append(Diagnostic(
+                "V204", ERROR,
+                f"ops stamped zero_stage={s} disagree with the recorded "
+                f"ShardingPlan stage={plan_stage}: the program carries "
+                f"two different ZeRO rewrites (or a stamp was edited) — "
+                f"stage-aware collective validation is unsound"))
+        has_pbucket = any(v.attrs.get("zero_param_bucket")
+                          for b in program.blocks for v in b.vars.values())
+        if has_pbucket and plan_stage < 3:
+            out.append(Diagnostic(
+                "V204", ERROR,
+                f"a ZeRO-3 param bucket var exists but the recorded plan "
+                f"says stage={plan_stage}: parameters are sharded without "
+                f"the stage-3 gather/update contract on record"))
     stamped = {d for degs in ring_degrees.values() for d in degs}
     for b in program.blocks:
         for v in b.vars.values():
@@ -927,6 +1066,11 @@ def _check_pass_order(program: Program, out: List[Diagnostic]):
         dp_applied = int(zs.get("dp_degree", 0)) if zs else 0
         if "dp_shard" in plan and int(plan["dp_shard"] or 0) != dp_applied:
             _drift("dp_shard", int(plan["dp_shard"] or 0), dp_applied)
+        stage_applied = int(zs.get("stage", 1)) if zs else 0
+        if "zero_stage" in plan and \
+                int(plan["zero_stage"] or 0) != stage_applied:
+            _drift("zero_stage", int(plan["zero_stage"] or 0),
+                   stage_applied)
         if zs is not None and plan.get("bucket_mb") and \
                 zs.get("bucket_bytes") and \
                 int(plan["bucket_mb"]) * 2 ** 20 != int(zs["bucket_bytes"]):

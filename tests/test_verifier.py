@@ -19,7 +19,7 @@ import paddle_tpu.static as static
 from paddle_tpu.static import layers
 from paddle_tpu.static.verifier import (
     ProgramVerificationError, check_program, collective_sequence,
-    collective_wire_bytes, self_check, verify_mode)
+    collective_wire_bytes, entry_wire_bytes, self_check, verify_mode)
 from paddle_tpu.core.pass_framework import (applied_passes, has_applied,
                                             record_applied)
 from paddle_tpu.core.program import OpDesc, OpRole, _reset_unique_names
@@ -41,9 +41,11 @@ def build_train(opt_cls=None, lr=1e-3):
     return main, startup, loss
 
 
-def build_sharded(dp=8, **kw):
+def build_sharded(dp=8, stage=1, gm=0, **kw):
     main, startup, loss = build_train(**kw)
-    plan = shard_optimizer_states(main, startup, dp_degree=dp)
+    plan = shard_optimizer_states(main, startup, dp_degree=dp, stage=stage)
+    if gm:
+        static.gradient_merge(main, gm, startup)
     return main, startup, loss, plan
 
 
@@ -525,6 +527,84 @@ class TestAppliedPassRegistry:
 
 
 # ---------------------------------------------------------------------------
+# ZeRO-2/3 stage-aware validation: clean programs stay clean, mutations
+# fire the right code (the ISSUE-11 "validate against the recorded
+# stage" contract)
+# ---------------------------------------------------------------------------
+class TestZeroStageMutations:
+    def test_zero2_gm_program_is_clean(self):
+        main, startup, loss, _ = build_sharded(stage=2, gm=2)
+        rep = check_program(main, startup=startup, fetch_list=[loss])
+        assert rep.ok, rep.render()
+
+    def test_zero3_program_is_clean(self):
+        main, startup, loss, _ = build_sharded(stage=3)
+        rep = check_program(main, startup=startup, fetch_list=[loss])
+        assert rep.ok, rep.render()
+
+    def test_zero3_gm_program_is_clean(self):
+        main, startup, loss, _ = build_sharded(stage=3, gm=2)
+        rep = check_program(main, startup=startup, fetch_list=[loss])
+        assert rep.ok, rep.render()
+
+    def test_zero3_rs_without_update_V201(self):
+        # mutate: drop the in-place bucket update — the stage-3 rs now
+        # reaches neither a sharded update nor a publish allgather
+        main, startup, loss, _ = build_sharded(stage=3)
+        blk = main.global_block()
+        blk.ops = [op for op in blk.ops
+                   if not op.attrs.get("zero_sharded")]
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V201")
+        assert any("deferred-publish" in h.message for h in hits)
+
+    def test_zero3_gather_of_replicated_var_V201(self):
+        # mutate: strip the dp_shard mark off the param bucket — the
+        # JIT gather would replicate an already-replicated buffer
+        main, startup, loss, plan = build_sharded(stage=3)
+        blk = main.global_block()
+        for name in plan.param_bucket_names():
+            blk.var(name).attrs.pop("dp_shard", None)
+        hits = assert_code(check_program(main, fetch_list=[loss]), "V201")
+        assert any("JIT param gather" in h.message for h in hits)
+
+    def test_zero3_stage_stamp_mismatch_V204(self):
+        # mutate: hand-edit one op's stage stamp — two different ZeRO
+        # rewrites on one program is unsound
+        main, startup, loss, _ = build_sharded(stage=3)
+        op = next(op for op in main.global_block().ops
+                  if op.attrs.get("zero_stage") == 3)
+        op.attrs["zero_stage"] = 1
+        assert_code(check_program(main, fetch_list=[loss]), "V204")
+
+    def test_zero3_plan_stage_downgrade_V204(self):
+        # mutate: rewrite the recorded plan's stage — a param bucket
+        # exists without the stage-3 contract on record
+        main, startup, loss, _ = build_sharded(stage=3)
+        main._zero_shard_plan.stage = 1
+        assert_code(check_program(main, fetch_list=[loss]), "V204")
+
+    def test_zero3_gather_output_numel_V203(self):
+        # mutate: shrink the declared gathered-output var — the gather
+        # of a dp_shard bucket must produce the declared global numel
+        main, startup, loss, _ = build_sharded(stage=3)
+        blk = main.global_block()
+        ag = next(op for op in blk.ops
+                  if op.attrs.get("zero_role") == "gather_fwd")
+        out_v = blk.var(ag.outputs["Out"][0])
+        out_v.shape = (int(out_v.shape[0]) // 2,)
+        assert_code(check_program(main, fetch_list=[loss]), "V203")
+
+    def test_zero2_orphan_rs_still_V201(self):
+        # the deferred-counterpart exemption is STAGE-3 ONLY: a stage-2
+        # program whose publish allgather is deleted is still a broken
+        # stale-params program
+        main, startup, loss, _ = build_sharded(stage=2, gm=2)
+        blk = main.global_block()
+        blk.ops = [op for op in blk.ops if op.type != "c_allgather"]
+        assert_code(check_program(main, fetch_list=[loss]), "V201")
+
+
+# ---------------------------------------------------------------------------
 # collective-sequence extraction (the planner substrate)
 # ---------------------------------------------------------------------------
 class TestCollectiveSequence:
@@ -539,16 +619,29 @@ class TestCollectiveSequence:
                 assert e["ring_id"] == 0
                 assert e["nbytes"] and e["nbytes"] > 0
 
-    def test_wire_bytes_matches_sharding_accounting(self):
-        # sharding.collective_bytes_per_step is now a deprecation shim
-        # delegating to THIS extractor's ring-0 slice, so the two must
-        # agree exactly (c_split prices 0 — it's a local slice)
-        from paddle_tpu.distributed.sharding import \
-            collective_bytes_per_step
+    def test_ring0_slice_prices_the_dist_pass_collectives(self):
+        # the retired sharding.collective_bytes_per_step shim's
+        # historical scope was exactly the ring-0 slice of THIS
+        # extractor — the slice must price the rs/ag pair and nothing
+        # else (c_split prices 0 — it's a local slice)
         main, startup, loss, _ = build_sharded()
         ours = collective_wire_bytes(main, 8, ring_id=0)
-        theirs = collective_bytes_per_step(main, 8)
-        assert ours == theirs > 0
+        by_hand = sum(entry_wire_bytes(e, 8)
+                      for e in collective_sequence(main)
+                      if e["ring_id"] == 0)
+        assert ours == int(by_hand) > 0
+
+    def test_zero3_gather_priced_at_local_shard(self):
+        # a ZeRO-3 JIT gather's operand is DECLARED at the global
+        # padded shape but each rank holds 1/N — the ring moves
+        # (N-1)/N × declared bytes, NOT (N-1) × declared
+        main, startup, loss, plan = build_sharded(stage=3)
+        gathers = [e for e in collective_sequence(main)
+                   if e["zero_role"] in ("gather_fwd", "gather_bwd")]
+        assert gathers
+        for e in gathers:
+            assert e["x_dp_shard"] == 8
+            assert entry_wire_bytes(e, 8) == (8 - 1) / 8 * e["nbytes"]
 
     def test_world_of_one_costs_zero(self):
         main, startup, loss, _ = build_sharded()

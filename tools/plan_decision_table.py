@@ -79,23 +79,32 @@ def _build_transformer(batch):
 # truth where measured) kept as the cross-check.
 ROWS = [
     ("lenet", "LeNet b256", _build_lenet, 256, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("resnet", "ResNet-50 b128", _build_resnet, 128, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("transformer", "Transformer-big s256 b16", _build_transformer, 16, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("bert32", "bert-base b32", _build_bert, 32, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("bert64", "bert-base b64", _build_bert, 64, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("bert96", "bert-base b96", _build_bert, 96, 1,
-     dict(remat=True, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=True, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("bert128", "bert-base b128 (N=8)", _build_bert, 128, 8,
-     dict(remat=True, dp_shard=8, grad_merge=1, ring=False), True),
+     dict(remat=True, dp_shard=8, zero_stage=1, grad_merge=1,
+          ring=False), True),
     ("ernie16", "ERNIE-large b16", _build_ernie_large, 16, 1,
-     dict(remat=False, dp_shard=0, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=0, zero_stage=0, grad_merge=1,
+          ring=False), True),
     ("ernie24", "ERNIE-large b24 (N=8)", _build_ernie_large, 24, 8,
-     dict(remat=False, dp_shard=8, grad_merge=1, ring=False), True),
+     dict(remat=False, dp_shard=8, zero_stage=1, grad_merge=1,
+          ring=False), True),
 ]
 
 # queue lines for the planner-chosen configs that actually exercise the
@@ -118,7 +127,7 @@ def _fmt_knobs(k):
     if k.get("remat"):
         parts.append("remat")
     if k.get("dp_shard"):
-        parts.append(f"zero1/{k['dp_shard']}")
+        parts.append(f"zero{k.get('zero_stage') or 1}/{k['dp_shard']}")
     if int(k.get("grad_merge") or 1) > 1:
         parts.append(f"gm{k['grad_merge']}")
     if k.get("ring"):
@@ -161,6 +170,8 @@ def main():
             (c for c in plan.trace
              if c["remat"] == hand["remat"]
              and c["dp_shard"] == hand["dp_shard"]
+             and c["zero_stage"] == hand.get("zero_stage",
+                                             1 if hand["dp_shard"] else 0)
              and c["grad_merge"] == hand["grad_merge"]
              and c["ring"] == hand["ring"]), None)
         beat = (plan.predicted_fits and hand_rec is not None and

@@ -1,5 +1,5 @@
-"""Fast CPU ZeRO-1 sharding gate: rewrite applies, shard shapes correct,
-zero post-warmup retraces, estimator shows the slot reduction.
+"""Fast CPU ZeRO sharding gate: rewrite applies, shard shapes correct,
+zero post-warmup retraces, estimator shows the slot/param reduction.
 
 The cheap canary for the sharded data-parallel tier
 (tests/test_shard_smoke.py runs it as a tier-1 test, mirroring
@@ -15,7 +15,11 @@ contracts the tier rests on:
   * the HBM estimator's world-size accounting reports the slot
     reduction (≤ plain/world + one bucket of padding);
   * the compile-once contract holds — a short mesh training run compiles
-    ONE executable and never re-traces after warmup.
+    ONE executable and never re-traces after warmup;
+  * the ZeRO-3 leg: full parameter sharding packs the params into
+    dp_shard buckets at ~1/world per chip, just-in-time allgathers are
+    present in forward (and the stage-1 publish is gone), a short mesh
+    run trains finite with zero post-warmup retraces.
 
 Prints one JSON line; correctness never depends on throughput.
 
@@ -142,6 +146,72 @@ def run_smoke(steps: int = 4, batch: int = 16):
         f"shard smoke FAILED: {new_compiles} recompile(s) after warmup "
         f"on the sharded program")
 
+    # -- ZeRO-3 leg: full parameter sharding --------------------------------
+    t3 = time.time()
+    _reset_unique_names()
+    main3, startup3 = static.Program(), static.Program()
+    with static.program_guard(main3, startup3):
+        x = layers.data("x", [-1, 16])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 32, act="relu")
+        pred = layers.fc(h, 1)
+        loss3 = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss3)
+    plain3 = static.analyze_program(main3, batch=batch)
+    plan3 = shard_optimizer_states(main3, startup3, dp_degree=WORLD,
+                                   stage=3)
+    sharded3 = static.analyze_program(main3, batch=batch)
+    assert plan3.stage == 3 and plan3.param_bucket_names(), plan3
+    blk3 = main3.global_block()
+    # per-chip param bytes ≈ total/8: every param is packed into a
+    # dp_shard bucket the walker charges 1/world (+ pow2 padding)
+    pbytes = sum(blk3.var(n).shape[0] * 4
+                 for n in plan3.param_bucket_names())
+    raw_pbytes = sum(b["raw_len"] * 4 for b in plan3.buckets
+                     if b.get("param_bucket"))
+    assert sharded3["parameter_bytes"] <= \
+        raw_pbytes // WORLD + len(plan3.buckets) * WORLD * 4, (
+        f"shard smoke FAILED: zero3 per-chip param bytes "
+        f"{sharded3['parameter_bytes']} not ~1/{WORLD} of {raw_pbytes}")
+    assert sharded3["persistable_bytes"] < plain3["persistable_bytes"] // 4
+    # JIT allgather present in FORWARD, no stage-1 publish
+    from paddle_tpu.core.program import OpRole as _OpRole
+    roles = [op.attrs.get("zero_role") for op in blk3.ops
+             if op.type == "c_allgather"]
+    assert roles.count("gather_fwd") == len(plan3.buckets) and \
+        "publish" not in roles, roles
+    first_mul = next(i for i, op in enumerate(blk3.ops)
+                     if op.type == "mul")
+    first_gather = next(i for i, op in enumerate(blk3.ops)
+                        if op.attrs.get("zero_role") == "gather_fwd")
+    assert first_gather < first_mul
+    rewrite3_wall = time.time() - t3
+    assert rewrite3_wall < 15.0, (
+        f"shard smoke FAILED: zero3 rewrite+estimate took "
+        f"{rewrite3_wall:.1f}s (>15s)")
+
+    compiled3 = CompiledProgram(main3).with_data_parallel(
+        loss_name=loss3.name)
+    exe3 = static.Executor()
+    scope3 = static.Scope()
+    with static.scope_guard(scope3):
+        exe3.run(startup3)
+        exe3.run(compiled3, feed=feed(), fetch_list=[loss3])
+        warm3 = len(compiled3._cache)
+        for _ in range(steps):
+            out3 = exe3.run(compiled3, feed=feed(), fetch_list=[loss3])
+        assert np.isfinite(np.asarray(out3[0])).all()
+        pb = scope3.get(plan3.param_bucket_names()[0])
+        shards3 = getattr(pb, "addressable_shards", None)
+        if shards3:
+            b0 = next(b for b in plan3.buckets if b.get("param_bucket"))
+            per_rank = {tuple(s.data.shape) for s in shards3}
+            assert per_rank == {(b0["shard_len"],)}, per_rank
+    new3 = len(compiled3._cache) - warm3
+    assert new3 == 0, (
+        f"shard smoke FAILED: {new3} recompile(s) after warmup on the "
+        f"zero3 program")
+
     return {
         "metric": "shard_smoke_slot_reduction_x",
         "value": round(plain["optimizer_slot_bytes"]
@@ -152,6 +222,12 @@ def run_smoke(steps: int = 4, batch: int = 16):
         "plain_slot_bytes": plain["optimizer_slot_bytes"],
         "sharded_slot_bytes": sharded["optimizer_slot_bytes"],
         "compiles_after_warmup": new_compiles,
+        "zero3_param_reduction_x": round(
+            plain3["parameter_bytes"]
+            / max(1, sharded3["parameter_bytes"]), 2),
+        "zero3_buckets": plan3.n_buckets,
+        "zero3_compiles_after_warmup": new3,
+        "zero3_rewrite_wall_s": round(rewrite3_wall, 2),
     }
 
 
