@@ -891,9 +891,14 @@ def main():
         # collectives) — reported alongside the ring-0 A/B numbers so
         # the full wire story stays visible
         wire_all = static.collective_wire_bytes(reduced, dp_shard)
+        # per-mesh-axis split: each ring priced at its OWN degree
+        # (tensor-ring collectives never pay the dp world) — the wire
+        # substrate the 2-D planner consumes
+        wire_axis = static.collective_wire_bytes_by_axis(reduced, dp_shard)
         _collective_bytes = {"allreduce": plain_bytes,
                              f"zero{zero_stage}": zero_bytes,
-                             f"zero{zero_stage}_all_rings": wire_all}
+                             f"zero{zero_stage}_all_rings": wire_all,
+                             "wire_bytes_per_axis": wire_axis}
     if grad_merge_k > 1:
         static.gradient_merge(main_p, grad_merge_k, startup_p)
     # compile-time HBM verdict rides every bench record: the number that

@@ -22,7 +22,8 @@ from .sharding import (  # noqa: F401
 )
 from .partition_spec import (  # noqa: F401
     match_partition_rules, zero_stage_rules, build_sharding_specs,
-    PartitionRule, REPLICATED, DP_SHARD,
+    tensor_parallel_rules, PartitionRule, REPLICATED, DP_SHARD,
+    MP_COL, MP_ROW,
 )
 from .elastic import (  # noqa: F401
     elasticize, rebucket_feeds, rederive_schedule, reanchor_topology,

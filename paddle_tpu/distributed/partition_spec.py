@@ -55,14 +55,24 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
-    "REPLICATED", "DP_SHARD", "PartitionRule", "PartitionAssignment",
-    "match_partition_rules", "zero_stage_rules", "build_sharding_specs",
+    "REPLICATED", "DP_SHARD", "MP_COL", "MP_ROW", "PartitionRule",
+    "PartitionAssignment", "match_partition_rules", "zero_stage_rules",
+    "tensor_parallel_rules", "build_sharding_specs",
     "state_partition_specs",
 ]
 
-# spec spelling: tuple of mesh-axis names per leading dim, () = replicated
+# spec spelling: tuple of mesh-axis names per dim (None = replicated dim,
+# trailing Nones may be omitted), () = fully replicated
 REPLICATED: Tuple = ()
 DP_SHARD: Tuple = ("dp",)
+# tensor-parallel (Megatron) weight splits over the model axis "mp":
+# column-parallel fc shards the OUT features (dim 1), row-parallel fc
+# shards the IN features (dim 0).  The layout analyzer
+# (static/layout_analysis.py) consumes these as seed specs; the runtime
+# "tp" mesh axis (distributed/tensor_parallel.py dist_attr) is the same
+# axis under its CompiledProgram name.
+MP_COL: Tuple = (None, "mp")
+MP_ROW: Tuple = ("mp", None)
 
 
 class PartitionRule:
@@ -184,6 +194,28 @@ def zero_stage_rules(stage: int) -> List[PartitionRule]:
         rules.append(PartitionRule(r"^slot:", DP_SHARD, strict=False))
     rules.append(PartitionRule(r".*", REPLICATED, strict=False))
     return rules
+
+
+def tensor_parallel_rules() -> List[PartitionRule]:
+    """The Megatron col/row split discipline as data: seed rules for the
+    layout analyzer (`static.propagate_shardings`) matching the default
+    parameter names `distributed/tensor_parallel.py`'s builders mint
+    (``col_parallel_fc_<n>.w_<k>`` etc.).  Parameters the builders
+    annotated with ``dist_attr`` don't need these — the rules exist for
+    programs rebuilt from serialized IR that predates the annotation,
+    and as the vocabulary user rule lists extend (prepend a rule to
+    shard a custom projection).  Non-strict: a name that matches but
+    cannot shard degrades to replicated."""
+    return [
+        PartitionRule(r"^param:col_parallel_fc.*\.w_", MP_COL,
+                      strict=False),
+        PartitionRule(r"^param:col_parallel_fc.*\.b_", ("mp",),
+                      strict=False),
+        PartitionRule(r"^param:row_parallel_fc.*\.w_", MP_ROW,
+                      strict=False),
+        PartitionRule(r"^param:row_parallel_fc.*\.b_", REPLICATED,
+                      strict=False),
+    ]
 
 
 def build_sharding_specs(program, stage: int,

@@ -74,8 +74,9 @@ def build_transformer_lm(vocab_size, hidden, num_layers, num_heads, seq_len,
             m_in = layers.layer_norm(h, begin_norm_axis=2)
             if tp > 1:
                 m = col_parallel_fc(m_in, hidden * 4, num_flatten_dims=2,
-                                    act="gelu")
-                m = row_parallel_fc(m, hidden, num_flatten_dims=2)
+                                    act="gelu", tp_degree=tp)
+                m = row_parallel_fc(m, hidden, num_flatten_dims=2,
+                                    tp_degree=tp)
             else:
                 m = layers.fc(m_in, hidden * 4, num_flatten_dims=2,
                               act="gelu")

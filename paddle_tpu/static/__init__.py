@@ -27,9 +27,14 @@ from .flops_analysis import (  # noqa: F401
 from . import flops_analysis  # noqa: F401
 from .verifier import (  # noqa: F401
     check_program, collective_sequence, collective_wire_bytes,
+    collective_wire_bytes_by_axis, program_ring_degrees,
     VerifyReport, Diagnostic, ProgramVerificationError,
 )
 from . import verifier  # noqa: F401
+from .layout_analysis import (  # noqa: F401
+    propagate_shardings, ShardingLayout, LayoutSpec,
+)
+from . import layout_analysis  # noqa: F401
 from .planner import (  # noqa: F401
     plan_program, apply_plan, Plan, ici_bytes_per_chip,
 )

@@ -133,6 +133,8 @@ class Plan:
         self.predicted_peak_bytes = int(chosen["peak_bytes"])
         self.predicted_fits = bool(chosen["fits"])
         self.predicted_wire_bytes = int(chosen["wire_bytes"])
+        self.predicted_wire_bytes_per_axis = dict(
+            chosen.get("wire_bytes_per_axis") or {})
         self.predicted_compute_ms = float(chosen["compute_ms"])
         self.predicted_wire_ms = float(chosen["wire_overlap_ms"] +
                                        chosen["wire_serial_ms"])
@@ -153,6 +155,8 @@ class Plan:
             "predicted_peak_bytes": self.predicted_peak_bytes,
             "predicted_fits": self.predicted_fits,
             "predicted_wire_bytes": self.predicted_wire_bytes,
+            "predicted_wire_bytes_per_axis":
+                dict(self.predicted_wire_bytes_per_axis),
             "predicted_compute_ms": round(self.predicted_compute_ms, 4),
             "predicted_wire_ms": round(self.predicted_wire_ms, 4),
             "n_candidates": len(self.trace),
@@ -292,13 +296,15 @@ class _RewritePoint:
     bucket count for byte-identical IR."""
 
     __slots__ = ("main", "startup", "reduced", "wire_overlap",
-                 "wire_serial", "error", "verify_verdict")
+                 "wire_serial", "wire_by_axis", "error", "verify_verdict")
 
     def __init__(self, base_main, base_startup, cand, world):
-        from .verifier import collective_sequence, entry_wire_bytes
+        from .verifier import (collective_sequence, entry_wire_bytes,
+                               _ring_degrees_from_seq, ring_axis)
         self.error = None
         self.verify_verdict = None  # lazily computed, cached
         self.wire_overlap = self.wire_serial = 0.0
+        self.wire_by_axis: Dict[str, float] = {}
         try:
             self.main, self.startup = _apply_knobs(base_main, base_startup,
                                                    cand)
@@ -310,12 +316,21 @@ class _RewritePoint:
         if world > 1:
             from ..distributed.compiled_program import insert_grad_allreduce
             self.reduced = insert_grad_allreduce(self.main)
-            for e in collective_sequence(self.reduced):
-                nbytes = entry_wire_bytes(e, world)
+            # each ring priced at its OWN degree (a tensor-parallel
+            # collective on a dp×tp candidate moves mp-ring bytes, not
+            # dp-world bytes) — the stamps are the authority; one
+            # sequence extraction serves both the degrees and the walk
+            seq = collective_sequence(self.reduced)
+            ring_degrees = _ring_degrees_from_seq(seq)
+            for e in seq:
+                nbytes = entry_wire_bytes(e, world, ring_degrees)
                 if e["type"] in _OVERLAPPABLE:
                     self.wire_overlap += nbytes
                 else:
                     self.wire_serial += nbytes
+                axis = ring_axis(e["ring_id"], e.get("mp_axis"))
+                self.wire_by_axis[axis] = \
+                    self.wire_by_axis.get(axis, 0.0) + nbytes
 
     def verify(self) -> str:
         """check_program(level="collective") on the reduced program —
@@ -351,6 +366,9 @@ def _price(point: _RewritePoint, cand: Dict, hbm_budget: Optional[int],
         "fits": bool(mem["fits"]),
         "flops": int(flops),
         "wire_bytes": int(point.wire_overlap + point.wire_serial),
+        "wire_bytes_per_axis": {a: int(b)
+                                for a, b in sorted(
+                                    point.wire_by_axis.items())},
         "compute_ms": compute_s * 1e3,
         "wire_overlap_ms": wo_s * 1e3,
         "wire_serial_ms": ws_s * 1e3,
@@ -492,7 +510,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
             if point.error is not None:
                 rec = dict(cand)
                 rec.update({"peak_bytes": 0, "fits": False, "flops": 0,
-                            "wire_bytes": 0, "compute_ms": 0.0,
+                            "wire_bytes": 0, "wire_bytes_per_axis": {},
+                            "compute_ms": 0.0,
                             "wire_overlap_ms": 0.0, "wire_serial_ms": 0.0,
                             "step_ms": float("inf"), "samples_per_sec": 0.0,
                             "verdict": f"rewrite refused: {point.error!r}"})

@@ -12,7 +12,7 @@ time to compile time, the same way `static/memory_analysis.py` moved
 OOMs to estimator time.
 
 `check_program(program, level=...)` walks the op IR and reports
-structured `Diagnostic`s (never raises on a defect unless asked) at four
+structured `Diagnostic`s (never raises on a defect unless asked) at five
 cumulative levels:
 
   1. ``graph``       — def-before-use, dangling vars, dtype/shape
@@ -42,6 +42,12 @@ cumulative levels:
                        bucketing policy and Python-captured array
                        constants baked into op attrs (each build
                        fingerprints differently → retrace every step).
+  5. ``layout``      — the sharding-propagation analyzer
+                       (static/layout_analysis.py): whole-graph SPMD
+                       layout inference over the dp × mp mesh, V601-V605
+                       (layout conflicts, missing reductions, redundant
+                       reshards, mesh-axis disagreements, indivisible
+                       shards) plus the priced reshard table.
 
 Diagnostic codes are STABLE (docs/static_analysis.md): tests and
 allowlists key on them.  Every diagnostic carries provenance (block/op
@@ -71,15 +77,17 @@ from ..core.program import Block, OpDesc, OpRole, Program
 __all__ = [
     "Diagnostic", "VerifyReport", "ProgramVerificationError",
     "check_program", "collective_sequence", "collective_wire_bytes",
-    "entry_wire_bytes",
+    "entry_wire_bytes", "collective_wire_bytes_by_axis", "ring_axis",
+    "program_ring_degrees",
     "verify_mode", "self_check", "verify_first_compile", "VERIFY_ENV",
 ]
 
 VERIFY_ENV = "PADDLE_TPU_VERIFY"
 
-# level name -> highest suite number it runs (levels are cumulative)
+# level name -> highest suite number it runs (levels are cumulative);
+# 5 = the sharding-propagation layout analyzer (layout_analysis.py V6xx)
 _LEVELS = {"graph": 1, "collective": 2, "donation": 3, "retrace": 4,
-           "all": 4, "strict": 4}
+           "layout": 5, "all": 5, "strict": 5}
 
 ERROR = "error"
 WARNING = "warning"
@@ -334,24 +342,70 @@ def collective_sequence(program: Program) -> List[dict]:
                 # 1/degree of the declared bytes (ZeRO-3 param gathers)
                 "x_dp_shard": (int(v.attrs.get("dp_shard") or 0)
                                if v is not None else 0),
+                # tensor-parallel builder stamps (distributed/
+                # tensor_parallel.py): the model axis the op rides and
+                # the tp degree declared at build time — the per-ring
+                # wire pricer uses the degree, the layout analyzer the
+                # axis
+                "mp_axis": op.attrs.get("mp_axis"),
+                "tp_degree": (int(op.attrs["tp_degree"])
+                              if op.attrs.get("tp_degree") else None),
             })
     return seq
 
 
-def entry_wire_bytes(entry: dict, world: int) -> float:
+# default ring → mesh-axis binding (compiled_program._compile dist_info:
+# ring 0 = the dp world, 101 = the sequence ring, 102 = the tensor ring)
+_RING_AXIS = {0: "dp", 101: "sp", 102: "mp"}
+
+
+def ring_axis(ring_id: int, mp_axis: Optional[str] = None) -> str:
+    """The mesh-axis name a ring id binds to (``mp_axis`` stamp wins;
+    unknown rings render as ``ring<N>``)."""
+    if mp_axis:
+        return str(mp_axis)
+    return _RING_AXIS.get(int(ring_id), f"ring{int(ring_id)}")
+
+
+def _ring_degrees_from_seq(seq: List[dict]) -> Dict[int, int]:
+    degrees: Dict[int, int] = {}
+    for e in seq:
+        d = e["tp_degree"] or e["dp_degree"]
+        if d:
+            degrees[e["ring_id"]] = max(degrees.get(e["ring_id"], 0),
+                                        int(d))
+    return degrees
+
+
+def program_ring_degrees(program: Program) -> Dict[int, int]:
+    """Per-ring group sizes the program's op stamps declare: the
+    builders' ``tp_degree`` on the tensor ring, the sharding pass's
+    ``dp_degree`` on ring 0.  The wire pricer's `ring_degrees` input —
+    a non-dp ring must be priced at ITS degree, not the dp world.
+    (Callers already holding a `collective_sequence` should derive the
+    degrees from it instead of re-walking the program.)"""
+    return _ring_degrees_from_seq(collective_sequence(program))
+
+
+def entry_wire_bytes(entry: dict, world: int,
+                     ring_degrees: Optional[Dict[int, int]] = None) -> float:
     """Ring-algorithm ICI bytes ONE rank moves for a single
     `collective_sequence` entry: allreduce 2(N-1)/N of the buffer,
     reduce-scatter (N-1)/N, allgather and the elastic all-gather fold
     (N-1)× the local shard, broadcast/scatter (N-1)/N, alltoall
-    (N-1)/N.  An entry stamped with its own ``dp_degree`` (the sharding
-    pass records the group size it padded for) is priced at THAT group
-    size; `world` covers the rest.  Unknown sizes price 0.  Shared by
-    `collective_wire_bytes` and the auto-parallel planner's
+    (N-1)/N.  Group-size resolution, most specific first: the entry's
+    own ``dp_degree``/``tp_degree`` stamp (the pass that emitted the op
+    recorded the group it rewrote for), then ``ring_degrees`` (ring id →
+    size, e.g. `program_ring_degrees` or a planner's candidate mesh),
+    then `world` — so a tensor-ring collective on a 4×2 mesh prices at
+    its mp degree 2, never the dp world.  Unknown sizes price 0.
+    Shared by `collective_wire_bytes` and the auto-parallel planner's
     overlap-aware roofline (static/planner.py)."""
     n = entry["nbytes"]
     if not n:
         return 0.0
-    g = entry["dp_degree"] or world  # per-entry group size wins
+    g = (entry["dp_degree"] or entry.get("tp_degree") or
+         (ring_degrees or {}).get(entry["ring_id"]) or world)
     if g <= 1:
         return 0.0
     t = entry["type"]
@@ -366,10 +420,12 @@ def entry_wire_bytes(entry: dict, world: int) -> float:
              "partial_allgather"):
         # input is the local shard; the ring moves (g-1) remote shards
         # (c_concat's kernel IS a tiled all_gather, ops/kernels/
-        # collective.py).  When the operand is a dp_shard persistable
-        # declared at the GLOBAL padded shape (a ZeRO-3 param-bucket
-        # gather), the local shard is 1/g of the declared bytes.
-        if entry.get("x_dp_shard"):
+        # collective.py).  When the operand is DECLARED at the GLOBAL
+        # gathered shape — a ZeRO-3 dp_shard param bucket, or a
+        # tensor-ring gather whose builder keeps build-time shapes
+        # global (``mp_axis`` stamp) — the local shard is 1/g of the
+        # declared bytes.
+        if entry.get("x_dp_shard") or entry.get("mp_axis"):
             return (g - 1) / g * n
         return float((g - 1) * n)
     if t in ("p_send", "p_recv"):
@@ -382,19 +438,50 @@ def entry_wire_bytes(entry: dict, world: int) -> float:
 
 
 def collective_wire_bytes(program: Program, world: int,
-                          ring_id: Optional[int] = None) -> int:
+                          ring_id: Optional[int] = None,
+                          ring_degrees: Optional[Dict[int, int]] = None
+                          ) -> int:
     """ICI bytes ONE rank moves per step under ring-algorithm accounting
     (per-entry formulas: `entry_wire_bytes`).  Entries with unknown
     sizes contribute 0 (count them via `collective_sequence` if that
-    matters).  `ring_id=None` sums every ring."""
+    matters).  `ring_id=None` sums every ring; `ring_degrees` maps ring
+    id → that ring's OWN group size (default: the program's stamps via
+    `program_ring_degrees`) so non-dp rings never price at the dp
+    world."""
     if world <= 1:
         return 0
+    seq = collective_sequence(program)
+    if ring_degrees is None:
+        ring_degrees = _ring_degrees_from_seq(seq)
     total = 0.0
-    for e in collective_sequence(program):
+    for e in seq:
         if ring_id is not None and e["ring_id"] != ring_id:
             continue
-        total += entry_wire_bytes(e, world)
+        total += entry_wire_bytes(e, world, ring_degrees)
     return int(total)
+
+
+def collective_wire_bytes_by_axis(program: Program, world: int,
+                                  ring_degrees: Optional[Dict[int, int]]
+                                  = None) -> Dict[str, int]:
+    """Per-mesh-axis split of `collective_wire_bytes`: ring-accounted
+    ICI bytes one rank moves per step, keyed by the axis each ring binds
+    to (`ring_axis`: ring 0 → "dp", the tensor ring → "mp", the
+    sequence ring → "sp").  The 2-D planner's wire substrate — an
+    mp-ring byte overlaps different hardware links than a dp-ring byte,
+    so the roofline must see them separately; also surfaced in the
+    ``bench.py --dp-shard`` JSON."""
+    seq = collective_sequence(program)
+    if ring_degrees is None:
+        ring_degrees = _ring_degrees_from_seq(seq)
+    totals: Dict[str, float] = {}
+    if world <= 1 and not ring_degrees:
+        return {}
+    for e in seq:
+        axis = ring_axis(e["ring_id"], e.get("mp_axis"))
+        totals[axis] = totals.get(axis, 0.0) + \
+            entry_wire_bytes(e, world, ring_degrees)
+    return {a: int(b) for a, b in sorted(totals.items())}
 
 
 # ---------------------------------------------------------------------------
@@ -1232,6 +1319,20 @@ def _check_retrace(program: Program, out: List[Diagnostic]):
 
 
 # ---------------------------------------------------------------------------
+# suite 5: sharding-propagation layout analyzer
+# ---------------------------------------------------------------------------
+def _check_layout(program: Program, out: List[Diagnostic]):
+    """V601-V605 via the sharding-propagation analyzer
+    (static/layout_analysis.py): infer every var's layout over the
+    dp × mp mesh from the builders' annotations and flag kernel-contract
+    conflicts, missing reductions, redundant reshards, mesh-axis
+    disagreements and indivisible shards.  Model-axis findings only — a
+    program with no tensor-parallel structure can't produce any."""
+    from .layout_analysis import propagate_shardings
+    out.extend(propagate_shardings(program).diagnostics)
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 def check_program(program: Program, level: str = "all",
@@ -1241,28 +1342,28 @@ def check_program(program: Program, level: str = "all",
                   raise_on_error: bool = False) -> VerifyReport:
     """Statically verify `program`'s op IR; returns a `VerifyReport`.
 
-    ``level``: "graph" | "collective" | "donation" | "retrace" | "all"
-    (cumulative: "donation" runs graph+collective+donation), or an int
-    1-4.  ``startup`` additionally checks init-time alias hazards
-    (V301).  ``fetch_list`` (vars or names) sharpens the dangling-var
-    and shard-fetch checks.  ``suppress`` drops diagnostic codes an
-    allowlist has accepted.  ``raise_on_error=True`` raises
-    `ProgramVerificationError` when any error-severity diagnostic
-    remains.
+    ``level``: "graph" | "collective" | "donation" | "retrace" |
+    "layout" | "all" (cumulative: "donation" runs
+    graph+collective+donation), or an int 1-5.  ``startup``
+    additionally checks init-time alias hazards (V301).  ``fetch_list``
+    (vars or names) sharpens the dangling-var and shard-fetch checks.
+    ``suppress`` drops diagnostic codes an allowlist has accepted.
+    ``raise_on_error=True`` raises `ProgramVerificationError` when any
+    error-severity diagnostic remains.
 
     Wired as ``paddle.static.check_program``; the same walk is run
     automatically at first compile and after every rewrite pass when
     ``PADDLE_TPU_VERIFY`` is set (docs/static_analysis.md).
     """
     if isinstance(level, int):
-        depth = max(1, min(4, level))
+        depth = max(1, min(5, level))
     else:
         try:
             depth = _LEVELS[str(level)]
         except KeyError:
             raise ValueError(
                 f"unknown verify level {level!r}: expected one of "
-                f"{sorted(_LEVELS)} or an int 1-4")
+                f"{sorted(_LEVELS)} or an int 1-5")
     fetch_roots: Set[str] = set()
     for f in (fetch_list or []):
         fetch_roots.add(f.name if hasattr(f, "name") else str(f))
@@ -1276,6 +1377,8 @@ def check_program(program: Program, level: str = "all",
         _check_donation(program, startup, fetch_roots, diags)
     if depth >= 4:
         _check_retrace(program, diags)
+    if depth >= 5:
+        _check_layout(program, diags)
 
     suppress = set(suppress)
     if suppress:

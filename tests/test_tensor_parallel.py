@@ -1,7 +1,12 @@
 """Tensor parallelism (distributed/tensor_parallel.py): Megatron col/row
 parallel fc over a dp×tp mesh must train EXACTLY like the equivalent plain
 fc network on one device — weights shard over tp, activations re-replicate
-at block boundaries, grads of replicated params stay in sync."""
+at block boundaries, grads of replicated params stay in sync.
+
+Also the home of the V6xx layout mutation matrix (ISSUE 12): every
+seeded defect class against the sharding-propagation analyzer
+(static/layout_analysis.py) must fire its stable code with op
+provenance."""
 import numpy as np
 import pytest
 
@@ -90,6 +95,148 @@ def test_tp_matches_single_device(tp):
     for v in main.all_parameters():
         arr = np.asarray(scope.get(v.name))
         assert arr.shape == tuple(v.shape), (v.name, arr.shape, v.shape)
+
+
+def test_tp_4x2_mesh_matches_serial_1e6():
+    """The acceptance run: an 8-device 4×2 dp × tp mesh training the
+    col→row fc pair must match the serial fc network allclose 1e-6 —
+    the layout the analyzer certifies is the layout the mesh executes."""
+    _need_devices(8)
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy)
+    single, _ = _train(*_build_plain())
+
+    main, startup, loss = _build_tp()
+    bs = BuildStrategy()
+    bs.tensor_parallel_degree = 2
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name,
+                                                 build_strategy=bs)
+    assert dict(cp._get_mesh().shape) == {"dp": 4, "tp": 2}
+    par, scope = _train(main, startup, loss, compiled=cp)
+    np.testing.assert_allclose(single, par, rtol=1e-6, atol=1e-6)
+
+    # and the analyzer agrees this program is layout-clean on that mesh
+    layout = static.propagate_shardings(main,
+                                        mesh_shape={"dp": 4, "mp": 2})
+    assert not layout.diagnostics, layout.codes()
+
+
+# ---------------------------------------------------------------------------
+# V6xx mutation matrix: every seeded defect class fires its stable code
+# with op provenance (static/layout_analysis.py)
+# ---------------------------------------------------------------------------
+MESH_4x2 = {"dp": 4, "mp": 2}
+
+
+def _codes(layout):
+    return {d.code for d in layout.diagnostics}
+
+
+def _assert_provenance(diag):
+    assert diag.op_type is not None, diag
+    assert diag.op_uid is not None, diag
+    assert diag.var is not None, diag
+
+
+def test_layout_mutation_dropped_allreduce_V602():
+    """Drop the row-parallel mp_allreduce_sum: the partial products are
+    read as if complete — the classic silent-garbage tp bug."""
+    main, _, _ = _build_tp()
+    for op in main.global_block().ops:
+        if op.type == "mp_allreduce_sum":
+            op.type = "assign"
+            op.attrs.pop("ring_id", None)
+    layout = static.propagate_shardings(main, mesh_shape=MESH_4x2)
+    hits = [d for d in layout.diagnostics if d.code == "V602"]
+    assert hits, layout.codes()
+    _assert_provenance(hits[0])
+    assert hits[0].var.startswith("row_parallel_fc"), hits[0]
+
+
+def test_layout_mutation_swapped_col_row_V601():
+    """Row-parallel fc first (fed the replicated feed): each rank would
+    contract the FULL input against its weight shard and the reduction
+    double-counts."""
+    from paddle_tpu.distributed.tensor_parallel import (col_parallel_fc,
+                                                        row_parallel_fc)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = row_parallel_fc(x, 16, act="relu", tp_degree=2)
+        pred = col_parallel_fc(h, 2, tp_degree=2)
+        loss = layers.mean(layers.square(layers.elementwise_sub(
+            layers.reduce_sum(pred, dim=[1], keep_dim=True), y)))
+        static.SGD(learning_rate=0.05).minimize(loss)
+    layout = static.propagate_shardings(main, mesh_shape=MESH_4x2)
+    hits = [d for d in layout.diagnostics if d.code == "V601"]
+    assert hits, layout.codes()
+    _assert_provenance(hits[0])
+    assert hits[0].op_type == "mul", hits[0]
+
+
+def test_layout_mutation_misrung_collective_V604():
+    """Re-ring the Megatron g onto ring 0 (the dp world): the reduction
+    completes over the wrong device group while the mp partial sum is
+    never finished."""
+    main, _, _ = _build_tp()
+    for op in main.global_block().ops:
+        if op.type == "mp_allreduce_sum":
+            op.attrs["ring_id"] = 0
+    layout = static.propagate_shardings(main, mesh_shape=MESH_4x2)
+    hits = [d for d in layout.diagnostics if d.code == "V604"]
+    assert hits, layout.codes()
+    _assert_provenance(hits[0])
+    assert hits[0].op_type == "mp_allreduce_sum", hits[0]
+
+
+def test_layout_mutation_indivisible_degree_V605():
+    """tp degree ∤ feature dim: the 16-wide column split cannot divide
+    a degree-3 mesh."""
+    main, _, _ = _build_tp()
+    layout = static.propagate_shardings(main, mesh_shape={"dp": 2,
+                                                          "mp": 3})
+    hits = [d for d in layout.diagnostics if d.code == "V605"]
+    assert hits, layout.codes()
+    assert any(d.var == "col_parallel_fc_0.w_0" or
+               d.var.startswith("col_parallel_fc") for d in hits), hits
+    assert all(d.var is not None for d in hits)
+
+
+def test_layout_mutation_redundant_gather_V603():
+    """A c_concat gather of a propagation-proven-replicated var: the
+    program pays (g-1)× wire for a reshard it does not need."""
+    from paddle_tpu.core.program import OpDesc
+    from paddle_tpu.distributed.tensor_parallel import TP_RING_ID
+    main, _, _ = _build_tp()
+    blk = main.global_block()
+    blk.create_var(name="useless_gather", dtype="float32")
+    blk.ops.append(OpDesc("c_concat", {"X": ["x"]},
+                          {"Out": ["useless_gather"]},
+                          {"ring_id": TP_RING_ID,
+                           "op_uid": main._next_uid()}))
+    layout = static.propagate_shardings(main, mesh_shape=MESH_4x2)
+    hits = [d for d in layout.diagnostics if d.code == "V603"]
+    assert hits, layout.codes()
+    _assert_provenance(hits[0])
+    assert hits[0].op_type == "c_concat", hits[0]
+
+
+def test_tp_builders_recorded_in_registry():
+    """The builders register themselves in the applied-passes registry
+    (pass 'tensor_parallel') and stamp their ops with mp_axis/tp_degree
+    so the analyzers see tp structure instead of anonymous ops."""
+    from paddle_tpu.core.pass_framework import applied_passes
+    main, _, _ = _build_tp()
+    entries = [e for e in applied_passes(main)
+               if e["pass"] == "tensor_parallel"]
+    builders = {e["builder"] for e in entries}
+    assert builders == {"col_parallel_fc", "row_parallel_fc"}, entries
+    stamped = [op for op in main.global_block().ops
+               if op.attrs.get("mp_axis") == "mp"]
+    types = {op.type for op in stamped}
+    assert "mp_allreduce_sum" in types and "c_identity" in types and \
+        "mul" in types, types
 
 
 def test_tp_annotations_and_ops():
