@@ -386,8 +386,137 @@ def serving_main():
         "serial_p99_ms": serial["p99_ms"],
         "speedup_vs_serial": round(batched["qps"] /
                                    max(serial["qps"], 1e-9), 3),
+        "paged_kv": _serving_paged_ab(),
     }
     print(json.dumps(result))
+
+
+def _serving_paged_ab():
+    """Paged-vs-fixed-slot generation A/B at EQUAL KV HBM: the planner
+    (`static.page_budget`, the HBM-walker sizing path) chooses the page
+    budget; the fixed-slot baseline gets the SAME kv byte budget spent
+    as dense worst-case max-context slots (generously uncharged for
+    workspace, biasing the comparison AGAINST paging).  Both engines
+    drain an identical shared-system-prompt workload; reported are peak
+    concurrent sequences (the capacity claim), QPS/chip, p50/p95/p99,
+    page-occupancy/sharing stats, and token-equality vs per-sequence
+    generate()."""
+    import threading
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedKVPool
+    from paddle_tpu.serving.metrics import (percentiles,
+                                            reset_serving_stats)
+    from paddle_tpu.static import page_budget
+    import jax
+
+    n_req = int(os.environ.get("BENCH_SERVING_GEN_REQUESTS", 24))
+    kv_hbm = int(os.environ.get("BENCH_SERVING_GEN_HBM", 1 << 20))
+    max_new = 8
+    rng = np.random.RandomState(7)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position=128, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.gpt.parameters()))
+        # planner-chosen budget: weights + the KV grant, never hand-set
+        plan = page_budget(m, page_tokens=16, max_context=128,
+                           hbm_bytes=weight_bytes + kv_hbm)
+        token_bytes = plan["page_bytes"] // plan["page_tokens"]
+        # fixed-slot capacity at the same kv budget: every slot commits
+        # a dense max-context buffer up front
+        fixed_slots = max(1, plan["kv_bytes"] //
+                          (token_bytes * plan["max_context"]))
+        # shared 16-token system prompt + unique 8-token user tail
+        head = rng.randint(2, 64, (16,)).astype(np.int64)
+        prompts = [np.concatenate([head,
+                                   rng.randint(2, 64, (8,))
+                                   .astype(np.int64)])
+                   for _ in range(n_req)]
+        refs = [np.asarray(m.generate(p[None], max_length=max_new,
+                                      decode_strategy="greedy_search")[0])
+                for p in prompts[:3]]
+
+        def drain(eng, pool=None):
+            reset_serving_stats()
+            peak = {"slots": 0, "pages": 0}
+            done = threading.Event()
+
+            def poll():
+                while not done.is_set():
+                    peak["slots"] = max(peak["slots"], eng.active_slots)
+                    if pool is not None:
+                        peak["pages"] = max(
+                            peak["pages"],
+                            pool.num_pages - pool.pages_free)
+                    time.sleep(0.001)
+
+            eng.start()
+            t = threading.Thread(target=poll, daemon=True)
+            t.start()
+            t0 = time.time()
+            try:
+                futs = [eng.submit(p, max_length=max_new)
+                        for p in prompts]
+                outs = [np.asarray(f.result(timeout=300)) for f in futs]
+            finally:
+                done.set()
+                eng.stop()
+            dt = time.time() - t0
+            t.join(timeout=1.0)
+            lat = percentiles()
+            return outs, dt, peak, lat
+
+        pool = PagedKVPool.from_plan(plan)
+        paged_eng = ContinuousBatchingEngine(m, max_slots=n_req,
+                                             kv_pool=pool)
+        p_outs, p_dt, p_peak, p_lat = drain(paged_eng, pool)
+        pool_stats = pool.stats()
+        pool.assert_drained()
+        fixed_eng = ContinuousBatchingEngine(m, max_slots=fixed_slots)
+        f_outs, f_dt, f_peak, f_lat = drain(fixed_eng)
+
+    token_equal = all(
+        np.array_equal(p_outs[i], refs[i]) for i in range(len(refs))
+    ) and all(np.array_equal(f_outs[i], p_outs[i])
+              for i in range(len(p_outs)))
+    chips = max(1, jax.device_count())
+
+    def _side(outs, dt, peak, lat):
+        return {
+            "qps": round(len(outs) / dt, 2),
+            "qps_per_chip": round(len(outs) / dt / chips, 2),
+            "tokens_per_s": round(len(outs) * max_new / dt, 1),
+            "wall_s": round(dt, 2),
+            "peak_concurrent_seqs": peak["slots"],
+            "p50_ms": round(lat.get("p50", 0.0), 3),
+            "p95_ms": round(lat.get("p95", 0.0), 3),
+            "p99_ms": round(lat.get("p99", 0.0), 3),
+        }
+
+    paged_side = _side(p_outs, p_dt, p_peak, p_lat)
+    paged_side["peak_pages_used"] = p_peak["pages"]
+    paged_side["page_occupancy_peak"] = round(
+        p_peak["pages"] / max(1, plan["pages"]), 4)
+    fixed_side = _side(f_outs, f_dt, f_peak, f_lat)
+    return {
+        "requests": n_req,
+        "max_new_tokens": max_new,
+        "kv_budget_bytes": plan["kv_bytes"],
+        "plan": {k: plan[k] for k in
+                 ("pages", "page_tokens", "max_slots", "max_context",
+                  "kv_bytes", "workspace_bytes", "source")},
+        "fixed_slots_at_equal_hbm": fixed_slots,
+        "paged": paged_side,
+        "fixed": fixed_side,
+        "pool": pool_stats,
+        "capacity_ratio": round(
+            paged_side["peak_concurrent_seqs"] /
+            max(1, fixed_side["peak_concurrent_seqs"]), 2),
+        "token_equal_vs_generate": bool(token_equal),
+    }
 
 
 def _argv_value(flag):

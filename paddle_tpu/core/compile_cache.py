@@ -34,7 +34,20 @@ from .monitor import stat_add, stat_reset, stats_with_prefix
 
 __all__ = ["initialize", "is_enabled", "cache_dir", "record_trace",
            "record_hit", "record_miss", "cache_stats", "reset_stats",
-           "persistent_entries", "DEFAULT_CACHE_DIR", "ENV_CACHE_DIR"]
+           "persistent_entries", "next_pow2", "DEFAULT_CACHE_DIR",
+           "ENV_CACHE_DIR"]
+
+
+def next_pow2(n: int, floor: int = 16) -> int:
+    """Smallest power-of-two bucket >= ``n`` (>= ``floor``) — the shape
+    policy that keeps compiled-executable counts logarithmic; shared by
+    the serving engine's KV padding and the planner's workspace
+    sizing so the two can never disagree about bucket geometry."""
+    b = int(floor)
+    n = int(n)
+    while b < n:
+        b <<= 1
+    return b
 
 ENV_CACHE_DIR = "PADDLE_TPU_CACHE_DIR"
 DEFAULT_CACHE_DIR = os.path.join("~", ".cache", "paddle_tpu", "xla")

@@ -49,6 +49,7 @@ import threading
 import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
@@ -240,7 +241,7 @@ class InferenceServer:
                  port: int = 0, batching: bool = True, max_batch: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 64,
                  request_timeout_s: float = 30.0, generator=None,
-                 gen_slots: int = 4):
+                 gen_slots: Optional[int] = None, gen_kv_pool=None):
         from . import Config, create_predictor
         from ..serving import DynamicBatcher
         self._status = "loading"
@@ -252,7 +253,8 @@ class InferenceServer:
             if batching else None
         self._engine = None
         if generator is not None:
-            self.attach_generator(generator, max_slots=gen_slots)
+            self.attach_generator(generator, max_slots=gen_slots,
+                                  kv_pool=gen_kv_pool)
         self._inflight = 0
         self._inflight_mu = threading.Lock()
         self._inflight_zero = threading.Condition(self._inflight_mu)
@@ -263,14 +265,18 @@ class InferenceServer:
         self.host, self.port = self._httpd.server_address[:2]
 
     # -- wiring -------------------------------------------------------------
-    def attach_generator(self, model, max_slots: int = 4,
-                         max_queue: int = 64, timeout_s: float = 120.0):
+    def attach_generator(self, model, max_slots: Optional[int] = None,
+                         max_queue: int = 64, timeout_s: float = 120.0,
+                         kv_pool=None):
         """Enable /generate: wrap ``model`` in a ContinuousBatchingEngine
-        (started with the server)."""
+        (started with the server).  ``kv_pool="auto"`` serves decode
+        through the block-paged KV pool sized by ``static.page_budget``
+        (admission by free-page count, COW prefix sharing); the plan's
+        batch ceiling applies unless ``max_slots`` is given."""
         from ..serving import ContinuousBatchingEngine
         self._engine = ContinuousBatchingEngine(
             model, max_slots=max_slots, max_queue=max_queue,
-            default_timeout_s=timeout_s)
+            default_timeout_s=timeout_s, kv_pool=kv_pool)
         if self._status == "ok":
             self._engine.start()
         return self._engine
@@ -299,6 +305,12 @@ class InferenceServer:
         if self._engine is not None:
             out["gen_queue_depth"] = self._engine.queue_depth
             out["gen_active_slots"] = self._engine.active_slots
+            out["gen_kv_buckets"] = self._engine.kv_buckets
+            if self._engine.kv_pool is not None:
+                # the autoscaler's admission-pressure signals: page
+                # occupancy + sharing, same numbers /metrics exports as
+                # serving_kv_* gauges
+                out["kv_pool"] = self._engine.kv_pool.stats()
         return out
 
     # -- request plumbing (handler-thread side) -----------------------------

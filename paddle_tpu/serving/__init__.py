@@ -1,14 +1,19 @@
 """paddle_tpu.serving — the request-coalescing tier between the HTTP
 surface (inference/server.py) and the compiled model.
 
-Three pieces:
+Four pieces:
 
 * ``DynamicBatcher`` (batcher.py) — bounded admission queue + scheduler
   thread that coalesces concurrent ``/predict`` requests into one padded
   device batch per tick and slices result rows back per caller.
-* ``ContinuousBatchingEngine`` (generation.py) — fixed-slot decode batch
-  with per-slot KV cache; sequences join free slots between steps and
-  retire on EOS/max-len (``/generate``).
+* ``ContinuousBatchingEngine`` (generation.py) — continuous-batching
+  decode; sequences join free slots between steps and retire on
+  EOS/max-len (``/generate``).  KV is per-slot dense arrays, or the
+  block-paged pool when ``kv_pool=`` is given.
+* ``PagedKVPool`` (kv_pool.py) — fixed-size KV pages + per-sequence page
+  tables with refcounted copy-on-write prefix sharing; admission is by
+  free-page reservation, sizing by ``static.page_budget`` (the HBM
+  walker), drift detection by ``budget_drift``.
 * metrics (metrics.py) — the ``serving.*`` counter/gauge/histogram
   namespace over core/monitor, dumped by ``/stats``.
 
@@ -21,11 +26,15 @@ from .batcher import (  # noqa: F401
 from .generation import (  # noqa: F401
     ContinuousBatchingEngine, GenerationRequest,
 )
+from .kv_pool import (  # noqa: F401
+    PagedKVPool, PageTable, PagePoolExhaustedError, budget_drift,
+)
 from .metrics import serving_stats, reset_serving_stats  # noqa: F401
 
 __all__ = [
     "DynamicBatcher", "BatcherError", "QueueFullError",
     "DeadlineExceededError", "BatcherStoppedError",
-    "ContinuousBatchingEngine", "GenerationRequest", "serving_stats",
-    "reset_serving_stats",
+    "ContinuousBatchingEngine", "GenerationRequest",
+    "PagedKVPool", "PageTable", "PagePoolExhaustedError", "budget_drift",
+    "serving_stats", "reset_serving_stats",
 ]

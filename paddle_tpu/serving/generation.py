@@ -4,21 +4,35 @@ scheduling) for autoregressive decode.
 ``GPTForGeneration.generate`` decodes one request at a time and
 recomputes the whole prefix every step — fine for a notebook, hopeless
 for serving: the device runs batch-1 matmuls and a long request blocks
-every short one behind it.  This engine keeps a FIXED-SLOT decode batch
-(``max_slots`` rows) stepping continuously; sequences are admitted into
-free slots BETWEEN steps and retired the moment they emit EOS or hit
-their length budget, so a finished short request never waits for the
-longest sequence in its batch (the continuous-batching lesson).
+every short one behind it.  This engine keeps a decode batch of up to
+``max_slots`` rows stepping continuously; sequences are admitted
+BETWEEN steps and retired the moment they emit EOS or hit their length
+budget, so a finished short request never waits for the longest
+sequence in its batch (the continuous-batching lesson).
 
-Per-slot KV cache: each slot owns dense per-layer K/V host arrays
-([heads, len, head_dim]) built once at admission (a single prefill pass
-over the prompt through ``GPTModel.forward(cache=...)``) and extended by
-one column per step, so a decode step is O(1) model work per token
-instead of O(len) prefix recompute.  Slots of different lengths share a
-step by padding KV to a power-of-two length bucket and masking the pad
-columns with the same additive-mask path the model uses for causality —
-shapes seen by the compiler stay bounded at (max_slots, log2 lengths),
-the serving analog of the executor's pow2 feed buckets.
+KV storage comes in two modes:
+
+* **Fixed-slot (default, the A/B baseline)** — each slot owns dense
+  per-layer K/V arrays ([heads, len, head_dim]) built at admission and
+  extended one column per step.  HBM pays worst case per slot.
+* **Paged (``kv_pool=``)** — KV lives in a shared ``PagedKVPool``
+  (serving/kv_pool.py): fixed-size pages, per-sequence page tables,
+  refcounted copy-on-write sharing of common prompt-prefix pages, and
+  ADMISSION BY FREE-PAGE RESERVATION instead of slot count.  The decode
+  step reads through a gather-by-page-table view into the very same
+  dense batched cache the fixed-slot path feeds ``GPTModel.forward
+  (cache=...)``, so compiled shapes stay bounded at (max_slots, log2
+  lengths) and greedy output stays token-equal to the fixed-slot
+  engine.  ``kv_pool="auto"`` sizes the pool with
+  ``static.page_budget`` — the HBM-walker budget path — and adopts its
+  batch ceiling / max-context.
+
+Backpressure mirrors the DynamicBatcher contract: queue overflow raises
+a load-scaled, JITTERED ``QueueFullError`` (a deterministic Retry-After
+synchronizes rejected clients into a thundering herd), requests whose
+page demand exceeds the whole pool are rejected at submit (they could
+only ever expire in the queue), and queued requests expire at their
+deadline.
 
 Decode strategies reuse the ``generate()`` contract: ``greedy_search``
 (deterministic — token-for-token equal to per-sequence ``generate``)
@@ -31,24 +45,19 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 from . import metrics
+from ..core.compile_cache import next_pow2 as _next_pow2
 from .batcher import (BatcherStoppedError, DeadlineExceededError,
-                      QueueFullError)
+                      QueueFullError, _jittered)
+from .kv_pool import PagedKVPool, PageTable
 
 __all__ = ["ContinuousBatchingEngine", "GenerationRequest"]
 
 _NEG_INF = -1e9
-
-
-def _next_pow2(n: int, floor: int = 16) -> int:
-    b = floor
-    while b < n:
-        b <<= 1
-    return b
 
 
 class GenerationRequest:
@@ -72,17 +81,20 @@ class GenerationRequest:
 
 
 class _Slot:
-    __slots__ = ("req", "kv", "tokens", "next_id", "n_new")
+    __slots__ = ("req", "kv", "table", "tokens", "next_id", "n_new")
 
-    def __init__(self, req, kv, tokens, next_id):
+    def __init__(self, req, kv, tokens, next_id, table=None):
         self.req = req
-        self.kv = kv          # per-layer (k [H, len, Dh], v [H, len, Dh])
+        self.kv = kv          # fixed mode: per-layer (k [H,len,Dh], v)
+        self.table = table    # paged mode: PageTable into the pool
         self.tokens = tokens  # prompt + generated so far (python list)
         self.next_id = next_id  # sampled, not yet fed through the model
         self.n_new = 1
 
     @property
     def kv_len(self) -> int:
+        if self.table is not None:
+            return self.table.length
         return self.kv[0][0].shape[1]
 
 
@@ -97,24 +109,83 @@ class ContinuousBatchingEngine:
     ``model`` is a ``GPTForGeneration`` (or bare ``GPTModel``) — anything
     exposing ``config``, ``gen_cache(batch)`` and the cache-aware
     ``forward(ids, cache, pos_offset, attn_mask)``.
+
+    ``kv_pool``: ``None`` keeps the dense fixed-slot cache; ``"auto"``
+    builds a ``PagedKVPool`` sized by ``static.page_budget(model)`` (the
+    planner/HBM-walker path) and adopts the plan's batch ceiling unless
+    ``max_slots`` is given explicitly; a plan dict or a ready
+    ``PagedKVPool`` is consumed as-is.
     """
 
-    def __init__(self, model, max_slots: int = 4, max_queue: int = 64,
-                 default_timeout_s: float = 120.0, kv_bucket_floor: int = 16):
+    def __init__(self, model, max_slots: Optional[int] = None,
+                 max_queue: int = 64, default_timeout_s: float = 120.0,
+                 kv_bucket_floor: int = 16, kv_pool=None):
         self._model = getattr(model, "gpt", model)
         self.config = self._model.config
+        self._pool: Optional[PagedKVPool] = None
+        if kv_pool is not None:
+            if kv_pool == "auto":
+                from ..static.planner import page_budget
+                self._pool = PagedKVPool.from_plan(page_budget(self._model))
+            elif isinstance(kv_pool, PagedKVPool):
+                self._pool = kv_pool
+            elif isinstance(kv_pool, dict):
+                self._pool = PagedKVPool.from_plan(kv_pool)
+            else:
+                raise ValueError(
+                    f"kv_pool must be None, 'auto', a plan dict or a "
+                    f"PagedKVPool, got {type(kv_pool).__name__}")
+            for name, want, got in (
+                    ("num_layers", self.config.num_layers,
+                     self._pool.num_layers),
+                    ("num_heads", self.config.num_heads,
+                     self._pool.num_heads),
+                    ("head_dim",
+                     self.config.hidden_size // self.config.num_heads,
+                     self._pool.head_dim)):
+                if int(want) != int(got):
+                    raise ValueError(
+                        f"kv_pool geometry mismatch: model {name}={want} "
+                        f"but pool was built for {got}")
+        plan = self._pool.plan if self._pool is not None else None
+        if max_slots is None:
+            max_slots = int(plan["max_slots"]) if plan else 4
         self.max_slots = int(max_slots)
+        # paged max-context: what the plan granted (never beyond the
+        # model's positions); fixed mode keeps max_position
+        self.max_context = int(self.config.max_position)
+        if self._pool is not None:
+            pool_ctx = self._pool.num_pages * self._pool.page_tokens
+            self.max_context = min(
+                self.max_context,
+                int(plan["max_context"]) if plan else pool_ctx)
         self.max_queue = int(max_queue)
         self.default_timeout_s = float(default_timeout_s)
         self._kv_floor = int(kv_bucket_floor)
         self._queue: List[GenerationRequest] = []
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._kv_buckets = set()   # distinct compiled KV lengths seen
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
         self._idle = threading.Condition(self._mu)
         self._running = False
         self._draining = False
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def kv_pool(self) -> Optional[PagedKVPool]:
+        return self._pool
+
+    @property
+    def paged(self) -> bool:
+        return self._pool is not None
+
+    @property
+    def kv_buckets(self) -> int:
+        """Distinct padded KV lengths the model has been asked to
+        compile — growth after warmup means a retrace."""
+        with self._mu:
+            return len(self._kv_buckets)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -152,15 +223,29 @@ class ContinuousBatchingEngine:
             self._thread = None
         # the decode thread is dead now: fail whatever it left in-flight
         # (drain=False, or a drain that timed out) instead of letting
-        # callers hang on their futures
+        # callers hang on their futures — and give its pages back
         for i, slot in enumerate(self._slots):
             if slot is not None:
                 if not slot.req.future.done():
                     slot.req.future.set_exception(BatcherStoppedError(
                         "generation engine stopped mid-decode"))
+                if slot.table is not None:
+                    self._pool.close_sequence(slot.table)
                 self._slots[i] = None
 
     # -- admission ----------------------------------------------------------
+    def _retry_hint(self, depth: int) -> float:
+        """Load-scaled jittered Retry-After: time for the backlog to
+        drain at the decode batch's width, inflated by page-pool
+        admission pressure (a nearly-full pool retires slower than the
+        queue math alone suggests)."""
+        base = max(0.05, 0.1 * depth / max(1, self.max_slots))
+        if self._pool is not None:
+            occupancy = 1.0 - (self._pool.pages_available
+                               / max(1, self._pool.num_pages))
+            base *= 1.0 + occupancy
+        return _jittered(base)
+
     def submit(self, input_ids, max_length: int = 20,
                decode_strategy: str = "greedy_search", top_k: int = 0,
                temperature: float = 1.0, seed: int = 0,
@@ -174,10 +259,21 @@ class ContinuousBatchingEngine:
         prompt = np.asarray(input_ids, np.int64).reshape(-1)
         if prompt.size == 0:
             raise ValueError("input_ids must hold at least one token")
-        if prompt.size + max_length > self.config.max_position:
+        if prompt.size + max_length > self.max_context:
+            limit = ("max_position" if self.max_context ==
+                     self.config.max_position else "the pool's max_context")
             raise ValueError(
                 f"prefix ({prompt.size}) + max_length ({max_length}) "
-                f"exceeds max_position ({self.config.max_position})")
+                f"exceeds {limit} ({self.max_context})")
+        if self._pool is not None:
+            worst = self._pool.pages_for_request(prompt.size, max_length)
+            if worst > self._pool.num_pages:
+                metrics.count("gen.rejected")
+                metrics.count("gen.rejected_pages")
+                raise ValueError(
+                    f"request can never fit: needs {worst} KV pages, the "
+                    f"pool holds {self._pool.num_pages} "
+                    f"({self._pool.page_tokens} tokens/page)")
         req = GenerationRequest(
             prompt, max_length, decode_strategy, top_k, temperature, seed,
             self.default_timeout_s if timeout_s is None else timeout_s)
@@ -188,7 +284,9 @@ class ContinuousBatchingEngine:
                     "generation engine is not accepting work")
             if len(self._queue) >= self.max_queue:
                 metrics.count("gen.rejected")
-                raise QueueFullError(len(self._queue), 1.0)
+                metrics.count("gen.rejected_queue_full")
+                raise QueueFullError(len(self._queue),
+                                     self._retry_hint(len(self._queue)))
             self._queue.append(req)
             metrics.count("gen.admitted")
             metrics.gauge("gen.queue.depth", len(self._queue))
@@ -208,11 +306,13 @@ class ContinuousBatchingEngine:
                 if not self._running:
                     return
                 pending = self._admit_locked()
-            for req in pending:
+            for req, table in pending:
                 try:
-                    self._prefill(req)
+                    self._prefill(req, table)
                 except Exception as e:  # noqa: BLE001 — this request only
                     metrics.count("gen.failed")
+                    if table is not None:
+                        self._pool.close_sequence(table)
                     req.future.set_exception(e)
             try:
                 if any(self._slots):
@@ -220,9 +320,14 @@ class ContinuousBatchingEngine:
             except Exception as e:  # noqa: BLE001 — fail loud, stay alive
                 self._fail_all(e)
 
-    def _admit_locked(self) -> List[GenerationRequest]:
-        """Pick queued requests for the free slots (FIFO, expired dropped);
-        called with the lock held, prefill happens outside it."""
+    def _admit_locked(self) -> List[Tuple[GenerationRequest,
+                                          Optional[PageTable]]]:
+        """Pick queued requests for the free slots (FIFO, expired
+        dropped); paged mode additionally requires a worst-case page
+        reservation and stops at the first request the pool cannot
+        cover (strict FIFO — skipping ahead would starve big
+        requests).  Called with the lock held, prefill happens outside
+        it."""
         now = time.monotonic()
         keep = []
         for req in self._queue:
@@ -233,12 +338,33 @@ class ContinuousBatchingEngine:
                 req.future.set_exception(DeadlineExceededError(
                     f"request expired after {now - req.t_enqueue:.2f}s "
                     "in queue"))
+            elif self._pool is not None and self._pool.pages_for_request(
+                    req.prompt.size, req.max_new) > self._pool.num_pages:
+                # defensive queue-expiry: a request no pool state could
+                # ever admit must not sit until its deadline (reachable
+                # only if the pool shrank after submit)
+                metrics.count("gen.rejected_pages")
+                req.future.set_exception(ValueError(
+                    "request can never fit in the KV page pool"))
             else:
                 keep.append(req)
         self._queue = keep
-        free = [i for i, s in enumerate(self._slots) if s is None]
-        pending = self._queue[:len(free)]
-        self._queue = self._queue[len(pending):]
+        free = sum(s is None for s in self._slots)
+        pending: List[Tuple[GenerationRequest, Optional[PageTable]]] = []
+        blocked = False
+        while self._queue and len(pending) < free:
+            req = self._queue[0]
+            table = None
+            if self._pool is not None:
+                worst = self._pool.pages_for_request(
+                    req.prompt.size, req.max_new)
+                if not self._pool.can_reserve(worst):
+                    blocked = True
+                    metrics.count("kv.admit_blocked")
+                    break
+                table = self._pool.reserve(worst)
+            pending.append((self._queue.pop(0), table))
+        metrics.gauge("kv.admission_blocked", int(blocked))
         metrics.gauge("gen.queue.depth", len(self._queue))
         return pending
 
@@ -248,17 +374,23 @@ class ContinuousBatchingEngine:
                 if slot is not None:
                     if not slot.req.future.done():
                         slot.req.future.set_exception(err)
+                    if slot.table is not None:
+                        self._pool.close_sequence(slot.table)
                     self._slots[i] = None
             metrics.gauge("gen.active_slots", 0)
             self._idle.notify_all()
 
     # -- model plumbing -----------------------------------------------------
-    def _prefill(self, req: GenerationRequest):
-        """Run the prompt through the model once: fills this sequence's KV
-        cache and samples its first token, then installs it in a free
-        slot (or retires it immediately on EOS/budget)."""
+    def _prefill(self, req: GenerationRequest,
+                 table: Optional[PageTable] = None):
+        """Run the prompt through the model once: fills this sequence's
+        KV (dense slot arrays, or pool pages through the prefix-sharing
+        write path) and samples its first token, then installs it in a
+        free slot (or retires it immediately on EOS/budget)."""
         import paddle_tpu
         if req.future.cancelled():
+            if table is not None:
+                self._pool.close_sequence(table)
             return
         p = req.prompt.size
         # pad the prompt to a pow2 length bucket so prefill compiles at
@@ -267,6 +399,9 @@ class ContinuousBatchingEngine:
         # < p, and their K/V columns are sliced away below
         pp = min(_next_pow2(p, self._kv_floor),
                  int(self.config.max_position))
+        with self._mu:
+            self._kv_buckets.add(("prefill", pp))
+            metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
         ids = np.full((1, pp), self.config.eos_id, np.int64)
         ids[0, :p] = req.prompt
         caches = self._model.gen_cache(1)
@@ -276,15 +411,31 @@ class ContinuousBatchingEngine:
             attn_mask=self._model._mask(pp))
         last = np.asarray(logits.numpy())[0, p - 1]
         nxt = self._sample(req, last)
-        kv = [(np.asarray(c.k.numpy())[0, :, :p],
-               np.asarray(c.v.numpy())[0, :, :p])
-              for c in caches]
-        slot = _Slot(req, kv, list(req.prompt), nxt)
         metrics.count("gen.prefill_tokens", p)
         if nxt == self.config.eos_id or req.max_new <= 1:
+            # never occupied a slot; pages were never written
+            if table is not None:
+                self._pool.close_sequence(table)
+            slot = _Slot(req, None, list(req.prompt), nxt)
             slot.tokens.append(nxt)
-            self._retire(slot)
+            self._finish(slot)
             return
+        if table is not None:
+            # KV column t is a pure function of tokens <= t, so the
+            # pool may satisfy whole prompt-head pages from another
+            # sequence's bitwise-identical prefill (COW prefix sharing)
+            k_stack = np.stack([np.asarray(c.k.numpy())[0, :, :p]
+                                for c in caches])
+            v_stack = np.stack([np.asarray(c.v.numpy())[0, :, :p]
+                                for c in caches])
+            self._pool.open_sequence(req.prompt, k_stack, v_stack,
+                                     table=table)
+            slot = _Slot(req, None, list(req.prompt), nxt, table=table)
+        else:
+            kv = [(np.asarray(c.k.numpy())[0, :, :p],
+                   np.asarray(c.v.numpy())[0, :, :p])
+                  for c in caches]
+            slot = _Slot(req, kv, list(req.prompt), nxt)
         with self._mu:
             idx = self._slots.index(None)
             self._slots[idx] = slot
@@ -292,15 +443,21 @@ class ContinuousBatchingEngine:
                           sum(s is not None for s in self._slots))
 
     def _step(self):
-        """One decode step over every active slot (ONE device batch)."""
+        """One decode step over every active slot (ONE device batch).
+        Paged and fixed slots feed the SAME batched dense cache — the
+        pool's gather-by-page-table view never changes compiled
+        shapes."""
         import paddle_tpu
         from ..nn import MultiHeadAttention
         with self._mu:
             # a cancelled future means the caller stopped waiting — free
-            # the slot instead of decoding tokens nobody will read
+            # the slot (and its pages) instead of decoding tokens nobody
+            # will read
             for i, s in enumerate(self._slots):
                 if s is not None and s.req.future.cancelled():
                     metrics.count("gen.cancelled")
+                    if s.table is not None:
+                        self._pool.close_sequence(s.table)
                     self._slots[i] = None
             active = [(i, s) for i, s in enumerate(self._slots)
                       if s is not None]
@@ -310,7 +467,11 @@ class ContinuousBatchingEngine:
         cfg = self.config
         heads = cfg.num_heads
         head_dim = cfg.hidden_size // heads
+        n_layers = cfg.num_layers
         lpad = _next_pow2(max(s.kv_len for _, s in active), self._kv_floor)
+        with self._mu:
+            self._kv_buckets.add(("decode", lpad))
+            metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
 
         ids = np.full((S, 1), cfg.eos_id, np.int64)
         pos = np.zeros(S, np.int64)
@@ -318,7 +479,6 @@ class ContinuousBatchingEngine:
         # valid history + self are 0, pad columns and idle rows -inf
         mask = np.full((S, 1, 1, lpad + 1), _NEG_INF, np.float32)
         mask[:, :, :, lpad] = 0.0
-        n_layers = len(active[0][1].kv)
         k_b = np.zeros((n_layers, S, heads, lpad, head_dim), np.float32)
         v_b = np.zeros_like(k_b)
         for i, s in active:
@@ -326,9 +486,14 @@ class ContinuousBatchingEngine:
             ids[i, 0] = s.next_id
             pos[i] = ln
             mask[i, :, :, :ln] = 0.0
-            for li, (k, v) in enumerate(s.kv):
-                k_b[li, i, :, :ln] = k
-                v_b[li, i, :, :ln] = v
+            if s.table is not None:
+                k_all, v_all = self._pool.gather(s.table)
+                k_b[:, i, :, :ln] = k_all
+                v_b[:, i, :, :ln] = v_all
+            else:
+                for li, (k, v) in enumerate(s.kv):
+                    k_b[li, i, :, :ln] = k
+                    v_b[li, i, :, :ln] = v
         caches = [MultiHeadAttention.Cache(paddle_tpu.to_tensor(k_b[li]),
                                            paddle_tpu.to_tensor(v_b[li]))
                   for li in range(n_layers)]
@@ -346,10 +511,19 @@ class ContinuousBatchingEngine:
 
         retired = []
         for i, s in active:
-            for li, (k, v) in enumerate(s.kv):
-                s.kv[li] = (
-                    np.concatenate([k, new_cols[li][0][i][:, None]], 1),
-                    np.concatenate([v, new_cols[li][1][i][:, None]], 1))
+            if s.table is not None:
+                # write-through the page table: a fresh page at the
+                # boundary, a COW copy when the target page is shared
+                k_col = np.stack([new_cols[li][0][i]
+                                  for li in range(n_layers)])
+                v_col = np.stack([new_cols[li][1][i]
+                                  for li in range(n_layers)])
+                self._pool.append_column(s.table, k_col, v_col)
+            else:
+                for li, (k, v) in enumerate(s.kv):
+                    s.kv[li] = (
+                        np.concatenate([k, new_cols[li][0][i][:, None]], 1),
+                        np.concatenate([v, new_cols[li][1][i][:, None]], 1))
             s.tokens.append(s.next_id)
             nxt = self._sample(s.req, step_logits[i])
             s.next_id = nxt
@@ -360,7 +534,7 @@ class ContinuousBatchingEngine:
         with self._mu:
             for i in retired:
                 slot, self._slots[i] = self._slots[i], None
-                self._retire(slot)
+                self._finish(slot)
             metrics.gauge("gen.active_slots",
                           sum(s is not None for s in self._slots))
 
@@ -375,7 +549,12 @@ class ContinuousBatchingEngine:
             return int(req.rng.choice(p.shape[0], p=p))
         return int(np.argmax(logits))
 
-    def _retire(self, slot: _Slot):
+    def _finish(self, slot: _Slot):
+        """Resolve a finished sequence and retire its pages the moment
+        it completes — freed pages are the admission currency."""
+        if slot.table is not None:
+            self._pool.close_sequence(slot.table)
+            slot.table = None
         metrics.count("gen.completed")
         metrics.observe("gen.seq_len", len(slot.tokens))
         metrics.latency_ms(time.monotonic() - slot.req.t_enqueue)

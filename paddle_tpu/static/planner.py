@@ -64,10 +64,11 @@ import itertools
 import os
 from typing import Dict, List, Optional, Tuple
 
+from ..core.compile_cache import next_pow2 as _next_pow2
 from ..core.program import Program
 
 __all__ = ["Plan", "plan_program", "apply_plan", "ici_bytes_per_chip",
-           "ICI_ENV", "DEFAULT_ICI_BYTES_PER_S"]
+           "page_budget", "ICI_ENV", "DEFAULT_ICI_BYTES_PER_S"]
 
 ICI_ENV = "PADDLE_TPU_ICI_BYTES_PER_S"
 
@@ -613,3 +614,166 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
     from ..core.pass_framework import finish_pass
     finish_pass(program, "auto_parallel_plan", startup=startup, **meta)
     return program
+
+
+# ---------------------------------------------------------------------------
+# serving KV-pool sizing (planner follow-up (d))
+# ---------------------------------------------------------------------------
+def _model_config(model=None, config=None) -> Dict:
+    """Normalize the decode model's geometry to a plain dict.  Accepts a
+    ``GPTForGeneration``/``GPTModel`` (anything carrying ``.config``),
+    a ``GPTConfig``-shaped object, or an already-plain dict."""
+    if config is None:
+        if model is None:
+            raise ValueError("page_budget needs a model or a config")
+        config = getattr(model, "gpt", model).config
+    if isinstance(config, dict):
+        src = dict(config)
+    else:
+        src = {k: getattr(config, k)
+               for k in ("num_layers", "num_heads", "hidden_size",
+                         "vocab_size", "max_position", "intermediate_size")}
+    out = {k: int(src[k]) for k in ("num_layers", "num_heads",
+                                    "hidden_size", "vocab_size",
+                                    "max_position")}
+    out["intermediate_size"] = int(
+        src.get("intermediate_size") or out["hidden_size"] * 4)
+    if out["hidden_size"] % out["num_heads"]:
+        raise ValueError(
+            f"hidden_size {out['hidden_size']} not divisible by "
+            f"num_heads {out['num_heads']}")
+    return out
+
+
+def _decode_weight_bytes(cfg: Dict) -> int:
+    """Parameter bytes of the decode model — the same shape x dtype
+    persistable accounting `memory_analysis.analyze_program` charges; in
+    dygraph the parameters ARE the persistables, and their shapes are
+    closed forms of the config (fp32)."""
+    hd, inter = cfg["hidden_size"], cfg["intermediate_size"]
+    per_block = (4 * (hd * hd + hd)       # q/k/v/out projections + bias
+                 + 2 * 2 * hd             # ln1/ln2 scale + shift
+                 + hd * inter + inter     # fc1
+                 + inter * hd + hd)       # fc2
+    n = (cfg["vocab_size"] * hd           # wte (tied LM head)
+         + cfg["max_position"] * hd       # wpe
+         + cfg["num_layers"] * per_block
+         + 2 * hd)                        # ln_f
+    return n * 4
+
+
+def page_budget(model=None, config=None, *, page_tokens: int = 16,
+                max_context: Optional[int] = None,
+                hbm_bytes: Optional[int] = None,
+                weight_bytes: Optional[int] = None,
+                kv_dtype: str = "float32",
+                max_slots_cap: Optional[int] = None,
+                headroom: float = 0.08) -> Dict:
+    """Size the serving tier's paged KV pool from the HBM walker's
+    budget instead of a hand-set page count (ROADMAP planner follow-up
+    (d): the same sizing authority that answers training fits/OOM).
+
+    Accounting, per chip::
+
+        usable    = hbm_budget_bytes() * (1 - headroom) - weight_bytes
+        workspace = max_slots * (dense K+V gather view at the pow2
+                    max-context bucket + a logits row)   # the decode
+                    step's transient, priced because the gather-by-
+                    page-table view coexists with the pool every step
+        pages     = (usable - workspace) / page_bytes
+
+    ``weight_bytes`` defaults to summing the live model's parameters —
+    the identical shape x dtype persistable accounting
+    ``memory_analysis.analyze_program`` performs (dygraph parameters are
+    the persistables) — or the closed-form config walk when only a
+    config is given.  ``hbm_bytes`` defaults to
+    ``memory_analysis.hbm_budget_bytes()`` (``PADDLE_TPU_HBM_BYTES``),
+    so the serving verdict and the training fits/OOM verdict share one
+    budget source.
+
+    The batch ceiling (``max_slots``) spends at most ~35% of the usable
+    budget on per-step workspace — pages are the asset, the gather view
+    is rent — and ``max_context`` is clamped down when the pool cannot
+    hold even one worst-case sequence at the requested context.
+
+    Returns the plan dict ``PagedKVPool.from_plan`` consumes; every
+    input is recorded in it so ``serving.kv_pool.budget_drift`` can
+    re-derive the numbers and flag hand-edits, V504-style.
+    """
+    import numpy as np
+    from .memory_analysis import hbm_budget_bytes
+    cfg = _model_config(model, config)
+    L, H = cfg["num_layers"], cfg["num_heads"]
+    Dh = cfg["hidden_size"] // H
+    T = int(page_tokens)
+    if T < 1:
+        raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    itemsize = np.dtype(kv_dtype).itemsize
+    budget = int(hbm_bytes) if hbm_bytes else hbm_budget_bytes()
+    if weight_bytes is None:
+        if model is not None:
+            weight_bytes = int(sum(
+                np.asarray(p.numpy()).nbytes
+                for p in getattr(model, "gpt", model).parameters()))
+        else:
+            weight_bytes = _decode_weight_bytes(cfg)
+    weight_bytes = int(weight_bytes)
+    cap = int(max_slots_cap) if max_slots_cap else 64
+    # ctx_req is the pre-clamp INPUT (recorded for budget_drift: feeding
+    # the pool-clamped max_context back in would re-derive a different
+    # workspace split and report drift on an untouched plan)
+    ctx_req = min(int(max_context) if max_context
+                  else cfg["max_position"], cfg["max_position"])
+    ctx = ctx_req
+
+    token_bytes = 2 * L * H * Dh * itemsize       # one K+V column, all layers
+    page_bytes = token_bytes * T
+    usable = int(budget * (1.0 - float(headroom))) - weight_bytes
+    if usable < page_bytes + token_bytes * _next_pow2(ctx):
+        raise ValueError(
+            f"page_budget: {budget} B HBM leaves {usable} B after "
+            f"{weight_bytes} B of weights — not enough for one decode "
+            f"slot at context {ctx} (raise PADDLE_TPU_HBM_BYTES or "
+            f"shrink the model)")
+    # per-slot step workspace: the dense [L, H, lpad, Dh] K+V gather
+    # view at the largest pow2 KV bucket, plus this row's logits
+    ws_slot = 2 * L * H * _next_pow2(ctx) * Dh * itemsize \
+        + cfg["vocab_size"] * 4
+    max_slots = max(1, min(cap, int(usable * 0.35) // ws_slot))
+    pages = (usable - max_slots * ws_slot) // page_bytes
+    while pages < 1 and max_slots > 1:      # tiny budgets: trade slots back
+        max_slots -= 1
+        pages = (usable - max_slots * ws_slot) // page_bytes
+    if pages < 1:
+        raise ValueError(
+            f"page_budget: workspace for one slot leaves no room for "
+            f"pages ({usable} usable, {ws_slot} per slot)")
+    pages = int(pages)
+    # the honest advertised max-context: ANY prompt shape within it must
+    # fit its admission reservation (pages_for_request), which includes
+    # the +1 COW allowance for a partial final prompt page — so the top
+    # page cannot be promised (ctx = pages*T would reject in-limit
+    # requests as "can never fit")
+    ctx = min(ctx, max(T, (pages - 1) * T))
+    max_slots = int(min(max_slots, pages))
+    return {
+        "pages": pages,
+        "page_tokens": T,
+        "max_slots": max_slots,
+        "max_context": int(ctx),
+        "max_context_requested": int(ctx_req),
+        "num_layers": L,
+        "num_heads": H,
+        "head_dim": Dh,
+        "kv_dtype": str(kv_dtype),
+        "page_bytes": int(page_bytes),
+        "kv_bytes": int(pages * page_bytes),
+        "workspace_bytes": int(max_slots * ws_slot),
+        "weight_bytes": weight_bytes,
+        "hbm_bytes": int(budget),
+        "headroom": float(headroom),
+        "max_slots_cap": cap,
+        "config": cfg,
+        "source": "static.page_budget (memory_analysis.hbm_budget_bytes "
+                  "+ parameter persistable walk)",
+    }

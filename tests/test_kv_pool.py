@@ -1,0 +1,481 @@
+"""Block-paged KV pool: COW prefix sharing, page-count admission,
+planner-sized budgets (serving/kv_pool.py + the paged engine mode).
+
+Covers the pool's own contracts (reservation accounting, refcounted
+prefix sharing, copy-on-write isolation, retire-frees, leak detection),
+the paged ContinuousBatchingEngine's token-equality with the fixed-slot
+engine and with per-sequence generate() across admit/retire churn,
+admission under page exhaustion (block + eventual completion, jittered
+queue-full backpressure), and the planner sizing path (page_budget +
+budget_drift)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (PagedKVPool, PagePoolExhaustedError,
+                                QueueFullError, budget_drift, metrics)
+from paddle_tpu.serving.kv_pool import PageTable
+
+
+def _pool(pages=16, T=4, L=2, H=2, Dh=4):
+    return PagedKVPool(num_layers=L, num_heads=H, head_dim=Dh,
+                       page_tokens=T, num_pages=pages)
+
+
+def _rand_kv(rng, L, H, n, Dh):
+    return (rng.randn(L, H, n, Dh).astype(np.float32),
+            rng.randn(L, H, n, Dh).astype(np.float32))
+
+
+# -- pool unit contracts ----------------------------------------------------
+def test_reservation_accounting():
+    pool = _pool(pages=8)
+    assert pool.pages_needed(0) == 0
+    assert pool.pages_needed(1) == 1
+    assert pool.pages_needed(4) == 1
+    assert pool.pages_needed(5) == 2
+    t = pool.reserve(5)
+    assert pool.pages_available == 3 and pool.pages_free == 8
+    assert pool.can_reserve(3) and not pool.can_reserve(4)
+    with pytest.raises(PagePoolExhaustedError):
+        pool.reserve(4)
+    pool.release(t)  # never opened: full reservation returns
+    assert pool.pages_available == 8
+    pool.assert_drained()
+
+
+def test_overcharge_beyond_reservation_raises():
+    pool = _pool(pages=8, T=4)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(2, 30, (4,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 4, 4)
+    table = pool.reserve(1)
+    pool.open_sequence(prompt, k, v, table=table)
+    kc, vc = _rand_kv(rng, 2, 2, 1, 4)
+    with pytest.raises(PagePoolExhaustedError, match="reservation"):
+        # position 4 needs a second page the table never reserved
+        pool.append_column(table, kc[:, :, 0], vc[:, :, 0])
+    pool.close_sequence(table)
+    pool.assert_drained()
+
+
+def test_prefix_sharing_refcounts_and_fewer_pages_than_solo():
+    """Two sequences with the same prompt head occupy fewer pages than
+    2x solo: full head pages are stored once and refcounted."""
+    pool = _pool(pages=16, T=4)
+    rng = np.random.RandomState(1)
+    head = rng.randint(2, 30, (8,)).astype(np.int64)   # 2 full pages
+    p1 = np.concatenate([head, [3]])
+    p2 = np.concatenate([head, [5]])
+    k1, v1 = _rand_kv(rng, 2, 2, 9, 4)
+    solo_pages = pool.pages_needed(p1.size)            # 3
+    t1 = pool.open_sequence(p1, k1, v1)
+    used_solo = pool.num_pages - pool.pages_free
+    assert used_solo == solo_pages
+    # second sequence: identical KV on the shared head (causal determinism)
+    k2 = k1.copy()
+    v2 = v1.copy()
+    t2 = pool.open_sequence(p2, k2, v2)
+    used_both = pool.num_pages - pool.pages_free
+    assert used_both == solo_pages + 1      # only the distinct tail page
+    assert used_both < 2 * solo_pages
+    assert pool.prefix_hits == 2 and pool.pages_shared == 2
+    # retire frees: t1 closes, shared pages survive for t2
+    pool.close_sequence(t1)
+    assert pool.pages_shared == 0
+    assert pool.num_pages - pool.pages_free == solo_pages
+    ks, _ = pool.gather(t2)
+    np.testing.assert_array_equal(ks[:, :, :8], k1[:, :, :8])
+    pool.close_sequence(t2)
+    pool.assert_drained()
+
+
+def test_cow_write_copies_and_isolates_sharers():
+    """Appending into a shared page copies it first: the writer gets its
+    own column, every sharer's view is bitwise untouched."""
+    pool = _pool(pages=16, T=4)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(2, 30, (6,)).astype(np.int64)  # page1 partial
+    k, v = _rand_kv(rng, 2, 2, 6, 4)
+    t1 = pool.open_sequence(prompt, k, v)
+    t2 = pool.open_sequence(prompt, k.copy(), v.copy())
+    assert pool.pages_shared == 2
+    kc, vc = _rand_kv(rng, 2, 2, 1, 4)
+    pool.append_column(t2, kc[:, :, 0], vc[:, :, 0])
+    assert pool.cow_copies == 1
+    assert t1.pages[1] != t2.pages[1]       # diverged
+    assert t1.pages[0] == t2.pages[0]       # untouched full page shared
+    k1g, _ = pool.gather(t1)
+    np.testing.assert_array_equal(k1g, k)
+    k2g, _ = pool.gather(t2)
+    np.testing.assert_array_equal(k2g[:, :, :6], k)
+    np.testing.assert_array_equal(k2g[:, :, 6], kc[:, :, 0])
+    # second append lands in the now-exclusive copy: no further COW
+    pool.append_column(t2, kc[:, :, 0], vc[:, :, 0])
+    assert pool.cow_copies == 1
+    pool.close_sequence(t1)
+    pool.close_sequence(t2)
+    pool.assert_drained()
+
+
+def test_leak_assertion_fires_on_open_table():
+    pool = _pool(pages=8)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(2, 30, (4,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 4, 4)
+    t = pool.open_sequence(prompt, k, v)
+    with pytest.raises(AssertionError, match="page leak"):
+        pool.assert_drained()
+    pool.close_sequence(t)
+    pool.assert_drained()
+
+
+def test_freed_prefix_page_is_unregistered():
+    """A retired sequence's pages leave the prefix registry: a later
+    identical prompt must re-store, never alias freed storage."""
+    pool = _pool(pages=4, T=4)
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(2, 30, (4,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 4, 4)
+    t1 = pool.open_sequence(prompt, k, v)
+    pool.close_sequence(t1)
+    pool.assert_drained()
+    t2 = pool.open_sequence(prompt, k, v)
+    assert pool.prefix_hits == 0            # no stale hit
+    pool.close_sequence(t2)
+    pool.assert_drained()
+
+
+def test_reservation_covers_cow_of_shared_partial_prompt_page():
+    """Regression: a sequence whose own final PARTIAL prompt page gets
+    prefix-shared must still afford the COW copy its first decode
+    write needs — pages_for_request reserves the allowance, so the
+    charge never overruns the reservation."""
+    pool = _pool(pages=16, T=4)
+    assert pool.pages_for_request(6, 2) == pool.pages_needed(8) + 1
+    assert pool.pages_for_request(8, 2) == pool.pages_needed(10)  # full
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(2, 30, (6,)).astype(np.int64)   # partial page
+    k, v = _rand_kv(rng, 2, 2, 6, 4)
+    ta = pool.reserve(pool.pages_for_request(6, 2))
+    pool.open_sequence(prompt, k, v, table=ta)           # A charges 2
+    tb = pool.reserve(pool.pages_for_request(6, 2))
+    pool.open_sequence(prompt, k.copy(), v.copy(), table=tb)  # B shares
+    col_k, col_v = _rand_kv(rng, 2, 2, 1, 4)
+    # A's write hits its now-shared page: the COW charge fits in the
+    # allowance instead of raising PagePoolExhaustedError
+    pool.append_column(ta, col_k[:, :, 0], col_v[:, :, 0])
+    pool.append_column(tb, col_k[:, :, 0], col_v[:, :, 0])
+    assert pool.cow_copies == 1
+    pool.close_sequence(ta)
+    pool.close_sequence(tb)
+    pool.assert_drained()
+
+
+def test_paged_engine_survives_identical_concurrent_prompts():
+    """Two identical partial-tail prompts decoding side by side (the
+    duplicate-request / retry shape) must both complete token-equal —
+    the COW of the shared partial page is covered by admission."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(2, 30, (6,)).astype(np.int64)   # 6 % 4 != 0
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        ref = np.asarray(m.generate(prompt[None], max_length=4,
+                                    decode_strategy="greedy_search")[0])
+        pool = PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                           page_tokens=4, num_pages=12)
+        eng = ContinuousBatchingEngine(m, max_slots=2,
+                                       kv_pool=pool).start()
+        try:
+            futs = [eng.submit(prompt, max_length=4) for _ in range(2)]
+            outs = [np.asarray(f.result(timeout=120)) for f in futs]
+        finally:
+            eng.stop()
+    for out in outs:
+        np.testing.assert_array_equal(ref, out)
+    pool.assert_drained()
+
+
+# -- planner sizing ---------------------------------------------------------
+def test_page_budget_sizes_pool_and_detects_drift():
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+           "num_heads": 2, "max_position": 128}
+    plan = page_budget(config=cfg, page_tokens=16,
+                       hbm_bytes=4 * 1024 * 1024, weight_bytes=0)
+    assert plan["pages"] >= 1 and plan["max_slots"] >= 1
+    assert plan["max_context"] <= 128
+    assert plan["head_dim"] == 16
+    # the budget actually fits: kv + workspace under headroomed HBM
+    assert plan["kv_bytes"] + plan["workspace_bytes"] <= \
+        int(4 * 1024 * 1024 * (1 - plan["headroom"]))
+    pool = PagedKVPool.from_plan(plan)
+    assert pool.num_pages == plan["pages"]
+    assert pool.page_bytes == plan["page_bytes"]
+    assert budget_drift(pool) == []         # plan-built: no drift
+    # hand-resize the pool -> V504-style drift report
+    pool.num_pages += 7
+    drift = budget_drift(pool)
+    assert drift and any("pages" in d for d in drift)
+    bare = _pool()
+    assert budget_drift(bare)               # no recorded plan at all
+
+
+def test_budget_drift_clean_when_context_was_clamped():
+    """A tiny budget clamps max_context down to the pages' reach; the
+    re-derivation must use the recorded REQUESTED context, not the
+    clamped one, or an untouched plan reads as drifted."""
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+           "num_heads": 2, "max_position": 128}
+    plan = page_budget(config=cfg, page_tokens=16, max_context=128,
+                       hbm_bytes=100_000, weight_bytes=0, headroom=0.0)
+    assert plan["max_context"] < plan["max_context_requested"]  # clamped
+    pool = PagedKVPool.from_plan(plan)
+    assert budget_drift(pool) == []
+    pool.close_sequence(pool.reserve(0))  # no-op touch; still clean
+    assert budget_drift(pool) == []
+
+
+def test_advertised_max_context_is_always_servable():
+    """Regression: every prompt shape within the plan's max_context —
+    including a partial final prompt page, whose reservation carries
+    the +1 COW allowance — must fit the pool, or an in-limit request
+    gets a permanent 'can never fit' rejection."""
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+           "num_heads": 2, "max_position": 128}
+    for hbm in (100_000, 140_000, 4 * 1024 * 1024):
+        plan = page_budget(config=cfg, page_tokens=16, max_context=128,
+                           hbm_bytes=hbm, weight_bytes=0, headroom=0.0)
+        pool = PagedKVPool.from_plan(plan)
+        ctx = plan["max_context"]
+        for p in (1, 15, 16, ctx - 1, ctx):   # aligned + partial shapes
+            if 0 < p <= ctx:
+                assert pool.pages_for_request(p, ctx - p) <= \
+                    plan["pages"], (hbm, p)
+
+
+def test_page_budget_refuses_impossible_budget():
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+           "num_heads": 2, "max_position": 128}
+    with pytest.raises(ValueError, match="not enough"):
+        page_budget(config=cfg, hbm_bytes=16 * 1024, weight_bytes=0)
+
+
+# -- paged engine -----------------------------------------------------------
+def _tiny_gpt(vocab=30):
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position=32, dropout=0.0)
+    return GPTForGeneration(GPTModel(cfg))
+
+
+def test_paged_engine_token_equal_across_churn():
+    """Greedy output through the paged engine — sequences of different
+    lengths joining and retiring mid-decode, prefix sharing live —
+    must match both per-sequence generate() and the fixed-slot engine
+    token for token, and the drained pool must hold zero pages."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    rng = np.random.RandomState(5)
+    head = rng.randint(2, 30, (6,)).astype(np.int64)
+    prompts = [rng.randint(2, 30, (n,)).astype(np.int64)
+               for n in (3, 5, 2, 7)]
+    prompts += [np.concatenate([head, [3]]), np.concatenate([head, [5]])]
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        refs = [m.generate(p[None], max_length=5,
+                           decode_strategy="greedy_search")[0]
+                for p in prompts]
+        pool = PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                           page_tokens=4, num_pages=24)
+        paged = ContinuousBatchingEngine(m, max_slots=2,
+                                         kv_pool=pool).start()
+        try:
+            futs = [paged.submit(p, max_length=5) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        finally:
+            paged.stop()
+        fixed = ContinuousBatchingEngine(m, max_slots=2).start()
+        try:
+            ffuts = [fixed.submit(p, max_length=5) for p in prompts]
+            fouts = [f.result(timeout=120) for f in ffuts]
+        finally:
+            fixed.stop()
+    for ref, out, fout in zip(refs, outs, fouts):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+        np.testing.assert_array_equal(np.asarray(fout), np.asarray(out))
+    pool.assert_drained()                   # page-leak check post-drain
+
+
+def test_paged_engine_admission_blocks_then_completes():
+    """A pool holding exactly one worst-case sequence serializes the
+    batch: later requests wait for pages, every request still
+    completes, and the admission-pressure counter registers the wait."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(2, 30, (3,)).astype(np.int64)
+               for _ in range(3)]
+    blocked0 = metrics.counter("kv.admit_blocked")
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        refs = [m.generate(p[None], max_length=4,
+                           decode_strategy="greedy_search")[0]
+                for p in prompts]
+        # 3+4=7 tokens -> 2 pages of 4 + 1 COW allowance (partial
+        # prompt page): the pool admits ONE sequence at a time
+        pool = PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                           page_tokens=4, num_pages=3)
+        eng = ContinuousBatchingEngine(m, max_slots=2,
+                                       kv_pool=pool).start()
+        try:
+            futs = [eng.submit(p, max_length=4) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        finally:
+            eng.stop()
+    for ref, out in zip(refs, outs):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    assert metrics.counter("kv.admit_blocked") > blocked0
+    pool.assert_drained()
+
+
+def test_paged_engine_rejects_and_hints_retry():
+    """Queue overflow answers the DynamicBatcher backpressure contract:
+    QueueFullError with a jittered load-scaled retry_after_s."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    with dg.guard():
+        m = _tiny_gpt()
+        eng = ContinuousBatchingEngine(
+            m, max_slots=1, max_queue=0,
+            kv_pool=PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                                page_tokens=4, num_pages=4)).start()
+        try:
+            hints = []
+            for _ in range(6):
+                with pytest.raises(QueueFullError) as ei:
+                    eng.submit([2, 3], max_length=4)
+                assert ei.value.http_status == 503
+                hints.append(ei.value.retry_after_s)
+        finally:
+            eng.stop()
+    assert all(h > 0 for h in hints)
+    assert len(set(hints)) > 1              # jittered, not a constant
+    # context guard: the pool's reach, not max_position, is the limit
+    with dg.guard():
+        m = _tiny_gpt()
+        eng = ContinuousBatchingEngine(
+            m, max_slots=1,
+            kv_pool=PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                                page_tokens=4, num_pages=2))
+        with pytest.raises(ValueError, match="max_context"):
+            eng.submit(list(range(2, 12)), max_length=10)  # 20 > 8
+
+
+def test_queue_expiry_of_never_fitting_request():
+    """_admit_locked expires a queued request whose page demand no pool
+    state could ever satisfy (reachable only if the pool shrank after
+    submit) instead of letting it camp until its deadline."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving.generation import GenerationRequest
+    with dg.guard():
+        m = _tiny_gpt()
+        pool = PagedKVPool(num_layers=1, num_heads=2, head_dim=8,
+                           page_tokens=4, num_pages=8)
+        eng = ContinuousBatchingEngine(m, max_slots=1, kv_pool=pool)
+        req = GenerationRequest(np.asarray([2, 3], np.int64), 4,
+                                "greedy_search", 0, 1.0, 0, 30.0)
+        eng._queue.append(req)
+        pool.num_pages = 1                  # pool "shrank" under it
+        with eng._mu:
+            pending = eng._admit_locked()
+        assert pending == []
+        with pytest.raises(ValueError, match="never fit"):
+            req.future.result(timeout=0)
+
+
+def test_engine_rejects_mismatched_pool_geometry():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    with dg.guard():
+        m = _tiny_gpt()
+        bad = PagedKVPool(num_layers=3, num_heads=2, head_dim=8,
+                          page_tokens=4, num_pages=4)
+        with pytest.raises(ValueError, match="geometry"):
+            ContinuousBatchingEngine(m, kv_pool=bad)
+        with pytest.raises(ValueError, match="kv_pool"):
+            ContinuousBatchingEngine(m, kv_pool=7)
+
+
+def test_paged_metrics_reach_prometheus_exposition():
+    """kv.pages_* gauges and the admission counters surface through
+    core.monitor.prometheus_text — the autoscaler's scrape."""
+    from paddle_tpu.core.monitor import prometheus_text
+    pool = _pool(pages=8)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(2, 30, (4,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 4, 4)
+    t = pool.open_sequence(prompt, k, v)
+    text = prometheus_text()
+    for name in ("serving_kv_pages_total", "serving_kv_pages_free",
+                 "serving_kv_pages_shared"):
+        assert name in text, f"{name} missing from exposition"
+    pool.close_sequence(t)
+    pool.assert_drained()
+
+
+def test_server_stats_include_pool(tmp_path):
+    """/stats carries the pool occupancy block and /metrics the kv
+    gauges when a paged generator is attached."""
+    import json
+    import urllib.request
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.inference.server import InferenceServer
+    from paddle_tpu.static import page_budget
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import serve_smoke
+    model_dir = str(tmp_path / "m")
+    serve_smoke.save_tiny_model(model_dir)
+    with dg.guard():
+        m = _tiny_gpt()
+        m.eval()
+        plan = page_budget(m, page_tokens=4,
+                           hbm_bytes=2 * 1024 * 1024)
+        srv = InferenceServer(model_dir, generator=m, gen_kv_pool=plan,
+                              gen_slots=2)
+        srv.start()
+        try:
+            base = f"http://{srv.host}:{srv.port}"
+            body = json.dumps({"input_ids": [[2, 3, 4]],
+                               "max_length": 4}).encode()
+            req = urllib.request.Request(
+                base + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            out = json.loads(urllib.request.urlopen(req, timeout=60)
+                             .read())
+            assert out["output_ids"] and len(out["output_ids"][0]) >= 4
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=10).read())
+            kvs = stats["kv_pool"]
+            assert kvs["pages_total"] == plan["pages"]
+            assert kvs["pages_free"] == plan["pages"]   # drained
+            text = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            assert "serving_kv_pages_total" in text
+            assert "serving_gen_queue_depth" in text
+        finally:
+            srv.stop()
+        srv.engine.kv_pool.assert_drained()

@@ -1,0 +1,158 @@
+"""Fast CPU paged-KV gate: planner-sized pool, COW prefix sharing,
+token-equal paged decode, zero post-warmup retraces.
+
+The cheap canary for the serving tier's paged KV cache
+(tests/test_page_smoke.py runs it as a tier-1 test, mirroring
+mem_smoke/serve_smoke): sizes a ``PagedKVPool`` with
+``static.page_budget`` (the HBM-walker path — never a hand-set page
+count), then asserts the contracts the paged engine rests on:
+
+  * the pool allocates exactly the planner-chosen budget and
+    ``budget_drift`` re-derives it clean (V504-style detectability);
+  * two live prompts sharing a head occupy FEWER pages than 2x solo
+    (refcounted prefix pages), and a decode write into a shared page
+    copies first (COW isolation);
+  * greedy decode through the paged ContinuousBatchingEngine is
+    token-equal to per-sequence ``generate()`` across admit/retire
+    churn;
+  * the padded KV-length buckets the model compiles against stop
+    growing after warmup (paging must not leak page structure into
+    compiled shapes), and the drained pool holds zero pages.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/page_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the planner budget the gate sizes against: small enough that the pool
+# slab is a few hundred KB of host numpy, big enough for the churn run
+SMOKE_HBM_BYTES = 4 * 1024 * 1024
+
+
+def run_smoke():
+    """Run the gate; returns the result dict (AssertionError on any
+    paged-KV contract regression)."""
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import (ContinuousBatchingEngine, PagedKVPool,
+                                    budget_drift)
+    from paddle_tpu.static import page_budget
+
+    t0 = time.time()
+    rng = np.random.RandomState(11)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                        num_heads=2, max_position=64, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+
+        # -- planner-sized pool: budget chosen by the HBM walker path --
+        plan = page_budget(m, page_tokens=4,
+                           hbm_bytes=SMOKE_HBM_BYTES)
+        pool = PagedKVPool.from_plan(plan)
+        assert pool.num_pages == plan["pages"], \
+            f"pool ignored the plan: {pool.num_pages} != {plan['pages']}"
+        assert pool.k.nbytes + pool.v.nbytes == plan["kv_bytes"], \
+            "allocated slab disagrees with the plan's kv_bytes"
+        drift = budget_drift(pool, m)
+        assert drift == [], f"fresh plan-built pool drifts: {drift}"
+
+        # -- prefix sharing: two sharers < 2x solo ---------------------
+        head = rng.randint(2, 48, (8,)).astype(np.int64)  # 2 full pages
+        pa = np.concatenate([head, [3]])
+        pb = np.concatenate([head, [5]])
+        solo = pool.pages_needed(pa.size)
+        L, H = plan["num_layers"], plan["num_heads"]
+        k = rng.randn(L, H, pa.size, plan["head_dim"]).astype(np.float32)
+        v = rng.randn(L, H, pa.size, plan["head_dim"]).astype(np.float32)
+        ta = pool.open_sequence(pa, k, v)
+        tb = pool.open_sequence(pb, k, v)
+        shared_used = pool.num_pages - pool.pages_free
+        assert shared_used < 2 * solo, \
+            f"sharing saved nothing: {shared_used} pages for 2 prompts " \
+            f"vs {solo} solo"
+        prefix_hits = pool.prefix_hits
+        assert prefix_hits == 2, f"expected 2 head-page hits, " \
+                                 f"got {prefix_hits}"
+        # COW: an IDENTICAL prompt shares every page including the
+        # partial tail page; its first decode write must copy that page,
+        # leaving ta's view bitwise intact
+        tc = pool.open_sequence(pa, k, v)
+        assert pool.prefix_hits == prefix_hits + 3
+        col = rng.randn(L, H, plan["head_dim"]).astype(np.float32)
+        pool.append_column(tc, col, col)
+        assert pool.cow_copies == 1, "shared-page write did not copy"
+        ka, _ = pool.gather(ta)
+        np.testing.assert_array_equal(ka, k)
+        pool.close_sequence(ta)
+        pool.close_sequence(tb)
+        pool.close_sequence(tc)
+        pool.assert_drained()
+
+        # -- token-equal paged decode across admit/retire churn --------
+        prompts = [rng.randint(2, 48, (n,)).astype(np.int64)
+                   for n in (3, 6, 2)]
+        prompts += [np.concatenate([head, [7]]),
+                    np.concatenate([head, [9]])]
+        refs = [np.asarray(m.generate(p[None], max_length=5,
+                                      decode_strategy="greedy_search")[0])
+                for p in prompts]
+        eng = ContinuousBatchingEngine(m, max_slots=2,
+                                       kv_pool=pool).start()
+        try:
+            # warmup: one request exercises the prefill/decode buckets
+            eng.submit(prompts[0], max_length=5).result(timeout=60)
+            warm_buckets = eng.kv_buckets
+            futs = [eng.submit(p, max_length=5) for p in prompts]
+            outs = [np.asarray(f.result(timeout=60)) for f in futs]
+            buckets_after = eng.kv_buckets
+        finally:
+            eng.stop()
+        for ref, out in zip(refs, outs):
+            np.testing.assert_array_equal(ref, out)
+        retraces = buckets_after - warm_buckets
+        assert retraces == 0, \
+            f"{retraces} new compiled KV buckets after warmup — paging " \
+            f"leaked page structure into compiled shapes"
+        pool.assert_drained()               # zero pages leaked post-drain
+
+    wall = time.time() - t0
+    result = {
+        "metric": "page_smoke_wall_s",
+        "value": round(wall, 2),
+        "unit": "s",
+        "pages": plan["pages"],
+        "page_tokens": plan["page_tokens"],
+        "max_slots": plan["max_slots"],
+        "max_context": plan["max_context"],
+        "kv_bytes": plan["kv_bytes"],
+        "solo_pages": solo,
+        "shared_pages_for_two": shared_used,
+        "prefix_hits": prefix_hits,
+        "cow_copies": 1,
+        "sequences_token_equal": len(prompts),
+        "traces_after_warmup": retraces,
+    }
+    return result
+
+
+def main():
+    result = run_smoke()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
