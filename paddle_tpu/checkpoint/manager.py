@@ -395,52 +395,74 @@ class CheckpointManager:
 
         Strictly THIS rank's manifest: falling back to rank 0's would
         silently restore rank-0's parameter shard as this host's state —
-        a missing rank manifest makes the step invalid here instead."""
-        path = os.path.join(self.step_dir(step), self._manifest_name())
-        try:
-            with open(path) as f:
-                manifest = json.load(f)
-        except (OSError, ValueError):
-            return None
-        if manifest.get("format") != FORMAT_VERSION:
-            return None
-        sizes = {}
-        for meta in manifest.get("tensors", {}).values():
-            shard = os.path.join(self.step_dir(step), meta["shard"])
-            if shard not in sizes:
-                try:
-                    sizes[shard] = os.path.getsize(shard)
-                except OSError:
-                    return None
-            if meta["offset"] + meta["nbytes"] > sizes[shard]:
-                return None  # truncated shard
-        return manifest
+        a missing rank manifest makes the step invalid here instead.
+        (One screen implementation for load() and load_merged():
+        delegates to `_rank_manifest`.)"""
+        return self._rank_manifest(step, self.rank)
 
     # -- load ---------------------------------------------------------------
-    def load(self, step: Optional[int] = None) -> Optional[Checkpoint]:
+    def load(self, step: Optional[int] = None,
+             on_mismatch: str = "convert") -> Optional[Checkpoint]:
         """Restore the newest valid checkpoint (or exactly `step`).
 
         Every tensor is CRC-verified against the manifest; a corrupt or
         truncated checkpoint is never returned — with `step=None` the
         manager warns and falls back to the previous valid step, with an
-        explicit `step` it raises CheckpointError."""
+        explicit `step` it raises CheckpointError.
+
+        ``on_mismatch`` governs a WORLD-SIZE mismatch at the storage
+        layer (the checkpoint was written by a different rank count —
+        the cross-host elastic re-form path, docs/elastic.md):
+
+          * ``"convert"`` (default) routes the step through
+            `load_merged`: every writer rank's shard manifest is read
+            and reassembled into one rank-complete state;
+          * ``"error"`` raises `CheckpointError` naming both worlds;
+          * ``"warn"`` restores the old behaviour — read only THIS
+            rank's shard and warn that vanished ranks' state is lost.
+        """
+        if on_mismatch not in ("convert", "error", "warn"):
+            raise ValueError(
+                f"on_mismatch must be 'convert', 'error' or 'warn', "
+                f"got {on_mismatch!r}")
         if step is not None:
             manifest = self._screen(step)
             if manifest is None:
+                if on_mismatch == "convert" and \
+                        self._foreign_world(step) is not None:
+                    # a GROWN world: this rank has no shard of its own
+                    # in the old layout, but the merged state serves it
+                    return self.load_merged(step=step)
                 raise CheckpointError(
                     f"checkpoint {self.step_dir(step)} is missing, "
                     "incomplete, or truncated")
-            return self._read(step, manifest)
+            return self._read(step, manifest, on_mismatch=on_mismatch)
         for cand in reversed(self.all_steps()):
             manifest = self._screen(cand)
             if manifest is None:
+                if on_mismatch == "convert" and \
+                        self._foreign_world(cand) is not None:
+                    try:
+                        return self.load_merged(step=cand)
+                    except CheckpointError as e:
+                        self._fallback_warn(cand, str(e))
+                        continue
                 self._fallback_warn(cand, "incomplete or truncated")
                 continue
             try:
-                return self._read(cand, manifest)
+                return self._read(cand, manifest, on_mismatch=on_mismatch)
             except CheckpointError as e:
                 self._fallback_warn(cand, str(e))
         return None
+
+    def _foreign_world(self, step: int) -> Optional[int]:
+        """The step's writer world size when it DIFFERS from this
+        manager's (screened via the rank-0 manifest), else None."""
+        man0 = self._rank_manifest(step, 0)
+        if man0 is None:
+            return None
+        saved = int(man0.get("world_size", 1))
+        return saved if saved != self.world_size else None
 
     def _fallback_warn(self, step: int, why: str) -> None:
         stat_add("checkpoint.load_fallbacks")
@@ -449,22 +471,36 @@ class CheckpointManager:
             "falling back to the previous valid step", RuntimeWarning,
             stacklevel=3)
 
-    def _read(self, step: int, manifest: dict) -> Checkpoint:
+    def _read(self, step: int, manifest: dict,
+              on_mismatch: str = "convert") -> Checkpoint:
         saved_world = int(manifest.get("world_size", 1))
         if saved_world != self.world_size:
             # topology shift at the storage layer: this manager's rank
-            # layout differs from the writer's.  Rank-private shards from
-            # vanished ranks are NOT merged here (single-host state is
-            # rank-complete; multi-host rank-merged load is a ROADMAP
-            # follow-up) — surface it instead of silently reading a
-            # same-named shard with different contents.
+            # layout differs from the writer's
+            if on_mismatch == "convert":
+                return self.load_merged(step=step)
+            if on_mismatch == "error":
+                raise CheckpointError(
+                    f"checkpoint step {step} was written by a world of "
+                    f"{saved_world} ranks but is being loaded by a "
+                    f"world of {self.world_size} ranks "
+                    f"(on_mismatch='error'; pass on_mismatch='convert' "
+                    f"for the rank-merged restore, docs/elastic.md)")
             warnings.warn(
                 f"checkpoint step {step} was written by a world of "
                 f"{saved_world} ranks but is being loaded by a world of "
                 f"{self.world_size}; rank-private shards of vanished "
-                "ranks are not merged — topology-shifted restore "
-                "converts replicated/global state only (docs/elastic.md)",
+                "ranks are NOT merged under on_mismatch='warn' — pass "
+                "on_mismatch='convert' (or call load_merged) for the "
+                "rank-merged restore (docs/elastic.md)",
                 RuntimeWarning, stacklevel=3)
+        state = self._read_state(step, manifest)
+        return Checkpoint(step=int(manifest["step"]), state=state,
+                          extra=dict(manifest.get("extra", {})))
+
+    def _read_state(self, step: int, manifest: dict) \
+            -> Dict[str, np.ndarray]:
+        """CRC-verified tensor read of one rank's manifest+shard."""
         state: Dict[str, np.ndarray] = {}
         by_shard: Dict[str, List[tuple]] = {}
         for name, meta in manifest["tensors"].items():
@@ -491,8 +527,127 @@ class CheckpointManager:
                         buf, dtype=np.dtype(meta["vdtype"])).copy()
                     state[name] = decode_tensor(
                         view.reshape(meta["shape"]), meta["dtype"])
-        return Checkpoint(step=int(manifest["step"]), state=state,
-                          extra=dict(manifest.get("extra", {})))
+        return state
+
+    # -- rank-merged load (cross-host world change, docs/elastic.md) --------
+    def _rank_manifest(self, step: int, rank: int) -> Optional[dict]:
+        """Parse + size-screen an EXPLICIT rank's manifest of `step`
+        (same validity screen as `_screen`, which covers only this
+        manager's own rank)."""
+        path = os.path.join(self.step_dir(step), self._manifest_name(rank))
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if manifest.get("format") != FORMAT_VERSION:
+            return None
+        sizes: Dict[str, int] = {}
+        for meta in manifest.get("tensors", {}).values():
+            shard = os.path.join(self.step_dir(step), meta["shard"])
+            if shard not in sizes:
+                try:
+                    sizes[shard] = os.path.getsize(shard)
+                except OSError:
+                    return None
+            if meta["offset"] + meta["nbytes"] > sizes[shard]:
+                return None
+        return manifest
+
+    def load_merged(self, step: Optional[int] = None,
+                    world: Optional[int] = None) -> Optional[Checkpoint]:
+        """Rank-merged restore: read EVERY writer rank's shard manifest
+        of `step` (the writer world comes from the rank-0 manifest) and
+        reassemble one rank-complete global state — the load path for a
+        host-count change, where this manager's rank layout no longer
+        matches the checkpoint's (fleet re-form, docs/elastic.md).
+
+        Merge rules, per tensor name:
+
+          * present in ONE rank — rank-private state, carried through;
+          * present in SEVERAL ranks, bitwise identical — replicated
+            state (the elastic fold guarantees per-host state is
+            rank-complete and identical), one copy carried;
+          * present in several ranks and DIFFERING — the hosts diverged;
+            refused with `CheckpointError` (silently picking one would
+            launder corruption into a resume).
+
+        ``world``: the dp degree of the world that will CONSUME the
+        merged state.  When the checkpoint records a ZeRO
+        ``zero_shard_plan`` whose dp_degree differs, the bucketed
+        layout is routed through ``sharding.unshard_state`` to the
+        plain per-param layout (bucket padding is world-dependent, so
+        the old bucket arrays cannot be re-fed directly) and the plan
+        is dropped from the sidecar; `Executor.restore_from_checkpoint`
+        then re-shards for the target program's own plan
+        (``reshard_state``) — the unshard→reshard conversion pair that
+        already carries single-host shard-count changes.
+
+        With ``step=None`` walks committed steps newest-first and falls
+        back past unmergeable ones, like `load`."""
+        if step is None:
+            for cand in reversed(self.all_steps()):
+                try:
+                    return self.load_merged(step=cand, world=world)
+                except CheckpointError as e:
+                    self._fallback_warn(cand, str(e))
+            return None
+        man0 = self._rank_manifest(step, 0)
+        if man0 is None:
+            raise CheckpointError(
+                f"checkpoint {self.step_dir(step)} has no valid rank-0 "
+                "manifest — nothing to merge")
+        saved_world = int(man0.get("world_size", 1))
+        state: Dict[str, np.ndarray] = {}
+        owner: Dict[str, int] = {}
+        conflicts: List[str] = []
+        for rank in range(saved_world):
+            man = man0 if rank == 0 else self._rank_manifest(step, rank)
+            if man is None:
+                raise CheckpointError(
+                    f"rank-merged load of step {step}: rank {rank} of "
+                    f"the writing world ({saved_world}) has a missing "
+                    "or truncated manifest/shard")
+            for name, arr in self._read_state(step, man).items():
+                prev = state.get(name)
+                if prev is None:
+                    state[name] = arr
+                    owner[name] = rank
+                elif prev.shape != arr.shape or prev.dtype != arr.dtype \
+                        or not np.array_equal(prev, arr):
+                    conflicts.append(
+                        f"{name!r} (rank {owner[name]} vs rank {rank})")
+        if conflicts:
+            raise CheckpointError(
+                f"rank-merged load of step {step}: {len(conflicts)} "
+                f"tensor(s) differ between writer ranks — the hosts "
+                f"diverged and no merge is sound: "
+                f"{conflicts[:6]}{'...' if len(conflicts) > 6 else ''}")
+        extra = dict(man0.get("extra", {}))
+        extra["merged_from_world"] = saved_world
+        plan = extra.get("zero_shard_plan")
+        if plan and world and int(world) != int(plan.get("dp_degree", 1)):
+            # bucket padding is a function of the dp degree, so the old
+            # world's bucket arrays cannot feed the new world's program;
+            # unshard to the plain per-param layout here and let the
+            # executor's topology-shift conversion re-shard for the
+            # target program's own recorded plan
+            from ..distributed.sharding import unshard_state
+            state = unshard_state(state, plan)
+            extra.pop("zero_shard_plan", None)
+            extra.pop("dp_degree", None)
+            warnings.warn(
+                f"rank-merged load: ZeRO layout recorded for dp="
+                f"{plan.get('dp_degree')} unsharded to the plain layout "
+                f"for the new world of {world} (restore re-shards "
+                "against the target program's plan)", RuntimeWarning,
+                stacklevel=2)
+        stat_add("checkpoint.merged_loads")
+        from ..observability.journal import emit as _jemit
+        _jemit("restore_merged", step=int(man0["step"]),
+               merged_from_world=saved_world, world=self.world_size)
+        return Checkpoint(step=int(man0["step"]), state=state,
+                          extra=extra)
 
     # -- multi-host pending recovery ----------------------------------------
     def _prune_stale_pending(self) -> None:

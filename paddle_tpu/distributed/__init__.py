@@ -29,6 +29,11 @@ from .elastic import (  # noqa: F401
     elasticize, rebucket_feeds, rederive_schedule, reanchor_topology,
     elastic_meta, micro_steps_per_global,
 )
+from .fleet_control import (  # noqa: F401
+    FleetController, FleetBarrier, FleetCommit, fleet_env, fleet_rank,
+    fleet_world_size, newest_mutual_checkpoint_step,
+)
+from . import fleet_control  # noqa: F401
 from .dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, QueueDataset, MultiSlotDataFeed,
 )

@@ -253,6 +253,9 @@ class CompiledProgram:
         self._cache: Dict[Any, Any] = {}
         self._mesh: Optional[Mesh] = None
         self._rewritten: Optional[Program] = None
+        # device dispatches issued (one per _run, one per _run_steps
+        # scan — the number the elastic run_steps K→1 claim is about)
+        self._dispatches = 0
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -356,6 +359,40 @@ class CompiledProgram:
             self._rewritten = rewritten
         return self._rewritten
 
+    def _anchor_elastic(self, executor, scope, elastic, n_dev) -> int:
+        """Resolve K for THIS mesh and re-anchor a topology-shifted
+        restore's counters against it; returns micro_k."""
+        n_logical = int(elastic["logical_dp"])
+        if n_logical % n_dev != 0:
+            raise ValueError(
+                f"elastic logical_dp={n_logical} is not divisible by "
+                f"the mesh world {n_dev}")
+        micro_k = n_logical // n_dev
+        # topology-shifted resume: restore_from_checkpoint left the
+        # schedule position in GLOBAL steps (it cannot know the new
+        # mesh); re-anchor the executor's micro-step counter for THIS
+        # world before deriving seeds from it
+        rebase = getattr(executor, "_elastic_rebase_global", None)
+        if rebase is not None:
+            from ..observability.journal import emit as _jemit
+            _jemit("reanchor", world=int(n_dev), k=int(micro_k),
+                   global_step=int(rebase))
+            executor._step = int(rebase) * micro_k
+            executor._elastic_steps = int(rebase) * micro_k
+            # the restore re-derived the persistable micro counter
+            # for its best-guess default world; THIS mesh is the
+            # authority — re-anchor it too, or the commit mask and
+            # per-rank RNG phase run at the wrong K (e.g. restore on
+            # an 8-device host, then places=4: counter g vs step
+            # g*2 would commit after ONE half-folded micro-step)
+            scope.set(elastic["counter"],
+                      jnp.array(np.full((1,), int(rebase) * micro_k,
+                                        np.int32)))
+            executor._elastic_rebase_global = None
+        executor._last_elastic_world = n_dev
+        executor._last_elastic_k = micro_k
+        return micro_k
+
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         from ..static.executor import (global_scope, BlockTracer,
                                        _persistable_names)
@@ -371,35 +408,7 @@ class CompiledProgram:
         elastic = getattr(program, "_elastic_meta", None)
         micro_k = 1
         if elastic is not None:
-            n_logical = int(elastic["logical_dp"])
-            if n_logical % n_dev != 0:
-                raise ValueError(
-                    f"elastic logical_dp={n_logical} is not divisible by "
-                    f"the mesh world {n_dev}")
-            micro_k = n_logical // n_dev
-            # topology-shifted resume: restore_from_checkpoint left the
-            # schedule position in GLOBAL steps (it cannot know the new
-            # mesh); re-anchor the executor's micro-step counter for THIS
-            # world before deriving seeds from it
-            rebase = getattr(executor, "_elastic_rebase_global", None)
-            if rebase is not None:
-                from ..observability.journal import emit as _jemit
-                _jemit("reanchor", world=int(n_dev), k=int(micro_k),
-                       global_step=int(rebase))
-                executor._step = int(rebase) * micro_k
-                executor._elastic_steps = int(rebase) * micro_k
-                # the restore re-derived the persistable micro counter
-                # for its best-guess default world; THIS mesh is the
-                # authority — re-anchor it too, or the commit mask and
-                # per-rank RNG phase run at the wrong K (e.g. restore on
-                # an 8-device host, then places=4: counter g vs step
-                # g*2 would commit after ONE half-folded micro-step)
-                scope.set(elastic["counter"],
-                          jnp.array(np.full((1,), int(rebase) * micro_k,
-                                            np.int32)))
-                executor._elastic_rebase_global = None
-            executor._last_elastic_world = n_dev
-            executor._last_elastic_k = micro_k
+            micro_k = self._anchor_elastic(executor, scope, elastic, n_dev)
 
         # pre-placed feeds (reader.Prefetcher via place_feed) pass through;
         # host arrays take the synchronous conversion
@@ -452,6 +461,7 @@ class CompiledProgram:
         else:
             seed = executor._seed_for_step(program)
         fetches, new_state = fn(state, feed_vals, jnp.uint32(seed))
+        self._dispatches += 1
         executor._step += 1
         if elastic is not None:
             executor._elastic_steps += 1
@@ -489,9 +499,162 @@ class CompiledProgram:
             out[n] = jax.device_put(a, NamedSharding(mesh, spec))
         return out
 
-    def _compile(self, program, state_names, feed_names, fetch_names, mesh):
-        from ..static.executor import BlockTracer
+    def _run_steps(self, executor, feed, fetch_list, scope, return_numpy):
+        """K steps in ONE device dispatch (Executor.run_steps contract)
+        over the sharded mesh: the traced step `lax.scan`s over the
+        stacked feeds' leading axis with the persistable state carried
+        on device.
+
+        For an elastic program this is the dispatch-collapse the
+        ROADMAP names: a global step is K = logical_dp/world
+        micro-steps, and driving them through run() pays K host
+        dispatch round-trips per global step; feeding the K re-bucketed
+        micro-feeds stacked ([K, M·b, ...]) runs the whole commit
+        window as ONE device call, bitwise-equal to the looped form
+        (same traced step, same per-window seed derivation — the
+        per-micro-step RNG phase comes from the persistable counter
+        carried through the scan)."""
+        from ..static.executor import global_scope, _persistable_names
+        scope = scope or global_scope()
+        feed = feed or {}
+        if not feed:
+            raise ValueError(
+                "run_steps needs at least one stacked feed to define "
+                "the number of steps")
+        fetch_names = [f.name if hasattr(f, "name") else str(f)
+                       for f in (fetch_list or [])]
+        program = self._get_program()
+        mesh = self._get_mesh()
+        if set(mesh.axis_names) - {"dp"}:
+            raise NotImplementedError(
+                "run_steps through CompiledProgram supports pure-dp "
+                "meshes only (sequence/tensor parallel degrees must "
+                "be 1)")
+        n_dev = len(mesh.devices.flat)
+        elastic = getattr(program, "_elastic_meta", None)
+        micro_k = 1
+        if elastic is not None:
+            micro_k = self._anchor_elastic(executor, scope, elastic,
+                                           n_dev)
+        feed_vals = {n: v if isinstance(v, jax.Array) else jnp.asarray(v)
+                     for n, v in feed.items()}
+        k = None
+        for n, v in feed_vals.items():
+            shape = tuple(getattr(v, "shape", ()))
+            if len(shape) == 0:
+                raise ValueError(
+                    f"run_steps feed {n!r} is a scalar; every feed "
+                    "needs a leading steps axis")
+            k = shape[0] if k is None else k
+            if shape[0] != k:
+                raise ValueError(
+                    f"feed {n!r} leading (steps) dim {shape[0]} != {k}")
+        k = int(k)
+        state_names = [n for n in _persistable_names(program)
+                       if scope.get(n) is not None]
+        feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                                for n, v in feed_vals.items()))
+        key = ("steps", program.fingerprint(), feed_sig,
+               tuple(fetch_names), tuple(state_names), n_dev,
+               getattr(self._build_strategy, "fetch_aggregation",
+                       "reduce"))
+        from ..core import compile_cache as _ccache
+        fn = self._cache.get(key)
+        if fn is None:
+            from ..static.verifier import verify_first_compile
+            verify_first_compile(program, fetch_list=fetch_names)
+            _ccache.record_miss()
+            _ccache.record_trace()
+            from ..observability.journal import emit as _jemit
+            _jemit("compile", mode="compiled_steps", world=int(n_dev),
+                   fingerprint=str(key[1])[:16])
+            fn = self._compile_steps(program, state_names, feed_vals,
+                                     fetch_names, mesh)
+            self._cache[key] = fn
+        else:
+            _ccache.record_hit()
+        from ..testing import chaos as _chaos
+        if _chaos.enabled():
+            if getattr(program, "_chaos_is_training", None) is None:
+                from ..static.executor import _is_training
+                program._chaos_is_training = _is_training(program)
+            if program._chaos_is_training:
+                _chaos.collective_hook(executor._train_runs + 1)
+        state = {n: scope.get(n) for n in state_names}
+        if elastic is not None:
+            # one RNG stream per GLOBAL step, same derivation as K
+            # looped _run calls would walk (scanned micro-step i of
+            # this window belongs to global step
+            # (elastic_steps + i) // K)
+            base = int(program.random_seed) * 1000003
+            seeds = jnp.asarray(
+                [(base + (executor._elastic_steps + i) // micro_k)
+                 % (2 ** 31) for i in range(k)], jnp.uint32)
+        else:
+            seeds = jnp.asarray(
+                [executor._seed_for_step(program) + i for i in range(k)],
+                jnp.uint32)
+        fetches, new_state = fn(state, feed_vals, seeds)
+        self._dispatches += 1
+        executor._step += k
+        if elastic is not None:
+            executor._elastic_steps += k
+        for n, v in new_state.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _compile_steps(self, program, state_names, feed_vals,
+                       fetch_names, mesh):
+        """jit(shard_map(scan(step))): the scanned sibling of _compile
+        (pure-dp meshes; feeds carry [K, per-step...] with the per-step
+        batch on axis 1)."""
         from ..utils.shard_map_compat import shard_map_unchecked
+        from .partition_spec import state_partition_specs
+        step = self._traced_step(program, state_names, fetch_names, mesh)
+        dp = mesh.shape["dp"]
+
+        def body(state, xs):
+            feed, seed = xs
+            fetches, new_state = step(state, feed, seed)
+            return new_state, fetches
+
+        def multi(state, feeds, seeds):
+            new_state, fetches = jax.lax.scan(body, state, (feeds, seeds))
+            return fetches, new_state
+
+        state_specs = state_partition_specs(program, mesh, state_names)
+        feed_specs = {}
+        for n, v in feed_vals.items():
+            shape = tuple(getattr(v, "shape", ()))
+            # steps axis never shards; the per-step batch (axis 1)
+            # shards over dp like the looped path's P("dp").  A
+            # non-divisible batch must FAIL here like it does there —
+            # silently replicating it would run every rank over the
+            # full batch with a different summation order, breaking
+            # the bitwise-to-looped contract
+            if len(shape) >= 2:
+                if shape[1] % dp != 0:
+                    raise ValueError(
+                        f"run_steps feed {n!r} per-step batch "
+                        f"{shape[1]} does not divide the dp world "
+                        f"{dp} (stacked feeds shard axis 1 over dp, "
+                        "like run() shards axis 0)")
+                feed_specs[n] = P(None, "dp")
+            else:
+                feed_specs[n] = P(None)  # [K] per-step scalars
+        fetch_specs = tuple(P() for _ in fetch_names)
+        sharded = shard_map_unchecked(
+            multi, mesh, in_specs=(state_specs, feed_specs, P()),
+            out_specs=(fetch_specs, state_specs))
+        return jax.jit(sharded, donate_argnums=(0,))
+
+    def _traced_step(self, program, state_names, fetch_names, mesh):
+        """The single traced (state, feed, seed) -> (fetches, state')
+        step both the per-dispatch (`_compile`) and scanned
+        (`_compile_steps`) paths wrap in shard_map."""
+        from ..static.executor import BlockTracer
         block = program.global_block()
         tracer = BlockTracer(block)
         axes = tuple(mesh.axis_names)
@@ -605,6 +768,16 @@ class CompiledProgram:
                     v = jax.lax.pmax(v, axes)
                 fetches.append(v)
             return tuple(fetches), new_state
+
+        return step
+
+    def _compile(self, program, state_names, feed_names, fetch_names, mesh):
+        from ..utils.shard_map_compat import shard_map_unchecked
+        block = program.global_block()
+        axes = tuple(mesh.axis_names)
+        has_sp = "sp" in axes
+        has_tp = "tp" in axes
+        step = self._traced_step(program, state_names, fetch_names, mesh)
 
         # ZeRO sharded buckets (distributed/sharding.py stages 1-3:
         # optimizer slots, gradient-merge shard accumulators, stage-3

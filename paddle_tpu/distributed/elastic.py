@@ -42,10 +42,24 @@ psum-ing it — (M-1)·|g| bytes vs allreduce's 2(M-1)/M·|g|.  Elastic mode
 trades up to ~M/2× gradient wire volume for topology invariance; the
 plain (non-elastic) path is untouched.
 
-(3) — ZeRO layout conversion — is handled at restore time by
-``Executor.restore_from_checkpoint`` routing state through
+(3) — ZeRO — composes two ways.  A stage-1 ``shard_optimizer_states``
+program elasticizes directly: each bucket's reduce-scattered 1/N
+gradient SHARD is folded into a ``dp_shard`` window accumulator
+(``c_elastic_fold`` with ``pre_reduced=True`` — no full-size gather,
+allreduce-cost wire), the per-micro-step 1/M scale is replaced by one
+exact pow2 1/N at commit, and the masked optimizer commit covers the
+bucket update + publish.  The reduce-scatter's summation order is
+implementation-defined, so THIS composition's cross-topology contract
+is allclose (1e-6), not bitwise; same-world kill/resume stays bitwise.
+Checkpoint layout conversion across shard counts is still handled at
+restore by ``Executor.restore_from_checkpoint`` routing state through
 ``sharding.unshard_state`` → ``sharding.reshard_state`` (see
-docs/elastic.md).
+docs/elastic.md).  Stages 2/3 refuse (chains interleave into backward).
+
+run_steps: an elastic program driven through
+``Executor.run_steps(CompiledProgram(...), feed=stacked_micro_feeds)``
+scans the whole K-micro-step commit window in ONE device dispatch,
+bitwise-equal to the looped form (compiled_program._run_steps).
 """
 from __future__ import annotations
 
@@ -101,12 +115,18 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     if elastic_meta(program) is not None or has_applied(program, "elastic"):
         raise ValueError("elasticize already applied to this program")
     plan = getattr(program, "_zero_shard_plan", None)
-    if (plan is not None and getattr(plan, "buckets", None)) or \
-            has_applied(program, "zero1_sharding"):
+    if plan is not None and not getattr(plan, "buckets", None):
+        plan = None
+    if plan is None and has_applied(program, "zero1_sharding"):
+        raise ValueError(
+            "elasticize: program carries a zero1_sharding registry "
+            "entry but no recorded ShardingPlan — cannot locate the "
+            "bucket chains to fold")
+    if plan is not None and int(getattr(plan, "stage", 1)) >= 2:
         raise NotImplementedError(
-            "elasticize does not compose with shard_optimizer_states "
-            "(ZeRO-1) yet — ZeRO topology shifts are handled by "
-            "checkpoint layout conversion at restore instead "
+            "elasticize composes with ZeRO stage 1 only: stages 2/3 "
+            "interleave their bucket chains into backward, where the "
+            "elastic window accumulation is not defined yet "
             "(docs/elastic.md)")
     if getattr(program, "_gm_meta", None) is not None or \
             has_applied(program, "gradient_merge"):
@@ -150,7 +170,8 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
         {"Out": [mask]}, {"ring_id": 0, "logical_dp": n})
 
     acc_names: List[str] = []
-    resets: List[tuple] = []  # (acc, folded) pairs to reset on commit
+    # (acc, folded, sharded) triples to reset on commit
+    resets: List[tuple] = []
 
     def _fold(src_name, like_var, hint):
         """acc += ordered cross-rank fold of `src_name`; returns the
@@ -164,14 +185,89 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
             {"X": [src_name], "Acc": [acc]}, {"Out": [folded]},
             {"ring_id": 0, "logical_dp": n})
         acc_names.append(acc)
-        resets.append((acc, folded))
+        resets.append((acc, folded, False))
         return folded
+
+    # -- ZeRO-1 composition (stage-1 plans only, gated above) ---------------
+    # The bucket chain (flatten → concat → pad → c_reducescatter) runs
+    # every micro-step and the window accumulates the 1/N reduce-
+    # scattered SHARD into a dp_shard persistable accumulator — 1/world
+    # of the gradient window memory per chip, and no full-size gather.
+    # The chain's per-micro-step `scale_by_world_size` (1/M, a function
+    # of the MESH) is dropped; the commit applies the exact pow2 1/N
+    # once.  The reduce-scatter's cross-rank summation order is
+    # implementation-defined, so this composition's topology-invariance
+    # contract is allclose, not bitwise (docs/elastic.md); same-world
+    # kill/resume stays bitwise.
+    bucket_grads: set = set()
+    drop_scale_ids: set = set()
+    fold_at: Dict[int, tuple] = {}  # anchor op id -> (ops, replaced, committed)
+    if plan is not None:
+        bucket_grads = {p["grad"] for b in plan.buckets
+                        for p in b["params"]}
+        by_bucket: Dict[str, List[OpDesc]] = {}
+        for op in opt_ops:
+            bn = op.attrs.get("zero_bucket")
+            if bn:
+                by_bucket.setdefault(bn, []).append(op)
+        for b in plan.buckets:
+            chain = by_bucket.get(b["name"], [])
+            if not chain:
+                raise ValueError(
+                    f"elasticize: recorded ZeRO bucket {b['name']!r} "
+                    "has no ops in the optimizer tail — plan and "
+                    "program drifted apart")
+            scale_op = next((o for o in chain
+                             if o.type == "scale_by_world_size"), None)
+            if scale_op is not None:
+                drop_scale_ids.add(id(scale_op))
+                fold_src = scale_op.inputs["X"][0]
+                replaced = scale_op.outputs["Out"][0]
+                anchor = scale_op
+            else:
+                fold_src = b["grad_shard"]
+                replaced = b["grad_shard"]
+                anchor = next(o for o in chain
+                              if fold_src in o.output_names())
+            acc = unique_name(b["name"] + "@ELASTIC_ACC")
+            for blk in (block, sblock):
+                v = blk.create_var(name=acc, shape=[b["padded_len"]],
+                                   dtype=b["grad_dtype"],
+                                   persistable=True, stop_gradient=True)
+                v.attrs["dp_shard"] = int(plan.dp_degree)
+            sblock.ops.append(OpDesc(
+                "fill_constant", {}, {"Out": [acc]},
+                {"shape": [b["padded_len"]], "value": 0.0,
+                 "dtype": b["grad_dtype"],
+                 "op_uid": startup._next_uid()}))
+            folded = new_tmp_var(block, like=block.var(acc),
+                                 name_hint=b["name"] + "@ELASTIC_FOLD")
+            committed = new_tmp_var(block, like=block.var(acc),
+                                    name_hint=b["name"] + "@ELASTIC_AVG")
+            emit = [
+                OpDesc("c_elastic_fold",
+                       {"X": [fold_src], "Acc": [acc]},
+                       {"Out": [folded]},
+                       {"ring_id": 0, "logical_dp": n,
+                        "pre_reduced": True,
+                        "op_uid": program._next_uid(),
+                        OpRole.KEY: OpRole.Optimize}),
+                OpDesc("scale", {"X": [folded]}, {"Out": [committed]},
+                       {"scale": 1.0 / n, "bias": 0.0,
+                        "op_uid": program._next_uid(),
+                        OpRole.KEY: OpRole.Optimize}),
+            ]
+            fold_at[id(anchor)] = (emit, replaced, committed)
+            acc_names.append(acc)
+            resets.append((acc, folded, True))
 
     grad_to_committed: Dict[str, str] = {}
     for p, g in pgs:
         gname = g.name if hasattr(g, "name") else str(g)
         if gname in grad_to_committed:
             continue
+        if gname in bucket_grads:
+            continue  # folded at the bucket-shard level instead
         gvar = block.var(gname)
         folded = _fold(gname, gvar, gname)
         committed = new_tmp_var(block, like=gvar,
@@ -196,7 +292,24 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     # `rename` keeps intra-group dataflow on the fresh @MASKED temps
     tail: List[OpDesc] = []
     rename: Dict[str, str] = {}
+
+    def _emit_fold(entry):
+        emit, replaced, committed = entry
+        for fop in emit:
+            # the fold's X is a chain temp the masking loop may have
+            # renamed — read the fresh value, not the stale var
+            for slot, names in fop.inputs.items():
+                fop.inputs[slot] = [rename.get(nm, nm) for nm in names]
+            block.ops.append(fop)
+        rename[replaced] = committed
+
     for op in opt_ops:
+        if id(op) in drop_scale_ids:
+            # the per-micro-step 1/M scale is replaced by the window
+            # fold + exact 1/N commit scale, emitted in its place
+            _emit_fold(fold_at.pop(id(op)))
+            continue
+        entry = fold_at.pop(id(op), None)
         for slot, names in op.inputs.items():
             op.inputs[slot] = [
                 rename.get(grad_to_committed.get(nm, nm),
@@ -204,15 +317,24 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
                 for nm in names]
         retarget_op_outputs_masked(program, op, mask, tail, rename)
         block.ops.append(op)
+        if entry is not None:  # scale-less chain: fold after the rs
+            _emit_fold(entry)
     block.ops.extend(tail)
 
     # accumulators reset on commit so the next window folds from zero
-    for acc, folded in resets:
+    for acc, folded, sharded in resets:
         zeros = new_tmp_var(block, like=block.var(acc),
                             name_hint=acc + "@ZERO")
-        _op(program, block, "fill_constant", {}, {"Out": [zeros]},
-            {"shape": list(block.var(acc).shape or [1]), "value": 0.0,
-             "dtype": block.var(acc).dtype})
+        if sharded:
+            # dp_shard accumulators are declared at the GLOBAL padded
+            # shape but trace their 1/world slice under shard_map —
+            # the zeros must follow the runtime shape
+            _op(program, block, "fill_zeros_like", {"X": [acc]},
+                {"Out": [zeros]}, {"dtype": block.var(acc).dtype})
+        else:
+            _op(program, block, "fill_constant", {}, {"Out": [zeros]},
+                {"shape": list(block.var(acc).shape or [1]), "value": 0.0,
+                 "dtype": block.var(acc).dtype})
         _op(program, block, "where",
             {"Condition": [mask], "X": [zeros], "Y": [folded]},
             {"Out": [acc]})
@@ -220,7 +342,11 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     program._fingerprint_cache = None
     startup._fingerprint_cache = None
     meta = {"logical_dp": n, "counter": counter, "loss_avg": loss_avg,
-            "accs": acc_names, "version": 1}
+            "accs": acc_names, "version": 1,
+            # ZeRO-1 composition marker: sharded-bucket reductions trade
+            # the bitwise cross-topology contract for allclose (verifier
+            # V206 exempts the stamped reduce-scatters off this)
+            "zero_stage1": plan is not None}
     program._elastic_meta = meta
     from ..core.pass_framework import finish_pass
     finish_pass(program, "elastic", startup=startup, logical_dp=n)

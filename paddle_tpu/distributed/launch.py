@@ -22,6 +22,17 @@ can fill — and relaunches with the elastic env contract
 committed checkpoint via ``Executor.restore_from_checkpoint``, whose
 topology-shifted restore re-buckets state and schedule for the new
 world.
+
+Multi-host elastic (docs/elastic.md "Cross-host fleets"): with several
+``--ips`` hosts, ``--elastic --fleet_dir <shared-fs dir>`` runs
+`launch_collective_fleet` — each host's launcher joins the fleet
+control plane (distributed/fleet_control.py), supervises its local
+trainers AND its peers' membership, and on a lost host every surviving
+launcher tears down, runs the two-phase survivor agreement (same
+re-formed world, same restore step, picked from the run journals), and
+relaunches with the ``PADDLE_TPU_FLEET_*`` contract; workers whose
+writer world changed restore through the rank-merged
+``CheckpointManager.load_merged``.
 """
 from __future__ import annotations
 
@@ -34,7 +45,8 @@ from .launch_utils import (Cluster, Pod, get_cluster, start_local_trainers,
                            watch_local_trainers, poll_local_trainers,
                            terminate_procs, find_free_ports)
 
-__all__ = ["launch_collective", "launch_ps", "main", "elastic_world_size"]
+__all__ = ["launch_collective", "launch_collective_fleet", "launch_ps",
+           "main", "elastic_world_size"]
 
 
 def _parse_args(argv=None):
@@ -50,9 +62,37 @@ def _parse_args(argv=None):
     p.add_argument("--elastic", action="store_true",
                    help="supervise: on a lost rank, re-form the job from "
                         "survivors and relaunch resuming from the last "
-                        "checkpoint (docs/elastic.md)")
+                        "checkpoint (docs/elastic.md); with multiple "
+                        "--ips hosts this needs --fleet_dir (the "
+                        "cross-host rendezvous)")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="elastic relaunch budget before giving up")
+    p.add_argument("--fleet_dir", type=str,
+                   default=os.environ.get("PADDLE_TPU_FLEET_DIR"),
+                   help="shared-filesystem rendezvous dir for multi-host "
+                        "elastic (distributed/fleet_control.py): every "
+                        "host's launcher joins membership here, agrees "
+                        "on the survivor set after a lost host, and "
+                        "exports the PADDLE_TPU_FLEET_* contract to its "
+                        "workers")
+    p.add_argument("--host_rank", type=int, default=None,
+                   help="this host's index in --ips (default: the "
+                        "position of POD_IP in --ips, else 0); must be "
+                        "explicit when simulating several hosts on one "
+                        "machine")
+    p.add_argument("--host_capacity", type=int, default=None,
+                   help="logical chips this host contributes to the "
+                        "fleet world (default: --nproc_per_node); the "
+                        "elastic logical world is the sum over --ips")
+    p.add_argument("--member_timeout", type=float, default=20.0,
+                   help="seconds without a membership refresh before a "
+                        "fleet host counts as lost")
+    p.add_argument("--journal_dir", type=str,
+                   default=os.environ.get("PADDLE_TPU_JOURNAL_DIR"),
+                   help="run-journal dir (exported to workers); the "
+                        "fleet re-form reads the survivors' journals to "
+                        "agree on the newest mutually-visible "
+                        "checkpoint step")
     p.add_argument("--term_grace", type=float, default=10.0,
                    help="seconds between SIGTERM and SIGKILL at teardown")
     p.add_argument("--heartbeat_dir", type=str, default=None,
@@ -114,16 +154,17 @@ def launch_collective(args):
     env contract; workers resume from the last committed checkpoint."""
     nproc = args.nproc_per_node
     n_ips = len([ip for ip in args.ips.split(",") if ip.strip()])
-    if args.elastic and n_ips > 1:
-        # this launcher supervises LOCAL trainers only; shrinking a
-        # multi-node job needs cross-host re-form coordination (every
-        # launcher must agree on the survivor set) — refuse rather than
-        # re-size the local pod against a global world it cannot see
-        sys.stderr.write(
-            "--elastic currently supervises a single node "
-            "(--ips with one host); multi-node elastic re-form needs "
-            "a cross-host coordinator (docs/elastic.md)\n")
-        return 2
+    if args.elastic and (n_ips > 1 or args.fleet_dir):
+        # multi-host elastic: every host's launcher joins the shared-fs
+        # rendezvous and the fleet controller drives the cross-host
+        # survivor agreement (distributed/fleet_control.py)
+        if not args.fleet_dir:
+            sys.stderr.write(
+                "--elastic with multiple --ips hosts needs --fleet_dir "
+                "(a shared-filesystem rendezvous dir every host "
+                "mounts; docs/elastic.md)\n")
+            return 2
+        return launch_collective_fleet(args)
     logical_world = nproc * n_ips
     hb_dir = args.heartbeat_dir
     restarts = 0
@@ -203,6 +244,162 @@ def launch_collective(args):
             f"mesh {nproc} -> {new_world} of logical {logical_world}, "
             f"restart {restarts + 1}/{args.max_restarts}\n")
         nproc = new_world
+        restarts += 1
+
+
+def _spawn_fleet_pod(args, nproc, envs, member_hosts, my_host, node_ips):
+    """Spawn THIS host's trainers for the current fleet formation.
+
+    Trainer ranks are dense over the formation: sorted member hosts ×
+    nproc (the CheckpointManager/journal/heartbeat rank layout every
+    consumer of the formation shares).  Pods are selected by host INDEX,
+    not by addr — simulated fleets run several 'hosts' on one ip."""
+    from .launch_utils import Cluster, Pod, Trainer
+    members = sorted(int(h) for h in member_hosts)
+    my_index = members.index(int(my_host))
+    if args.started_port is not None:
+        ports = list(range(args.started_port, args.started_port + nproc))
+    else:
+        ports = find_free_ports(nproc)
+    cluster = Cluster()
+    rank = 0
+    for idx, h in enumerate(members):
+        ip = node_ips[h] if h < len(node_ips) else "127.0.0.1"
+        pod = Pod(idx, ip)
+        for i in range(nproc):
+            # remote hosts' endpoints are decorative here (no connect in
+            # the simulated fleet; a real slice passes --started_port so
+            # every host derives the same port map)
+            pod.trainers.append(Trainer(f"{ip}:{ports[i]}", rank, [i]))
+            rank += 1
+        cluster.pods.append(pod)
+    pod = cluster.pods[my_index]
+    procs = start_local_trainers(cluster, pod, args.training_script,
+                                 args.training_script_args,
+                                 log_dir=args.log_dir, envs=envs)
+    ranks = [t.rank for t in pod.trainers]
+    return procs, ranks
+
+
+def launch_collective_fleet(args):
+    """Multi-host elastic supervision: the per-host launcher joined to
+    the fleet control plane (distributed/fleet_control.py).
+
+    Each host's launcher (1) rendezvouses at --fleet_dir and agrees the
+    epoch-0 formation, (2) spawns its local trainers with the elastic +
+    fleet env contract, (3) supervises — local exit codes, heartbeat
+    stalls, AND peer membership — and (4) on any loss tears its pod
+    down and runs the two-phase survivor agreement so every surviving
+    launcher re-forms to the SAME world and restore step, then
+    relaunches.  Workers resume via the rank-merged restore
+    (CheckpointManager.load_merged) when the writer world changed."""
+    from .fleet_control import (FleetAgreementTimeout, FleetController,
+                                fleet_rank)
+    nproc = args.nproc_per_node
+    node_ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+    n_ips = max(1, len(node_ips))
+    host = args.host_rank
+    if host is None:
+        pod_ip = os.environ.get("POD_IP", "")
+        host = node_ips.index(pod_ip) if pod_ip in node_ips else 0
+    capacity = args.host_capacity or nproc
+    logical_world = capacity * n_ips
+    hb_dir = args.heartbeat_dir
+    ctl = FleetController(
+        args.fleet_dir, host=host, capacity=capacity,
+        logical_world=logical_world,
+        member_timeout_s=args.member_timeout,
+        journal_dir=args.journal_dir, heartbeat_dir=hb_dir,
+        stall_timeout_s=(args.stall_timeout if hb_dir else None))
+    # a reused fleet dir must not replay a previous run's agreement
+    # (stale commits/proposals/barriers/done-members) into this one
+    ctl.reset_rendezvous()
+    try:
+        commit = ctl.form(expect=range(n_ips))
+    except FleetAgreementTimeout as e:
+        sys.stderr.write(f"fleet formation failed: {e}\n")
+        return 1
+    restarts = 0
+    while True:
+        my_rank0 = fleet_rank(host, commit.members) * nproc
+        ranks = list(range(my_rank0, my_rank0 + nproc))
+        envs = {"PADDLE_TPU_ELASTIC": "1",
+                "PADDLE_TPU_ELASTIC_LOGICAL_WORLD": str(logical_world),
+                "PADDLE_TPU_ELASTIC_RESTART": str(restarts)}
+        envs.update(ctl.env_for_workers(commit))
+        if args.journal_dir:
+            envs["PADDLE_TPU_JOURNAL_DIR"] = args.journal_dir
+        if hb_dir:
+            envs["PADDLE_TPU_HEARTBEAT_DIR"] = hb_dir
+            os.makedirs(hb_dir, exist_ok=True)
+            for name in os.listdir(hb_dir):  # sweep stale incarnations
+                if name.startswith("heartbeat.rank"):
+                    try:
+                        os.unlink(os.path.join(hb_dir, name))
+                    except OSError:
+                        pass
+        sys.stderr.write(
+            f"fleet host {host}: epoch {commit.epoch} members "
+            f"{commit.members} world {commit.world} restore_step "
+            f"{commit.restore_step} — spawning ranks {ranks}\n")
+        procs, ranks = _spawn_fleet_pod(args, nproc, envs,
+                                        commit.members, host, node_ips)
+        failed, stalled, lost = [], [], []
+        try:
+            while True:
+                ctl.tick(ranks=ranks)
+                procs, _done, failed = poll_local_trainers(procs)
+                if failed:
+                    break
+                if not procs:  # every local trainer finished cleanly
+                    ctl.leave()
+                    ctl.close()
+                    return 0
+                if hb_dir:
+                    from ..observability.heartbeat import stalled_ranks
+                    stalled = stalled_ranks(
+                        hb_dir, args.stall_timeout,
+                        ranks=[tp.rank for tp in procs])
+                    if stalled:
+                        break
+                lost = ctl.lost_members(commit)
+                if lost:
+                    break
+                if ctl.reform_requested():
+                    break
+                time.sleep(0.3)
+        except KeyboardInterrupt:
+            terminate_procs(procs, sigterm_grace=args.term_grace)
+            ctl.close()
+            return 1
+        why = (f"rank(s) failed {[tp.rank for tp in failed]}" if failed
+               else f"rank(s) stalled {stalled}" if stalled
+               else f"host(s) lost {lost}" if lost
+               else "peer requested re-form")
+        sys.stderr.write(
+            f"fleet host {host}: {why} at epoch {commit.epoch} — "
+            "tearing down local pod for survivor agreement\n")
+        # SIGTERM first: survivors' preemption handlers stage their
+        # final checkpoint before the fleet re-forms on top of it
+        terminate_procs(procs + failed, sigterm_grace=args.term_grace)
+        if restarts >= args.max_restarts:
+            sys.stderr.write(
+                f"fleet host {host}: restart budget exhausted "
+                f"({restarts}/{args.max_restarts})\n")
+            ctl.close()
+            return 1
+        try:
+            commit = ctl.reform(commit)
+        except FleetAgreementTimeout as e:
+            sys.stderr.write(f"fleet re-form failed: {e}\n")
+            ctl.close()
+            return 1
+        if commit.world < 1 or host not in commit.members:
+            sys.stderr.write(
+                f"fleet host {host}: not part of the re-formed fleet "
+                f"{commit.members}\n")
+            ctl.close()
+            return 1
         restarts += 1
 
 

@@ -31,6 +31,9 @@ Event schema (every event):
     checkpoint_commit step, path
     restore           step, [global_step, world]
     reanchor          world, global_step (elastic topology shift)
+    reform            epoch, world, members, restore_step (fleet
+                      control plane committed a (re-)formation —
+                      distributed/fleet_control.py)
     chaos             directive, step (injected fault fired)
     collective_retry  step, attempt (caller retrying an injected /
                       transient collective failure)
@@ -229,7 +232,13 @@ def reconstruct_timeline(events: Iterable[dict]) -> dict:
     the steps it ran, where it resumed, topology reanchors, checkpoint
     commits and injected chaos.  This is the post-hoc proof that a
     kill/resume run did what the elastic contract promises — derived
-    from the journals alone, no live process needed."""
+    from the journals alone, no live process needed.
+
+    Also the fleet control plane's LIVE substrate: per-incarnation
+    ``saves`` (checkpoint_save — staged shards) vs ``commits``
+    (published steps) is what `fleet_control.newest_mutual_checkpoint_
+    step` intersects across survivors to agree on the restore point,
+    and ``reforms`` records the committed fleet (re-)formations."""
     runs: List[dict] = []
     by_id: Dict[str, dict] = {}
     for e in sorted(events, key=lambda e: (e.get("t", 0),
@@ -240,7 +249,8 @@ def reconstruct_timeline(events: Iterable[dict]) -> dict:
             run = by_id[rid] = {
                 "run_id": rid, "start_t": e.get("t"),
                 "steps": [], "global_steps": [], "restored_step": None,
-                "restored_global": None, "reanchors": [], "commits": [],
+                "restored_global": None, "reanchors": [], "saves": [],
+                "commits": [], "reforms": [],
                 "chaos": [], "collective_retries": 0, "n_events": 0,
             }
             runs.append(run)
@@ -256,8 +266,15 @@ def reconstruct_timeline(events: Iterable[dict]) -> dict:
         elif kind == "reanchor":
             run["reanchors"].append({"world": e.get("world"),
                                      "global_step": e.get("global_step")})
+        elif kind == "checkpoint_save":
+            run["saves"].append(e.get("step"))
         elif kind == "checkpoint_commit":
             run["commits"].append(e.get("step"))
+        elif kind == "reform":
+            run["reforms"].append({"epoch": e.get("epoch"),
+                                   "world": e.get("world"),
+                                   "members": e.get("members"),
+                                   "restore_step": e.get("restore_step")})
         elif kind == "chaos":
             run["chaos"].append({"directive": e.get("directive"),
                                  "step": e.get("step")})

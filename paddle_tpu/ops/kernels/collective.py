@@ -356,8 +356,18 @@ def c_elastic_fold(ins, attrs, ctx):
     is implementation-defined and XLA may reassociate psum(a+b) into
     psum(a)+psum(b), both of which break bitwise topology invariance.
     Off-mesh this degrades to acc + x (a world of one logical rank per
-    micro-step)."""
+    micro-step).
+
+    ``pre_reduced=True`` (the elastic × ZeRO-1 composition,
+    distributed/elastic.py): X is ALREADY a cross-rank reduction — the
+    1/N reduce-scattered gradient shard — so the gather half is skipped
+    and the op is the accumulator continuation ``acc + x`` on every
+    mesh.  The explicit fold order (hence bitwise topology invariance)
+    is traded away there; the composition's contract is allclose, not
+    bitwise (docs/elastic.md)."""
     x, acc = ins["X"], ins["Acc"]
+    if attrs.get("pre_reduced"):
+        return {"Out": acc + x}
     axes = _axes(ctx, attrs)
     if not axes:
         return {"Out": acc + x}

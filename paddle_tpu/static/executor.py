@@ -980,16 +980,40 @@ class Executor:
         extra optimizer updates.
         """
         from ..core.program import default_main_program
+        from ..distributed.compiled_program import CompiledProgram
         program = program or default_main_program()
+        if isinstance(program, CompiledProgram) or (
+                not isinstance(program, Program)
+                and hasattr(program, "_run_steps")):
+            # multi-chip scanned dispatch (incl. the elastic K-micro-step
+            # window: one global step = ONE device call instead of K
+            # host dispatches — distributed/elastic.py)
+            import time as _time
+            _t0 = _time.perf_counter()
+            results = program._run_steps(self, feed, fetch_list, scope,
+                                         return_numpy)
+            k = 0
+            for v in (feed or {}).values():
+                k = int(getattr(v, "shape", (1,))[0] or 1)
+                break
+            self._observe_step(program, _time.perf_counter() - _t0,
+                               feed or {}, steps=max(1, k),
+                               chips=_wrapper_chips(program), stacked=True)
+            self._maybe_checkpoint(
+                program, scope or getattr(program, "_scope", None)
+                or global_scope())
+            self._chaos_step(program)
+            return results
         scope = scope or global_scope()
         feed = feed or {}
         if getattr(program, "_elastic_meta", None) is not None:
             raise NotImplementedError(
-                "run_steps does not support elastic programs yet: the "
-                "scanned steps axis would fix the micro-step count at "
-                "trace time, defeating the world-size-resolved schedule "
-                "— drive elastic programs through run() "
-                "(distributed/elastic.py)")
+                "run_steps on a RAW elastic Program is not supported: "
+                "the schedule's K is resolved from the mesh at trace "
+                "time, which only exists under CompiledProgram — wrap "
+                "it (CompiledProgram(main).with_data_parallel(...)) and "
+                "run_steps scans the K-micro-step window in one device "
+                "dispatch (distributed/elastic.py)")
         fetch_names = [v.name if hasattr(v, "name") else str(v)
                        for v in (fetch_list or [])]
         block = program.global_block()
@@ -1440,7 +1464,20 @@ class Executor:
             raise ValueError(
                 f"on_mismatch must be 'convert', 'error' or 'warn', "
                 f"got {on_mismatch!r}")
-        ckpt = manager.load(step=step)
+        # the manager owns the STORAGE-layer topology shift (the
+        # checkpoint was written by a different rank count): forward
+        # on_mismatch so 'convert' routes through the rank-merged loader
+        # and 'error' names both worlds (duck-typed managers in tests
+        # may not take the kwarg)
+        import inspect
+        load_kwargs = {"step": step}
+        try:
+            if "on_mismatch" in inspect.signature(
+                    manager.load).parameters:
+                load_kwargs["on_mismatch"] = on_mismatch
+        except (TypeError, ValueError):
+            pass
+        ckpt = manager.load(**load_kwargs)
         if ckpt is None:
             self.last_restored_extra = None
             return None

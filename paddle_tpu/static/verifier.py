@@ -1045,9 +1045,17 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
     # The elastic fold exists BECAUSE psum's reduction order is
     # implementation-defined; any order-sensitive psum collective on the
     # fold's ring silently re-introduces the world-size dependence.
-    if getattr(program, "_elastic_meta", None) is not None:
+    el_meta = getattr(program, "_elastic_meta", None)
+    if el_meta is not None:
         for e in seq:
             if e["type"] in _PSUM_ORDER_SENSITIVE and e["ring_id"] == 0:
+                if el_meta.get("zero_stage1") and e.get("zero_role"):
+                    # elastic × ZeRO-1: the bucket reduce-scatter IS the
+                    # composition's documented reduction — it trades the
+                    # bitwise cross-topology contract for allclose
+                    # (distributed/elastic.py), so it is not a latent
+                    # reassociation hazard
+                    continue
                 out.append(Diagnostic(
                     "V206", ERROR,
                     f"{e['type']} on ring 0 inside an elastic program: "
@@ -1068,6 +1076,11 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
                 producers[n] = op
     for i, op in enumerate(block.ops):
         if op.type not in _REDUCE_OPS:
+            continue
+        if op.type == "c_elastic_fold" and op.attrs.get("pre_reduced"):
+            # elastic × ZeRO-1 window accumulation: X IS the bucket's
+            # reduce-scattered shard by design — the fold skips its
+            # gather half and only continues the accumulator
             continue
         frontier = [n for n in op.inputs.get("X", []) if n]
         seen: Set[str] = set()
@@ -1110,12 +1123,22 @@ def _check_pass_order(program: Program, out: List[Diagnostic]):
             "elastic and gradient_merge both applied: the elastic "
             "schedule IS a masked accumulation window — stacking a "
             "second counter double-masks the optimizer commit"))
+    el_meta = getattr(program, "_elastic_meta", None) or {}
     if "elastic" in order and "zero1_sharding" in order:
-        out.append(Diagnostic(
-            "V503", ERROR,
-            "elastic and zero1_sharding both applied: the ordered fold "
-            "reduces into REPLICATED accumulators while ZeRO-1 updates "
-            "1/N shards — the combination is refused by elasticize()"))
+        if order.index("zero1_sharding") > order.index("elastic"):
+            out.append(Diagnostic(
+                "V503", ERROR,
+                "zero1_sharding applied AFTER elastic: the sharding "
+                "pass would bucket the fold's @MASKED temps — "
+                "elasticize must run on the already-sharded program"))
+        elif not el_meta.get("zero_stage1"):
+            out.append(Diagnostic(
+                "V503", ERROR,
+                "elastic and zero1_sharding both applied but the "
+                "elastic rewrite was not ZeRO-aware (no sharded window "
+                "accumulators): the ordered fold reduces into "
+                "REPLICATED accumulators while ZeRO-1 updates 1/N "
+                "shards — re-run elasticize on the sharded program"))
     if "gradient_merge" in order and "zero1_sharding" in order and \
             order.index("gradient_merge") < order.index("zero1_sharding"):
         out.append(Diagnostic(

@@ -15,6 +15,16 @@ elastic_smoke.py) and on a real preemptible fleet when needed.
       right after the executor finishes micro-step ``<step>``, but only
       on trainer rank ``<r>`` (default 0, from ``PADDLE_TRAINER_ID``).
 
+  ``lose_host@<step>[:host=<h>]``
+      Simulate losing a WHOLE host of the fleet (docs/elastic.md
+      multi-host): right after the executor finishes micro-step
+      ``<step>``, but only when this process's fleet host id
+      (``PADDLE_TPU_FLEET_HOST_ID``) is ``<h>`` (default 0), SIGKILL
+      the host's fleet launcher (``PADDLE_TPU_FLEET_LAUNCHER_PID``)
+      and then this trainer — no goodbye from either, exactly what a
+      preempted host looks like.  Surviving hosts' controllers see the
+      membership record go stale and drive the cross-host re-form.
+
   ``slow_save=<seconds>``
       Sleep inside the checkpoint writer between the shard bytes and the
       manifest — the slow-disk half of a torn-write race.
@@ -112,6 +122,9 @@ def _parse(raw: str) -> List[_Directive]:
                 else signal.SIGKILL
             out.append(_Directive("kill", step=step,
                                   rank=int(opts.get("rank", 0)), sig=sig))
+        elif name == "lose_host":
+            out.append(_Directive("lose_host", step=step,
+                                  rank=int(opts.get("host", 0))))
         elif name == "slow_save":
             out.append(_Directive("slow_save",
                                   seconds=float(opts.get("value", 0.1))))
@@ -174,6 +187,13 @@ def _journal_fire(directive: str, step) -> None:
         pass
 
 
+def _fleet_host() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TPU_FLEET_HOST_ID", "0"))
+    except ValueError:
+        return 0
+
+
 def step_hook(step: int) -> None:
     """Called by the executor after finishing micro-step `step`."""
     if not enabled():
@@ -183,6 +203,20 @@ def step_hook(step: int) -> None:
             d.step = None  # never double-fire in one process
             _journal_fire("kill", step)
             _die(d.sig)
+        elif d.kind == "lose_host" and d.step == step and \
+                d.rank == _fleet_host():
+            d.step = None
+            _journal_fire("lose_host", step)
+            # the launcher first (it must not observe our death and
+            # relaunch locally — the HOST is gone), then ourselves;
+            # SIGKILL both: a preempted host sends no goodbyes
+            pid = os.environ.get("PADDLE_TPU_FLEET_LAUNCHER_PID")
+            if pid:
+                try:
+                    os.kill(int(pid), signal.SIGKILL)
+                except (ValueError, ProcessLookupError, PermissionError):
+                    pass
+            _die(signal.SIGKILL)
 
 
 def save_hook(stage_dir: str, step: int) -> None:

@@ -1,0 +1,309 @@
+"""Elastic composition lifts (ISSUE 14 satellites): elastic × ZeRO-1
+sharded window accumulation, and elastic × run_steps — the K-micro-step
+window scanned into ONE device dispatch."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+
+import jax  # noqa: E402
+
+import paddle_tpu.static as static  # noqa: E402
+from paddle_tpu.core.program import _reset_unique_names  # noqa: E402
+from paddle_tpu.distributed.compiled_program import CompiledProgram  # noqa: E402
+from paddle_tpu.distributed.elastic import (  # noqa: E402
+    elasticize, rebucket_feeds)
+from paddle_tpu.distributed.sharding import shard_optimizer_states  # noqa: E402
+from paddle_tpu.static import layers  # noqa: E402
+
+LOGICAL = 8
+STEPS = 5
+
+
+def _build(zero_stage=0, elastic=True):
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    plan = None
+    if zero_stage:
+        plan = shard_optimizer_states(main, startup, dp_degree=LOGICAL,
+                                      stage=zero_stage)
+    meta = None
+    if elastic:
+        meta = elasticize(main, startup, logical_dp=LOGICAL,
+                          loss_name=loss)
+    return main, startup, loss, meta, plan
+
+
+def _feeds(n=STEPS):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(LOGICAL, 8).astype(np.float32),
+             "y": rng.rand(LOGICAL, 1).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _train(zero_stage, elastic, world, feeds=None):
+    main, startup, loss, meta, _plan = _build(zero_stage, elastic)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:world])
+    trace = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for f in feeds or _feeds():
+            if elastic:
+                for mf in rebucket_feeds(f, LOGICAL, world):
+                    out = exe.run(cp, feed=mf,
+                                  fetch_list=[meta["loss_avg"]])
+            else:
+                out = exe.run(cp, feed=f, fetch_list=[loss])
+            trace.append(np.asarray(out[0]).reshape(-1)[0])
+        params = {p.name: np.asarray(scope.get(p.name))
+                  for p in main.all_parameters()}
+    return np.asarray(trace, np.float64), params
+
+
+# ---------------------------------------------------------------------------
+# elastic × ZeRO-1
+# ---------------------------------------------------------------------------
+def test_elastic_zero1_allclose_to_plain_full_mesh():
+    """plain-vs-elastic+zero1 on the 8-device mesh: the sharded window
+    accumulation (c_elastic_fold pre_reduced over the reduce-scattered
+    shard) reproduces the plain update to 1e-6."""
+    t_plain, p_plain = _train(0, False, LOGICAL)
+    t_ez, p_ez = _train(1, True, LOGICAL)
+    np.testing.assert_allclose(t_ez, t_plain, atol=1e-6, rtol=1e-6)
+    for n in p_plain:
+        np.testing.assert_allclose(p_ez[n], p_plain[n], atol=1e-6,
+                                   rtol=1e-6, err_msg=n)
+
+
+def test_elastic_zero1_allclose_across_worlds():
+    """the SAME elastic+zero1 program on a half mesh (K=2 micro-steps)
+    stays allclose to the plain full-mesh run — the composition's
+    topology contract (bitwise is traded for allclose by the
+    reduce-scatter; docs/elastic.md)."""
+    t_plain, p_plain = _train(0, False, LOGICAL)
+    t_ez4, p_ez4 = _train(1, True, 4)
+    np.testing.assert_allclose(t_ez4, t_plain, atol=1e-6, rtol=1e-6)
+    for n in p_plain:
+        np.testing.assert_allclose(p_ez4[n], p_plain[n], atol=1e-6,
+                                   rtol=1e-6, err_msg=n)
+
+
+def test_elastic_zero1_program_is_strict_clean():
+    """V206/V207/V503 must all accept the composed program (the sharded
+    fold is stamped + meta-marked; PADDLE_TPU_VERIFY=strict raises on
+    any diagnostic)."""
+    from paddle_tpu.static.verifier import check_program
+    main, startup, loss, meta, plan = _build(1, True)
+    assert meta["zero_stage1"] is True
+    assert plan is not None and plan.buckets
+    report = check_program(main, level="all")
+    assert not report.errors, [str(d) for d in report.errors]
+
+
+def test_elastic_refuses_zero_stage2():
+    main, startup, loss, _meta, _plan = _build(0, False)
+    shard_optimizer_states(main, startup, dp_degree=LOGICAL, stage=2)
+    with pytest.raises(NotImplementedError, match="stage 1 only"):
+        elasticize(main, startup, logical_dp=LOGICAL)
+
+
+def test_elastic_zero1_sharded_accumulators_are_dp_shard():
+    """The window accumulators live at 1/N per chip (dp_shard global
+    padded shape), not full-size — the memory point of the lift."""
+    main, _startup, _loss, meta, plan = _build(1, True)
+    block = main.global_block()
+    shard_accs = [a for a in meta["accs"] if "@ELASTIC_ACC" in a
+                  and block.var(a).attrs.get("dp_shard")]
+    assert len(shard_accs) == len(plan.buckets)
+    for a in shard_accs:
+        v = block.var(a)
+        assert v.persistable
+        assert int(v.attrs["dp_shard"]) == LOGICAL
+    # and no full-size per-param elastic accumulator shadows the grads
+    bucket_grads = {p["grad"] for b in plan.buckets for p in b["params"]}
+    for g in bucket_grads:
+        assert not any(acc.startswith(g + "@ELASTIC_ACC")
+                       for acc in meta["accs"])
+
+
+# ---------------------------------------------------------------------------
+# elastic × run_steps (scanned K-micro-step window)
+# ---------------------------------------------------------------------------
+def test_elastic_run_steps_one_dispatch_bitwise():
+    """One global step through run_steps = ONE device dispatch instead
+    of K, with the loss trace and params BITWISE-equal to the looped
+    form."""
+    world = 4  # K = 2
+    k = LOGICAL // world
+    feeds = _feeds(4)
+
+    main, startup, loss, meta, _ = _build(0, True)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:world])
+    looped, looped_params = [], None
+    with static.scope_guard(scope):
+        exe.run(startup)
+        d0 = cp._dispatches
+        for f in feeds:
+            for mf in rebucket_feeds(f, LOGICAL, world):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            looped.append(np.asarray(out[0]))
+        looped_disp = cp._dispatches - d0
+        looped_params = {p.name: np.asarray(scope.get(p.name))
+                         for p in main.all_parameters()}
+    assert looped_disp == k * len(feeds)
+
+    main2, startup2, loss2, meta2, _ = _build(0, True)
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    cp2 = CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name, places=list(jax.devices())[:world])
+    scanned = []
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        d0 = cp2._dispatches
+        for f in feeds:
+            micro = rebucket_feeds(f, LOGICAL, world)
+            stacked = {n: np.stack([m[n] for m in micro])
+                       for n in micro[0]}
+            outs = exe2.run_steps(cp2, feed=stacked,
+                                  fetch_list=[meta2["loss_avg"]])
+            # fetches stack to [K, ...]; the commit micro-step's value
+            # is the global step's committed loss
+            scanned.append(np.asarray(outs[0])[-1])
+        scanned_disp = cp2._dispatches - d0
+        scanned_params = {p.name: np.asarray(scope2.get(p.name))
+                          for p in main2.all_parameters()}
+    # the dispatch-count claim: K host dispatches collapse to 1
+    assert scanned_disp == len(feeds)
+    assert looped_disp == k * scanned_disp
+    for i, (a, b) in enumerate(zip(looped, scanned)):
+        assert np.array_equal(a, b), (i, a, b)
+    for n in looped_params:
+        assert np.array_equal(looped_params[n], scanned_params[n]), n
+
+
+def test_elastic_run_steps_resumes_mid_stream_bitwise():
+    """Switching dispatch modes mid-run (looped -> scanned) continues
+    the same schedule: counters/seeds line up because the scan carries
+    the same persistable micro counter."""
+    world = 4
+    feeds = _feeds(4)
+    main, startup, loss, meta, _ = _build(0, True)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:world])
+    mixed = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for gi, f in enumerate(feeds):
+            micro = rebucket_feeds(f, LOGICAL, world)
+            if gi % 2 == 0:
+                for mf in micro:
+                    out = exe.run(cp, feed=mf,
+                                  fetch_list=[meta["loss_avg"]])
+                mixed.append(np.asarray(out[0]))
+            else:
+                outs = exe.run_steps(cp, feed={
+                    n: np.stack([m[n] for m in micro])
+                    for n in micro[0]}, fetch_list=[meta["loss_avg"]])
+                mixed.append(np.asarray(outs[0])[-1])
+
+    main2, startup2, loss2, meta2, _ = _build(0, True)
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    cp2 = CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name, places=list(jax.devices())[:world])
+    looped = []
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        for f in feeds:
+            for mf in rebucket_feeds(f, LOGICAL, world):
+                out = exe2.run(cp2, feed=mf,
+                               fetch_list=[meta2["loss_avg"]])
+            looped.append(np.asarray(out[0]))
+    for a, b in zip(looped, mixed):
+        assert np.array_equal(a, b)
+
+
+def test_run_steps_refuses_indivisible_per_step_batch():
+    """Silently replicating a non-divisible per-step batch would run
+    every rank over the full rows with a different summation order —
+    the scanned path must fail loudly like the looped path does."""
+    main, startup, loss, _m, _ = _build(0, False)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:4])
+    with static.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="does not divide"):
+            exe.run_steps(cp, feed={
+                "x": np.zeros((2, 6, 8), np.float32),
+                "y": np.zeros((2, 6, 1), np.float32)},
+                fetch_list=[loss])
+
+
+def test_run_steps_raw_elastic_program_still_refused():
+    main, startup, loss, meta, _ = _build(0, True)
+    exe = static.Executor()
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(NotImplementedError, match="CompiledProgram"):
+            exe.run_steps(main, feed={"x": np.zeros((2, 8, 8),
+                                                    np.float32),
+                                      "y": np.zeros((2, 8, 1),
+                                                    np.float32)},
+                          fetch_list=[meta["loss_avg"]])
+
+
+def test_run_steps_compiled_non_elastic_matches_run():
+    """The scanned CompiledProgram path is not elastic-only: a plain
+    data-parallel program scans bitwise-equal to looped run()."""
+    feeds = _feeds(3)
+    main, startup, loss, _meta, _ = _build(0, False)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:LOGICAL])
+    with static.scope_guard(scope):
+        exe.run(startup)
+        looped = [np.asarray(exe.run(cp, feed=f, fetch_list=[loss])[0])
+                  for f in feeds]
+        lp = {p.name: np.asarray(scope.get(p.name))
+              for p in main.all_parameters()}
+
+    main2, startup2, loss2, _m, _ = _build(0, False)
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    cp2 = CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name, places=list(jax.devices())[:LOGICAL])
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        stacked = {n: np.stack([f[n] for f in feeds])
+                   for n in feeds[0]}
+        outs = exe2.run_steps(cp2, feed=stacked, fetch_list=[loss2])
+        sp = {p.name: np.asarray(scope2.get(p.name))
+              for p in main2.all_parameters()}
+    scanned = np.asarray(outs[0])
+    assert scanned.shape[0] == len(feeds)
+    for i in range(len(feeds)):
+        assert np.array_equal(scanned[i], looped[i]), i
+    for n in lp:
+        assert np.array_equal(lp[n], sp[n]), n

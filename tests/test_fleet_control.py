@@ -1,0 +1,419 @@
+"""Unit tests for the fleet control plane (distributed/fleet_control.py)
+and the rank-merged checkpoint loader (CheckpointManager.load_merged)."""
+import json
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import fleet_control as fc
+from paddle_tpu.distributed.fleet_control import (
+    FleetAgreementTimeout, FleetBarrier, FleetController, fleet_env,
+    fleet_rank, fleet_world_size, live_members,
+    newest_mutual_checkpoint_step, propose_reform, read_commit,
+    read_members, write_member)
+
+
+# ---------------------------------------------------------------------------
+# world math / rank layout
+# ---------------------------------------------------------------------------
+def test_fleet_world_size_math():
+    assert fleet_world_size(8, 8) == 8
+    assert fleet_world_size(7, 8) == 4   # largest pow2 divisor fillable
+    assert fleet_world_size(4, 8) == 4
+    assert fleet_world_size(3, 8) == 2
+    assert fleet_world_size(1, 8) == 1
+    assert fleet_world_size(0, 8) == 0
+    assert fleet_world_size(16, 8) == 8  # never exceeds the logical world
+
+
+def test_fleet_rank_is_dense_over_sorted_members():
+    assert fleet_rank(0, [0, 1]) == 0
+    assert fleet_rank(1, [0, 1]) == 1
+    # after host 0 is lost, host 1 becomes rank 0 of the new formation
+    assert fleet_rank(1, [1]) == 0
+    assert fleet_rank(3, [3, 1]) == 1
+
+
+# ---------------------------------------------------------------------------
+# membership + liveness
+# ---------------------------------------------------------------------------
+def test_membership_roundtrip_and_liveness(tmp_path):
+    d = str(tmp_path)
+    write_member(d, 0, capacity=4, epoch=0, ranks=[0])
+    write_member(d, 1, capacity=4, epoch=0, ranks=[1])
+    members = read_members(d)
+    assert sorted(members) == [0, 1]
+    assert members[0]["capacity"] == 4 and members[1]["ranks"] == [1]
+    assert sorted(live_members(d, timeout_s=60.0)) == [0, 1]
+    # a host that stops refreshing ages out
+    now = time.time() + 120
+    assert sorted(live_members(d, timeout_s=60.0, now=now)) == []
+
+
+def test_done_member_departed_not_lost(tmp_path):
+    d = str(tmp_path)
+    write_member(d, 0, capacity=4, epoch=0)
+    write_member(d, 1, capacity=4, epoch=0, status="done")
+    assert sorted(live_members(d, timeout_s=60.0)) == [0]
+    ctl = FleetController(d, host=0, capacity=4, logical_world=8,
+                         member_timeout_s=60.0)
+    commit = fc.FleetCommit({"epoch": 0, "members": [0, 1], "world": 8})
+    assert ctl.lost_members(commit) == []  # departed cleanly, not lost
+
+
+def test_wedged_host_counts_as_lost_via_heartbeats(tmp_path):
+    """A host whose launcher still refreshes but whose every trainer
+    heartbeat went stale is wedged — liveness from the heartbeat files,
+    not just the membership record."""
+    from paddle_tpu.observability.heartbeat import heartbeat_path
+    d = str(tmp_path / "fleet")
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    write_member(d, 0, capacity=4, epoch=0, ranks=[0])
+    write_member(d, 1, capacity=4, epoch=0, ranks=[1])
+    old = time.time() - 1000
+    for rank, t in ((0, time.time()), (1, old)):
+        with open(heartbeat_path(hb, rank), "w") as f:
+            json.dump({"rank": rank, "step": 3, "t": t}, f)
+    live = live_members(d, timeout_s=60.0, heartbeat_dir=hb,
+                        stall_timeout_s=30.0)
+    assert sorted(live) == [0]  # host 1's only rank stalled -> lost
+
+
+# ---------------------------------------------------------------------------
+# two-phase agreement
+# ---------------------------------------------------------------------------
+def _make_ctl(d, host, n=2, capacity=4, logical=8, **kw):
+    kw.setdefault("member_timeout_s", 5.0)
+    kw.setdefault("agreement_timeout_s", 20.0)
+    return FleetController(d, host=host, capacity=capacity,
+                           logical_world=logical, **kw)
+
+
+def test_two_phase_agreement_two_hosts(tmp_path):
+    d = str(tmp_path)
+    ctls = [_make_ctl(d, h) for h in range(2)]
+    results = {}
+
+    def run(h):
+        results[h] = ctls[h].form(expect=[0, 1])
+
+    threads = [threading.Thread(target=run, args=(h,)) for h in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert results[0] == results[1]
+    assert results[0].members == [0, 1]
+    assert results[0].world == 8
+    assert results[0]["coordinator"] == 0
+    # the commit is durable: a late reader adopts the same record
+    assert read_commit(d, 0) == results[0]
+
+
+def test_reform_excludes_stale_host_and_converges(tmp_path):
+    """Host 2 dies before the re-form: its membership ages out, the two
+    survivors' proposals converge on {0,1} and commit world 4 of the
+    logical 8 (3 hosts x capacity 4 = capacity 8 shrank to 8->...->4)."""
+    d = str(tmp_path)
+    write_member(d, 2, capacity=4, epoch=1)  # the dead host's last record
+    ctls = [_make_ctl(d, h, member_timeout_s=0.8) for h in range(2)]
+    for c in ctls:
+        c.epoch = 1
+    time.sleep(1.0)  # host 2's record goes stale
+    for c in ctls:   # the survivors' launchers have been ticking all along
+        c.tick(min_interval_s=0.0)
+    results = {}
+
+    def run(h):
+        results[h] = ctls[h].form(epoch=1)
+
+    threads = [threading.Thread(target=run, args=(h,)) for h in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert results[0] == results[1]
+    assert results[0].members == [0, 1]
+    assert results[0].world == 8  # 2 survivors x capacity 4 fill 8
+
+    # single survivor at the next epoch: world shrinks to its capacity
+    solo = _make_ctl(d, 0, member_timeout_s=0.5)
+    solo.epoch = 2
+    time.sleep(0.8)
+    commit = solo.form(epoch=2)
+    assert commit.members == [0] and commit.world == 4
+
+
+def test_reset_rendezvous_sweeps_previous_run(tmp_path):
+    """A reused --fleet_dir must not replay the previous run's
+    agreement: stale commits/proposals/barrier markers/done-members are
+    swept at startup; fresh membership survives."""
+    d = str(tmp_path)
+    propose_reform(d, 0, epoch=1, members=[0], world=4, restore_step=9)
+    fc._write_json(fc._commit_path(d, 1),
+                   {"epoch": 1, "members": [0], "world": 4,
+                    "restore_step": 9})
+    os.makedirs(os.path.join(d, "barrier.e0.n1"))
+    write_member(d, 1, capacity=4, epoch=3, status="done")  # old run done
+    write_member(d, 0, capacity=4, epoch=0)                 # fresh peer
+    ctl = _make_ctl(d, 1)
+    ctl.reset_rendezvous()
+    assert read_commit(d, 1) is None
+    assert fc.read_proposals(d, 1) == {}
+    assert not os.path.isdir(os.path.join(d, "barrier.e0.n1"))
+    members = read_members(d)
+    assert sorted(members) == [0]  # done-record swept, fresh one kept
+    assert not ctl.reform_requested()
+
+
+def test_agreement_timeout_raises(tmp_path):
+    ctl = _make_ctl(str(tmp_path), 0, agreement_timeout_s=0.5)
+    with pytest.raises(FleetAgreementTimeout):
+        ctl.await_members([0, 1], timeout_s=0.5)
+
+
+def test_reform_requested_channel(tmp_path):
+    d = str(tmp_path)
+    ctl = _make_ctl(d, 0)
+    assert not ctl.reform_requested()
+    propose_reform(d, 1, epoch=1, members=[1], world=4, restore_step=None)
+    assert ctl.reform_requested()
+
+
+def test_fleet_barrier_synchronizes(tmp_path):
+    d = str(tmp_path)
+    barriers = [FleetBarrier(d, h, [0, 1], timeout_s=10.0)
+                for h in range(2)]
+    order = []
+
+    def run(h, delay):
+        time.sleep(delay)
+        barriers[h]()
+        order.append(h)
+
+    threads = [threading.Thread(target=run, args=(0, 0.0)),
+               threading.Thread(target=run, args=(1, 0.3))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert sorted(order) == [0, 1]
+    lone = FleetBarrier(d, 0, [0, 1], epoch=9, timeout_s=0.3)
+    with pytest.raises(FleetAgreementTimeout):
+        lone()  # the peer never arrives at this epoch's barrier
+
+
+# ---------------------------------------------------------------------------
+# restore-step agreement off the journals
+# ---------------------------------------------------------------------------
+def _write_journal(directory, rank, events):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"journal.rank{rank}.jsonl")
+    with open(path, "a") as f:
+        for seq, (kind, fields) in enumerate(events):
+            rec = {"v": 1, "run_id": f"r{rank}", "rank": rank,
+                   "seq": seq, "t": 1000.0 + seq, "kind": kind}
+            rec.update(fields)
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_newest_mutual_checkpoint_step(tmp_path):
+    d = str(tmp_path)
+    # rank 0 staged 2,4,6 and committed 2,4 (6 staged, never published);
+    # rank 1 staged 2,4 only — mutual newest is 4
+    _write_journal(d, 0, [("checkpoint_save", {"step": 2}),
+                          ("checkpoint_commit", {"step": 2}),
+                          ("checkpoint_save", {"step": 4}),
+                          ("checkpoint_commit", {"step": 4}),
+                          ("checkpoint_save", {"step": 6})])
+    _write_journal(d, 1, [("checkpoint_save", {"step": 2}),
+                          ("checkpoint_save", {"step": 4})])
+    assert newest_mutual_checkpoint_step(d, [0, 1]) == 4
+    assert newest_mutual_checkpoint_step(d, [0]) == 4
+    # a survivor with no journal -> nothing provably restorable
+    assert newest_mutual_checkpoint_step(d, [0, 7]) is None
+
+
+def test_reconstruct_timeline_carries_saves_and_reforms(tmp_path):
+    from paddle_tpu.observability.journal import (read_journal,
+                                                  reconstruct_timeline)
+    d = str(tmp_path)
+    _write_journal(d, 0, [("checkpoint_save", {"step": 2}),
+                          ("reform", {"epoch": 1, "world": 4,
+                                      "members": [0],
+                                      "restore_step": 2})])
+    tl = reconstruct_timeline(
+        read_journal(os.path.join(d, "journal.rank0.jsonl")))
+    inc = tl["incarnations"][0]
+    assert inc["saves"] == [2]
+    assert inc["reforms"] == [{"epoch": 1, "world": 4, "members": [0],
+                               "restore_step": 2}]
+
+
+# ---------------------------------------------------------------------------
+# env contract + metrics
+# ---------------------------------------------------------------------------
+def test_env_contract_roundtrip(tmp_path):
+    d = str(tmp_path)
+    ctl = _make_ctl(d, 1)
+    commit = fc.FleetCommit({"epoch": 3, "members": [0, 1], "world": 8,
+                             "restore_step": 40})
+    env = ctl.env_for_workers(commit)
+    fl = fleet_env(env)
+    assert fl is not None
+    assert fl.dir == d and fl.epoch == 3 and fl.host == 1
+    assert fl.hosts == [0, 1] and fl.world == 8
+    assert fl.restore_step == 40
+    assert fl.rank == 1 and fl.n_hosts == 2
+    assert fleet_env({}) is None
+
+
+def test_fleet_gauges_reach_prometheus(tmp_path):
+    from paddle_tpu.core.monitor import prometheus_text
+    ctl = _make_ctl(str(tmp_path), 0)
+    commit = ctl.form(expect=[0])
+    assert commit.members == [0]
+    text = prometheus_text()
+    assert "fleet_members 1" in text
+    assert "fleet_epoch" in text and "fleet_reform_count" in text
+
+
+def test_chaos_lose_host_parses():
+    from paddle_tpu.testing import chaos
+    os.environ["PADDLE_TPU_CHAOS"] = "lose_host@4:host=1"
+    try:
+        chaos.reload()
+        assert chaos.enabled()
+        d = chaos._directives()[0]
+        assert d.kind == "lose_host" and d.step == 4 and d.rank == 1
+    finally:
+        del os.environ["PADDLE_TPU_CHAOS"]
+        chaos.reload()
+
+
+# ---------------------------------------------------------------------------
+# rank-merged checkpoint load (satellite: _read world-mismatch routing)
+# ---------------------------------------------------------------------------
+def _two_host_checkpoint(root, step, state0, state1, extra=None):
+    from paddle_tpu.checkpoint import CheckpointManager
+    m0 = CheckpointManager(root, rank=0, world_size=2)
+    m1 = CheckpointManager(root, rank=1, world_size=2)
+    m0.save(step, state0, extra=extra or {}, sync=True)
+    m1.save(step, state1, sync=True)
+    m0.commit(step)
+    m0.close()
+    m1.close()
+
+
+def test_load_merged_reassembles_rank_complete_state(tmp_path):
+    from paddle_tpu.checkpoint import CheckpointManager
+    root = str(tmp_path)
+    w = np.arange(8).astype(np.float32)
+    _two_host_checkpoint(root, 5,
+                         {"w": w, "r0": np.ones(2, np.float32)},
+                         {"w": w, "r1": np.full(2, 3.0, np.float32)},
+                         extra={"program_fingerprint": "fp"})
+    mm = CheckpointManager(root, rank=0, world_size=1)
+    ck = mm.load()  # on_mismatch='convert' default routes through merge
+    assert ck is not None and ck.step == 5
+    assert sorted(ck.state) == ["r0", "r1", "w"]
+    assert np.array_equal(ck.state["w"], w)
+    assert np.array_equal(ck.state["r1"], np.full(2, 3.0, np.float32))
+    assert ck.extra["merged_from_world"] == 2
+    assert ck.extra["program_fingerprint"] == "fp"
+    mm.close()
+
+
+def test_load_merged_refuses_diverged_ranks(tmp_path):
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointError
+    root = str(tmp_path)
+    w = np.arange(8).astype(np.float32)
+    _two_host_checkpoint(root, 7, {"w": w}, {"w": w + 1})
+    mm = CheckpointManager(root, rank=0, world_size=1)
+    with pytest.raises(CheckpointError, match="differ between writer"):
+        mm.load(step=7)
+    mm.close()
+
+
+def test_load_on_mismatch_error_names_both_worlds(tmp_path):
+    from paddle_tpu.checkpoint import CheckpointManager, CheckpointError
+    root = str(tmp_path)
+    w = np.arange(8).astype(np.float32)
+    _two_host_checkpoint(root, 3, {"w": w}, {"w": w})
+    mm = CheckpointManager(root, rank=0, world_size=1)
+    with pytest.raises(CheckpointError) as ei:
+        mm.load(step=3, on_mismatch="error")
+    assert "world of 2" in str(ei.value)
+    assert "world of 1" in str(ei.value)
+    with pytest.raises(ValueError):
+        mm.load(on_mismatch="sideways")
+    mm.close()
+
+
+def test_load_on_mismatch_warn_keeps_old_behaviour(tmp_path):
+    from paddle_tpu.checkpoint import CheckpointManager
+    root = str(tmp_path)
+    w = np.arange(8).astype(np.float32)
+    _two_host_checkpoint(root, 3, {"w": w, "r0": np.ones(1, np.float32)},
+                         {"w": w, "r1": np.ones(1, np.float32)})
+    mm = CheckpointManager(root, rank=0, world_size=1)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ck = mm.load(step=3, on_mismatch="warn")
+    assert any("NOT merged" in str(w_.message) for w_ in caught)
+    assert "r1" not in ck.state  # own shard only
+    mm.close()
+
+
+def test_load_merged_grown_world_serves_rankless_reader(tmp_path):
+    """1 -> 2 growth: the new rank 1 has no shard of its own in the old
+    layout; on_mismatch='convert' serves it the merged (complete)
+    state."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    root = str(tmp_path)
+    w = np.arange(4).astype(np.float32)
+    m = CheckpointManager(root)  # world_size=1 commit
+    m.save(9, {"w": w}, sync=True)
+    m.close()
+    grown = CheckpointManager(root, rank=1, world_size=2)
+    ck = grown.load(step=9)
+    assert ck is not None and np.array_equal(ck.state["w"], w)
+    grown.close()
+
+
+def test_load_merged_unshards_recorded_zero_plan(tmp_path):
+    """A recorded zero_shard_plan whose dp degree differs from the new
+    world is routed through unshard_state to the plain layout (bucket
+    padding is world-dependent); the plan leaves the sidecar."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    plan = {"dp_degree": 2, "stage": 1, "buckets": [{
+        "name": "zero1/b0_adam", "op_type": "adam", "dtype": "float32",
+        "grad_dtype": "float32", "raw_len": 3, "padded_len": 4,
+        "shard_len": 2,
+        "params": [{"param": "fc.w", "grad": "fc.w@GRAD", "offset": 0,
+                    "numel": 3, "shape": [3]}],
+        "slots": {"moment1": "zero1/b0_adam@moment1"},
+        "scalars": {},
+        "orig_slots": {"fc.w": {"moment1": "fc.w_moment1_0"}},
+        "grad_shard": "g", "param_bucket": None}]}
+    root = str(tmp_path)
+    m = CheckpointManager(root)
+    m.save(4, {"fc.w": np.ones(3, np.float32),
+               "zero1/b0_adam@moment1":
+               np.array([1., 2., 3., 0.], np.float32)},
+           extra={"zero_shard_plan": plan, "dp_degree": 2}, sync=True)
+    m.close()
+    mm = CheckpointManager(root)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        ck = mm.load_merged(step=4, world=4)
+    assert "zero_shard_plan" not in ck.extra
+    assert np.array_equal(ck.state["fc.w_moment1_0"],
+                          np.array([1., 2., 3.], np.float32))
+    assert "zero1/b0_adam@moment1" not in ck.state
+    mm.close()

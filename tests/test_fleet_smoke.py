@@ -1,0 +1,35 @@
+"""Tier-1 gate for the fleet control plane: two supervised process
+groups rendezvous, one is chaos-killed whole, the survivors agree on one
+re-formed world and resume from a rank-merged restore, bitwise-equal to
+an uninterrupted run (tools/fleet_smoke.py; docs/elastic.md)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fleet_smoke_gate():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_smoke.py")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"fleet smoke failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["metric"] == "fleet_smoke_reformed_world"
+    assert result["value"] == 4  # survivors' capacity of the logical 8
+    assert result["bitwise_loss_trace"] is True
+    assert result["bitwise_params"] is True
+    assert result["restore_step"] is not None
+    # budget: the whole two-launcher chaos scenario + in-process
+    # reference; generous headroom over the ~15 s typical so a loaded
+    # CI box never flakes the gate
+    assert result["wall_s"] < 120, result

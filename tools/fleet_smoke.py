@@ -1,0 +1,218 @@
+"""Fleet gate: a two-host elastic fleet survives losing a host, bitwise.
+
+The tier-1 slice of the fleet control plane (tests/test_fleet_smoke.py
+runs it; docs/elastic.md "Cross-host fleets").  Two REAL launcher
+processes (``paddle_tpu.distributed.launch --elastic --fleet_dir``)
+simulate two hosts of a logical-8 fleet on the 8-device CPU mesh, each
+supervising one trainer (tools/fleet_worker.py) that owns 4 of the
+logical chips:
+
+  1. both launchers rendezvous at the shared fleet dir, agree the
+     epoch-0 formation (members {0,1}, world 8) and spawn trainers;
+     trainers publish SHARED rank-sharded checkpoints through the fleet
+     barrier (save → wait → cross-host barrier → rank-0 commit);
+  2. chaos takes host 1 down WHOLE (``lose_host@4:host=1`` SIGKILLs
+     launcher + trainer after global step 2 — no goodbye); host 0's
+     next publish barrier can never pass, so its trainer stalls at the
+     exact committed frontier;
+  3. host 0's controller sees host 1's membership go stale, tears its
+     pod down (SIGTERM — the preemption save stages), runs the
+     two-phase survivor agreement — members {0}, world 4, restore step
+     picked LIVE from the run journals (newest step every survivor
+     staged AND some rank committed) — and relaunches;
+  4. the relaunched trainer's world_size=1 manager hits the world-of-2
+     checkpoint and loads it RANK-MERGED (CheckpointManager.
+     load_merged), resumes at the agreed step, and finishes;
+  5. the survivor's stitched loss trace and final params must be
+     BITWISE equal to an uninterrupted single-process 8-device run
+     (the ROADMAP Done= condition), and the journals must show the
+     reform + merged restore.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/fleet_smoke.py [--steps 4] [--kill-at 2]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOGICAL = 8
+N_HOSTS = 2
+CAPACITY = 4  # logical chips per host
+
+
+def _reference(steps):
+    """Uninterrupted 8-device elastic run: the bitwise oracle."""
+    import jax
+    import paddle_tpu.static as static
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    from paddle_tpu.distributed.elastic import rebucket_feeds
+    from tools.fleet_worker import build_elastic, feeds_for
+    main, startup, loss, meta = build_elastic()
+    exe = static.Executor()
+    scope = static.Scope()
+    trace = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        cp = CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, places=list(jax.devices())[:LOGICAL])
+        for f in feeds_for(steps):
+            for mf in rebucket_feeds(f, LOGICAL, LOGICAL):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            trace.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        params = {p.name: np.asarray(scope.get(p.name)).tolist()
+                  for p in main.all_parameters()}
+    return trace, params
+
+
+def run_smoke(steps: int = 4, kill_at: int = 2, base: str = None):
+    """Run the gate; returns the result dict (AssertionError on a fleet
+    re-form / rank-merged-restore regression)."""
+    # every tier-1 smoke doubles as a verifier sweep
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert 0 < kill_at < steps
+    ndev = len(jax.devices())
+    assert ndev >= LOGICAL, (
+        f"fleet smoke needs {LOGICAL} devices "
+        f"(XLA_FLAGS=--xla_force_host_platform_device_count={LOGICAL}), "
+        f"got {ndev}")
+    t_start = time.time()
+    base = base or tempfile.mkdtemp(prefix="fleet_smoke_")
+    fleet_dir = os.path.join(base, "fleet")
+    journal_dir = os.path.join(base, "journal")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fleet_worker.py")
+    ref_trace, ref_params = _reference(steps)
+
+    # K = LOGICAL/CAPACITY micro-runs per global step on a host mesh
+    kill_run = kill_at * (LOGICAL // CAPACITY)
+    launchers = []
+    for host in range(N_HOSTS):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TPU_FLEET_TEST_DIR": base,
+            "FLEET_TOTAL_STEPS": str(steps),
+            "PADDLE_TPU_CHAOS": f"lose_host@{kill_run}:host=1",
+            "JAX_PLATFORMS": "cpu",
+        })
+        log = open(os.path.join(base, f"launcher{host}.log"), "w")
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--elastic", "--fleet_dir", fleet_dir,
+               "--ips", "127.0.0.1,127.0.0.1",
+               "--host_rank", str(host),
+               "--host_capacity", str(CAPACITY),
+               "--member_timeout", "2.5",
+               "--term_grace", "5",
+               "--journal_dir", journal_dir,
+               worker]
+        launchers.append((host, subprocess.Popen(
+            cmd, env=env, stdout=log, stderr=log), log))
+
+    rcs = {}
+    deadline = time.time() + 120
+    for host, proc, log in launchers:
+        try:
+            rcs[host] = proc.wait(max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(10)
+            rcs[host] = "timeout"
+        log.close()
+
+    def _log(host):
+        try:
+            with open(os.path.join(base, f"launcher{host}.log")) as f:
+                return f.read()[-3000:]
+        except OSError:
+            return "<no log>"
+
+    assert rcs[0] == 0, (
+        f"fleet smoke FAILED: survivor launcher exited {rcs[0]}\n"
+        f"{_log(0)}")
+    assert rcs[1] != 0, (
+        "fleet smoke FAILED: the chaos-killed host's launcher exited 0 "
+        "— lose_host never fired?\n" + _log(1))
+
+    # -- the survivors agreed on ONE re-formed world ------------------------
+    from paddle_tpu.distributed.fleet_control import read_commit
+    commit0 = read_commit(fleet_dir, 0)
+    commit1 = read_commit(fleet_dir, 1)
+    assert commit0 is not None and commit0.members == [0, 1] \
+        and commit0.world == LOGICAL, commit0
+    assert commit1 is not None, "no epoch-1 commit: re-form never agreed"
+    assert commit1.members == [0] and commit1.world == CAPACITY, commit1
+    assert commit1.restore_step is not None, (
+        "re-form carried no restore step (journal agreement failed)")
+
+    # -- stitched survivor trace + final params BITWISE-equal ---------------
+    with open(os.path.join(base, "out_host0_e1.json")) as f:
+        final = json.load(f)
+    assert final["done"], "relaunched trainer never completed"
+    assert final["resumed_global"] == kill_at, final["resumed_global"]
+    with open(os.path.join(base, "out_host0_e0.json")) as f:
+        phase1 = json.load(f)
+    stitched = dict(phase1["losses"])
+    stitched.update(final["losses"])
+    for gi in range(steps):
+        got = stitched.get(str(gi), stitched.get(gi))
+        assert got is not None, f"global step {gi} missing from traces"
+        assert got == ref_trace[gi], (
+            f"fleet smoke FAILED: loss trace diverged at global step "
+            f"{gi}: {got!r} != {ref_trace[gi]!r}")
+    for name, want in ref_params.items():
+        got = final["params"][name]
+        assert np.array_equal(np.asarray(want), np.asarray(got)), (
+            f"fleet smoke FAILED: param {name} diverged after the "
+            "rank-merged fleet restore")
+
+    # -- journals show the reform + the merged restore ----------------------
+    from paddle_tpu.observability.journal import (read_rank_journals,
+                                                  reconstruct_timeline)
+    journals = read_rank_journals(journal_dir)
+    reforms = [r for events in journals.values()
+               for inc in reconstruct_timeline(events)["incarnations"]
+               for r in inc["reforms"]]
+    assert any(r["epoch"] == 1 and r["world"] == CAPACITY
+               for r in reforms), f"no reform event journaled: {reforms}"
+    merged = [e for events in journals.values() for e in events
+              if e.get("kind") == "restore_merged"]
+    assert merged and merged[0]["merged_from_world"] == N_HOSTS, (
+        "survivor did not restore through the rank-merged loader")
+
+    return {
+        "metric": "fleet_smoke_reformed_world",
+        "value": commit1.world,
+        "logical_dp": LOGICAL,
+        "hosts": N_HOSTS,
+        "kill_at_global_step": kill_at,
+        "restore_step": commit1.restore_step,
+        "global_steps": steps,
+        "bitwise_loss_trace": True,
+        "bitwise_params": True,
+        "reform_epochs": 1,
+        "wall_s": round(time.time() - t_start, 2),
+    }
+
+
+def main():
+    steps, kill_at = 4, 2
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    if "--kill-at" in sys.argv:
+        kill_at = int(sys.argv[sys.argv.index("--kill-at") + 1])
+    print(json.dumps(run_smoke(steps=steps, kill_at=kill_at)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Fleet trainer worker — one host of a simulated multi-host elastic fleet.
+
+Spawned by ``paddle_tpu.distributed.launch --elastic --fleet_dir ...``
+(tools/fleet_smoke.py drives two of these as two "hosts").  Reads the
+``PADDLE_TPU_FLEET_*`` env contract, builds the elasticized toy model
+(logical_dp=8), resumes from the SHARED checkpoint root at the fleet's
+agreed restore step — a rank-merged load when the writer world differs
+— and trains the remaining global steps on its local mesh, publishing
+multi-host checkpoints through the fleet barrier (save → wait → barrier
+→ rank-0 commit).  ``PADDLE_TPU_CHAOS`` ``lose_host@...`` may take this
+whole host (launcher included) down mid-run — that is the point.
+
+Each incarnation incrementally rewrites
+``$PADDLE_TPU_FLEET_TEST_DIR/out_host<h>_e<epoch>.json`` with its loss
+trace so the smoke can stitch the survivor's story even for killed
+incarnations; the completing incarnation adds final params + done=True.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LOGICAL = 8
+
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={LOGICAL}").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_elastic():
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.distributed.elastic import elasticize
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    meta = elasticize(main, startup, logical_dp=LOGICAL, loss_name=loss)
+    return main, startup, loss, meta
+
+
+def feeds_for(total_steps):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(LOGICAL, 8).astype(np.float32),
+             "y": rng.rand(LOGICAL, 1).astype(np.float32)}
+            for _ in range(total_steps)]
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.compiled_program import CompiledProgram
+    from paddle_tpu.distributed.elastic import rebucket_feeds
+    from paddle_tpu.distributed.fleet_control import fleet_env
+
+    fl = fleet_env()
+    assert fl is not None, "fleet_worker needs the PADDLE_TPU_FLEET_* env"
+    base = os.environ["PADDLE_TPU_FLEET_TEST_DIR"]
+    total = int(os.environ.get("FLEET_TOTAL_STEPS", "4"))
+    # this host's local mesh: its even share of the fleet world
+    world = max(1, fl.world // fl.n_hosts)
+    k = LOGICAL // world
+    out_json = os.path.join(base, f"out_host{fl.host}_e{fl.epoch}.json")
+
+    main_, startup, loss, meta = build_elastic()
+    exe = static.Executor()
+    scope = static.Scope()
+    mgr = CheckpointManager(os.path.join(base, "ckpt"), rank=fl.rank,
+                            world_size=fl.n_hosts)
+    mgr.install_preemption_handler()  # SIGTERM -> final staged snapshot
+    barrier = fl.barrier(timeout_s=120.0) if fl.n_hosts > 1 else None
+
+    losses = {}
+    g = 0
+
+    def report(done=False, params=None):
+        rec = {"host": fl.host, "epoch": fl.epoch, "rank": fl.rank,
+               "hosts": fl.hosts, "fleet_world": fl.world, "world": world,
+               "restore_step_env": fl.restore_step, "resumed_global": g,
+               "losses": losses, "done": done}
+        if params is not None:
+            rec["params"] = params
+        tmp = out_json + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, out_json)
+
+    with static.scope_guard(scope):
+        exe.run(startup)
+        exe.enable_checkpointing(mgr, program=main_, every_n_steps=k,
+                                 scope=scope, barrier=barrier)
+        resumed = exe.restore_from_checkpoint(
+            mgr, program=main_, scope=scope, world=world,
+            step=fl.restore_step)
+        if resumed is not None:
+            g = int(exe.last_restored_extra.get("global_step", 0))
+        report()
+        cp = CompiledProgram(main_).with_data_parallel(
+            loss_name=loss.name, places=list(jax.devices())[:world])
+        for gi, f in enumerate(feeds_for(total)[g:], start=g):
+            for mf in rebucket_feeds(f, LOGICAL, world):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            losses[gi] = float(np.asarray(out[0]).reshape(-1)[0])
+            report()
+        params = {p.name: np.asarray(scope.get(p.name)).tolist()
+                  for p in main_.all_parameters()}
+        report(done=True, params=params)
+    mgr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,7 @@ MODULES = [
     "paddle_tpu.metric",
     "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet",
+    "paddle_tpu.distributed.fleet_control",
     "paddle_tpu.distributed.tensor_parallel",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
