@@ -590,6 +590,29 @@ def _zero_stage_knob():
     return zs
 
 
+def _tp_knob():
+    """--tp [N] / BENCH_TP_DEGREE=N: Megatron tensor-parallel A/B — the
+    model builds through the tensor_parallel builders at degree N
+    (models.build_transformer_lm).  On this bench's single-device
+    Executor path the Megatron collectives degrade to identity, so
+    tokens/s measures the tp build's dispatch/fusion overhead while
+    predicted_peak_bytes (walker tp division) and wire_bytes_per_axis
+    (mp ring at its own degree, batch-bound) report the dp×tp mesh
+    story — the mesh numbers need CompiledProgram over real chips
+    (queued as tp2_*/auto_tp_* in perf_r05/queue.txt).  A bare --tp
+    targets degree 2 (the v5e 4×2 host split)."""
+    raw = _argv_value("--tp")
+    if raw is None:
+        raw = os.environ.get("BENCH_TP_DEGREE", "0")
+    elif raw == "":
+        raw = os.environ.get("BENCH_TP_DEGREE", "") or "2"
+    tp = int(raw or 0)
+    if tp < 0:
+        raise SystemExit("bench: --tp needs a non-negative degree "
+                         "(e.g. --tp 2)")
+    return 0 if tp == 1 else tp
+
+
 def seq_ladder_main():
     """Sequence-length ladder (`python bench.py --seq-ladder` or
     BENCH_MODE=seq_ladder): builds the bench model at each rung —
@@ -725,6 +748,121 @@ def seq_ladder_main():
     print(json.dumps(result))
 
 
+def tp_main():
+    """Tensor-parallel A/B (`python bench.py --tp N` or
+    BENCH_TP_DEGREE=N): builds the bench geometry through the
+    tensor_parallel builders (models.build_transformer_lm) and trains it
+    over a dp×tp CompiledProgram mesh on the local devices — the tp
+    shards need a real mesh (the per-head reshapes bake local dims, so
+    the single-device Executor path cannot run this build).  On a CPU
+    host the mesh is the virtual 8-device test mesh; on chip it is the
+    tunnel's slice.  Emits ONE JSON line with tokens/s, the tp walker
+    verdict (`analyze_program(tp_degree=)`), and the per-axis wire
+    split (`collective_wire_bytes_by_axis`, mp ring at its own degree,
+    batch-bound) riding ``memory_knobs``."""
+    tp = _tp_knob()
+    if tp <= 1:
+        raise SystemExit("bench --tp: a tensor-parallel degree >= 2 is "
+                         "required in this mode (use the default bench "
+                         "for the tp-off baseline)")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU") or not os.environ.get(
+            "BENCH_AUTO_TPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu.core import compile_cache
+    from paddle_tpu.core.program import _reset_unique_names
+    from paddle_tpu.distributed.compiled_program import (CompiledProgram,
+                                                         BuildStrategy,
+                                                         insert_grad_allreduce)
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    want_world = int(os.environ.get("BENCH_WORLD", "0"))
+    world = min(want_world, len(devices)) if want_world else len(devices)
+    if world % tp != 0 or world < tp:
+        raise SystemExit(
+            f"bench --tp: world {world} does not hold a tp={tp} mesh")
+    dp_world = world // tp
+    seq = int(os.environ.get("BENCH_SEQ", 512 if on_tpu else 32))
+    layers_n = int(os.environ.get("BENCH_LAYERS", 12 if on_tpu else 2))
+    hidden = int(os.environ.get("BENCH_HIDDEN", 768 if on_tpu else 64))
+    heads = int(os.environ.get("BENCH_HEADS", 12 if on_tpu else 4))
+    vocab = int(os.environ.get("BENCH_VOCAB", 30522 if on_tpu else 256))
+    batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 6))
+
+    from paddle_tpu.models import build_transformer_lm
+    _reset_unique_names()
+    main_p, startup_p, loss, _ = build_transformer_lm(
+        vocab_size=vocab, hidden=hidden, num_layers=layers_n,
+        num_heads=heads, seq_len=seq, tensor_parallel_degree=tp)
+    with static.program_guard(main_p, startup_p):
+        static.Adam(learning_rate=1e-4).minimize(loss)
+
+    # compile-time story: tp walker verdict + per-axis wire, recorded
+    # before a single device cycle is spent
+    _mem = static.analyze_program(main_p, batch=batch, tp_degree=tp)
+    reduced = insert_grad_allreduce(main_p)
+    wire_axis = static.collective_wire_bytes_by_axis(reduced, dp_world,
+                                                     batch=batch)
+
+    bs = BuildStrategy()
+    bs.tensor_parallel_degree = tp
+    cp = CompiledProgram(main_p).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs,
+        places=list(devices)[:world])
+    exe = static.Executor()
+    scope = static.Scope()
+    rng = np.random.RandomState(0)
+    idt = np.int64 if jax.config.jax_enable_x64 else np.int32
+    gb = batch * dp_world
+    feed = {"ids": rng.randint(0, vocab, (gb, seq)).astype(idt),
+            "pos": np.tile(np.arange(seq), (gb, 1)).astype(idt),
+            "labels": rng.randint(0, vocab, (gb, seq, 1)).astype(idt)}
+    with static.scope_guard(scope):
+        exe.run(startup_p)
+        exe.run(cp, feed=feed, fetch_list=[loss])      # warm/compile
+        exe.run(cp, feed=feed, fetch_list=[])
+        warm_traces = compile_cache.cache_stats()["traces"]
+        t0 = time.time()
+        for _ in range(steps - 1):
+            exe.run(cp, feed=feed, fetch_list=[])
+        out = exe.run(cp, feed=feed, fetch_list=[loss])
+        np.asarray(out[0])
+        dt = time.time() - t0
+    retraces = compile_cache.cache_stats()["traces"] - warm_traces
+    tokens_per_sec = steps * gb * seq / dt / world  # per chip
+    result = {
+        "metric": "tp_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "on_tpu": on_tpu,
+        "mesh": {"dp": dp_world, "tp": tp},
+        "seq": seq,
+        "global_batch": gb,
+        "measured_step_ms": round(dt / steps * 1e3, 2),
+        "retraces_after_warmup": int(retraces),
+        "predicted_peak_bytes": _mem["peak_bytes"],
+        "predicted_fits": _mem["fits"],
+        "hbm_budget_bytes": _mem["budget_bytes"],
+        "memory_knobs": {"remat": "off", "grad_merge_k": 0,
+                         "ring": False, "dp_shard": 0, "zero_stage": 0,
+                         "tp_degree": tp},
+        "collective_bytes_per_step": {"wire_bytes_per_axis": wire_axis},
+    }
+    assert retraces == 0, "bench --tp: recompile inside the timed loop"
+    if not on_tpu:
+        result["failed"] = True
+        result["note"] = ("CPU mesh run; the walker/wire predictions "
+                          "are the deliverable")
+    print(json.dumps(result))
+
+
 def auto_main():
     """Auto-parallel planner mode (`python bench.py --auto` or
     BENCH_MODE=auto): build the bench model, let
@@ -769,8 +907,28 @@ def auto_main():
     batch = int(os.environ.get("BENCH_BATCH", "0")) or None
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 8))
 
+    # BENCH_TP=1 / BENCH_TP_DEGREES=2,4 put the tensor-parallel axis on
+    # the lattice: tp variants are auto-generated from the model config
+    # through the tensor_parallel builders (no hand-feeding the winner),
+    # so the BASE build uses the same static LM builder for an
+    # apples-to-apples trace.  BENCH_GLOBAL_BATCH=G arms the
+    # effective-global-batch constraint (gm×tp candidates can win).
+    tp_env = os.environ.get("BENCH_TP_DEGREES", "")
+    want_tp = tuple(int(x) for x in tp_env.split(",") if x.strip())
+    use_tp_lattice = bool(want_tp) or \
+        os.environ.get("BENCH_TP", "") not in ("", "0", "false")
+    global_batch = int(os.environ.get("BENCH_GLOBAL_BATCH", "0")) or None
+
     def build(use_ring):
         _reset_unique_names()
+        if use_tp_lattice:
+            from paddle_tpu.models import build_transformer_lm
+            main_b, startup_b, loss_b, _ = build_transformer_lm(
+                vocab_size=vocab, hidden=hidden, num_layers=layers_n,
+                num_heads=heads, seq_len=seq)
+            with static.program_guard(main_b, startup_b):
+                static.Adam(learning_rate=1e-4).minimize(loss_b)
+            return main_b, startup_b, loss_b
         return build_bert_base(vocab, seq, hidden, layers_n, heads,
                                batch or 8, use_amp=use_amp,
                                use_ring=use_ring)
@@ -779,7 +937,7 @@ def auto_main():
     t_plan = time.time()
     main_p, startup_p, loss = build(use_ring=False)
     variants = {}
-    if seq >= 2048:
+    if seq >= 2048 and not use_tp_lattice:
         # the long-seq regime where the ring knob is worth searching;
         # ring attention is emitted at BUILD time, so it enters the
         # lattice as a program variant
@@ -790,11 +948,24 @@ def auto_main():
     knobs = None
     if not on_tpu and batch is None:
         knobs = {"batch": (2, 4, 8)}
+    model_config = None
+    if use_tp_lattice:
+        model_config = dict(vocab_size=vocab, hidden=hidden,
+                            num_layers=layers_n, num_heads=heads,
+                            seq_len=seq, learning_rate=1e-4)
+        if want_tp:
+            knobs = dict(knobs or {})
+            knobs["tp_degree"] = (0,) + want_tp
     plan = static.plan_program(main_p, startup_p, world=world,
                                batch=batch, knobs=knobs,
-                               variants=variants or None)
+                               variants=variants or None,
+                               model_config=model_config,
+                               global_batch=global_batch)
     if plan.knobs["ring"]:
         main_p, startup_p, loss = ring_main, ring_startup, ring_loss
+    tp_chosen = int(plan.knobs.get("tp_degree") or 0)
+    if tp_chosen > 1:
+        main_p, startup_p, loss = plan.build_variants[tp_chosen]
     static.apply_plan(main_p, startup_p, plan)
     plan_wall = time.time() - t_plan
 
@@ -815,9 +986,18 @@ def auto_main():
         return
 
     b = plan.batch
-    gb = b * world
+    dp_world = world // tp_chosen if tp_chosen > 1 else world
+    gb = b * dp_world
+    loss_name = loss if isinstance(loss, str) else loss.name
+    bs_build = None
+    if tp_chosen > 1:
+        from paddle_tpu.distributed.compiled_program import BuildStrategy
+        bs_build = BuildStrategy()
+        bs_build.tensor_parallel_degree = tp_chosen
+        result["mesh"] = {"dp": dp_world, "tp": tp_chosen}
     cp = CompiledProgram(main_p).with_data_parallel(
-        loss_name=loss.name, places=list(devices)[:world])
+        loss_name=loss_name, build_strategy=bs_build,
+        places=list(devices)[:world])
     exe = static.Executor()
     scope = static.Scope()
     rng = np.random.RandomState(0)
@@ -898,6 +1078,12 @@ def main():
         return
     if "--auto" in sys.argv or os.environ.get("BENCH_MODE") == "auto":
         auto_main()
+        return
+    # --tp 1 / --tp 0 explicitly ask for the NO-tensor-parallel
+    # baseline: fall through to the default bench instead of silently
+    # measuring a tp mesh
+    if _tp_knob() > 1:
+        tp_main()
         return
     # allow CPU fallback benchmarking only when explicitly requested or
     # after the full retry budget is exhausted
@@ -1022,8 +1208,10 @@ def main():
         wire_all = static.collective_wire_bytes(reduced, dp_shard)
         # per-mesh-axis split: each ring priced at its OWN degree
         # (tensor-ring collectives never pay the dp world) — the wire
-        # substrate the 2-D planner consumes
-        wire_axis = static.collective_wire_bytes_by_axis(reduced, dp_shard)
+        # substrate the 2-D planner consumes; batch bound so mp-ring
+        # activation collectives price
+        wire_axis = static.collective_wire_bytes_by_axis(reduced, dp_shard,
+                                                         batch=batch)
         _collective_bytes = {"allreduce": plain_bytes,
                              f"zero{zero_stage}": zero_bytes,
                              f"zero{zero_stage}_all_rings": wire_all,

@@ -20,7 +20,7 @@ from .program import Program, OpDesc, OpRole
 
 __all__ = ["register_pass", "get_pass", "apply_passes", "PassContext",
            "all_passes", "record_applied", "applied_passes", "has_applied",
-           "finish_pass"]
+           "finish_pass", "built_tp_degree"]
 
 _PASSES: Dict[str, Callable] = {}
 
@@ -61,6 +61,20 @@ def applied_passes(program: Program) -> List[dict]:
 
 def has_applied(program: Program, name: str) -> bool:
     return any(e.get("pass") == name for e in applied_passes(program))
+
+
+def built_tp_degree(program: Program) -> int:
+    """The tensor-parallel degree a program was BUILT with (0 for plain
+    builds): the `tensor_parallel` builders record themselves in this
+    registry and stamp their ops with ``tp_degree``.  THE one detection
+    rule — the planner's tp pinning/apply gate and the verifier's V504
+    tp-drift check both call it, so they can never disagree."""
+    d = max([int(e.get("tp_degree") or 0) for e in applied_passes(program)
+             if e.get("pass") == "tensor_parallel"] or [0])
+    if d:
+        return d
+    return max([int(op.attrs.get("tp_degree") or 0)
+                for b in program.blocks for op in b.ops] or [0])
 
 
 def finish_pass(program: Program, name: str, startup=None, **meta):

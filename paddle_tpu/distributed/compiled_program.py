@@ -283,6 +283,11 @@ class CompiledProgram:
 
     def _get_mesh(self) -> Mesh:
         if self._mesh is None:
+            # axis names come from the shared canonicalizer
+            # (core/mesh_axes.py) so the runtime mesh and the layout
+            # analyzer can never disagree on the tensor axis's name
+            from ..core.mesh_axes import (DP_AXIS, SP_AXIS,
+                                          MP_AXIS_CANONICAL, runtime_axis)
             devs = np.array(self._devices())
             sp = max(1, int(getattr(self._build_strategy,
                                     "sequence_parallel_degree", 1)))
@@ -295,13 +300,14 @@ class CompiledProgram:
             if sp > 1:
                 dp = len(devs) // sp
                 self._mesh = Mesh(devs[: dp * sp].reshape(dp, sp),
-                                  ("dp", "sp"))
+                                  (DP_AXIS, SP_AXIS))
             elif tp > 1:
                 dp = len(devs) // tp
-                self._mesh = Mesh(devs[: dp * tp].reshape(dp, tp),
-                                  ("dp", "tp"))
+                self._mesh = Mesh(
+                    devs[: dp * tp].reshape(dp, tp),
+                    (DP_AXIS, runtime_axis(MP_AXIS_CANONICAL)))
             else:
-                self._mesh = Mesh(devs, ("dp",))
+                self._mesh = Mesh(devs, (DP_AXIS,))
         return self._mesh
 
     def _get_program(self) -> Program:
@@ -312,31 +318,35 @@ class CompiledProgram:
                 for b in self._program.blocks for v in b.vars.values())
             has_elastic = getattr(self._program, "_elastic_meta",
                                   None) is not None
-            if has_elastic and (
-                    int(getattr(self._build_strategy,
-                                "sequence_parallel_degree", 1)) > 1 or
-                    int(getattr(self._build_strategy,
-                                "tensor_parallel_degree", 1)) > 1):
-                # the ordered fold reduces over ring 0's dp axis only;
-                # under dp×sp gradients are partial over both axes and
-                # the fold would silently drop the sp contributions
+            # dp×tp composes: ring 0 binds to the dp sub-axis only (the
+            # dist_info registry in _traced_step), so the ZeRO bucket
+            # reduce-scatter, the grad allreduce, and the elastic
+            # ordered fold all reduce over dp while the tp leg stays
+            # intact — tp-partial activations are already completed by
+            # the builders' mp_allreduce_sum, tp-sharded weight grads
+            # are per-shard values that must NOT cross the tp axis, and
+            # dp_shard slot buckets place P("dp") on the 2-D mesh
+            # (replicated over tp).  dp×sp still refuses: there
+            # gradients are partial over BOTH axes and a dp-only
+            # reduction silently drops the sp contributions.
+            if has_elastic and int(getattr(
+                    self._build_strategy,
+                    "sequence_parallel_degree", 1)) > 1:
                 raise NotImplementedError(
                     "elastic programs (distributed/elastic.elasticize) "
-                    "compose with a pure dp mesh only; sequence/tensor "
-                    "parallel degrees must be 1")
-            if has_zero and (
-                    int(getattr(self._build_strategy,
-                                "sequence_parallel_degree", 1)) > 1 or
-                    int(getattr(self._build_strategy,
-                                "tensor_parallel_degree", 1)) > 1):
-                # under dp×sp grads are partial over BOTH axes but the
-                # ZeRO reduce-scatter rides ring 0's first axis only;
-                # under dp×tp the slot-spec interplay is untested —
-                # refuse rather than silently mis-reduce
+                    "compose with dp or dp×tp meshes only; the ordered "
+                    "fold reduces ring 0's dp axis, but under dp×sp "
+                    "gradients are partial over both axes "
+                    "(sequence_parallel_degree must be 1)")
+            if has_zero and int(getattr(
+                    self._build_strategy,
+                    "sequence_parallel_degree", 1)) > 1:
                 raise NotImplementedError(
                     "ZeRO-1 sharded programs (shard_optimizer_states) "
-                    "compose with a pure dp mesh only; sequence/tensor "
-                    "parallel degrees must be 1")
+                    "compose with dp or dp×tp meshes only; the bucket "
+                    "reduce-scatter rides ring 0's dp axis, but under "
+                    "dp×sp gradients are partial over both axes "
+                    "(sequence_parallel_degree must be 1)")
             if self._is_data_parallel:
                 scale = (self._build_strategy.gradient_scale_strategy ==
                          GradientScaleStrategy.CoeffNumDevice and n > 1)
@@ -361,12 +371,15 @@ class CompiledProgram:
 
     def _anchor_elastic(self, executor, scope, elastic, n_dev) -> int:
         """Resolve K for THIS mesh and re-anchor a topology-shifted
-        restore's counters against it; returns micro_k."""
+        restore's counters against it; returns micro_k.  `n_dev` is the
+        mesh's DP degree — under a dp×tp mesh the elastic schedule folds
+        over dp sub-ranks only (the tp leg is model parallelism, not
+        extra data-parallel capacity)."""
         n_logical = int(elastic["logical_dp"])
         if n_logical % n_dev != 0:
             raise ValueError(
                 f"elastic logical_dp={n_logical} is not divisible by "
-                f"the mesh world {n_dev}")
+                f"the mesh dp degree {n_dev}")
         micro_k = n_logical // n_dev
         # topology-shifted resume: restore_from_checkpoint left the
         # schedule position in GLOBAL steps (it cannot know the new
@@ -408,7 +421,8 @@ class CompiledProgram:
         elastic = getattr(program, "_elastic_meta", None)
         micro_k = 1
         if elastic is not None:
-            micro_k = self._anchor_elastic(executor, scope, elastic, n_dev)
+            micro_k = self._anchor_elastic(executor, scope, elastic,
+                                           int(mesh.shape["dp"]))
 
         # pre-placed feeds (reader.Prefetcher via place_feed) pass through;
         # host arrays take the synchronous conversion
@@ -525,17 +539,17 @@ class CompiledProgram:
                        for f in (fetch_list or [])]
         program = self._get_program()
         mesh = self._get_mesh()
-        if set(mesh.axis_names) - {"dp"}:
+        if set(mesh.axis_names) - {"dp", "tp"}:
             raise NotImplementedError(
-                "run_steps through CompiledProgram supports pure-dp "
-                "meshes only (sequence/tensor parallel degrees must "
+                "run_steps through CompiledProgram supports dp and "
+                "dp×tp meshes only (sequence parallel degree must "
                 "be 1)")
         n_dev = len(mesh.devices.flat)
         elastic = getattr(program, "_elastic_meta", None)
         micro_k = 1
         if elastic is not None:
             micro_k = self._anchor_elastic(executor, scope, elastic,
-                                           n_dev)
+                                           int(mesh.shape["dp"]))
         feed_vals = {n: v if isinstance(v, jax.Array) else jnp.asarray(v)
                      for n, v in feed.items()}
         k = None
@@ -776,7 +790,6 @@ class CompiledProgram:
         block = program.global_block()
         axes = tuple(mesh.axis_names)
         has_sp = "sp" in axes
-        has_tp = "tp" in axes
         step = self._traced_step(program, state_names, fetch_names, mesh)
 
         # ZeRO sharded buckets (distributed/sharding.py stages 1-3:
@@ -788,47 +801,11 @@ class CompiledProgram:
         # come from the partition-spec engine — the single consumption
         # point, so the engine's plan and the mesh's placement can never
         # drift apart.
+        # dist_attr tp param sharding + accumulator inheritance live in
+        # the engine too, so the per-dispatch and scanned compile paths
+        # place identical 2-D layouts
         from .partition_spec import state_partition_specs
         state_specs = state_partition_specs(program, mesh, state_names)
-        if has_tp:
-            # param sharding from dist_attr annotations
-            # (tensor_parallel.py shard_param); optimizer accumulators
-            # inherit their param's sharding by name prefix + equal shape
-            annotated = {}
-            for n in state_names:
-                try:
-                    v = block.var(n)
-                except KeyError:
-                    continue
-                da = v.attrs.get("dist_attr")
-                if da:
-                    axis, dim = da
-                    spec = [None] * len(v.shape or ())
-                    spec[int(dim)] = axis
-                    state_specs[n] = P(*spec)
-                    annotated[n] = (tuple(v.shape or ()), P(*spec))
-            for n in state_names:
-                if n in annotated:
-                    continue
-                try:
-                    v = block.var(n)
-                except KeyError:
-                    continue
-                shape = tuple(v.shape or ())
-                # explicit accumulator→param link (set by
-                # Optimizer._add_accumulator) — the old name-prefix+shape
-                # heuristic could match an unrelated var whose name
-                # happened to extend an annotated param's
-                owner = v.attrs.get("accum_of")
-                if owner is not None:
-                    hit = annotated.get(owner)
-                    if hit is not None and shape == hit[0]:
-                        state_specs[n] = hit[1]
-                    continue
-                for pname, (pshape, pspec) in annotated.items():
-                    if n.startswith(pname + "_") and shape == pshape:
-                        state_specs[n] = pspec
-                        break
         if has_sp:
             # batch over dp, sequence (dim 1) over sp; rank-1 feeds
             # (e.g. flat labels) shard batch only

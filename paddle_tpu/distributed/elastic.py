@@ -173,19 +173,30 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
     # (acc, folded, sharded) triples to reset on commit
     resets: List[tuple] = []
 
-    def _fold(src_name, like_var, hint):
-        """acc += ordered cross-rank fold of `src_name`; returns the
-        folded (pre-reset) temp and registers the reset."""
+    def _fold(src_name, like_var, hint, dist_attr=None):
+        """acc += ordered cross-rank fold of `src_name` (over the dp
+        axis — ring 0 binds to the dp sub-axis on a dp×tp mesh, leaving
+        the tp leg intact); returns the folded (pre-reset) temp and
+        registers the reset.  `dist_attr` (the owning param's tp
+        annotation) makes the accumulator shard over tp like the grad
+        it folds — a tp-sharded grad is a LOCAL shard at runtime, so a
+        replicated global-shape accumulator would shape-mismatch inside
+        the trace."""
         acc = unique_name(hint + "@ELASTIC_ACC")
         shape = list(like_var.shape or [1])
         _persistable(acc, shape, like_var.dtype or "float32", 0.0)
+        if dist_attr:
+            for blk in (block, sblock):
+                blk.var(acc).attrs["dist_attr"] = list(dist_attr)
         folded = new_tmp_var(block, like=block.var(acc),
                              name_hint=hint + "@ELASTIC_FOLD")
         _op(program, block, "c_elastic_fold",
             {"X": [src_name], "Acc": [acc]}, {"Out": [folded]},
             {"ring_id": 0, "logical_dp": n})
         acc_names.append(acc)
-        resets.append((acc, folded, False))
+        # tp-sharded accumulators reset through fill_zeros_like so the
+        # zeros follow the runtime (local-shard) shape, like dp_shard
+        resets.append((acc, folded, bool(dist_attr)))
         return folded
 
     # -- ZeRO-1 composition (stage-1 plans only, gated above) ---------------
@@ -269,7 +280,10 @@ def elasticize(program: Program, startup: Program, logical_dp: int,
         if gname in bucket_grads:
             continue  # folded at the bucket-shard level instead
         gvar = block.var(gname)
-        folded = _fold(gname, gvar, gname)
+        pvar = block.vars.get(p.name if hasattr(p, "name") else str(p))
+        folded = _fold(gname, gvar, gname,
+                       dist_attr=(pvar.attrs.get("dist_attr")
+                                  if pvar is not None else None))
         committed = new_tmp_var(block, like=gvar,
                                 name_hint=gname + "@ELASTIC_AVG")
         _op(program, block, "scale", {"X": [folded]}, {"Out": [committed]},

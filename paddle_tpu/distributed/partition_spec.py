@@ -293,14 +293,27 @@ def build_sharding_specs(program, stage: int,
 
 def state_partition_specs(program, mesh, state_names: Iterable[str]):
     """The `shard_map` in/out specs for a program's persistable state:
-    materialize every ``dp_shard``-marked var (the ZeRO passes' stamped
-    spec) as ``PartitionSpec("dp")``, everything else replicated.  The
-    single consumption point `CompiledProgram` routes through, so the
-    spec the engine decided and the spec the mesh executes can never
-    drift apart."""
+
+    * every ``dp_shard``-marked var (the ZeRO passes' stamped spec)
+      materializes as ``PartitionSpec("dp")`` — on a 2-D dp×tp mesh
+      that places the bucket over the dp sub-axis only, replicated
+      across tp (each tp rank holds, donates and updates the same slot
+      shard — the ZeRO×tp composition's placement contract);
+    * when the mesh carries a ``tp`` axis, parameters annotated
+      ``dist_attr`` (`tensor_parallel.shard_param`) shard their
+      declared dim over it, and optimizer accumulators inherit their
+      param's spec through the ``accum_of`` link (name-prefix + equal
+      shape as the legacy fallback);
+    * everything else is replicated.
+
+    The single consumption point `CompiledProgram` routes through (both
+    the per-dispatch and scanned compile paths), so the spec the engine
+    decided and the spec the mesh executes can never drift apart."""
     from jax.sharding import PartitionSpec as P
     block = program.global_block()
+    has_tp = "tp" in getattr(mesh, "axis_names", ())
     specs = {}
+    annotated = {}
     for n in state_names:
         try:
             v = block.var(n)
@@ -316,6 +329,38 @@ def state_partition_specs(program, mesh, state_names: Iterable[str]):
                     f"divide the mesh dp degree {dp}; re-run "
                     f"shard_optimizer_states for this mesh")
             specs[n] = P("dp")
-        else:
-            specs[n] = P()
+            continue
+        da = v.attrs.get("dist_attr") if has_tp else None
+        if da:
+            axis, dim = da
+            spec = [None] * len(v.shape or ())
+            spec[int(dim)] = axis
+            specs[n] = P(*spec)
+            annotated[n] = (tuple(v.shape or ()), P(*spec))
+            continue
+        specs[n] = P()
+    if annotated:
+        # optimizer accumulators inherit their param's tp sharding
+        for n in state_names:
+            if n in annotated or specs.get(n) != P():
+                continue
+            try:
+                v = block.var(n)
+            except KeyError:
+                continue
+            shape = tuple(v.shape or ())
+            # explicit accumulator→param link (set by
+            # Optimizer._add_accumulator) — the old name-prefix+shape
+            # heuristic could match an unrelated var whose name
+            # happened to extend an annotated param's
+            owner = v.attrs.get("accum_of")
+            if owner is not None:
+                hit = annotated.get(owner)
+                if hit is not None and shape == hit[0]:
+                    specs[n] = hit[1]
+                continue
+            for pname, (pshape, pspec) in annotated.items():
+                if n.startswith(pname + "_") and shape == pshape:
+                    specs[n] = pspec
+                    break
     return specs

@@ -254,6 +254,17 @@ def _collect_candidates(block, warn: bool) -> List[Tuple[int, "OpDesc"]]:
         if pvar.shape is None or any(d is None or int(d) < 0
                                      for d in pvar.shape):
             continue  # dynamic-shaped param: cannot compute static offsets
+        if pvar.attrs.get("dist_attr"):
+            # tensor-parallel weight shard (tensor_parallel.shard_param):
+            # under a dp×tp mesh each rank's runtime value is a LOCAL
+            # shard whose length differs from the declared global shape,
+            # so the flat dp bucket's static offsets would misalign —
+            # and its grads must reduce over dp only, which the
+            # per-param allreduce path (ring 0 → "dp") already does.
+            # Its slots inherit the tp sharding through state_partition_
+            # specs instead: tp divides that memory, ZeRO covers the
+            # replicated remainder.
+            continue
         gvar = block.vars.get(gnames[0])
         if gvar is not None and gvar.attrs.get("var_type") == \
                 "SELECTED_ROWS":

@@ -43,12 +43,15 @@ TP_RING_ID = 102
 
 # the canonical model-parallel axis name the layout analyzer speaks
 # (the runtime mesh axis is spelled "tp" — same axis, CompiledProgram
-# binds TP_RING_ID to it)
-MP_AXIS = "mp"
+# binds TP_RING_ID to it); both spellings come from the shared
+# canonicalizer so the stamp and the runtime mesh can never drift
+from ..core.mesh_axes import MP_AXIS_CANONICAL as MP_AXIS
+from ..core.mesh_axes import MP_AXIS_RUNTIME as _TP_AXIS
 
 
-def shard_param(var: VarDesc, dim: int, axis: str = "tp") -> VarDesc:
-    """Annotate a parameter as sharded over `axis` at `dim`."""
+def shard_param(var: VarDesc, dim: int, axis: str = _TP_AXIS) -> VarDesc:
+    """Annotate a parameter as sharded over `axis` at `dim` (runtime
+    spelling; the layout analyzer canonicalizes via core/mesh_axes)."""
     var.attrs["dist_attr"] = [axis, int(dim)]
     return var
 

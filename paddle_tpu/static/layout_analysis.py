@@ -69,12 +69,9 @@ __all__ = ["LayoutSpec", "ShardingLayout", "propagate_shardings",
 MODEL_AXES = frozenset(("mp", "sp"))
 
 # the runtime mesh spells the model axis "tp" (CompiledProgram); the
-# analyzer canonicalizes to "mp" (the ROADMAP's dp × mp vocabulary)
-_AXIS_ALIASES = {"tp": "mp"}
-
-
-def _canon(axis: Optional[str]) -> Optional[str]:
-    return _AXIS_ALIASES.get(axis, axis) if axis else None
+# analyzer canonicalizes to "mp" (the ROADMAP's dp × mp vocabulary) —
+# both via the ONE shared table in core/mesh_axes.py
+from ..core.mesh_axes import canonical_axis as _canon
 
 
 class LayoutSpec:
@@ -990,15 +987,61 @@ class _Engine:
         self._check_divisibility()
         return iters
 
+    def _local_shape_region(self) -> Set[str]:
+        """Vars whose DECLARED shapes are build-time LOCAL shards: the
+        downstream closure of every head-split reshape whose known-dim
+        numel drops by exactly the degree of a model axis THE OUTPUT IS
+        SHARDED OVER (parallel_attention reshapes [b, t, H] globals
+        into [b, t, H/tp/d, d] locals — the division is baked into the
+        target shape).  V605 must not judge these extents against the
+        mesh degree: they are already divided.  The closure ends where
+        the local representation does — at the reduction/gather
+        collectives that return values to the global representation
+        (the row-parallel g, tensor-ring gathers), so vars after the
+        block boundary are judged normally again."""
+        local: Set[str] = set()
+        for op in self.block.ops:
+            if op.type in _REDUCTION_COLLECTIVES or \
+                    op.type in _GATHER_COLLECTIVES:
+                continue  # outputs are global-representation again
+            seeded = False
+            if op.type in ("reshape", "reshape2"):
+                x = _first(op.inputs.get("X", []))
+                out = _first(op.outputs.get("Out", []))
+                in_shape = _shape_of(self.block, x)
+                out_shape = _shape_of(self.block, out)
+                out_axes = self.get(out).model_axes() if out else set()
+                if in_shape is not None and out_shape is not None and \
+                        out_axes:
+                    pin = pout = 1
+                    for v in in_shape:
+                        if int(v) > 0:
+                            pin *= int(v)
+                    for v in out_shape:
+                        if int(v) > 0:
+                            pout *= int(v)
+                    for a in out_axes:
+                        g = int(self.mesh.get(a) or 0)
+                        if g > 1 and pout > 0 and pin == pout * g:
+                            seeded = True
+            if seeded or any(n in local for n in op.input_names()):
+                local.update(n for n in op.output_names() if n)
+        return local
+
     def _check_divisibility(self):
         """V605: a model-axis shard whose declared dim does not divide
-        the mesh degree of its axis."""
+        the mesh degree of its axis.  Vars in the build-time-local
+        region (see `_local_shape_region`) are exempt — their extents
+        already encode the division."""
         producers: Dict[str, Tuple[int, OpDesc]] = {}
         for i, op in enumerate(self.block.ops):
             for n in op.output_names():
                 if n and n not in producers:
                     producers[n] = (i, op)
+        local = self._local_shape_region()
         for name, spec in sorted(self.specs.items()):
+            if name in local:
+                continue
             for d, a in enumerate(spec.spec):
                 if a not in MODEL_AXES:
                     continue

@@ -64,7 +64,8 @@ import numpy as np
 from ..core.program import Program, OpRole
 
 __all__ = ["estimate_peak_bytes", "analyze_program", "hbm_budget_bytes",
-           "select_layer_checkpoints", "DEFAULT_HBM_BYTES"]
+           "select_layer_checkpoints", "mp_sharded_vars",
+           "DEFAULT_HBM_BYTES"]
 
 # v5e usable HBM: the 16 GiB card minus the XLA runtime reserve — the
 # ceiling the round-5 allocator errors quoted ("15.75G of 16.00G").
@@ -251,11 +252,21 @@ def _strip_derived(name: str) -> Optional[str]:
 
 class _Sizer:
     """name -> bytes, binding symbolic -1 dims to `batch` and resolving
-    derived names (@GRAD/@RC/...) to their base var's shape/dtype."""
+    derived names (@GRAD/@RC/...) to their base var's shape/dtype.
 
-    def __init__(self, block, batch: int):
+    `tp_sharded`/`tp_degree`: vars the sharding-propagation analyzer
+    proved mp-sharded are charged 1/degree per chip — each rank
+    materializes only its feature shard (weights, their grads and
+    residual activations between a column- and row-parallel layer).
+    Derived names divide through their BASE var's verdict: the grad of
+    a sharded weight is the same local shard."""
+
+    def __init__(self, block, batch: int, tp_sharded=None,
+                 tp_degree: int = 0):
         self.block = block
         self.batch = max(1, int(batch))
+        self.tp_sharded = tp_sharded or frozenset()
+        self.tp_degree = max(0, int(tp_degree))
         self.cache: Dict[str, int] = {}
         self.unknown: List[str] = []
 
@@ -285,10 +296,14 @@ class _Sizer:
     def __call__(self, name: str) -> int:
         if name in self.cache:
             return self.cache[name]
-        size = self._var_bytes(self.var_of(name))
+        var = self.var_of(name)
+        size = self._var_bytes(var)
         if size is None:
             self.unknown.append(name)
             size = 0
+        elif self.tp_degree > 1 and var is not None and \
+                var.name in self.tp_sharded:
+            size = -(-size // self.tp_degree)
         self.cache[name] = size
         return size
 
@@ -306,10 +321,33 @@ def _phase_of(op) -> str:
     return "forward"
 
 
+def mp_sharded_vars(program: Program, tp_degree: int) -> Set[str]:
+    """The vars a `tp_degree` tensor-parallel mesh holds at 1/tp per
+    chip: everything the sharding-propagation analyzer proves
+    mp-sharded (annotated weights, their grads' base vars, and the
+    feature-sharded activations between a column- and row-parallel
+    layer), plus their ``accum_of``-linked optimizer accumulators.
+    Batch-independent — callers pricing many batch buckets of one
+    program (the planner's `_RewritePoint`) compute it once and pass it
+    to `analyze_program(tp_sharded=)`."""
+    from .layout_analysis import propagate_shardings
+    layout = propagate_shardings(program,
+                                 mesh_shape={"mp": int(tp_degree)})
+    out = {n for n, s in layout.specs.items() if "mp" in s.axes()}
+    for b in program.blocks:
+        for v in b.vars.values():
+            owner = v.attrs.get("accum_of")
+            if owner and owner in out:
+                out.add(v.name)
+    return out
+
+
 def analyze_program(program: Program, batch: Optional[int] = None,
                     budget_bytes: Optional[int] = None,
                     dp_shard: Optional[int] = None,
-                    zero_stage: Optional[int] = None) -> Dict:
+                    zero_stage: Optional[int] = None,
+                    tp_degree: Optional[int] = None,
+                    tp_sharded: Optional[Set[str]] = None) -> Dict:
     """Full liveness report for `program`'s global block.
 
     Returns a dict with ``peak_bytes`` (persistables + peak live
@@ -344,6 +382,17 @@ def analyze_program(program: Program, batch: Optional[int] = None,
     bound — it does not model the transient gathered copies — so the
     applied program's walk is the authority (the planner prices applied
     clones, never predictions).
+
+    `tp_degree` prices a TENSOR-PARALLEL mesh: the sharding-propagation
+    analyzer (`static.propagate_shardings` over an {"mp": tp} mesh)
+    decides which vars are mp-sharded — annotated weights, their
+    optimizer accumulators (``accum_of``), and the feature-sharded
+    activations between a column- and row-parallel layer — and each is
+    charged 1/tp per chip.  Everything propagation can't prove sharded
+    (replicated embeddings, partial sums, tainted vars) stays
+    full-size, so the verdict is conservative.  `tp_sharded` takes the
+    precomputed set (`mp_sharded_vars` — batch-independent) so repeated
+    batch-bucket pricing skips the propagation re-run.
     """
     from ..core.flags import flag
     if batch is None:
@@ -356,7 +405,14 @@ def analyze_program(program: Program, batch: Optional[int] = None,
     pred_stage = max(1, int(zero_stage)) if pred_shard else 0
     budget = hbm_budget_bytes() if budget_bytes is None else int(budget_bytes)
     block = program.global_block()
-    sizer = _Sizer(block, batch)
+    tp = int(tp_degree) if tp_degree and int(tp_degree) > 1 else 0
+    mp_sharded: Set[str] = set()
+    if tp:
+        # tp_sharded: the precomputed (batch-independent) set, so
+        # callers pricing many batch buckets don't re-run propagation
+        mp_sharded = (set(tp_sharded) if tp_sharded is not None
+                      else mp_sharded_vars(program, tp))
+    sizer = _Sizer(block, batch, mp_sharded, tp)
 
     var_desc = {}
     persistable: Set[str] = set()
@@ -500,6 +556,7 @@ def analyze_program(program: Program, batch: Optional[int] = None,
     return {
         "batch": int(batch),
         "dp_shard": int(pred_shard) if pred_shard else None,
+        "tp_degree": tp or None,
         "persistable_bytes": int(persistable_bytes),
         "optimizer_slot_bytes": int(slot_bytes),
         # per-chip PARAMETER state (replicated params, or the 1/degree

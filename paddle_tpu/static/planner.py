@@ -87,13 +87,19 @@ DEFAULT_BUCKET_MB = (32,)
 # gathers (distributed/sharding.py)
 DEFAULT_ZERO_STAGES = (1, 2, 3)
 
-# the full knob tuple one lattice point carries, in table order
+# the full knob tuple one lattice point carries, in table order.
+# tp_degree is a BUILD-VARIANT axis (0 = the base build): candidates
+# are whole alternative builds of the transformer blocks via the
+# tensor_parallel builders, entering the lattice like the ring knob —
+# pre-built pairs in `variants={"tp": {degree: (main, startup)}}`, or
+# auto-generated from `model_config=`.
 KNOB_KEYS = ("batch", "remat", "dp_shard", "zero_stage", "grad_merge",
-             "bucket_mb", "ring")
+             "bucket_mb", "ring", "tp_degree")
 
-# gradient reduction collectives XLA overlaps with backward compute;
-# everything else (the allgather publish, forward collectives) is
-# serial on the critical path
+# gradient reduction collectives XLA overlaps with backward compute —
+# on ring 0 (the dp axis) only: an mp-ring collective sits on the
+# forward/backward critical path of the very matmuls it completes, so
+# tensor-ring bytes are serial no matter the op type
 _OVERLAPPABLE = frozenset((
     "c_allreduce_sum", "c_reducescatter", "mp_allreduce_sum",
     "c_elastic_fold",
@@ -140,6 +146,11 @@ class Plan:
         self.predicted_wire_ms = float(chosen["wire_overlap_ms"] +
                                        chosen["wire_serial_ms"])
         self.predicted_flops = int(chosen["flops"])
+        self.predicted_effective_global_batch = int(
+            chosen.get("effective_global_batch") or 0)
+        # tp build pairs (plan_program fills this in): {degree: (main,
+        # startup[, loss_name])} so callers can train the winning build
+        self.build_variants: Dict[int, Tuple] = {}
 
     @property
     def batch(self) -> int:
@@ -160,6 +171,8 @@ class Plan:
                 dict(self.predicted_wire_bytes_per_axis),
             "predicted_compute_ms": round(self.predicted_compute_ms, 4),
             "predicted_wire_ms": round(self.predicted_wire_ms, 4),
+            "predicted_effective_global_batch":
+                self.predicted_effective_global_batch,
             "n_candidates": len(self.trace),
         }
 
@@ -167,20 +180,21 @@ class Plan:
         """The per-candidate trace as a markdown table (the docs/perf.md
         decision-table source)."""
         head = ("| batch | remat | dp_shard | stage | gm K | bucket MB | "
-                "ring | peak GiB | fits | step ms | verdict |")
-        sep = "|---|---|---|---|---|---|---|---|---|---|---|"
+                "ring | tp | peak GiB | fits | step ms | verdict |")
+        sep = "|---|---|---|---|---|---|---|---|---|---|---|---|"
         rows = [head, sep]
         for c in self.trace:
             rows.append(
                 "| {batch} | {remat} | {dp_shard} | {zero_stage} | "
-                "{grad_merge} | {bucket_mb} | {ring} | {gib:.2f} | "
-                "{fits} | {step_ms:.2f} | {verdict} |".format(
+                "{grad_merge} | {bucket_mb} | {ring} | {tp_degree} | "
+                "{gib:.2f} | {fits} | {step_ms:.2f} | {verdict} |".format(
                     gib=c["peak_bytes"] / 2 ** 30,
                     fits="yes" if c["fits"] else "no",
-                    **{k: c[k] for k in ("batch", "remat", "dp_shard",
-                                         "zero_stage", "grad_merge",
-                                         "bucket_mb", "ring", "step_ms",
-                                         "verdict")}))
+                    **{k: c.get(k, 0)
+                       for k in ("batch", "remat", "dp_shard",
+                                 "zero_stage", "grad_merge",
+                                 "bucket_mb", "ring", "tp_degree",
+                                 "step_ms", "verdict")}))
         return "\n".join(rows)
 
     def __repr__(self):
@@ -213,50 +227,72 @@ class _QuietVerify:
 
 def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
                   have_ring_variant: bool,
-                  can_remat: bool, can_gm: bool) -> List[Dict]:
+                  can_remat: bool, can_gm: bool,
+                  tp_candidates: Tuple[int, ...] = ()) -> List[Dict]:
     """Enumerate the candidate lattice points (dicts of knob values),
     deduplicating no-op combinations (bucket_mb only matters when
     sharding; remat only when checkpoints exist; gm only when the
-    program recorded its param/grad pairs)."""
+    program recorded its param/grad pairs).  `tp_candidates` are the
+    tensor-parallel degrees build variants exist for; each tp degree
+    carves the world into dp×tp, so the dp_shard axis under tp `d`
+    ranges over divisors of world//d."""
     knobs = dict(knobs or {})
     batches = tuple(knobs.get("batch") or
                     ((int(batch),) if batch else DEFAULT_BATCH_BUCKETS))
     remats = tuple(knobs.get("remat") or
                    ((False, True) if can_remat else (False,)))
-    dps = tuple(knobs.get("dp_shard") or
-                ((0, int(world)) if world > 1 else (0,)))
     stages = tuple(knobs.get("zero_stage") or DEFAULT_ZERO_STAGES)
     gms = tuple(knobs.get("grad_merge") or
                 (DEFAULT_GRAD_MERGE if can_gm else (1,)))
     buckets = tuple(knobs.get("bucket_mb") or DEFAULT_BUCKET_MB)
     rings = tuple(knobs.get("ring") or
                   ((False, True) if have_ring_variant else (False,)))
+    tps = tuple(knobs.get("tp_degree")
+                if knobs.get("tp_degree") is not None
+                else ((0,) + tuple(sorted(tp_candidates))))
 
     seen = set()
     out = []
-    for b, r, dp, z, gm, mb, ring in itertools.product(
-            batches, remats, dps, stages, gms, buckets, rings):
-        if ring and not have_ring_variant:
+    for tp in tps:
+        tp = int(tp)
+        if tp > 1 and tp not in tp_candidates:
+            continue  # no build variant for this degree
+        if tp > 1 and world % tp != 0:
             continue
-        if not can_remat and r:
-            continue
-        if not can_gm and gm > 1:
-            continue
-        mb_eff = int(mb) if dp > 1 else 0   # bucket size is a ZeRO knob
-        # the stage axis only exists once a dp degree does; stage 2
-        # without gradient_merge IS stage 1 (the sharded accumulator
-        # only materializes under a merge window), so it collapses
-        z_eff = int(z) if dp > 1 else 0
-        if z_eff == 2 and gm <= 1:
-            z_eff = 1
-        key = (int(b), bool(r), int(dp), z_eff, int(gm), mb_eff,
-               bool(ring))
-        if key in seen:
-            continue
-        seen.add(key)
-        out.append({"batch": int(b), "remat": bool(r), "dp_shard": int(dp),
-                    "zero_stage": z_eff, "grad_merge": int(gm),
-                    "bucket_mb": mb_eff, "ring": bool(ring)})
+        dp_world = world // tp if tp > 1 else world
+        dps_raw = knobs.get("dp_shard") or \
+            ((0, dp_world) if dp_world > 1 else (0,))
+        # under tp the dp sub-axis shrinks: a requested shard degree
+        # that no longer divides it is dropped, not mis-padded
+        dps = tuple(d for d in dps_raw
+                    if d == 0 or (d <= dp_world and dp_world % d == 0)) \
+            or (0,)
+        for b, r, dp, z, gm, mb, ring in itertools.product(
+                batches, remats, dps, stages, gms, buckets, rings):
+            if ring and not have_ring_variant:
+                continue
+            if ring and tp > 1:
+                continue  # one model axis per mesh (ring = sp)
+            if not can_remat and r and tp == 0:
+                continue
+            if not can_gm and gm > 1 and tp == 0:
+                continue
+            mb_eff = int(mb) if dp > 1 else 0  # bucket size is a ZeRO knob
+            # the stage axis only exists once a dp degree does; stage 2
+            # without gradient_merge IS stage 1 (the sharded accumulator
+            # only materializes under a merge window), so it collapses
+            z_eff = int(z) if dp > 1 else 0
+            if z_eff == 2 and gm <= 1:
+                z_eff = 1
+            key = (int(b), bool(r), int(dp), z_eff, int(gm), mb_eff,
+                   bool(ring), tp)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append({"batch": int(b), "remat": bool(r),
+                        "dp_shard": int(dp), "zero_stage": z_eff,
+                        "grad_merge": int(gm), "bucket_mb": mb_eff,
+                        "ring": bool(ring), "tp_degree": tp})
     return out
 
 
@@ -290,22 +326,32 @@ def _apply_knobs(main: Program, startup: Optional[Program],
 
 
 class _RewritePoint:
-    """One (remat, dp_shard, grad_merge, bucket_mb, ring) rewrite tuple,
-    applied and wire-priced ONCE and shared by every batch bucket —
-    batch is a feed-time binding, not a rewrite, so re-cloning and
-    re-verifying per batch would multiply the dominant cost by the
-    bucket count for byte-identical IR."""
+    """One (remat, dp_shard, grad_merge, bucket_mb, ring, tp_degree)
+    rewrite tuple, applied and wire-priced ONCE and shared by every
+    batch bucket — batch is a feed-time binding, not a rewrite, so
+    re-cloning and re-verifying per batch would multiply the dominant
+    cost by the bucket count for byte-identical IR.  Wire bytes are kept
+    as (fixed, per-batch-unit) pairs: weight-shaped collectives price
+    once, activation collectives (the mp ring's whole traffic — partial
+    sums and the f-operator's backward psum ride [-1, ...] operands)
+    scale with the batch bucket at `_price` time."""
 
-    __slots__ = ("main", "startup", "reduced", "wire_overlap",
-                 "wire_serial", "wire_by_axis", "error", "verify_verdict")
+    __slots__ = ("main", "startup", "reduced", "tp", "dp_world",
+                 "wire_overlap", "wire_serial", "wire_by_axis",
+                 "mp_sharded", "error", "verify_verdict")
 
     def __init__(self, base_main, base_startup, cand, world):
         from .verifier import (collective_sequence, entry_wire_bytes,
                                _ring_degrees_from_seq, ring_axis)
         self.error = None
         self.verify_verdict = None  # lazily computed, cached
-        self.wire_overlap = self.wire_serial = 0.0
-        self.wire_by_axis: Dict[str, float] = {}
+        self.tp = int(cand.get("tp_degree") or 0)
+        self.dp_world = world // self.tp if self.tp > 1 else world
+        # (fixed, per-batch-unit) accumulators
+        self.wire_overlap = [0.0, 0.0]
+        self.wire_serial = [0.0, 0.0]
+        self.wire_by_axis: Dict[str, List[float]] = {}
+        self.mp_sharded = None
         try:
             self.main, self.startup = _apply_knobs(base_main, base_startup,
                                                    cand)
@@ -313,32 +359,51 @@ class _RewritePoint:
             self.main = self.startup = self.reduced = None
             self.error = e
             return
+        if self.tp > 1:
+            # batch-independent: computed once here, shared by every
+            # batch bucket's HBM walk instead of re-running propagation
+            from .memory_analysis import mp_sharded_vars
+            self.mp_sharded = mp_sharded_vars(self.main, self.tp)
         self.reduced = self.main
-        if world > 1:
+        if self.dp_world > 1:
             from ..distributed.compiled_program import insert_grad_allreduce
             self.reduced = insert_grad_allreduce(self.main)
+        if self.dp_world > 1 or self.tp > 1:
             # each ring priced at its OWN degree (a tensor-parallel
             # collective on a dp×tp candidate moves mp-ring bytes, not
             # dp-world bytes) — the stamps are the authority; one
-            # sequence extraction serves both the degrees and the walk
+            # sequence extraction serves both the degrees and the walk.
+            # Ring 0's fallback degree is the DP SUB-world: on a 4×2
+            # candidate the grad allreduce crosses 4 ranks, not 8.
             seq = collective_sequence(self.reduced)
             ring_degrees = _ring_degrees_from_seq(seq)
             for e in seq:
-                nbytes = entry_wire_bytes(e, world, ring_degrees)
-                if e["type"] in _OVERLAPPABLE:
-                    self.wire_overlap += nbytes
-                else:
-                    self.wire_serial += nbytes
+                fixed = entry_wire_bytes(e, self.dp_world, ring_degrees)
+                per_unit = entry_wire_bytes(e, self.dp_world, ring_degrees,
+                                            batch=1) - fixed
+                # XLA overlaps dp-ring gradient reductions with backward
+                # compute; mp-ring collectives sit on the critical path
+                # of the matmuls they complete, so they price serial
+                bucket = (self.wire_overlap
+                          if e["type"] in _OVERLAPPABLE
+                          and e["ring_id"] == 0 else self.wire_serial)
+                bucket[0] += fixed
+                bucket[1] += per_unit
                 axis = ring_axis(e["ring_id"], e.get("mp_axis"))
-                self.wire_by_axis[axis] = \
-                    self.wire_by_axis.get(axis, 0.0) + nbytes
+                ax = self.wire_by_axis.setdefault(axis, [0.0, 0.0])
+                ax[0] += fixed
+                ax[1] += per_unit
 
     def verify(self) -> str:
-        """check_program(level="collective") on the reduced program —
-        once per rewrite point (the verdict is batch-independent)."""
+        """check_program on the reduced program — once per rewrite point
+        (the verdict is batch-independent).  1-D candidates gate at
+        level "collective"; 2-D (tp) candidates gate the full layout
+        analyzer too (level "layout", V601-V605) so the search space
+        never contains a mis-reduced layout."""
         if self.verify_verdict is None:
             from .verifier import check_program
-            report = check_program(self.reduced, level="collective",
+            level = "layout" if self.tp > 1 else "collective"
+            report = check_program(self.reduced, level=level,
                                    startup=self.startup)
             if report.errors:
                 self.verify_verdict = "dropped: " + ",".join(
@@ -349,35 +414,123 @@ class _RewritePoint:
 
 
 def _price(point: _RewritePoint, cand: Dict, hbm_budget: Optional[int],
-           peak_flops: float, ici_bps: float) -> Dict:
-    """Roofline-price one (rewrite point, batch) candidate."""
+           peak_flops: float, ici_bps: float, world: int,
+           global_batch: Optional[int] = None) -> Dict:
+    """Roofline-price one (rewrite point, batch) candidate.
+
+    2-D accounting: compute divides the mp-STAMPED ops' walked FLOPs by
+    the tp degree (the Megatron col/row matmuls and their grads carry
+    the builders' ``mp_axis`` stamp, which autodiff copies onto the grad
+    ops; the attention core's per-head work is already walked at its
+    local shard shapes), the HBM walker charges 1/tp of mp-sharded
+    param/activation bytes (`analyze_program(tp_degree=)`), and wire
+    combines each ring's fixed and batch-proportional legs.  The
+    objective stays samples/sec/CHIP: a tp candidate's batch feeds
+    world/tp data-parallel replicas, so its per-chip rate is
+    batch·dp_world/world per step — pure-dp candidates reduce to the
+    classic batch/step.
+
+    `global_batch` is the effective-global-batch constraint: a
+    candidate whose batch × dp replicas × grad-merge window falls short
+    of the demanded global batch is infeasible no matter how fast."""
     from .memory_analysis import analyze_program
     from .flops_analysis import analyze_flops
 
     batch = cand["batch"]
-    mem = analyze_program(point.main, batch=batch, budget_bytes=hbm_budget)
-    flops = analyze_flops(point.main, batch=batch)["total_flops"]
+    tp = point.tp
+    mem = analyze_program(point.main, batch=batch, budget_bytes=hbm_budget,
+                          tp_degree=tp if tp > 1 else None,
+                          tp_sharded=point.mp_sharded)
+    rep = analyze_flops(point.main, batch=batch)
+    flops = rep["total_flops"]
+    if tp > 1:
+        block = point.main.global_block()
+        sharded = sum(
+            r["flops"] for r in rep["per_op"]
+            if block.ops[r["index"]].attrs.get("mp_axis"))
+        flops = (flops - sharded) + sharded / tp
     compute_s = flops / peak_flops if peak_flops else 0.0
-    wo_s = point.wire_overlap / ici_bps if ici_bps else 0.0
-    ws_s = point.wire_serial / ici_bps if ici_bps else 0.0
+    wo = point.wire_overlap[0] + batch * point.wire_overlap[1]
+    ws = point.wire_serial[0] + batch * point.wire_serial[1]
+    wo_s = wo / ici_bps if ici_bps else 0.0
+    ws_s = ws / ici_bps if ici_bps else 0.0
     step_s = max(compute_s, wo_s) + ws_s
+    eff_batch = batch * point.dp_world * max(1, int(cand["grad_merge"]))
     rec = dict(cand)
     rec.update({
         "peak_bytes": int(mem["peak_bytes"]),
         "fits": bool(mem["fits"]),
         "flops": int(flops),
-        "wire_bytes": int(point.wire_overlap + point.wire_serial),
-        "wire_bytes_per_axis": {a: int(b)
-                                for a, b in sorted(
-                                    point.wire_by_axis.items())},
+        "wire_bytes": int(wo + ws),
+        "wire_bytes_per_axis": {
+            a: int(f + batch * u)
+            for a, (f, u) in sorted(point.wire_by_axis.items())},
         "compute_ms": compute_s * 1e3,
         "wire_overlap_ms": wo_s * 1e3,
         "wire_serial_ms": ws_s * 1e3,
         "step_ms": step_s * 1e3,
-        "samples_per_sec": (batch / step_s) if step_s > 0 else 0.0,
+        "effective_global_batch": int(eff_batch),
+        "samples_per_sec": (batch * point.dp_world / max(1, world) / step_s)
+        if step_s > 0 else 0.0,
         "verdict": "",
     })
+    if global_batch and eff_batch < int(global_batch):
+        rec["fits"] = False
+        rec["verdict"] = (f"under global batch "
+                          f"({eff_batch} < {int(global_batch)})")
     return rec
+
+
+def _tp_variants_from_config(model_config: Dict, world: int,
+                             degrees=None) -> Dict[int, Tuple]:
+    """Auto-generate tensor-parallel BUILD variants from a model config:
+    each candidate degree rebuilds the transformer blocks through the
+    `tensor_parallel` builders (`models.build_transformer_lm` with
+    ``tensor_parallel_degree=``) and minimizes the same optimizer, so
+    the planner can search tp without the caller hand-feeding the
+    winner.  Config keys: ``vocab_size``, ``hidden``, ``num_layers``,
+    ``num_heads``, ``seq_len``; optional ``learning_rate`` (default
+    1e-3) and ``optimizer`` ("adam" | "sgd", default "adam").  Candidate
+    degrees (when not given): powers of two ≥ 2 dividing the world,
+    the head count and the hidden width.  Returns {degree: (main,
+    startup, loss_name)}."""
+    import paddle_tpu.static as static
+    from ..models.static_lm import build_transformer_lm
+    cfg = dict(model_config)
+    heads = int(cfg["num_heads"])
+    hidden = int(cfg["hidden"])
+    if degrees is None:
+        degrees, d = [], 2
+        while d <= min(int(world), heads):
+            if world % d == 0 and heads % d == 0 and hidden % d == 0:
+                degrees.append(d)
+            d *= 2
+    out: Dict[int, Tuple] = {}
+    lr = float(cfg.get("learning_rate", 1e-3))
+    opt_name = str(cfg.get("optimizer", "adam")).lower()
+    for d in degrees:
+        d = int(d)
+        if d < 2:
+            continue
+        main, startup, loss, _ = build_transformer_lm(
+            vocab_size=int(cfg["vocab_size"]), hidden=hidden,
+            num_layers=int(cfg["num_layers"]), num_heads=heads,
+            seq_len=int(cfg["seq_len"]), tensor_parallel_degree=d)
+        with static.program_guard(main, startup):
+            opt = (static.SGD(learning_rate=lr) if opt_name == "sgd"
+                   else static.Adam(learning_rate=lr))
+            opt.minimize(loss)
+        out[d] = (main, startup, loss.name)
+    return out
+
+
+def _built_tp_degree(program: Program) -> int:
+    """The tp degree a program was BUILT with (0 for plain builds) —
+    the shared registry rule (`core.pass_framework.built_tp_degree`),
+    so the planner's pinning and the verifier's V504 drift check can
+    never disagree."""
+    from ..core.pass_framework import built_tp_degree
+    return built_tp_degree(program)
 
 
 def plan_program(program: Program, startup: Optional[Program] = None,
@@ -385,40 +538,61 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                  knobs: Optional[Dict] = None, batch: Optional[int] = None,
                  variants: Optional[Dict[str, Tuple[Program,
                                                     Program]]] = None,
+                 model_config: Optional[Dict] = None,
+                 global_batch: Optional[int] = None,
                  peak_flops: Optional[float] = None,
                  ici_bytes_per_s: Optional[float] = None,
                  verify: bool = True) -> Plan:
     """Compile-time search for the best training configuration of
-    `program` on a `world`-chip data-parallel mesh.  Returns a `Plan`.
+    `program` on a `world`-chip mesh (data-parallel, or 2-D dp×tp when
+    tensor-parallel build variants are in the lattice).  Returns a
+    `Plan`.
 
     * `program`/`startup` — a minimized (optimizer ops appended)
       training program pair.  Neither is modified: every candidate is
       applied to clones; call `apply_plan` (or `bench.py --auto`) to
       apply the winner for real.
-    * `world` — data-parallel chip count the wire costs and dp_shard
-      candidates target (1 = single chip, no wire).
+    * `world` — total chip count the wire costs and shard candidates
+      target (1 = single chip, no wire).  A tp-degree-`d` candidate
+      carves it into a (world/d) × d dp×tp mesh.
     * `hbm_budget` — per-chip budget bytes for the fits gate (default
       `PADDLE_TPU_HBM_BYTES` → v5e usable 15.75 GiB).
     * `knobs` — per-knob candidate overrides, e.g. ``{"batch": (64, 96),
       "grad_merge": (1,)}``; unset knobs use the default lattice.
     * `batch` — pin the batch bucket (equivalent to
-      ``knobs={"batch": (b,)}``).
-    * `variants` — alternative BUILDS of the same model keyed by knob,
-      currently ``{"ring": (main, startup)}``: ring attention is emitted
-      at build time by `nets.scaled_dot_product_attention`, so the long-
-      seq ring knob enters the lattice as a pre-built variant instead of
-      a post-hoc idiom rewrite.  Ring candidates are priced with the
-      single-chip degraded-kernel S² charge (`memory_analysis.
-      _op_internal_bytes`) — conservative, same as `bench.py --ring`.
+      ``knobs={"batch": (b,)}``).  Under tp this is the per-dp-replica
+      batch (all tp shards of a replica consume the same rows).
+    * `variants` — alternative BUILDS of the same model keyed by knob:
+      ``{"ring": (main, startup)}`` for ring attention, and
+      ``{"tp": {degree: (main, startup)}}`` for Megatron tensor
+      parallelism — tp is emitted at build time by the
+      `distributed/tensor_parallel` builders, so each searched degree
+      enters the lattice as a pre-built pair like the ring knob.
+    * `model_config` — auto-generate the tp variants instead of
+      hand-feeding them: a dict of `models.build_transformer_lm`
+      geometry (``vocab_size``/``hidden``/``num_layers``/``num_heads``/
+      ``seq_len`` + optional ``learning_rate``/``optimizer``); the
+      planner rebuilds the blocks through the tensor_parallel builders
+      for every viable power-of-two degree.  The generated pairs ride
+      ``plan.build_variants`` so the caller can train the winner.
+    * `global_batch` — the effective-global-batch constraint: every
+      candidate must reach ``batch × dp_replicas × grad_merge ≥
+      global_batch`` or it is infeasible — this is how gradient-merge ×
+      tp candidates WIN when the user demands a batch no single-chip
+      plan can hold, instead of the search returning
+      ``predicted_fits=False``.
     * `peak_flops` / `ici_bytes_per_s` — roofline denominators (default:
       the v5e targets via `peak_flops_per_chip("tpu")` and
       `ici_bytes_per_chip()`; planning always prices the TPU target even
       when the planner itself runs on a CPU host).
     * `verify` — gate every HBM-feasible candidate through
-      `check_program(level="collective")` and drop any with error
-      diagnostics (the deadlock/drift/composition surface).  Leave on;
-      it exists as a switch only for estimator-sweep modes that re-plan
-      the same program family many times (`bench.py --seq-ladder`).
+      `check_program` and drop any with error diagnostics: level
+      "collective" for 1-D candidates, level "layout" (the V6xx
+      sharding-propagation analyzer) for every 2-D tp candidate — the
+      search space never contains a deadlocking or mis-reduced plan.
+      Leave on; it exists as a switch only for estimator-sweep modes
+      that re-plan the same program family many times
+      (`bench.py --seq-ladder`).
 
     Selection: among verified fitting candidates, maximize predicted
     samples/sec/chip (ties prefer fewer knobs, then lower peak bytes).
@@ -438,6 +612,20 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     peak = float(peak_flops) if peak_flops else peak_flops_per_chip("tpu")
     ici = float(ici_bytes_per_s) if ici_bytes_per_s else ici_bytes_per_chip()
     variants = dict(variants or {})
+
+    # tensor-parallel build variants: hand-fed pairs win; a model config
+    # auto-generates the rest (only degrees not already supplied)
+    tp_builds: Dict[int, Tuple] = {
+        int(d): tuple(pair) for d, pair in (variants.get("tp") or {}).items()
+        if int(d) > 1}
+    if model_config is not None:
+        want = None
+        if knobs and knobs.get("tp_degree") is not None:
+            want = [int(d) for d in knobs["tp_degree"] if int(d) > 1]
+        generated = _tp_variants_from_config(model_config, world,
+                                             degrees=want)
+        for d, triple in generated.items():
+            tp_builds.setdefault(d, triple)
 
     from .memory_analysis import select_layer_checkpoints
     can_remat = (has_applied(program, "recompute") or
@@ -467,6 +655,9 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     pre_ring = any(op.type == "ring_attention"
                    for b in program.blocks for op in b.ops)
     can_gm = bool(getattr(program, "_ps_params_grads", None)) or pre_gm > 0
+    # a program BUILT through the tensor_parallel builders can't drop
+    # its Megatron collectives — the tp axis pins like the ring knob
+    pre_tp = _built_tp_degree(program)
 
     eff_knobs = dict(knobs or {})
     if pre_remat:
@@ -475,6 +666,9 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         eff_knobs["grad_merge"] = (pre_gm,)
     if pre_ring:
         eff_knobs["ring"] = (True,)
+    if pre_tp:
+        eff_knobs["tp_degree"] = (pre_tp,)
+        tp_builds[pre_tp] = (program, startup)
     if pre_dp:
         # pin through the axis (NOT a post-filter: a pre-sharded degree
         # outside the default (0, world) axis would otherwise empty the
@@ -483,9 +677,10 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         eff_knobs["zero_stage"] = (pre_stage or 1,)
         if pre_bucket_mb:
             eff_knobs["bucket_mb"] = (pre_bucket_mb,)
+    tp_candidates = tuple(sorted(tp_builds))
     lattice = _knob_lattice(world, batch, eff_knobs,
                             pre_ring or "ring" in variants,
-                            can_remat, can_gm)
+                            can_remat, can_gm, tp_candidates)
     if not lattice:
         # over-constrained knob lists (e.g. remat forced on a model with
         # no checkpointable layers): fall back to pricing the program
@@ -493,7 +688,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         lattice = [{"batch": int(batch or 1), "remat": pre_remat,
                     "dp_shard": pre_dp, "zero_stage": pre_stage,
                     "grad_merge": pre_gm or 1,
-                    "bucket_mb": pre_bucket_mb, "ring": pre_ring}]
+                    "bucket_mb": pre_bucket_mb, "ring": pre_ring,
+                    "tp_degree": pre_tp}]
 
     trace: List[Dict] = []
     points: Dict[Tuple, _RewritePoint] = {}
@@ -502,8 +698,13 @@ def plan_program(program: Program, startup: Optional[Program] = None,
             base_main, base_startup = (program, startup)
             if cand["ring"] and not pre_ring:
                 base_main, base_startup = variants["ring"]
+            tp = int(cand.get("tp_degree") or 0)
+            if tp > 1 and tp != pre_tp:
+                pair = tp_builds[tp]
+                base_main, base_startup = pair[0], pair[1]
             rkey = (cand["remat"], cand["dp_shard"], cand["zero_stage"],
-                    cand["grad_merge"], cand["bucket_mb"], cand["ring"])
+                    cand["grad_merge"], cand["bucket_mb"], cand["ring"],
+                    tp)
             point = points.get(rkey)
             if point is None:
                 point = points[rkey] = _RewritePoint(
@@ -515,10 +716,12 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                             "compute_ms": 0.0,
                             "wire_overlap_ms": 0.0, "wire_serial_ms": 0.0,
                             "step_ms": float("inf"), "samples_per_sec": 0.0,
+                            "effective_global_batch": 0,
                             "verdict": f"rewrite refused: {point.error!r}"})
                 trace.append(rec)
                 continue
-            rec = _price(point, cand, budget, peak, ici)
+            rec = _price(point, cand, budget, peak, ici, world,
+                         global_batch)
             if verify and rec["fits"]:
                 verdict = point.verify()
                 rec["verdict"] = verdict
@@ -526,7 +729,7 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                     rec["fits"] = False
             elif rec["fits"]:
                 rec["verdict"] = "unverified"
-            else:
+            elif not rec["verdict"]:
                 rec["verdict"] = "over budget"
             trace.append(rec)
 
@@ -534,10 +737,12 @@ def plan_program(program: Program, startup: Optional[Program] = None,
 
     def _n_knobs(r):
         # higher ZeRO stages count as extra knobs so ties prefer the
-        # least-invasive rewrite (plain < zero1 < zero2 < zero3)
+        # least-invasive rewrite (plain < zero1 < zero2 < zero3); a tp
+        # build variant counts like any other knob
         return (int(r["remat"]) + int(r["dp_shard"] > 1) +
                 max(0, int(r.get("zero_stage") or 0) - 1) +
-                int(r["grad_merge"] > 1) + int(r["ring"]))
+                int(r["grad_merge"] > 1) + int(r["ring"]) +
+                int((r.get("tp_degree") or 0) > 1))
 
     if feasible:
         chosen = max(feasible,
@@ -557,6 +762,11 @@ def plan_program(program: Program, startup: Optional[Program] = None,
             r["verdict"] = chosen["verdict"]
     knob_dict = {k: chosen[k] for k in KNOB_KEYS}
     plan = Plan(knob_dict, world, budget, chosen, trace)
+    # the tp build pairs (hand-fed AND auto-generated) ride the plan so
+    # a caller can apply/train the winning variant without rebuilding:
+    # {degree: (main, startup)} or (main, startup, loss_name) for
+    # config-generated builds
+    plan.build_variants = dict(tp_builds)
     # non-registry attachment for inspection/telemetry; the REGISTRY
     # entry is written by apply_plan, at application time, so the V504
     # drift check compares a recorded plan only against a program the
@@ -571,10 +781,13 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
     V504 drift check can flag later hand-edits.  Rewrites run with the
     env-gated self-checks armed (unlike candidate enumeration).
 
-    The ring knob cannot be applied post-hoc — ring attention is emitted
+    The ring and tp knobs cannot be applied post-hoc — both are emitted
     at build time — so ``plan.knobs["ring"]=True`` demands the caller
-    pass the ring-built program (raises otherwise).  Batch is a feed-
-    time binding, not a rewrite; read it from ``plan.knobs["batch"]``.
+    pass the ring-built program, and ``plan.knobs["tp_degree"]=d``
+    demands the degree-`d` tensor-parallel build (``plan.build_variants
+    [d]`` when the planner generated it; raises otherwise).  Batch is a
+    feed-time binding, not a rewrite; read it from
+    ``plan.knobs["batch"]``.
     """
     from ..core.pass_framework import has_applied
     knobs = plan.knobs if isinstance(plan, Plan) else dict(plan)
@@ -586,6 +799,15 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
             f"program was built with ring_attention={has_ring} — apply the "
             f"plan to the matching build variant "
             f"(nets.scaled_dot_product_attention(sequence_parallel=...))")
+    built_tp = _built_tp_degree(program)
+    plan_tp = int(knobs.get("tp_degree") or 0)
+    if plan_tp != built_tp:
+        raise ValueError(
+            f"apply_plan: plan says tp_degree={plan_tp} but the program "
+            f"was built with tp_degree={built_tp} — apply the plan to "
+            f"the matching tensor-parallel build variant "
+            f"(plan.build_variants[{plan_tp}], or rebuild through the "
+            f"tensor_parallel builders)")
     meta = {k: knobs.get(k) for k in KNOB_KEYS}
     if isinstance(plan, Plan):
         meta["predicted_step_ms"] = round(plan.predicted_step_ms, 4)

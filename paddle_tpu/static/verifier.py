@@ -202,6 +202,11 @@ _COLLECTIVE_OPS = frozenset((
     "barrier", "mp_allreduce_sum", "c_elastic_fold", "partial_allgather",
     "p_send", "p_recv", "ring_attention", "sync_batch_norm",
     "sync_batch_norm_grad",
+    # the Megatron f-operator's BACKWARD is an allreduce over the tensor
+    # ring (ops/kernels/collective._c_identity_grad); grad ops inherit
+    # the forward op's ring/mp stamps, so the schedule and the per-axis
+    # wire pricer both see the mp ring's dominant backward cost
+    "c_identity_grad",
 ))
 
 # collectives whose summation order XLA may legally reassociate — fatal
@@ -354,16 +359,20 @@ def collective_sequence(program: Program) -> List[dict]:
     return seq
 
 
-# default ring → mesh-axis binding (compiled_program._compile dist_info:
-# ring 0 = the dp world, 101 = the sequence ring, 102 = the tensor ring)
-_RING_AXIS = {0: "dp", 101: "sp", 102: "mp"}
+# default ring → mesh-axis binding: the shared canonicalizer table
+# (core/mesh_axes.py — the same source CompiledProgram._get_mesh and
+# layout_analysis speak, so analyzer and runtime can never disagree on
+# the tensor axis's name)
+from ..core.mesh_axes import RING_AXIS as _RING_AXIS
+from ..core.mesh_axes import canonical_axis as _canonical_axis
 
 
 def ring_axis(ring_id: int, mp_axis: Optional[str] = None) -> str:
-    """The mesh-axis name a ring id binds to (``mp_axis`` stamp wins;
-    unknown rings render as ``ring<N>``)."""
+    """The CANONICAL mesh-axis name a ring id binds to (``mp_axis``
+    stamp wins; runtime spellings like ``"tp"`` canonicalize through
+    `core.mesh_axes`; unknown rings render as ``ring<N>``)."""
     if mp_axis:
-        return str(mp_axis)
+        return _canonical_axis(str(mp_axis))
     return _RING_AXIS.get(int(ring_id), f"ring{int(ring_id)}")
 
 
@@ -387,8 +396,28 @@ def program_ring_degrees(program: Program) -> Dict[int, int]:
     return _ring_degrees_from_seq(collective_sequence(program))
 
 
+def _entry_nbytes(entry: dict, batch: Optional[int] = None) \
+        -> Optional[int]:
+    """An entry's operand bytes, optionally binding symbolic -1 dims to
+    `batch`: the mp-ring collectives ride ACTIVATIONS ([-1, t, hidden]
+    cotangents and partial sums), whose wire cost is batch-proportional
+    and prices 0 unless the caller binds the batch."""
+    n = entry.get("nbytes")
+    if n:
+        return n
+    shape = entry.get("shape")
+    if not batch or not shape:
+        return None
+    total = 1
+    for d in shape:
+        d = int(d)
+        total *= int(batch) if d < 0 else d
+    return total * _dtype_bytes(entry.get("dtype"))
+
+
 def entry_wire_bytes(entry: dict, world: int,
-                     ring_degrees: Optional[Dict[int, int]] = None) -> float:
+                     ring_degrees: Optional[Dict[int, int]] = None,
+                     batch: Optional[int] = None) -> float:
     """Ring-algorithm ICI bytes ONE rank moves for a single
     `collective_sequence` entry: allreduce 2(N-1)/N of the buffer,
     reduce-scatter (N-1)/N, allgather and the elastic all-gather fold
@@ -398,10 +427,12 @@ def entry_wire_bytes(entry: dict, world: int,
     recorded the group it rewrote for), then ``ring_degrees`` (ring id →
     size, e.g. `program_ring_degrees` or a planner's candidate mesh),
     then `world` — so a tensor-ring collective on a 4×2 mesh prices at
-    its mp degree 2, never the dp world.  Unknown sizes price 0.
+    its mp degree 2, never the dp world.  `batch` binds symbolic -1
+    dims so activation collectives (the mp ring's whole traffic) price
+    their batch-proportional bytes; unknown sizes price 0.
     Shared by `collective_wire_bytes` and the auto-parallel planner's
     overlap-aware roofline (static/planner.py)."""
-    n = entry["nbytes"]
+    n = _entry_nbytes(entry, batch)
     if not n:
         return 0.0
     g = (entry["dp_degree"] or entry.get("tp_degree") or
@@ -411,7 +442,9 @@ def entry_wire_bytes(entry: dict, world: int,
     t = entry["type"]
     if t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
              "c_allreduce_prod", "mp_allreduce_sum", "sync_batch_norm",
-             "sync_batch_norm_grad"):
+             "sync_batch_norm_grad", "c_identity_grad"):
+        # c_identity_grad: the Megatron f-operator's backward psum of
+        # the replicated input's cotangent over the tensor ring
         return 2.0 * (g - 1) / g * n
     if t in ("c_reducescatter", "c_scatter", "c_broadcast",
              "broadcast", "alltoall"):
@@ -439,12 +472,13 @@ def entry_wire_bytes(entry: dict, world: int,
 
 def collective_wire_bytes(program: Program, world: int,
                           ring_id: Optional[int] = None,
-                          ring_degrees: Optional[Dict[int, int]] = None
-                          ) -> int:
+                          ring_degrees: Optional[Dict[int, int]] = None,
+                          batch: Optional[int] = None) -> int:
     """ICI bytes ONE rank moves per step under ring-algorithm accounting
     (per-entry formulas: `entry_wire_bytes`).  Entries with unknown
     sizes contribute 0 (count them via `collective_sequence` if that
-    matters).  `ring_id=None` sums every ring; `ring_degrees` maps ring
+    matters; `batch` binds symbolic -1 dims so activation collectives
+    price).  `ring_id=None` sums every ring; `ring_degrees` maps ring
     id → that ring's OWN group size (default: the program's stamps via
     `program_ring_degrees`) so non-dp rings never price at the dp
     world."""
@@ -457,20 +491,23 @@ def collective_wire_bytes(program: Program, world: int,
     for e in seq:
         if ring_id is not None and e["ring_id"] != ring_id:
             continue
-        total += entry_wire_bytes(e, world, ring_degrees)
+        total += entry_wire_bytes(e, world, ring_degrees, batch)
     return int(total)
 
 
 def collective_wire_bytes_by_axis(program: Program, world: int,
                                   ring_degrees: Optional[Dict[int, int]]
-                                  = None) -> Dict[str, int]:
+                                  = None,
+                                  batch: Optional[int] = None
+                                  ) -> Dict[str, int]:
     """Per-mesh-axis split of `collective_wire_bytes`: ring-accounted
     ICI bytes one rank moves per step, keyed by the axis each ring binds
     to (`ring_axis`: ring 0 → "dp", the tensor ring → "mp", the
     sequence ring → "sp").  The 2-D planner's wire substrate — an
     mp-ring byte overlaps different hardware links than a dp-ring byte,
     so the roofline must see them separately; also surfaced in the
-    ``bench.py --dp-shard`` JSON."""
+    ``bench.py --dp-shard`` / ``--tp`` JSON.  `batch` binds symbolic -1
+    dims (the mp ring's traffic is activations)."""
     seq = collective_sequence(program)
     if ring_degrees is None:
         ring_degrees = _ring_degrees_from_seq(seq)
@@ -480,7 +517,7 @@ def collective_wire_bytes_by_axis(program: Program, world: int,
     for e in seq:
         axis = ring_axis(e["ring_id"], e.get("mp_axis"))
         totals[axis] = totals.get(axis, 0.0) + \
-            entry_wire_bytes(e, world, ring_degrees)
+            entry_wire_bytes(e, world, ring_degrees, batch)
     return {a: int(b) for a, b in sorted(totals.items())}
 
 
@@ -1197,6 +1234,16 @@ def _check_pass_order(program: Program, out: List[Diagnostic]):
                            for b in program.blocks for op in b.ops)
             if bool(plan["ring"]) != has_ring:
                 _drift("ring", bool(plan["ring"]), has_ring)
+        if "tp_degree" in plan:
+            # the applied tp degree is a BUILD property (a plan claiming
+            # tp on a plain build, or a tp build whose plan says 0, is
+            # the same knobs-never-ran drift as the ring knob); the
+            # detection rule is shared with the planner's pinning
+            from ..core.pass_framework import built_tp_degree
+            tp_applied = built_tp_degree(program)
+            if int(plan["tp_degree"] or 0) != tp_applied:
+                _drift("tp_degree", int(plan["tp_degree"] or 0),
+                       tp_applied)
 
 
 # ---------------------------------------------------------------------------

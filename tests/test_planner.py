@@ -394,3 +394,142 @@ def test_planner_pins_ring_built_program():
     report = static.check_program(main, level="collective",
                                   startup=startup)
     assert "V504" not in report.codes(), report.render()
+
+
+# ---------------------------------------------------------------------------
+# tp_degree lattice axis (ISSUE 15)
+# ---------------------------------------------------------------------------
+_TP_GEOM = dict(vocab_size=128, hidden=64, num_layers=2, num_heads=4,
+                seq_len=32, learning_rate=1e-2)
+
+
+def _build_lm(tp=1):
+    from paddle_tpu.models import build_transformer_lm
+    _reset_unique_names()
+    main, startup, loss, _ = build_transformer_lm(
+        vocab_size=_TP_GEOM["vocab_size"], hidden=_TP_GEOM["hidden"],
+        num_layers=_TP_GEOM["num_layers"], num_heads=_TP_GEOM["num_heads"],
+        seq_len=_TP_GEOM["seq_len"], tensor_parallel_degree=tp)
+    import paddle_tpu.static as _s
+    with _s.program_guard(main, startup):
+        _s.Adam(learning_rate=_TP_GEOM["learning_rate"]).minimize(loss)
+    return main, startup, loss
+
+
+def test_tp_lattice_from_hand_variants_prices_both_axes():
+    """A hand-fed {"tp": {2: pair}} variant puts tp on the lattice:
+    2-D candidates carry per-axis wire with the mp ring priced at its
+    OWN degree (batch-proportional activations included), and dp_shard
+    candidates under tp shrink to the dp sub-world."""
+    base = _build_lm(tp=1)
+    tp2 = _build_lm(tp=2)
+    plan = static.plan_program(base[0], base[1], world=WORLD, batch=8,
+                               knobs={"grad_merge": (1,)},
+                               variants={"tp": {2: (tp2[0], tp2[1])}})
+    tp_cands = [c for c in plan.trace if c["tp_degree"] == 2]
+    assert tp_cands, plan.render_table()
+    for c in tp_cands:
+        if c["fits"]:
+            assert c["wire_bytes_per_axis"].get("mp", 0) > 0, c
+            assert c["verdict"].startswith("verified"), c
+        assert c["dp_shard"] in (0, WORLD // 2), c
+    # the mp wire is batch-proportional: replanning at twice the batch
+    # must grow it
+    base2 = _build_lm(tp=1)
+    tp2b = _build_lm(tp=2)
+    plan2 = static.plan_program(base2[0], base2[1], world=WORLD, batch=16,
+                                knobs={"grad_merge": (1,)},
+                                variants={"tp": {2: (tp2b[0], tp2b[1])}})
+    mp8 = next(c["wire_bytes_per_axis"]["mp"] for c in plan.trace
+               if c["tp_degree"] == 2 and not c["remat"]
+               and not c["dp_shard"])
+    mp16 = next(c["wire_bytes_per_axis"]["mp"] for c in plan2.trace
+                if c["tp_degree"] == 2 and not c["remat"]
+                and not c["dp_shard"])
+    assert mp16 > mp8, (mp8, mp16)
+
+
+def test_tp_lattice_charges_compute_and_hbm_at_one_over_tp():
+    """The 2-D pricing contract: a tp=2 candidate's walked HBM peak and
+    compute leg both drop below the same-batch pure-dp candidate's
+    (sharded weights/activations at 1/tp, mp-stamped matmul FLOPs at
+    1/tp)."""
+    base = _build_lm(tp=1)
+    tp2 = _build_lm(tp=2)
+    plan = static.plan_program(base[0], base[1], world=WORLD, batch=8,
+                               knobs={"grad_merge": (1,), "remat": (False,),
+                                      "dp_shard": (0,)},
+                               variants={"tp": {2: (tp2[0], tp2[1])}})
+    dp_c = next(c for c in plan.trace if not c["tp_degree"])
+    tp_c = next(c for c in plan.trace if c["tp_degree"] == 2)
+    assert tp_c["peak_bytes"] < dp_c["peak_bytes"], (dp_c, tp_c)
+    assert tp_c["compute_ms"] < dp_c["compute_ms"], (dp_c, tp_c)
+
+
+def test_planner_picks_4x2_unprompted_when_pure_dp_infeasible():
+    """The ISSUE 15 acceptance core (also gated by tools/
+    tp_plan_smoke.py): with tp variants auto-generated from a model
+    config — never hand-fed — and a budget below the best pure-dp walk,
+    the planner chooses the 4×2 dp×tp plan."""
+    from paddle_tpu.static.memory_analysis import XLA_REMAT_SLACK
+    base = _build_lm(tp=1)
+    knobs = {"batch": (8,), "grad_merge": (1,), "zero_stage": (1,)}
+    probe = static.plan_program(base[0], base[1], world=WORLD,
+                                hbm_budget=1 << 50,
+                                knobs=dict(knobs, tp_degree=(0, 2)),
+                                model_config=_TP_GEOM, verify=False)
+    best_dp = min(c["peak_bytes"] for c in probe.trace
+                  if not c["tp_degree"] and c["peak_bytes"] > 0)
+    base2 = _build_lm(tp=1)
+    plan = static.plan_program(base2[0], base2[1], world=WORLD,
+                               hbm_budget=int(best_dp / XLA_REMAT_SLACK) - 1,
+                               knobs=dict(knobs), model_config=_TP_GEOM)
+    assert plan.predicted_fits, plan.render_table()
+    assert plan.knobs["tp_degree"] == 2, plan.render_table()
+    assert all(not c["fits"] for c in plan.trace if not c["tp_degree"])
+    assert 2 in plan.build_variants
+
+
+def test_global_batch_constraint_gm_tp_candidate_wins():
+    """ISSUE 15 acceptance: when the user demands a global batch no
+    single-chip plan can hold, the effective-global-batch constraint
+    turns gm×tp candidates into feasible winners instead of the search
+    returning predicted_fits=False."""
+    from paddle_tpu.static.memory_analysis import XLA_REMAT_SLACK
+    base = _build_lm(tp=1)
+    # dp_shard pinned off: ZeRO slot sharding would undercut the
+    # pure-dp floor below the gm accumulators' cost and close the
+    # budget window this scenario needs (demanded batch + tight HBM)
+    knobs = {"batch": (4, 8), "zero_stage": (1,), "remat": (False,),
+             "dp_shard": (0,), "tp_degree": (0, 2)}
+    probe = static.plan_program(base[0], base[1], world=WORLD,
+                                hbm_budget=1 << 50, knobs=dict(knobs),
+                                model_config=_TP_GEOM, verify=False)
+    # premise: every batch-8 plan (any axis) and every pure-dp plan is
+    # walker-infeasible, while the gm×tp winner (batch 4, tp 2, gm 2 —
+    # the only lattice point reaching the demanded global batch) fits
+    floor = min(c["peak_bytes"] for c in probe.trace
+                if c["peak_bytes"] > 0 and
+                (not c["tp_degree"] or c["batch"] > 4))
+    win_peak = min(c["peak_bytes"] for c in probe.trace
+                   if c["tp_degree"] == 2 and c["batch"] == 4
+                   and c["grad_merge"] == 2 and c["peak_bytes"] > 0)
+    assert win_peak < floor, probe.render_table()
+    budget = int(floor / XLA_REMAT_SLACK) - 1
+    # demand a global batch only a gm window can reach at batch 4 on
+    # the dp=4 sub-axis: 4 × 4 × 2 = 32
+    base2 = _build_lm(tp=1)
+    plan = static.plan_program(base2[0], base2[1], world=WORLD,
+                               hbm_budget=budget, knobs=dict(knobs),
+                               model_config=_TP_GEOM, global_batch=32)
+    assert plan.predicted_fits, plan.render_table()
+    assert plan.knobs["tp_degree"] == 2, plan.render_table()
+    assert plan.knobs["grad_merge"] == 2, plan.render_table()
+    assert plan.predicted_effective_global_batch >= 32
+    # and WITHOUT the constraint the same search picks gm=1 (gm is a
+    # priced no-win that only the batch demand justifies)
+    base3 = _build_lm(tp=1)
+    plan_free = static.plan_program(base3[0], base3[1], world=WORLD,
+                                    hbm_budget=budget, knobs=dict(knobs),
+                                    model_config=_TP_GEOM)
+    assert plan_free.knobs["grad_merge"] == 1, plan_free.render_table()
