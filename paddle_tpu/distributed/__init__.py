@@ -25,6 +25,10 @@ from .partition_spec import (  # noqa: F401
     tensor_parallel_rules, PartitionRule, REPLICATED, DP_SHARD,
     MP_COL, MP_ROW,
 )
+from .scan_window import (  # noqa: F401
+    WindowSplit, split_commit_tail, mark_scan_hoist,
+    scan_window_wire_bytes,
+)
 from .elastic import (  # noqa: F401
     elasticize, rebucket_feeds, rederive_schedule, reanchor_topology,
     elastic_meta, micro_steps_per_global,

@@ -18,6 +18,7 @@ XLA's collective combiner.
 from __future__ import annotations
 
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -527,7 +528,23 @@ class CompiledProgram:
         window as ONE device call, bitwise-equal to the looped form
         (same traced step, same per-window seed derivation — the
         per-micro-step RNG phase comes from the persistable counter
-        carried through the scan)."""
+        carried through the scan).
+
+        For a gradient-merge (optionally ×ZeRO) program whose K is a
+        whole number of commit windows and whose counter sits on a
+        window boundary, the scan runs HOISTED (scan_window.py): the
+        commit tail — optimizer update, publish allgather, merged-grad
+        allreduce — executes once per gm-K window instead of once per
+        micro-step, cutting the publish wire to 1/K.  Numerics are
+        unchanged (the looped commit is masked off on the same steps);
+        set ``PADDLE_TPU_SCAN_HOIST=0`` to force the unhoisted scan
+        (the bench A/B switch).
+
+        Stacked feeds ride the executor's FLAGS_feed_bucketing policy:
+        a ragged PER-STEP batch pads up to an already-compiled stacked
+        bucket (axis 1) under ``fetch_aggregation="reduce"`` — same
+        duplicated-row caveats as run()'s bucketing (docs/perf.md).
+        The steps axis is never padded."""
         from ..static.executor import global_scope, _persistable_names
         scope = scope or global_scope()
         feed = feed or {}
@@ -539,11 +556,10 @@ class CompiledProgram:
                        for f in (fetch_list or [])]
         program = self._get_program()
         mesh = self._get_mesh()
-        if set(mesh.axis_names) - {"dp", "tp"}:
+        if set(mesh.axis_names) - {"dp", "tp", "sp"}:
             raise NotImplementedError(
-                "run_steps through CompiledProgram supports dp and "
-                "dp×tp meshes only (sequence parallel degree must "
-                "be 1)")
+                "run_steps through CompiledProgram supports dp, dp×tp "
+                "and dp×sp meshes only")
         n_dev = len(mesh.devices.flat)
         elastic = getattr(program, "_elastic_meta", None)
         micro_k = 1
@@ -566,24 +582,48 @@ class CompiledProgram:
         k = int(k)
         state_names = [n for n in _persistable_names(program)
                        if scope.get(n) is not None]
+
+        # commit-tail hoist eligibility: a splittable gm window, K a
+        # whole number of windows, and the persistable counter on a
+        # window boundary (a mid-window start must replay the masked
+        # looped semantics — the plain scan does exactly that)
+        split = None
+        if elastic is None and \
+                os.environ.get("PADDLE_TPU_SCAN_HOIST", "1").lower() \
+                not in ("0", "false", "off"):
+            split = self._window_split(program, tuple(fetch_names))
+        hoist = False
+        if split is not None and k % split.k == 0:
+            cval = scope.get(split.counter)
+            if cval is not None:
+                cnt = int(np.asarray(cval).reshape(-1)[0])
+                hoist = cnt % split.k == 0
+        agg = getattr(self._build_strategy, "fetch_aggregation", "reduce")
         feed_sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
                                 for n, v in feed_vals.items()))
-        key = ("steps", program.fingerprint(), feed_sig,
-               tuple(fetch_names), tuple(state_names), n_dev,
-               getattr(self._build_strategy, "fetch_aggregation",
-                       "reduce"))
+        key = ("steps", bool(hoist), program.fingerprint(), feed_sig,
+               tuple(fetch_names), tuple(state_names), n_dev, agg)
         from ..core import compile_cache as _ccache
         fn = self._cache.get(key)
+        bucket = None  # (real per-step batch, padded per-step batch)
+        if fn is None and agg == "reduce":
+            bucketed = self._bucket_lookup_steps(executor, key, feed_vals)
+            if bucketed is not None:
+                key, feed_vals, bucket = bucketed
+                fn = self._cache.get(key)
         if fn is None:
             from ..static.verifier import verify_first_compile
             verify_first_compile(program, fetch_list=fetch_names)
             _ccache.record_miss()
             _ccache.record_trace()
             from ..observability.journal import emit as _jemit
-            _jemit("compile", mode="compiled_steps", world=int(n_dev),
-                   fingerprint=str(key[1])[:16])
+            _jemit("compile",
+                   mode=("compiled_steps_hoisted" if hoist
+                         else "compiled_steps"), world=int(n_dev),
+                   fingerprint=str(key[2])[:16])
             fn = self._compile_steps(program, state_names, feed_vals,
-                                     fetch_names, mesh)
+                                     fetch_names, mesh,
+                                     split=split if hoist else None)
             self._cache[key] = fn
         else:
             _ccache.record_hit()
@@ -605,9 +645,12 @@ class CompiledProgram:
                 [(base + (executor._elastic_steps + i) // micro_k)
                  % (2 ** 31) for i in range(k)], jnp.uint32)
         else:
+            # (x % m + i) % m == (x + i) % m: re-applying the modulus
+            # keeps micro-step i's seed EXACTLY what the i-th looped
+            # _run call would derive, across the 2**31 wrap included
             seeds = jnp.asarray(
-                [executor._seed_for_step(program) + i for i in range(k)],
-                jnp.uint32)
+                [(executor._seed_for_step(program) + i) % (2 ** 31)
+                 for i in range(k)], jnp.uint32)
         fetches, new_state = fn(state, feed_vals, seeds)
         self._dispatches += 1
         executor._step += k
@@ -615,28 +658,76 @@ class CompiledProgram:
             executor._elastic_steps += k
         for n, v in new_state.items():
             scope.set(n, v)
+        if bucket is not None:
+            fetches = executor._unpad_steps_fetches(
+                fetches, bucket[0], bucket[1],
+                block=program.global_block(), fetch_names=fetch_names)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
     def _compile_steps(self, program, state_names, feed_vals,
-                       fetch_names, mesh):
+                       fetch_names, mesh, split=None):
         """jit(shard_map(scan(step))): the scanned sibling of _compile
-        (pure-dp meshes; feeds carry [K, per-step...] with the per-step
-        batch on axis 1)."""
+        (dp / dp×tp / dp×sp meshes; feeds carry [K, per-step...] with
+        the per-step batch on axis 1).
+
+        With `split` (a scan_window.WindowSplit) the scan runs the
+        HOISTED window: an outer scan over K/gm_k windows, each window
+        an inner scan of gm_k commit-free body steps followed by ONE
+        commit-tail execution — the publish allgather and merged-grad
+        allreduce run once per window instead of once per micro-step."""
         from ..utils.shard_map_compat import shard_map_unchecked
         from .partition_spec import state_partition_specs
-        step = self._traced_step(program, state_names, fetch_names, mesh)
         dp = mesh.shape["dp"]
+        has_sp = "sp" in mesh.axis_names
+        sp_deg = mesh.shape["sp"] if has_sp else 1
+        block = program.global_block()
+
+        if split is not None:
+            step = self._traced_step(split.body, state_names,
+                                     fetch_names, mesh)
+            # the tail is a pure function of persistable state (the
+            # splitter's soundness contract): no feed, no fetches — it
+            # recomputes the mask from the carried counter and commits
+            tail_step = self._traced_step(split.tail, state_names, [],
+                                          mesh)
+            gm_k = int(split.k)
+        else:
+            step = self._traced_step(program, state_names, fetch_names,
+                                     mesh)
 
         def body(state, xs):
             feed, seed = xs
             fetches, new_state = step(state, feed, seed)
             return new_state, fetches
 
-        def multi(state, feeds, seeds):
-            new_state, fetches = jax.lax.scan(body, state, (feeds, seeds))
-            return fetches, new_state
+        if split is not None:
+            def window(state, xs):
+                feeds_w, seeds_w = xs
+                state, fetches = jax.lax.scan(body, state,
+                                              (feeds_w, seeds_w))
+                # tail has no RNG ops (splitter contract: persistable
+                # reads only) — the seed argument is inert
+                _, state = tail_step(state, {}, seeds_w[-1])
+                return state, fetches
+
+            def multi(state, feeds, seeds):
+                k = seeds.shape[0]
+                m = k // gm_k
+                feeds_w = {n: v.reshape((m, gm_k) + v.shape[1:])
+                           for n, v in feeds.items()}
+                seeds_w = seeds.reshape((m, gm_k))
+                new_state, fetches = jax.lax.scan(window, state,
+                                                  (feeds_w, seeds_w))
+                fetches = tuple(f.reshape((k,) + f.shape[2:])
+                                for f in fetches)
+                return fetches, new_state
+        else:
+            def multi(state, feeds, seeds):
+                new_state, fetches = jax.lax.scan(body, state,
+                                                  (feeds, seeds))
+                return fetches, new_state
 
         state_specs = state_partition_specs(program, mesh, state_names)
         feed_specs = {}
@@ -655,7 +746,23 @@ class CompiledProgram:
                         f"{shape[1]} does not divide the dp world "
                         f"{dp} (stacked feeds shard axis 1 over dp, "
                         "like run() shards axis 0)")
-                feed_specs[n] = P(None, "dp")
+                if has_sp:
+                    # mirror _compile's sp heuristic one axis right:
+                    # the declared per-step dim 1 (sequence) is the
+                    # stacked axis 2
+                    try:
+                        gshape = tuple(block.var(n).shape or ())
+                    except KeyError:
+                        gshape = ()
+                    if len(gshape) >= 2 and gshape[1] is not None and \
+                            gshape[1] > 1 and gshape[1] % sp_deg == 0 \
+                            and len(shape) >= 3 and \
+                            shape[2] % sp_deg == 0:
+                        feed_specs[n] = P(None, "dp", "sp")
+                    else:
+                        feed_specs[n] = P(None, "dp")
+                else:
+                    feed_specs[n] = P(None, "dp")
             else:
                 feed_specs[n] = P(None)  # [K] per-step scalars
         fetch_specs = tuple(P() for _ in fetch_names)
@@ -663,6 +770,79 @@ class CompiledProgram:
             multi, mesh, in_specs=(state_specs, feed_specs, P()),
             out_specs=(fetch_specs, state_specs))
         return jax.jit(sharded, donate_argnums=(0,))
+
+    def _window_split(self, program, fetch_names):
+        """Cached scan_window.split_commit_tail — the split walks (and
+        clones) the whole program, so _run_steps memoizes it per
+        (fingerprint, fetches)."""
+        key = (program.fingerprint(), tuple(fetch_names))
+        cached = getattr(self, "_scan_split_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .scan_window import split_commit_tail
+        split = split_commit_tail(program, fetch_names)
+        self._scan_split_cache = (key, split)
+        return split
+
+    def _bucket_lookup_steps(self, executor, miss_key, feed_vals):
+        """CompiledProgram analog of Executor._bucket_lookup_steps: on
+        a scanned-cache miss under the executor's FLAGS_feed_bucketing
+        policy, pad the PER-STEP batch (axis 1 of every stacked feed)
+        up to the smallest already-compiled stacked bucket with the
+        same step count — the steps axis is never padded.  Only under
+        ``fetch_aggregation="reduce"`` (concat fetches interleave
+        per-shard rows, which un-padding cannot unpick); padded
+        duplicate rows carry the same caveats as run()'s bucketing."""
+        policy = getattr(executor, "bucket_policy", "off")
+        if policy not in ("existing", "pow2") or not feed_vals:
+            return None
+        memo = getattr(self, "_steps_bucket_map", None)
+        if memo is None:
+            memo = self._steps_bucket_map = {}
+        hit = memo.get(miss_key)
+        if hit is not None:
+            bucket_key, target = hit
+            return (bucket_key,
+                    executor._pad_steps_feeds(feed_vals, target), target)
+        tag, hoist, fp, feed_sig, rest = (miss_key[0], miss_key[1],
+                                          miss_key[2], miss_key[3],
+                                          miss_key[4:])
+        dims = set()
+        for _, shape, _ in feed_sig:
+            if len(shape) < 2:
+                return None
+            dims.add(int(shape[1]))
+        if len(dims) != 1:
+            return None
+        b = dims.pop()
+
+        def rebucket(sig, new_b):
+            return tuple((n, (s[0], new_b) + tuple(s[2:]), dt)
+                         for n, s, dt in sig)
+
+        candidates = []
+        for k in self._cache:
+            if len(k) != len(miss_key) or k[0] != tag or k[1] != hoist \
+                    or k[2] != fp or k[4:] != rest:
+                continue
+            cdims = {int(s[1]) for _, s, _ in k[3] if len(s) >= 2}
+            if len(cdims) != 1:
+                continue
+            cand_b = cdims.pop()
+            if cand_b < b:
+                continue
+            if k[3] == rebucket(feed_sig, cand_b):
+                candidates.append(cand_b)
+        if not candidates:
+            return None
+        target_b = min(candidates)
+        if target_b == b:
+            return None
+        bucket_key = (tag, hoist, fp, rebucket(feed_sig, target_b)) + rest
+        memo[miss_key] = (bucket_key, (b, target_b))
+        return (bucket_key,
+                executor._pad_steps_feeds(feed_vals, (b, target_b)),
+                (b, target_b))
 
     def _traced_step(self, program, state_names, fetch_names, mesh):
         """The single traced (state, feed, seed) -> (fetches, state')

@@ -97,14 +97,16 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
                              {"shape": list(g.shape or [1]), "value": 0.0,
                               "dtype": g.dtype,
                               "op_uid": startup._next_uid()}))
-        # acc += g   (every step)
+        # acc += g   (every step — gm_role "accumulate" keeps it in the
+        # scan BODY under the commit-tail hoist; the averaging scale is
+        # commit work, only meaningful on the k-th step)
         _op(program, block, "elementwise_add", {"X": [acc], "Y": [g.name]},
-            {"Out": [acc]})
+            {"Out": [acc]}, {"gm_role": "accumulate"})
         if avg:
             avg_name = new_tmp_var(block, like=block.var(g.name),
                                    name_hint=g.name + "@GM_AVG")
             _op(program, block, "scale", {"X": [acc]}, {"Out": [avg_name]},
-                {"scale": 1.0 / k_steps, "bias": 0.0})
+                {"scale": 1.0 / k_steps, "bias": 0.0, "gm_role": "tail"})
         else:
             avg_name = acc
         grad_to_avg[g.name] = avg_name
@@ -127,12 +129,14 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
              "dtype": bucket["grad_dtype"],
              "op_uid": startup._next_uid()}))
         _op(program, block, "elementwise_add",
-            {"X": [sacc], "Y": [gshard]}, {"Out": [sacc]})
+            {"X": [sacc], "Y": [gshard]}, {"Out": [sacc]},
+            {"gm_role": "accumulate"})
         if avg:
             avg_name = new_tmp_var(block, like=block.var(sacc),
                                    name_hint=bucket["name"] + "@GM_AVG")
             _op(program, block, "scale", {"X": [sacc]},
-                {"Out": [avg_name]}, {"scale": 1.0 / k_steps, "bias": 0.0})
+                {"Out": [avg_name]}, {"scale": 1.0 / k_steps, "bias": 0.0,
+                                      "gm_role": "tail"})
         else:
             avg_name = sacc
         return sacc, avg_name
@@ -162,7 +166,15 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
                                           grad_to_avg.get(n, n))
                                for n in names]
         retarget_op_outputs_masked(program, op, mask, tail, rename)
+        # the masked optimizer group only DOES anything on the k-th
+        # step — the commit-tail hoist (distributed/scan_window.py)
+        # moves it out of the scan body, so the update (and the
+        # stage-1/2 publish allgather riding in it) runs once per
+        # window instead of K times
+        op.attrs.setdefault("gm_role", "tail")
         block.ops.append(op)
+    for sel in tail:
+        sel.attrs.setdefault("gm_role", "tail")
     block.ops.extend(tail)
 
     # record what a topology-shifted resume must re-derive: the counter
@@ -183,9 +195,11 @@ def apply_gradient_merge(program, startup, params_grads, k_steps, avg=True):
         zeros = new_tmp_var(block, like=block.var(acc),
                             name_hint=acc + "@ZERO")
         _op(program, block, "fill_zeros_like", {"X": [acc]},
-            {"Out": [zeros]}, {"dtype": block.var(acc).dtype})
+            {"Out": [zeros]}, {"dtype": block.var(acc).dtype,
+                               "gm_role": "tail"})
         _op(program, block, "where", {"Condition": [mask], "X": [zeros],
-                                      "Y": [acc]}, {"Out": [acc]})
+                                      "Y": [acc]}, {"Out": [acc]},
+            {"gm_role": "tail"})
     program._fingerprint_cache = None
     finish_pass(program, "gradient_merge", startup=startup,
                 k=int(k_steps), zero_stage=(getattr(plan, "stage", 0)

@@ -45,6 +45,12 @@ def append_masked_step_counter(program: Program, startup: Program,
     Ops appended (all straight-line):
         step = step + 1                (persistable write-back)
         mask = (step % k == 0) [& step >= begin]
+
+    Every op is stamped with a ``gm_role`` attr so the commit-tail
+    hoist (distributed/scan_window.py) can split the window: the
+    increment is ``"counter_inc"`` (runs once per micro-step, scan
+    BODY), the mask derivation is ``"mask"`` (pure function of the
+    persistable counter — replayed in BOTH body and hoisted tail).
     """
     block = program.global_block()
     # int32 counter: a float32 counter stops advancing at 2**24 steps
@@ -64,28 +70,31 @@ def append_masked_step_counter(program: Program, startup: Program,
     # mask, so the counter name rides a program attr
     program._last_masked_counter = step
     _op(program, block, "increment", {"X": [step]}, {"Out": [step]},
-        {"step": 1})
+        {"step": 1, "gm_role": "counter_inc"})
     kconst = new_tmp_var(block, name_hint=f"@{prefix}_k", dtype="int32")
     _op(program, block, "fill_constant", {}, {"Out": [kconst]},
-        {"shape": [1], "value": int(k_steps), "dtype": "int32"})
+        {"shape": [1], "value": int(k_steps), "dtype": "int32",
+         "gm_role": "mask"})
     rem = new_tmp_var(block, name_hint=f"@{prefix}_rem", dtype="int32")
     _op(program, block, "elementwise_mod", {"X": [step], "Y": [kconst]},
-        {"Out": [rem]})
+        {"Out": [rem]}, {"gm_role": "mask"})
     zero = new_tmp_var(block, name_hint=f"@{prefix}_zero", dtype="int32")
     _op(program, block, "fill_constant", {}, {"Out": [zero]},
-        {"shape": [1], "value": 0, "dtype": "int32"})
+        {"shape": [1], "value": 0, "dtype": "int32", "gm_role": "mask"})
     mask = new_tmp_var(block, name_hint=f"@{prefix}_mask", dtype="bool")
-    _op(program, block, "equal", {"X": [rem], "Y": [zero]}, {"Out": [mask]})
+    _op(program, block, "equal", {"X": [rem], "Y": [zero]}, {"Out": [mask]},
+        {"gm_role": "mask"})
     if begin_step > 0:
         beg = new_tmp_var(block, name_hint=f"@{prefix}_begin", dtype="int32")
         _op(program, block, "fill_constant", {}, {"Out": [beg]},
-            {"shape": [1], "value": int(begin_step), "dtype": "int32"})
+            {"shape": [1], "value": int(begin_step), "dtype": "int32",
+             "gm_role": "mask"})
         past = new_tmp_var(block, name_hint=f"@{prefix}_past", dtype="bool")
         _op(program, block, "greater_equal", {"X": [step], "Y": [beg]},
-            {"Out": [past]})
+            {"Out": [past]}, {"gm_role": "mask"})
         both = new_tmp_var(block, name_hint=f"@{prefix}_both", dtype="bool")
         _op(program, block, "logical_and", {"X": [mask], "Y": [past]},
-            {"Out": [both]})
+            {"Out": [both]}, {"gm_role": "mask"})
         mask = both
     return mask
 

@@ -321,7 +321,8 @@ def shard_optimizer_states(program: Program, startup: Program,
                            scale: bool = True,
                            fp16_allreduce: Optional[bool] = None,
                            stage: int = 1,
-                           rules: Tuple = ()) -> ShardingPlan:
+                           rules: Tuple = (),
+                           prefetch_gathers: bool = True) -> ShardingPlan:
     """Rewrite an already-minimized `program` for ZeRO sharded DP at
     `stage` 1 (optimizer slots), 2 (+ sharded gradient accumulation
     under gradient_merge), or 3 (+ the parameters themselves, with
@@ -351,6 +352,13 @@ def shard_optimizer_states(program: Program, startup: Program,
     keeps an embedding replicated under stage 3.  Strict user rules that
     claim a var the pass cannot shard are refused (over-match refusal,
     `build_sharding_specs`).
+
+    prefetch_gathers: stage-3 double-buffering — reorder each backward
+    param gather one bucket ahead of its use so bucket k+1's
+    ``c_allgather`` is in flight during bucket k's grad compute, pinned
+    with an ``optimization_barrier`` so XLA's scheduler cannot sink it
+    back to the consumer (`_prefetch_backward_gathers`).  Identity
+    numerics; default on.
     """
     import jax
     stage = int(stage)
@@ -745,6 +753,8 @@ def shard_optimizer_states(program: Program, startup: Program,
     # -- stage 3: just-in-time parameter gathers + startup pack -------------
     if packed:
         _emit_stage3_param_machinery(program, startup, packed, world)
+        if prefetch_gathers:
+            _prefetch_backward_gathers(program)
     program._fingerprint_cache = None
     startup._fingerprint_cache = None
 
@@ -874,6 +884,86 @@ def _emit_stage3_param_machinery(program: Program, startup: Program,
             sblock.ops.append(OpDesc(
                 "concat", {"X": flat}, {"Out": [pbucket]},
                 {"axis": 0, "op_uid": startup._next_uid()}))
+
+
+def _prefetch_backward_gathers(program: Program) -> int:
+    """Double-buffer the ZeRO-3 backward param gathers.
+
+    `_emit_stage3_param_machinery` places each bucket's ``gather_bwd``
+    ``c_allgather`` right before its first backward reader, so gather
+    latency serializes with the grad compute it feeds.  This post-pass
+    reorders each gather (the allgather only — the local slice/reshape
+    ops stay at the use site) one bucket EARLIER: gather j is issued
+    immediately before bucket j-1's first slice, and an
+    ``optimization_barrier`` over (gather j's output, bucket j-1's
+    gathered buffer) pins the issue order — bucket j-1's consumers now
+    depend on gather j having been scheduled, so XLA's latency-hiding
+    scheduler overlaps gather j with bucket j-1's grad compute instead
+    of sinking it back down to bucket j's slices.  At most two gathered
+    buckets are live at once (the double-buffer bound).  The barrier is
+    an identity: numerics are bit-identical.
+
+    Returns the number of gathers prefetched (0 or 1 bucket: nothing to
+    overlap).
+    """
+    block = program.global_block()
+
+    def _bwd_gathers():
+        return [op for op in block.ops
+                if op.type == "c_allgather"
+                and op.attrs.get("zero_role") == "gather_bwd"
+                and not op.attrs.get("zero_prefetched")]
+
+    gathers = _bwd_gathers()
+    if len(gathers) < 2:
+        return 0
+    # the name bucket j's slice ops currently read (updated as barriers
+    # re-route them through their @PIN outputs)
+    reads = [op.outputs["Out"][0] for op in gathers]
+    moved = 0
+    for j in range(1, len(gathers)):
+        g = gathers[j]
+        prev_read = reads[j - 1]
+        # bucket j-1's first consumer: the earliest slice reading its
+        # gathered buffer
+        pos = next((i for i, op in enumerate(block.ops)
+                    if op.type == "slice"
+                    and op.attrs.get("zero_role") == "gather_bwd"
+                    and prev_read in op.inputs.get("Input", [])), None)
+        if pos is None:
+            continue
+        gi = block.ops.index(g)
+        if gi < pos:
+            continue  # already ahead of the consumer it should overlap
+        pfull = g.outputs["Out"][0]
+        pvar = block.var(pfull)
+        prev_var = block.var(prev_read)
+        pf_pre = _tmp(block, pfull + "@PREFETCH", list(pvar.shape),
+                      pvar.dtype)
+        pin = _tmp(block, prev_read + "@PIN", list(prev_var.shape),
+                   prev_var.dtype)
+        g.outputs["Out"] = [pf_pre]
+        g.attrs["zero_prefetched"] = True
+        bar = _mk_op(program, "optimization_barrier",
+                     {"X": [pf_pre, prev_read]},
+                     {"Out": [pfull, pin]},
+                     {"zero_stage": 3,
+                      "zero_bucket": g.attrs.get("zero_bucket"),
+                      "zero_role": "gather_prefetch"})
+        bar.attrs[OpRole.KEY] = OpRole.Backward
+        for op in block.ops:
+            if op.type == "slice" and \
+                    op.attrs.get("zero_role") == "gather_bwd" and \
+                    prev_read in op.inputs.get("Input", []):
+                op.inputs["Input"] = [pin if n == prev_read else n
+                                      for n in op.inputs["Input"]]
+        del block.ops[gi]
+        block.ops[pos:pos] = [g, bar]
+        reads[j - 1] = pin
+        moved += 1
+    if moved:
+        program._fingerprint_cache = None
+    return moved
 
 
 # ---------------------------------------------------------------------------

@@ -37,6 +37,7 @@ from .layout_analysis import (  # noqa: F401
 from . import layout_analysis  # noqa: F401
 from .planner import (  # noqa: F401
     plan_program, apply_plan, Plan, ici_bytes_per_chip, page_budget,
+    calibrate, Calibration, default_calibration,
 )
 from . import planner  # noqa: F401
 from .recompute_rewrite import apply_recompute  # noqa: F401

@@ -476,7 +476,23 @@ def analyze_program(program: Program, batch: Optional[int] = None,
                     last_use[n] = i
                     for r in reps.get(n, ()):
                         last_use[r] = i
-        if op.type in _ALIAS_OPS or op.type in _FUSABLE_OPS:
+        if op.type == "optimization_barrier":
+            # positional aliasing: Out[i] IS X[i] (jax.lax.
+            # optimization_barrier returns its operand tuple unchanged).
+            # The union rule below would merge every operand pair into
+            # one root set — a multi-operand barrier (the ZeRO-3 gather
+            # prefetch pins bucket k+1's gather to bucket k's reads)
+            # would then chain ALL gathered buckets into a single
+            # lifetime and the walker would charge the whole parameter
+            # set as simultaneously live.
+            xs = op.inputs.get("X", [])
+            outs = op.outputs.get("Out", [])
+            for xn, on in zip(xs, outs):
+                if not on:
+                    continue
+                reps[on] = (reps.get(xn) or frozenset((xn,))) \
+                    if xn and xn not in persistable else frozenset()
+        elif op.type in _ALIAS_OPS or op.type in _FUSABLE_OPS:
             roots = frozenset(
                 r
                 for n in op.input_names() if n and n not in persistable

@@ -47,9 +47,27 @@ feasible batch wins, which is exactly the measured r5 result (b64 at
 
 Knobs the model deliberately prices as no-wins so the trace shows WHY:
 gradient_merge runs its (masked) commit and its reduction every
-micro-step in this implementation, so it never improves predicted
+micro-step in the LOOPED dispatch, so alone it never improves predicted
 throughput — it exists to hit an EFFECTIVE batch a bigger per-chip
 batch can't fit, and the trace table says so instead of hiding it.
+The `scan_hoist` knob changes that: under the scanned-window dispatch
+(`distributed/scan_window.split_commit_tail`) the commit tail — the
+optimizer update and the ZeRO publish allgather — runs ONCE per
+K-step window instead of every micro-step, so the publish-role wire
+bytes price at 1/K and a gm×ZeRO candidate can win on wire, not just
+on effective batch.
+
+The roofline is a RANKING model by default; `calibrate(pairs)` fits
+per-class efficiency coefficients (compute, overlappable wire, serial
+wire, plus a per-dispatch overhead intercept) from (predicted
+component, measured step) pairs so `predicted_step_ms` approaches
+wall-clock on the calibrated host.  `tools/calibrate_roofline.py`
+produces the pairs on the local mesh and checks the fit in at
+``perf_r05/roofline_calibration.json``; `plan_program` loads it
+automatically once its residual is under
+`DEFAULT_CALIBRATION_RESIDUAL_PCT` (opt out with
+``PADDLE_TPU_ROOFLINE_CALIBRATION=0``, or point the env at another
+fit).
 
 `apply_plan(program, startup, plan)` applies the chosen knobs to the
 real program, recording the plan in the `core/pass_framework`
@@ -61,6 +79,7 @@ run on the local mesh.
 from __future__ import annotations
 
 import itertools
+import math
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -68,9 +87,18 @@ from ..core.compile_cache import next_pow2 as _next_pow2
 from ..core.program import Program
 
 __all__ = ["Plan", "plan_program", "apply_plan", "ici_bytes_per_chip",
-           "page_budget", "ICI_ENV", "DEFAULT_ICI_BYTES_PER_S"]
+           "page_budget", "ICI_ENV", "DEFAULT_ICI_BYTES_PER_S",
+           "Calibration", "calibrate", "default_calibration",
+           "CALIBRATION_ENV", "DEFAULT_CALIBRATION_RESIDUAL_PCT"]
 
 ICI_ENV = "PADDLE_TPU_ICI_BYTES_PER_S"
+
+# roofline calibration: env points at a `calibrate()` JSON (or "0" to
+# disable); the default path is the checked-in fit produced by
+# tools/calibrate_roofline.py.  A fit is only trusted by default when
+# its held-in residual is under this bound.
+CALIBRATION_ENV = "PADDLE_TPU_ROOFLINE_CALIBRATION"
+DEFAULT_CALIBRATION_RESIDUAL_PCT = 15.0
 
 # v5e inter-chip interconnect: 1600 Gbit/s per chip = 200 GB/s — the
 # same chip the HBM budget (15.75 GiB) and peak-FLOPs (197 TF bf16)
@@ -94,7 +122,7 @@ DEFAULT_ZERO_STAGES = (1, 2, 3)
 # pre-built pairs in `variants={"tp": {degree: (main, startup)}}`, or
 # auto-generated from `model_config=`.
 KNOB_KEYS = ("batch", "remat", "dp_shard", "zero_stage", "grad_merge",
-             "bucket_mb", "ring", "tp_degree")
+             "bucket_mb", "ring", "tp_degree", "scan_hoist")
 
 # gradient reduction collectives XLA overlaps with backward compute —
 # on ring 0 (the dp axis) only: an mp-ring collective sits on the
@@ -117,6 +145,218 @@ def ici_bytes_per_chip() -> float:
         except ValueError:
             pass
     return DEFAULT_ICI_BYTES_PER_S
+
+
+# ---------------------------------------------------------------------------
+# roofline calibration
+# ---------------------------------------------------------------------------
+class Calibration:
+    """A fitted mapping from the roofline's predicted components to
+    wall-clock step time on one host class:
+
+        step_ms = max(compute_ms / eff_compute,
+                      wire_overlap_ms / eff_wire_overlap)
+                  + wire_serial_ms / eff_wire_serial
+                  + overhead_ms
+
+    The three ``eff_*`` coefficients are per-class efficiencies in
+    (0, 1] — the fraction of the peak rate that leg actually sustains —
+    and ``overhead_ms`` is the per-dispatch constant (tracing epilogue,
+    host transfer, runtime launch) the pure roofline prices at zero.
+    A coefficient whose component is zero in every fitted pair is
+    unidentifiable and stays at 1.0 (recorded in ``unidentified``).
+
+    Produced by `calibrate(pairs)`; consumed by `plan_program` (every
+    priced candidate's ``step_ms``/``samples_per_sec`` pass through
+    `step_ms()` and the record is stamped ``calibrated=True``)."""
+
+    __slots__ = ("eff_compute", "eff_wire_overlap", "eff_wire_serial",
+                 "overhead_ms", "residual_pct", "n_pairs",
+                 "unidentified", "source")
+
+    def __init__(self, eff_compute: float = 1.0,
+                 eff_wire_overlap: float = 1.0,
+                 eff_wire_serial: float = 1.0,
+                 overhead_ms: float = 0.0,
+                 residual_pct: float = 0.0, n_pairs: int = 0,
+                 unidentified: Tuple[str, ...] = (),
+                 source: str = ""):
+        self.eff_compute = float(eff_compute)
+        self.eff_wire_overlap = float(eff_wire_overlap)
+        self.eff_wire_serial = float(eff_wire_serial)
+        self.overhead_ms = float(overhead_ms)
+        self.residual_pct = float(residual_pct)
+        self.n_pairs = int(n_pairs)
+        self.unidentified = tuple(unidentified)
+        self.source = str(source)
+
+    def step_ms(self, compute_ms: float, wire_overlap_ms: float,
+                wire_serial_ms: float) -> float:
+        return (max(compute_ms / self.eff_compute,
+                    wire_overlap_ms / self.eff_wire_overlap) +
+                wire_serial_ms / self.eff_wire_serial + self.overhead_ms)
+
+    def to_dict(self) -> Dict:
+        return {
+            "eff_compute": round(self.eff_compute, 6),
+            "eff_wire_overlap": round(self.eff_wire_overlap, 6),
+            "eff_wire_serial": round(self.eff_wire_serial, 6),
+            "overhead_ms": round(self.overhead_ms, 6),
+            "residual_pct": round(self.residual_pct, 4),
+            "n_pairs": self.n_pairs,
+            "unidentified": list(self.unidentified),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict, source: str = "") -> "Calibration":
+        return cls(eff_compute=d.get("eff_compute", 1.0),
+                   eff_wire_overlap=d.get("eff_wire_overlap", 1.0),
+                   eff_wire_serial=d.get("eff_wire_serial", 1.0),
+                   overhead_ms=d.get("overhead_ms", 0.0),
+                   residual_pct=d.get("residual_pct", 0.0),
+                   n_pairs=d.get("n_pairs", 0),
+                   unidentified=tuple(d.get("unidentified") or ()),
+                   source=source)
+
+    def save(self, path: str, extra: Optional[Dict] = None):
+        import json
+        rec = {"calibration": self.to_dict()}
+        if extra:
+            rec.update(extra)
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Calibration":
+        import json
+        with open(path) as f:
+            rec = json.load(f)
+        return cls.from_dict(rec.get("calibration") or rec, source=path)
+
+    def __repr__(self):
+        return (f"Calibration(eff_compute={self.eff_compute:.3f}, "
+                f"eff_wire_overlap={self.eff_wire_overlap:.3f}, "
+                f"eff_wire_serial={self.eff_wire_serial:.3f}, "
+                f"overhead_ms={self.overhead_ms:.3f}, "
+                f"residual_pct={self.residual_pct:.1f}, "
+                f"n_pairs={self.n_pairs})")
+
+
+def calibrate(pairs: List[Dict]) -> Calibration:
+    """Fit a `Calibration` from (predicted components, measured) pairs.
+
+    Each pair is a dict with ``compute_ms``, ``wire_overlap_ms``,
+    ``wire_serial_ms`` (the planner's per-candidate roofline legs, e.g.
+    straight out of a `Plan.trace` record) and ``measured_ms`` (the
+    wall-clock per-step time of the SAME candidate on the target host).
+
+    The fit is a deterministic coordinate descent minimizing the mean
+    squared RELATIVE error (so a 10 ms shape and a 1000 ms shape weigh
+    equally), each coordinate refined over a shrinking log/linear grid.
+    ``residual_pct`` is the mean absolute percent error of the final
+    fit over the fitted pairs — the number the default-on gate
+    (`DEFAULT_CALIBRATION_RESIDUAL_PCT`) compares against."""
+    pts = [(max(0.0, float(p["compute_ms"])),
+            max(0.0, float(p["wire_overlap_ms"])),
+            max(0.0, float(p["wire_serial_ms"])),
+            float(p["measured_ms"]))
+           for p in pairs if float(p.get("measured_ms") or 0) > 0]
+    if not pts:
+        raise ValueError("calibrate: no pairs with measured_ms > 0")
+
+    ident_c = any(c > 0 for c, _, _, _ in pts)
+    ident_w = any(w > 0 for _, w, _, _ in pts)
+    ident_s = any(s > 0 for _, _, s, _ in pts)
+
+    def _err(ec, ew, es, oh):
+        tot = 0.0
+        for c, w, s, m in pts:
+            pred = max(c / ec, w / ew) + s / es + oh
+            rel = (pred - m) / m
+            tot += rel * rel
+        return tot / len(pts)
+
+    # coefficient search windows: efficiencies in (1e-4, 1]; overhead in
+    # [0, min measured] (an intercept above the fastest pair would fit
+    # negative work).  Three shrink rounds of 17-point per-coordinate
+    # grids ≈ 1e-3 relative resolution, deterministic and dependency-free.
+    coords = {"ec": 0.5 if ident_c else 1.0,
+              "ew": 0.5 if ident_w else 1.0,
+              "es": 0.5 if ident_s else 1.0,
+              "oh": 0.0}
+    spans = {"ec": (1e-4, 1.0), "ew": (1e-4, 1.0), "es": (1e-4, 1.0),
+             "oh": (0.0, min(m for _, _, _, m in pts))}
+    active = ([k for k, flag in (("ec", ident_c), ("ew", ident_w),
+                                 ("es", ident_s)) if flag] + ["oh"])
+    for _round in range(4):
+        for key in active:
+            lo, hi = spans[key]
+            best_v, best_e = coords[key], None
+            n = 17
+            for i in range(n):
+                if key == "oh":
+                    v = lo + (hi - lo) * i / (n - 1)
+                else:  # log-spaced: efficiencies vary over decades
+                    v = math.exp(math.log(max(lo, 1e-4)) +
+                                 (math.log(hi) - math.log(max(lo, 1e-4))) *
+                                 i / (n - 1))
+                trial = dict(coords)
+                trial[key] = v
+                e = _err(trial["ec"], trial["ew"], trial["es"], trial["oh"])
+                if best_e is None or e < best_e:
+                    best_v, best_e = v, e
+            coords[key] = best_v
+            # shrink the window around the winner for the next round
+            width = (hi - lo) / 4
+            spans[key] = (max(spans[key][0], best_v - width),
+                          min(spans[key][1] if key != "oh"
+                              else spans[key][1], best_v + width))
+
+    ec, ew, es, oh = coords["ec"], coords["ew"], coords["es"], coords["oh"]
+    resid = sum(abs(max(c / ec, w / ew) + s / es + oh - m) / m
+                for c, w, s, m in pts) / len(pts) * 100.0
+    unident = tuple(n for n, flag in (("compute", ident_c),
+                                      ("wire_overlap", ident_w),
+                                      ("wire_serial", ident_s)) if not flag)
+    return Calibration(eff_compute=ec, eff_wire_overlap=ew,
+                       eff_wire_serial=es, overhead_ms=oh,
+                       residual_pct=resid, n_pairs=len(pts),
+                       unidentified=unident)
+
+
+_CALIB_CACHE: Dict[Tuple, Optional[Calibration]] = {}
+
+
+def default_calibration() -> Optional[Calibration]:
+    """The calibration `plan_program` applies when the caller passes
+    none: the file named by ``PADDLE_TPU_ROOFLINE_CALIBRATION`` (unset →
+    the checked-in ``perf_r05/roofline_calibration.json``; "0"/"off" →
+    disabled), trusted only when its recorded residual is under
+    `DEFAULT_CALIBRATION_RESIDUAL_PCT`.  Cached per (path, mtime)."""
+    raw = os.environ.get(CALIBRATION_ENV, "")
+    if raw.lower() in ("0", "off", "false", "none"):
+        return None
+    path = raw or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "perf_r05", "roofline_calibration.json")
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    key = (path, mtime)
+    if key not in _CALIB_CACHE:
+        try:
+            calib = Calibration.load(path)
+        except Exception:
+            calib = None
+        if calib is not None and not (
+                calib.residual_pct < DEFAULT_CALIBRATION_RESIDUAL_PCT):
+            calib = None  # fit exists but isn't trusted yet
+        _CALIB_CACHE.clear()  # one live entry; stale mtimes never pile up
+        _CALIB_CACHE[key] = calib
+    return _CALIB_CACHE[key]
 
 
 class Plan:
@@ -148,6 +388,10 @@ class Plan:
         self.predicted_flops = int(chosen["flops"])
         self.predicted_effective_global_batch = int(
             chosen.get("effective_global_batch") or 0)
+        self.predicted_calibrated = bool(chosen.get("calibrated"))
+        # the Calibration the prices passed through (plan_program fills
+        # this in; None = raw roofline ranking numbers)
+        self.calibration: Optional[Calibration] = None
         # tp build pairs (plan_program fills this in): {degree: (main,
         # startup[, loss_name])} so callers can train the winning build
         self.build_variants: Dict[int, Tuple] = {}
@@ -173,6 +417,10 @@ class Plan:
             "predicted_wire_ms": round(self.predicted_wire_ms, 4),
             "predicted_effective_global_batch":
                 self.predicted_effective_global_batch,
+            "calibrated": self.predicted_calibrated,
+            "calibration_residual_pct":
+                (round(self.calibration.residual_pct, 4)
+                 if self.calibration is not None else None),
             "n_candidates": len(self.trace),
         }
 
@@ -180,13 +428,14 @@ class Plan:
         """The per-candidate trace as a markdown table (the docs/perf.md
         decision-table source)."""
         head = ("| batch | remat | dp_shard | stage | gm K | bucket MB | "
-                "ring | tp | peak GiB | fits | step ms | verdict |")
-        sep = "|---|---|---|---|---|---|---|---|---|---|---|---|"
+                "ring | tp | scan | peak GiB | fits | step ms | verdict |")
+        sep = "|---|---|---|---|---|---|---|---|---|---|---|---|---|"
         rows = [head, sep]
         for c in self.trace:
             rows.append(
                 "| {batch} | {remat} | {dp_shard} | {zero_stage} | "
                 "{grad_merge} | {bucket_mb} | {ring} | {tp_degree} | "
+                "{scan_hoist} | "
                 "{gib:.2f} | {fits} | {step_ms:.2f} | {verdict} |".format(
                     gib=c["peak_bytes"] / 2 ** 30,
                     fits="yes" if c["fits"] else "no",
@@ -194,7 +443,7 @@ class Plan:
                        for k in ("batch", "remat", "dp_shard",
                                  "zero_stage", "grad_merge",
                                  "bucket_mb", "ring", "tp_degree",
-                                 "step_ms", "verdict")}))
+                                 "scan_hoist", "step_ms", "verdict")}))
         return "\n".join(rows)
 
     def __repr__(self):
@@ -247,6 +496,11 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
     buckets = tuple(knobs.get("bucket_mb") or DEFAULT_BUCKET_MB)
     rings = tuple(knobs.get("ring") or
                   ((False, True) if have_ring_variant else (False,)))
+    # scan_hoist is a DISPATCH knob, not a rewrite: it rides any
+    # gradient-merge candidate (the hoisted window needs a commit tail
+    # to hoist) and shares the gm candidate's rewrite point
+    hoists = tuple(knobs.get("scan_hoist") or
+                   ((False, True) if can_gm else (False,)))
     tps = tuple(knobs.get("tp_degree")
                 if knobs.get("tp_degree") is not None
                 else ((0,) + tuple(sorted(tp_candidates))))
@@ -267,8 +521,8 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
         dps = tuple(d for d in dps_raw
                     if d == 0 or (d <= dp_world and dp_world % d == 0)) \
             or (0,)
-        for b, r, dp, z, gm, mb, ring in itertools.product(
-                batches, remats, dps, stages, gms, buckets, rings):
+        for b, r, dp, z, gm, mb, ring, sh in itertools.product(
+                batches, remats, dps, stages, gms, buckets, rings, hoists):
             if ring and not have_ring_variant:
                 continue
             if ring and tp > 1:
@@ -284,15 +538,19 @@ def _knob_lattice(world: int, batch: Optional[int], knobs: Optional[Dict],
             z_eff = int(z) if dp > 1 else 0
             if z_eff == 2 and gm <= 1:
                 z_eff = 1
+            # the hoist needs a commit tail: no merge window, nothing
+            # to hoist — the knob collapses to the looped dispatch
+            sh_eff = bool(sh) and int(gm) > 1
             key = (int(b), bool(r), int(dp), z_eff, int(gm), mb_eff,
-                   bool(ring), tp)
+                   bool(ring), tp, sh_eff)
             if key in seen:
                 continue
             seen.add(key)
             out.append({"batch": int(b), "remat": bool(r),
                         "dp_shard": int(dp), "zero_stage": z_eff,
                         "grad_merge": int(gm), "bucket_mb": mb_eff,
-                        "ring": bool(ring), "tp_degree": tp})
+                        "ring": bool(ring), "tp_degree": tp,
+                        "scan_hoist": sh_eff})
     return out
 
 
@@ -338,19 +596,29 @@ class _RewritePoint:
 
     __slots__ = ("main", "startup", "reduced", "tp", "dp_world",
                  "wire_overlap", "wire_serial", "wire_by_axis",
-                 "mp_sharded", "error", "verify_verdict")
+                 "wire_publish", "wire_publish_by_axis",
+                 "mp_sharded", "error", "verify_verdict", "price_cache")
 
     def __init__(self, base_main, base_startup, cand, world):
         from .verifier import (collective_sequence, entry_wire_bytes,
                                _ring_degrees_from_seq, ring_axis)
         self.error = None
         self.verify_verdict = None  # lazily computed, cached
+        # (peak_bytes, mem_fits, flops) per batch bucket: the HBM and
+        # FLOPs walks are scan_hoist-independent, so the hoisted and
+        # looped spellings of one rewrite point share them
+        self.price_cache: Dict[int, Tuple[int, bool, int]] = {}
         self.tp = int(cand.get("tp_degree") or 0)
         self.dp_world = world // self.tp if self.tp > 1 else world
         # (fixed, per-batch-unit) accumulators
         self.wire_overlap = [0.0, 0.0]
         self.wire_serial = [0.0, 0.0]
         self.wire_by_axis: Dict[str, List[float]] = {}
+        # publish-role bytes tracked SEPARATELY (a subset of the serial
+        # bucket): the scan_hoist knob prices them at 1/K because the
+        # hoisted commit tail publishes once per merge window
+        self.wire_publish = [0.0, 0.0]
+        self.wire_publish_by_axis: Dict[str, List[float]] = {}
         self.mp_sharded = None
         try:
             self.main, self.startup = _apply_knobs(base_main, base_startup,
@@ -393,6 +661,13 @@ class _RewritePoint:
                 ax = self.wire_by_axis.setdefault(axis, [0.0, 0.0])
                 ax[0] += fixed
                 ax[1] += per_unit
+                if e.get("zero_role") == "publish":
+                    self.wire_publish[0] += fixed
+                    self.wire_publish[1] += per_unit
+                    pa = self.wire_publish_by_axis.setdefault(
+                        axis, [0.0, 0.0])
+                    pa[0] += fixed
+                    pa[1] += per_unit
 
     def verify(self) -> str:
         """check_program on the reduced program — once per rewrite point
@@ -415,7 +690,8 @@ class _RewritePoint:
 
 def _price(point: _RewritePoint, cand: Dict, hbm_budget: Optional[int],
            peak_flops: float, ici_bps: float, world: int,
-           global_batch: Optional[int] = None) -> Dict:
+           global_batch: Optional[int] = None,
+           calib: Optional[Calibration] = None) -> Dict:
     """Roofline-price one (rewrite point, batch) candidate.
 
     2-D accounting: compute divides the mp-STAMPED ops' walked FLOPs by
@@ -438,37 +714,59 @@ def _price(point: _RewritePoint, cand: Dict, hbm_budget: Optional[int],
 
     batch = cand["batch"]
     tp = point.tp
-    mem = analyze_program(point.main, batch=batch, budget_bytes=hbm_budget,
-                          tp_degree=tp if tp > 1 else None,
-                          tp_sharded=point.mp_sharded)
-    rep = analyze_flops(point.main, batch=batch)
-    flops = rep["total_flops"]
-    if tp > 1:
-        block = point.main.global_block()
-        sharded = sum(
-            r["flops"] for r in rep["per_op"]
-            if block.ops[r["index"]].attrs.get("mp_axis"))
-        flops = (flops - sharded) + sharded / tp
+    cached = point.price_cache.get(batch)
+    if cached is None:
+        mem = analyze_program(point.main, batch=batch,
+                              budget_bytes=hbm_budget,
+                              tp_degree=tp if tp > 1 else None,
+                              tp_sharded=point.mp_sharded)
+        rep = analyze_flops(point.main, batch=batch)
+        flops = rep["total_flops"]
+        if tp > 1:
+            block = point.main.global_block()
+            sharded = sum(
+                r["flops"] for r in rep["per_op"]
+                if block.ops[r["index"]].attrs.get("mp_axis"))
+            flops = (flops - sharded) + sharded / tp
+        cached = (int(mem["peak_bytes"]), bool(mem["fits"]), flops)
+        point.price_cache[batch] = cached
+    peak_bytes, mem_fits, flops = cached
     compute_s = flops / peak_flops if peak_flops else 0.0
     wo = point.wire_overlap[0] + batch * point.wire_overlap[1]
     ws = point.wire_serial[0] + batch * point.wire_serial[1]
+    gm_k = max(1, int(cand["grad_merge"]))
+    axis_discount: Dict[str, float] = {}
+    if cand.get("scan_hoist") and gm_k > 1:
+        # hoisted commit tail: the publish allgather runs once per
+        # K-step window, so its per-step bytes price at 1/K (publish is
+        # always serial — allgather after the sharded update)
+        pub = point.wire_publish[0] + batch * point.wire_publish[1]
+        ws -= pub * (1.0 - 1.0 / gm_k)
+        axis_discount = {
+            a: (f + batch * u) * (1.0 - 1.0 / gm_k)
+            for a, (f, u) in point.wire_publish_by_axis.items()}
     wo_s = wo / ici_bps if ici_bps else 0.0
     ws_s = ws / ici_bps if ici_bps else 0.0
-    step_s = max(compute_s, wo_s) + ws_s
-    eff_batch = batch * point.dp_world * max(1, int(cand["grad_merge"]))
+    if calib is not None:
+        step_s = calib.step_ms(compute_s * 1e3, wo_s * 1e3,
+                               ws_s * 1e3) / 1e3
+    else:
+        step_s = max(compute_s, wo_s) + ws_s
+    eff_batch = batch * point.dp_world * gm_k
     rec = dict(cand)
     rec.update({
-        "peak_bytes": int(mem["peak_bytes"]),
-        "fits": bool(mem["fits"]),
+        "peak_bytes": peak_bytes,
+        "fits": mem_fits,
         "flops": int(flops),
         "wire_bytes": int(wo + ws),
         "wire_bytes_per_axis": {
-            a: int(f + batch * u)
+            a: int(f + batch * u - axis_discount.get(a, 0.0))
             for a, (f, u) in sorted(point.wire_by_axis.items())},
         "compute_ms": compute_s * 1e3,
         "wire_overlap_ms": wo_s * 1e3,
         "wire_serial_ms": ws_s * 1e3,
         "step_ms": step_s * 1e3,
+        "calibrated": calib is not None,
         "effective_global_batch": int(eff_batch),
         "samples_per_sec": (batch * point.dp_world / max(1, world) / step_s)
         if step_s > 0 else 0.0,
@@ -542,7 +840,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                  global_batch: Optional[int] = None,
                  peak_flops: Optional[float] = None,
                  ici_bytes_per_s: Optional[float] = None,
-                 verify: bool = True) -> Plan:
+                 verify: bool = True,
+                 calibration: Optional[Calibration] = None) -> Plan:
     """Compile-time search for the best training configuration of
     `program` on a `world`-chip mesh (data-parallel, or 2-D dp×tp when
     tensor-parallel build variants are in the lattice).  Returns a
@@ -593,6 +892,14 @@ def plan_program(program: Program, startup: Optional[Program] = None,
       Leave on; it exists as a switch only for estimator-sweep modes
       that re-plan the same program family many times
       (`bench.py --seq-ladder`).
+    * `calibration` — a `Calibration` every candidate's price passes
+      through (``calibrated=True`` in the trace records).  Default
+      (None) consults `default_calibration()`: the checked-in
+      ``perf_r05/roofline_calibration.json`` fit when its residual is
+      under `DEFAULT_CALIBRATION_RESIDUAL_PCT` (env
+      ``PADDLE_TPU_ROOFLINE_CALIBRATION`` overrides the path or
+      disables with "0").  Pass ``False`` to force raw roofline
+      ranking numbers.
 
     Selection: among verified fitting candidates, maximize predicted
     samples/sec/chip (ties prefer fewer knobs, then lower peak bytes).
@@ -611,6 +918,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     budget = int(hbm_budget) if hbm_budget else hbm_budget_bytes()
     peak = float(peak_flops) if peak_flops else peak_flops_per_chip("tpu")
     ici = float(ici_bytes_per_s) if ici_bytes_per_s else ici_bytes_per_chip()
+    calib = default_calibration() if calibration is None else \
+        (calibration or None)
     variants = dict(variants or {})
 
     # tensor-parallel build variants: hand-fed pairs win; a model config
@@ -658,8 +967,11 @@ def plan_program(program: Program, startup: Optional[Program] = None,
     # a program BUILT through the tensor_parallel builders can't drop
     # its Megatron collectives — the tp axis pins like the ring knob
     pre_tp = _built_tp_degree(program)
+    pre_hoist = has_applied(program, "scan_hoist")
 
     eff_knobs = dict(knobs or {})
+    if pre_hoist:
+        eff_knobs["scan_hoist"] = (True,)
     if pre_remat:
         eff_knobs["remat"] = (True,)
     if pre_gm:
@@ -689,7 +1001,7 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                     "dp_shard": pre_dp, "zero_stage": pre_stage,
                     "grad_merge": pre_gm or 1,
                     "bucket_mb": pre_bucket_mb, "ring": pre_ring,
-                    "tp_degree": pre_tp}]
+                    "tp_degree": pre_tp, "scan_hoist": bool(pre_hoist)}]
 
     trace: List[Dict] = []
     points: Dict[Tuple, _RewritePoint] = {}
@@ -717,11 +1029,12 @@ def plan_program(program: Program, startup: Optional[Program] = None,
                             "wire_overlap_ms": 0.0, "wire_serial_ms": 0.0,
                             "step_ms": float("inf"), "samples_per_sec": 0.0,
                             "effective_global_batch": 0,
+                            "calibrated": False,
                             "verdict": f"rewrite refused: {point.error!r}"})
                 trace.append(rec)
                 continue
             rec = _price(point, cand, budget, peak, ici, world,
-                         global_batch)
+                         global_batch, calib)
             if verify and rec["fits"]:
                 verdict = point.verify()
                 rec["verdict"] = verdict
@@ -742,7 +1055,8 @@ def plan_program(program: Program, startup: Optional[Program] = None,
         return (int(r["remat"]) + int(r["dp_shard"] > 1) +
                 max(0, int(r.get("zero_stage") or 0) - 1) +
                 int(r["grad_merge"] > 1) + int(r["ring"]) +
-                int((r.get("tp_degree") or 0) > 1))
+                int((r.get("tp_degree") or 0) > 1) +
+                int(bool(r.get("scan_hoist"))))
 
     if feasible:
         chosen = max(feasible,
@@ -762,6 +1076,7 @@ def plan_program(program: Program, startup: Optional[Program] = None,
             r["verdict"] = chosen["verdict"]
     knob_dict = {k: chosen[k] for k in KNOB_KEYS}
     plan = Plan(knob_dict, world, budget, chosen, trace)
+    plan.calibration = calib
     # the tp build pairs (hand-fed AND auto-generated) ride the plan so
     # a caller can apply/train the winning variant without rebuilding:
     # {degree: (main, startup)} or (main, startup, loss_name) for
@@ -828,6 +1143,11 @@ def apply_plan(program: Program, startup: Optional[Program], plan) -> Program:
             not has_applied(program, "gradient_merge"):
         from .optimizer import gradient_merge
         gradient_merge(program, int(knobs["grad_merge"]), startup)
+    if knobs.get("scan_hoist") and not has_applied(program, "scan_hoist"):
+        # dispatch-level knob: validates the window splits cleanly and
+        # records it so run_steps' hoisted path + V504 see the intent
+        from ..distributed.scan_window import mark_scan_hoist
+        mark_scan_hoist(program)
     # record LAST (the rewrites' own self-checks run mid-application;
     # recording first would make them see a plan whose passes aren't
     # applied yet and V504 at the rewrite site), then self-check the

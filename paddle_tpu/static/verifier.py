@@ -1143,6 +1143,36 @@ def _check_collectives(program: Program, out: List[Diagnostic]):
             if p.type in _REDUCE_TRANSPARENT:
                 frontier.extend(p.input_names())
 
+    # V208: a per-micro-step collective the scanned-window hoist would
+    # have removed.  A gradient-merge program's publish-role collectives
+    # (the ZeRO allgather after the masked commit) run under the merge
+    # MASK — K-1 of every K dispatches move those bytes to publish
+    # values the mask then discards.  The commit-tail hoist
+    # (distributed/scan_window.mark_scan_hoist + Executor.run_steps)
+    # runs them once per window instead; warn when the program merged
+    # gradients but nothing recorded the hoist.  Keyed off the gm mask
+    # (gm_role stamps / _gm_meta) + the publish zero_role stamps, so a
+    # hand-built masked commit without the stamps stays silent rather
+    # than false-positive.
+    from ..core.pass_framework import has_applied
+    gm_meta = getattr(program, "_gm_meta", None) or {}
+    if int(gm_meta.get("k", 0) or 0) > 1 and \
+            not has_applied(program, "scan_hoist"):
+        has_mask = any(op.attrs.get("gm_role") == "mask"
+                       for op in block.ops)
+        for e in seq:
+            if e.get("zero_role") == "publish" and has_mask:
+                out.append(Diagnostic(
+                    "V208", WARNING,
+                    f"{e['type']} publishes under a gradient-merge mask "
+                    f"(K={gm_meta['k']}): {gm_meta['k'] - 1} of every "
+                    f"{gm_meta['k']} dispatches move these bytes for a "
+                    f"masked-out commit — the scanned-window hoist "
+                    f"(scan_window.mark_scan_hoist / run_steps) "
+                    f"publishes once per window",
+                    block_idx=e["block"], op_idx=e["index"],
+                    op_type=e["type"], op_uid=e["op_uid"], var=e["var"]))
+
     _check_pass_order(program, out)
 
 
@@ -1244,6 +1274,15 @@ def _check_pass_order(program: Program, out: List[Diagnostic]):
             if int(plan["tp_degree"] or 0) != tp_applied:
                 _drift("tp_degree", int(plan["tp_degree"] or 0),
                        tp_applied)
+        if "scan_hoist" in plan:
+            # the hoist is a dispatch knob recorded by mark_scan_hoist —
+            # a plan that priced the publish at 1/K over a program
+            # nobody marked (or a marked program whose plan priced the
+            # looped wire) attributes bytes that never moved
+            hoist_applied = "scan_hoist" in order
+            if bool(plan["scan_hoist"]) != hoist_applied:
+                _drift("scan_hoist", bool(plan["scan_hoist"]),
+                       hoist_applied)
 
 
 # ---------------------------------------------------------------------------

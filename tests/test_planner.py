@@ -197,6 +197,41 @@ def test_plan_drift_v504_fires_on_missing_pass():
                for d in report.errors), report.render()
 
 
+def test_plan_drift_v504_scan_hoist_missing_pass():
+    """Mutation (ISSUE 16): the plan chose the scanned commit-tail
+    hoist but `mark_scan_hoist` never recorded — the runtime would
+    silently run the looped K-publish window the plan priced away."""
+    main, startup, loss, _ = _tiny()
+    from paddle_tpu.core.pass_framework import record_applied
+    static.gradient_merge(main, 4, startup)
+    record_applied(main, "auto_parallel_plan", batch=8, remat=False,
+                   dp_shard=0, zero_stage=0, grad_merge=4, bucket_mb=0,
+                   ring=False, tp_degree=0, scan_hoist=True)
+    report = static.check_program(main, level="collective",
+                                  startup=startup)
+    assert any(d.code == "V504" and "scan_hoist" in d.message
+               for d in report.errors), report.render()
+
+
+def test_plan_drift_v504_scan_hoist_hand_marked():
+    """The reverse mutation: the plan said LOOPED (scan_hoist False)
+    but someone hand-marked the hoist after planning."""
+    from paddle_tpu.distributed.scan_window import mark_scan_hoist
+    main, startup, loss, _ = _tiny()
+    plan = static.plan_program(main, startup, world=1, batch=8,
+                               knobs={"remat": (False,),
+                                      "grad_merge": (4,),
+                                      "scan_hoist": (False,)})
+    static.apply_plan(main, startup, plan)
+    clean = static.check_program(main, level="collective", startup=startup)
+    assert "V504" not in clean.codes(), clean.render()
+    mark_scan_hoist(main)
+    drifted = static.check_program(main, level="collective",
+                                   startup=startup)
+    assert any(d.code == "V504" and "scan_hoist" in d.message
+               for d in drifted.errors), drifted.render()
+
+
 def test_plan_prefers_fitting_knobs_over_infeasible_plain():
     """The planner's whole point: when plain doesn't fit, the chosen
     plan carries the knob that makes it fit (remat here), with a FITS
@@ -264,7 +299,11 @@ def test_planner_searches_zero_stages_and_picks_zero3_unprompted():
     main, startup, loss = _fc_tower()
     param_bytes = sum(int(np.prod(p.shape)) * 4
                       for p in main.all_parameters())
-    budget = int(param_bytes * 0.9)   # params alone exceed the chip
+    # params alone exceed the chip; the +2 MiB headroom covers the
+    # stage-3 backward-gather PREFETCH double buffer (two gathered
+    # 1-MiB buckets live at once — the walker charges the overlap the
+    # prefetch really costs), still far under any replicated-param peak
+    budget = int(param_bytes * 0.9) + 2 * 2 ** 20
     plan = static.plan_program(main, startup, world=8, batch=4,
                                hbm_budget=budget,
                                knobs={"batch": (4,), "grad_merge": (1,),

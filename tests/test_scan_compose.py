@@ -1,0 +1,193 @@
+"""Scanned-window composition (ISSUE 16 satellite): `Executor.run_steps`
+x {ZeRO-1, ZeRO-2 x gradient-merge (commit-tail HOISTED), ZeRO-3,
+tensor-parallel, elastic} matches the looped per-step path to 1e-6 on
+the 8-device CPU mesh — per-micro-step losses AND final parameters.
+
+The zero2+gm (the hoisted default hot path) and tp legs stay tier-1;
+the remaining composes are `slow` (each costs a mesh XLA compile and
+the tier-1 budget is guarded — same split as test_elastic_compose).
+"""
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+
+import jax  # noqa: E402
+
+import paddle_tpu.static as static  # noqa: E402
+from paddle_tpu.core.program import _reset_unique_names  # noqa: E402
+from paddle_tpu.distributed.compiled_program import (  # noqa: E402
+    BuildStrategy, CompiledProgram)
+from paddle_tpu.distributed.sharding import shard_optimizer_states  # noqa: E402
+from paddle_tpu.static import layers  # noqa: E402
+
+WORLD = 8
+GB = 8  # global batch: divides the dp mesh under every variant
+
+
+def _model():
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        y = layers.data("y", [-1, 1])
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        loss = layers.mean(layers.square(layers.elementwise_sub(pred, y)))
+        static.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _variant(name):
+    """(main, startup, loss, build_strategy, steps-per-window)."""
+    main, startup, loss = _model()
+    bs, k = None, 4
+    if name == "zero1":
+        shard_optimizer_states(main, startup, dp_degree=WORLD, stage=1)
+    elif name == "zero2_gm":
+        shard_optimizer_states(main, startup, dp_degree=WORLD, stage=2)
+        static.gradient_merge(main, 2, startup_program=startup)
+        k = 2  # window == merge window, so the hoist gate engages
+    elif name == "zero3":
+        shard_optimizer_states(main, startup, dp_degree=WORLD, stage=3)
+    elif name == "tp2":
+        bs = BuildStrategy()
+        bs.tensor_parallel_degree = 2
+    else:
+        raise AssertionError(name)
+    return main, startup, loss, bs, k
+
+
+def _feeds(n):
+    rng = np.random.RandomState(3)
+    return [{"x": rng.rand(GB, 8).astype(np.float32),
+             "y": rng.rand(GB, 1).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _run(name, scanned, windows=2):
+    main, startup, loss, bs, k = _variant(name)
+    feeds = _feeds(windows * k)
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(loss_name=loss.name,
+                                                  build_strategy=bs)
+    losses = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        if scanned:
+            d0 = cp._dispatches
+            for w in range(windows):
+                sfeed = {fn: np.stack([feeds[w * k + i][fn]
+                                       for i in range(k)])
+                         for fn in ("x", "y")}
+                outs = exe.run_steps(cp, feed=sfeed, fetch_list=[loss])
+                losses.extend(np.asarray(outs[0]).reshape(-1))
+            # the window IS one device dispatch, whatever the variant
+            assert cp._dispatches - d0 == windows
+        else:
+            for f in feeds:
+                out = exe.run(cp, feed=f, fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        # every persistable materialized in the scope — under zero3 the
+        # raw params are packed into dp_shard buckets, so comparing the
+        # scope's persistables covers params, master state, and the gm
+        # counter uniformly across variants
+        params = {}
+        for vn, v in main.global_block().vars.items():
+            arr = scope.get(vn) if v.persistable else None
+            if arr is not None:
+                params[vn] = np.asarray(arr)
+    assert len(params) >= 4, sorted(params)
+    hoisted = any(key[0] == "steps" and key[1] for key in cp._cache)
+    return np.asarray(losses, np.float64), params, hoisted
+
+
+def _assert_compose(name, expect_hoist=False):
+    l_loss, l_params, _ = _run(name, scanned=False)
+    s_loss, s_params, hoisted = _run(name, scanned=True)
+    np.testing.assert_allclose(l_loss, s_loss, rtol=1e-6, atol=1e-6)
+    assert l_params.keys() == s_params.keys()
+    for n in sorted(l_params):
+        np.testing.assert_allclose(l_params[n], s_params[n],
+                                   rtol=1e-6, atol=1e-6, err_msg=n)
+    if expect_hoist:
+        assert hoisted, ("the zero2 x gm window must take the HOISTED "
+                         "scan variant (cache key flag)")
+
+
+# -- tier-1: the default hot path and the tp mesh ---------------------------
+def test_scan_zero2_gm_hoisted_matches_looped():
+    _assert_compose("zero2_gm", expect_hoist=True)
+
+
+def test_scan_tp2_matches_looped():
+    _assert_compose("tp2")
+
+
+# -- slow: the remaining composes (one mesh compile each) -------------------
+@pytest.mark.slow
+def test_scan_zero1_matches_looped():
+    _assert_compose("zero1")
+
+
+@pytest.mark.slow
+def test_scan_zero3_matches_looped():
+    _assert_compose("zero3")
+
+
+@pytest.mark.slow
+def test_scan_elastic_matches_looped_window():
+    """elastic x run_steps: the K-micro-step elastic window scanned
+    into one dispatch tracks the looped schedule to 1e-6 (the bitwise
+    contract lives in test_elastic_compose; this seals the compose
+    matrix from the scanned side)."""
+    from paddle_tpu.distributed.elastic import elasticize, rebucket_feeds
+    world, logical = 4, 8
+    feeds = _feeds(3)
+
+    def build():
+        main, startup, loss = _model()
+        meta = elasticize(main, startup, logical_dp=logical,
+                          loss_name=loss)
+        return main, startup, loss, meta
+
+    main, startup, loss, meta = build()
+    exe = static.Executor()
+    scope = static.Scope()
+    cp = CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, places=list(jax.devices())[:world])
+    looped = []
+    with static.scope_guard(scope):
+        exe.run(startup)
+        for f in feeds:
+            for mf in rebucket_feeds(f, logical, world):
+                out = exe.run(cp, feed=mf, fetch_list=[meta["loss_avg"]])
+            looped.append(np.asarray(out[0]).reshape(-1)[0])
+        lp = {p.name: np.asarray(scope.get(p.name))
+              for p in main.all_parameters()}
+
+    main2, startup2, loss2, meta2 = build()
+    exe2 = static.Executor()
+    scope2 = static.Scope()
+    cp2 = CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name, places=list(jax.devices())[:world])
+    scanned = []
+    with static.scope_guard(scope2):
+        exe2.run(startup2)
+        for f in feeds:
+            micro = rebucket_feeds(f, logical, world)
+            stacked = {n: np.stack([m[n] for m in micro])
+                       for n in micro[0]}
+            outs = exe2.run_steps(cp2, feed=stacked,
+                                  fetch_list=[meta2["loss_avg"]])
+            scanned.append(np.asarray(outs[0])[-1].reshape(-1)[0])
+        sp = {p.name: np.asarray(scope2.get(p.name))
+              for p in main2.all_parameters()}
+
+    np.testing.assert_allclose(looped, scanned, rtol=1e-6, atol=1e-6)
+    for n in sorted(lp):
+        np.testing.assert_allclose(lp[n], sp[n], rtol=1e-6, atol=1e-6,
+                                   err_msg=n)
