@@ -387,6 +387,8 @@ def serving_main():
         "speedup_vs_serial": round(batched["qps"] /
                                    max(serial["qps"], 1e-9), 3),
         "paged_kv": _serving_paged_ab(),
+        "radix_prefix": _serving_radix_ab(),
+        "speculative": _serving_speculative_ab(),
     }
     print(json.dumps(result))
 
@@ -516,6 +518,187 @@ def _serving_paged_ab():
             paged_side["peak_concurrent_seqs"] /
             max(1, fixed_side["peak_concurrent_seqs"]), 2),
         "token_equal_vs_generate": bool(token_equal),
+    }
+
+
+def _serving_radix_ab():
+    """Retained-prefix generation A/B on a repeated-system-prompt
+    trace: a few long system prompts recur across the request stream
+    with unique user tails, so after each head's first retirement the
+    radix tree serves its pages back and prefill runs only the
+    uncovered suffix.  The cold side is an identical engine with no
+    prefix cache.  Requests drain sequentially (each retires before the
+    next prefills) so the hit pattern is the trace's, not a scheduling
+    race's.  Reported are the retained-hit rate, prefill tokens skipped
+    vs actually run, tokens/s on both sides, and token-equality — a
+    radix hit must never change output."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedKVPool, RadixPrefixCache,
+                                    metrics)
+    from paddle_tpu.serving.metrics import reset_serving_stats
+    from paddle_tpu.static import page_budget
+
+    n_req = int(os.environ.get("BENCH_SERVING_RADIX_REQUESTS", 24))
+    kv_hbm = int(os.environ.get("BENCH_SERVING_GEN_HBM", 1 << 20))
+    n_heads, head_tokens, max_new = 3, 32, 8
+    rng = np.random.RandomState(17)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position=128, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.gpt.parameters()))
+        plan = page_budget(m, page_tokens=16, max_context=128,
+                           hbm_bytes=weight_bytes + kv_hbm)
+        heads = [rng.randint(2, 64, (head_tokens,)).astype(np.int64)
+                 for _ in range(n_heads)]
+        prompts = [np.concatenate([heads[i % n_heads],
+                                   rng.randint(2, 64, (8,))
+                                   .astype(np.int64)])
+                   for i in range(n_req)]
+
+        def drain_seq(eng):
+            reset_serving_stats()
+            eng.start()
+            t0 = time.time()
+            try:
+                outs = [np.asarray(eng.submit(p, max_length=max_new)
+                                   .result(timeout=300))
+                        for p in prompts]
+            finally:
+                eng.stop()
+            return outs, time.time() - t0
+
+        cold_pool = PagedKVPool.from_plan(plan)
+        c_outs, c_dt = drain_seq(
+            ContinuousBatchingEngine(m, max_slots=4, kv_pool=cold_pool))
+        c_prefill = metrics.counter("gen.prefill_tokens")
+        cold_pool.assert_drained()
+
+        pool = PagedKVPool.from_plan(plan)
+        radix = RadixPrefixCache.from_plan(pool)
+        w_outs, w_dt = drain_seq(
+            ContinuousBatchingEngine(m, max_slots=4, kv_pool=pool,
+                                     prefix_cache=radix))
+        w_prefill = metrics.counter("gen.prefill_tokens")
+        hit_tokens = metrics.counter("kv.radix_hit_tokens")
+        retained = pool.pages_retained
+        pool.assert_drained()
+        radix.clear()
+        pool.assert_drained()
+
+    token_equal = all(np.array_equal(a, b)
+                      for a, b in zip(w_outs, c_outs))
+    return {
+        "requests": n_req,
+        "distinct_heads": n_heads,
+        "head_tokens": head_tokens,
+        "watermarks": [radix.low_watermark, radix.high_watermark],
+        "radix_hits": radix.hits,
+        "hit_rate": round(radix.hits / max(1, n_req), 3),
+        "prefill_tokens_skipped": int(hit_tokens),
+        "prefill_tokens_cold": int(c_prefill),
+        "prefill_tokens_warm": int(w_prefill),
+        "retained_pages_at_drain": int(retained),
+        "evicted_pages": radix.evicted_pages,
+        "tokens_per_s_warm": round(n_req * max_new / w_dt, 1),
+        "tokens_per_s_cold": round(n_req * max_new / c_dt, 1),
+        "speedup_vs_cold": round(c_dt / max(w_dt, 1e-9), 3),
+        "token_equal_vs_cold": bool(token_equal),
+    }
+
+
+def _serving_speculative_ab():
+    """Speculative-decode generation A/B: a 2-layer stamped sibling
+    proposes k tokens per slot and the target verifies the whole batch
+    in one step; the plain side is the same paged engine with no draft.
+    The stamp here is full-depth (the target IS 2 layers) so acceptance
+    is total and accepted-tokens/step approaches 1 + k — the machinery
+    ceiling; production drafts are shallower and land in between.  Both
+    sides drain the same concurrent greedy workload; reported are
+    accepted/step, proposal/rollback totals, wall-clock on both sides,
+    and token-equality — rejection sampling must be invisible in
+    output."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedKVPool, SpeculativeDecoder,
+                                    metrics, stamp_draft)
+    from paddle_tpu.serving.metrics import reset_serving_stats
+    from paddle_tpu.static import page_budget
+
+    n_req = int(os.environ.get("BENCH_SERVING_SPEC_REQUESTS", 8))
+    kv_hbm = int(os.environ.get("BENCH_SERVING_GEN_HBM", 1 << 20))
+    max_new, k = 16, 3
+    rng = np.random.RandomState(19)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position=128, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.gpt.parameters()))
+        plan = page_budget(m, page_tokens=16, max_context=128,
+                           hbm_bytes=weight_bytes + kv_hbm,
+                           draft_layers=2)
+        prompts = [rng.randint(2, 64, (8 + (i % 4),)).astype(np.int64)
+                   for i in range(n_req)]
+
+        def drain(eng):
+            reset_serving_stats()
+            eng.start()
+            t0 = time.time()
+            try:
+                futs = [eng.submit(p, max_length=max_new)
+                        for p in prompts]
+                outs = [np.asarray(f.result(timeout=300))
+                        for f in futs]
+            finally:
+                eng.stop()
+            return outs, time.time() - t0
+
+        plain_pool = PagedKVPool.from_plan(plan)
+        p_outs, p_dt = drain(
+            ContinuousBatchingEngine(m, max_slots=4,
+                                     kv_pool=plain_pool))
+        plain_pool.assert_drained()
+
+        spec = SpeculativeDecoder(stamp_draft(m, num_layers=2), k=k)
+        pool = PagedKVPool.from_plan(plan)
+        s_outs, s_dt = drain(
+            ContinuousBatchingEngine(m, max_slots=4, kv_pool=pool,
+                                     speculative=spec))
+        steps = metrics.counter("spec.steps")
+        proposed = metrics.counter("spec.proposed")
+        accepted = metrics.counter("spec.accepted")
+        rolled = metrics.counter("spec.rollback_cols")
+        # per-ROW commit depth (the engine observes each row's committed
+        # count every verify step) — gen.tokens / spec.steps would
+        # conflate batch occupancy with speculation depth
+        per_row = metrics.percentiles("spec.accepted_per_step")
+        pool.assert_drained()
+
+    token_equal = all(np.array_equal(a, b)
+                      for a, b in zip(s_outs, p_outs))
+    return {
+        "requests": n_req,
+        "max_new_tokens": max_new,
+        "draft_layers": 2,
+        "k": k,
+        "draft_kv_bytes": plan["draft_kv_bytes"],
+        "accepted_per_step": round(per_row.get("mean", 0.0), 2),
+        "verify_steps": int(steps),
+        "proposed": int(proposed),
+        "accepted": int(accepted),
+        "rollback_cols": int(rolled),
+        "draft_tokens": int(spec.draft_tokens),
+        "wall_s_spec": round(s_dt, 2),
+        "wall_s_plain": round(p_dt, 2),
+        "speedup_vs_plain": round(p_dt / max(s_dt, 1e-9), 3),
+        "token_equal_vs_plain": bool(token_equal),
     }
 
 

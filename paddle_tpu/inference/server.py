@@ -241,7 +241,8 @@ class InferenceServer:
                  port: int = 0, batching: bool = True, max_batch: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 64,
                  request_timeout_s: float = 30.0, generator=None,
-                 gen_slots: Optional[int] = None, gen_kv_pool=None):
+                 gen_slots: Optional[int] = None, gen_kv_pool=None,
+                 gen_prefix_cache=None, gen_speculative=None):
         from . import Config, create_predictor
         from ..serving import DynamicBatcher
         self._status = "loading"
@@ -254,7 +255,9 @@ class InferenceServer:
         self._engine = None
         if generator is not None:
             self.attach_generator(generator, max_slots=gen_slots,
-                                  kv_pool=gen_kv_pool)
+                                  kv_pool=gen_kv_pool,
+                                  prefix_cache=gen_prefix_cache,
+                                  speculative=gen_speculative)
         self._inflight = 0
         self._inflight_mu = threading.Lock()
         self._inflight_zero = threading.Condition(self._inflight_mu)
@@ -267,16 +270,21 @@ class InferenceServer:
     # -- wiring -------------------------------------------------------------
     def attach_generator(self, model, max_slots: Optional[int] = None,
                          max_queue: int = 64, timeout_s: float = 120.0,
-                         kv_pool=None):
+                         kv_pool=None, prefix_cache=None,
+                         speculative=None):
         """Enable /generate: wrap ``model`` in a ContinuousBatchingEngine
         (started with the server).  ``kv_pool="auto"`` serves decode
         through the block-paged KV pool sized by ``static.page_budget``
         (admission by free-page count, COW prefix sharing); the plan's
-        batch ceiling applies unless ``max_slots`` is given."""
+        batch ceiling applies unless ``max_slots`` is given.
+        ``prefix_cache="auto"`` retains hot prompt prefixes across
+        requests (radix tree, watermark-bounded); ``speculative="auto"``
+        decodes through a stamped 2-layer draft (both need paged KV)."""
         from ..serving import ContinuousBatchingEngine
         self._engine = ContinuousBatchingEngine(
             model, max_slots=max_slots, max_queue=max_queue,
-            default_timeout_s=timeout_s, kv_pool=kv_pool)
+            default_timeout_s=timeout_s, kv_pool=kv_pool,
+            prefix_cache=prefix_cache, speculative=speculative)
         if self._status == "ok":
             self._engine.start()
         return self._engine
@@ -311,6 +319,10 @@ class InferenceServer:
                 # occupancy + sharing, same numbers /metrics exports as
                 # serving_kv_* gauges
                 out["kv_pool"] = self._engine.kv_pool.stats()
+            if self._engine.prefix_cache is not None:
+                out["prefix_cache"] = self._engine.prefix_cache.stats()
+            if self._engine.speculative is not None:
+                out["speculative"] = self._engine.speculative.stats()
         return out
 
     # -- request plumbing (handler-thread side) -----------------------------

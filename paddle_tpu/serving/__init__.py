@@ -14,6 +14,14 @@ Four pieces:
   tables with refcounted copy-on-write prefix sharing; admission is by
   free-page reservation, sizing by ``static.page_budget`` (the HBM
   walker), drift detection by ``budget_drift``.
+* ``RadixPrefixCache`` (prefix_cache.py) — retained radix tree over
+  committed prefixes: pages pinned past last-sharer retirement
+  (watermark-bounded LRU), radix hits skip prefill compute over the hit
+  tokens (reused prefill).
+* ``SpeculativeDecoder`` (speculative.py) — draft/target speculative
+  decoding: ``stamp_draft`` builds the small sibling, the engine
+  verifies k proposals per batched step and rolls rejections back via
+  page-table truncation.
 * metrics (metrics.py) — the ``serving.*`` counter/gauge/histogram
   namespace over core/monitor, dumped by ``/stats``.
 
@@ -29,6 +37,10 @@ from .generation import (  # noqa: F401
 from .kv_pool import (  # noqa: F401
     PagedKVPool, PageTable, PagePoolExhaustedError, budget_drift,
 )
+from .prefix_cache import RadixPrefixCache  # noqa: F401
+from .speculative import (  # noqa: F401
+    SpeculativeDecoder, stamp_draft, longest_accepted,
+)
 from .metrics import serving_stats, reset_serving_stats  # noqa: F401
 
 __all__ = [
@@ -36,5 +48,6 @@ __all__ = [
     "DeadlineExceededError", "BatcherStoppedError",
     "ContinuousBatchingEngine", "GenerationRequest",
     "PagedKVPool", "PageTable", "PagePoolExhaustedError", "budget_drift",
-    "serving_stats", "reset_serving_stats",
+    "RadixPrefixCache", "SpeculativeDecoder", "stamp_draft",
+    "longest_accepted", "serving_stats", "reset_serving_stats",
 ]

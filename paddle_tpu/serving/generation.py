@@ -39,6 +39,24 @@ Decode strategies reuse the ``generate()`` contract: ``greedy_search``
 and ``sampling`` (temperature / top-k, per-request seeded RNG).  Beam
 search is whole-sequence search and cannot join a running batch; the
 engine rejects it at submit.
+
+Two optional paged-mode subsystems turn page sharing into compute
+sharing:
+
+* ``prefix_cache=`` (serving/prefix_cache.py) — a retained radix tree
+  over committed prefixes.  On admission the engine looks the prompt up
+  (capped at ``len(prompt) - 1`` so the model always sees at least one
+  suffix token), adopts the hit pages into the fresh page table and
+  runs prefill attention ONLY over the uncovered suffix; at retirement
+  the committed full-page prefix is inserted (pages pinned past
+  last-sharer close, watermark-bounded).
+* ``speculative=`` (serving/speculative.py) — draft/target speculative
+  decoding.  Each decode step, the draft proposes up to ``k`` tokens
+  per greedy row; the target verifies every proposal in ONE batched
+  step (width ``k+1`` instead of 1); accepted chains commit, the first
+  rejection rolls the page-table tail back via ``pool.truncate``.
+  Greedy output stays token-equal to the target alone — acceptance
+  replays the exact plain-greedy emission loop over the verified chain.
 """
 from __future__ import annotations
 
@@ -115,11 +133,18 @@ class ContinuousBatchingEngine:
     planner/HBM-walker path) and adopts the plan's batch ceiling unless
     ``max_slots`` is given explicitly; a plan dict or a ready
     ``PagedKVPool`` is consumed as-is.
+
+    ``prefix_cache``: ``"auto"`` builds a ``RadixPrefixCache`` with the
+    plan's ``retained_watermarks``; a ready cache (bound to this pool)
+    is consumed as-is.  ``speculative``: ``"auto"`` stamps a 2-layer
+    draft from the model and wraps it in a ``SpeculativeDecoder``; a
+    ready decoder is consumed as-is.  Both require paged mode.
     """
 
     def __init__(self, model, max_slots: Optional[int] = None,
                  max_queue: int = 64, default_timeout_s: float = 120.0,
-                 kv_bucket_floor: int = 16, kv_pool=None):
+                 kv_bucket_floor: int = 16, kv_pool=None,
+                 prefix_cache=None, speculative=None):
         self._model = getattr(model, "gpt", model)
         self.config = self._model.config
         self._pool: Optional[PagedKVPool] = None
@@ -165,6 +190,37 @@ class ContinuousBatchingEngine:
         self._queue: List[GenerationRequest] = []
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
         self._kv_buckets = set()   # distinct compiled KV lengths seen
+        self._radix = None
+        if prefix_cache is not None:
+            if self._pool is None:
+                raise ValueError(
+                    "prefix_cache requires paged KV (kv_pool=)")
+            if prefix_cache == "auto":
+                from .prefix_cache import RadixPrefixCache
+                self._radix = RadixPrefixCache.from_plan(self._pool)
+            else:
+                if prefix_cache.pool is not self._pool:
+                    raise ValueError(
+                        "prefix_cache is bound to a different pool")
+                self._radix = prefix_cache
+        self._spec = None
+        if speculative is not None:
+            if self._pool is None:
+                raise ValueError(
+                    "speculative decoding requires paged KV (kv_pool=) "
+                    "— rollback is page-table truncation")
+            if speculative == "auto":
+                from .speculative import SpeculativeDecoder, stamp_draft
+                self._spec = SpeculativeDecoder(
+                    stamp_draft(self._model, num_layers=2),
+                    kv_bucket_floor=self._kv_floor)
+            else:
+                self._spec = speculative
+            self._spec.geometry_check(self.config)
+            self._spec.track_buckets(
+                self._kv_buckets,
+                on_change=lambda: metrics.gauge(
+                    "gen.kv_buckets", len(self._kv_buckets)))
         self._mu = threading.Lock()
         self._work = threading.Condition(self._mu)
         self._idle = threading.Condition(self._mu)
@@ -179,6 +235,14 @@ class ContinuousBatchingEngine:
     @property
     def paged(self) -> bool:
         return self._pool is not None
+
+    @property
+    def prefix_cache(self):
+        return self._radix
+
+    @property
+    def speculative(self):
+        return self._spec
 
     @property
     def kv_buckets(self) -> int:
@@ -232,6 +296,8 @@ class ContinuousBatchingEngine:
                 if slot.table is not None:
                     self._pool.close_sequence(slot.table)
                 self._slots[i] = None
+        if self._spec is not None:
+            self._spec.close_all()
 
     # -- admission ----------------------------------------------------------
     def _retry_hint(self, depth: int) -> float:
@@ -316,7 +382,10 @@ class ContinuousBatchingEngine:
                     req.future.set_exception(e)
             try:
                 if any(self._slots):
-                    self._step()
+                    if self._spec is not None:
+                        self._step_spec()
+                    else:
+                        self._step()
             except Exception as e:  # noqa: BLE001 — fail loud, stay alive
                 self._fail_all(e)
 
@@ -377,6 +446,8 @@ class ContinuousBatchingEngine:
                     if slot.table is not None:
                         self._pool.close_sequence(slot.table)
                     self._slots[i] = None
+            if self._spec is not None:
+                self._spec.close_all()
             metrics.gauge("gen.active_slots", 0)
             self._idle.notify_all()
 
@@ -386,34 +457,89 @@ class ContinuousBatchingEngine:
         """Run the prompt through the model once: fills this sequence's
         KV (dense slot arrays, or pool pages through the prefix-sharing
         write path) and samples its first token, then installs it in a
-        free slot (or retires it immediately on EOS/budget)."""
+        free slot (or retires it immediately on EOS/budget).
+
+        With a radix prefix cache attached, a retained-prefix hit maps
+        the hit pages into the page table (``adopt_prefix``) and runs
+        prefill attention ONLY over the uncovered suffix — the hit
+        tokens never touch the model (compute sharing, counted by
+        ``kv.radix_hit_tokens``).  The hit is capped at ``p - 1`` so at
+        least one suffix token always runs for next-token logits."""
         import paddle_tpu
         if req.future.cancelled():
             if table is not None:
                 self._pool.close_sequence(table)
             return
         p = req.prompt.size
-        # pad the prompt to a pow2 length bucket so prefill compiles at
-        # most log2(max_position) shapes (same bounded-shape discipline
-        # as decode); causality makes the pad tokens invisible to rows
-        # < p, and their K/V columns are sliced away below
-        pp = min(_next_pow2(p, self._kv_floor),
-                 int(self.config.max_position))
-        with self._mu:
-            self._kv_buckets.add(("prefill", pp))
-            metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
-        ids = np.full((1, pp), self.config.eos_id, np.int64)
-        ids[0, :p] = req.prompt
-        caches = self._model.gen_cache(1)
-        logits, caches = self._model.forward(
-            paddle_tpu.to_tensor(ids), cache=caches,
-            pos_offset=np.zeros(1, np.int64),
-            attn_mask=self._model._mask(pp))
-        last = np.asarray(logits.numpy())[0, p - 1]
+        m, hit_pids = 0, []
+        if self._radix is not None and table is not None:
+            m, hit_pids = self._radix.match(req.prompt, max_tokens=p - 1)
+        if m:
+            self._pool.adopt_prefix(table, hit_pids, m)
+            self._radix.hits += 1
+            self._radix.hit_tokens += m
+            metrics.count("kv.radix_hits")
+            metrics.count("kv.radix_hit_tokens", m)
+            sp = p - m
+            # cached columns and suffix rows both pad to pow2 buckets;
+            # suffix pad capped so pad positions stay inside wpe
+            mpad = _next_pow2(m, self._kv_floor)
+            spp = min(_next_pow2(sp, self._kv_floor),
+                      int(self.config.max_position) - m)
+            with self._mu:
+                self._kv_buckets.add(("reuse_prefill", mpad, spp))
+                metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
+            cfg = self.config
+            heads = cfg.num_heads
+            head_dim = cfg.hidden_size // heads
+            k_hit, v_hit = self._pool.gather(table)   # [L, H, m, Dh]
+            k_c = np.zeros((cfg.num_layers, 1, heads, mpad, head_dim),
+                           np.float32)
+            v_c = np.zeros_like(k_c)
+            k_c[:, 0, :, :m] = k_hit
+            v_c[:, 0, :, :m] = v_hit
+            from ..nn import MultiHeadAttention
+            caches = [MultiHeadAttention.Cache(
+                paddle_tpu.to_tensor(k_c[li]), paddle_tpu.to_tensor(v_c[li]))
+                for li in range(cfg.num_layers)]
+            ids = np.full((1, spp), cfg.eos_id, np.int64)
+            ids[0, :sp] = req.prompt[m:]
+            # suffix row u sees every adopted column plus suffix
+            # columns <= u (causal); pad cache columns stay -inf
+            mask = np.full((1, 1, spp, mpad + spp), _NEG_INF, np.float32)
+            mask[0, 0, :, :m] = 0.0
+            for u in range(spp):
+                mask[0, 0, u, mpad:mpad + u + 1] = 0.0
+            logits, caches = self._model.forward(
+                paddle_tpu.to_tensor(ids), cache=caches,
+                pos_offset=np.asarray([m], np.int64),
+                attn_mask=paddle_tpu.to_tensor(mask))
+            last = np.asarray(logits.numpy())[0, sp - 1]
+            metrics.count("gen.prefill_tokens", sp)
+        else:
+            # pad the prompt to a pow2 length bucket so prefill compiles
+            # at most log2(max_position) shapes (same bounded-shape
+            # discipline as decode); causality makes the pad tokens
+            # invisible to rows < p, and their K/V columns are sliced
+            # away below
+            pp = min(_next_pow2(p, self._kv_floor),
+                     int(self.config.max_position))
+            with self._mu:
+                self._kv_buckets.add(("prefill", pp))
+                metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
+            ids = np.full((1, pp), self.config.eos_id, np.int64)
+            ids[0, :p] = req.prompt
+            caches = self._model.gen_cache(1)
+            logits, caches = self._model.forward(
+                paddle_tpu.to_tensor(ids), cache=caches,
+                pos_offset=np.zeros(1, np.int64),
+                attn_mask=self._model._mask(pp))
+            last = np.asarray(logits.numpy())[0, p - 1]
+            metrics.count("gen.prefill_tokens", p)
         nxt = self._sample(req, last)
-        metrics.count("gen.prefill_tokens", p)
         if nxt == self.config.eos_id or req.max_new <= 1:
-            # never occupied a slot; pages were never written
+            # never occupied a slot; adopted pages (if any) just drop
+            # their refcount at close
             if table is not None:
                 self._pool.close_sequence(table)
             slot = _Slot(req, None, list(req.prompt), nxt)
@@ -423,13 +549,18 @@ class ContinuousBatchingEngine:
         if table is not None:
             # KV column t is a pure function of tokens <= t, so the
             # pool may satisfy whole prompt-head pages from another
-            # sequence's bitwise-identical prefill (COW prefix sharing)
-            k_stack = np.stack([np.asarray(c.k.numpy())[0, :, :p]
-                                for c in caches])
-            v_stack = np.stack([np.asarray(c.v.numpy())[0, :, :p]
-                                for c in caches])
+            # sequence's bitwise-identical prefill (COW prefix sharing).
+            # On a radix hit only the suffix columns install
+            # (start=m); adopted pages are already in the table.
+            off = mpad if m else 0
+            k_stack = np.stack(
+                [np.asarray(c.k.numpy())[0, :, off:off + p - m]
+                 for c in caches])
+            v_stack = np.stack(
+                [np.asarray(c.v.numpy())[0, :, off:off + p - m]
+                 for c in caches])
             self._pool.open_sequence(req.prompt, k_stack, v_stack,
-                                     table=table)
+                                     table=table, start=m)
             slot = _Slot(req, None, list(req.prompt), nxt, table=table)
         else:
             kv = [(np.asarray(c.k.numpy())[0, :, :p],
@@ -441,6 +572,10 @@ class ContinuousBatchingEngine:
             self._slots[idx] = slot
             metrics.gauge("gen.active_slots",
                           sum(s is not None for s in self._slots))
+        if self._spec is not None:
+            # seed the draft's dense KV for this slot (the decode-loop
+            # thread owns both engines, so this cannot race a step)
+            self._spec.open(idx, slot.tokens)
 
     def _step(self):
         """One decode step over every active slot (ONE device batch).
@@ -538,6 +673,143 @@ class ContinuousBatchingEngine:
             metrics.gauge("gen.active_slots",
                           sum(s is not None for s in self._slots))
 
+    def _step_spec(self):
+        """One SPECULATIVE decode step over every active slot: the
+        draft proposes up to k tokens per greedy row, the target
+        verifies pending + proposals in ONE batched forward (query
+        width W instead of 1 — nearly free in the memory-bound decode
+        regime), accepted chains commit, and the first rejection rolls
+        the page-table tail back with ``pool.truncate``.  Emission
+        replays the plain-greedy retire loop over the verified chain
+        token by token, so output is token-equal to ``_step`` whatever
+        the draft proposed.  Sampling rows ride along at width 1 (the
+        plain path inside the spec batch)."""
+        import paddle_tpu
+        from ..nn import MultiHeadAttention
+        with self._mu:
+            for i, s in enumerate(self._slots):
+                if s is not None and s.req.future.cancelled():
+                    metrics.count("gen.cancelled")
+                    if s.table is not None:
+                        self._pool.close_sequence(s.table)
+                    self._spec.close(i)
+                    self._slots[i] = None
+            active = [(i, s) for i, s in enumerate(self._slots)
+                      if s is not None]
+        if not active:
+            return
+        S = self.max_slots
+        cfg = self.config
+        heads = cfg.num_heads
+        head_dim = cfg.hidden_size // heads
+        n_layers = cfg.num_layers
+        max_ln = max(s.kv_len for _, s in active)
+        # batch query width: pending token + up to k proposals, shrunk
+        # only when a row's pad-query positions would leave the wpe
+        # table (every row's positions run ln .. ln+W-1)
+        W = max(1, min(1 + self._spec.k, int(cfg.max_position) - max_ln))
+        # per-row fed tokens: [pending x0, d1..d_{w-1}] — proposals only
+        # for greedy rows with emission budget left
+        fed = {}
+        for i, s in active:
+            w = max(1, min(W, s.req.max_new - s.n_new,
+                           self.max_context - s.kv_len))
+            row = [s.next_id]
+            if w > 1 and s.req.strategy == "greedy_search":
+                row += self._spec.propose(i, s.tokens, s.next_id,
+                                          n=w - 1)
+            fed[i] = row
+        lpad = _next_pow2(max_ln, self._kv_floor)
+        with self._mu:
+            self._kv_buckets.add(("spec", lpad, W))
+            metrics.gauge("gen.kv_buckets", len(self._kv_buckets))
+        ids = np.full((S, W), cfg.eos_id, np.int64)
+        pos = np.zeros(S, np.int64)
+        # additive mask over [cache cols 0..lpad-1, W new cols]: every
+        # query sees its row's valid history, new cols are causal among
+        # themselves (query u sees new cols <= u), pads stay -inf
+        mask = np.full((S, 1, W, lpad + W), _NEG_INF, np.float32)
+        for u in range(W):
+            mask[:, :, u, lpad:lpad + u + 1] = 0.0
+        k_b = np.zeros((n_layers, S, heads, lpad, head_dim), np.float32)
+        v_b = np.zeros_like(k_b)
+        for i, s in active:
+            ln = s.kv_len
+            row = fed[i]
+            ids[i, :len(row)] = row
+            pos[i] = ln
+            mask[i, :, :, :ln] = 0.0
+            k_all, v_all = self._pool.gather(s.table)
+            k_b[:, i, :, :ln] = k_all
+            v_b[:, i, :, :ln] = v_all
+        caches = [MultiHeadAttention.Cache(paddle_tpu.to_tensor(k_b[li]),
+                                           paddle_tpu.to_tensor(v_b[li]))
+                  for li in range(n_layers)]
+        logits, new_caches = self._model.forward(
+            paddle_tpu.to_tensor(ids), cache=caches, pos_offset=pos,
+            attn_mask=paddle_tpu.to_tensor(mask))
+        step_logits = np.asarray(logits.numpy())  # [S, W, V]
+        Ks = [np.asarray(c.k.numpy()) for c in new_caches]
+        Vs = [np.asarray(c.v.numpy()) for c in new_caches]
+        metrics.count("gen.steps")
+        metrics.count("spec.steps")
+        metrics.observe("gen.step_occupancy", len(active))
+
+        retired = []
+        for i, s in active:
+            row = fed[i]
+            w = len(row)
+            base = s.kv_len
+            # the batched verify produced a KV column for every fed
+            # token — write them all through the page table, then roll
+            # the rejected tail back below
+            for t in range(w):
+                k_col = np.stack([Ks[li][i, :, lpad + t]
+                                  for li in range(n_layers)])
+                v_col = np.stack([Vs[li][i, :, lpad + t]
+                                  for li in range(n_layers)])
+                self._pool.append_column(s.table, k_col, v_col)
+            # emission: the plain-greedy loop replayed over the chain —
+            # commit fed[t], derive the next token from the target's
+            # own logits at t, continue only while the next draft
+            # matches it exactly
+            committed, t, done = 0, 0, False
+            while True:
+                s.tokens.append(row[t])
+                nxt = self._sample(s.req, step_logits[i, t])
+                s.next_id = nxt
+                s.n_new += 1
+                committed = t + 1
+                if nxt == self.config.eos_id \
+                        or s.n_new >= s.req.max_new:
+                    s.tokens.append(nxt)
+                    done = True
+                    break
+                if t + 1 < w and row[t + 1] == nxt:
+                    t += 1
+                    continue
+                break
+            if committed < w:
+                self._pool.truncate(s.table, base + committed)
+                metrics.count("spec.rollback_cols", w - committed)
+            metrics.observe("spec.accepted_per_step", committed)
+            metrics.count("spec.proposed", w - 1)
+            metrics.count("spec.accepted", committed - 1)
+            metrics.count("gen.tokens", committed)
+            if done:
+                self._spec.close(i)
+                retired.append(i)
+            else:
+                # mirror the outcome into the draft's dense KV (its
+                # truncate-to-committed rollback)
+                self._spec.commit(i, s.tokens, s.next_id)
+        with self._mu:
+            for i in retired:
+                slot, self._slots[i] = self._slots[i], None
+                self._finish(slot)
+            metrics.gauge("gen.active_slots",
+                          sum(s is not None for s in self._slots))
+
     def _sample(self, req: GenerationRequest, logits: np.ndarray) -> int:
         if req.strategy == "sampling":
             logits = logits / max(req.temperature, 1e-6)
@@ -551,8 +823,14 @@ class ContinuousBatchingEngine:
 
     def _finish(self, slot: _Slot):
         """Resolve a finished sequence and retire its pages the moment
-        it completes — freed pages are the admission currency."""
+        it completes — freed pages are the admission currency.  With a
+        radix cache attached, the committed full-page prefix is
+        retained FIRST (pins ride on the still-live refcounts), then
+        the table closes normally."""
         if slot.table is not None:
+            if self._radix is not None and slot.table.pages:
+                self._radix.insert(np.asarray(slot.tokens, np.int64),
+                                   slot.table)
             self._pool.close_sequence(slot.table)
             slot.table = None
         metrics.count("gen.completed")

@@ -30,6 +30,18 @@ usage is bounded by the reservation (sharing and early EOS only
 reduce), so the pool trades no correctness for the oversubscription the
 fixed-slot engine could never attempt.
 
+Retention (the radix prefix cache's storage contract): pages normally
+free when their last sharer retires, but ``serving/prefix_cache.py``
+may PIN a page past that point so a hot system prompt stays resident
+across non-concurrent requests.  Pinned pages whose only reference is
+the pin are a fourth accounting class — RETAINED — beside
+free/live/reserved: they are counted as reclaimable headroom by
+``pages_available`` (admission never starves because of retention),
+and an allocation that finds the free list empty asks the registered
+reclaimer (``set_reclaimer``) to evict retained pages before it may
+raise.  ``truncate`` is the speculative decoder's rollback: drop the
+page-table tail past a committed length and refund the charge.
+
 Sizing belongs to the planner: build the pool from
 ``static.plan_program``'s sibling ``static.page_budget(model)`` (the
 HBM-walker sizing path) via ``PagedKVPool.from_plan``; the plan is
@@ -39,7 +51,7 @@ pool geometry is detectable, V504-style.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -115,7 +127,13 @@ class PagedKVPool:
         # prefix sharing: exact-token-prefix key -> page id, and back
         self._prefix: Dict[bytes, int] = {}
         self._page_key: Dict[int, bytes] = {}
-        self._mu = threading.Lock()
+        # retention: page ids the radix prefix cache holds one ref on;
+        # a pinned page with refcount 1 is RETAINED (cache-only) and
+        # reclaimable through _reclaim_cb.  RLock: the reclaimer runs
+        # inside _alloc and calls back into unpin_page.
+        self._radix_pinned: set = set()
+        self._reclaim_cb = None
+        self._mu = threading.RLock()
         self.cow_copies = 0
         self.prefix_hits = 0
         self.plan = dict(plan) if plan else None
@@ -172,9 +190,27 @@ class PagedKVPool:
         return self._shared_pages
 
     @property
+    def pages_retained(self) -> int:
+        """Pages held ONLY by the radix prefix cache (pinned, no live
+        sequence) — resident-but-reclaimable, the fourth accounting
+        class beside free/live/reserved."""
+        with self._mu:
+            return sum(1 for pid in self._radix_pinned
+                       if self._refcount[pid] == 1)
+
+    @property
     def pages_available(self) -> int:
-        """Pages a NEW reservation may claim right now."""
-        return len(self._free) - self._reserved_unallocated
+        """Pages a NEW reservation may claim right now.  Retained pages
+        count: the reclaimer evicts them on demand, so retention can
+        never starve admission."""
+        return len(self._free) + self.pages_retained \
+            - self._reserved_unallocated
+
+    def set_reclaimer(self, fn):
+        """Register the radix cache's eviction hook: ``fn(n)`` must try
+        to bring ``pages_free`` up to ``n`` by unpinning retained pages
+        (called by ``_alloc`` before it may raise)."""
+        self._reclaim_cb = fn
 
     # -- admission reservation ---------------------------------------------
     def can_reserve(self, n_pages: int) -> bool:
@@ -207,6 +243,11 @@ class PagedKVPool:
             raise PagePoolExhaustedError(
                 f"sequence exceeded its reservation "
                 f"({table.reserved} pages)")
+        if not self._free and self._reclaim_cb is not None:
+            # retention consumed the free list: reservations were
+            # granted counting retained pages as reclaimable, so the
+            # radix cache must now make good on that promise
+            self._reclaim_cb(1)
         if not self._free:
             raise PagePoolExhaustedError(
                 "free list empty under outstanding reservations — "
@@ -216,6 +257,11 @@ class PagedKVPool:
         table.charged += 1
         self._reserved_unallocated -= 1
         return pid
+
+    def _incref(self, pid: int):
+        self._refcount[pid] += 1
+        if self._refcount[pid] == 2:
+            self._shared_pages += 1
 
     def _decref(self, pid: int):
         self._refcount[pid] -= 1
@@ -227,24 +273,117 @@ class PagedKVPool:
                 del self._prefix[key]
             self._free.append(pid)
 
+    # -- retention (radix prefix cache hooks) -------------------------------
+    def pin_page(self, pid: int):
+        """Hold one reference on a page past last-sharer retirement (the
+        radix cache's retention primitive).  Idempotent per page: a page
+        carries at most one pin."""
+        with self._mu:
+            if self._refcount[pid] < 1:
+                raise ValueError(f"cannot pin free page {pid}")
+            if pid in self._radix_pinned:
+                return
+            self._radix_pinned.add(pid)
+            self._incref(pid)
+        self._publish()
+
+    def unpin_page(self, pid: int):
+        """Drop a pin (eviction path): the page frees now if no live
+        sequence still references it."""
+        with self._mu:
+            if pid not in self._radix_pinned:
+                return
+            self._radix_pinned.discard(pid)
+            self._decref(pid)
+        self._publish()
+
+    def adopt_prefix(self, table: PageTable, pids: Sequence[int],
+                     n_tokens: int):
+        """Map already-resident prefix pages into a fresh sequence's
+        page table (the radix-hit fast path: refcount bumps, no writes,
+        no charge against the reservation).  ``n_tokens`` must be the
+        page-aligned token count the pages cover."""
+        n = int(n_tokens)
+        if n % self.page_tokens or len(pids) != n // self.page_tokens:
+            raise ValueError(
+                f"adopt_prefix needs page-aligned tokens: {n} tokens "
+                f"vs {len(pids)} pages of {self.page_tokens}")
+        if table.pages or table.length:
+            raise ValueError("adopt_prefix needs a fresh page table")
+        with self._mu:
+            for pid in pids:
+                if self._refcount[pid] < 1:
+                    raise ValueError(
+                        f"page {pid} is free — stale radix hit")
+            for pid in pids:
+                self._incref(pid)
+                table.pages.append(int(pid))
+            table.length = n
+        self._publish()
+
+    def truncate(self, table: PageTable, new_length: int):
+        """Roll a sequence back to ``new_length`` committed tokens (the
+        speculative decoder's rejection path): pages wholly past the
+        boundary are dropped, and pages this table owned exclusively are
+        refunded to its reservation so later decode can re-allocate
+        them."""
+        n = int(new_length)
+        if n < 0 or n > table.length:
+            raise ValueError(
+                f"truncate to {n} outside [0, {table.length}]")
+        keep = -(-n // self.page_tokens)
+        with self._mu:
+            dropped = table.pages[keep:]
+            del table.pages[keep:]
+            for pid in dropped:
+                if self._refcount[pid] == 1 \
+                        and pid not in self._radix_pinned:
+                    # exclusively ours: the reservation gets the page
+                    # back (shared/pinned drops keep their charge —
+                    # conservative, never under-reserved)
+                    table.charged -= 1
+                    self._reserved_unallocated += 1
+                self._decref(pid)
+            table.length = n
+        self._publish()
+
     # -- sequence lifecycle -------------------------------------------------
     def open_sequence(self, prompt: np.ndarray, k_prompt: np.ndarray,
                       v_prompt: np.ndarray,
                       table: Optional[PageTable] = None,
-                      reserved: Optional[int] = None) -> PageTable:
+                      reserved: Optional[int] = None,
+                      start: int = 0) -> PageTable:
         """Install a prefilled prompt: ``k_prompt``/``v_prompt`` are the
-        per-layer stacked KV ``[L, H, p, Dh]`` and ``prompt`` the int64
-        token ids (the sharing key material).  Pages completing a prefix
-        another live sequence already stored are SHARED (refcount bump,
-        no write); the rest are written and registered."""
+        per-layer stacked KV ``[L, H, p - start, Dh]`` and ``prompt``
+        the FULL int64 token ids (the sharing key material).  Pages
+        completing a prefix another live sequence already stored are
+        SHARED (refcount bump, no write); the rest are written and
+        registered.
+
+        ``start`` is the reused-prefill entry point: a table that
+        already holds ``start`` tokens of adopted radix pages
+        (page-aligned) receives only the uncovered suffix's KV —
+        prefix keys still hash the full prompt head, so suffix pages
+        stay shareable."""
         prompt = np.ascontiguousarray(np.asarray(prompt, np.int64))
         p = int(prompt.size)
         T = self.page_tokens
+        start = int(start)
+        if start % T:
+            raise ValueError(
+                f"start={start} must be page-aligned ({T} tokens/page)")
         if table is None:
+            if start:
+                raise ValueError("suffix install needs the adopted table")
             table = self.reserve(self.pages_needed(p) if reserved is None
                                  else reserved)
+        if start and (table.length != start
+                      or len(table.pages) != start // T):
+            raise ValueError(
+                f"table holds {table.length} tokens / "
+                f"{len(table.pages)} pages, expected {start} adopted")
         with self._mu:
-            for a in range(0, p, T):
+            for a in range(start, p, T):
                 b = min(a + T, p)
                 # key = the exact token prefix this page completes; KV
                 # col t is a pure function of tokens <= t, so equal
@@ -252,15 +391,15 @@ class PagedKVPool:
                 key = prompt[:b].tobytes()
                 pid = self._prefix.get(key)
                 if pid is not None and self._refcount[pid] > 0:
-                    self._refcount[pid] += 1
-                    if self._refcount[pid] == 2:
-                        self._shared_pages += 1
+                    self._incref(pid)
                     self.prefix_hits += 1
                     metrics.count("kv.prefix_hits")
                 else:
                     pid = self._alloc(table)
-                    self.k[:, pid, :, : b - a] = k_prompt[:, :, a:b]
-                    self.v[:, pid, :, : b - a] = v_prompt[:, :, a:b]
+                    self.k[:, pid, :, : b - a] = k_prompt[:, :, a - start:
+                                                          b - start]
+                    self.v[:, pid, :, : b - a] = v_prompt[:, :, a - start:
+                                                          b - start]
                     self._prefix[key] = pid
                     self._page_key[pid] = key
                 table.pages.append(pid)
@@ -339,6 +478,7 @@ class PagedKVPool:
                 "pages_used": self.num_pages - free,
                 "pages_reserved": self._reserved_unallocated,
                 "pages_shared": shared,
+                "pages_retained": self.pages_retained,
                 "page_tokens": self.page_tokens,
                 "page_bytes": self.page_bytes,
                 "prefix_hits": self.prefix_hits,
@@ -353,16 +493,28 @@ class PagedKVPool:
         metrics.gauge("kv.pages_free", len(self._free))
         metrics.gauge("kv.pages_shared", self.pages_shared)
         metrics.gauge("kv.pages_reserved", self._reserved_unallocated)
+        metrics.gauge("kv.retained_pages", self.pages_retained)
 
     def assert_drained(self):
-        """Post-drain leak check: every page free, nothing reserved, no
-        registered prefixes (tests + engine stop-path sanity)."""
-        leaked = self.num_pages - len(self._free)
-        if leaked or self._reserved_unallocated or self._prefix:
-            raise AssertionError(
-                f"page leak: {leaked} pages still held, "
-                f"{self._reserved_unallocated} reserved, "
-                f"{len(self._prefix)} prefixes registered")
+        """Post-drain leak check: every page free OR retained-by-radix
+        (pinned with no live sequence — clean residency, not a leak),
+        nothing reserved, and no prefix registered for a page that is
+        neither free, live, nor radix-pinned (tests + engine stop-path
+        sanity)."""
+        with self._mu:
+            leaked = [pid for pid in range(self.num_pages)
+                      if self._refcount[pid] > 0
+                      and not (pid in self._radix_pinned
+                               and self._refcount[pid] == 1)]
+            stale = [k for k, pid in self._prefix.items()
+                     if pid not in self._radix_pinned]
+            if leaked or self._reserved_unallocated or stale:
+                raise AssertionError(
+                    f"page leak: {len(leaked)} pages held by retired "
+                    f"sequences (neither free, live, nor radix-pinned), "
+                    f"{self._reserved_unallocated} reserved, "
+                    f"{len(stale)} prefixes registered for unpinned "
+                    f"pages")
 
 
 def budget_drift(pool: PagedKVPool, model=None) -> List[str]:
@@ -387,7 +539,8 @@ def budget_drift(pool: PagedKVPool, model=None) -> List[str]:
         weight_bytes=(int(plan["weight_bytes"])
                       if model is None else None),
         max_slots_cap=int(plan.get("max_slots_cap", 0)) or None,
-        headroom=float(plan.get("headroom", 0.08)))
+        headroom=float(plan.get("headroom", 0.08)),
+        draft_layers=int(plan.get("draft_layers", 0)))
     drift = []
     for key, live in (("pages", pool.num_pages),
                       ("page_tokens", pool.page_tokens),
@@ -398,4 +551,14 @@ def budget_drift(pool: PagedKVPool, model=None) -> List[str]:
             drift.append(
                 f"{key}: pool has {live}, page_budget derives "
                 f"{fresh[key]} under the recorded inputs")
+    # retention watermarks ride the plan (prefix_cache reads them);
+    # hand-edited watermarks are drift exactly like hand-set pages
+    if plan.get("retained_watermarks") is not None:
+        for key in ("low", "high"):
+            want = int(fresh["retained_watermarks"][key])
+            have = int(plan["retained_watermarks"].get(key, -1))
+            if want != have:
+                drift.append(
+                    f"retained_watermarks.{key}: plan records {have}, "
+                    f"page_budget derives {want}")
     return drift

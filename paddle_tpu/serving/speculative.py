@@ -1,0 +1,280 @@
+"""Draft/target speculative decoding for the continuous-batching engine
+(Leviathan et al. 2023; greedy-mode acceptance).
+
+Plain decode emits ONE token per sequence per device step — the step is
+memory-bound (stream all weights to produce one column), so the chip
+idles on compute.  Speculative decoding buys back that slack: a small
+DRAFT model proposes ``k`` tokens autoregressively (cheap — a 2-layer
+sibling), then the TARGET verifies all ``k`` in ONE batched step
+through the paged KV cache (the fed width grows from 1 to ``k+1``
+tokens, nearly free in the memory-bound regime).  Accepted prefixes
+commit; the first rejection truncates the page-table tail
+(``PagedKVPool.truncate`` — the rollback the pool was built for) and
+the target's own argmax replaces the rejected token, so greedy output
+is TOKEN-EQUAL to the target decoding alone, whatever the draft says.
+
+This module owns the draft side and the acceptance math:
+
+* ``SpeculativeDecoder`` — wraps a draft model, keeps one dense KV
+  cache per engine slot (prefill once at admission, extend one column
+  per proposed token, truncate to the committed stream after每 verify),
+  and proposes greedily.  The engine owns the target verify step and
+  the pool rollback.
+* ``longest_accepted(proposed, target_greedy)`` — the pure acceptance
+  rule: drafts are accepted while they match the target's greedy chain.
+* ``stamp_draft(target, num_layers=2)`` — stamp a draft sibling from
+  the TARGET's own config (same vocab/hidden/heads, ``num_layers``
+  blocks) and adopt the target's embedding + first-block weights.  For
+  a trained production target the draft would be distilled offline (the
+  static-graph counterpart is ``models.build_transformer_lm`` at
+  ``num_layers=2``); weight-adoption is the honest stand-in this repo's
+  random-weight models allow — with ``num_layers == target layers`` the
+  stamp is exact and acceptance is total, which is the smoke's
+  machinery gate, while a shallower stamp exercises real rejection.
+
+Draft sizing belongs to the planner: ``static.page_budget(...,
+draft_layers=2)`` charges the draft's weights and per-slot dense KV
+against the HBM budget before pages are carved.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.compile_cache import next_pow2 as _next_pow2
+
+__all__ = ["SpeculativeDecoder", "stamp_draft", "longest_accepted"]
+
+_NEG_INF = -1e9
+
+
+def longest_accepted(proposed: Sequence[int],
+                     target_greedy: Sequence[int]) -> int:
+    """Number of draft tokens accepted under greedy verification: the
+    longest prefix of ``proposed`` matching the target's greedy chain
+    ``target_greedy`` (``target_greedy[t]`` = target argmax after the
+    fed prefix ending in token t).  Chain acceptance, not pointwise: a
+    mismatch at j invalidates every later draft (its context is
+    wrong)."""
+    a = 0
+    while a < len(proposed) and a < len(target_greedy) \
+            and int(proposed[a]) == int(target_greedy[a]):
+        a += 1
+    return a
+
+
+def stamp_draft(target, num_layers: int = 2, copy_weights: bool = True):
+    """Stamp a draft sibling from the target's config: same
+    vocab/hidden/heads/positions, ``num_layers`` blocks, dropout 0.
+    ``copy_weights`` adopts the target's embeddings, first
+    ``num_layers`` blocks and final LN (structured state-dict names
+    line up, deeper blocks are simply absent from the draft)."""
+    from ..models.gpt import GPTConfig, GPTModel, GPTForGeneration
+    gpt = getattr(target, "gpt", target)
+    c = gpt.config
+    draft_cfg = GPTConfig(
+        vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+        num_layers=min(int(num_layers), int(c.num_layers)),
+        num_heads=c.num_heads, intermediate_size=c.intermediate_size,
+        max_position=c.max_position, bos_id=c.bos_id, eos_id=c.eos_id,
+        dropout=0.0)
+    draft = GPTForGeneration(GPTModel(draft_cfg))
+    if copy_weights:
+        draft.gpt.set_state_dict(gpt.state_dict())
+    draft.eval()
+    return draft
+
+
+class _DraftState:
+    """One slot's draft-side memory: per-layer dense KV ``[H, n, Dh]``
+    plus the exact token stream those columns were computed for."""
+
+    __slots__ = ("kv", "fed")
+
+    def __init__(self, n_layers: int):
+        self.kv: List = [None] * n_layers
+        self.fed: List[int] = []
+
+
+class SpeculativeDecoder:
+    """Draft-model manager for one engine: per-slot dense draft KV,
+    greedy proposals, commit/rollback mirroring the target's page
+    table.
+
+        spec = SpeculativeDecoder(stamp_draft(target), k=4)
+        eng = ContinuousBatchingEngine(target, kv_pool="auto",
+                                       speculative=spec)
+
+    The draft's KV lives densely per slot (charged by
+    ``static.page_budget(draft_layers=)``); proposal forwards are
+    batch-1 with the same pow2 KV bucketing discipline as the engine,
+    so compiled draft shapes stay bounded too.
+    """
+
+    def __init__(self, draft_model, k: int = 4,
+                 kv_bucket_floor: int = 16):
+        self._draft = getattr(draft_model, "gpt", draft_model)
+        self.config = self._draft.config
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self._floor = int(kv_bucket_floor)
+        self._state: Dict[int, _DraftState] = {}
+        self._buckets = None   # engine's kv-bucket set (shared tracking)
+        self.draft_tokens = 0
+
+    def geometry_check(self, target_config):
+        """The draft must speak the target's token space and position
+        range (acceptance compares token ids; positions index wpe)."""
+        for name in ("vocab_size", "max_position", "eos_id"):
+            want, got = (int(getattr(target_config, name)),
+                         int(getattr(self.config, name)))
+            if want != got:
+                raise ValueError(
+                    f"draft/target mismatch: {name} target={want} "
+                    f"draft={got}")
+
+    def track_buckets(self, bucket_set, on_change=None):
+        """Share the engine's compiled-shape bucket set so draft
+        retraces count against the same no-retrace-after-warmup gate."""
+        self._buckets = bucket_set
+        self._on_bucket = on_change
+
+    def _bucket(self, tag, n):
+        if self._buckets is not None:
+            before = len(self._buckets)
+            self._buckets.add(("draft_" + tag, n))
+            if self._on_bucket is not None \
+                    and len(self._buckets) != before:
+                self._on_bucket()
+
+    # -- slot lifecycle -----------------------------------------------------
+    def open(self, slot: int, prompt_tokens):
+        """Draft prefill at admission: one forward over the prompt
+        (pow2-padded like the engine's target prefill) seeds this
+        slot's dense draft KV."""
+        import paddle_tpu
+        toks = [int(t) for t in np.asarray(prompt_tokens).reshape(-1)]
+        st = _DraftState(self.config.num_layers)
+        p = len(toks)
+        pp = min(_next_pow2(p, self._floor),
+                 int(self.config.max_position))
+        self._bucket("prefill", pp)
+        ids = np.full((1, pp), self.config.eos_id, np.int64)
+        ids[0, :p] = toks
+        caches = self._draft.gen_cache(1)
+        _, caches = self._draft.forward(
+            paddle_tpu.to_tensor(ids), cache=caches,
+            pos_offset=np.zeros(1, np.int64),
+            attn_mask=self._draft._mask(pp))
+        st.kv = [(np.asarray(c.k.numpy())[0, :, :p].copy(),
+                  np.asarray(c.v.numpy())[0, :, :p].copy())
+                 for c in caches]
+        st.fed = toks
+        self._state[slot] = st
+
+    def close(self, slot: int):
+        self._state.pop(slot, None)
+
+    def close_all(self):
+        self._state.clear()
+
+    @property
+    def open_slots(self) -> int:
+        return len(self._state)
+
+    # -- proposal -----------------------------------------------------------
+    def _feed_one(self, st: _DraftState, token: int) -> np.ndarray:
+        """Advance the draft one token: returns the next-token logits
+        and extends the dense draft KV by one column."""
+        import paddle_tpu
+        from ..nn import MultiHeadAttention
+        cfg = self.config
+        n = st.kv[0][0].shape[1] if st.kv[0] is not None else 0
+        lpad = _next_pow2(max(1, n), self._floor)
+        self._bucket("decode", lpad)
+        H = cfg.num_heads
+        Dh = cfg.hidden_size // H
+        k_b = np.zeros((cfg.num_layers, 1, H, lpad, Dh), np.float32)
+        v_b = np.zeros_like(k_b)
+        for li, kv in enumerate(st.kv):
+            if kv is not None:
+                k_b[li, 0, :, :n] = kv[0]
+                v_b[li, 0, :, :n] = kv[1]
+        mask = np.full((1, 1, 1, lpad + 1), _NEG_INF, np.float32)
+        mask[0, 0, 0, :n] = 0.0
+        mask[0, 0, 0, lpad] = 0.0
+        caches = [MultiHeadAttention.Cache(paddle_tpu.to_tensor(k_b[li]),
+                                           paddle_tpu.to_tensor(v_b[li]))
+                  for li in range(cfg.num_layers)]
+        ids = np.full((1, 1), int(token), np.int64)
+        logits, new_caches = self._draft.forward(
+            paddle_tpu.to_tensor(ids), cache=caches,
+            pos_offset=np.asarray([n], np.int64),
+            attn_mask=paddle_tpu.to_tensor(mask))
+        for li, c in enumerate(new_caches):
+            col_k = np.asarray(c.k.numpy())[0, :, lpad][:, None]
+            col_v = np.asarray(c.v.numpy())[0, :, lpad][:, None]
+            old = st.kv[li]
+            st.kv[li] = ((np.concatenate([old[0], col_k], 1),
+                          np.concatenate([old[1], col_v], 1))
+                         if old is not None else (col_k, col_v))
+        st.fed.append(int(token))
+        self.draft_tokens += 1
+        return np.asarray(logits.numpy())[0, 0]
+
+    def propose(self, slot: int, committed: Sequence[int],
+                pending: int, n: Optional[int] = None) -> List[int]:
+        """Greedily propose up to ``n`` (default ``k``) tokens after
+        ``committed + [pending]``.  Catch-up tokens the draft has not
+        seen yet (e.g. the bonus token after a full accept) are fed
+        first; the draft KV ends covering the whole stream plus all but
+        the last proposal."""
+        st = self._state[slot]
+        stream = [int(t) for t in committed] + [int(pending)]
+        if st.fed != stream[:len(st.fed)]:
+            raise AssertionError(
+                "draft cache diverged from the committed stream — "
+                "commit() missed a rollback")
+        n = self.k if n is None else min(int(n), self.k)
+        logits = None
+        for tok in stream[len(st.fed):]:
+            logits = self._feed_one(st, tok)
+        proposals: List[int] = []
+        for _ in range(n):
+            if logits is None:       # stream already fully fed
+                raise AssertionError("propose() needs >= 1 unfed token")
+            nxt = int(np.argmax(logits))
+            proposals.append(nxt)
+            if len(proposals) == n:
+                break                # the last proposal is never fed
+            logits = self._feed_one(st, nxt)
+        return proposals
+
+    # -- commit / rollback --------------------------------------------------
+    def commit(self, slot: int, committed: Sequence[int],
+               pending: Optional[int]):
+        """Mirror the target-side verification outcome: truncate the
+        draft KV to the longest prefix of what it fed that the engine
+        actually committed (``committed`` tokens + the still-pending
+        next token).  The rollback analog of ``PagedKVPool.truncate``."""
+        st = self._state.get(slot)
+        if st is None:
+            return
+        stream = [int(t) for t in committed]
+        if pending is not None:
+            stream.append(int(pending))
+        keep = 0
+        while keep < len(st.fed) and keep < len(stream) \
+                and st.fed[keep] == stream[keep]:
+            keep += 1
+        if keep < len(st.fed):
+            st.fed = st.fed[:keep]
+            st.kv = [(kv[0][:, :keep], kv[1][:, :keep])
+                     if kv is not None else None for kv in st.kv]
+
+    def stats(self) -> Dict:
+        return {"k": self.k, "open_slots": len(self._state),
+                "draft_tokens": self.draft_tokens,
+                "draft_layers": int(self.config.num_layers)}

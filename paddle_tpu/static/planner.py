@@ -1210,7 +1210,8 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
                 weight_bytes: Optional[int] = None,
                 kv_dtype: str = "float32",
                 max_slots_cap: Optional[int] = None,
-                headroom: float = 0.08) -> Dict:
+                headroom: float = 0.08,
+                draft_layers: int = 0) -> Dict:
     """Size the serving tier's paged KV pool from the HBM walker's
     budget instead of a hand-set page count (ROADMAP planner follow-up
     (d): the same sizing authority that answers training fits/OOM).
@@ -1237,6 +1238,15 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     budget on per-step workspace — pages are the asset, the gather view
     is rent — and ``max_context`` is clamped down when the pool cannot
     hold even one worst-case sequence at the requested context.
+
+    ``draft_layers`` charges a speculative-decoding draft model (a
+    ``draft_layers``-layer sibling of the same config): its parameter
+    bytes come off the usable budget and its per-slot dense KV rides
+    the step workspace, so pools sized for speculative serving never
+    overcommit HBM the draft needs.  The plan also carries
+    ``retained_watermarks`` — the free-page low/high marks
+    ``serving.RadixPrefixCache`` bounds retention with (evict LRU when
+    free falls below ``low``, release down to ``high``).
 
     Returns the plan dict ``PagedKVPool.from_plan`` consumes; every
     input is recorded in it so ``serving.kv_pool.budget_drift`` can
@@ -1270,17 +1280,34 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
 
     token_bytes = 2 * L * H * Dh * itemsize       # one K+V column, all layers
     page_bytes = token_bytes * T
-    usable = int(budget * (1.0 - float(headroom))) - weight_bytes
+    # speculative draft charge: a draft_layers-layer sibling's weights
+    # are resident beside the target, and every decode slot carries a
+    # dense draft KV cache at the same pow2 context bucket
+    draft_layers = max(0, int(draft_layers))
+    draft_weight_bytes = 0
+    draft_kv_slot = 0
+    if draft_layers:
+        draft_cfg = dict(cfg)
+        draft_cfg["num_layers"] = draft_layers
+        draft_weight_bytes = _decode_weight_bytes(draft_cfg)
+        draft_kv_slot = 2 * draft_layers * H * _next_pow2(ctx) * Dh \
+            * itemsize
+    usable = int(budget * (1.0 - float(headroom))) - weight_bytes \
+        - draft_weight_bytes
     if usable < page_bytes + token_bytes * _next_pow2(ctx):
         raise ValueError(
             f"page_budget: {budget} B HBM leaves {usable} B after "
-            f"{weight_bytes} B of weights — not enough for one decode "
+            f"{weight_bytes} B of weights"
+            + (f" + {draft_weight_bytes} B of draft weights"
+               if draft_layers else "") +
+            f" — not enough for one decode "
             f"slot at context {ctx} (raise PADDLE_TPU_HBM_BYTES or "
             f"shrink the model)")
     # per-slot step workspace: the dense [L, H, lpad, Dh] K+V gather
-    # view at the largest pow2 KV bucket, plus this row's logits
+    # view at the largest pow2 KV bucket, plus this row's logits (and
+    # the draft model's per-slot dense KV when speculating)
     ws_slot = 2 * L * H * _next_pow2(ctx) * Dh * itemsize \
-        + cfg["vocab_size"] * 4
+        + cfg["vocab_size"] * 4 + draft_kv_slot
     max_slots = max(1, min(cap, int(usable * 0.35) // ws_slot))
     pages = (usable - max_slots * ws_slot) // page_bytes
     while pages < 1 and max_slots > 1:      # tiny budgets: trade slots back
@@ -1298,11 +1325,21 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     # requests as "can never fit")
     ctx = min(ctx, max(T, (pages - 1) * T))
     max_slots = int(min(max_slots, pages))
+    # retention watermarks, in FREE pages: the radix cache evicts LRU
+    # leaves when free drops below `low` and releases until free climbs
+    # back to `high` — retention is bounded, admission never starves
+    wm_low = max(1, pages // 8)
+    wm_high = max(wm_low + 1, pages // 4)
     return {
         "pages": pages,
         "page_tokens": T,
         "max_slots": max_slots,
         "max_context": int(ctx),
+        "retained_watermarks": {"low": int(wm_low),
+                                "high": int(min(wm_high, pages))},
+        "draft_layers": draft_layers,
+        "draft_weight_bytes": int(draft_weight_bytes),
+        "draft_kv_bytes": int(max_slots * draft_kv_slot),
         "max_context_requested": int(ctx_req),
         "num_layers": L,
         "num_heads": H,

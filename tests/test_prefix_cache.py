@@ -1,0 +1,229 @@
+"""Retained radix prefix cache (serving/prefix_cache.py + the engine's
+reused-prefill path).
+
+Covers the radix tree's own contracts (page-granular insert/match,
+split-node on divergence, LRU touch ordering), watermark-bounded
+retention (eviction order, reclaim under admission pressure — the
+promise that lets ``pages_available`` count retained pages), the
+pool-side retention accounting (pin/unpin/adopt/assert_drained), and
+the engine's reused prefill: a radix hit skips the hit tokens' prefill
+compute while output stays token-equal to a cold pool."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (ContinuousBatchingEngine, PagedKVPool,
+                                RadixPrefixCache, metrics)
+
+
+def _pool(pages=16, T=4, L=2, H=2, Dh=4):
+    return PagedKVPool(num_layers=L, num_heads=H, head_dim=Dh,
+                       page_tokens=T, num_pages=pages)
+
+
+def _open(pool, rng, tokens):
+    tokens = np.asarray(tokens, np.int64)
+    L, H, Dh = pool.num_layers, pool.num_heads, pool.head_dim
+    k = rng.randn(L, H, tokens.size, Dh).astype(np.float32)
+    v = rng.randn(L, H, tokens.size, Dh).astype(np.float32)
+    return pool.open_sequence(tokens, k, v)
+
+
+def _retire(pool, radix, rng, tokens):
+    """Open, retain, close — the engine's _finish path in miniature."""
+    t = _open(pool, rng, tokens)
+    radix.insert(np.asarray(tokens, np.int64), t)
+    pool.close_sequence(t)
+    return t
+
+
+# -- radix tree contracts ---------------------------------------------------
+def test_insert_match_page_granularity():
+    pool = _pool()
+    radix = RadixPrefixCache(pool, low_watermark=1, high_watermark=2)
+    rng = np.random.RandomState(0)
+    toks = np.arange(10, 20).astype(np.int64)        # 2 full pages + 2
+    _retire(pool, radix, rng, toks)
+    # only FULL pages are retained; the partial tail page freed at close
+    assert radix.retained_pages == 2
+    assert pool.pages_retained == 2
+    n, pids = radix.match(toks)
+    assert n == 8 and len(pids) == 2
+    # page granularity: 5 matching tokens only cover one full page
+    n, _ = radix.match(toks[:5])
+    assert n == 4
+    # max_tokens cap is page-aligned too (the engine passes p - 1)
+    n, _ = radix.match(toks, max_tokens=toks.size - 1)
+    assert n == 8
+    n, _ = radix.match(toks[:8], max_tokens=7)
+    assert n == 4
+    # a diverging stream misses past the shared head
+    other = toks.copy()
+    other[6] = 99
+    n, _ = radix.match(other)
+    assert n == 4
+    pool.assert_drained()
+    radix.clear()
+    assert pool.pages_retained == 0 and pool.pages_free == pool.num_pages
+
+
+def test_split_node_on_divergence():
+    pool = _pool(pages=32)
+    radix = RadixPrefixCache(pool, low_watermark=1, high_watermark=2)
+    rng = np.random.RandomState(1)
+    a = np.arange(0, 12).astype(np.int64)            # 3 full pages
+    b = np.concatenate([a[:8], [90, 91, 92, 93]]).astype(np.int64)
+    _retire(pool, radix, rng, a)
+    assert radix.nodes == 1                          # one 3-page edge
+    _retire(pool, radix, rng, b)
+    # divergence at page 2 splits the edge: common 2-page vertex with
+    # two single-page children
+    assert radix.nodes == 3
+    assert radix.retained_pages == 4                 # 2 common + 2 tails
+    na, pa = radix.match(a)
+    nb, pb = radix.match(b)
+    assert na == 12 and nb == 12
+    assert pa[:2] == pb[:2] and pa[2] != pb[2]
+    # inserting an already-covered stream adds nothing
+    before = radix.retained_pages
+    _retire(pool, radix, rng, a)
+    assert radix.retained_pages == before
+    radix.clear()
+    pool.assert_drained()
+
+
+def test_watermark_eviction_lru_order():
+    pool = _pool(pages=8, T=4)
+    # low=3: retention may consume the pool down to 3 free pages; once
+    # it dips below, LRU leaves evict until 4 are free again
+    radix = RadixPrefixCache(pool, low_watermark=3, high_watermark=4)
+    rng = np.random.RandomState(2)
+    a = np.arange(0, 8).astype(np.int64)
+    b = np.arange(100, 108).astype(np.int64)
+    _retire(pool, radix, rng, a)                     # 2 retained, 6 free
+    _retire(pool, radix, rng, b)                     # 4 retained, 4 free
+    # touch a AFTER b so b is the LRU leaf
+    radix.match(a)
+    c = np.arange(200, 208).astype(np.int64)
+    _retire(pool, radix, rng, c)                     # free dips to 2 < low
+    # maintain evicted down to high=4 free: exactly one leaf went, and
+    # it was b (least recently used), never the freshly touched a
+    assert pool.pages_free >= 4
+    assert radix.match(b)[0] == 0, "LRU leaf survived eviction"
+    assert radix.match(a)[0] == 8, "recently-touched leaf was evicted"
+    assert radix.evicted_pages == 2
+    radix.clear()
+    pool.assert_drained()
+
+
+def test_reclaim_under_admission_pressure():
+    pool = _pool(pages=4, T=4)
+    radix = RadixPrefixCache(pool, low_watermark=1, high_watermark=2)
+    rng = np.random.RandomState(3)
+    _retire(pool, radix, rng, np.arange(0, 8))       # 2 retained, 2 free
+    # available counts retained pages as reclaimable headroom: a
+    # 3-page reservation is grantable even though only 2 are free
+    assert pool.pages_free == 2 and pool.pages_available == 4
+    assert pool.can_reserve(3)
+    t = pool.reserve(3)
+    # the third allocation finds the free list empty and must pull a
+    # page back from retention through the registered reclaimer
+    toks = np.arange(100, 112).astype(np.int64)
+    k = rng.randn(2, 2, 12, 4).astype(np.float32)
+    v = rng.randn(2, 2, 12, 4).astype(np.float32)
+    table = pool.open_sequence(toks, k, v, table=t)
+    assert table.length == 12
+    assert radix.evicted_pages == 2, "allocator never hit the reclaimer"
+    assert radix.match(np.arange(0, 8))[0] == 0
+    pool.close_sequence(table)
+    pool.assert_drained()
+
+
+def test_retention_accounting_and_drain():
+    pool = _pool(pages=8, T=4)
+    radix = RadixPrefixCache(pool, low_watermark=1, high_watermark=2)
+    rng = np.random.RandomState(4)
+    toks = np.arange(0, 8).astype(np.int64)
+    t = _open(pool, rng, toks)
+    radix.insert(toks, t)
+    # while the sequence lives, pinned pages are SHARED, not retained
+    assert pool.pages_retained == 0 and pool.pages_shared == 2
+    pool.close_sequence(t)
+    assert pool.pages_retained == 2 and pool.pages_shared == 0
+    # retained-but-unreferenced pages are clean, not leaks
+    pool.assert_drained()
+    # adopt maps them into a fresh table without charging it
+    n, pids = radix.match(toks)
+    t2 = pool.reserve(2)
+    pool.adopt_prefix(t2, pids, n)
+    assert t2.charged == 0 and t2.length == 8
+    assert pool.pages_retained == 0          # live again while adopted
+    pool.close_sequence(t2)
+    assert pool.pages_retained == 2
+    radix.clear()
+    pool.assert_drained()
+    # pinning a free page is a stale-hit bug, loudly rejected
+    with pytest.raises(ValueError, match="free"):
+        pool.pin_page(pids[0])
+
+
+def test_watermark_validation():
+    pool = _pool(pages=8)
+    with pytest.raises(ValueError):
+        RadixPrefixCache(pool, low_watermark=4, high_watermark=4)
+    with pytest.raises(ValueError):
+        RadixPrefixCache(pool, low_watermark=0, high_watermark=2)
+    with pytest.raises(ValueError):
+        RadixPrefixCache(pool, low_watermark=2, high_watermark=9)
+
+
+# -- engine integration: reused prefill -------------------------------------
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                        num_heads=2, max_position=64, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+        yield m
+
+
+def test_reused_prefill_token_equal_to_cold(tiny_lm):
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(2, 48, (9,)).astype(np.int64)
+
+    cold_pool = PagedKVPool(2, 2, 8, page_tokens=4, num_pages=64)
+    eng = ContinuousBatchingEngine(tiny_lm, max_slots=2,
+                                   kv_pool=cold_pool).start()
+    try:
+        ref = np.asarray(eng.submit(prompt, max_length=5)
+                         .result(timeout=60))
+    finally:
+        eng.stop()
+    cold_pool.assert_drained()
+
+    pool = PagedKVPool(2, 2, 8, page_tokens=4, num_pages=64)
+    radix = RadixPrefixCache(pool, low_watermark=2, high_watermark=4)
+    eng = ContinuousBatchingEngine(tiny_lm, max_slots=2, kv_pool=pool,
+                                   prefix_cache=radix).start()
+    try:
+        out1 = np.asarray(eng.submit(prompt, max_length=5)
+                          .result(timeout=60))
+        pre = metrics.counter("gen.prefill_tokens")
+        pre_hits = metrics.counter("kv.radix_hit_tokens")
+        out2 = np.asarray(eng.submit(prompt, max_length=5)
+                          .result(timeout=60))
+        ran = metrics.counter("gen.prefill_tokens") - pre
+        hit = metrics.counter("kv.radix_hit_tokens") - pre_hits
+    finally:
+        eng.stop()
+    np.testing.assert_array_equal(out1, ref)
+    np.testing.assert_array_equal(out2, ref)
+    assert hit == 8, f"expected a 2-page hit, got {hit} tokens"
+    assert ran == prompt.size - hit, \
+        f"hit prefill ran {ran} tokens, expected the uncovered suffix"
+    assert radix.hits == 1
+    pool.assert_drained()
+    radix.clear()
+    pool.assert_drained()

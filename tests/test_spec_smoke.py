@@ -1,0 +1,48 @@
+"""Tier-1 compute-sharing gate (NOT marked slow — a regression in
+radix retention, reused prefill, speculative token-equality, or the
+bounded-compiled-shapes contract must fail the suite, not wait for a
+perf round).
+
+Drives tools/spec_smoke.py in-process: the second identical prompt
+hits the retained radix tree and prefills only the uncovered suffix,
+speculative decode through a stamped draft commits more than one token
+per target verify step while staying token-equal to the plain engine,
+compiled KV buckets stop growing after warmup, and the pool drains
+leak-free with retained pages still resident.  Mirrors the page_smoke
+gate pattern; the CLI round-trip is `slow` (a fresh interpreter buys
+no extra coverage in-process).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_spec_smoke_gate():
+    import spec_smoke
+    result = spec_smoke.run_smoke()
+    assert result["traces_after_warmup"] == 0, result
+    assert result["radix_hit_tokens"] > 0, result
+    assert result["prefill_tokens_on_hit"] < result["prompt_tokens"], \
+        result
+    assert result["accepted_per_step"] > 1.0, result
+    assert result["retained_pages_at_drain"] > 0, result
+    assert result["value"] < 60, result  # in-process gate stays fast
+
+
+@pytest.mark.slow
+def test_spec_smoke_cli_prints_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "spec_smoke.py")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["traces_after_warmup"] == 0
+    assert result["accepted_per_step"] > 1.0
+    assert result["radix_hit_tokens"] > 0

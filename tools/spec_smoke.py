@@ -1,0 +1,172 @@
+"""Fast CPU gate for the retained prefix cache + speculative decoding:
+radix hits skip prefill compute, spec decode stays token-equal with
+accepted-tokens/step > 1, zero post-warmup retraces, leak-free drain
+with retention active.
+
+The cheap canary for the compute-sharing serving tier
+(tests/test_spec_smoke.py runs it as a tier-1 test, mirroring
+page_smoke):
+
+  * a planner-sized pool (``page_budget(draft_layers=2)`` — the draft's
+    weights and dense KV are charged before pages are carved) with a
+    ``RadixPrefixCache`` at the plan's ``retained_watermarks``;
+  * the SECOND submission of an identical prompt hits the radix tree:
+    its prefill runs attention over strictly fewer tokens than the
+    prompt (``kv.radix_hit_tokens`` counts exactly the skipped ones)
+    and the output stays token-equal to ``generate()``;
+  * speculative decode through a ``stamp_draft`` sibling (full-depth
+    copy of the 2-layer target, so proposals agree and acceptance is
+    total) emits MORE than one token per target step, token-equal;
+  * the compiled KV buckets stop growing after warmup (radix reuse and
+    k-wide verify steps must not leak new shapes per request), and the
+    drained pool reports zero leaks while still holding retained pages.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/spec_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# small enough that the pool slab + draft KV are a few hundred KB of
+# host numpy, big enough for retention + the churn run
+SMOKE_HBM_BYTES = 4 * 1024 * 1024
+
+
+def run_smoke():
+    """Run the gate; returns the result dict (AssertionError on any
+    compute-sharing contract regression)."""
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import (ContinuousBatchingEngine, PagedKVPool,
+                                    RadixPrefixCache, SpeculativeDecoder,
+                                    metrics, stamp_draft)
+    from paddle_tpu.static import page_budget
+
+    t0 = time.time()
+    rng = np.random.RandomState(13)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                        num_heads=2, max_position=64, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+
+        plan = page_budget(m, page_tokens=4, hbm_bytes=SMOKE_HBM_BYTES,
+                           draft_layers=2)
+        assert plan["draft_kv_bytes"] > 0 and plan["draft_weight_bytes"] > 0
+        wm = plan["retained_watermarks"]
+        assert 0 < wm["low"] < wm["high"] <= plan["pages"], wm
+        pool = PagedKVPool.from_plan(plan)
+        radix = RadixPrefixCache.from_plan(pool)
+        assert (radix.low_watermark, radix.high_watermark) == \
+            (wm["low"], wm["high"])
+        # full-depth stamp of the 2-layer target: draft == target, so
+        # greedy proposals always verify (the machinery gate — a
+        # production draft is shallower and merely accepts less)
+        spec = SpeculativeDecoder(stamp_draft(m, num_layers=2), k=3)
+
+        pa = rng.randint(2, 48, (9,)).astype(np.int64)
+        pb = rng.randint(2, 48, (9,)).astype(np.int64)
+        # target-only references through the PLAIN paged engine (itself
+        # token-equal to generate(), gated by page_smoke) — it compiles
+        # the same prefill/decode buckets the spec engine reuses, so
+        # the whole gate pays the XLA toll once
+        ref_pool = PagedKVPool.from_plan(plan)
+        ref_eng = ContinuousBatchingEngine(m, max_slots=2,
+                                           kv_pool=ref_pool).start()
+        try:
+            refs = {key: np.asarray(
+                        ref_eng.submit(p, max_length=6).result(timeout=60))
+                    for key, p in (("a", pa), ("b", pb))}
+        finally:
+            ref_eng.stop()
+        ref_pool.assert_drained()
+
+        eng = ContinuousBatchingEngine(m, max_slots=2, kv_pool=pool,
+                                       prefix_cache=radix,
+                                       speculative=spec).start()
+        try:
+            # -- warmup: cold prefill + radix-hit reuse shapes ---------
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["a"])
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["a"])
+            warm_buckets = eng.kv_buckets
+
+            # -- radix hit skips prefill compute -----------------------
+            pre_prefill = metrics.counter("gen.prefill_tokens")
+            pre_hit = metrics.counter("kv.radix_hit_tokens")
+            pre_steps = metrics.counter("spec.steps")
+            pre_tokens = metrics.counter("gen.tokens")
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["a"])
+            prefill_ran = metrics.counter("gen.prefill_tokens") - pre_prefill
+            hit_tokens = metrics.counter("kv.radix_hit_tokens") - pre_hit
+            assert hit_tokens > 0, "second identical prompt missed the " \
+                "radix tree"
+            assert prefill_ran == pa.size - hit_tokens, \
+                f"prefill ran {prefill_ran} tokens, expected only the " \
+                f"{pa.size - hit_tokens}-token uncovered suffix"
+            assert prefill_ran < pa.size, "radix hit skipped no compute"
+
+            # -- speculative: > 1 committed token per target step ------
+            spec_steps = metrics.counter("spec.steps") - pre_steps
+            committed = metrics.counter("gen.tokens") - pre_tokens
+            accepted_per_step = committed / max(1, spec_steps)
+            assert accepted_per_step > 1.0, \
+                f"speculation bought nothing: {committed} tokens over " \
+                f"{spec_steps} verify steps"
+
+            # -- cold second prompt: no new compiled shapes ------------
+            out = eng.submit(pb, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["b"])
+            buckets_after = eng.kv_buckets
+        finally:
+            eng.stop()
+        retraces = buckets_after - warm_buckets
+        assert retraces == 0, \
+            f"{retraces} new compiled KV buckets after warmup — radix " \
+            f"reuse or spec verify leaked shapes"
+        retained = pool.pages_retained
+        assert retained > 0, "retirement inserted nothing into the tree"
+        pool.assert_drained()    # retained pages are clean, not leaks
+        radix.clear()
+        assert pool.pages_retained == 0
+        pool.assert_drained()
+
+    wall = time.time() - t0
+    result = {
+        "metric": "spec_smoke_wall_s",
+        "value": round(wall, 2),
+        "unit": "s",
+        "pages": plan["pages"],
+        "watermarks": [wm["low"], wm["high"]],
+        "draft_kv_bytes": plan["draft_kv_bytes"],
+        "radix_hit_tokens": int(hit_tokens),
+        "prefill_tokens_on_hit": int(prefill_ran),
+        "prompt_tokens": int(pa.size),
+        "accepted_per_step": round(accepted_per_step, 2),
+        "retained_pages_at_drain": int(retained),
+        "traces_after_warmup": retraces,
+    }
+    return result
+
+
+def main():
+    result = run_smoke()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
