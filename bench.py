@@ -389,6 +389,7 @@ def serving_main():
         "paged_kv": _serving_paged_ab(),
         "radix_prefix": _serving_radix_ab(),
         "speculative": _serving_speculative_ab(),
+        "tp_decode": _serving_tp_decode_ab(),
     }
     print(json.dumps(result))
 
@@ -699,6 +700,93 @@ def _serving_speculative_ab():
         "wall_s_plain": round(p_dt, 2),
         "speedup_vs_plain": round(p_dt / max(s_dt, 1e-9), 3),
         "token_equal_vs_plain": bool(token_equal),
+    }
+
+
+def _serving_tp_decode_ab():
+    """tp-sharded decode A/B at EQUAL per-chip HBM: the same model, the
+    same pinned per-chip budget, page pools carved by
+    `static.page_budget` at tp=1 and tp=2.  At tp=2 each chip holds
+    half the Megatron-splittable weights and half of every KV byte
+    (heads shard), so the per-chip budget carves more pages — reported
+    as page capacity and peak concurrent sequences — while the decode
+    itself runs `serving.TPShardedDecoder`'s CompiledProgram across the
+    dp×mp mesh.  Both sides drain the same greedy workload;
+    token-equality vs the tp=1 engine is ASSERTED (sharded math must be
+    invisible in output), tokens/s measures what the mp collectives
+    cost on this host."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import ContinuousBatchingEngine, PagedKVPool
+    from paddle_tpu.serving.metrics import reset_serving_stats
+    from paddle_tpu.static import page_budget
+
+    n_req = int(os.environ.get("BENCH_SERVING_TP_REQUESTS", 8))
+    tp = int(os.environ.get("BENCH_SERVING_TP_DEGREE", 2))
+    kv_hbm = int(os.environ.get("BENCH_SERVING_TP_HBM", 1 << 18))
+    max_new = 8
+    rng = np.random.RandomState(23)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_position=128, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.gpt.parameters()))
+        # the PINNED per-chip budget both sides must live inside —
+        # weights + a thin KV grant, so the tp=1 pool is starved and
+        # the tp=2 per-chip savings convert into pages
+        hbm = weight_bytes + kv_hbm
+        plan1 = page_budget(m, page_tokens=16, max_context=128,
+                            hbm_bytes=hbm)
+        plan2 = page_budget(m, page_tokens=16, max_context=128,
+                            hbm_bytes=hbm, tp_degree=tp)
+        prompts = [rng.randint(2, 64, (6 + (i % 5),)).astype(np.int64)
+                   for i in range(n_req)]
+
+        def drain(eng):
+            reset_serving_stats()
+            eng.start()
+            t0 = time.time()
+            try:
+                futs = [eng.submit(p, max_length=max_new)
+                        for p in prompts]
+                outs = [np.asarray(f.result(timeout=600))
+                        for f in futs]
+            finally:
+                eng.stop()
+            return outs, time.time() - t0
+
+        pool1 = PagedKVPool.from_plan(plan1)
+        outs1, dt1 = drain(ContinuousBatchingEngine(
+            m, max_slots=4, kv_pool=pool1))
+        pool1.assert_drained()
+
+        pool2 = PagedKVPool.from_plan(plan2)
+        eng2 = ContinuousBatchingEngine(m, max_slots=4, kv_pool=pool2)
+        outs2, dt2 = drain(eng2)
+        pool2.assert_drained()
+
+    # the tp A/B's contract: sharding must be invisible in output
+    assert all(np.array_equal(a, b) for a, b in zip(outs1, outs2)), \
+        "tp-sharded decode diverged from single-chip greedy"
+    tok = n_req * max_new
+    return {
+        "requests": n_req,
+        "max_new_tokens": max_new,
+        "tp_degree": eng2.tp_degree,
+        "hbm_per_chip_bytes": hbm,
+        "pages_tp1": plan1["pages"],
+        "pages_tp2": plan2["pages"],
+        "page_capacity_ratio": round(plan2["pages"] /
+                                     max(1, plan1["pages"]), 2),
+        "max_slots_tp1": plan1["max_slots"],
+        "max_slots_tp2": plan2["max_slots"],
+        "tokens_per_s_tp1": round(tok / dt1, 1),
+        "tokens_per_s_tp2": round(tok / dt2, 1),
+        "wall_s_tp1": round(dt1, 2),
+        "wall_s_tp2": round(dt2, 2),
+        "token_equal": True,
     }
 
 

@@ -984,7 +984,8 @@ class CompiledProgram:
         # dist_attr tp param sharding + accumulator inheritance live in
         # the engine too, so the per-dispatch and scanned compile paths
         # place identical 2-D layouts
-        from .partition_spec import state_partition_specs
+        from .partition_spec import (state_partition_specs,
+                                     feed_partition_specs)
         state_specs = state_partition_specs(program, mesh, state_names)
         if has_sp:
             # batch over dp, sequence (dim 1) over sp; rank-1 feeds
@@ -1004,7 +1005,11 @@ class CompiledProgram:
                 else:
                     feed_specs[n] = P("dp")
         else:
-            feed_specs = {n: P("dp") for n in feed_names}
+            # the partition-spec engine: P("dp") batch split for
+            # training feeds (the historical default), dist_attr
+            # head-dim tp shards and replicated_feed P() for the
+            # tp-decode serving programs
+            feed_specs = feed_partition_specs(program, mesh, feed_names)
         fetch_specs = tuple(P() for _ in fetch_names)
 
         sharded = shard_map_unchecked(

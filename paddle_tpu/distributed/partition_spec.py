@@ -58,7 +58,7 @@ __all__ = [
     "REPLICATED", "DP_SHARD", "MP_COL", "MP_ROW", "PartitionRule",
     "PartitionAssignment", "match_partition_rules", "zero_stage_rules",
     "tensor_parallel_rules", "build_sharding_specs",
-    "state_partition_specs",
+    "state_partition_specs", "feed_partition_specs",
 ]
 
 # spec spelling: tuple of mesh-axis names per dim (None = replicated dim,
@@ -363,4 +363,42 @@ def state_partition_specs(program, mesh, state_names: Iterable[str]):
                 if n.startswith(pname + "_") and shape == pshape:
                     specs[n] = pspec
                     break
+    return specs
+
+
+def feed_partition_specs(program, mesh, feed_names: Iterable[str]):
+    """The `shard_map` in-specs for a program's FEEDS — the serving
+    sibling of `state_partition_specs`.
+
+    Training feeds are batches: dim 0 splits over the data-parallel
+    axis, always, and that is the historical hard-coded
+    ``P("dp")``-for-everything behaviour this function preserves as the
+    default.  A tensor-parallel decode program breaks the monoculture:
+    its per-layer KV-cache feeds shard on the HEAD dim over ``tp``
+    (`tensor_parallel.shard_param`'s ``dist_attr`` spelling, stamped on
+    the feed var by the decode builder), and its token/position/mask
+    feeds are REPLICATED (every chip decodes the same rows; dp is a
+    replication axis on the serving mesh) — stamped
+    ``replicated_feed`` by the builder.  Vars the program does not
+    declare fall back to ``P("dp")``, the training contract."""
+    from jax.sharding import PartitionSpec as P
+    block = program.global_block()
+    has_tp = "tp" in getattr(mesh, "axis_names", ())
+    specs = {}
+    for n in feed_names:
+        try:
+            v = block.var(n)
+        except KeyError:
+            specs[n] = P("dp")
+            continue
+        da = v.attrs.get("dist_attr") if has_tp else None
+        if da:
+            axis, dim = da
+            spec = [None] * len(v.shape or ())
+            spec[int(dim)] = axis
+            specs[n] = P(*spec)
+        elif v.attrs.get("replicated_feed"):
+            specs[n] = P()
+        else:
+            specs[n] = P("dp")
     return specs

@@ -242,7 +242,8 @@ class InferenceServer:
                  max_wait_ms: float = 2.0, max_queue: int = 64,
                  request_timeout_s: float = 30.0, generator=None,
                  gen_slots: Optional[int] = None, gen_kv_pool=None,
-                 gen_prefix_cache=None, gen_speculative=None):
+                 gen_prefix_cache=None, gen_speculative=None,
+                 gen_tp_degree: Optional[int] = None):
         from . import Config, create_predictor
         from ..serving import DynamicBatcher
         self._status = "loading"
@@ -257,7 +258,8 @@ class InferenceServer:
             self.attach_generator(generator, max_slots=gen_slots,
                                   kv_pool=gen_kv_pool,
                                   prefix_cache=gen_prefix_cache,
-                                  speculative=gen_speculative)
+                                  speculative=gen_speculative,
+                                  tp_degree=gen_tp_degree)
         self._inflight = 0
         self._inflight_mu = threading.Lock()
         self._inflight_zero = threading.Condition(self._inflight_mu)
@@ -271,7 +273,7 @@ class InferenceServer:
     def attach_generator(self, model, max_slots: Optional[int] = None,
                          max_queue: int = 64, timeout_s: float = 120.0,
                          kv_pool=None, prefix_cache=None,
-                         speculative=None):
+                         speculative=None, tp_degree: Optional[int] = None):
         """Enable /generate: wrap ``model`` in a ContinuousBatchingEngine
         (started with the server).  ``kv_pool="auto"`` serves decode
         through the block-paged KV pool sized by ``static.page_budget``
@@ -279,12 +281,16 @@ class InferenceServer:
         batch ceiling applies unless ``max_slots`` is given.
         ``prefix_cache="auto"`` retains hot prompt prefixes across
         requests (radix tree, watermark-bounded); ``speculative="auto"``
-        decodes through a stamped 2-layer draft (both need paged KV)."""
+        decodes through a stamped 2-layer draft (both need paged KV).
+        ``tp_degree`` > 1 serves decode tp-sharded from the dp×tp mesh
+        (``serving.TPShardedDecoder``); a planner plan passed as
+        ``kv_pool`` carries its own degree, an explicit arg wins."""
         from ..serving import ContinuousBatchingEngine
         self._engine = ContinuousBatchingEngine(
             model, max_slots=max_slots, max_queue=max_queue,
             default_timeout_s=timeout_s, kv_pool=kv_pool,
-            prefix_cache=prefix_cache, speculative=speculative)
+            prefix_cache=prefix_cache, speculative=speculative,
+            tp_degree=tp_degree)
         if self._status == "ok":
             self._engine.start()
         return self._engine

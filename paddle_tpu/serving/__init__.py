@@ -38,6 +38,7 @@ from .kv_pool import (  # noqa: F401
     PagedKVPool, PageTable, PagePoolExhaustedError, budget_drift,
 )
 from .prefix_cache import RadixPrefixCache  # noqa: F401
+from .tp_decode import TPShardedDecoder, build_decode_program  # noqa: F401
 from .speculative import (  # noqa: F401
     SpeculativeDecoder, stamp_draft, longest_accepted,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "DeadlineExceededError", "BatcherStoppedError",
     "ContinuousBatchingEngine", "GenerationRequest",
     "PagedKVPool", "PageTable", "PagePoolExhaustedError", "budget_drift",
-    "RadixPrefixCache", "SpeculativeDecoder", "stamp_draft",
+    "RadixPrefixCache", "TPShardedDecoder", "build_decode_program",
+    "SpeculativeDecoder", "stamp_draft",
     "longest_accepted", "serving_stats", "reset_serving_stats",
 ]

@@ -144,14 +144,32 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_slots: Optional[int] = None,
                  max_queue: int = 64, default_timeout_s: float = 120.0,
                  kv_bucket_floor: int = 16, kv_pool=None,
-                 prefix_cache=None, speculative=None):
+                 prefix_cache=None, speculative=None,
+                 tp_degree: Optional[int] = None):
+        # tp-sharded decode: resolve the degree (explicit arg wins, else
+        # a planner plan / ready pool carries it), then wrap the model's
+        # forward in the mesh-dispatching backend.  TPShardedDecoder has
+        # no .gpt attr, so the unwrap below keeps the sharded path.
+        if tp_degree is None:
+            if isinstance(kv_pool, PagedKVPool):
+                tp_degree = kv_pool.tp_degree
+            elif isinstance(kv_pool, dict):
+                tp_degree = int(kv_pool.get("tp_degree", 1))
+            else:
+                tp_degree = 1
+        self.tp_degree = max(1, int(tp_degree))
+        if self.tp_degree > 1:
+            from .tp_decode import TPShardedDecoder
+            if not isinstance(model, TPShardedDecoder):
+                model = TPShardedDecoder(model, self.tp_degree)
         self._model = getattr(model, "gpt", model)
         self.config = self._model.config
         self._pool: Optional[PagedKVPool] = None
         if kv_pool is not None:
             if kv_pool == "auto":
                 from ..static.planner import page_budget
-                self._pool = PagedKVPool.from_plan(page_budget(self._model))
+                self._pool = PagedKVPool.from_plan(
+                    page_budget(self._model, tp_degree=self.tp_degree))
             elif isinstance(kv_pool, PagedKVPool):
                 self._pool = kv_pool
             elif isinstance(kv_pool, dict):
@@ -172,6 +190,12 @@ class ContinuousBatchingEngine:
                     raise ValueError(
                         f"kv_pool geometry mismatch: model {name}={want} "
                         f"but pool was built for {got}")
+            if self._pool.tp_degree != self.tp_degree:
+                raise ValueError(
+                    f"tp_degree mismatch: engine runs tp={self.tp_degree} "
+                    f"but the pool plan was sized for "
+                    f"tp={self._pool.tp_degree} — per-chip page budgets "
+                    "would not match the sharded slabs")
         plan = self._pool.plan if self._pool is not None else None
         if max_slots is None:
             max_slots = int(plan["max_slots"]) if plan else 4

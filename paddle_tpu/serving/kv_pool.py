@@ -157,6 +157,22 @@ class PagedKVPool:
         return 2 * self.num_layers * self.num_heads * self.page_tokens \
             * self.head_dim * self.dtype.itemsize
 
+    @property
+    def tp_degree(self) -> int:
+        """The tensor-parallel degree the recorded plan sized this pool
+        for (1 = single-chip).  The host slab always holds the full
+        head dim — page ids, refcounts, and tables are GLOBAL token
+        geometry — but on a tp mesh each chip's resident shard of a page
+        is ``[L, H/tp, T, Dh]``, so the per-chip byte charge divides."""
+        return int((self.plan or {}).get("tp_degree", 1))
+
+    @property
+    def page_bytes_per_chip(self) -> int:
+        """Bytes of one page actually resident per chip: `page_bytes`
+        over the head-sharding tp degree (the number `page_budget`
+        carved pages against)."""
+        return self.page_bytes // max(1, self.tp_degree)
+
     def pages_needed(self, n_tokens: int) -> int:
         """Worst-case pages a sequence of ``n_tokens`` total (prompt +
         generated) occupies — the admission reservation unit."""
@@ -481,6 +497,8 @@ class PagedKVPool:
                 "pages_retained": self.pages_retained,
                 "page_tokens": self.page_tokens,
                 "page_bytes": self.page_bytes,
+                "tp_degree": self.tp_degree,
+                "page_bytes_per_chip": self.page_bytes_per_chip,
                 "prefix_hits": self.prefix_hits,
                 "cow_copies": self.cow_copies,
                 "occupancy": round(1.0 - free / self.num_pages, 4),
@@ -540,7 +558,8 @@ def budget_drift(pool: PagedKVPool, model=None) -> List[str]:
                       if model is None else None),
         max_slots_cap=int(plan.get("max_slots_cap", 0)) or None,
         headroom=float(plan.get("headroom", 0.08)),
-        draft_layers=int(plan.get("draft_layers", 0)))
+        draft_layers=int(plan.get("draft_layers", 0)),
+        tp_degree=int(plan.get("tp_degree", 1)))
     drift = []
     for key, live in (("pages", pool.num_pages),
                       ("page_tokens", pool.page_tokens),

@@ -37,6 +37,14 @@ reservation granted against retained headroom can always be honored.
 Watermarks come from the planner: ``static.page_budget`` emits
 ``retained_watermarks={"low", "high"}`` in the plan and
 ``RadixPrefixCache.from_plan(pool)`` reads them.
+
+tp-sharded decode (ISSUE 19) changes NOTHING here by construction: the
+radix tree keys on token bytes and stores page ids, and page tables are
+replicated host-side even when each chip holds only an ``H/tp`` head
+shard of every page (``kv_pool.tp_degree``).  Retention, adoption, and
+eviction are all page-id plumbing, so the same tree serves the 4×2 mesh
+engine and the single-chip engine — the equality matrix in
+tests/test_serving.py pins a radix-hit resume token-equal across both.
 """
 from __future__ import annotations
 

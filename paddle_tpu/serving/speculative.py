@@ -17,9 +17,9 @@ This module owns the draft side and the acceptance math:
 
 * ``SpeculativeDecoder`` — wraps a draft model, keeps one dense KV
   cache per engine slot (prefill once at admission, extend one column
-  per proposed token, truncate to the committed stream after每 verify),
-  and proposes greedily.  The engine owns the target verify step and
-  the pool rollback.
+  per proposed token, truncate to the committed stream after every
+  verify), and proposes greedily.  The engine owns the target verify
+  step and the pool rollback.
 * ``longest_accepted(proposed, target_greedy)`` — the pure acceptance
   rule: drafts are accepted while they match the target's greedy chain.
 * ``stamp_draft(target, num_layers=2)`` — stamp a draft sibling from
@@ -34,7 +34,17 @@ This module owns the draft side and the acceptance math:
 
 Draft sizing belongs to the planner: ``static.page_budget(...,
 draft_layers=2)`` charges the draft's weights and per-slot dense KV
-against the HBM budget before pages are carved.
+against the HBM budget before pages are carved — at ``tp_degree=2``
+the charge halves per chip because the draft's KV shards on heads with
+the target's.
+
+tp-sharded decode (ISSUE 19) changes NOTHING in the acceptance logic:
+proposals, ``longest_accepted``, and the page-table ``truncate``
+rollback are all token/page-id arithmetic on the replicated host side.
+The target's verify step simply runs through
+``serving.tp_decode.TPShardedDecoder`` (the fed width W=k+1 becomes a
+decode-program bucket), so verify/rollback are token-equal on the 4×2
+mesh — pinned by the equality matrix in tests/test_serving.py.
 """
 from __future__ import annotations
 

@@ -1,0 +1,383 @@
+"""tp-sharded decode: serve one big model from N chips via the 2-D mesh.
+
+``TPShardedDecoder`` is a drop-in forward backend for
+``ContinuousBatchingEngine``: it wraps a dygraph ``GPTModel`` (or
+``GPTForGeneration``) and replays the engine's cache-aware
+``forward(ids, cache, pos_offset, attn_mask)`` through a static
+``CompiledProgram`` on the dp×tp mesh — Megatron-style column/row
+parallel q/k/v/out and fc1/fc2 (``distributed/tensor_parallel.py``
+builders), attention over ``num_heads/tp`` local heads per chip, and
+the per-layer KV cache fed head-sharded (``dist_attr=["tp", 1]`` →
+each chip holds ``[B, H/tp, L, Dh]``).
+
+What stays replicated: token/position embeddings, LayerNorms, the
+row-projection biases, the additive attention mask, the page tables
+(host-side), and the logits — exactly the split ``static.page_budget``
+prices with ``tp_degree=``.  dp is a pure replication axis for
+serving: every dp replica computes the same batch, so the fetch-side
+``pmean`` over identical replicas is exact on power-of-two worlds.
+
+The engine's token-level machinery (radix prefix adoption, speculative
+verify/rollback, paged writes) rides unchanged: this class honors the
+same forward contract as the dygraph model — logits plus per-layer
+``MultiHeadAttention.Cache`` whose K/V are the input cache with the
+new columns appended on axis 2 at GLOBAL head count (the tp gather is
+a ``c_concat`` all-gather over the feature dim inside the program).
+
+Numerics are op-for-op with the dygraph path in eval mode (the
+wrapped model is switched to ``eval()`` at construction — decode must
+be deterministic): embed+pos add, pre-norm blocks, matmul→scale→
+mask-add→softmax attention, gelu(approximate=False) MLP, ln_f, tied
+LM head.  Programs are memoized per ``(batch, cache_len, width)``
+bucket — the same pow2 bucket discipline the engine already applies
+to KV lengths, so post-warmup steps never retrace.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TPShardedDecoder", "build_decode_program"]
+
+_PFX = "tpdec_"
+
+
+def _param_map(cfg) -> Dict[str, str]:
+    """static param name -> dygraph state_dict key."""
+    m = {_PFX + "wte": "wte.weight", _PFX + "wpe": "wpe.weight",
+         _PFX + "lnf_w": "ln_f.weight", _PFX + "lnf_b": "ln_f.bias"}
+    for li in range(cfg.num_layers):
+        b = f"{_PFX}b{li}_"
+        s = f"blocks.{li}."
+        m[b + "ln1_w"] = s + "ln1.weight"
+        m[b + "ln1_b"] = s + "ln1.bias"
+        m[b + "ln2_w"] = s + "ln2.weight"
+        m[b + "ln2_b"] = s + "ln2.bias"
+        for p, d in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj"),
+                     ("o", "out_proj")):
+            m[b + p + "_w"] = f"{s}attn.{d}.weight"
+            m[b + p + "_b"] = f"{s}attn.{d}.bias"
+        m[b + "fc1_w"] = s + "fc1.weight"
+        m[b + "fc1_b"] = s + "fc1.bias"
+        m[b + "fc2_w"] = s + "fc2.weight"
+        m[b + "fc2_b"] = s + "fc2.bias"
+    return m
+
+
+def build_decode_program(cfg, batch: int, cache_len: int, width: int,
+                         tp_degree: int):
+    """Build ONE static decode-step program for a (B, lc, W) bucket.
+
+    Feeds: ``ids``/``pos`` int64 [B, W] and ``mask`` float32
+    [B, 1, W, lc+W] (all stamped ``replicated_feed`` — every chip sees
+    the full batch); per-layer ``cache_k_{li}``/``cache_v_{li}``
+    float32 at the GLOBAL [B, H, lc, Dh] geometry, stamped
+    ``dist_attr=["tp", 1]`` so ``feed_partition_specs`` shards the
+    head dim — each chip receives its [B, H/tp, lc, Dh] slice.
+
+    Fetches: ``logits`` [B, W, V] (replicated) and per-layer
+    ``kg_{li}``/``vg_{li}`` — the layer's NEW K/V columns c_concat-
+    gathered back to [B, W, hidden] global feature order (head-major,
+    so the host reshape [B, W, H, Dh] → transpose rebuilds the cache
+    layout).  Returns ``(program, feed_names, fetch_names)``.
+    """
+    from ..core.program import Program, program_guard
+    from ..static import layers
+    from ..static.layer_helper import LayerHelper
+    from ..static.param_attr import ParamAttr
+    from ..distributed.tensor_parallel import (
+        col_parallel_fc, row_parallel_fc, tp_identity, shard_param,
+        TP_RING_ID, MP_AXIS)
+
+    c = cfg
+    tp = int(tp_degree)
+    H, Dh = c.num_heads, c.hidden_size // c.num_heads
+    if H % tp:
+        raise ValueError(
+            f"num_heads={H} must divide by tp_degree={tp} (attention "
+            "heads shard whole onto tp ranks)")
+    h_loc = H // tp
+    B, lc, W = int(batch), int(cache_len), int(width)
+    L = lc + W
+
+    main, startup = Program(), Program()
+    feed_names = ["ids", "pos", "mask"]
+    kv_fetches = []
+    with program_guard(main, startup):
+        ids = layers.data("ids", [B, W], "int64")
+        pos = layers.data("pos", [B, W], "int64")
+        mask = layers.data("mask", [B, 1, W, L], "float32")
+        for v in (ids, pos, mask):
+            v.attrs["replicated_feed"] = True
+        cache_feeds = []
+        for li in range(c.num_layers):
+            if lc:
+                ck = layers.data(f"cache_k_{li}", [B, H, lc, Dh], "float32")
+                cv = layers.data(f"cache_v_{li}", [B, H, lc, Dh], "float32")
+                # head-dim shard: chip r holds heads r*h_loc..(r+1)*h_loc
+                shard_param(ck, dim=1)
+                shard_param(cv, dim=1)
+                feed_names += [ck.name, cv.name]
+                cache_feeds.append((ck, cv))
+            else:
+                cache_feeds.append(None)
+
+        def _fix(z, shape):
+            # re-anchor abstract-eval bails (global/local shape mixes
+            # and -1 batch dims) at the known runtime shape
+            if z.shape is None:
+                z.shape = tuple(shape)
+                z.dtype = "float32"
+            return z
+
+        def _ln(x, name):
+            return layers.layer_norm(
+                x, begin_norm_axis=2, epsilon=1e-5,
+                param_attr=ParamAttr(name=name + "_w"),
+                bias_attr=ParamAttr(name=name + "_b"))
+
+        def _split(z):  # [B, W, h_loc*Dh] local -> [B, h_loc, W, Dh]
+            z = layers.reshape(z, [-1, W, h_loc, Dh])
+            # upstream build shapes are GLOBAL while these dims are the
+            # local shard — abstract eval bails, but the target is known.
+            # The batch dim stays -1 (symbolic): the verifier's global
+            # trace and the layout analyzer's dim tracker both treat it
+            # as a wildcard, so the head-split keeps the 'mp' shard on
+            # h_loc without a V104 global/local extent clash.
+            z.shape = (-1, W, h_loc, Dh)
+            return layers.transpose(z, [0, 2, 1, 3])
+
+        def _gather(z):
+            # all-gather the col-sharded features back to global order
+            # for the fetch — attention keeps consuming the shard
+            helper = LayerHelper("kv_gather")
+            out = helper.create_variable_for_type_inference(z.dtype)
+            op = helper.append_op("c_concat", {"X": [z]}, {"Out": [out]},
+                                  {"ring_id": TP_RING_ID})
+            op.attrs["mp_axis"] = MP_AXIS
+            if out.shape is None:
+                out.shape = tuple(z.shape)
+                out.dtype = z.dtype
+            return out
+
+        tok = layers.embedding(ids, size=[c.vocab_size, c.hidden_size],
+                               param_attr=ParamAttr(name=_PFX + "wte"))
+        posv = layers.embedding(pos, size=[c.max_position, c.hidden_size],
+                                param_attr=ParamAttr(name=_PFX + "wpe"))
+        x = layers.elementwise_add(tok, posv)
+
+        for li in range(c.num_layers):
+            pb = f"{_PFX}b{li}_"
+            h = _ln(x, pb + "ln1")
+            # ONE Megatron f-op shared by the q/k/v column projections
+            xid = tp_identity(h, tp_degree=tp)
+            proj = {}
+            for p in ("q", "k", "v"):
+                proj[p] = col_parallel_fc(
+                    xid, c.hidden_size, num_flatten_dims=2,
+                    param_attr=ParamAttr(name=pb + p + "_w"),
+                    bias_attr=ParamAttr(name=pb + p + "_b"),
+                    input_is_identity=True, tp_degree=tp,
+                    name=f"b{li}_{p}")
+            qh, kh, vh = (_split(proj[p]) for p in ("q", "k", "v"))
+            if lc:
+                ck, cv = cache_feeds[li]
+                kc = layers.concat([ck, kh], axis=2)
+                vc = layers.concat([cv, vh], axis=2)
+                # global-H feed vs local-h_loc fresh columns: infer
+                # bails on the mix; the runtime (local) shape is known
+                for z in (kc, vc):
+                    z.shape = (B, h_loc, L, Dh)
+                    z.dtype = "float32"
+            else:
+                kc, vc = kh, vh
+            # matmul THEN scale, mask add, softmax — the dygraph
+            # MultiHeadAttention score path, op for op
+            scores = layers.matmul(qh, kc, transpose_y=True)
+            if scores.shape is None:
+                scores.shape = (B, h_loc, W, L)
+                scores.dtype = "float32"
+            scores = layers.scale(scores, scale=Dh ** -0.5)
+            scores = layers.elementwise_add(scores, mask)
+            wts = layers.softmax(scores, axis=-1)
+            ctx = layers.matmul(wts, vc)
+            if ctx.shape is None:
+                ctx.shape = (B, h_loc, W, Dh)
+                ctx.dtype = "float32"
+            ctx = layers.transpose(ctx, [0, 2, 1, 3])
+            ctx = layers.reshape(ctx, [-1, W, h_loc * Dh])
+            # head-major merge: the 'mp' shard on h_loc carries onto the
+            # merged feature dim, which row_parallel_fc then contracts
+            ctx.shape = (B, W, h_loc * Dh)
+            attn = row_parallel_fc(
+                ctx, c.hidden_size, num_flatten_dims=2,
+                in_features=c.hidden_size,
+                param_attr=ParamAttr(name=pb + "o_w"),
+                bias_attr=ParamAttr(name=pb + "o_b"),
+                tp_degree=tp, name=f"b{li}_o")
+            x = _fix(layers.elementwise_add(x, attn),
+                     (B, W, c.hidden_size))
+            h = _ln(x, pb + "ln2")
+            f1 = col_parallel_fc(
+                h, c.intermediate_size, num_flatten_dims=2,
+                param_attr=ParamAttr(name=pb + "fc1_w"),
+                bias_attr=ParamAttr(name=pb + "fc1_b"),
+                tp_degree=tp, name=f"b{li}_fc1")
+            g = layers.gelu(f1, approximate=False)
+            f2 = row_parallel_fc(
+                g, c.hidden_size, num_flatten_dims=2,
+                in_features=c.intermediate_size,
+                param_attr=ParamAttr(name=pb + "fc2_w"),
+                bias_attr=ParamAttr(name=pb + "fc2_b"),
+                tp_degree=tp, name=f"b{li}_fc2")
+            x = _fix(layers.elementwise_add(x, f2),
+                     (B, W, c.hidden_size))
+
+            kv_fetches += [_gather(proj["k"]).name,
+                           _gather(proj["v"]).name]
+
+        xf = _ln(x, _PFX + "lnf")
+        wte_w = main.global_block().var(_PFX + "wte")
+        logits = layers.matmul(xf, wte_w, transpose_y=True)
+    fetch_names = [logits.name] + kv_fetches
+    return main, feed_names, fetch_names
+
+
+class TPShardedDecoder:
+    """Engine forward backend running decode tp-sharded on the mesh.
+
+    Wraps a dygraph model; exposes the engine's model contract —
+    ``config``, ``gen_cache``, ``_mask``, ``state_dict``,
+    ``parameters`` and the cache-aware ``forward`` — with the forward
+    dispatched through per-bucket ``CompiledProgram``s on a dp×tp
+    mesh.  Deliberately has NO ``.gpt`` attribute: the engine unwraps
+    ``getattr(model, "gpt", model)``, and the sharded backend must
+    survive that unwrap.
+    """
+
+    def __init__(self, model, tp_degree: int, places=None):
+        inner = getattr(model, "gpt", model)
+        # decode must be deterministic (dropout off) for the
+        # token-equality contract with the single-chip path
+        inner.eval()
+        self._inner = inner
+        self.config = inner.config
+        tp = int(tp_degree)
+        if tp < 1:
+            raise ValueError(f"tp_degree must be >= 1, got {tp}")
+        if self.config.num_heads % tp:
+            raise ValueError(
+                f"num_heads={self.config.num_heads} must divide by "
+                f"tp_degree={tp}")
+        self.tp_degree = tp
+        self._places = places
+        from ..static.executor import Executor, Scope
+        self._scope = Scope()
+        self._exe = Executor()
+        self._programs: Dict[Tuple[int, int, int], Tuple] = {}
+        self._install_weights()
+
+    # -- engine model contract (delegated) ------------------------------
+    def gen_cache(self, batch_size):
+        return self._inner.gen_cache(batch_size)
+
+    def _mask(self, seq):
+        return self._inner._mask(seq)
+
+    def state_dict(self, *a, **kw):
+        return self._inner.state_dict(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        return self._inner.parameters(*a, **kw)
+
+    def eval(self):
+        self._inner.eval()
+        return self
+
+    @property
+    def buckets_compiled(self) -> int:
+        return len(self._programs)
+
+    # -- weights --------------------------------------------------------
+    def _install_weights(self):
+        sd = self._inner.state_dict()
+        for pname, key in _param_map(self.config).items():
+            t = sd[key]
+            self._scope.set(pname, np.asarray(
+                t.numpy() if hasattr(t, "numpy") else t, np.float32))
+
+    def _program_for(self, B: int, lc: int, W: int):
+        key = (B, lc, W)
+        hit = self._programs.get(key)
+        if hit is None:
+            from ..distributed.compiled_program import (CompiledProgram,
+                                                        BuildStrategy)
+            prog, feeds, fetches = build_decode_program(
+                self.config, B, lc, W, self.tp_degree)
+            bs = BuildStrategy()
+            bs.tensor_parallel_degree = self.tp_degree
+            compiled = CompiledProgram(prog, build_strategy=bs)
+            if self._places is not None:
+                compiled._places = list(self._places)
+            hit = (compiled, feeds, fetches)
+            self._programs[key] = hit
+        return hit
+
+    # -- forward --------------------------------------------------------
+    def forward(self, input_ids, cache=None, pos_offset=None,
+                attn_mask=None):
+        import paddle_tpu
+        from ..nn import MultiHeadAttention
+        if cache is None:
+            # plain LM forward (no decode cache): single-chip delegate
+            return self._inner(input_ids, pos_offset=pos_offset,
+                               attn_mask=attn_mask)
+        ids = np.asarray(input_ids.numpy()
+                         if hasattr(input_ids, "numpy") else input_ids,
+                         np.int64)
+        B, W = int(ids.shape[0]), int(ids.shape[1])
+        cache_np = [(np.asarray(c.k.numpy()), np.asarray(c.v.numpy()))
+                    for c in cache]
+        lc = int(cache_np[0][0].shape[2])
+        if pos_offset is None:
+            off = np.zeros(B, np.int64)
+        else:
+            off = np.broadcast_to(
+                np.asarray(pos_offset, np.int64).reshape(-1), (B,))
+        pos = off[:, None] + np.arange(W, dtype=np.int64)[None]
+        if attn_mask is None:
+            m = np.asarray(self._inner._mask(W).numpy())
+        else:
+            m = np.asarray(attn_mask.numpy()
+                           if hasattr(attn_mask, "numpy") else attn_mask,
+                           np.float32)
+        if m.ndim == 2:   # the model's [S, S] causal mask (lc == 0)
+            m = m[None, None]
+        m = np.ascontiguousarray(
+            np.broadcast_to(m, (B, 1, W, lc + W)), np.float32)
+
+        compiled, _feeds, fetches = self._program_for(B, lc, W)
+        feed = {"ids": ids, "pos": pos, "mask": m}
+        for li, (k, v) in enumerate(cache_np):
+            if lc:
+                feed[f"cache_k_{li}"] = k
+                feed[f"cache_v_{li}"] = v
+        outs = self._exe.run(program=compiled, feed=feed,
+                             fetch_list=fetches, scope=self._scope)
+        logits = np.asarray(outs[0])
+        H = self.config.num_heads
+        Dh = self.config.hidden_size // H
+        new_caches = []
+        for li in range(self.config.num_layers):
+            kg = np.asarray(outs[1 + 2 * li])   # [B, W, hidden] global
+            vg = np.asarray(outs[2 + 2 * li])
+            k_new = kg.reshape(B, W, H, Dh).transpose(0, 2, 1, 3)
+            v_new = vg.reshape(B, W, H, Dh).transpose(0, 2, 1, 3)
+            k_full = np.concatenate([cache_np[li][0], k_new], axis=2)
+            v_full = np.concatenate([cache_np[li][1], v_new], axis=2)
+            new_caches.append(MultiHeadAttention.Cache(
+                paddle_tpu.to_tensor(k_full), paddle_tpu.to_tensor(v_full)))
+        return paddle_tpu.to_tensor(logits), new_caches
+
+    __call__ = forward

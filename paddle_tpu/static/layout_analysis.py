@@ -488,7 +488,12 @@ class _Engine:
 
     def _align(self, out_rank: int, in_rank: int, axis_attr: int) -> int:
         """Fluid elementwise broadcast: Y dim j aligns to X dim
-        offset+j, offset = axis attr (or trailing alignment)."""
+        offset+j, offset = axis attr (or trailing alignment).  The axis
+        attr only positions the lower-rank (broadcast) operand — a
+        full-rank operand always aligns at 0, so a bias add with
+        axis=rank-1 must not shift the activation's own dims."""
+        if out_rank is not None and in_rank >= out_rank:
+            return 0
         if axis_attr is not None and axis_attr >= 0:
             return int(axis_attr)
         return max(0, out_rank - in_rank)
@@ -714,7 +719,11 @@ class _Engine:
         # this tracker — taint instead of guessing.
         p = 0
         while p < min(len(in_shape), len(out_shape)) and \
-                int(in_shape[p]) == int(out_shape[p]):
+                (int(in_shape[p]) == int(out_shape[p]) or
+                 int(in_shape[p]) < 0 or int(out_shape[p]) < 0):
+            # a -1 dim is the symbolic batch — it matches any extent,
+            # so a concrete-batch producer feeding a -1-declared
+            # reshape still maps the prefix identity
             p += 1
         out_spec: List[Optional[str]] = [None] * len(out_shape)
         lost = False

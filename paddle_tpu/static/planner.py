@@ -163,21 +163,29 @@ class Calibration:
     (0, 1] — the fraction of the peak rate that leg actually sustains —
     and ``overhead_ms`` is the per-dispatch constant (tracing epilogue,
     host transfer, runtime launch) the pure roofline prices at zero.
-    A coefficient whose component is zero in every fitted pair is
-    unidentifiable and stays at 1.0 (recorded in ``unidentified``).
+    ``overhead_ms_by_world`` refines the intercept per MESH CLASS
+    (world size): a world=8 dispatch pays shard_map splitting and an
+    8-way runtime launch a world=1 dispatch never sees, so one shared
+    intercept fits whichever class dominates the ladder and misses the
+    other (ROADMAP calibration item (b): 46% on fc512_b8).  `step_ms`
+    consults it when the caller passes ``world=``; unknown worlds fall
+    back to the shared intercept.  A coefficient whose component is
+    zero in every fitted pair is unidentifiable and stays at 1.0
+    (recorded in ``unidentified``).
 
     Produced by `calibrate(pairs)`; consumed by `plan_program` (every
     priced candidate's ``step_ms``/``samples_per_sec`` pass through
     `step_ms()` and the record is stamped ``calibrated=True``)."""
 
     __slots__ = ("eff_compute", "eff_wire_overlap", "eff_wire_serial",
-                 "overhead_ms", "residual_pct", "n_pairs",
-                 "unidentified", "source")
+                 "overhead_ms", "overhead_ms_by_world", "residual_pct",
+                 "n_pairs", "unidentified", "source")
 
     def __init__(self, eff_compute: float = 1.0,
                  eff_wire_overlap: float = 1.0,
                  eff_wire_serial: float = 1.0,
                  overhead_ms: float = 0.0,
+                 overhead_ms_by_world: Optional[Dict[int, float]] = None,
                  residual_pct: float = 0.0, n_pairs: int = 0,
                  unidentified: Tuple[str, ...] = (),
                  source: str = ""):
@@ -185,16 +193,28 @@ class Calibration:
         self.eff_wire_overlap = float(eff_wire_overlap)
         self.eff_wire_serial = float(eff_wire_serial)
         self.overhead_ms = float(overhead_ms)
+        self.overhead_ms_by_world = {
+            int(w): float(v)
+            for w, v in (overhead_ms_by_world or {}).items()}
         self.residual_pct = float(residual_pct)
         self.n_pairs = int(n_pairs)
         self.unidentified = tuple(unidentified)
         self.source = str(source)
 
+    def overhead_for(self, world: Optional[int] = None) -> float:
+        if world is not None:
+            hit = self.overhead_ms_by_world.get(int(world))
+            if hit is not None:
+                return hit
+        return self.overhead_ms
+
     def step_ms(self, compute_ms: float, wire_overlap_ms: float,
-                wire_serial_ms: float) -> float:
+                wire_serial_ms: float,
+                world: Optional[int] = None) -> float:
         return (max(compute_ms / self.eff_compute,
                     wire_overlap_ms / self.eff_wire_overlap) +
-                wire_serial_ms / self.eff_wire_serial + self.overhead_ms)
+                wire_serial_ms / self.eff_wire_serial +
+                self.overhead_for(world))
 
     def to_dict(self) -> Dict:
         return {
@@ -202,6 +222,9 @@ class Calibration:
             "eff_wire_overlap": round(self.eff_wire_overlap, 6),
             "eff_wire_serial": round(self.eff_wire_serial, 6),
             "overhead_ms": round(self.overhead_ms, 6),
+            "overhead_ms_by_world": {
+                str(w): round(v, 6)
+                for w, v in sorted(self.overhead_ms_by_world.items())},
             "residual_pct": round(self.residual_pct, 4),
             "n_pairs": self.n_pairs,
             "unidentified": list(self.unidentified),
@@ -213,6 +236,7 @@ class Calibration:
                    eff_wire_overlap=d.get("eff_wire_overlap", 1.0),
                    eff_wire_serial=d.get("eff_wire_serial", 1.0),
                    overhead_ms=d.get("overhead_ms", 0.0),
+                   overhead_ms_by_world=d.get("overhead_ms_by_world"),
                    residual_pct=d.get("residual_pct", 0.0),
                    n_pairs=d.get("n_pairs", 0),
                    unidentified=tuple(d.get("unidentified") or ()),
@@ -250,6 +274,14 @@ def calibrate(pairs: List[Dict]) -> Calibration:
     ``wire_serial_ms`` (the planner's per-candidate roofline legs, e.g.
     straight out of a `Plan.trace` record) and ``measured_ms`` (the
     wall-clock per-step time of the SAME candidate on the target host).
+    A pair may also carry ``world`` (the mesh size the measurement ran
+    on); when two or more world classes are present the dispatch
+    intercept is fitted PER CLASS — a world=8 dispatch pays shard_map
+    splitting and an 8-way launch a world=1 dispatch never sees, and
+    sharing one intercept across both makes whichever class is rarer in
+    the ladder fit worst.  The shared ``overhead_ms`` remains the
+    pair-weighted mean of the class intercepts, the fallback for worlds
+    the ladder never measured.
 
     The fit is a deterministic coordinate descent minimizing the mean
     squared RELATIVE error (so a 10 ms shape and a 1000 ms shape weigh
@@ -260,67 +292,91 @@ def calibrate(pairs: List[Dict]) -> Calibration:
     pts = [(max(0.0, float(p["compute_ms"])),
             max(0.0, float(p["wire_overlap_ms"])),
             max(0.0, float(p["wire_serial_ms"])),
-            float(p["measured_ms"]))
+            float(p["measured_ms"]),
+            int(p["world"]) if p.get("world") is not None else None)
            for p in pairs if float(p.get("measured_ms") or 0) > 0]
     if not pts:
         raise ValueError("calibrate: no pairs with measured_ms > 0")
 
-    ident_c = any(c > 0 for c, _, _, _ in pts)
-    ident_w = any(w > 0 for _, w, _, _ in pts)
-    ident_s = any(s > 0 for _, _, s, _ in pts)
+    ident_c = any(c > 0 for c, _, _, _, _ in pts)
+    ident_w = any(w > 0 for _, w, _, _, _ in pts)
+    ident_s = any(s > 0 for _, _, s, _, _ in pts)
 
-    def _err(ec, ew, es, oh):
+    # one intercept coordinate per world class when ≥2 classes measured;
+    # otherwise a single shared "oh" (the pre-per-world behaviour).
+    worlds = sorted({wd for *_, wd in pts if wd is not None})
+    per_world = len(worlds) >= 2
+    oh_keys = ([f"oh@{wd}" for wd in worlds] +
+               (["oh"] if any(wd is None for *_, wd in pts) else [])
+               ) if per_world else ["oh"]
+
+    def _oh_key(wd):
+        return f"oh@{wd}" if per_world and wd is not None else "oh"
+
+    def _err(trial):
         tot = 0.0
-        for c, w, s, m in pts:
-            pred = max(c / ec, w / ew) + s / es + oh
+        ec, ew, es = trial["ec"], trial["ew"], trial["es"]
+        for c, w, s, m, wd in pts:
+            pred = max(c / ec, w / ew) + s / es + trial[_oh_key(wd)]
             rel = (pred - m) / m
             tot += rel * rel
         return tot / len(pts)
 
-    # coefficient search windows: efficiencies in (1e-4, 1]; overhead in
-    # [0, min measured] (an intercept above the fastest pair would fit
-    # negative work).  Three shrink rounds of 17-point per-coordinate
-    # grids ≈ 1e-3 relative resolution, deterministic and dependency-free.
+    # coefficient search windows: efficiencies in (1e-4, 1]; each
+    # intercept in [0, min measured in its class] (an intercept above
+    # the class's fastest pair would fit negative work).  Shrink rounds
+    # of 17-point per-coordinate grids ≈ 1e-3 relative resolution,
+    # deterministic and dependency-free.
     coords = {"ec": 0.5 if ident_c else 1.0,
               "ew": 0.5 if ident_w else 1.0,
-              "es": 0.5 if ident_s else 1.0,
-              "oh": 0.0}
-    spans = {"ec": (1e-4, 1.0), "ew": (1e-4, 1.0), "es": (1e-4, 1.0),
-             "oh": (0.0, min(m for _, _, _, m in pts))}
+              "es": 0.5 if ident_s else 1.0}
+    spans = {"ec": (1e-4, 1.0), "ew": (1e-4, 1.0), "es": (1e-4, 1.0)}
+    for k in oh_keys:
+        cls = [m for _, _, _, m, wd in pts if _oh_key(wd) == k]
+        coords[k] = 0.0
+        spans[k] = (0.0, min(cls) if cls else 0.0)
     active = ([k for k, flag in (("ec", ident_c), ("ew", ident_w),
-                                 ("es", ident_s)) if flag] + ["oh"])
+                                 ("es", ident_s)) if flag] + oh_keys)
     for _round in range(4):
         for key in active:
             lo, hi = spans[key]
             best_v, best_e = coords[key], None
             n = 17
             for i in range(n):
-                if key == "oh":
-                    v = lo + (hi - lo) * i / (n - 1)
+                if key.startswith("oh"):
+                    v = lo + (hi - lo) * i / (n - 1) if hi > lo else lo
                 else:  # log-spaced: efficiencies vary over decades
                     v = math.exp(math.log(max(lo, 1e-4)) +
                                  (math.log(hi) - math.log(max(lo, 1e-4))) *
                                  i / (n - 1))
                 trial = dict(coords)
                 trial[key] = v
-                e = _err(trial["ec"], trial["ew"], trial["es"], trial["oh"])
+                e = _err(trial)
                 if best_e is None or e < best_e:
                     best_v, best_e = v, e
             coords[key] = best_v
             # shrink the window around the winner for the next round
             width = (hi - lo) / 4
             spans[key] = (max(spans[key][0], best_v - width),
-                          min(spans[key][1] if key != "oh"
-                              else spans[key][1], best_v + width))
+                          min(spans[key][1], best_v + width))
 
-    ec, ew, es, oh = coords["ec"], coords["ew"], coords["es"], coords["oh"]
-    resid = sum(abs(max(c / ec, w / ew) + s / es + oh - m) / m
-                for c, w, s, m in pts) / len(pts) * 100.0
+    ec, ew, es = coords["ec"], coords["ew"], coords["es"]
+    resid = sum(abs(max(c / ec, w / ew) + s / es + coords[_oh_key(wd)] - m)
+                / m for c, w, s, m, wd in pts) / len(pts) * 100.0
     unident = tuple(n for n, flag in (("compute", ident_c),
                                       ("wire_overlap", ident_w),
                                       ("wire_serial", ident_s)) if not flag)
+    by_world = ({wd: coords[f"oh@{wd}"] for wd in worlds}
+                if per_world else {})
+    if per_world:
+        # shared fallback intercept = pair-weighted mean of the fitted
+        # class intercepts (worlds the ladder never measured get this)
+        oh = (sum(coords[_oh_key(wd)] for *_, wd in pts) / len(pts))
+    else:
+        oh = coords["oh"]
     return Calibration(eff_compute=ec, eff_wire_overlap=ew,
                        eff_wire_serial=es, overhead_ms=oh,
+                       overhead_ms_by_world=by_world,
                        residual_pct=resid, n_pairs=len(pts),
                        unidentified=unident)
 
@@ -749,7 +805,7 @@ def _price(point: _RewritePoint, cand: Dict, hbm_budget: Optional[int],
     ws_s = ws / ici_bps if ici_bps else 0.0
     if calib is not None:
         step_s = calib.step_ms(compute_s * 1e3, wo_s * 1e3,
-                               ws_s * 1e3) / 1e3
+                               ws_s * 1e3, world=int(world)) / 1e3
     else:
         step_s = max(compute_s, wo_s) + ws_s
     eff_batch = batch * point.dp_world * gm_k
@@ -1204,6 +1260,21 @@ def _decode_weight_bytes(cfg: Dict) -> int:
     return n * 4
 
 
+def _decode_shardable_bytes(cfg: Dict) -> int:
+    """The Megatron-splittable subset of `_decode_weight_bytes`: per
+    block, the q/k/v/out projection matrices (col/row split), the qkv
+    biases (ride the col shard), and fc1 weight+bias / fc2 weight (col
+    then row).  Embeddings, layer norms, the out-proj and fc2 biases
+    (row-parallel bias applies after the allreduce) stay replicated —
+    `distributed.tensor_parallel`'s exact shard set."""
+    hd, inter = cfg["hidden_size"], cfg["intermediate_size"]
+    per_block = (4 * hd * hd              # q/k/v/out projection matrices
+                 + 3 * hd                 # q/k/v biases (col-sharded)
+                 + hd * inter + inter     # fc1 weight + bias (col)
+                 + inter * hd)            # fc2 weight (row)
+    return cfg["num_layers"] * per_block * 4
+
+
 def page_budget(model=None, config=None, *, page_tokens: int = 16,
                 max_context: Optional[int] = None,
                 hbm_bytes: Optional[int] = None,
@@ -1211,7 +1282,8 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
                 kv_dtype: str = "float32",
                 max_slots_cap: Optional[int] = None,
                 headroom: float = 0.08,
-                draft_layers: int = 0) -> Dict:
+                draft_layers: int = 0,
+                tp_degree: int = 1) -> Dict:
     """Size the serving tier's paged KV pool from the HBM walker's
     budget instead of a hand-set page count (ROADMAP planner follow-up
     (d): the same sizing authority that answers training fits/OOM).
@@ -1248,6 +1320,20 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     ``serving.RadixPrefixCache`` bounds retention with (evict LRU when
     free falls below ``low``, release down to ``high``).
 
+    ``tp_degree`` sizes the pool for a tensor-parallel decode mesh:
+    every chip holds 1/tp of the Megatron-splittable weights
+    (`_decode_shardable_bytes` — attention/MLP matrices; embeddings,
+    layer norms and row-parallel biases stay replicated) and 1/tp of
+    every KV byte (heads shard, so each chip's page slab is
+    ``[L, P, H/tp, T, Dh]``), while the logits row is replicated (the
+    row-parallel head allreduces the full vocab onto every chip).  The
+    HBM budget stays PER CHIP — the whole point is that a model
+    infeasible at tp=1 under a pinned ``PADDLE_TPU_HBM_BYTES`` carves a
+    real page pool at tp=2 because the per-chip charge shrank.  Page
+    counts and contexts in the plan remain GLOBAL token geometry
+    (page tables are host-side and replicated); only the byte
+    accounting divides.
+
     Returns the plan dict ``PagedKVPool.from_plan`` consumes; every
     input is recorded in it so ``serving.kv_pool.budget_drift`` can
     re-derive the numbers and flag hand-edits, V504-style.
@@ -1260,6 +1346,13 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     T = int(page_tokens)
     if T < 1:
         raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+    tp = int(tp_degree) if tp_degree else 1
+    if tp < 1:
+        raise ValueError(f"tp_degree must be >= 1, got {tp_degree}")
+    if H % tp:
+        raise ValueError(
+            f"page_budget: num_heads {H} not divisible by tp_degree "
+            f"{tp} — the KV slab shards on the head dim")
     itemsize = np.dtype(kv_dtype).itemsize
     budget = int(hbm_bytes) if hbm_bytes else hbm_budget_bytes()
     if weight_bytes is None:
@@ -1270,6 +1363,10 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
         else:
             weight_bytes = _decode_weight_bytes(cfg)
     weight_bytes = int(weight_bytes)
+    # per-chip weights: the Megatron-splittable subset divides by tp,
+    # the replicated remainder (embeddings/LN/row biases) is paid whole
+    shardable = min(weight_bytes, _decode_shardable_bytes(cfg))
+    weight_bytes_pc = weight_bytes - (shardable - shardable // tp)
     cap = int(max_slots_cap) if max_slots_cap else 64
     # ctx_req is the pre-clamp INPUT (recorded for budget_drift: feeding
     # the pool-clamped max_context back in would re-derive a different
@@ -1279,40 +1376,50 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     ctx = ctx_req
 
     token_bytes = 2 * L * H * Dh * itemsize       # one K+V column, all layers
-    page_bytes = token_bytes * T
+    page_bytes = token_bytes * T                  # global (all tp shards)
+    H_loc = H // tp                               # heads resident per chip
+    token_bytes_pc = 2 * L * H_loc * Dh * itemsize
+    page_bytes_pc = token_bytes_pc * T
     # speculative draft charge: a draft_layers-layer sibling's weights
     # are resident beside the target, and every decode slot carries a
-    # dense draft KV cache at the same pow2 context bucket
+    # dense draft KV cache at the same pow2 context bucket (both shard
+    # on heads with the target, so the per-chip charge divides too)
     draft_layers = max(0, int(draft_layers))
     draft_weight_bytes = 0
-    draft_kv_slot = 0
+    draft_weight_bytes_pc = 0
+    draft_kv_slot_pc = 0
     if draft_layers:
         draft_cfg = dict(cfg)
         draft_cfg["num_layers"] = draft_layers
         draft_weight_bytes = _decode_weight_bytes(draft_cfg)
-        draft_kv_slot = 2 * draft_layers * H * _next_pow2(ctx) * Dh \
-            * itemsize
-    usable = int(budget * (1.0 - float(headroom))) - weight_bytes \
-        - draft_weight_bytes
-    if usable < page_bytes + token_bytes * _next_pow2(ctx):
+        d_shard = _decode_shardable_bytes(draft_cfg)
+        draft_weight_bytes_pc = draft_weight_bytes \
+            - (d_shard - d_shard // tp)
+        draft_kv_slot_pc = 2 * draft_layers * H_loc * _next_pow2(ctx) \
+            * Dh * itemsize
+    usable = int(budget * (1.0 - float(headroom))) - weight_bytes_pc \
+        - draft_weight_bytes_pc
+    if usable < page_bytes_pc + token_bytes_pc * _next_pow2(ctx):
         raise ValueError(
-            f"page_budget: {budget} B HBM leaves {usable} B after "
-            f"{weight_bytes} B of weights"
-            + (f" + {draft_weight_bytes} B of draft weights"
+            f"page_budget: {budget} B HBM/chip leaves {usable} B after "
+            f"{weight_bytes_pc} B of per-chip weights"
+            + (f" + {draft_weight_bytes_pc} B of draft weights"
                if draft_layers else "") +
             f" — not enough for one decode "
-            f"slot at context {ctx} (raise PADDLE_TPU_HBM_BYTES or "
-            f"shrink the model)")
-    # per-slot step workspace: the dense [L, H, lpad, Dh] K+V gather
-    # view at the largest pow2 KV bucket, plus this row's logits (and
-    # the draft model's per-slot dense KV when speculating)
-    ws_slot = 2 * L * H * _next_pow2(ctx) * Dh * itemsize \
-        + cfg["vocab_size"] * 4 + draft_kv_slot
+            f"slot at context {ctx} at tp={tp} (raise "
+            f"PADDLE_TPU_HBM_BYTES, raise tp_degree, or shrink the "
+            f"model)")
+    # per-slot step workspace: the dense [L, H/tp, lpad, Dh] K+V gather
+    # view at the largest pow2 KV bucket, plus this row's REPLICATED
+    # logits (the row-parallel head allreduces full vocab everywhere),
+    # and the draft model's per-slot dense KV when speculating
+    ws_slot = 2 * L * H_loc * _next_pow2(ctx) * Dh * itemsize \
+        + cfg["vocab_size"] * 4 + draft_kv_slot_pc
     max_slots = max(1, min(cap, int(usable * 0.35) // ws_slot))
-    pages = (usable - max_slots * ws_slot) // page_bytes
+    pages = (usable - max_slots * ws_slot) // page_bytes_pc
     while pages < 1 and max_slots > 1:      # tiny budgets: trade slots back
         max_slots -= 1
-        pages = (usable - max_slots * ws_slot) // page_bytes
+        pages = (usable - max_slots * ws_slot) // page_bytes_pc
     if pages < 1:
         raise ValueError(
             f"page_budget: workspace for one slot leaves no room for "
@@ -1339,7 +1446,7 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
                                 "high": int(min(wm_high, pages))},
         "draft_layers": draft_layers,
         "draft_weight_bytes": int(draft_weight_bytes),
-        "draft_kv_bytes": int(max_slots * draft_kv_slot),
+        "draft_kv_bytes": int(max_slots * draft_kv_slot_pc * tp),
         "max_context_requested": int(ctx_req),
         "num_layers": L,
         "num_heads": H,
@@ -1349,6 +1456,9 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
         "kv_bytes": int(pages * page_bytes),
         "workspace_bytes": int(max_slots * ws_slot),
         "weight_bytes": weight_bytes,
+        "tp_degree": tp,
+        "weight_bytes_per_chip": int(weight_bytes_pc),
+        "page_bytes_per_chip": int(page_bytes_pc),
         "hbm_bytes": int(budget),
         "headroom": float(headroom),
         "max_slots_cap": cap,

@@ -479,3 +479,62 @@ def test_server_stats_include_pool(tmp_path):
         finally:
             srv.stop()
         srv.engine.kv_pool.assert_drained()
+
+
+# -- tp-sharded page budgets ------------------------------------------------
+
+def test_page_budget_tp_divides_per_chip_charges():
+    """tp=2 halves the per-chip page bytes and the Megatron-splittable
+    weight charge, so the SAME per-chip HBM budget carves strictly more
+    pages — while page counts, contexts, and table geometry stay GLOBAL
+    (tables are host-side and replicated)."""
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+           "num_heads": 4, "max_position": 128}
+    hbm = 256 * 1024
+    p1 = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm)
+    p2 = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, tp_degree=2)
+    assert p2["tp_degree"] == 2
+    assert p2["page_bytes_per_chip"] * 2 == p2["page_bytes"]
+    assert p2["page_bytes"] == p1["page_bytes"]      # global geometry
+    assert p2["pages"] > p1["pages"]
+    assert p2["weight_bytes_per_chip"] < p1["weight_bytes_per_chip"]
+    pool = PagedKVPool.from_plan(p2)
+    assert pool.tp_degree == 2
+    assert pool.page_bytes_per_chip * 2 == pool.page_bytes
+    assert budget_drift(pool) == []                  # tp plan re-derives
+    stats = pool.stats()
+    assert stats["tp_degree"] == 2
+    assert stats["page_bytes_per_chip"] == pool.page_bytes_per_chip
+
+
+def test_page_budget_tp_rejects_unsplittable_heads():
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 33, "num_layers": 2,
+           "num_heads": 3, "max_position": 128}
+    with pytest.raises(ValueError, match="head dim"):
+        page_budget(config=cfg, hbm_bytes=1 << 20, tp_degree=2)
+
+
+def test_page_budget_tp_charges_sharded_draft():
+    """The speculative draft's weights and per-slot dense KV shard on
+    heads with the target: at tp=2 the per-chip draft charge halves
+    (global draft bytes stay put — tables and token geometry are
+    global), so the same budget with a draft carves more pages."""
+    from paddle_tpu.static import page_budget
+    cfg = {"vocab_size": 64, "hidden_size": 32, "num_layers": 4,
+           "num_heads": 4, "max_position": 128}
+    hbm = 4 * 1024 * 1024
+    p1 = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, draft_layers=2)
+    p2 = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, draft_layers=2, tp_degree=2)
+    assert p2["draft_weight_bytes"] == p1["draft_weight_bytes"]
+    assert p2["pages"] > p1["pages"]
+    # the draft's dense per-slot KV rides the workspace: per slot the
+    # tp=2 charge must be under the tp=1 charge (heads shard)
+    ws1 = p1["workspace_bytes"] // p1["max_slots"]
+    ws2 = p2["workspace_bytes"] // p2["max_slots"]
+    assert ws2 < ws1

@@ -1,0 +1,24 @@
+"""Tier-1 tp-serving gate (NOT marked slow — losing the tp=2 page
+capacity win, sharded-decode token equality, or the decode bucket
+cache is a multi-chip serving regression that must fail the suite, not
+wait for a perf round).
+
+Drives tools/tp_serve_smoke.py in-process: one pinned per-chip HBM
+budget sized at tp=1 and tp=2 by ``static.page_budget``, the
+``TPShardedDecoder`` CompiledProgram vs the dygraph model on prefill
+and cached-decode buckets, and a zero-retrace repeat of both warmed
+buckets."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_tp_serve_smoke_gate():
+    import tp_serve_smoke
+    result = tp_serve_smoke.run_smoke()
+    assert result["pages_tp2"] > result["pages_tp1"], result
+    assert result["traces_after_warmup"] == 0, result
+    assert result["token_equal"] is True, result
+    assert result["buckets_compiled"] >= 2, result

@@ -183,6 +183,91 @@ def _measure(width, spec, batch, world):
     return min(times) * 1e3   # ms
 
 
+def _decode_pair(label, B, lc, W, tp, peak):
+    """One decode-step (memory-bound) calibration pair: the serving hot
+    path is a tiny-FLOP, cache-dominated bucket, so its measured time is
+    mostly dispatch intercept + mp wire — exactly the legs the training
+    ladder under-constrains.  Prediction prices the REAL decode program
+    (`serving.build_decode_program`): compute from the IR FLOP walk
+    divided by tp (heads/MLP shard; the logits row is replicated but
+    small at this geometry), serial wire from the per-layer Megatron
+    collectives (two allreduces + the two KV gathers) over the ici
+    rate.  Measurement drives `serving.TPShardedDecoder` — the same
+    CompiledProgram the engine runs — best-of-3 over STEPS steps."""
+    import jax
+    import numpy as np
+    import paddle_tpu
+    from paddle_tpu.models.gpt import GPTModel, GPTConfig
+    from paddle_tpu.nn import MultiHeadAttention
+    from paddle_tpu.serving.tp_decode import (TPShardedDecoder,
+                                              build_decode_program)
+    from paddle_tpu.static.flops_analysis import analyze_flops
+    from paddle_tpu.static.planner import ici_bytes_per_chip
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position=256, dropout=0.0)
+    prog, _, _ = build_decode_program(cfg, batch=B, cache_len=lc,
+                                      width=W, tp_degree=tp)
+    flops = analyze_flops(prog, batch=B)["total_flops"]
+    compute_ms = flops / max(tp, 1) / peak * 1e3
+    # per-layer serial mp wire: ring allreduce moves 2(tp-1)/tp of the
+    # [B, W, hidden] activation twice (o-proj + fc2), the two c_concat
+    # KV gathers move (tp-1)/tp of it each
+    act = B * W * cfg.hidden_size * 4
+    frac = (tp - 1) / tp if tp > 1 else 0.0
+    wire = cfg.num_layers * (2 * 2 * frac * act + 2 * frac * act)
+    wire_serial_ms = wire / ici_bytes_per_chip() * 1e3
+
+    np.random.seed(0)
+    m = GPTModel(cfg)
+    m.eval()
+    world = 8 if tp > 1 else 1
+    places = None if tp > 1 else [jax.devices()[0]]
+    dec = TPShardedDecoder(m, tp_degree=tp, places=places)
+    ids = np.random.randint(0, cfg.vocab_size, (B, W)).astype(np.int64)
+    k = np.random.randn(cfg.num_layers, B, cfg.num_heads, lc,
+                        cfg.hidden_size // cfg.num_heads)
+    k = (k * 0.1).astype(np.float32)
+    pos = np.full((B,), lc, np.int64)
+    mask = np.zeros((B, 1, W, lc + W), np.float32)
+
+    def cache():
+        return [MultiHeadAttention.Cache(paddle_tpu.to_tensor(k[li]),
+                                         paddle_tpu.to_tensor(k[li]))
+                for li in range(cfg.num_layers)]
+
+    dec.forward(paddle_tpu.to_tensor(ids), cache=cache(),
+                pos_offset=pos,
+                attn_mask=paddle_tpu.to_tensor(mask))     # warm/compile
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(STEPS):
+            out, _ = dec.forward(paddle_tpu.to_tensor(ids), cache=cache(),
+                                 pos_offset=pos,
+                                 attn_mask=paddle_tpu.to_tensor(mask))
+        np.asarray(out.numpy())
+        times.append((time.time() - t0) / STEPS)
+    return {"label": label, "batch": B, "width": W, "world": world,
+            "knobs": {"decode": True, "tp_degree": tp, "cache_len": lc},
+            "compute_ms": compute_ms,
+            "wire_overlap_ms": 0.0,
+            "wire_serial_ms": wire_serial_ms,
+            "predicted_raw_ms": compute_ms + wire_serial_ms,
+            "measured_ms": round(min(times) * 1e3, 4)}
+
+
+# (label, batch B, cache_len lc, step width W, tp degree) — the serving
+# regime's calibration rows: decode steps from the engine's bucket
+# lattice, tp=1 vs tp=2 so the per-world intercepts see both mesh
+# classes from the memory-bound side too
+DECODE_SHAPES = [
+    ("decode_b4_lc64_w1_tp1", 4, 64, 1, 1),
+    ("decode_b4_lc64_w1_tp2", 4, 64, 1, 2),
+    ("decode_b4_lc64_w4_tp2", 4, 64, 4, 2),
+]
+
+
 # (label, width, batch, world, knob spec) — the looped/hoisted gm pair
 # shares a rewrite so the hoist's measured win is apples-to-apples
 SHAPES = [
@@ -218,6 +303,8 @@ def run_calibration():
         pairs.append(dict(pred, label=label, width=width, batch=batch,
                           world=world, knobs=dict(spec),
                           measured_ms=round(measured, 4)))
+    for label, B, lc, W, tp in DECODE_SHAPES:
+        pairs.append(_decode_pair(label, B, lc, W, tp, peak))
     cal = calibrate(pairs)
     return cal, pairs, peak
 

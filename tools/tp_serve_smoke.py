@@ -1,0 +1,147 @@
+"""Fast CPU tp-serving gate: tp=2 page budget beats tp=1, sharded
+decode is token-equal, zero post-warmup retraces.
+
+The cheap canary for the tp-sharded decode tier
+(tests/test_tp_serve_smoke.py runs it as a tier-1 test, mirroring
+page_smoke/serve_smoke): sizes the SAME model's page pool with
+``static.page_budget`` at tp=1 and tp=2 under one pinned per-chip HBM
+budget, then asserts the contracts multi-chip serving rests on:
+
+  * the tp=2 plan carves MORE pages than tp=1 at equal per-chip HBM —
+    halving the per-chip weight + KV charge is the whole point of
+    sharding the decode;
+  * ``serving.TPShardedDecoder`` (the CompiledProgram the engine runs
+    across the dp×mp mesh) produces the single-chip model's argmax
+    token and its gathered KV columns bit-for-bit shape-equal on both
+    a prefill bucket and a cached decode bucket;
+  * repeating a warmed bucket adds ZERO jit traces — the decode
+    program must ride its (batch, cache, width) bucket cache, never a
+    fresh trace.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/tp_serve_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pinned per-chip budget: weights + a thin KV grant so the tp=1 pool is
+# starved and the tp=2 per-chip savings convert into visible pages
+SMOKE_KV_GRANT = 256 * 1024
+
+
+def run_smoke():
+    """Run the gate; returns the result dict (AssertionError on any
+    tp-serving contract regression)."""
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.core import compile_cache
+    from paddle_tpu.models import GPTConfig, GPTModel
+    from paddle_tpu.nn import MultiHeadAttention
+    from paddle_tpu.serving import TPShardedDecoder
+    from paddle_tpu.static import page_budget
+
+    t0 = time.time()
+    rng = np.random.RandomState(3)
+    with dg.guard():
+        cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                        num_heads=4, max_position=64, dropout=0.0)
+        m = GPTModel(cfg)
+        m.eval()
+
+        # -- planner budgets: tp=2 must out-carve tp=1 per chip --------
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.parameters()))
+        hbm = weight_bytes + SMOKE_KV_GRANT
+        plan1 = page_budget(m, page_tokens=4, max_context=64,
+                            hbm_bytes=hbm)
+        plan2 = page_budget(m, page_tokens=4, max_context=64,
+                            hbm_bytes=hbm, tp_degree=2)
+        assert plan2["pages"] > plan1["pages"], \
+            f"tp=2 carved no extra pages: {plan2['pages']} vs " \
+            f"{plan1['pages']} at equal per-chip HBM"
+
+        # -- sharded decode token-equal on prefill + decode buckets ----
+        dec = TPShardedDecoder(m, tp_degree=2)
+        ids = rng.randint(0, 48, (1, 8)).astype(np.int64)
+        zero = np.zeros(1, np.int64)
+        lr, cr = m.forward(paddle_tpu.to_tensor(ids), cache=m.gen_cache(1),
+                           pos_offset=zero, attn_mask=m._mask(8))
+        lt, ct = dec.forward(paddle_tpu.to_tensor(ids),
+                             cache=m.gen_cache(1), pos_offset=zero,
+                             attn_mask=m._mask(8))
+        a, b = np.asarray(lr.numpy()), np.asarray(lt.numpy())
+        assert (a.argmax(-1) == b.argmax(-1)).all(), \
+            "sharded prefill diverged from single-chip argmax"
+        np.testing.assert_allclose(a, b, atol=1e-4)
+        for li in range(cfg.num_layers):
+            np.testing.assert_allclose(
+                np.asarray(cr[li].k.numpy()), np.asarray(ct[li].k.numpy()),
+                atol=1e-4, err_msg="gathered K columns diverged")
+
+        S, lc = 2, 8
+        H, Dh = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        kv = (rng.randn(cfg.num_layers, 2, S, H, lc, Dh) * 0.1
+              ).astype(np.float32)
+
+        def cache():
+            return [MultiHeadAttention.Cache(
+                paddle_tpu.to_tensor(kv[li, 0].copy()),
+                paddle_tpu.to_tensor(kv[li, 1].copy()))
+                for li in range(cfg.num_layers)]
+
+        ids2 = rng.randint(0, 48, (S, 1)).astype(np.int64)
+        pos2 = np.full((S,), lc, np.int64)
+        mask = np.zeros((S, 1, 1, lc + 1), np.float32)
+        lr, _ = m.forward(paddle_tpu.to_tensor(ids2), cache=cache(),
+                          pos_offset=pos2,
+                          attn_mask=paddle_tpu.to_tensor(mask))
+        lt, _ = dec.forward(paddle_tpu.to_tensor(ids2), cache=cache(),
+                            pos_offset=pos2,
+                            attn_mask=paddle_tpu.to_tensor(mask))
+        a, b = np.asarray(lr.numpy()), np.asarray(lt.numpy())
+        assert (a.argmax(-1) == b.argmax(-1)).all(), \
+            "sharded decode diverged from single-chip argmax"
+
+        # -- warmed buckets must not retrace ---------------------------
+        s0 = compile_cache.cache_stats()
+        dec.forward(paddle_tpu.to_tensor(ids2), cache=cache(),
+                    pos_offset=pos2,
+                    attn_mask=paddle_tpu.to_tensor(mask))
+        dec.forward(paddle_tpu.to_tensor(ids), cache=m.gen_cache(1),
+                    pos_offset=zero, attn_mask=m._mask(8))
+        s1 = compile_cache.cache_stats()
+        retraces = s1["traces"] - s0["traces"]
+        assert retraces == 0, \
+            f"warmed decode buckets retraced {retraces} time(s)"
+
+    return {
+        "metric": "tp_serve_smoke_wall_s",
+        "value": round(time.time() - t0, 2),
+        "pages_tp1": plan1["pages"],
+        "pages_tp2": plan2["pages"],
+        "page_capacity_ratio": round(plan2["pages"] /
+                                     max(1, plan1["pages"]), 2),
+        "buckets_compiled": dec.buckets_compiled,
+        "traces_after_warmup": retraces,
+        "token_equal": True,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_smoke()))
