@@ -22,6 +22,11 @@ Four pieces:
   decoding: ``stamp_draft`` builds the small sibling, the engine
   verifies k proposals per batched step and rolls rejections back via
   page-table truncation.
+* int8 decode (int8_decode.py + ``PagedKVPool(kv_dtype="int8")``) —
+  weight-only quantized decode matmuls (``Int8Linear`` /
+  ``quantize_decode_model`` for tp=1, ``slim.freeze_weights_int8``
+  stamped inside ``TPShardedDecoder`` for tp>1) over int8 KV pages
+  with fp32 scale sidecars, carving ~2x the pages at equal HBM.
 * metrics (metrics.py) — the ``serving.*`` counter/gauge/histogram
   namespace over core/monitor, dumped by ``/stats``.
 
@@ -39,6 +44,7 @@ from .kv_pool import (  # noqa: F401
 )
 from .prefix_cache import RadixPrefixCache  # noqa: F401
 from .tp_decode import TPShardedDecoder, build_decode_program  # noqa: F401
+from .int8_decode import Int8Linear, quantize_decode_model  # noqa: F401
 from .speculative import (  # noqa: F401
     SpeculativeDecoder, stamp_draft, longest_accepted,
 )
@@ -50,6 +56,7 @@ __all__ = [
     "ContinuousBatchingEngine", "GenerationRequest",
     "PagedKVPool", "PageTable", "PagePoolExhaustedError", "budget_drift",
     "RadixPrefixCache", "TPShardedDecoder", "build_decode_program",
+    "Int8Linear", "quantize_decode_model",
     "SpeculativeDecoder", "stamp_draft",
     "longest_accepted", "serving_stats", "reset_serving_stats",
 ]

@@ -145,7 +145,8 @@ class ContinuousBatchingEngine:
                  max_queue: int = 64, default_timeout_s: float = 120.0,
                  kv_bucket_floor: int = 16, kv_pool=None,
                  prefix_cache=None, speculative=None,
-                 tp_degree: Optional[int] = None):
+                 tp_degree: Optional[int] = None,
+                 weight_dtype: Optional[str] = None):
         # tp-sharded decode: resolve the degree (explicit arg wins, else
         # a planner plan / ready pool carries it), then wrap the model's
         # forward in the mesh-dispatching backend.  TPShardedDecoder has
@@ -158,10 +159,34 @@ class ContinuousBatchingEngine:
             else:
                 tp_degree = 1
         self.tp_degree = max(1, int(tp_degree))
+        # int8 decode matmuls: resolved exactly like tp_degree — the
+        # explicit arg wins, else the pool's recorded plan carries it
+        if weight_dtype is None:
+            if isinstance(kv_pool, PagedKVPool):
+                weight_dtype = (kv_pool.plan or {}).get(
+                    "weight_dtype", "float32")
+            elif isinstance(kv_pool, dict):
+                weight_dtype = kv_pool.get("weight_dtype", "float32")
+            else:
+                weight_dtype = "float32"
+        self.weight_dtype = str(weight_dtype)
+        if self.weight_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"weight_dtype must be float32 or int8, got "
+                f"{weight_dtype!r}")
+        # the float model is the sizing authority: page_budget's weight
+        # walk must see the fp32 parameters, not the quantized sibling's
+        float_model = getattr(model, "gpt", model)
         if self.tp_degree > 1:
             from .tp_decode import TPShardedDecoder
             if not isinstance(model, TPShardedDecoder):
-                model = TPShardedDecoder(model, self.tp_degree)
+                model = TPShardedDecoder(model, self.tp_degree,
+                                         weight_dtype=self.weight_dtype)
+        elif self.weight_dtype == "int8":
+            from .tp_decode import TPShardedDecoder
+            if not isinstance(model, TPShardedDecoder):
+                from .int8_decode import quantize_decode_model
+                model = quantize_decode_model(model)
         self._model = getattr(model, "gpt", model)
         self.config = self._model.config
         self._pool: Optional[PagedKVPool] = None
@@ -169,7 +194,8 @@ class ContinuousBatchingEngine:
             if kv_pool == "auto":
                 from ..static.planner import page_budget
                 self._pool = PagedKVPool.from_plan(
-                    page_budget(self._model, tp_degree=self.tp_degree))
+                    page_budget(float_model, tp_degree=self.tp_degree,
+                                weight_dtype=self.weight_dtype))
             elif isinstance(kv_pool, PagedKVPool):
                 self._pool = kv_pool
             elif isinstance(kv_pool, dict):
@@ -196,6 +222,14 @@ class ContinuousBatchingEngine:
                     f"but the pool plan was sized for "
                     f"tp={self._pool.tp_degree} — per-chip page budgets "
                     "would not match the sharded slabs")
+            plan_wd = str((self._pool.plan or {}).get(
+                "weight_dtype", self.weight_dtype))
+            if plan_wd != self.weight_dtype:
+                raise ValueError(
+                    f"weight_dtype mismatch: engine serves "
+                    f"{self.weight_dtype} weights but the pool plan "
+                    f"budgeted for {plan_wd} — the weight-byte carve "
+                    "would not match what is resident")
         plan = self._pool.plan if self._pool is not None else None
         if max_slots is None:
             max_slots = int(plan["max_slots"]) if plan else 4

@@ -1,0 +1,95 @@
+"""Dygraph int8 decode: weight-only quantized Linear + model builder.
+
+The single-chip (tp=1) half of the int8 serving path.  The tp-sharded
+engine stamps ``build_decode_program``'s matmuls into ``int8_matmul``
+statically (``slim.freeze_weights_int8`` inside ``TPShardedDecoder``);
+this module gives the dygraph ``GPTModel`` forward the SAME treatment
+so both engine shapes serve the identical numerics: ``Int8Linear``
+dispatches the same ``int8_matmul`` kernel eagerly, against weights
+quantized through the same ``fake_channel_wise_quantize_abs_max``
+grid (per-out-channel, quant_axis=1) — one source of truth for
+scale/round/clip on every path.
+
+``quantize_decode_model`` builds a quantized SIBLING: a fresh
+``GPTModel`` from the same config + state_dict with every
+q/k/v/out-proj and fc1/fc2 ``Linear`` swapped for ``Int8Linear``.
+The float original is untouched — it stays the A/B baseline the
+token-equality contract compares against.  Embeddings, LayerNorms,
+biases and the tied-embedding logits matmul stay fp32, mirroring the
+static stamp's structural exclusions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph.layers import Layer
+
+__all__ = ["Int8Linear", "quantize_decode_model"]
+
+_MAX_RANGE = 127.0
+
+
+def _quantize_weight(w: np.ndarray):
+    """Per-out-channel int8 quantization through the registered kernel —
+    bit-identical to the static stamp's grid."""
+    import jax.numpy as jnp
+    from ..ops.registry import run_kernel, OpContext
+    r = run_kernel("fake_channel_wise_quantize_abs_max",
+                   {"X": jnp.asarray(np.asarray(w, np.float32))},
+                   {"bit_length": 8, "quant_axis": 1}, OpContext())
+    return (np.asarray(r["Out"]).astype(np.int8),
+            np.asarray(r["OutScale"], np.float32))
+
+
+class Int8Linear(Layer):
+    """Weight-only int8 drop-in for a float ``nn.Linear``: int8 weight
+    + per-out-channel fp32 scale buffers, forward through the
+    ``int8_matmul`` kernel (dynamic per-tensor activation quant, int32
+    MXU accumulation, fused bias)."""
+
+    def __init__(self, linear):
+        super().__init__()
+        import paddle_tpu
+        w = np.asarray(linear.weight.numpy(), np.float32)
+        if w.ndim != 2:
+            raise ValueError(
+                f"Int8Linear needs a 2-D weight, got {w.shape}")
+        q, scale = _quantize_weight(w)
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        self.register_buffer("weight_int8", paddle_tpu.to_tensor(q))
+        self.register_buffer("weight_scale", paddle_tpu.to_tensor(scale))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..tensor._dispatch import dispatch
+        ins = {"X": x, "W": self.weight_int8,
+               "WScale": self.weight_scale}
+        if self.bias is not None:
+            ins["Bias"] = self.bias
+        return dispatch("int8_matmul", ins, {"max_range": _MAX_RANGE})
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8")
+
+
+def quantize_decode_model(model):
+    """Return an int8 weight-only SIBLING of a dygraph GPT decode model.
+
+    A fresh ``GPTModel`` is built from ``model.config`` and loaded with
+    ``model``'s state_dict, then every block's q/k/v/out-proj and
+    fc1/fc2 ``Linear`` is swapped for an ``Int8Linear`` quantizing that
+    weight.  Returns the sibling in eval mode; the input model (and its
+    parameters) are untouched."""
+    from ..models.gpt import GPTModel
+    inner = getattr(model, "gpt", model)
+    clone = GPTModel(inner.config)
+    clone.set_state_dict(inner.state_dict())
+    clone.eval()
+    for blk in clone.blocks:
+        for holder, name in ((blk.attn, "q_proj"), (blk.attn, "k_proj"),
+                             (blk.attn, "v_proj"), (blk.attn, "out_proj"),
+                             (blk, "fc1"), (blk, "fc2")):
+            setattr(holder, name, Int8Linear(getattr(holder, name)))
+    return clone

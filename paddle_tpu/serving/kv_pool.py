@@ -47,6 +47,21 @@ Sizing belongs to the planner: build the pool from
 HBM-walker sizing path) via ``PagedKVPool.from_plan``; the plan is
 recorded on the pool and ``budget_drift`` re-derives it so hand-edited
 pool geometry is detectable, V504-style.
+
+int8 pages (``kv_dtype="int8"``): the slabs store K/V as int8 with a
+per-(layer, page, head) fp32 DEQUANT SCALE in a sidecar array
+(``x ≈ q * scale``, scale = absmax/127).  Quantization happens on
+write and dequantization inside ``gather``, so everything above the
+slab — page tables, COW sharing, radix ``adopt_prefix``, speculative
+``truncate`` — rides unchanged as page-id plumbing.  The write policy
+is REQUANTIZE-ON-GROW: a column whose absmax exceeds the page's
+current scale requantizes the resident columns under the grown scale
+(ratio ≤ 1, magnitudes only shrink) before the new column lands, so a
+page's columns always share one scale and saturation is structurally
+impossible; ``quant_scale_clips`` counts any defensive clamp anyway.
+``page_bytes`` prices the int8 itemsize plus the scale sidecar, which
+is what lets ``static.page_budget(kv_dtype="int8")`` carve ~2× the
+pages at equal HBM.
 """
 from __future__ import annotations
 
@@ -100,7 +115,8 @@ class PagedKVPool:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  page_tokens: int = 16, num_pages: int = 64,
-                 dtype=np.float32, plan: Optional[Dict] = None):
+                 dtype=np.float32, plan: Optional[Dict] = None,
+                 kv_dtype=None):
         if page_tokens < 1 or num_pages < 1:
             raise ValueError(
                 f"need positive page_tokens/num_pages, got "
@@ -110,7 +126,9 @@ class PagedKVPool:
         self.head_dim = int(head_dim)
         self.page_tokens = int(page_tokens)
         self.num_pages = int(num_pages)
-        self.dtype = np.dtype(dtype)
+        # kv_dtype is the planner-facing name for the same knob
+        self.dtype = np.dtype(kv_dtype if kv_dtype is not None else dtype)
+        self.is_quantized = self.dtype == np.int8
         # ONE slab per tensor, allocated up front: page id p is
         # self.k[:, p] across every layer (no per-sequence allocation
         # ever happens again)
@@ -118,6 +136,12 @@ class PagedKVPool:
                  self.page_tokens, self.head_dim)
         self.k = np.zeros(shape, self.dtype)
         self.v = np.zeros(shape, self.dtype)
+        if self.is_quantized:
+            # per-(layer, page, head) fp32 dequant scale: x ≈ q * scale
+            sshape = (self.num_layers, self.num_pages, self.num_heads)
+            self.k_scale = np.zeros(sshape, np.float32)
+            self.v_scale = np.zeros(sshape, np.float32)
+        self.quant_scale_clips = 0
         self._refcount = np.zeros(self.num_pages, np.int32)
         self._free: List[int] = list(range(self.num_pages - 1, -1, -1))
         self._reserved_unallocated = 0
@@ -153,9 +177,14 @@ class PagedKVPool:
     # -- geometry -----------------------------------------------------------
     @property
     def page_bytes(self) -> int:
-        """Bytes one page occupies across both tensors and all layers."""
-        return 2 * self.num_layers * self.num_heads * self.page_tokens \
+        """Bytes one page occupies across both tensors and all layers —
+        for int8 pages that is the int8 data plus the per-(layer, head)
+        fp32 scale sidecar rows for both K and V."""
+        data = 2 * self.num_layers * self.num_heads * self.page_tokens \
             * self.head_dim * self.dtype.itemsize
+        if self.is_quantized:
+            data += 2 * self.num_layers * self.num_heads * 4
+        return data
 
     @property
     def tp_degree(self) -> int:
@@ -363,6 +392,75 @@ class PagedKVPool:
             table.length = n
         self._publish()
 
+    # -- int8 page quantization ---------------------------------------------
+    def _quantize_into(self, slab, scale_arr, pid: int, col_slice,
+                       x: np.ndarray, s: np.ndarray):
+        """Quantize fp ``x`` [L, H, n, Dh] under per-(L, H) scale ``s``
+        and store into page ``pid`` at ``col_slice``.  The scale always
+        covers the chunk's absmax (fresh-write or requantize-on-grow
+        policy), so the clamp is defensive; any element it actually
+        saturates is counted in ``quant_scale_clips``."""
+        q = np.rint(np.divide(
+            np.asarray(x, np.float32), s[:, :, None, None],
+            out=np.zeros(x.shape, np.float32),
+            where=s[:, :, None, None] > 0))
+        clips = int(np.count_nonzero(np.abs(q) > 127))
+        if clips:
+            self.quant_scale_clips += clips
+            metrics.count("kv.quant_scale_clips", clips)
+            np.clip(q, -127, 127, out=q)
+        slab[:, pid, :, col_slice] = q.astype(np.int8)
+
+    def _store_page_chunk(self, pid: int, ncols: int,
+                          k_chunk: np.ndarray, v_chunk: np.ndarray):
+        """Install columns [0, ncols) of a FRESHLY allocated page (the
+        prefill write).  fp pools store verbatim; int8 pools derive the
+        page scale from the chunk's per-(layer, head) absmax."""
+        if not self.is_quantized:
+            self.k[:, pid, :, :ncols] = k_chunk
+            self.v[:, pid, :, :ncols] = v_chunk
+            return
+        for slab, scale_arr, x in ((self.k, self.k_scale, k_chunk),
+                                   (self.v, self.v_scale, v_chunk)):
+            x = np.asarray(x, np.float32)
+            s = np.max(np.abs(x), axis=(2, 3)) / 127.0
+            scale_arr[:, pid] = s
+            self._quantize_into(slab, scale_arr, pid, slice(0, ncols),
+                                x, s)
+
+    def _store_column(self, pid: int, off: int, k_col: np.ndarray,
+                      v_col: np.ndarray):
+        """Write one decode column at ``off`` into an EXCLUSIVE page.
+        int8 pools requantize-on-grow: if the column's absmax exceeds
+        the page's current scale, the resident columns are requantized
+        under the grown scale first (ratio old/new ≤ 1 — magnitudes
+        only shrink, so the rewrite itself can never clip)."""
+        if not self.is_quantized:
+            self.k[:, pid, :, off] = k_col
+            self.v[:, pid, :, off] = v_col
+            return
+        for slab, scale_arr, col in ((self.k, self.k_scale, k_col),
+                                     (self.v, self.v_scale, v_col)):
+            x = np.asarray(col, np.float32)
+            need = np.max(np.abs(x), axis=2) / 127.0   # [L, H]
+            cur = scale_arr[:, pid]
+            grow = need > cur
+            if np.any(grow):
+                new = np.where(grow, need, cur)
+                if off:
+                    ratio = np.divide(cur, new,
+                                      out=np.ones_like(cur),
+                                      where=new > 0)
+                    resident = slab[:, pid, :, :off].astype(np.float32)
+                    slab[:, pid, :, :off] = np.rint(
+                        resident * ratio[:, :, None, None]
+                    ).astype(np.int8)
+                scale_arr[:, pid] = new
+                cur = new
+            self._quantize_into(slab, scale_arr, pid,
+                                slice(off, off + 1),
+                                x[:, :, None, :], cur)
+
     # -- sequence lifecycle -------------------------------------------------
     def open_sequence(self, prompt: np.ndarray, k_prompt: np.ndarray,
                       v_prompt: np.ndarray,
@@ -412,10 +510,10 @@ class PagedKVPool:
                     metrics.count("kv.prefix_hits")
                 else:
                     pid = self._alloc(table)
-                    self.k[:, pid, :, : b - a] = k_prompt[:, :, a - start:
-                                                          b - start]
-                    self.v[:, pid, :, : b - a] = v_prompt[:, :, a - start:
-                                                          b - start]
+                    self._store_page_chunk(
+                        pid, b - a,
+                        k_prompt[:, :, a - start: b - start],
+                        v_prompt[:, :, a - start: b - start])
                     self._prefix[key] = pid
                     self._page_key[pid] = key
                 table.pages.append(pid)
@@ -444,13 +542,15 @@ class PagedKVPool:
                 new = self._alloc(table)
                 self.k[:, new] = self.k[:, pid]
                 self.v[:, new] = self.v[:, pid]
+                if self.is_quantized:
+                    self.k_scale[:, new] = self.k_scale[:, pid]
+                    self.v_scale[:, new] = self.v_scale[:, pid]
                 self._decref(pid)
                 table.pages[j] = new
                 pid = new
                 self.cow_copies += 1
                 metrics.count("kv.cow_copies")
-            self.k[:, pid, :, off] = k_col
-            self.v[:, pid, :, off] = v_col
+            self._store_column(pid, off, k_col, v_col)
             table.length = pos + 1
         self._publish()
 
@@ -461,13 +561,23 @@ class PagedKVPool:
         shapes never see page structure)."""
         L, H, T, D = (self.num_layers, self.num_heads, self.page_tokens,
                       self.head_dim)
+        out_dtype = np.float32 if self.is_quantized else self.dtype
         if not table.pages:
-            return (np.zeros((L, H, 0, D), self.dtype),
-                    np.zeros((L, H, 0, D), self.dtype))
+            return (np.zeros((L, H, 0, D), out_dtype),
+                    np.zeros((L, H, 0, D), out_dtype))
         idx = np.asarray(table.pages, np.int64)
         n = idx.size
-        k = self.k[:, idx].transpose(0, 2, 1, 3, 4).reshape(L, H, n * T, D)
-        v = self.v[:, idx].transpose(0, 2, 1, 3, 4).reshape(L, H, n * T, D)
+        k = self.k[:, idx]
+        v = self.v[:, idx]
+        if self.is_quantized:
+            # dequantize through the per-(layer, page, head) sidecar:
+            # x = q * scale, broadcast over the token and Dh dims
+            k = k.astype(np.float32) \
+                * self.k_scale[:, idx][:, :, :, None, None]
+            v = v.astype(np.float32) \
+                * self.v_scale[:, idx][:, :, :, None, None]
+        k = k.transpose(0, 2, 1, 3, 4).reshape(L, H, n * T, D)
+        v = v.transpose(0, 2, 1, 3, 4).reshape(L, H, n * T, D)
         return k[:, :, : table.length], v[:, :, : table.length]
 
     def close_sequence(self, table: PageTable):
@@ -501,6 +611,8 @@ class PagedKVPool:
                 "page_bytes_per_chip": self.page_bytes_per_chip,
                 "prefix_hits": self.prefix_hits,
                 "cow_copies": self.cow_copies,
+                "kv_dtype": self.dtype.name,
+                "quant_scale_clips": self.quant_scale_clips,
                 "occupancy": round(1.0 - free / self.num_pages, 4),
             }
 
@@ -512,6 +624,11 @@ class PagedKVPool:
         metrics.gauge("kv.pages_shared", self.pages_shared)
         metrics.gauge("kv.pages_reserved", self._reserved_unallocated)
         metrics.gauge("kv.retained_pages", self.pages_retained)
+        # dtype as a numeric gauge (Prometheus has no string series):
+        # 1 = int8 pages, 0 = fp pages; the clip counter rides beside
+        # it so a saturating pool is visible even before /stats is read
+        metrics.gauge("kv.kv_dtype_int8", 1 if self.is_quantized else 0)
+        metrics.gauge("kv.quant_scale_clips", self.quant_scale_clips)
 
     def assert_drained(self):
         """Post-drain leak check: every page free OR retained-by-radix
@@ -554,13 +671,24 @@ def budget_drift(pool: PagedKVPool, model=None) -> List[str]:
         max_context=int(plan.get("max_context_requested",
                                  plan["max_context"])),
         hbm_bytes=int(plan["hbm_bytes"]),
-        weight_bytes=(int(plan["weight_bytes"])
+        # weight_bytes_fp32 is the RAW parameter-byte input; feeding the
+        # int8-adjusted resident bytes back would re-quantize them
+        weight_bytes=(int(plan.get("weight_bytes_fp32",
+                                   plan["weight_bytes"]))
                       if model is None else None),
         max_slots_cap=int(plan.get("max_slots_cap", 0)) or None,
         headroom=float(plan.get("headroom", 0.08)),
         draft_layers=int(plan.get("draft_layers", 0)),
-        tp_degree=int(plan.get("tp_degree", 1)))
+        tp_degree=int(plan.get("tp_degree", 1)),
+        kv_dtype=str(plan.get("kv_dtype", "float32")),
+        weight_dtype=str(plan.get("weight_dtype", "float32")))
     drift = []
+    want_dtype = np.dtype(str(plan.get("kv_dtype", "float32")))
+    if pool.dtype != want_dtype:
+        drift.append(
+            f"kv_dtype: pool stores {pool.dtype.name}, plan records "
+            f"{want_dtype.name} — the carve assumed "
+            f"{want_dtype.itemsize}-byte pages")
     for key, live in (("pages", pool.num_pages),
                       ("page_tokens", pool.page_tokens),
                       ("num_layers", pool.num_layers),

@@ -256,7 +256,8 @@ class TPShardedDecoder:
     survive that unwrap.
     """
 
-    def __init__(self, model, tp_degree: int, places=None):
+    def __init__(self, model, tp_degree: int, places=None,
+                 weight_dtype: str = "float32"):
         inner = getattr(model, "gpt", model)
         # decode must be deterministic (dropout off) for the
         # token-equality contract with the single-chip path
@@ -271,6 +272,11 @@ class TPShardedDecoder:
                 f"num_heads={self.config.num_heads} must divide by "
                 f"tp_degree={tp}")
         self.tp_degree = tp
+        self.weight_dtype = str(weight_dtype)
+        if self.weight_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"weight_dtype must be float32 or int8, got "
+                f"{weight_dtype!r}")
         self._places = places
         from ..static.executor import Executor, Scope
         self._scope = Scope()
@@ -315,6 +321,15 @@ class TPShardedDecoder:
                                                         BuildStrategy)
             prog, feeds, fetches = build_decode_program(
                 self.config, B, lc, W, self.tp_degree)
+            if self.weight_dtype == "int8":
+                # weight-only stamp: q/k/v/out-proj/fc matmuls become
+                # int8_matmul over GLOBALLY-quantized per-out-channel
+                # weights; deterministic ".int8"/".deq_scale" names
+                # mean every bucket shares one quantized scope copy
+                # (the tied-embedding logits matmul stays fp32 — its
+                # transpose_y excludes it structurally)
+                from ..slim.quantization import freeze_weights_int8
+                freeze_weights_int8(prog, self._scope)
             bs = BuildStrategy()
             bs.tensor_parallel_degree = self.tp_degree
             compiled = CompiledProgram(prog, build_strategy=bs)

@@ -29,7 +29,8 @@ import numpy as np
 from ..core.program import Program, OpDesc, OpRole, unique_name
 
 __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
-           "PostTrainingQuantization", "QUANTIZABLE_OPS"]
+           "PostTrainingQuantization", "QUANTIZABLE_OPS",
+           "freeze_weights_int8"]
 
 # reference QuantizationTransformPass._supported_quantizable_op_type
 QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul", "fc")
@@ -249,6 +250,124 @@ class QuantizationFreezePass:
         block.ops = new_ops
         program._fingerprint_cache = None
         return program
+
+
+def freeze_weights_int8(program, scope, predicate=None,
+                        weight_bits: int = 8) -> int:
+    """Weight-only int8 freeze for an INFERENCE program (the serving
+    decode stamp): rewrite every ``mul``/``matmul``/``matmul_v2`` whose
+    weight operand is a 2-D persistable contracting its input's LAST
+    dim into a single ``int8_matmul`` — int8 weights + per-out-channel
+    fp32 scales in the scope, int32 MXU accumulation, activations
+    quantized dynamically per-tensor inside the kernel.  Returns the
+    number of matmuls rewritten.
+
+    Unlike ``QuantizationFreezePass`` (QAT freeze: fake-quant ops
+    already mark the weights), this walks a FLOAT program and uses
+    DETERMINISTIC names (``w + ".int8"`` / ``w + ".deq_scale"``): the
+    decode path stamps one program per (batch, cache_len, width)
+    bucket against one shared scope, so every bucket must resolve to
+    the same quantized copy — and ``state_partition_specs`` keys must
+    stay stable across buckets.
+
+    tp-sharded programs stay shard-consistent: the weight is quantized
+    GLOBALLY per out-channel, the int8 var inherits the fp32 weight's
+    ``dist_attr``, and the scale shards with the out dim when the
+    weight is column-parallel (dim 1) or stays replicated when it is
+    row-parallel (dim 0) — row shards share the global channel scale,
+    so per-chip dequantized partials sum to exactly the global
+    dequantized product.
+
+    ``predicate(op, weight_name)`` narrows the rewrite set (the decode
+    stamp skips nothing by default; the tied-embedding logits matmul is
+    excluded structurally by its ``transpose_y``)."""
+    from ..ops.registry import run_kernel, OpContext
+    block = program.global_block()
+    b = float((1 << (int(weight_bits) - 1)) - 1)
+    new_ops: List[OpDesc] = []
+    n_rewritten = 0
+    for op in block.ops:
+        wslot = None
+        if op.type == "mul":
+            wslot = "Y"
+            ok = int(op.attrs.get("y_num_col_dims", 1)) == 1
+        elif op.type in ("matmul", "matmul_v2"):
+            wslot = "Y"
+            tx = any(op.attrs.get(k, False) for k in
+                     ("transpose_X", "transpose_x", "trans_x"))
+            ty = any(op.attrs.get(k, False) for k in
+                     ("transpose_Y", "transpose_y", "trans_y"))
+            ok = not tx and not ty \
+                and float(op.attrs.get("alpha", 1.0)) == 1.0
+        else:
+            new_ops.append(op)
+            continue
+        wname = (op.inputs.get(wslot) or [None])[0]
+        xname = (op.inputs.get("X") or [None])[0]
+        if not (ok and wname and xname and _is_param(block, wname)):
+            new_ops.append(op)
+            continue
+        wvar = block.var(wname)
+        if wvar.shape is None or len(wvar.shape) != 2:
+            new_ops.append(op)
+            continue
+        xvar = block.var(xname) if block.has_var(xname) else None
+        xshape = getattr(xvar, "shape", None)
+        if op.type == "mul":
+            # int8_matmul contracts the LAST dim; mul flattens X[m:] —
+            # equivalent only when that tail is a single dim
+            m = int(op.attrs.get("x_num_col_dims", 1))
+            if xshape is None or m != len(xshape) - 1:
+                new_ops.append(op)
+                continue
+        if predicate is not None and not predicate(op, wname):
+            new_ops.append(op)
+            continue
+        iname = wname + ".int8"
+        sname = wname + ".deq_scale"
+        if scope.get(iname) is None:
+            import jax.numpy as jnp
+            w = scope.get(wname)
+            if w is None:
+                new_ops.append(op)
+                continue
+            r = run_kernel("fake_channel_wise_quantize_abs_max",
+                           {"X": jnp.asarray(np.asarray(w, np.float32))},
+                           {"bit_length": int(weight_bits),
+                            "quant_axis": 1}, OpContext())
+            scope.set(iname, np.asarray(r["Out"]).astype(np.int8))
+            scope.set(sname, np.asarray(r["OutScale"], np.float32))
+        if not block.has_var(iname):
+            iv = block.create_var(name=iname, shape=list(wvar.shape),
+                                  dtype="int8", persistable=True,
+                                  stop_gradient=True)
+            sv = block.create_var(name=sname, shape=[wvar.shape[1]],
+                                  dtype="float32", persistable=True,
+                                  stop_gradient=True)
+            dist = wvar.attrs.get("dist_attr")
+            if dist is not None:
+                iv.attrs["dist_attr"] = list(dist)
+                if int(dist[1]) == 1:
+                    # column-parallel: out-channels shard, and the
+                    # per-channel scales shard with them (dim 0 of [N])
+                    sv.attrs["dist_attr"] = [dist[0], 0]
+        attrs = {"max_range": b, OpRole.KEY: OpRole.Forward,
+                 "op_uid": block.program._next_uid()}
+        for key in ("mp_axis", "tp_degree"):
+            if key in op.attrs:
+                attrs[key] = op.attrs[key]
+        new_ops.append(OpDesc(
+            "int8_matmul",
+            {"X": [xname], "W": [iname], "WScale": [sname]},
+            {"Out": op.outputs["Out"]}, attrs))
+        # the fp32 weight leaves the PROGRAM (its persistable set — and
+        # the per-chip state the partition engine ships — shrinks 4x);
+        # the scope keeps the float value for programs sharing it
+        block.vars.pop(wname, None)
+        n_rewritten += 1
+    block.ops = new_ops
+    program._fingerprint_cache = None
+    return n_rewritten
 
 
 class PostTrainingQuantization:

@@ -52,12 +52,19 @@ from typing import Dict, List, Optional, Tuple
 from ..core.program import Program
 
 __all__ = ["analyze_flops", "estimate_step_flops", "peak_flops_per_chip",
+           "INT8_MXU_RATE",
            "PEAK_FLOPS_ENV", "DEFAULT_TPU_PEAK_FLOPS"]
 
 PEAK_FLOPS_ENV = "PADDLE_TPU_PEAK_FLOPS"
 
 # v5e bf16 MXU peak — the chip the north star is denominated in
 DEFAULT_TPU_PEAK_FLOPS = 197e12
+
+# int8 MXU rate multiplier over the bf16 peak: the v5e runs int8
+# matmuls at 394 vs 197 TOPS (tools/bench_int8.py validates the 2x
+# through preferred_element_type=int32) — the calibrated roofline
+# divides int8_flops by INT8_MXU_RATE*peak instead of peak
+INT8_MXU_RATE = 2.0
 
 
 def peak_flops_per_chip(platform: Optional[str] = None) -> float:
@@ -183,7 +190,15 @@ def _matmul_flops(op, shaper, base: str) -> int:
         k = _prod(sx[a:])
         n = _prod(sy[b:])
         return 2 * m * k * n
-    # matmul / matmul_v2 / bmm / int8_matmul: batched [..., m, k]x[..., k, n]
+    if base == "int8_matmul":
+        # weight-only int8: X [..., K] contracts its last dim against
+        # the int8 W [K, N] slot (there is no Y)
+        sx = shaper(_first(op, "X"))
+        sw = shaper(_first(op, "W"))
+        if sx is None or sw is None or len(sw) < 2 or not sx:
+            return 0
+        return 2 * _prod(sx[:-1]) * sx[-1] * sw[-1]
+    # matmul / matmul_v2 / bmm: batched [..., m, k] x [..., k, n]
     sx = shaper(_first(op, "X"))
     sy = shaper(_first(op, "Y"))
     if sx is None or sy is None or len(sx) < 2 or len(sy) < 2:
@@ -344,6 +359,7 @@ def analyze_flops(program: Program, batch: Optional[int] = None) -> Dict:
     by_class: Dict[str, int] = {}
     phase_flops = {"forward": 0, "backward": 0, "optimize": 0}
     total = 0
+    int8 = 0
     for i, op in enumerate(block.ops):
         if op.type in ("feed", "fetch"):
             continue
@@ -355,11 +371,16 @@ def analyze_flops(program: Program, batch: Optional[int] = None) -> Dict:
             by_class[cls] = by_class.get(cls, 0) + f
             phase_flops[phase] += f
             total += f
+            if op.type == "int8_matmul":
+                int8 += f
     matmul_like = (by_class.get("matmul", 0) + by_class.get("attention", 0)
                    + by_class.get("conv", 0))
     return {
         "batch": int(shaper.batch),
         "total_flops": int(total),
+        # the slice running at the int8 MXU rate (INT8_MXU_RATE x peak);
+        # roofline compute time = (total - int8)/peak + int8/(rate*peak)
+        "int8_flops": int(int8),
         "phase_flops": {k: int(v) for k, v in phase_flops.items()},
         "by_class": {k: int(v) for k, v in sorted(by_class.items())},
         "per_op": per_op,

@@ -433,6 +433,8 @@ class _Engine:
             return self._ew_join(op, op_idx, op.inputs.get("X", []))
         if t == "mul":
             return self._mul(op, op_idx)
+        if t == "int8_matmul":
+            return self._int8_matmul(op, op_idx)
         if t == "matmul":
             return self._matmul(op, op_idx)
         if t in ("reshape", "reshape2"):
@@ -587,6 +589,44 @@ class _Engine:
         out = _first(op.outputs.get("Out", []))
         m = int(op.attrs.get("x_num_col_dims", 1))
         k = int(op.attrs.get("y_num_col_dims", 1))
+        self._mul_like(op, op_idx, x, y, out, m, k)
+
+    def _int8_matmul(self, op: OpDesc, op_idx: int):
+        """Weight-only int8 matmul (the serving decode stamp): X
+        contracts its LAST dim against W [K, N] — `mul` semantics with
+        m = rank(X) - 1, k = 1, so the Megatron col/row contracts carry
+        over unchanged.  WScale is per-out-channel: it must shard with
+        W's out dim (column-parallel) or stay replicated
+        (row-parallel); anything else rescales one chip's channels
+        with another's scales."""
+        x = _first(op.inputs.get("X", []))
+        w = _first(op.inputs.get("W", []))
+        out = _first(op.outputs.get("Out", []))
+        s = _first(op.inputs.get("WScale", []))
+        ws = self.get(w)
+        a_col = next((a for j, a in enumerate(ws.spec)
+                      if a in MODEL_AXES and j >= 1), None)
+        if s is not None:
+            ss = self._consume(op, op_idx, s)
+            if ss.axis_at(0) != a_col and s not in self.tainted \
+                    and w not in self.tainted:
+                self.diag(
+                    "V601",
+                    f"int8_matmul scale {s!r} is laid out "
+                    f"{ss.render()} but weight {w!r}'s out-channels "
+                    f"are {'sharded over ' + repr(a_col) if a_col else 'replicated'}"
+                    f" — per-channel dequant would apply the wrong "
+                    f"chip's scales", op=op, op_idx=op_idx, var=s)
+        for n in op.inputs.get("Bias", []):
+            self._consume(op, op_idx, n)
+        x_shape = _shape_of(self.block, x)
+        xs = self.get(x)
+        rank = len(x_shape) if x_shape is not None \
+            else max(len(xs.spec), 2)
+        self._mul_like(op, op_idx, x, w, out, rank - 1, 1)
+
+    def _mul_like(self, op: OpDesc, op_idx: int, x, y, out,
+                  m: int, k: int):
         xs = self._consume(op, op_idx, x)
         ys = self._consume(op, op_idx, y)
 

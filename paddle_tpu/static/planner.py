@@ -1275,11 +1275,27 @@ def _decode_shardable_bytes(cfg: Dict) -> int:
     return cfg["num_layers"] * per_block * 4
 
 
+def _decode_quantizable_counts(cfg: Dict):
+    """Matrix elements and out-channels of the decode matmuls the int8
+    weight stamp rewrites — q/k/v/out projections, fc1, fc2.  Biases,
+    layer norms, embeddings and the tied logits matmul stay fp32.
+    Out-channels split by shard class: col-parallel scales (q/k/v, fc1)
+    shard with the out dim, row-parallel scales (out-proj, fc2) cover
+    the full out dim on every chip."""
+    hd, inter = cfg["hidden_size"], cfg["intermediate_size"]
+    L = cfg["num_layers"]
+    elems = L * (4 * hd * hd + 2 * hd * inter)
+    col_channels = L * (3 * hd + inter)
+    row_channels = L * (2 * hd)
+    return elems, col_channels, row_channels
+
+
 def page_budget(model=None, config=None, *, page_tokens: int = 16,
                 max_context: Optional[int] = None,
                 hbm_bytes: Optional[int] = None,
                 weight_bytes: Optional[int] = None,
                 kv_dtype: str = "float32",
+                weight_dtype: str = "float32",
                 max_slots_cap: Optional[int] = None,
                 headroom: float = 0.08,
                 draft_layers: int = 0,
@@ -1334,6 +1350,24 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     (page tables are host-side and replicated); only the byte
     accounting divides.
 
+    ``kv_dtype="int8"`` prices pages at the int8 itemsize PLUS the
+    per-(layer, page, head) fp32 scale sidecar ``PagedKVPool`` keeps
+    for both K and V — that is what carves ~2× the pages at equal HBM
+    (composing multiplicatively with ``tp_degree``: 2×tp× per-chip
+    capacity).  The dense gather workspace stays priced at fp32: the
+    pool dequantizes on read, so the decode step's transient view is
+    full-precision regardless of what the pages store.  The draft's
+    dense KV charge shrinks with the same itemsize (+ its scale rows).
+
+    ``weight_dtype="int8"`` re-prices the decode weights for the
+    weight-only quantization stamp: the quantizable matmul matrices
+    (q/k/v/out projections, fc1, fc2) drop to 1 byte/element plus
+    per-out-channel fp32 scales; biases, norms, embeddings and the
+    tied logits matmul stay fp32.  Col-parallel scales shard with tp,
+    row-parallel scales are replicated — the per-chip charge accounts
+    for both.  The plan records the raw fp32 parameter bytes as
+    ``weight_bytes_fp32`` so ``budget_drift`` can re-derive.
+
     Returns the plan dict ``PagedKVPool.from_plan`` consumes; every
     input is recorded in it so ``serving.kv_pool.budget_drift`` can
     re-derive the numbers and flag hand-edits, V504-style.
@@ -1363,9 +1397,22 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
         else:
             weight_bytes = _decode_weight_bytes(cfg)
     weight_bytes = int(weight_bytes)
+    weight_bytes_fp32 = weight_bytes
+    shardable = min(weight_bytes, _decode_shardable_bytes(cfg))
+    weight_dtype = str(weight_dtype)
+    if weight_dtype not in ("float32", "int8"):
+        raise ValueError(
+            f"page_budget: weight_dtype must be float32 or int8, got "
+            f"{weight_dtype!r}")
+    if weight_dtype == "int8":
+        elems, col_ch, row_ch = _decode_quantizable_counts(cfg)
+        # matrices go 4B -> 1B; fp32 scales come back per out-channel
+        weight_bytes -= elems * 3 - (col_ch + row_ch) * 4
+        # the shardable set holds the matrices (now 1B) and the
+        # col-parallel scales; row-parallel scales are replicated
+        shardable = min(weight_bytes, shardable - elems * 3 + col_ch * 4)
     # per-chip weights: the Megatron-splittable subset divides by tp,
     # the replicated remainder (embeddings/LN/row biases) is paid whole
-    shardable = min(weight_bytes, _decode_shardable_bytes(cfg))
     weight_bytes_pc = weight_bytes - (shardable - shardable // tp)
     cap = int(max_slots_cap) if max_slots_cap else 64
     # ctx_req is the pre-clamp INPUT (recorded for budget_drift: feeding
@@ -1380,6 +1427,16 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     H_loc = H // tp                               # heads resident per chip
     token_bytes_pc = 2 * L * H_loc * Dh * itemsize
     page_bytes_pc = token_bytes_pc * T
+    quant_kv = np.dtype(kv_dtype) == np.int8
+    if quant_kv:
+        # the pool's per-(layer, page, head) fp32 scale sidecars (K and
+        # V) ride every page — charged so the ~2x carve is honest
+        page_bytes += 2 * L * H * 4
+        page_bytes_pc += 2 * L * H_loc * 4
+    # the decode step's dense gather view is DEQUANTIZED on read, so
+    # the per-slot workspace stays fp32 even over int8 pages
+    ws_item = 4 if quant_kv else itemsize
+    ws_col_pc = 2 * L * H_loc * Dh * ws_item
     # speculative draft charge: a draft_layers-layer sibling's weights
     # are resident beside the target, and every decode slot carries a
     # dense draft KV cache at the same pow2 context bucket (both shard
@@ -1397,9 +1454,13 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
             - (d_shard - d_shard // tp)
         draft_kv_slot_pc = 2 * draft_layers * H_loc * _next_pow2(ctx) \
             * Dh * itemsize
+        if quant_kv:
+            # the draft's dense int8 KV carries per-(layer, head)
+            # fp32 scales, same sidecar layout as the pool's pages
+            draft_kv_slot_pc += 2 * draft_layers * H_loc * 4
     usable = int(budget * (1.0 - float(headroom))) - weight_bytes_pc \
         - draft_weight_bytes_pc
-    if usable < page_bytes_pc + token_bytes_pc * _next_pow2(ctx):
+    if usable < page_bytes_pc + ws_col_pc * _next_pow2(ctx):
         raise ValueError(
             f"page_budget: {budget} B HBM/chip leaves {usable} B after "
             f"{weight_bytes_pc} B of per-chip weights"
@@ -1413,7 +1474,7 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
     # view at the largest pow2 KV bucket, plus this row's REPLICATED
     # logits (the row-parallel head allreduces full vocab everywhere),
     # and the draft model's per-slot dense KV when speculating
-    ws_slot = 2 * L * H_loc * _next_pow2(ctx) * Dh * itemsize \
+    ws_slot = ws_col_pc * _next_pow2(ctx) \
         + cfg["vocab_size"] * 4 + draft_kv_slot_pc
     max_slots = max(1, min(cap, int(usable * 0.35) // ws_slot))
     pages = (usable - max_slots * ws_slot) // page_bytes_pc
@@ -1452,10 +1513,12 @@ def page_budget(model=None, config=None, *, page_tokens: int = 16,
         "num_heads": H,
         "head_dim": Dh,
         "kv_dtype": str(kv_dtype),
+        "weight_dtype": weight_dtype,
         "page_bytes": int(page_bytes),
         "kv_bytes": int(pages * page_bytes),
         "workspace_bytes": int(max_slots * ws_slot),
         "weight_bytes": weight_bytes,
+        "weight_bytes_fp32": weight_bytes_fp32,
         "tp_degree": tp,
         "weight_bytes_per_chip": int(weight_bytes_pc),
         "page_bytes_per_chip": int(page_bytes_pc),

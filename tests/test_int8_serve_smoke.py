@@ -1,0 +1,26 @@
+"""Tier-1 int8-serving gate (NOT marked slow — losing the int8 page
+capacity win, quantized-decode token equality, or the radix/spec
+composition over int8 pages is a serving regression that must fail the
+suite, not wait for a perf round).
+
+Drives tools/int8_serve_smoke.py in-process: one pinned HBM budget
+sized at fp32 and int8 by ``static.page_budget``, the Int8Linear
+engine over int8 KV pages with radix retention and a speculative
+draft, token-equality vs the fp32 paged engine, and a zero-retrace
+repeat of the warmed buckets."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_int8_serve_smoke_gate():
+    import int8_serve_smoke
+    result = int8_serve_smoke.run_smoke()
+    assert result["page_capacity_ratio"] >= 1.9, result
+    assert result["token_equal"] is True, result
+    assert result["traces_after_warmup"] == 0, result
+    assert result["quant_scale_clips"] == 0, result
+    assert result["radix_hit_tokens"] > 0, result
+    assert result["accepted_per_step"] > 1.0, result

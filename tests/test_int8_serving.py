@@ -1,0 +1,511 @@
+"""int8 serving path: quantized KV pages + weight-only decode matmuls
+(serving/kv_pool.py kv_dtype="int8", serving/int8_decode.py,
+slim.freeze_weights_int8, static.page_budget dtype arithmetic).
+
+Covers the pool's quantize-on-write/dequantize-on-read contract (fp32
+gather, COW scale copies, requantize-on-grow without clips, truncate
+riding unchanged), the planner's dtype pricing (int8 pages ~2x fp32 at
+equal budget with the scale sidecar charged, multiplicative composition
+with tp_degree, int8 weight repricing, int8 draft KV), budget_drift's
+dtype-disagreement catch, engine-level token-equality at tp=1 and tp=2
+(radix + speculative riding int8 pages with their counters intact),
+the static stamp's structural exclusions (transposed matmuls stay
+fp32), int8_matmul FLOP pricing, and the Prometheus exposition of the
+quantization gauges."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import (ContinuousBatchingEngine, PagedKVPool,
+                                RadixPrefixCache, SpeculativeDecoder,
+                                budget_drift, metrics, stamp_draft)
+
+_CFG = {"vocab_size": 64, "hidden_size": 32, "num_layers": 2,
+        "num_heads": 4, "max_position": 128}
+
+
+def _int8_pool(pages=16, T=4, L=2, H=2, Dh=4):
+    return PagedKVPool(num_layers=L, num_heads=H, head_dim=Dh,
+                       page_tokens=T, num_pages=pages, kv_dtype="int8")
+
+
+def _rand_kv(rng, L, H, n, Dh, scale=1.0):
+    return ((rng.randn(L, H, n, Dh) * scale).astype(np.float32),
+            (rng.randn(L, H, n, Dh) * scale).astype(np.float32))
+
+
+# -- pool: quantize-on-write / dequantize-on-read ---------------------------
+def test_int8_pool_gather_returns_fp32_within_quant_error():
+    pool = _int8_pool()
+    assert pool.is_quantized and pool.dtype == np.int8
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(2, 30, (7,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 7, 4)
+    t = pool.open_sequence(prompt, k, v)
+    kg, vg = pool.gather(t)
+    assert kg.dtype == np.float32 and vg.dtype == np.float32
+    # per-(layer,page,head) absmax/127 grid: relative error <= 1/127
+    # of each head's absmax over the page
+    tol = np.abs(k).max() / 127.0 + 1e-7
+    np.testing.assert_allclose(kg, k, atol=tol)
+    np.testing.assert_allclose(vg, v, atol=tol)
+    assert pool.stats()["kv_dtype"] == "int8"
+    pool.close_sequence(t)
+    pool.assert_drained()
+
+
+def test_int8_cow_copies_scales_and_isolates_sharers():
+    """COW on an int8 pool must copy the scale rows with the page, or
+    the writer's requantize-on-grow would silently rescale the
+    sharer's resident columns."""
+    pool = _int8_pool()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(2, 30, (6,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 6, 4)
+    t1 = pool.open_sequence(prompt, k, v)
+    t2 = pool.open_sequence(prompt, k.copy(), v.copy())
+    k1_before, _ = pool.gather(t1)
+    # append a 10x-magnitude column: COW + scale grow on the copy only
+    kc, vc = _rand_kv(rng, 2, 2, 1, 4, scale=10.0)
+    pool.append_column(t2, kc[:, :, 0], vc[:, :, 0])
+    assert pool.cow_copies == 1
+    assert t1.pages[1] != t2.pages[1]
+    k1_after, _ = pool.gather(t1)
+    np.testing.assert_array_equal(k1_before, k1_after)
+    k2g, _ = pool.gather(t2)
+    tol = 10.0 / 127.0 + 1e-7
+    np.testing.assert_allclose(k2g[:, :, 6], kc[:, :, 0], atol=tol)
+    pool.close_sequence(t1)
+    pool.close_sequence(t2)
+    pool.assert_drained()
+
+
+def test_int8_requantize_on_grow_never_clips():
+    """A decode column hotter than the page's resident absmax grows the
+    scale and requantizes residents under it — the clip counter stays
+    zero (clipping would silently corrupt attention over old tokens)."""
+    pool = _int8_pool()
+    clips0 = pool.quant_scale_clips
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(2, 30, (3,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 3, 4, scale=0.1)
+    t = pool.open_sequence(prompt, k, v)
+    kc, vc = _rand_kv(rng, 2, 2, 1, 4, scale=50.0)   # 500x hotter
+    pool.append_column(t, kc[:, :, 0], vc[:, :, 0])
+    assert pool.quant_scale_clips == clips0 == 0
+    kg, _ = pool.gather(t)
+    # residents survive the regrind at the new (coarser) grid
+    tol = np.abs(kc).max() / 127.0 + 1e-7
+    np.testing.assert_allclose(kg[:, :, :3], k, atol=tol)
+    np.testing.assert_allclose(kg[:, :, 3], kc[:, :, 0], atol=tol)
+    pool.close_sequence(t)
+    pool.assert_drained()
+
+
+def test_int8_truncate_rides_page_id_plumbing():
+    """Speculative rollback is pure page-table arithmetic — on an int8
+    pool it must behave identically (scales are per page, not per
+    column, so dropping tail columns needs no scale bookkeeping)."""
+    pool = _int8_pool()
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(2, 30, (5,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 5, 4)
+    t = pool.open_sequence(prompt, k, v)
+    kc, vc = _rand_kv(rng, 2, 2, 2, 4)
+    pool.append_column(t, kc[:, :, 0], vc[:, :, 0])
+    pool.append_column(t, kc[:, :, 1], vc[:, :, 1])
+    pool.truncate(t, 5)           # roll both decode columns back
+    assert t.length == 5
+    kg, _ = pool.gather(t)
+    assert kg.shape[2] == 5
+    tol = np.abs(k).max() / 127.0 + 1e-7
+    np.testing.assert_allclose(kg, k, atol=tol)
+    pool.close_sequence(t)
+    pool.assert_drained()
+
+
+# -- planner dtype arithmetic -----------------------------------------------
+def test_page_budget_int8_carves_about_2x_pages():
+    from paddle_tpu.static import page_budget
+    hbm = 4 * 1024 * 1024
+    pf = page_budget(config=_CFG, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, weight_bytes=0)
+    pi = page_budget(config=_CFG, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, weight_bytes=0, kv_dtype="int8")
+    assert pi["kv_dtype"] == "int8"
+    assert pi["pages"] >= 1.9 * pf["pages"]
+    # the sidecar keeps it under a clean 2x of the data bytes alone
+    L, H = _CFG["num_layers"], _CFG["num_heads"]
+    assert pi["page_bytes"] == pf["page_bytes"] // 4 + 2 * L * H * 4
+    pool = PagedKVPool.from_plan(pi)
+    assert pool.is_quantized
+    assert budget_drift(pool) == []
+
+
+def test_page_budget_int8_composes_with_tp():
+    """kv_dtype="int8" and tp_degree=2 are independent multipliers on
+    per-chip page cost: int8 x tp2 carves ~2x the tp2-fp32 pages, and
+    the per-chip scale sidecar charges only the local heads."""
+    from paddle_tpu.static import page_budget
+    hbm = 256 * 1024
+    pf = page_budget(config=_CFG, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, tp_degree=2)
+    pi = page_budget(config=_CFG, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, tp_degree=2, kv_dtype="int8")
+    assert pi["pages"] >= 1.9 * pf["pages"]
+    L, H = _CFG["num_layers"], _CFG["num_heads"]
+    # global sidecar charges all H heads, per-chip only H/2
+    assert (pi["page_bytes"] - 2 * L * H * 4) == \
+        2 * (pi["page_bytes_per_chip"] - 2 * L * (H // 2) * 4)
+    pool = PagedKVPool.from_plan(pi)
+    assert pool.tp_degree == 2 and pool.is_quantized
+    assert budget_drift(pool) == []
+
+
+def test_page_budget_int8_weight_dtype_reprices_and_records():
+    """weight_dtype="int8" returns ~3 of every 4 decode-matmul weight
+    bytes to the carve (int8 payload + per-out-channel fp32 scales) and
+    records both the served dtype and the original fp32 bytes so
+    budget_drift re-derives without double-quantizing."""
+    from paddle_tpu.static import page_budget
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    with dg.guard():
+        m = GPTForGeneration(GPTModel(GPTConfig(dropout=0.0, **_CFG)))
+        m.eval()
+        wb = int(sum(np.asarray(p.numpy()).nbytes
+                     for p in m.gpt.parameters()))
+        hbm = wb + 256 * 1024
+        pf = page_budget(m, page_tokens=16, max_context=128,
+                         hbm_bytes=hbm)
+        pi = page_budget(m, page_tokens=16, max_context=128,
+                         hbm_bytes=hbm, weight_dtype="int8")
+    assert pi["weight_dtype"] == "int8"
+    assert pi["weight_bytes_fp32"] == pf["weight_bytes"] == wb
+    assert pi["weight_bytes"] < pf["weight_bytes"]
+    # the returned bytes become pages: strictly more than fp32 weights
+    assert pi["pages"] > pf["pages"]
+    pool = PagedKVPool.from_plan(pi)
+    assert budget_drift(pool) == []
+
+
+def test_page_budget_int8_draft_charge_shrinks():
+    """The speculative draft's dense per-slot KV is charged at the kv
+    dtype: at int8 (+ scale rows) each slot costs less workspace, so
+    the same budget with a draft carves more pages."""
+    from paddle_tpu.static import page_budget
+    cfg = dict(_CFG, num_layers=4)
+    hbm = 4 * 1024 * 1024
+    pf = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, weight_bytes=0, draft_layers=2)
+    pi = page_budget(config=cfg, page_tokens=16, max_context=128,
+                     hbm_bytes=hbm, weight_bytes=0, draft_layers=2,
+                     kv_dtype="int8")
+    ws_f = pf["workspace_bytes"] // pf["max_slots"]
+    ws_i = pi["workspace_bytes"] // pi["max_slots"]
+    assert ws_i < ws_f
+    assert pi["pages"] > pf["pages"]
+
+
+def test_budget_drift_catches_dtype_disagreement():
+    """A pool storing fp32 under a plan that budgeted int8 pages is the
+    silent 2x-overcommit: budget_drift must name the dtype before the
+    page-count re-derivation confuses the report."""
+    from paddle_tpu.static import page_budget
+    plan = page_budget(config=_CFG, page_tokens=16, max_context=128,
+                       hbm_bytes=4 * 1024 * 1024, weight_bytes=0,
+                       kv_dtype="int8")
+    pool = PagedKVPool.from_plan(plan)
+    assert budget_drift(pool) == []
+    wrong = PagedKVPool(num_layers=_CFG["num_layers"],
+                        num_heads=_CFG["num_heads"],
+                        head_dim=_CFG["hidden_size"] // _CFG["num_heads"],
+                        page_tokens=plan["page_tokens"],
+                        num_pages=plan["pages"])
+    wrong.plan = dict(plan)
+    drift = budget_drift(wrong)
+    assert drift and any("kv_dtype" in d for d in drift)
+
+
+# -- engine token-equality --------------------------------------------------
+class _ScriptedFlaky(SpeculativeDecoder):
+    """Proposals scripted from the fp32 reference chains, with every
+    3rd call's first token flipped off the chain: unflipped calls are
+    guaranteed accepts, flipped calls guaranteed rejections — so both
+    accept and ROLLBACK traffic through the quantized page tables is
+    forced by construction, not by the weight draw, and the acceptance
+    rule keeps the output token-equal regardless.  open/commit/close
+    are no-ops (no draft model runs — the dense draft KV is off-pool
+    and already covered by test_speculative.py); what this isolates is
+    the engine's verify/append/truncate riding int8 pages."""
+
+    def __init__(self, model, scripts, k=2):
+        super().__init__(model, k=k)
+        self.scripts = [[int(t) for t in s] for s in scripts]
+        self._calls = 0
+
+    def open(self, slot, prompt_tokens):
+        pass
+
+    def close(self, slot):
+        pass
+
+    def commit(self, slot, committed, pending):
+        pass
+
+    def propose(self, slot, committed, pending, n=None):
+        n = self.k if n is None else min(int(n), self.k)
+        script = next((s for s in self.scripts
+                       if len(s) >= len(committed)
+                       and all(int(a) == int(b)
+                               for a, b in zip(committed, s))), None)
+        pos = len(committed) + 1        # stream = committed + [pending]
+        out = [] if script is None else script[pos:pos + n]
+        self._calls += 1
+        if out and self._calls % 3 == 0:
+            out = list(out)
+            out[0] = (out[0] + 1) % self.config.vocab_size
+        return out
+
+
+def _gpt():
+    # pin the process-wide init generator: the int8 EQUALITY contract is
+    # per-model, so the weights under test must not drift with test order
+    import paddle_tpu
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    paddle_tpu.seed(1234)
+    cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                    num_heads=4, max_position=64, dropout=0.0)
+    return GPTForGeneration(GPTModel(cfg))
+
+
+@pytest.mark.slow
+def test_int8_engine_token_equal_tp1():
+    """The tp=1 int8 contract: an engine resolving weight_dtype="int8"
+    from the plan (Int8Linear-swapped sibling) over int8 KV pages must
+    reproduce the fp32 paged engine's greedy output token for token on
+    this model — the tested tolerance is EQUALITY (see docs/serving.md
+    for the acceptance rule if a future model breaks it).  Slow: the
+    tier-1 copy of this contract is tools/int8_serve_smoke.py."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.static import page_budget
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(2, 48, (n,)).astype(np.int64)
+               for n in (3, 5, 7, 4)]
+    with dg.guard():
+        m = _gpt()
+        m.eval()
+        plan_f = page_budget(m, page_tokens=4, max_context=64)
+        pool_f = PagedKVPool.from_plan(plan_f)
+        eng = ContinuousBatchingEngine(m, max_slots=2,
+                                       kv_pool=pool_f).start()
+        try:
+            refs = [np.asarray(eng.submit(p, max_length=6)
+                               .result(timeout=120)) for p in prompts]
+        finally:
+            eng.stop()
+        pool_f.assert_drained()
+
+        plan_i = page_budget(m, page_tokens=4, max_context=64,
+                             kv_dtype="int8", weight_dtype="int8")
+        pool_i = PagedKVPool.from_plan(plan_i)
+        eng = ContinuousBatchingEngine(m, max_slots=2, kv_pool=pool_i)
+        assert eng.weight_dtype == "int8"
+        eng.start()
+        try:
+            outs = [np.asarray(eng.submit(p, max_length=6)
+                               .result(timeout=120)) for p in prompts]
+        finally:
+            eng.stop()
+    for i, (ref, out) in enumerate(zip(refs, outs)):
+        np.testing.assert_array_equal(
+            ref, out, err_msg=f"prompt {i} diverged under int8")
+    assert pool_i.stats()["quant_scale_clips"] == 0
+    pool_i.assert_drained()
+
+
+@pytest.mark.slow
+def test_int8_engine_token_equal_tp2_with_radix_and_spec():
+    """The full composition: a tp=2 engine (static int8 stamp inside
+    TPShardedDecoder) over int8 sharded pages, with radix retention and
+    a scripted speculative draft forcing both accepts and rollbacks,
+    reproduces the fp32 tp=1 paged engine token for token — and the
+    spec/radix
+    counters behave exactly as on fp32 pages (quantization must be
+    invisible to the page-id plumbing).  Slow: ~2 min of tp=2 mesh
+    bucket compiles on the CPU host; the tier-1 int8 gate is
+    tools/int8_serve_smoke.py."""
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.static import page_budget
+    rng = np.random.RandomState(17)
+    head = rng.randint(2, 48, (8,)).astype(np.int64)   # 2 full pages
+    prompts = [np.concatenate([head, rng.randint(2, 48, (3,))
+                               .astype(np.int64)]) for _ in range(2)]
+    prompts.append(rng.randint(2, 48, (5,)).astype(np.int64))
+    prompts.append(prompts[0].copy())          # whole-prompt radix hit
+    with dg.guard():
+        m = _gpt()
+        m.eval()
+        plan_f = page_budget(m, page_tokens=4, max_context=64)
+        ref_pool = PagedKVPool.from_plan(plan_f)
+        eng = ContinuousBatchingEngine(m, max_slots=2,
+                                       kv_pool=ref_pool).start()
+        try:
+            refs = [np.asarray(eng.submit(p, max_length=5)
+                               .result(timeout=120)) for p in prompts]
+        finally:
+            eng.stop()
+        ref_pool.assert_drained()
+
+        plan_i = page_budget(m, page_tokens=4, max_context=64,
+                             tp_degree=2, kv_dtype="int8",
+                             weight_dtype="int8")
+        pool = PagedKVPool.from_plan(plan_i)
+        radix = RadixPrefixCache(pool, low_watermark=2, high_watermark=4)
+        spec = _ScriptedFlaky(stamp_draft(m, num_layers=1),
+                              [r.tolist() for r in refs], k=2)
+        eng = ContinuousBatchingEngine(m, max_slots=2, kv_pool=pool,
+                                       prefix_cache=radix,
+                                       speculative=spec)
+        assert eng.tp_degree == 2 and eng.weight_dtype == "int8"
+        eng.start()
+        try:
+            outs = [np.asarray(eng.submit(p, max_length=5)
+                               .result(timeout=300)) for p in prompts]
+        finally:
+            eng.stop()
+    for i, (ref, out) in enumerate(zip(refs, outs)):
+        np.testing.assert_array_equal(
+            ref, out, err_msg=f"prompt {i} diverged on int8 tp=2")
+    assert radix.hits >= 1, "radix hit never rode the int8 pages"
+    assert metrics.counter("spec.accepted") >= 1
+    assert metrics.counter("spec.rollback_cols") >= 1, \
+        "shallow draft produced no rollbacks on int8 pages"
+    pool.assert_drained()
+    radix.clear()
+    pool.assert_drained()
+
+
+def test_engine_weight_dtype_mismatch_rejected():
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.static import page_budget
+    with dg.guard():
+        m = _gpt()
+        plan = page_budget(m, page_tokens=4, max_context=64,
+                           weight_dtype="int8")
+        pool = PagedKVPool.from_plan(plan)
+        with pytest.raises(ValueError, match="weight_dtype mismatch"):
+            ContinuousBatchingEngine(m, kv_pool=pool,
+                                     weight_dtype="float32")
+
+
+# -- static stamp structural exclusions -------------------------------------
+def test_freeze_skips_transposed_and_non_param_matmuls():
+    """Regression for the tied-embedding bug: ``layers.matmul`` stamps
+    ``transpose_Y`` (capitalized), and the logits row reuses the
+    embedding table with transpose_y=True — the stamp must leave it
+    (and any activation x activation matmul) fp32, or the embedding
+    var gets popped out from under the lookup."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.static.param_attr import ParamAttr
+    from paddle_tpu.slim.quantization import freeze_weights_int8
+    from paddle_tpu.static.executor import Scope
+    from paddle_tpu.core.program import _reset_unique_names
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = layers.data("ids", [-1, 4], dtype="int64")
+        tok = layers.embedding(ids, size=[16, 8],
+                               param_attr=ParamAttr(name="wte"))
+        h = layers.fc(tok, 8, num_flatten_dims=2,
+                      param_attr=ParamAttr(name="fc_w"),
+                      bias_attr=ParamAttr(name="fc_b"))
+        wte_w = main.global_block().var("wte")
+        layers.matmul(h, wte_w, transpose_y=True)    # tied logits row
+    sc = Scope()
+    rng = np.random.RandomState(0)
+    sc.set("wte", rng.randn(16, 8).astype(np.float32))
+    sc.set("fc_w", rng.randn(8, 8).astype(np.float32))
+    sc.set("fc_b", rng.randn(8).astype(np.float32))
+    n = freeze_weights_int8(main, sc)
+    assert n == 1                            # only the fc's mul
+    types = [op.type for op in main.global_block().ops]
+    assert "int8_matmul" in types
+    assert "matmul" in types                 # the transposed logits row
+    assert main.global_block().has_var("wte"), \
+        "tied embedding popped out from under lookup_table"
+
+
+# -- pricing + observability ------------------------------------------------
+def test_flops_analysis_prices_int8_matmul():
+    """The walk must price int8_matmul from its X/W slots (2*M*K*N) and
+    report the int8 share for the roofline's 2x-MXU-rate leg."""
+    import paddle_tpu.static as static
+    from paddle_tpu.static import layers
+    from paddle_tpu.static.param_attr import ParamAttr
+    from paddle_tpu.slim.quantization import freeze_weights_int8
+    from paddle_tpu.static.flops_analysis import analyze_flops
+    from paddle_tpu.static.executor import Scope
+    from paddle_tpu.core.program import _reset_unique_names
+    _reset_unique_names()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = layers.data("x", [-1, 8])
+        layers.fc(x, 6, param_attr=ParamAttr(name="w_f"),
+                  bias_attr=False)
+    before = analyze_flops(main, batch=4)
+    assert before["int8_flops"] == 0
+    sc = Scope()
+    sc.set("w_f", np.random.RandomState(0).randn(8, 6)
+           .astype(np.float32))
+    assert freeze_weights_int8(main, sc) == 1
+    after = analyze_flops(main, batch=4)
+    assert after["int8_flops"] == 2 * 4 * 8 * 6
+    assert after["total_flops"] == before["total_flops"]
+
+
+def test_int8_decode_program_layout_is_v6xx_clean():
+    """The stamped tp=2 decode program — int8 weights sharded on out
+    channels with their scale vectors, row-parallel scales replicated —
+    must analyze clean under the V6xx propagator."""
+    from paddle_tpu.models import GPTConfig, GPTModel
+    from paddle_tpu.serving.tp_decode import (build_decode_program,
+                                              _param_map)
+    from paddle_tpu.slim.quantization import freeze_weights_int8
+    from paddle_tpu.static.executor import Scope
+    from paddle_tpu.static.layout_analysis import propagate_shardings
+    import paddle_tpu.dygraph as dg
+    cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                    num_heads=4, max_position=64, dropout=0.0)
+    with dg.guard():
+        np.random.seed(0)
+        m = GPTModel(cfg)
+        m.eval()
+        sd = m.state_dict()
+        prog, _, _ = build_decode_program(cfg, batch=4, cache_len=16,
+                                          width=1, tp_degree=2)
+        sc = Scope()
+        for pname, key in _param_map(cfg).items():
+            sc.set(pname, np.asarray(sd[key].numpy(), np.float32))
+        n = freeze_weights_int8(prog, sc)
+    assert n == 6 * cfg.num_layers
+    layout = propagate_shardings(prog, mesh_shape={"dp": 4, "tp": 2},
+                                 batch=4)
+    assert layout.diagnostics == [], layout.diagnostics
+
+
+def test_int8_quant_gauges_reach_prometheus():
+    from paddle_tpu.core.monitor import prometheus_text
+    pool = _int8_pool(pages=8)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(2, 30, (4,)).astype(np.int64)
+    k, v = _rand_kv(rng, 2, 2, 4, 4)
+    t = pool.open_sequence(prompt, k, v)
+    stats = pool.stats()
+    assert stats["kv_dtype"] == "int8"
+    assert stats["quant_scale_clips"] == 0
+    text = prometheus_text()
+    assert "serving_kv_kv_dtype_int8" in text
+    assert "serving_kv_quant_scale_clips" in text
+    pool.close_sequence(t)
+    pool.assert_drained()

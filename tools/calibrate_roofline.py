@@ -183,7 +183,7 @@ def _measure(width, spec, batch, world):
     return min(times) * 1e3   # ms
 
 
-def _decode_pair(label, B, lc, W, tp, peak):
+def _decode_pair(label, B, lc, W, tp, peak, weight_dtype="float32"):
     """One decode-step (memory-bound) calibration pair: the serving hot
     path is a tiny-FLOP, cache-dominated bucket, so its measured time is
     mostly dispatch intercept + mp wire — exactly the legs the training
@@ -193,23 +193,46 @@ def _decode_pair(label, B, lc, W, tp, peak):
     small at this geometry), serial wire from the per-layer Megatron
     collectives (two allreduces + the two KV gathers) over the ici
     rate.  Measurement drives `serving.TPShardedDecoder` — the same
-    CompiledProgram the engine runs — best-of-3 over STEPS steps."""
+    CompiledProgram the engine runs — best-of-3 over STEPS steps.
+
+    At weight_dtype="int8" the program is first stamped through
+    `slim.freeze_weights_int8` (the decoder applies the same stamp
+    internally) and the int8 share of the walk is priced at
+    `INT8_MXU_RATE` x the matmul rate — the v5e MXU claim the queued
+    on-chip rows check; on this CPU host the decode step is
+    intercept-dominated, so the fitted residual barely sees the rate
+    and the pair's job is pinning the int8 wire/intercept shape."""
     import jax
     import numpy as np
     import paddle_tpu
     from paddle_tpu.models.gpt import GPTModel, GPTConfig
     from paddle_tpu.nn import MultiHeadAttention
     from paddle_tpu.serving.tp_decode import (TPShardedDecoder,
-                                              build_decode_program)
-    from paddle_tpu.static.flops_analysis import analyze_flops
+                                              build_decode_program,
+                                              _param_map)
+    from paddle_tpu.static.flops_analysis import (analyze_flops,
+                                                  INT8_MXU_RATE)
     from paddle_tpu.static.planner import ici_bytes_per_chip
 
     cfg = GPTConfig(vocab_size=64, hidden_size=64, num_layers=2,
                     num_heads=4, max_position=256, dropout=0.0)
     prog, _, _ = build_decode_program(cfg, batch=B, cache_len=lc,
                                       width=W, tp_degree=tp)
-    flops = analyze_flops(prog, batch=B)["total_flops"]
-    compute_ms = flops / max(tp, 1) / peak * 1e3
+    np.random.seed(0)
+    m = GPTModel(cfg)
+    m.eval()
+    if weight_dtype == "int8":
+        from paddle_tpu.slim.quantization import freeze_weights_int8
+        from paddle_tpu.static.executor import Scope
+        sd = m.state_dict()
+        sc = Scope()
+        for pname, key in _param_map(cfg).items():
+            sc.set(pname, np.asarray(sd[key].numpy(), np.float32))
+        freeze_weights_int8(prog, sc)
+    fl = analyze_flops(prog, batch=B)
+    fp_flops = fl["total_flops"] - fl.get("int8_flops", 0)
+    compute_ms = ((fp_flops + fl.get("int8_flops", 0) / INT8_MXU_RATE)
+                  / max(tp, 1) / peak * 1e3)
     # per-layer serial mp wire: ring allreduce moves 2(tp-1)/tp of the
     # [B, W, hidden] activation twice (o-proj + fc2), the two c_concat
     # KV gathers move (tp-1)/tp of it each
@@ -218,12 +241,10 @@ def _decode_pair(label, B, lc, W, tp, peak):
     wire = cfg.num_layers * (2 * 2 * frac * act + 2 * frac * act)
     wire_serial_ms = wire / ici_bytes_per_chip() * 1e3
 
-    np.random.seed(0)
-    m = GPTModel(cfg)
-    m.eval()
     world = 8 if tp > 1 else 1
     places = None if tp > 1 else [jax.devices()[0]]
-    dec = TPShardedDecoder(m, tp_degree=tp, places=places)
+    dec = TPShardedDecoder(m, tp_degree=tp, places=places,
+                           weight_dtype=weight_dtype)
     ids = np.random.randint(0, cfg.vocab_size, (B, W)).astype(np.int64)
     k = np.random.randn(cfg.num_layers, B, cfg.num_heads, lc,
                         cfg.hidden_size // cfg.num_heads)
@@ -249,7 +270,8 @@ def _decode_pair(label, B, lc, W, tp, peak):
         np.asarray(out.numpy())
         times.append((time.time() - t0) / STEPS)
     return {"label": label, "batch": B, "width": W, "world": world,
-            "knobs": {"decode": True, "tp_degree": tp, "cache_len": lc},
+            "knobs": {"decode": True, "tp_degree": tp, "cache_len": lc,
+                      "weight_dtype": weight_dtype},
             "compute_ms": compute_ms,
             "wire_overlap_ms": 0.0,
             "wire_serial_ms": wire_serial_ms,
@@ -257,14 +279,18 @@ def _decode_pair(label, B, lc, W, tp, peak):
             "measured_ms": round(min(times) * 1e3, 4)}
 
 
-# (label, batch B, cache_len lc, step width W, tp degree) — the serving
-# regime's calibration rows: decode steps from the engine's bucket
-# lattice, tp=1 vs tp=2 so the per-world intercepts see both mesh
-# classes from the memory-bound side too
+# (label, batch B, cache_len lc, step width W, tp degree, weight dtype)
+# — the serving regime's calibration rows: decode steps from the
+# engine's bucket lattice, tp=1 vs tp=2 so the per-world intercepts see
+# both mesh classes from the memory-bound side too, plus the int8
+# stamped pair of each mesh class so the calibrated roofline carries
+# the INT8_MXU_RATE pricing leg
 DECODE_SHAPES = [
-    ("decode_b4_lc64_w1_tp1", 4, 64, 1, 1),
-    ("decode_b4_lc64_w1_tp2", 4, 64, 1, 2),
-    ("decode_b4_lc64_w4_tp2", 4, 64, 4, 2),
+    ("decode_b4_lc64_w1_tp1", 4, 64, 1, 1, "float32"),
+    ("decode_b4_lc64_w1_tp2", 4, 64, 1, 2, "float32"),
+    ("decode_b4_lc64_w4_tp2", 4, 64, 4, 2, "float32"),
+    ("decode_b4_lc64_w1_int8_tp1", 4, 64, 1, 1, "int8"),
+    ("decode_b4_lc64_w1_int8_tp2", 4, 64, 1, 2, "int8"),
 ]
 
 
@@ -303,8 +329,9 @@ def run_calibration():
         pairs.append(dict(pred, label=label, width=width, batch=batch,
                           world=world, knobs=dict(spec),
                           measured_ms=round(measured, 4)))
-    for label, B, lc, W, tp in DECODE_SHAPES:
-        pairs.append(_decode_pair(label, B, lc, W, tp, peak))
+    for label, B, lc, W, tp, wdt in DECODE_SHAPES:
+        pairs.append(_decode_pair(label, B, lc, W, tp, peak,
+                                  weight_dtype=wdt))
     cal = calibrate(pairs)
     return cal, pairs, peak
 

@@ -1,0 +1,184 @@
+"""Fast CPU gate for the int8 serving path: int8 pages carve ~2x the
+fp32 pool at a pinned budget, int8 decode stays token-equal to the
+fp32 engine, radix hits and speculative accepts ride int8 pages, zero
+post-warmup retraces, leak-free drain.
+
+The cheap canary for the quantized serving tier
+(tests/test_int8_serve_smoke.py runs it as a tier-1 test, mirroring
+page_smoke/spec_smoke/tp_serve_smoke):
+
+  * one pinned HBM budget (weights + a thin KV grant) sized by
+    ``static.page_budget`` at fp32 and at kv_dtype/weight_dtype
+    ="int8" — the int8 plan must carve >= 1.9x the pages (int8 KV
+    halves page bytes net of the fp32 scale sidecar, int8 weights
+    return ~3/4 of the decode-matmul weight bytes to the carve);
+  * an int8 engine (``quantize_decode_model``'s Int8Linear sibling
+    over int8 pages) with radix retention and a full-depth speculative
+    draft reproduces the fp32 paged engine's greedy output token for
+    token — the tested tolerance on this model is EQUALITY (see
+    docs/serving.md for the acceptance rule);
+  * the second identical prompt hits the radix tree (prefill runs only
+    the uncovered suffix) and speculation commits > 1 token per verify
+    step — both riding QUANTIZED pages;
+  * the compiled KV buckets stop growing after warmup, the scale-clip
+    counter stays zero, and the drained pool reports zero leaks.
+
+Prints one JSON line; correctness never depends on throughput.
+
+Usage: python tools/int8_serve_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# pinned budget: weights + a thin KV grant, so the fp32 pool is starved
+# and the int8 savings convert into visible pages
+SMOKE_KV_GRANT = 256 * 1024
+
+
+def run_smoke():
+    """Run the gate; returns the result dict (AssertionError on any
+    int8-serving contract regression)."""
+    os.environ.setdefault("PADDLE_TPU_VERIFY", "warn")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.dygraph as dg
+    from paddle_tpu.models import GPTConfig, GPTModel, GPTForGeneration
+    from paddle_tpu.serving import (ContinuousBatchingEngine, PagedKVPool,
+                                    RadixPrefixCache, SpeculativeDecoder,
+                                    metrics, stamp_draft)
+    from paddle_tpu.static import page_budget
+
+    t0 = time.time()
+    rng = np.random.RandomState(13)
+    with dg.guard():
+        # pin the process-wide init generator: the token-EQUALITY
+        # contract below is per-model, so the weights must not drift
+        # with whatever ran earlier in this process (tier-1 wrapper)
+        import paddle_tpu
+        paddle_tpu.seed(1234)
+        cfg = GPTConfig(vocab_size=48, hidden_size=16, num_layers=2,
+                        num_heads=2, max_position=64, dropout=0.0)
+        m = GPTForGeneration(GPTModel(cfg))
+        m.eval()
+
+        # -- planner budgets: int8 must out-carve fp32 >= 1.9x ---------
+        weight_bytes = int(sum(np.asarray(p.numpy()).nbytes
+                               for p in m.gpt.parameters()))
+        hbm = weight_bytes + SMOKE_KV_GRANT
+        plan_f = page_budget(m, page_tokens=4, max_context=64,
+                             hbm_bytes=hbm, draft_layers=2)
+        plan_i = page_budget(m, page_tokens=4, max_context=64,
+                             hbm_bytes=hbm, draft_layers=2,
+                             kv_dtype="int8", weight_dtype="int8")
+        ratio = plan_i["pages"] / max(1, plan_f["pages"])
+        assert ratio >= 1.9, \
+            f"int8 carved only {ratio:.2f}x fp32 pages " \
+            f"({plan_i['pages']} vs {plan_f['pages']}) at equal HBM"
+
+        pa = rng.randint(2, 48, (9,)).astype(np.int64)
+        pb = rng.randint(2, 48, (9,)).astype(np.int64)
+        # fp32 references through the plain paged engine (itself
+        # token-equal to generate(), gated by page_smoke)
+        ref_pool = PagedKVPool.from_plan(plan_f)
+        ref_eng = ContinuousBatchingEngine(m, max_slots=2,
+                                           kv_pool=ref_pool).start()
+        try:
+            refs = {key: np.asarray(
+                        ref_eng.submit(p, max_length=6).result(timeout=60))
+                    for key, p in (("a", pa), ("b", pb))}
+        finally:
+            ref_eng.stop()
+        ref_pool.assert_drained()
+
+        # -- the int8 engine: quantized weights + pages + radix + spec -
+        pool = PagedKVPool.from_plan(plan_i)
+        assert pool.is_quantized and pool.stats()["kv_dtype"] == "int8"
+        radix = RadixPrefixCache.from_plan(pool)
+        spec = SpeculativeDecoder(stamp_draft(m, num_layers=2), k=3)
+        eng = ContinuousBatchingEngine(m, max_slots=2, kv_pool=pool,
+                                       prefix_cache=radix,
+                                       speculative=spec)
+        assert eng.weight_dtype == "int8"
+        eng.start()
+        try:
+            # -- warmup: cold prefill + radix-hit reuse shapes ---------
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(
+                out, refs["a"], err_msg="int8 decode diverged from fp32")
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["a"])
+            warm_buckets = eng.kv_buckets
+
+            # -- radix hit skips prefill over QUANTIZED pages ----------
+            pre_prefill = metrics.counter("gen.prefill_tokens")
+            pre_hit = metrics.counter("kv.radix_hit_tokens")
+            pre_steps = metrics.counter("spec.steps")
+            pre_tokens = metrics.counter("gen.tokens")
+            out = eng.submit(pa, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["a"])
+            prefill_ran = metrics.counter("gen.prefill_tokens") - pre_prefill
+            hit_tokens = metrics.counter("kv.radix_hit_tokens") - pre_hit
+            assert hit_tokens > 0, \
+                "second identical prompt missed the radix tree"
+            assert prefill_ran < pa.size, "radix hit skipped no compute"
+
+            # -- speculation commits > 1 token per verify step ---------
+            spec_steps = metrics.counter("spec.steps") - pre_steps
+            committed = metrics.counter("gen.tokens") - pre_tokens
+            accepted_per_step = committed / max(1, spec_steps)
+            assert accepted_per_step > 1.0, \
+                f"speculation bought nothing on int8 pages: " \
+                f"{committed} tokens over {spec_steps} verify steps"
+
+            # -- cold second prompt: no new compiled shapes ------------
+            out = eng.submit(pb, max_length=6).result(timeout=60)
+            np.testing.assert_array_equal(out, refs["b"])
+            buckets_after = eng.kv_buckets
+        finally:
+            eng.stop()
+        retraces = buckets_after - warm_buckets
+        assert retraces == 0, \
+            f"{retraces} new compiled KV buckets after warmup"
+        stats = pool.stats()
+        assert stats["quant_scale_clips"] == 0, \
+            f"{stats['quant_scale_clips']} scale clips — the " \
+            f"requantize-on-grow policy must never clip"
+        retained = pool.pages_retained
+        assert retained > 0, "retirement inserted nothing into the tree"
+        pool.assert_drained()    # retained pages are clean, not leaks
+        radix.clear()
+        pool.assert_drained()
+
+    wall = time.time() - t0
+    return {
+        "metric": "int8_serve_smoke_wall_s",
+        "value": round(wall, 2),
+        "unit": "s",
+        "pages_fp32": plan_f["pages"],
+        "pages_int8": plan_i["pages"],
+        "page_capacity_ratio": round(ratio, 2),
+        "kv_dtype": stats["kv_dtype"],
+        "quant_scale_clips": stats["quant_scale_clips"],
+        "radix_hit_tokens": int(hit_tokens),
+        "prefill_tokens_on_hit": int(prefill_ran),
+        "accepted_per_step": round(accepted_per_step, 2),
+        "retained_pages_at_drain": int(retained),
+        "traces_after_warmup": retraces,
+        "token_equal": True,
+    }
+
+
+def main():
+    print(json.dumps(run_smoke()))
+
+
+if __name__ == "__main__":
+    main()
